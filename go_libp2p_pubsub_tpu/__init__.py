@@ -1,0 +1,16 @@
+"""go_libp2p_pubsub_tpu: a TPU-native pubsub framework.
+
+A from-scratch rebuild of the capabilities of go-libp2p-pubsub (FloodSub,
+RandomSub, GossipSub v1.0/v1.1 with peer scoring and attack hardening) in two
+cooperating halves:
+
+- ``core``: the protocol semantics as a pure-Python asyncio implementation
+  with full API parity (topics, subscriptions, validators, scoring, tracing).
+- ``models``/``ops``/``parallel``: the TPU simulation engine — the same
+  protocol expressed as vectorized JAX state transitions over all simulated
+  peers at once, sharded over a device mesh.
+
+See SURVEY.md at the repo root for the layer map this structure follows.
+"""
+
+__version__ = "0.1.0"

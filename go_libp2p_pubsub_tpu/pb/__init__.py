@@ -1,0 +1,32 @@
+"""Wire format layer (L0): proto2 codec + RPC and trace schemas."""
+
+from .proto import (
+    Field,
+    Message,
+    decode_uvarint,
+    encode_uvarint,
+    iter_delimited,
+    read_delimited,
+    write_delimited,
+)
+from .rpc import (
+    RPC,
+    CompatMessage,
+    ControlGraft,
+    ControlIHave,
+    ControlIWant,
+    ControlMessage,
+    ControlPrune,
+    PeerInfo,
+    PubMessage,
+    SubOpts,
+)
+from .trace import TraceEvent, TraceEventBatch, TraceType
+
+__all__ = [
+    "Field", "Message", "encode_uvarint", "decode_uvarint",
+    "write_delimited", "read_delimited", "iter_delimited",
+    "RPC", "PubMessage", "CompatMessage", "SubOpts", "ControlMessage",
+    "ControlIHave", "ControlIWant", "ControlGraft", "ControlPrune", "PeerInfo",
+    "TraceEvent", "TraceEventBatch", "TraceType",
+]

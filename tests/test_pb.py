@@ -1,0 +1,158 @@
+"""Wire codec tests: round-trips, framing, compat, and cross-checks against
+protobuf-canonical byte patterns (computed by hand from the proto2 spec)."""
+
+import pytest
+
+from go_libp2p_pubsub_tpu.pb import (
+    RPC,
+    CompatMessage,
+    ControlGraft,
+    ControlIHave,
+    ControlIWant,
+    ControlMessage,
+    ControlPrune,
+    PeerInfo,
+    PubMessage,
+    SubOpts,
+    TraceEvent,
+    TraceEventBatch,
+    TraceType,
+    decode_uvarint,
+    encode_uvarint,
+    iter_delimited,
+    read_delimited,
+    write_delimited,
+)
+from go_libp2p_pubsub_tpu.pb import trace as tr
+
+
+def test_uvarint_roundtrip():
+    for v in [0, 1, 127, 128, 300, 2**21, 2**35, 2**63, 2**64 - 1]:
+        enc = encode_uvarint(v)
+        dec, pos = decode_uvarint(enc)
+        assert dec == v and pos == len(enc)
+
+
+def test_uvarint_known_bytes():
+    # canonical protobuf examples
+    assert encode_uvarint(1) == b"\x01"
+    assert encode_uvarint(300) == b"\xac\x02"
+
+
+def test_message_known_encoding():
+    # field 2 (data, bytes) -> tag 0x12; field 4 (topic, string) -> tag 0x22
+    m = PubMessage(data=b"hi", topic="t")
+    assert m.encode() == b"\x12\x02hi\x22\x01t"
+
+
+def test_rpc_roundtrip():
+    rpc = RPC(
+        subscriptions=[SubOpts(subscribe=True, topicid="foo"),
+                       SubOpts(subscribe=False, topicid="bar")],
+        publish=[PubMessage(from_peer=b"\x01\x02", data=b"payload",
+                            seqno=b"\x00\x00\x00\x00\x00\x00\x00\x07",
+                            topic="foo", signature=b"sig", key=b"key")],
+        control=ControlMessage(
+            ihave=[ControlIHave(topic_id="foo", message_ids=[b"m1", b"\xff\xfe"])],
+            iwant=[ControlIWant(message_ids=[b"m2"])],
+            graft=[ControlGraft(topic_id="foo")],
+            prune=[ControlPrune(topic_id="bar",
+                                peers=[PeerInfo(peer_id=b"p1", signed_peer_record=b"rec")],
+                                backoff=60)],
+        ),
+    )
+    data = rpc.encode()
+    back = RPC.decode(data)
+    assert back == rpc
+    assert back.publish[0].data == b"payload"
+    assert back.control.ihave[0].message_ids == [b"m1", b"\xff\xfe"]
+    assert back.control.prune[0].backoff == 60
+
+
+def test_non_utf8_message_ids_roundtrip():
+    # the reference warns go protobuf emits invalid utf8 in string fields;
+    # our bytes-typed ids must round-trip arbitrary binary
+    ih = ControlIHave(topic_id="t", message_ids=[bytes(range(256))])
+    assert ControlIHave.decode(ih.encode()) == ih
+
+
+def test_compat_single_vs_multi_topic():
+    # new single-topic Message and old repeated topicIDs share field tag 4:
+    # a single-topic message decodes as a one-element topicIDs list and
+    # vice versa (reference compat_test.go:10-83 proves the same property).
+    new = PubMessage(from_peer=b"p", data=b"d", topic="topic-a")
+    old = CompatMessage.decode(new.encode())
+    assert old.topic_ids == ["topic-a"]
+
+    old2 = CompatMessage(from_peer=b"p", data=b"d", topic_ids=["t1", "t2"])
+    new2 = PubMessage.decode(old2.encode())
+    # last value wins for a non-repeated field per proto2 semantics
+    assert new2.topic == "t2"
+
+
+def test_unknown_fields_skipped():
+    # encode an RPC, append an unknown field (num 15, varint), decode fine
+    rpc = RPC(publish=[PubMessage(data=b"x", topic="t")])
+    raw = rpc.encode() + encode_uvarint((15 << 3) | 0) + encode_uvarint(42)
+    assert RPC.decode(raw) == rpc
+
+
+def test_delimited_framing():
+    msgs = [RPC(publish=[PubMessage(data=bytes([i]) * i, topic=f"t{i}")])
+            for i in range(5)]
+    buf = b"".join(write_delimited(m) for m in msgs)
+    out = list(iter_delimited(RPC, buf))
+    assert out == msgs
+
+
+def test_delimited_max_size():
+    big = RPC(publish=[PubMessage(data=b"x" * 100, topic="t")])
+    buf = write_delimited(big)
+    with pytest.raises(ValueError):
+        read_delimited(RPC, buf, 0, max_size=10)
+
+
+def test_trace_event_roundtrip():
+    ev = TraceEvent(
+        type=TraceType.GRAFT,
+        peer_id=b"me",
+        timestamp=1234567890,
+        graft=tr.GraftEv(peer_id=b"other", topic="t"),
+    )
+    back = TraceEvent.decode(ev.encode())
+    assert back == ev
+    assert back.type == TraceType.GRAFT
+    assert TraceType.NAMES[back.type] == "GRAFT"
+
+
+def test_trace_batch_roundtrip():
+    evs = [TraceEvent(type=TraceType.JOIN, peer_id=b"p", timestamp=i,
+                      join=tr.JoinEv(topic="x")) for i in range(10)]
+    batch = TraceEventBatch(batch=evs)
+    assert TraceEventBatch.decode(batch.encode()) == batch
+
+
+def test_negative_int64_timestamp():
+    ev = TraceEvent(type=TraceType.JOIN, timestamp=-1)
+    back = TraceEvent.decode(ev.encode())
+    assert back.timestamp == -1
+
+
+def test_duplicate_singular_message_merges():
+    # proto2: two occurrences of singular `control` merge, not replace
+    a = RPC(control=ControlMessage(ihave=[ControlIHave(topic_id="t", message_ids=[b"a"])]))
+    b = RPC(control=ControlMessage(iwant=[ControlIWant(message_ids=[b"b"])]))
+    merged = RPC.decode(a.encode() + b.encode())
+    assert len(merged.control.ihave) == 1 and len(merged.control.iwant) == 1
+
+
+def test_truncated_unknown_field_rejected():
+    rpc = RPC(publish=[PubMessage(data=b"x", topic="t")])
+    raw = rpc.encode() + encode_uvarint((15 << 3) | 2) + encode_uvarint(100) + b"short"
+    with pytest.raises(ValueError):
+        RPC.decode(raw)
+
+
+def test_varint_overflow_rejected():
+    with pytest.raises(ValueError):
+        decode_uvarint(b"\xff" * 9 + b"\x7f")

@@ -1,0 +1,145 @@
+"""Cross-check the hand-rolled codec against protoc-generated code.
+
+Encodes with our codec, decodes with the official protobuf runtime (and the
+reverse), proving byte-level interop with any stock protobuf implementation —
+which is what the Go reference uses on the wire.
+"""
+
+import importlib.util
+import subprocess
+import sys
+
+import pytest
+
+from go_libp2p_pubsub_tpu.pb import (
+    RPC, ControlGraft, ControlIHave, ControlIWant, ControlMessage,
+    ControlPrune, PeerInfo, PubMessage, SubOpts,
+)
+
+# Same wire contract as the reference (pb/rpc.proto), restated independently.
+RPC_PROTO = """
+syntax = "proto2";
+package interop.pb;
+
+message RPC {
+  repeated SubOpts subscriptions = 1;
+  repeated Message publish = 2;
+  message SubOpts {
+    optional bool subscribe = 1;
+    optional string topicid = 2;
+  }
+  optional ControlMessage control = 3;
+}
+message Message {
+  optional bytes from = 1;
+  optional bytes data = 2;
+  optional bytes seqno = 3;
+  optional string topic = 4;
+  optional bytes signature = 5;
+  optional bytes key = 6;
+}
+message ControlMessage {
+  repeated ControlIHave ihave = 1;
+  repeated ControlIWant iwant = 2;
+  repeated ControlGraft graft = 3;
+  repeated ControlPrune prune = 4;
+}
+message ControlIHave {
+  optional string topicID = 1;
+  repeated bytes messageIDs = 2;
+}
+message ControlIWant {
+  repeated bytes messageIDs = 1;
+}
+message ControlGraft {
+  optional string topicID = 1;
+}
+message ControlPrune {
+  optional string topicID = 1;
+  repeated PeerInfo peers = 2;
+  optional uint64 backoff = 3;
+}
+message PeerInfo {
+  optional bytes peerID = 1;
+  optional bytes signedPeerRecord = 2;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def pb2(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("interop_proto")
+    (tmp / "interop.proto").write_text(RPC_PROTO)
+    try:
+        subprocess.run(
+            ["protoc", f"--proto_path={tmp}", f"--python_out={tmp}", "interop.proto"],
+            check=True, capture_output=True,
+        )
+    except (FileNotFoundError, subprocess.CalledProcessError):
+        pytest.skip("protoc unavailable")
+    spec = importlib.util.spec_from_file_location("interop_pb2", tmp / "interop_pb2.py")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["interop_pb2"] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except Exception as e:  # runtime/gencode version mismatch
+        pytest.skip(f"protobuf runtime cannot load gencode: {e}")
+    return mod
+
+
+def _sample_rpc() -> RPC:
+    return RPC(
+        subscriptions=[SubOpts(subscribe=True, topicid="alpha"),
+                       SubOpts(subscribe=False, topicid="beta")],
+        publish=[PubMessage(from_peer=b"\x12\x20" + bytes(32), data=b"hello world",
+                            seqno=(7).to_bytes(8, "big"), topic="alpha",
+                            signature=b"\x01" * 64, key=b"\x08\x01\x12\x20" + bytes(32))],
+        control=ControlMessage(
+            ihave=[ControlIHave(topic_id="alpha", message_ids=[b"id-1", b"\xde\xad\xbe\xef"])],
+            iwant=[ControlIWant(message_ids=[b"id-2"])],
+            graft=[ControlGraft(topic_id="alpha")],
+            prune=[ControlPrune(topic_id="beta",
+                                peers=[PeerInfo(peer_id=b"QmPeer", signed_peer_record=b"env")],
+                                backoff=60)],
+        ),
+    )
+
+
+def test_ours_decodable_by_protobuf(pb2):
+    data = _sample_rpc().encode()
+    official = pb2.RPC()
+    official.ParseFromString(data)
+    assert official.subscriptions[0].subscribe is True
+    assert official.subscriptions[0].topicid == "alpha"
+    assert official.publish[0].data == b"hello world"
+    assert official.publish[0].topic == "alpha"
+    assert official.control.ihave[0].messageIDs == [b"id-1", b"\xde\xad\xbe\xef"]
+    assert official.control.prune[0].backoff == 60
+    assert official.control.prune[0].peers[0].peerID == b"QmPeer"
+
+
+def test_protobuf_decodable_by_ours(pb2):
+    official = pb2.RPC()
+    s = official.subscriptions.add()
+    s.subscribe = True
+    s.topicid = "gamma"
+    m = official.publish.add()
+    m.data = b"payload"
+    m.topic = "gamma"
+    m.seqno = (99).to_bytes(8, "big")
+    ih = official.control.ihave.add()
+    ih.topicID = "gamma"
+    ih.messageIDs.append(b"\x00\xffmid")
+    ours = RPC.decode(official.SerializeToString())
+    assert ours.subscriptions[0].topicid == "gamma"
+    assert ours.publish[0].data == b"payload"
+    assert ours.control.ihave[0].message_ids == [b"\x00\xffmid"]
+
+
+def test_byte_identical_roundtrip(pb2):
+    # protobuf serializes fields in field-number order, as does our codec;
+    # re-encoding an official parse of our bytes must reproduce them.
+    data = _sample_rpc().encode()
+    official = pb2.RPC()
+    official.ParseFromString(data)
+    assert official.SerializeToString() == data

@@ -1,0 +1,35 @@
+"""Protocol core: full pubsub semantics as an asyncio implementation."""
+
+from .blacklist import Blacklist, MapBlacklist, TimeCachedBlacklist
+from .crypto import PrivateKey, PublicKey, generate_keypair, peer_id_extract_key
+from .floodsub import FloodSubRouter, create_floodsub
+from .host import Host, InProcNetwork, NegotiationError, Stream, StreamResetError
+from .pubsub import PubSub, PubSubRouter
+from .sign import (
+    MessageSignaturePolicy,
+    SignatureError,
+    sign_message,
+    verify_message_signature,
+)
+from .timecache import FirstSeenCache
+from .topic import (
+    Subscription,
+    SubscriptionCancelledError,
+    Topic,
+    TopicClosedError,
+    TopicEventHandler,
+)
+from .trace import EventTracer, RawTracer, Tracer
+from .types import (
+    FLOODSUB_ID,
+    GOSSIPSUB_ID_V10,
+    GOSSIPSUB_ID_V11,
+    RANDOMSUB_ID,
+    AcceptStatus,
+    Message,
+    PeerEvent,
+    PeerID,
+    ValidationResult,
+    default_msg_id_fn,
+)
+from .validation import TopicValidator, Validation, ValidationError

@@ -1,0 +1,44 @@
+"""Peer blacklists (reference blacklist.go:12-64)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from .timecache import FirstSeenCache
+from .types import PeerID
+
+
+class Blacklist:
+    def add(self, pid: PeerID) -> bool:
+        raise NotImplementedError
+
+    def contains(self, pid: PeerID) -> bool:
+        raise NotImplementedError
+
+
+class MapBlacklist(Blacklist):
+    """Unbounded set-backed blacklist."""
+
+    def __init__(self):
+        self._set: set[PeerID] = set()
+
+    def add(self, pid: PeerID) -> bool:
+        self._set.add(pid)
+        return True
+
+    def contains(self, pid: PeerID) -> bool:
+        return pid in self._set
+
+
+class TimeCachedBlacklist(Blacklist):
+    """Blacklist whose entries expire after ``ttl`` seconds."""
+
+    def __init__(self, ttl: float, clock: Optional[Callable[[], float]] = None):
+        self._cache = FirstSeenCache(ttl, clock)
+
+    def add(self, pid: PeerID) -> bool:
+        self._cache.add(pid)
+        return True
+
+    def contains(self, pid: PeerID) -> bool:
+        return self._cache.has(pid)

@@ -1,0 +1,123 @@
+"""Stream I/O: per-peer reader and writer tasks.
+
+Behavioral mirror of the reference comm layer (/root/reference/comm.go):
+one inbound reader task per stream (varint-delimited RPC frames), one
+outbound writer task per peer draining a bounded queue, a hello packet
+carrying the full subscription set on connect, and dead-peer notification on
+stream failure.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..pb import rpc as pb
+from ..pb.proto import write_delimited
+from .host import Stream, StreamResetError
+from .types import PeerID
+
+
+def rpc_with_subs(*subopts: pb.SubOpts) -> pb.RPC:
+    return pb.RPC(subscriptions=list(subopts))
+
+
+def rpc_with_messages(*msgs: pb.PubMessage) -> pb.RPC:
+    return pb.RPC(publish=list(msgs))
+
+
+def rpc_with_control(msgs: list, ihave: list, iwant: list,
+                     graft: list, prune: list) -> pb.RPC:
+    return pb.RPC(
+        publish=list(msgs),
+        control=pb.ControlMessage(ihave=ihave, iwant=iwant,
+                                  graft=graft, prune=prune),
+    )
+
+
+def copy_rpc(rpc: pb.RPC) -> pb.RPC:
+    """Shallow-ish copy: fresh containers, shared immutable leaves."""
+    out = pb.RPC(subscriptions=list(rpc.subscriptions),
+                 publish=list(rpc.publish))
+    if rpc.control is not None:
+        out.control = pb.ControlMessage(
+            ihave=list(rpc.control.ihave), iwant=list(rpc.control.iwant),
+            graft=list(rpc.control.graft), prune=list(rpc.control.prune))
+    return out
+
+
+class PeerConn:
+    """Outbound state for one peer: bounded queue + writer task."""
+
+    def __init__(self, ps, pid: PeerID):
+        self.ps = ps
+        self.pid = pid
+        self.queue: asyncio.Queue = asyncio.Queue(
+            maxsize=ps.peer_outbound_queue_size)
+        self.closed = False
+        self.task: Optional[asyncio.Task] = None
+
+    def try_send(self, rpc: pb.RPC) -> bool:
+        """Non-blocking enqueue; False when the queue is full (drop-on-full,
+        reference gossipsub.go:1149-1156)."""
+        if self.closed:
+            return False
+        try:
+            self.queue.put_nowait(rpc)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    def close(self) -> None:
+        self.closed = True
+        if self.task is not None:
+            self.task.cancel()
+
+
+async def handle_new_peer(ps, conn: PeerConn) -> None:
+    """Open the outbound stream and run the writer loop
+    (reference comm.go:91-116,134-165)."""
+    try:
+        stream = await ps.host.new_stream(conn.pid, ps.router.protocols())
+    except Exception as e:
+        # distinguishes protocol-not-supported from dead peer the way the
+        # reference routes newPeerError vs peerDead (comm.go:96-101)
+        ps._post(lambda: ps._handle_peer_error(conn.pid, e))
+        return
+    try:
+        while True:
+            rpc = await conn.queue.get()
+            stream.write(write_delimited(rpc))
+    except (asyncio.CancelledError, StreamResetError):
+        try:
+            stream.close()
+        except Exception:
+            pass
+
+
+async def handle_new_stream(ps, stream: Stream) -> None:
+    """Inbound reader loop: varint-delimited RPC frames
+    (reference comm.go:43-89)."""
+    pid = stream.remote_peer
+    ps._post(lambda: ps._handle_inbound_stream(pid, stream))
+    try:
+        while True:
+            size = await stream.read_uvarint()
+            if size > ps.max_message_size:
+                stream.reset()
+                ps._post(lambda: ps._handle_peer_dead(pid))
+                return
+            frame = await stream.read_exact(size)
+            try:
+                rpc = pb.RPC.decode(frame)
+            except ValueError:
+                # garbage frame: kill the stream like a read error
+                stream.reset()
+                ps._post(lambda: ps._handle_peer_dead(pid))
+                return
+            ps._post_incoming_rpc(pid, rpc)
+    except EOFError:
+        # graceful close by remote: remove peer if fully disconnected
+        ps._post(lambda: ps._handle_peer_dead(pid))
+    except (StreamResetError, asyncio.CancelledError):
+        ps._post(lambda: ps._handle_peer_dead(pid))

@@ -1,0 +1,232 @@
+"""Keys, signatures, and peer identity.
+
+Implements the identity model the reference delegates to libp2p-core/crypto:
+ed25519 keypairs, protobuf-wrapped public keys, and peer IDs that are the
+(identity) multihash of the wrapped public key — so IDs and keys interoperate
+with real libp2p peers.  Uses the ``cryptography`` package when present and a
+pure-Python RFC 8032 implementation otherwise.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Optional
+
+from ..pb.proto import BYTES, ENUM, Field, Message
+from .types import PeerID
+
+try:  # C-backed fast path
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey as _CPriv,
+        Ed25519PublicKey as _CPub,
+    )
+    from cryptography.hazmat.primitives import serialization as _ser
+    from cryptography.exceptions import InvalidSignature as _InvalidSig
+    _HAVE_CRYPTOGRAPHY = True
+except Exception:  # pragma: no cover - environment without cryptography
+    _HAVE_CRYPTOGRAPHY = False
+
+
+# -- pure-Python ed25519 (RFC 8032) fallback -------------------------------
+
+_P = 2**255 - 19
+_L = 2**252 + 27742317777372353535851937790883648493
+_D = (-121665 * pow(121666, _P - 2, _P)) % _P
+
+
+def _sha512(s: bytes) -> bytes:
+    return hashlib.sha512(s).digest()
+
+
+def _inv(x: int) -> int:
+    return pow(x, _P - 2, _P)
+
+
+def _edwards_add(p, q):
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = (y1 - x1) * (y2 - x2) % _P
+    b = (y1 + x1) * (y2 + x2) % _P
+    c = 2 * t1 * t2 * _D % _P
+    dd = 2 * z1 * z2 % _P
+    e, f, g, h = b - a, dd - c, dd + c, b + a
+    return (e * f % _P, g * h % _P, f * g % _P, e * h % _P)
+
+
+def _scalar_mult(p, e: int):
+    q = (0, 1, 1, 0)
+    while e:
+        if e & 1:
+            q = _edwards_add(q, p)
+        p = _edwards_add(p, p)
+        e >>= 1
+    return q
+
+
+def _point_compress(p) -> bytes:
+    zinv = _inv(p[2])
+    x = p[0] * zinv % _P
+    y = p[1] * zinv % _P
+    return ((y | ((x & 1) << 255)).to_bytes(32, "little"))
+
+
+def _recover_x(y: int, sign: int) -> Optional[int]:
+    if y >= _P:
+        return None
+    x2 = (y * y - 1) * _inv(_D * y * y + 1) % _P
+    if x2 == 0:
+        return None if sign else 0
+    x = pow(x2, (_P + 3) // 8, _P)
+    if (x * x - x2) % _P:
+        x = x * pow(2, (_P - 1) // 4, _P) % _P
+    if (x * x - x2) % _P:
+        return None
+    if (x & 1) != sign:
+        x = _P - x
+    return x
+
+
+def _point_decompress(s: bytes):
+    y = int.from_bytes(s, "little")
+    sign = y >> 255
+    y &= (1 << 255) - 1
+    x = _recover_x(y, sign)
+    if x is None:
+        return None
+    return (x, y, 1, x * y % _P)
+
+
+_BY = 4 * _inv(5) % _P
+_BX = _recover_x(_BY, 0)
+_B = (_BX, _BY, 1, _BX * _BY % _P)
+
+
+def _py_keygen(seed: bytes):
+    h = _sha512(seed)
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return _point_compress(_scalar_mult(_B, a))
+
+
+def _py_sign(seed: bytes, pub: bytes, msg: bytes) -> bytes:
+    h = _sha512(seed)
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    prefix = h[32:]
+    r = int.from_bytes(_sha512(prefix + msg), "little") % _L
+    rp = _point_compress(_scalar_mult(_B, r))
+    k = int.from_bytes(_sha512(rp + pub + msg), "little") % _L
+    s = (r + k * a) % _L
+    return rp + s.to_bytes(32, "little")
+
+
+def _py_verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    if len(sig) != 64 or len(pub) != 32:
+        return False
+    a = _point_decompress(pub)
+    if a is None:
+        return False
+    rp = _point_decompress(sig[:32])
+    if rp is None:
+        return False
+    s = int.from_bytes(sig[32:], "little")
+    if s >= _L:
+        return False
+    k = int.from_bytes(_sha512(sig[:32] + pub + msg), "little") % _L
+    lhs = _scalar_mult(_B, s)
+    rhs = _edwards_add(rp, _scalar_mult(a, k))
+    # compare affine coords
+    return (
+        lhs[0] * rhs[2] % _P == rhs[0] * lhs[2] % _P
+        and lhs[1] * rhs[2] % _P == rhs[1] * lhs[2] % _P
+    )
+
+
+# -- key wrapping (libp2p PublicKey protobuf) ------------------------------
+
+
+class KeyType:
+    RSA = 0
+    ED25519 = 1
+    SECP256K1 = 2
+    ECDSA = 3
+
+
+class PublicKeyProto(Message):
+    FIELDS = (Field(1, "type", ENUM), Field(2, "data", BYTES))
+
+
+class PrivateKey:
+    """An ed25519 signing key."""
+
+    def __init__(self, seed: Optional[bytes] = None):
+        self._seed = seed if seed is not None else os.urandom(32)
+        if _HAVE_CRYPTOGRAPHY:
+            self._ck = _CPriv.from_private_bytes(self._seed)
+            raw_pub = self._ck.public_key().public_bytes(
+                _ser.Encoding.Raw, _ser.PublicFormat.Raw)
+        else:
+            self._ck = None
+            raw_pub = _py_keygen(self._seed)
+        self.public = PublicKey(raw_pub)
+
+    def sign(self, data: bytes) -> bytes:
+        if self._ck is not None:
+            return self._ck.sign(data)
+        return _py_sign(self._seed, self.public.raw, data)
+
+
+class PublicKey:
+    """An ed25519 verification key."""
+
+    def __init__(self, raw: bytes):
+        if len(raw) != 32:
+            raise ValueError("ed25519 public key must be 32 bytes")
+        self.raw = raw
+
+    def verify(self, data: bytes, sig: bytes) -> bool:
+        if _HAVE_CRYPTOGRAPHY:
+            try:
+                _CPub.from_public_bytes(self.raw).verify(sig, data)
+                return True
+            except (_InvalidSig, ValueError):
+                return False
+        return _py_verify(self.raw, data, sig)
+
+    def marshal(self) -> bytes:
+        """Protobuf-wrapped key as embedded in the wire ``key`` field."""
+        return PublicKeyProto(type=KeyType.ED25519, data=self.raw).encode()
+
+    @classmethod
+    def unmarshal(cls, data: bytes) -> "PublicKey":
+        pk = PublicKeyProto.decode(data)
+        if pk.type != KeyType.ED25519:
+            raise ValueError(f"unsupported key type {pk.type}")
+        return cls(pk.data)
+
+    def peer_id(self) -> PeerID:
+        """Derive the peer ID: identity multihash of the wrapped key.
+
+        libp2p uses the identity multihash (code 0x00) when the wrapped key
+        is <= 42 bytes, which ed25519 always is — so the key is recoverable
+        from the ID itself (the property sign.go:77-90 relies on).
+        """
+        wrapped = self.marshal()
+        return PeerID(bytes([0x00, len(wrapped)]) + wrapped)
+
+
+def peer_id_extract_key(pid: PeerID) -> Optional[PublicKey]:
+    """Recover the public key embedded in an identity-multihash peer ID."""
+    if len(pid) < 2 or pid[0] != 0x00 or pid[1] != len(pid) - 2:
+        return None
+    try:
+        return PublicKey.unmarshal(bytes(pid[2:]))
+    except ValueError:
+        return None
+
+
+def generate_keypair(seed: Optional[bytes] = None) -> PrivateKey:
+    return PrivateKey(seed)

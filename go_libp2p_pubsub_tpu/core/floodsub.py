@@ -1,0 +1,61 @@
+"""FloodSub: the baseline router — flood every message to every topic peer.
+
+Behavioral equivalent of /root/reference/floodsub.go (108 LoC): publish
+forwards to all known peers subscribed to the topic except the source and
+origin; no control messages, no state beyond what the core tracks.
+"""
+
+from __future__ import annotations
+
+from .comm import rpc_with_messages
+from .pubsub import PubSub, PubSubRouter
+from .types import FLOODSUB_ID, AcceptStatus, Message, PeerID
+
+
+class FloodSubRouter(PubSubRouter):
+    def __init__(self):
+        self.ps: PubSub = None
+
+    def protocols(self) -> list[str]:
+        return [FLOODSUB_ID]
+
+    def attach(self, ps: PubSub) -> None:
+        self.ps = ps
+
+    def add_peer(self, pid: PeerID, proto: str) -> None:
+        self.ps.tracer.add_peer(pid, proto)
+
+    def remove_peer(self, pid: PeerID) -> None:
+        self.ps.tracer.remove_peer(pid)
+
+    def enough_peers(self, topic: str, suggested: int = 0) -> bool:
+        tmap = self.ps.topics.get(topic, set())
+        if suggested <= 0:
+            suggested = 5  # reference floodsub.go:62
+        return len(tmap) >= suggested
+
+    def accept_from(self, pid: PeerID) -> AcceptStatus:
+        return AcceptStatus.ALL
+
+    def handle_rpc(self, rpc, from_peer: PeerID) -> None:
+        pass  # floodsub has no control logic
+
+    def publish(self, msg: Message) -> None:
+        from_peer = msg.received_from
+        origin = msg.from_peer
+        out = rpc_with_messages(msg.rpc)
+        for pid in self.ps.topics.get(msg.topic, set()):
+            if pid == from_peer or pid == origin:
+                continue
+            self.ps.send_rpc_to(pid, out)
+
+    def join(self, topic: str) -> None:
+        self.ps.tracer.join(topic)
+
+    def leave(self, topic: str) -> None:
+        self.ps.tracer.leave(topic)
+
+
+async def create_floodsub(host, **kwargs) -> PubSub:
+    """Construct a floodsub pubsub instance (reference floodsub.go:25)."""
+    return await PubSub.create(host, FloodSubRouter(), **kwargs)

@@ -1,0 +1,336 @@
+"""Minimal host + in-process network.
+
+Plays the role libp2p's host/swarm plays for the reference: peers own
+keypairs, connect to each other, open protocol-negotiated bidirectional
+streams, and observe connection lifecycle events.  The in-proc network runs
+any number of hosts inside one asyncio loop with real byte streams between
+them — the same trick the reference test suite uses (blankhost over an
+in-memory swarm, /root/reference/floodsub_test.go:45-55) promoted to the
+framework's primary transport for protocol-core work.
+
+Optional per-link latency makes the transport usable for topology experiments
+and for generating validation traces for the TPU simulator.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, Iterable, Optional
+
+from .crypto import PrivateKey, generate_keypair
+from .types import PeerID
+
+
+class StreamResetError(Exception):
+    pass
+
+
+class NegotiationError(Exception):
+    """No common protocol — the 'protocol not supported' failure class."""
+
+
+class _BytePipe:
+    """One direction of a stream: chunk queue + EOF/reset flags."""
+
+    def __init__(self):
+        self._chunks: list[bytes] = []
+        self._pos = 0
+        self._eof = False
+        self._reset = False
+        self._wakeup = asyncio.Event()
+
+    def feed(self, data: bytes) -> None:
+        if self._eof or self._reset:
+            return
+        self._chunks.append(data)
+        self._wakeup.set()
+
+    def feed_eof(self) -> None:
+        self._eof = True
+        self._wakeup.set()
+
+    def feed_reset(self) -> None:
+        self._reset = True
+        self._wakeup.set()
+
+    def _buffered(self) -> int:
+        return sum(len(c) for c in self._chunks) - self._pos
+
+    async def read_exact(self, n: int) -> bytes:
+        while True:
+            if self._reset:
+                raise StreamResetError("stream reset")
+            if self._buffered() >= n:
+                out = bytearray()
+                need = n
+                while need:
+                    chunk = self._chunks[0]
+                    avail = len(chunk) - self._pos
+                    take = min(avail, need)
+                    out += chunk[self._pos:self._pos + take]
+                    self._pos += take
+                    need -= take
+                    if self._pos == len(chunk):
+                        self._chunks.pop(0)
+                        self._pos = 0
+                return bytes(out)
+            if self._eof:
+                raise EOFError("stream closed")
+            self._wakeup.clear()
+            await self._wakeup.wait()
+
+    async def read_uvarint(self) -> int:
+        result = 0
+        shift = 0
+        while True:
+            b = (await self.read_exact(1))[0]
+            result |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                if result >= 1 << 64:
+                    raise ValueError("varint overflows 64 bits")
+                return result
+            shift += 7
+            if shift >= 70:
+                raise ValueError("varint too long")
+
+
+class Stream:
+    """One side of a negotiated bidirectional stream."""
+
+    def __init__(self, conn: "Connection", protocol: str, rx: _BytePipe,
+                 tx: _BytePipe, network: "InProcNetwork"):
+        self.conn = conn
+        self.protocol = protocol
+        self.remote_peer: Optional[PeerID] = None  # set at creation site
+        self._rx = rx
+        self._tx = tx
+        self._net = network
+        self._closed = False
+
+    def write(self, data: bytes) -> None:
+        if self._closed:
+            raise StreamResetError("write on closed stream")
+        self._net._deliver(self.conn, self._tx, data)
+
+    async def read_exact(self, n: int) -> bytes:
+        return await self._rx.read_exact(n)
+
+    async def read_uvarint(self) -> int:
+        return await self._rx.read_uvarint()
+
+    def close(self) -> None:
+        """Close the write side (remote reader sees EOF)."""
+        if not self._closed:
+            self._closed = True
+            self._net._deliver_eof(self.conn, self._tx)
+
+    def reset(self) -> None:
+        """Abort both directions."""
+        self._closed = True
+        self._tx.feed_reset()
+        self._rx.feed_reset()
+
+
+class Connection:
+    """A live link between two hosts. ``initiator`` opened it (outbound)."""
+
+    _next_id = 0
+
+    def __init__(self, a: "Host", b: "Host"):
+        self.initiator = a
+        self.responder = b
+        self.streams: list[Stream] = []
+        self.closed = False
+        Connection._next_id += 1
+        self.id = Connection._next_id
+
+    def peers(self) -> tuple[PeerID, PeerID]:
+        return self.initiator.id, self.responder.id
+
+    def is_outbound_for(self, pid: PeerID) -> bool:
+        return self.initiator.id == pid
+
+
+StreamHandler = Callable[[Stream], Awaitable[None]]
+
+
+class Notifiee:
+    """Connection lifecycle observer (reference notify.go:11-61)."""
+
+    def connected(self, conn: Connection) -> None: ...
+    def disconnected(self, conn: Connection) -> None: ...
+
+
+class ConnManager:
+    """Tag/protect bookkeeping the tag tracer feeds (reference tag_tracer.go)."""
+
+    def __init__(self):
+        self.tags: dict[PeerID, dict[str, int]] = {}
+        self.protected: dict[PeerID, set[str]] = {}
+
+    def tag_peer(self, pid: PeerID, tag: str, value: int) -> None:
+        self.tags.setdefault(pid, {})[tag] = self.tags.get(pid, {}).get(tag, 0) + value
+
+    def untag_peer(self, pid: PeerID, tag: str) -> None:
+        self.tags.get(pid, {}).pop(tag, None)
+
+    def upsert_tag(self, pid: PeerID, tag: str, fn: Callable[[int], int]) -> None:
+        cur = self.tags.setdefault(pid, {}).get(tag, 0)
+        self.tags[pid][tag] = fn(cur)
+
+    def protect(self, pid: PeerID, tag: str) -> None:
+        self.protected.setdefault(pid, set()).add(tag)
+
+    def unprotect(self, pid: PeerID, tag: str) -> bool:
+        tags = self.protected.get(pid, set())
+        tags.discard(tag)
+        if not tags:
+            self.protected.pop(pid, None)
+        return bool(tags)
+
+
+class Host:
+    """A network participant: identity + streams + lifecycle notifications."""
+
+    def __init__(self, network: "InProcNetwork", key: Optional[PrivateKey] = None):
+        self.network = network
+        self.key = key or generate_keypair()
+        self.id: PeerID = self.key.public.peer_id()
+        self.handlers: dict[str, StreamHandler] = {}
+        self.notifiees: list[Notifiee] = []
+        self.conns: dict[PeerID, list[Connection]] = {}
+        self.conn_manager = ConnManager()
+        # peerstore: public keys learned out-of-band or via identify
+        self.peerstore_keys: dict[PeerID, object] = {self.id: self.key.public}
+        # simulated external IP for score colocation tests ("/ip4/…")
+        self.ip: str = ""
+
+    # -- wiring ------------------------------------------------------------
+
+    def set_stream_handler(self, protocol: str, handler: StreamHandler) -> None:
+        self.handlers[protocol] = handler
+
+    def remove_stream_handler(self, protocol: str) -> None:
+        self.handlers.pop(protocol, None)
+
+    def notify(self, n: Notifiee) -> None:
+        self.notifiees.append(n)
+        for plist in self.conns.values():
+            for c in plist:
+                n.connected(c)
+
+    # -- connectivity ------------------------------------------------------
+
+    async def connect(self, peer: "Host | PeerID") -> Connection:
+        other = peer if isinstance(peer, Host) else self.network.hosts[peer]
+        return await self.network.connect(self, other)
+
+    async def disconnect(self, pid: PeerID) -> None:
+        await self.network.disconnect(self.id, pid)
+
+    def connectedness(self, pid: PeerID) -> bool:
+        return bool(self.conns.get(pid))
+
+    def peers(self) -> list[PeerID]:
+        return [p for p, cs in self.conns.items() if cs]
+
+    # -- streams -----------------------------------------------------------
+
+    async def new_stream(self, pid: PeerID, protocols: Iterable[str]) -> Stream:
+        return await self.network.new_stream(self, pid, list(protocols))
+
+
+class InProcNetwork:
+    """The universe of hosts sharing one asyncio loop.
+
+    ``latency`` (seconds) delays byte delivery per link; 0 delivers inline.
+    """
+
+    def __init__(self, latency: float = 0.0):
+        self.hosts: dict[PeerID, Host] = {}
+        self.latency = latency
+        self._tasks: set[asyncio.Task] = set()
+
+    def new_host(self, key: Optional[PrivateKey] = None) -> Host:
+        h = Host(self, key)
+        self.hosts[h.id] = h
+        return h
+
+    # -- connection management --------------------------------------------
+
+    async def connect(self, a: Host, b: Host) -> Connection:
+        existing = a.conns.get(b.id)
+        if existing:
+            return existing[0]
+        conn = Connection(a, b)
+        a.conns.setdefault(b.id, []).append(conn)
+        b.conns.setdefault(a.id, []).append(conn)
+        # learn each other's keys (identify protocol equivalent)
+        a.peerstore_keys[b.id] = b.key.public
+        b.peerstore_keys[a.id] = a.key.public
+        for n in list(a.notifiees):
+            n.connected(conn)
+        for n in list(b.notifiees):
+            n.connected(conn)
+        await asyncio.sleep(0)  # let notification-spawned tasks start
+        return conn
+
+    async def disconnect(self, apid: PeerID, bpid: PeerID) -> None:
+        a, b = self.hosts[apid], self.hosts[bpid]
+        conns = a.conns.pop(bpid, [])
+        b.conns.pop(apid, None)
+        for conn in conns:
+            conn.closed = True
+            for s in conn.streams:
+                s.reset()
+            for n in list(a.notifiees):
+                n.disconnected(conn)
+            for n in list(b.notifiees):
+                n.disconnected(conn)
+        await asyncio.sleep(0)
+
+    # -- streams -----------------------------------------------------------
+
+    async def new_stream(self, src: Host, pid: PeerID, protocols: list[str]) -> Stream:
+        dst = self.hosts.get(pid)
+        if dst is None or not src.conns.get(pid):
+            raise ConnectionError(f"{src.id.short()} not connected to {pid!r}")
+        proto = next((p for p in protocols if p in dst.handlers), None)
+        if proto is None:
+            raise NegotiationError(f"protocols not supported: {protocols}")
+        conn = src.conns[pid][0]
+        a2b, b2a = _BytePipe(), _BytePipe()
+        local = Stream(conn, proto, rx=b2a, tx=a2b, network=self)
+        remote = Stream(conn, proto, rx=a2b, tx=b2a, network=self)
+        local.remote_peer = pid
+        remote.remote_peer = src.id
+        conn.streams.extend((local, remote))
+        handler = dst.handlers[proto]
+        self.spawn(handler(remote))
+        await asyncio.sleep(0)
+        return local
+
+    # -- delivery ----------------------------------------------------------
+
+    def _deliver(self, conn: Connection, pipe: _BytePipe, data: bytes) -> None:
+        if self.latency > 0:
+            asyncio.get_running_loop().call_later(self.latency, pipe.feed, data)
+        else:
+            pipe.feed(data)
+
+    def _deliver_eof(self, conn: Connection, pipe: _BytePipe) -> None:
+        if self.latency > 0:
+            asyncio.get_running_loop().call_later(self.latency, pipe.feed_eof)
+        else:
+            pipe.feed_eof()
+
+    def spawn(self, coro) -> asyncio.Task:
+        task = asyncio.ensure_future(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return task
+
+    async def close(self) -> None:
+        for t in list(self._tasks):
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)

@@ -1,0 +1,514 @@
+"""PubSub core: single event-loop runtime that owns all shared state.
+
+Behavioral equivalent of the reference core (/root/reference/pubsub.go):
+peer lifecycle, topic/subscription bookkeeping, RPC dispatch, the message
+push path with blacklist/signing/dedup gates, and the pluggable router
+contract.  Concurrency follows the reference's single-writer discipline —
+all shared state mutates inside one asyncio task (the process loop), fed by
+thunks — which is the asyncio analog of the Go version's channel select.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import Awaitable, Callable, Iterable, Optional
+
+from ..pb import rpc as pb
+from .blacklist import Blacklist, MapBlacklist
+from .comm import PeerConn, handle_new_peer, handle_new_stream, rpc_with_subs
+from .host import Host, Notifiee, Stream
+from .sign import MessageSignaturePolicy, sign_message
+from .timecache import FirstSeenCache
+from .trace import EventTracer, RawTracer, Tracer
+from .types import (
+    DEFAULT_MAX_MESSAGE_SIZE,
+    DEFAULT_PEER_OUTBOUND_QUEUE_SIZE,
+    AcceptStatus,
+    Message,
+    MsgIdFunction,
+    PeerEvent,
+    PeerID,
+    REJECT_BLACKLISTED_PEER,
+    REJECT_BLACKLISTED_SOURCE,
+    REJECT_MISSING_SIGNATURE,
+    REJECT_SELF_ORIGIN,
+    REJECT_UNEXPECTED_AUTH_INFO,
+    REJECT_UNEXPECTED_SIGNATURE,
+    TIME_CACHE_DURATION,
+    default_msg_id_fn,
+)
+from .validation import TopicValidator, Validation, ValidationError
+
+
+class PubSubRouter:
+    """The pluggable routing contract (reference pubsub.go:157-187)."""
+
+    def protocols(self) -> list[str]:
+        raise NotImplementedError
+
+    def attach(self, ps: "PubSub") -> None:
+        raise NotImplementedError
+
+    def add_peer(self, pid: PeerID, proto: str) -> None:
+        raise NotImplementedError
+
+    def remove_peer(self, pid: PeerID) -> None:
+        raise NotImplementedError
+
+    def enough_peers(self, topic: str, suggested: int = 0) -> bool:
+        raise NotImplementedError
+
+    def accept_from(self, pid: PeerID) -> AcceptStatus:
+        return AcceptStatus.ALL
+
+    def handle_rpc(self, rpc: pb.RPC, from_peer: PeerID) -> None:
+        raise NotImplementedError
+
+    def publish(self, msg: Message) -> None:
+        raise NotImplementedError
+
+    def join(self, topic: str) -> None:
+        raise NotImplementedError
+
+    def leave(self, topic: str) -> None:
+        raise NotImplementedError
+
+
+class _PubSubNotifiee(Notifiee):
+    """Connection lifecycle adapter (reference notify.go:11-61)."""
+
+    def __init__(self, ps: "PubSub"):
+        self.ps = ps
+
+    def connected(self, conn) -> None:
+        pid = (conn.responder.id if conn.initiator.id == self.ps.host.id
+               else conn.initiator.id)
+        self.ps._post(lambda: self.ps._handle_new_peer(pid))
+
+    def disconnected(self, conn) -> None:
+        pid = (conn.responder.id if conn.initiator.id == self.ps.host.id
+               else conn.initiator.id)
+        self.ps._post(lambda: self.ps._handle_peer_dead(pid))
+
+
+class PubSub:
+    """The pubsub runtime for one host.  Construct via ``await create(...)``."""
+
+    def __init__(self, host: Host, router: PubSubRouter, *,
+                 sign_policy: MessageSignaturePolicy = MessageSignaturePolicy.STRICT_SIGN,
+                 msg_id_fn: MsgIdFunction = default_msg_id_fn,
+                 event_tracer: Optional[EventTracer] = None,
+                 raw_tracers: Optional[list[RawTracer]] = None,
+                 blacklist: Optional[Blacklist] = None,
+                 subscription_filter=None,
+                 discovery=None,
+                 peer_outbound_queue_size: int = DEFAULT_PEER_OUTBOUND_QUEUE_SIZE,
+                 max_message_size: int = DEFAULT_MAX_MESSAGE_SIZE,
+                 validate_queue_size: int = 32,
+                 validate_throttle: int = 8192,
+                 validate_workers: int = 4,
+                 seen_ttl: float = TIME_CACHE_DURATION,
+                 clock: Optional[Callable[[], float]] = None):
+        self.host = host
+        self.router = router
+        self.sign_policy = sign_policy
+        self.msg_id = msg_id_fn
+        self.blacklist = blacklist or MapBlacklist()
+        self.sub_filter = subscription_filter
+        self.disc = discovery
+        self.peer_outbound_queue_size = peer_outbound_queue_size
+        self.max_message_size = max_message_size
+        self.clock = clock or time.monotonic
+
+        self.sign_id: Optional[PeerID] = host.id if sign_policy.must_sign else None
+        self.sign_key = host.key if sign_policy.must_sign else None
+
+        # all state below is owned by the process loop
+        self.peers: dict[PeerID, PeerConn] = {}
+        self.topics: dict[str, set[PeerID]] = {}       # topic -> remote peers
+        self.my_subs: dict[str, set] = {}              # topic -> Subscriptions
+        self.my_relays: dict[str, int] = {}            # topic -> relay refcount
+        self.my_topics: dict[str, object] = {}         # topic -> Topic handle
+        self.inbound_streams: dict[PeerID, Stream] = {}
+
+        self.seen_messages = FirstSeenCache(seen_ttl, clock=self.clock)
+        self._seqno = time.time_ns()
+
+        # clock=None in the Tracer means wall-clock ns; a user-injected
+        # virtual clock must stamp traces on the same timeline
+        self.tracer = Tracer(host.id, msg_id_fn, event_tracer, raw_tracers,
+                             clock=clock)
+        self.val = Validation(self, queue_size=validate_queue_size,
+                              throttle=validate_throttle,
+                              workers=validate_workers)
+
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._loop_task: Optional[asyncio.Task] = None
+        self._tasks: set[asyncio.Task] = set()
+        self._closed = False
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    async def create(cls, host: Host, router: PubSubRouter, **kwargs) -> "PubSub":
+        ps = cls(host, router, **kwargs)
+        if ps.disc is not None:
+            ps.disc.start(ps)
+        router.attach(ps)
+        for proto in router.protocols():
+            host.set_stream_handler(proto, lambda s, _ps=ps: handle_new_stream(_ps, s))
+        ps.val.start()
+        ps._loop_task = asyncio.ensure_future(ps._process_loop())
+        host.notify(_PubSubNotifiee(ps))
+        await asyncio.sleep(0)
+        return ps
+
+    async def close(self) -> None:
+        self._closed = True
+        if self.disc is not None:
+            self.disc.stop()
+        self.val.stop()
+        if self._loop_task:
+            self._loop_task.cancel()
+        for conn in self.peers.values():
+            conn.close()
+        for t in list(self._tasks):
+            t.cancel()
+        await asyncio.gather(*self._tasks, self._loop_task,
+                             return_exceptions=True)
+
+    # -- event loop plumbing ----------------------------------------------
+
+    def _post(self, fn: Callable[[], None]) -> None:
+        """Enqueue a thunk to run in loop context (the reference's channels
+        and eval chan collapse into this)."""
+        if not self._closed:
+            self._queue.put_nowait(fn)
+
+    async def _eval(self, fn: Callable[[], object]):
+        """Run a thunk in loop context and await its result."""
+        if self._closed:
+            raise RuntimeError("pubsub instance is closed")
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+
+        def run():
+            try:
+                fut.set_result(fn())
+            except Exception as e:  # propagate to caller
+                fut.set_exception(e)
+
+        self._post(run)
+        return await fut
+
+    def _spawn(self, coro: Awaitable) -> asyncio.Task:
+        task = asyncio.ensure_future(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return task
+
+    async def _process_loop(self) -> None:
+        while True:
+            fn = await self._queue.get()
+            try:
+                fn()
+            except Exception:
+                import traceback
+                traceback.print_exc()
+
+    def _post_incoming_rpc(self, pid: PeerID, rpc: pb.RPC) -> None:
+        self._post(lambda: self._handle_incoming_rpc(pid, rpc))
+
+    # -- peer lifecycle (loop context) ------------------------------------
+
+    def _handle_new_peer(self, pid: PeerID) -> None:
+        if pid in self.peers:
+            return
+        if self.blacklist.contains(pid):
+            return
+        conn = PeerConn(self, pid)
+        conn.try_send(self._hello_packet())
+        conn.task = self._spawn(handle_new_peer(self, conn))
+        self.peers[pid] = conn
+
+    def _handle_peer_error(self, pid: PeerID, err: Exception) -> None:
+        # protocol negotiation failure: forget the peer (reference
+        # newPeerError path)
+        conn = self.peers.pop(pid, None)
+        if conn:
+            conn.close()
+
+    def _handle_inbound_stream(self, pid: PeerID, stream: Stream) -> None:
+        if pid not in self.peers:
+            # stream from a peer we dropped (e.g. negotiation error):
+            # refuse it (reference pubsub.go:500-506)
+            stream.reset()
+            return
+        if self.blacklist.contains(pid):
+            conn = self.peers.pop(pid, None)
+            if conn:
+                conn.close()
+            stream.reset()
+            return
+        old = self.inbound_streams.get(pid)
+        if old is not None and old is not stream:
+            # duplicate inbound stream: reset the old one (reference
+            # pubsub.go:504-516 keeps one inbound stream per peer)
+            old.reset()
+        self.inbound_streams[pid] = stream
+        self.router.add_peer(pid, stream.protocol)
+
+    def _handle_peer_dead(self, pid: PeerID) -> None:
+        conn = self.peers.get(pid)
+        if conn is None:
+            return
+        conn.close()
+        if self.host.connectedness(pid):
+            # duplicate conn closed while still connected: respawn writer
+            newconn = PeerConn(self, pid)
+            newconn.try_send(self._hello_packet())
+            newconn.task = self._spawn(handle_new_peer(self, newconn))
+            self.peers[pid] = newconn
+            return
+        del self.peers[pid]
+        self.inbound_streams.pop(pid, None)
+        for topic, tmap in self.topics.items():
+            if pid in tmap:
+                tmap.discard(pid)
+                self._notify_leave(topic, pid)
+        self.router.remove_peer(pid)
+
+    # -- hello / announce --------------------------------------------------
+
+    def _hello_packet(self) -> pb.RPC:
+        subs = [pb.SubOpts(subscribe=True, topicid=t)
+                for t in sorted(set(self.my_subs) | set(self.my_relays))]
+        return rpc_with_subs(*subs)
+
+    def _announce(self, topic: str, sub: bool) -> None:
+        out = rpc_with_subs(pb.SubOpts(subscribe=sub, topicid=topic))
+        for pid, conn in self.peers.items():
+            if conn.try_send(out):
+                self.tracer.send_rpc(out, pid)
+            else:
+                self.tracer.drop_rpc(out, pid)
+                self._spawn(self._announce_retry(pid, topic, sub))
+
+    async def _announce_retry(self, pid: PeerID, topic: str, sub: bool) -> None:
+        await asyncio.sleep(random.uniform(0.001, 0.05))
+
+        def retry():
+            ok = topic in self.my_subs or topic in self.my_relays
+            if ok == sub:
+                conn = self.peers.get(pid)
+                if conn is None:
+                    return
+                out = rpc_with_subs(pb.SubOpts(subscribe=sub, topicid=topic))
+                if conn.try_send(out):
+                    self.tracer.send_rpc(out, pid)
+                else:
+                    self.tracer.drop_rpc(out, pid)
+                    self._spawn(self._announce_retry(pid, topic, sub))
+
+        self._post(retry)
+
+    # -- RPC dispatch (loop context) --------------------------------------
+
+    def _handle_incoming_rpc(self, pid: PeerID, rpc: pb.RPC) -> None:
+        self.tracer.recv_rpc(rpc, pid)
+
+        subs = rpc.subscriptions
+        if subs and self.sub_filter is not None:
+            try:
+                subs = self.sub_filter.filter_incoming_subscriptions(pid, subs)
+            except ValueError:
+                return  # filter error: ignore whole RPC
+
+        for subopt in subs:
+            t = subopt.topicid
+            if subopt.subscribe:
+                tmap = self.topics.setdefault(t, set())
+                if pid not in tmap:
+                    tmap.add(pid)
+                    topic = self.my_topics.get(t)
+                    if topic is not None:
+                        topic._send_notification(
+                            PeerEvent(PeerEvent.Type.JOIN, pid))
+            else:
+                tmap = self.topics.get(t)
+                if tmap and pid in tmap:
+                    tmap.discard(pid)
+                    self._notify_leave(t, pid)
+
+        accept = self.router.accept_from(pid)
+        if accept == AcceptStatus.NONE:
+            return
+        if accept == AcceptStatus.CONTROL:
+            if rpc.publish:
+                self.tracer.throttle_peer(pid)
+        else:
+            for pmsg in rpc.publish:
+                if not (self._subscribed_to(pmsg) or self._can_relay(pmsg)):
+                    continue
+                self.push_msg(Message(pmsg, received_from=pid))
+
+        self.router.handle_rpc(rpc, pid)
+
+    def _subscribed_to(self, pmsg: pb.PubMessage) -> bool:
+        return pmsg.topic in self.my_subs
+
+    def _can_relay(self, pmsg: pb.PubMessage) -> bool:
+        return self.my_relays.get(pmsg.topic, 0) > 0
+
+    def _notify_leave(self, topic: str, pid: PeerID) -> None:
+        t = self.my_topics.get(topic)
+        if t is not None:
+            t._send_notification(PeerEvent(PeerEvent.Type.LEAVE, pid))
+
+    # -- message push path (loop context) ---------------------------------
+
+    def push_msg(self, msg: Message) -> None:
+        """Gate + validate + publish (reference pubsub.go:978-1022)."""
+        src = msg.received_from
+        if self.blacklist.contains(src):
+            self.tracer.reject_message(msg, REJECT_BLACKLISTED_PEER)
+            return
+        frm = msg.from_peer
+        if frm is not None and self.blacklist.contains(frm):
+            self.tracer.reject_message(msg, REJECT_BLACKLISTED_SOURCE)
+            return
+
+        try:
+            self.check_signing_policy(msg)
+        except ValidationError:
+            return
+
+        if frm == self.host.id and src != self.host.id:
+            self.tracer.reject_message(msg, REJECT_SELF_ORIGIN)
+            return
+
+        msg_id = self.msg_id(msg.rpc)
+        if self.seen_messages.has(msg_id):
+            self.tracer.duplicate_message(msg)
+            return
+
+        if not self.val.push(src, msg):
+            return
+
+        if self.mark_seen(msg_id):
+            self.publish_message(msg)
+
+    def check_signing_policy(self, msg: Message) -> None:
+        """Raises ValidationError on policy violation
+        (reference pubsub.go:1024-1054)."""
+        if not self.sign_policy.must_verify:
+            return
+        if self.sign_policy.must_sign:
+            if msg.rpc.signature is None:
+                self.tracer.reject_message(msg, REJECT_MISSING_SIGNATURE)
+                raise ValidationError(REJECT_MISSING_SIGNATURE)
+            # actual signature verification happens in the validation
+            # pipeline, after the dedup check, to avoid paying it twice
+        else:
+            if msg.rpc.signature is not None:
+                self.tracer.reject_message(msg, REJECT_UNEXPECTED_SIGNATURE)
+                raise ValidationError(REJECT_UNEXPECTED_SIGNATURE)
+            if self.sign_id is None and (
+                    msg.rpc.seqno is not None or msg.rpc.from_peer is not None
+                    or msg.rpc.key is not None):
+                self.tracer.reject_message(msg, REJECT_UNEXPECTED_AUTH_INFO)
+                raise ValidationError(REJECT_UNEXPECTED_AUTH_INFO)
+
+    def mark_seen(self, msg_id: bytes) -> bool:
+        return self.seen_messages.add(msg_id)
+
+    def seen_message(self, msg_id: bytes) -> bool:
+        return self.seen_messages.has(msg_id)
+
+    def deliver_validated(self, msg: Message) -> None:
+        """Called by the validation pipeline on acceptance (any task)."""
+        self._post(lambda: self.publish_message(msg))
+
+    def publish_message(self, msg: Message) -> None:
+        self.tracer.deliver_message(msg)
+        self._notify_subs(msg)
+        self.router.publish(msg)
+
+    def _notify_subs(self, msg: Message) -> None:
+        for sub in self.my_subs.get(msg.topic, ()):
+            sub._deliver(msg)
+
+    # -- seqno -------------------------------------------------------------
+
+    def next_seqno(self) -> bytes:
+        self._seqno += 1
+        return self._seqno.to_bytes(8, "big")
+
+    # -- outbound RPC helper (used by routers) -----------------------------
+
+    def send_rpc_to(self, pid: PeerID, rpc: pb.RPC) -> bool:
+        conn = self.peers.get(pid)
+        if conn is None:
+            return False
+        if conn.try_send(rpc):
+            self.tracer.send_rpc(rpc, pid)
+            return True
+        self.tracer.drop_rpc(rpc, pid)
+        return False
+
+    # -- public API --------------------------------------------------------
+
+    async def join(self, topic_name: str):
+        """Join a topic, returning the Topic handle
+        (reference pubsub.go:1078-1112)."""
+        from .topic import Topic
+        if self.sub_filter is not None and not self.sub_filter.can_subscribe(topic_name):
+            raise ValueError(f"topic is not allowed by the subscription filter: {topic_name}")
+
+        def add():
+            t = self.my_topics.get(topic_name)
+            if t is not None:
+                return t
+            t = Topic(self, topic_name)
+            self.my_topics[topic_name] = t
+            return t
+
+        return await self._eval(add)
+
+    async def get_topics(self) -> list[str]:
+        return await self._eval(lambda: sorted(self.my_subs))
+
+    async def list_peers(self, topic: str = "") -> list[PeerID]:
+        def get():
+            if topic:
+                tmap = self.topics.get(topic)
+                if tmap is None:
+                    return []
+                return [p for p in self.peers if p in tmap]
+            return list(self.peers)
+        return await self._eval(get)
+
+    async def blacklist_peer(self, pid: PeerID) -> None:
+        def bl():
+            self.blacklist.add(pid)
+            conn = self.peers.pop(pid, None)
+            if conn is not None:
+                conn.close()
+                for topic, tmap in self.topics.items():
+                    if pid in tmap:
+                        tmap.discard(pid)
+                        self._notify_leave(topic, pid)
+                self.router.remove_peer(pid)
+        await self._eval(bl)
+
+    async def register_topic_validator(self, topic: str, fn, *,
+                                       timeout: Optional[float] = None,
+                                       concurrency: int = 1024,
+                                       inline: bool = False) -> None:
+        val = TopicValidator(topic, fn, timeout=timeout,
+                             concurrency=concurrency, inline=inline)
+        await self._eval(lambda: self.val.add_validator(val))
+
+    async def unregister_topic_validator(self, topic: str) -> None:
+        await self._eval(lambda: self.val.remove_validator(topic))

@@ -1,0 +1,47 @@
+"""First-seen time cache used for message dedup and expiring blacklists.
+
+Equivalent in behavior to the whyrusleeping/timecache dependency the
+reference uses for its seen-messages set (/root/reference/pubsub.go:240,
+851-868): entries expire ``ttl`` seconds after first insertion; re-adding an
+existing entry does NOT extend its life.
+
+Supports an injectable clock so tests and the simulator can use virtual time.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Callable, Optional
+
+
+class FirstSeenCache:
+    def __init__(self, ttl: float, clock: Optional[Callable[[], float]] = None):
+        self.ttl = ttl
+        self._clock = clock or time.monotonic
+        # insertion-ordered: oldest first, so sweeping stops early
+        self._entries: OrderedDict[object, float] = OrderedDict()
+
+    def _sweep(self) -> None:
+        now = self._clock()
+        while self._entries:
+            key, expiry = next(iter(self._entries.items()))
+            if expiry > now:
+                break
+            self._entries.popitem(last=False)
+
+    def add(self, key) -> bool:
+        """Insert if absent. Returns True if the key was newly added."""
+        self._sweep()
+        if key in self._entries:
+            return False
+        self._entries[key] = self._clock() + self.ttl
+        return True
+
+    def has(self, key) -> bool:
+        self._sweep()
+        return key in self._entries
+
+    def __len__(self) -> int:
+        self._sweep()
+        return len(self._entries)

@@ -1,0 +1,261 @@
+"""Topic and Subscription handles — the user-facing API.
+
+Behavioral equivalent of the reference handles (/root/reference/topic.go,
+subscription.go): per-topic publish/subscribe/relay with ref-counted
+announcements, peer join/leave event handlers with a collapsing event log,
+and pull-based subscriptions.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Optional
+
+from ..pb import rpc as pb
+from .types import Message, PeerEvent, PeerID
+from .validation import ValidationError
+
+
+class TopicClosedError(Exception):
+    pass
+
+
+class SubscriptionCancelledError(Exception):
+    pass
+
+
+class Subscription:
+    """Pull-based message consumption (reference subscription.go:10-51)."""
+
+    def __init__(self, ps, topic: str, buffer_size: int = 32):
+        self.ps = ps
+        self.topic = topic
+        self._buffer_size = buffer_size
+        self._buf: list[Message] = []
+        self._wakeup = asyncio.Event()
+        self._cancelled = False
+
+    def _deliver(self, msg: Message) -> None:
+        if len(self._buf) >= self._buffer_size:
+            return  # subscriber too slow: drop (reference pubsub.go:842-846)
+        self._buf.append(msg)
+        self._wakeup.set()
+
+    async def next(self) -> Message:
+        while True:
+            if self._buf:
+                return self._buf.pop(0)
+            if self._cancelled:
+                raise SubscriptionCancelledError(self.topic)
+            self._wakeup.clear()
+            await self._wakeup.wait()
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> Message:
+        try:
+            return await self.next()
+        except SubscriptionCancelledError:
+            raise StopAsyncIteration
+
+    def cancel(self) -> None:
+        if self._cancelled:
+            return
+        self._cancelled = True
+        self._wakeup.set()  # wake any consumer blocked in next()
+        self.ps._post(lambda: self.ps_remove())
+
+    def ps_remove(self) -> None:
+        # loop context (reference handleRemoveSubscription pubsub.go:665-686)
+        ps = self.ps
+        subs = ps.my_subs.get(self.topic)
+        if subs is None or self not in subs:
+            return
+        subs.discard(self)
+        if not subs:
+            del ps.my_subs[self.topic]
+            if ps.my_relays.get(self.topic, 0) == 0:
+                if ps.disc is not None:
+                    ps.disc.stop_advertise(self.topic)
+                ps._announce(self.topic, False)
+                ps.router.leave(self.topic)
+
+
+class TopicEventHandler:
+    """Peer join/leave events with a collapsing per-peer event log
+    (reference topic.go:301-386)."""
+
+    def __init__(self, topic: "Topic"):
+        self.topic = topic
+        self._log: dict[PeerID, PeerEvent.Type] = {}
+        self._signal = asyncio.Event()
+        self._cancelled = False
+
+    def _send(self, evt: PeerEvent) -> None:
+        existing = self._log.get(evt.peer)
+        if existing is None:
+            self._log[evt.peer] = evt.type
+            self._signal.set()
+        elif existing != evt.type:
+            # join+leave before anyone read it: the pair cancels out
+            del self._log[evt.peer]
+
+    async def next_peer_event(self) -> PeerEvent:
+        while True:
+            if self._cancelled:
+                raise TopicClosedError("event handler cancelled")
+            if self._log:
+                peer, typ = next(iter(self._log.items()))
+                del self._log[peer]
+                return PeerEvent(typ, peer)
+            self._signal.clear()
+            await self._signal.wait()
+
+    def cancel(self) -> None:
+        self._cancelled = True
+        self.topic._evt_handlers.discard(self)
+        self._signal.set()
+
+
+class Topic:
+    """Per-topic facade (reference topic.go)."""
+
+    def __init__(self, ps, name: str):
+        self.ps = ps
+        self.name = name
+        self.closed = False
+        self._evt_handlers: set[TopicEventHandler] = set()
+
+    # called from loop context
+    def _send_notification(self, evt: PeerEvent) -> None:
+        for h in list(self._evt_handlers):
+            h._send(evt)
+
+    async def event_handler(self) -> TopicEventHandler:
+        if self.closed:
+            raise TopicClosedError(self.name)
+        h = TopicEventHandler(self)
+        self._evt_handlers.add(h)
+        return h
+
+    async def subscribe(self, buffer_size: int = 32) -> Subscription:
+        """Create a subscription; first sub/relay announces + joins the
+        router (reference topic.go:135-172, pubsub.go:692-713)."""
+        if self.closed:
+            raise TopicClosedError(self.name)
+        sub = Subscription(self.ps, self.name, buffer_size)
+
+        def add():
+            ps = self.ps
+            subs = ps.my_subs.get(self.name)
+            if not subs and ps.my_relays.get(self.name, 0) == 0:
+                if ps.disc is not None:
+                    ps.disc.advertise(self.name)
+                ps._announce(self.name, True)
+                ps.router.join(self.name)
+            ps.my_subs.setdefault(self.name, set()).add(sub)
+            return sub
+
+        result = await self.ps._eval(add)
+        if self.ps.disc is not None:
+            await self.ps.disc.discover(self.name)
+        return result
+
+    async def relay(self) -> Callable[[], None]:
+        """Enable forwarding without delivery; returns a cancel function
+        (reference topic.go:174-195)."""
+        if self.closed:
+            raise TopicClosedError(self.name)
+
+        def add():
+            ps = self.ps
+            ps.my_relays[self.name] = ps.my_relays.get(self.name, 0) + 1
+            if ps.my_relays[self.name] == 1 and not ps.my_subs.get(self.name):
+                if ps.disc is not None:
+                    ps.disc.advertise(self.name)
+                ps._announce(self.name, True)
+                ps.router.join(self.name)
+
+        await self.ps._eval(add)
+
+        cancelled = False
+
+        def cancel() -> None:
+            nonlocal cancelled
+            if cancelled:
+                return
+            cancelled = True
+            self.ps._post(self._remove_relay)
+
+        return cancel
+
+    def _remove_relay(self) -> None:
+        ps = self.ps
+        if ps.my_relays.get(self.name, 0) == 0:
+            return
+        ps.my_relays[self.name] -= 1
+        if ps.my_relays[self.name] == 0:
+            del ps.my_relays[self.name]
+            if not ps.my_subs.get(self.name):
+                if ps.disc is not None:
+                    ps.disc.stop_advertise(self.name)
+                ps._announce(self.name, False)
+                ps.router.leave(self.name)
+
+    async def publish(self, data: bytes, ready=None) -> None:
+        """Build, sign, and locally validate a message
+        (reference topic.go:207-245)."""
+        if self.closed:
+            raise TopicClosedError(self.name)
+        ps = self.ps
+        m = pb.PubMessage(data=data, topic=self.name)
+        if ps.sign_id is not None:
+            m.from_peer = bytes(ps.sign_id)
+            m.seqno = ps.next_seqno()
+        if ps.sign_key is not None:
+            from .sign import sign_message
+            sign_message(m, ps.sign_key, ps.sign_id)
+
+        if ready is not None and ps.disc is not None:
+            await ps.disc.bootstrap(self.name, ready)
+
+        msg = Message(m, received_from=ps.host.id, local=True)
+        await ps.val.push_local(msg)
+
+    async def set_score_params(self, params) -> None:
+        """Live re-parameterization of this topic's score params
+        (reference topic.go:36-74)."""
+        router = self.ps.router
+        if not hasattr(router, "update_topic_score_params"):
+            raise ValueError("router does not support peer score")
+        err = await self.ps._eval(
+            lambda: router.update_topic_score_params(self.name, params))
+        if err is not None:
+            raise err
+
+    async def list_peers(self) -> list[PeerID]:
+        if self.closed:
+            return []
+        return await self.ps.list_peers(self.name)
+
+    async def close(self) -> None:
+        """Close the handle; errors if subs/relays/handlers outstanding
+        (reference topic.go:258-280, pubsub.go:644-661)."""
+        if self.closed:
+            return
+
+        def rm():
+            ps = self.ps
+            if (not self._evt_handlers and not ps.my_subs.get(self.name)
+                    and ps.my_relays.get(self.name, 0) == 0):
+                ps.my_topics.pop(self.name, None)
+                return None
+            return ValueError(
+                "cannot close topic: outstanding event handlers, "
+                "subscriptions, or relays")
+
+        err = await self.ps._eval(rm)
+        if err is not None:
+            raise err
+        self.closed = True

@@ -1,0 +1,197 @@
+"""Observability bus.
+
+Two tiers, mirroring the reference design (/root/reference/trace.go:15-59):
+
+- ``EventTracer``: receives fully-populated protobuf ``TraceEvent`` objects;
+  at most one per pubsub instance (sinks in ``tracer_sinks.py``).
+- ``RawTracer``: synchronous low-level callbacks; any number may be attached.
+  Internal components (peer score, gossip promise tracker, tag tracer, peer
+  gater) are themselves RawTracers — the observability bus doubles as the
+  internal wiring, a key architectural idea kept from the reference.
+
+The bus (``Tracer``) is invoked from the pubsub core at every significant
+event site and builds TraceEvents lazily (only when an EventTracer is set).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from ..pb import rpc as pb
+from ..pb import trace as tr
+from ..pb.trace import TraceType
+from .types import Message, MsgIdFunction, PeerID
+
+
+class EventTracer:
+    def trace(self, evt: tr.TraceEvent) -> None:
+        raise NotImplementedError
+
+
+class RawTracer:
+    """Override any subset; default callbacks are no-ops."""
+
+    def add_peer(self, p: PeerID, proto: str) -> None: ...
+    def remove_peer(self, p: PeerID) -> None: ...
+    def join(self, topic: str) -> None: ...
+    def leave(self, topic: str) -> None: ...
+    def graft(self, p: PeerID, topic: str) -> None: ...
+    def prune(self, p: PeerID, topic: str) -> None: ...
+    def validate_message(self, msg: Message) -> None: ...
+    def deliver_message(self, msg: Message) -> None: ...
+    def reject_message(self, msg: Message, reason: str) -> None: ...
+    def duplicate_message(self, msg: Message) -> None: ...
+    def throttle_peer(self, p: PeerID) -> None: ...
+
+
+def _now_ns(clock: Optional[Callable[[], float]] = None) -> int:
+    return time.time_ns() if clock is None else int(clock() * 1e9)
+
+
+class Tracer:
+    """Fan-out bus: one EventTracer + N RawTracers."""
+
+    def __init__(self, pid: PeerID, msg_id_fn: MsgIdFunction,
+                 event_tracer: Optional[EventTracer] = None,
+                 raw_tracers: Optional[list[RawTracer]] = None,
+                 clock: Optional[Callable[[], float]] = None):
+        self.pid = pid
+        self.msg_id = msg_id_fn
+        self.event_tracer = event_tracer
+        self.raw = list(raw_tracers or [])
+        self.clock = clock
+
+    def _emit(self, **kwargs) -> None:
+        if self.event_tracer is not None:
+            self.event_tracer.trace(tr.TraceEvent(
+                peer_id=bytes(self.pid), timestamp=_now_ns(self.clock), **kwargs))
+
+    # -- message events ----------------------------------------------------
+
+    def publish_message(self, msg: Message) -> None:
+        self._emit(type=TraceType.PUBLISH_MESSAGE,
+                   publish_message=tr.PublishMessageEv(
+                       message_id=self.msg_id(msg.rpc), topic=msg.rpc.topic))
+
+    def validate_message(self, msg: Message) -> None:
+        if msg.received_from != self.pid:
+            for t in self.raw:
+                t.validate_message(msg)
+
+    def reject_message(self, msg: Message, reason: str) -> None:
+        if msg.received_from != self.pid:
+            for t in self.raw:
+                t.reject_message(msg, reason)
+        self._emit(type=TraceType.REJECT_MESSAGE,
+                   reject_message=tr.RejectMessageEv(
+                       message_id=self.msg_id(msg.rpc),
+                       received_from=bytes(msg.received_from or b""),
+                       reason=reason, topic=msg.rpc.topic))
+
+    def duplicate_message(self, msg: Message) -> None:
+        if msg.received_from != self.pid:
+            for t in self.raw:
+                t.duplicate_message(msg)
+        self._emit(type=TraceType.DUPLICATE_MESSAGE,
+                   duplicate_message=tr.DuplicateMessageEv(
+                       message_id=self.msg_id(msg.rpc),
+                       received_from=bytes(msg.received_from or b""),
+                       topic=msg.rpc.topic))
+
+    def deliver_message(self, msg: Message) -> None:
+        if msg.received_from != self.pid:
+            for t in self.raw:
+                t.deliver_message(msg)
+        self._emit(type=TraceType.DELIVER_MESSAGE,
+                   deliver_message=tr.DeliverMessageEv(
+                       message_id=self.msg_id(msg.rpc), topic=msg.rpc.topic,
+                       received_from=bytes(msg.received_from or b"")))
+
+    # -- peer / topic events ----------------------------------------------
+
+    def add_peer(self, p: PeerID, proto: str) -> None:
+        for t in self.raw:
+            t.add_peer(p, proto)
+        self._emit(type=TraceType.ADD_PEER,
+                   add_peer=tr.AddPeerEv(peer_id=bytes(p), proto=proto))
+
+    def remove_peer(self, p: PeerID) -> None:
+        for t in self.raw:
+            t.remove_peer(p)
+        self._emit(type=TraceType.REMOVE_PEER,
+                   remove_peer=tr.RemovePeerEv(peer_id=bytes(p)))
+
+    def join(self, topic: str) -> None:
+        for t in self.raw:
+            t.join(topic)
+        self._emit(type=TraceType.JOIN, join=tr.JoinEv(topic=topic))
+
+    def leave(self, topic: str) -> None:
+        for t in self.raw:
+            t.leave(topic)
+        self._emit(type=TraceType.LEAVE, leave=tr.LeaveEv(topic=topic))
+
+    def graft(self, p: PeerID, topic: str) -> None:
+        for t in self.raw:
+            t.graft(p, topic)
+        self._emit(type=TraceType.GRAFT,
+                   graft=tr.GraftEv(peer_id=bytes(p), topic=topic))
+
+    def prune(self, p: PeerID, topic: str) -> None:
+        for t in self.raw:
+            t.prune(p, topic)
+        self._emit(type=TraceType.PRUNE,
+                   prune=tr.PruneEv(peer_id=bytes(p), topic=topic))
+
+    def throttle_peer(self, p: PeerID) -> None:
+        for t in self.raw:
+            t.throttle_peer(p)
+
+    # -- RPC events --------------------------------------------------------
+
+    def _rpc_meta(self, rpc: pb.RPC) -> tr.RPCMeta:
+        meta = tr.RPCMeta()
+        for m in rpc.publish:
+            meta.messages.append(tr.MessageMeta(
+                message_id=self.msg_id(m), topic=m.topic))
+        for s in rpc.subscriptions:
+            meta.subscription.append(tr.SubMeta(
+                subscribe=s.subscribe, topic=s.topicid))
+        c = rpc.control
+        if c is not None and not c.is_empty():
+            cm = tr.ControlMeta()
+            for ih in c.ihave:
+                cm.ihave.append(tr.ControlIHaveMeta(
+                    topic=ih.topic_id, message_ids=list(ih.message_ids)))
+            for iw in c.iwant:
+                cm.iwant.append(tr.ControlIWantMeta(message_ids=list(iw.message_ids)))
+            for g in c.graft:
+                cm.graft.append(tr.ControlGraftMeta(topic=g.topic_id))
+            for pr in c.prune:
+                cm.prune.append(tr.ControlPruneMeta(
+                    topic=pr.topic_id,
+                    peers=[pi.peer_id for pi in pr.peers if pi.peer_id]))
+            meta.control = cm
+        return meta
+
+    def recv_rpc(self, rpc: pb.RPC, from_peer: PeerID) -> None:
+        if self.event_tracer is None:
+            return
+        self._emit(type=TraceType.RECV_RPC,
+                   recv_rpc=tr.RecvRPCEv(received_from=bytes(from_peer),
+                                         meta=self._rpc_meta(rpc)))
+
+    def send_rpc(self, rpc: pb.RPC, to: PeerID) -> None:
+        if self.event_tracer is None:
+            return
+        self._emit(type=TraceType.SEND_RPC,
+                   send_rpc=tr.SendRPCEv(send_to=bytes(to),
+                                         meta=self._rpc_meta(rpc)))
+
+    def drop_rpc(self, rpc: pb.RPC, to: PeerID) -> None:
+        if self.event_tracer is None:
+            return
+        self._emit(type=TraceType.DROP_RPC,
+                   drop_rpc=tr.DropRPCEv(send_to=bytes(to),
+                                         meta=self._rpc_meta(rpc)))

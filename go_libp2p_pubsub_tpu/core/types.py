@@ -1,0 +1,135 @@
+"""Shared core types: peer IDs, protocol IDs, result lattices, defaults.
+
+Semantics mirror the reference runtime (see /root/reference/pubsub.go:27-30,
+157-199 and /root/reference/validation.go:20-63) without reusing its code.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+# -- protocol IDs ----------------------------------------------------------
+
+FLOODSUB_ID = "/floodsub/1.0.0"
+RANDOMSUB_ID = "/randomsub/1.0.0"
+GOSSIPSUB_ID_V10 = "/meshsub/1.0.0"
+GOSSIPSUB_ID_V11 = "/meshsub/1.1.0"
+
+# -- global defaults (reference pubsub.go:27-30) ---------------------------
+
+DEFAULT_MAX_MESSAGE_SIZE = 1 << 20          # 1 MiB
+TIME_CACHE_DURATION = 120.0                 # seen-message TTL seconds
+DEFAULT_PEER_OUTBOUND_QUEUE_SIZE = 32
+DEFAULT_VALIDATE_QUEUE_SIZE = 32
+DEFAULT_VALIDATE_THROTTLE = 8192
+DEFAULT_VALIDATE_TOPIC_THROTTLE = 1024
+
+SIGN_PREFIX = b"libp2p-pubsub:"
+
+
+class PeerID(bytes):
+    """A peer identity: the multihash bytes of the peer's public key.
+
+    Subclasses bytes so it is hashable, comparable, and drops straight into
+    wire fields.  ``pretty()`` renders base58btc like libp2p peer IDs.
+    """
+
+    __slots__ = ()
+
+    _B58 = "123456789ABCDEFGHJKLMNPQRSTUVWXYZabcdefghijkmnopqrstuvwxyz"
+
+    def pretty(self) -> str:
+        n = int.from_bytes(b"\x01" + self, "big")  # prefix guards leading zeros
+        out = []
+        while n:
+            n, r = divmod(n, 58)
+            out.append(self._B58[r])
+        return "".join(reversed(out))
+
+    def short(self) -> str:
+        p = self.pretty()
+        return p[-8:]
+
+    def __repr__(self) -> str:
+        return f"<peer {self.short()}>"
+
+
+class AcceptStatus(enum.Enum):
+    """Router verdict on an incoming RPC (reference pubsub.go:189-199)."""
+
+    NONE = 0      # drop the whole RPC
+    CONTROL = 1   # process only control messages, drop payload
+    ALL = 2       # process everything
+
+
+class ValidationResult(enum.IntEnum):
+    """Extended validator verdict (reference validation.go:38-48)."""
+
+    ACCEPT = 0
+    REJECT = 1
+    IGNORE = 2
+
+
+# Rejection reasons surfaced via the tracer (reference tracer.go:49-61).
+REJECT_BLACKLISTED_PEER = "blacklisted peer"
+REJECT_BLACKLISTED_SOURCE = "blacklisted source"
+REJECT_MISSING_SIGNATURE = "missing signature"
+REJECT_UNEXPECTED_SIGNATURE = "unexpected signature"
+REJECT_UNEXPECTED_AUTH_INFO = "unexpected auth info"
+REJECT_INVALID_SIGNATURE = "invalid signature"
+REJECT_VALIDATION_QUEUE_FULL = "validation queue full"
+REJECT_VALIDATION_THROTTLED = "validation throttled"
+REJECT_VALIDATION_FAILED = "validation failed"
+REJECT_VALIDATION_IGNORED = "validation ignored"
+REJECT_SELF_ORIGIN = "self originated message"
+
+
+@dataclass
+class Message:
+    """A pubsub message as seen by the application layer.
+
+    Wraps the wire message plus receive metadata (reference pubsub.go:150-155).
+    """
+
+    rpc: object                       # pb.PubMessage
+    received_from: Optional[PeerID] = None
+    validator_data: object = None
+    local: bool = False
+
+    @property
+    def data(self) -> bytes:
+        return self.rpc.data or b""
+
+    @property
+    def topic(self) -> str:
+        return self.rpc.topic
+
+    @property
+    def from_peer(self) -> Optional[PeerID]:
+        return PeerID(self.rpc.from_peer) if self.rpc.from_peer else None
+
+    @property
+    def seqno(self) -> Optional[bytes]:
+        return self.rpc.seqno
+
+
+MsgIdFunction = Callable[[object], bytes]
+
+
+def default_msg_id_fn(pmsg) -> bytes:
+    """Default message ID: concat(from, seqno) (reference pubsub.go:1166-1179)."""
+    return (pmsg.from_peer or b"") + (pmsg.seqno or b"")
+
+
+@dataclass
+class PeerEvent:
+    """Topic peer join/leave event (reference topic.go:301-310)."""
+
+    class Type(enum.IntEnum):
+        JOIN = 0
+        LEAVE = 1
+
+    type: "PeerEvent.Type"
+    peer: PeerID

@@ -1,0 +1,231 @@
+"""Validation pipeline.
+
+Behavioral equivalent of the reference front-end (/root/reference/
+validation.go:65-546) in asyncio: a bounded queue feeds worker tasks that
+verify signatures, dedup via the seen-cache, run inline validators, and
+schedule async validators under global + per-topic concurrency throttles.
+Results form the lattice Accept < Ignore < Throttled < Reject.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+from typing import Callable, Optional
+
+from .sign import SignatureError, verify_message_signature
+from .types import (
+    DEFAULT_VALIDATE_QUEUE_SIZE,
+    DEFAULT_VALIDATE_THROTTLE,
+    DEFAULT_VALIDATE_TOPIC_THROTTLE,
+    Message,
+    PeerID,
+    REJECT_INVALID_SIGNATURE,
+    REJECT_VALIDATION_FAILED,
+    REJECT_VALIDATION_IGNORED,
+    REJECT_VALIDATION_QUEUE_FULL,
+    REJECT_VALIDATION_THROTTLED,
+    ValidationResult,
+)
+
+# internal lattice value (reference validation.go:52)
+_THROTTLED = -1
+
+
+class ValidationError(Exception):
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class TopicValidator:
+    """A registered validator for one topic."""
+
+    def __init__(self, topic: str, fn: Callable, *, timeout: Optional[float] = None,
+                 concurrency: int = DEFAULT_VALIDATE_TOPIC_THROTTLE,
+                 inline: bool = False):
+        self.topic = topic
+        self.fn = fn
+        self.timeout = timeout
+        self.inline = inline
+        self.semaphore = asyncio.Semaphore(concurrency)
+
+    async def run(self, src: PeerID, msg: Message) -> ValidationResult:
+        try:
+            if self.timeout:
+                res = await asyncio.wait_for(self._call(src, msg), self.timeout)
+            else:
+                res = await self._call(src, msg)
+        except asyncio.TimeoutError:
+            return ValidationResult.IGNORE
+        if isinstance(res, bool):  # plain Validator: bool verdict
+            return ValidationResult.ACCEPT if res else ValidationResult.REJECT
+        if res in (ValidationResult.ACCEPT, ValidationResult.REJECT,
+                   ValidationResult.IGNORE):
+            return ValidationResult(res)
+        return ValidationResult.IGNORE  # unexpected result
+
+    async def _call(self, src: PeerID, msg: Message):
+        res = self.fn(src, msg)
+        if inspect.isawaitable(res):
+            res = await res
+        return res
+
+
+class Validation:
+    """The pipeline. Owned by a PubSub instance."""
+
+    def __init__(self, ps, *, queue_size: int = DEFAULT_VALIDATE_QUEUE_SIZE,
+                 throttle: int = DEFAULT_VALIDATE_THROTTLE, workers: int = 4):
+        self.ps = ps
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize=queue_size)
+        self.throttle = asyncio.Semaphore(throttle)
+        self.num_workers = workers
+        self.topic_vals: dict[str, TopicValidator] = {}
+        self._tasks: list[asyncio.Task] = []
+
+    def start(self) -> None:
+        for _ in range(self.num_workers):
+            self._tasks.append(asyncio.ensure_future(self._worker()))
+
+    def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+
+    # -- registration ------------------------------------------------------
+
+    def add_validator(self, val: TopicValidator) -> None:
+        if val.topic in self.topic_vals:
+            raise ValueError(f"duplicate validator for topic {val.topic}")
+        self.topic_vals[val.topic] = val
+
+    def remove_validator(self, topic: str) -> None:
+        if topic not in self.topic_vals:
+            raise ValueError(f"no validator for topic {topic}")
+        del self.topic_vals[topic]
+
+    def _get_validators(self, msg: Message) -> list[TopicValidator]:
+        val = self.topic_vals.get(msg.topic)
+        return [val] if val is not None else []
+
+    # -- entry points ------------------------------------------------------
+
+    async def push_local(self, msg: Message) -> None:
+        """Synchronously validate a locally published message; raises on
+        failure (reference validation.go:216-226)."""
+        self.ps.tracer.publish_message(msg)
+        self.ps.check_signing_policy(msg)  # raises ValidationError
+        vals = self._get_validators(msg)
+        await self._validate(vals, msg.received_from, msg, synchronous=True)
+
+    def push(self, src: PeerID, msg: Message) -> bool:
+        """Queue a remote message for validation.  Returns True when no
+        validation is needed and the caller may forward immediately."""
+        vals = self._get_validators(msg)
+        if vals or msg.rpc.signature is not None:
+            try:
+                self.queue.put_nowait((vals, src, msg))
+            except asyncio.QueueFull:
+                self.ps.tracer.reject_message(msg, REJECT_VALIDATION_QUEUE_FULL)
+            return False
+        return True
+
+    # -- pipeline ----------------------------------------------------------
+
+    async def _worker(self) -> None:
+        while True:
+            vals, src, msg = await self.queue.get()
+            try:
+                await self._validate(vals, src, msg, synchronous=False)
+            except ValidationError:
+                pass
+            except Exception:  # user validator bug must not kill the worker
+                import traceback
+                traceback.print_exc()
+
+    async def _validate(self, vals: list[TopicValidator], src: Optional[PeerID],
+                        msg: Message, synchronous: bool) -> None:
+        if msg.rpc.signature is not None:
+            try:
+                verify_message_signature(msg.rpc)
+            except SignatureError:
+                self.ps.tracer.reject_message(msg, REJECT_INVALID_SIGNATURE)
+                raise ValidationError(REJECT_INVALID_SIGNATURE)
+
+        # mark seen after signature verification so user validators run once
+        msg_id = self.ps.msg_id(msg.rpc)
+        if not self.ps.mark_seen(msg_id):
+            self.ps.tracer.duplicate_message(msg)
+            return
+        self.ps.tracer.validate_message(msg)
+
+        inline = [v for v in vals if v.inline or synchronous]
+        async_vals = [v for v in vals if not (v.inline or synchronous)]
+
+        result = ValidationResult.ACCEPT
+        for val in inline:
+            r = await val.run(src, msg)
+            if r == ValidationResult.REJECT:
+                result = ValidationResult.REJECT
+                break
+            if r == ValidationResult.IGNORE:
+                result = ValidationResult.IGNORE
+
+        if result == ValidationResult.REJECT:
+            self.ps.tracer.reject_message(msg, REJECT_VALIDATION_FAILED)
+            raise ValidationError(REJECT_VALIDATION_FAILED)
+
+        if async_vals:
+            if self.throttle.locked():
+                self.ps.tracer.reject_message(msg, REJECT_VALIDATION_THROTTLED)
+                return
+            await self.throttle.acquire()
+            # tracked so PubSub.close() can cancel in-flight validations
+            self.ps._spawn(
+                self._do_validate_async(async_vals, src, msg, result))
+            return
+
+        if result == ValidationResult.IGNORE:
+            self.ps.tracer.reject_message(msg, REJECT_VALIDATION_IGNORED)
+            raise ValidationError(REJECT_VALIDATION_IGNORED)
+
+        self.ps.deliver_validated(msg)
+
+    async def _do_validate_async(self, vals: list[TopicValidator],
+                                 src: Optional[PeerID], msg: Message,
+                                 prior: ValidationResult) -> None:
+        try:
+            result = await self._validate_topic(vals, src, msg)
+            if result == ValidationResult.ACCEPT and prior != ValidationResult.ACCEPT:
+                result = prior
+            if result == ValidationResult.ACCEPT:
+                self.ps.deliver_validated(msg)
+            elif result == ValidationResult.REJECT:
+                self.ps.tracer.reject_message(msg, REJECT_VALIDATION_FAILED)
+            elif result == _THROTTLED:
+                self.ps.tracer.reject_message(msg, REJECT_VALIDATION_THROTTLED)
+            else:
+                self.ps.tracer.reject_message(msg, REJECT_VALIDATION_IGNORED)
+        finally:
+            self.throttle.release()
+
+    async def _validate_topic(self, vals, src, msg):
+        results = []
+        for val in vals:
+            if val.semaphore.locked():
+                # per-topic throttle: treat as Throttled (takes precedence
+                # over Ignore in the result lattice)
+                results.append(_THROTTLED)
+                continue
+            async with val.semaphore:
+                results.append(await val.run(src, msg))
+
+        result = ValidationResult.ACCEPT
+        for r in results:
+            if r == ValidationResult.REJECT:
+                return ValidationResult.REJECT
+            if r == _THROTTLED:
+                result = _THROTTLED
+            elif r == ValidationResult.IGNORE and result != _THROTTLED:
+                result = ValidationResult.IGNORE
+        return result

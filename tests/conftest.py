@@ -5,6 +5,8 @@ validated on host CPU devices instead (the driver separately dry-run-compiles
 the multi-chip path via __graft_entry__.dryrun_multichip).
 """
 
+import asyncio
+import inspect
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -13,3 +15,14 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run ``async def`` tests under asyncio.run (no plugin dependency)."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {name: pyfuncitem.funcargs[name]
+                  for name in pyfuncitem._fixtureinfo.argnames}
+        asyncio.run(asyncio.wait_for(fn(**kwargs), timeout=120))
+        return True
+    return None

@@ -1,0 +1,278 @@
+"""FloodSub end-to-end tests, mirroring the reference suite's core scenarios
+(/root/reference/floodsub_test.go: TestBasicFloodsub, TestMultihops,
+TestReconnects, TestSelfReceive, subscription announcements)."""
+
+import asyncio
+
+import pytest
+
+from go_libp2p_pubsub_tpu.core import (
+    InProcNetwork,
+    MessageSignaturePolicy,
+    create_floodsub,
+)
+from helpers import connect, connect_all, dense_connect, get_hosts, settle
+
+
+async def make_floodsubs(hosts, **kwargs):
+    return [await create_floodsub(h, **kwargs) for h in hosts]
+
+
+async def close_all(pubsubs, net):
+    for ps in pubsubs:
+        await ps.close()
+    await net.close()
+
+
+async def test_basic_floodsub():
+    # 20 hosts, dense topology, every host publishes; all others receive
+    net = InProcNetwork()
+    hosts = get_hosts(net, 20)
+    psubs = await make_floodsubs(hosts)
+    subs = []
+    for ps in psubs:
+        topic = await ps.join("foobar")
+        subs.append(await topic.subscribe())
+    await dense_connect(hosts)
+    await settle(0.1)
+
+    for i, ps in enumerate(psubs):
+        data = f"it's not a floooood {i}".encode()
+        topic = await ps.join("foobar")
+        await topic.publish(data)
+        for j, sub in enumerate(subs):
+            msg = await asyncio.wait_for(sub.next(), 5)
+            assert msg.data == data
+            assert msg.from_peer == hosts[i].id
+
+    await close_all(psubs, net)
+
+
+async def test_self_receive():
+    net = InProcNetwork()
+    hosts = get_hosts(net, 1)
+    (ps,) = await make_floodsubs(hosts)
+    topic = await ps.join("t")
+    sub = await topic.subscribe()
+    await topic.publish(b"hello self")
+    msg = await asyncio.wait_for(sub.next(), 5)
+    assert msg.data == b"hello self"
+    assert msg.local or msg.received_from == hosts[0].id
+    await close_all([ps], net)
+
+
+async def test_multihop_does_not_forward():
+    # floodsub does NOT relay beyond direct topic peers unless the middle
+    # node subscribes: A - B - C with only A,C subscribed -> no delivery
+    net = InProcNetwork()
+    hosts = get_hosts(net, 3)
+    psubs = await make_floodsubs(hosts)
+    ta = await psubs[0].join("chain")
+    tc = await psubs[2].join("chain")
+    sub_c = await tc.subscribe()
+    _sub_a = await ta.subscribe()
+    await connect(hosts[0], hosts[1])
+    await connect(hosts[1], hosts[2])
+    await settle(0.1)
+
+    await ta.publish(b"hop hop")
+    with pytest.raises(asyncio.TimeoutError):
+        await asyncio.wait_for(sub_c.next(), 0.3)
+    await close_all(psubs, net)
+
+
+async def test_multihop_with_middle_subscriber():
+    # when B also subscribes, the message relays A -> B -> C
+    net = InProcNetwork()
+    hosts = get_hosts(net, 3)
+    psubs = await make_floodsubs(hosts)
+    topics = [await ps.join("chain") for ps in psubs]
+    subs = [await t.subscribe() for t in topics]
+    await connect(hosts[0], hosts[1])
+    await connect(hosts[1], hosts[2])
+    await settle(0.1)
+
+    await topics[0].publish(b"over the river")
+    for sub in subs[1:]:
+        msg = await asyncio.wait_for(sub.next(), 5)
+        assert msg.data == b"over the river"
+    await close_all(psubs, net)
+
+
+async def test_reconnect():
+    net = InProcNetwork()
+    hosts = get_hosts(net, 2)
+    psubs = await make_floodsubs(hosts)
+    t0 = await psubs[0].join("t")
+    t1 = await psubs[1].join("t")
+    sub1 = await t1.subscribe()
+    await connect(hosts[0], hosts[1])
+    await settle(0.1)
+
+    await t0.publish(b"one")
+    assert (await asyncio.wait_for(sub1.next(), 5)).data == b"one"
+
+    await hosts[0].disconnect(hosts[1].id)
+    await settle(0.1)
+    assert await psubs[0].list_peers("t") == []
+
+    await connect(hosts[0], hosts[1])
+    await settle(0.2)
+    await t0.publish(b"two")
+    assert (await asyncio.wait_for(sub1.next(), 5)).data == b"two"
+    await close_all(psubs, net)
+
+
+async def test_no_sign_policy():
+    net = InProcNetwork()
+    hosts = get_hosts(net, 2)
+    psubs = await make_floodsubs(
+        hosts, sign_policy=MessageSignaturePolicy.STRICT_NO_SIGN)
+    t0 = await psubs[0].join("t")
+    t1 = await psubs[1].join("t")
+    sub = await t1.subscribe()
+    await connect(hosts[0], hosts[1])
+    await settle(0.1)
+    await t0.publish(b"anon")
+    msg = await asyncio.wait_for(sub.next(), 5)
+    assert msg.data == b"anon"
+    assert msg.rpc.signature is None and msg.rpc.from_peer is None
+    await close_all(psubs, net)
+
+
+async def test_subscription_announcement_reaches_late_peer():
+    # host connects AFTER the subscription exists; hello packet carries it
+    net = InProcNetwork()
+    hosts = get_hosts(net, 2)
+    psubs = await make_floodsubs(hosts)
+    t1 = await psubs[1].join("late")
+    sub = await t1.subscribe()
+    await settle(0.05)
+    await connect(hosts[0], hosts[1])
+    await settle(0.1)
+
+    assert await psubs[0].list_peers("late") == [hosts[1].id]
+    t0 = await psubs[0].join("late")
+    await t0.publish(b"hi")
+    assert (await asyncio.wait_for(sub.next(), 5)).data == b"hi"
+    await close_all(psubs, net)
+
+
+async def test_unsubscribe_announcement():
+    net = InProcNetwork()
+    hosts = get_hosts(net, 2)
+    psubs = await make_floodsubs(hosts)
+    t1 = await psubs[1].join("t")
+    sub = await t1.subscribe()
+    await connect(hosts[0], hosts[1])
+    await settle(0.1)
+    assert await psubs[0].list_peers("t") == [hosts[1].id]
+
+    sub.cancel()
+    await settle(0.1)
+    assert await psubs[0].list_peers("t") == []
+    await close_all(psubs, net)
+
+
+async def test_blacklist_drops_messages():
+    net = InProcNetwork()
+    hosts = get_hosts(net, 2)
+    psubs = await make_floodsubs(hosts)
+    t0 = await psubs[0].join("t")
+    t1 = await psubs[1].join("t")
+    sub = await t1.subscribe()
+    await connect(hosts[0], hosts[1])
+    await settle(0.1)
+
+    await psubs[1].blacklist_peer(hosts[0].id)
+    await settle(0.05)
+    await t0.publish(b"nope")
+    with pytest.raises(asyncio.TimeoutError):
+        await asyncio.wait_for(sub.next(), 0.3)
+    await close_all(psubs, net)
+
+
+async def test_peer_events():
+    net = InProcNetwork()
+    hosts = get_hosts(net, 2)
+    psubs = await make_floodsubs(hosts)
+    t0 = await psubs[0].join("evt")
+    handler = await t0.event_handler()
+    t1 = await psubs[1].join("evt")
+    sub = await t1.subscribe()
+    await connect(hosts[0], hosts[1])
+    ev = await asyncio.wait_for(handler.next_peer_event(), 5)
+    assert ev.peer == hosts[1].id and ev.type.name == "JOIN"
+
+    sub.cancel()
+    ev = await asyncio.wait_for(handler.next_peer_event(), 5)
+    assert ev.peer == hosts[1].id and ev.type.name == "LEAVE"
+    await close_all(psubs, net)
+
+
+async def test_validator_rejects():
+    net = InProcNetwork()
+    hosts = get_hosts(net, 2)
+    psubs = await make_floodsubs(hosts)
+    t0 = await psubs[0].join("guarded")
+    t1 = await psubs[1].join("guarded")
+    sub = await t1.subscribe()
+
+    async def validator(src, msg):
+        return b"bad" not in msg.data
+
+    await psubs[1].register_topic_validator("guarded", validator)
+    await connect(hosts[0], hosts[1])
+    await settle(0.1)
+
+    await t0.publish(b"a bad message")
+    await t0.publish(b"a good message")
+    msg = await asyncio.wait_for(sub.next(), 5)
+    assert msg.data == b"a good message"
+    await close_all(psubs, net)
+
+
+async def test_message_signature_verified_on_wire():
+    # messages forwarded between hosts carry valid signatures; a host with
+    # strict policy accepts them (full sign/verify round over the wire)
+    net = InProcNetwork()
+    hosts = get_hosts(net, 5)
+    psubs = await make_floodsubs(hosts)
+    topics = [await ps.join("signed") for ps in psubs]
+    subs = [await t.subscribe() for t in topics]
+    await connect_all(hosts)
+    await settle(0.1)
+    await topics[0].publish(b"authenticated")
+    for sub in subs[1:]:
+        msg = await asyncio.wait_for(sub.next(), 5)
+        assert msg.data == b"authenticated"
+        assert msg.rpc.signature is not None
+    await close_all(psubs, net)
+
+
+async def test_cancel_wakes_blocked_consumer():
+    net = InProcNetwork()
+    hosts = get_hosts(net, 1)
+    (ps,) = await make_floodsubs(hosts)
+    topic = await ps.join("t")
+    sub = await topic.subscribe()
+
+    async def consume():
+        with pytest.raises(Exception):
+            await sub.next()
+
+    task = asyncio.ensure_future(consume())
+    await settle(0.05)
+    sub.cancel()
+    await asyncio.wait_for(task, 2)  # must not hang
+    await close_all([ps], net)
+
+
+async def test_api_raises_after_close():
+    net = InProcNetwork()
+    hosts = get_hosts(net, 1)
+    (ps,) = await make_floodsubs(hosts)
+    await ps.close()
+    with pytest.raises(RuntimeError):
+        await ps.get_topics()
+    await net.close()

@@ -88,11 +88,19 @@ async def handle_new_peer(ps, conn: PeerConn) -> None:
         while True:
             rpc = await conn.queue.get()
             stream.write(write_delimited(rpc))
-    except (asyncio.CancelledError, StreamResetError):
+    except asyncio.CancelledError:
         try:
             stream.close()
         except Exception:
             pass
+    except StreamResetError:
+        # write failure = dead peer (reference comm.go:100-106): tear the
+        # peer down so the core can respawn or remove it
+        try:
+            stream.close()
+        except Exception:
+            pass
+        ps._post(lambda: ps._handle_peer_dead(conn.pid))
 
 
 async def handle_new_stream(ps, stream: Stream) -> None:

@@ -110,6 +110,11 @@ class Stream:
     def write(self, data: bytes) -> None:
         if self._closed:
             raise StreamResetError("write on closed stream")
+        if self._tx._reset or self._tx._eof:
+            # remote reset the stream: writing errors instead of
+            # black-holing (matches real stream semantics the comm layer's
+            # dead-peer handling depends on)
+            raise StreamResetError("write on reset stream")
         self._net._deliver(self.conn, self._tx, data)
 
     async def read_exact(self, n: int) -> bytes:

@@ -13,13 +13,13 @@ from __future__ import annotations
 import asyncio
 import random
 import time
-from typing import Awaitable, Callable, Iterable, Optional
+from typing import Awaitable, Callable, Optional
 
 from ..pb import rpc as pb
 from .blacklist import Blacklist, MapBlacklist
 from .comm import PeerConn, handle_new_peer, handle_new_stream, rpc_with_subs
 from .host import Host, Notifiee, Stream
-from .sign import MessageSignaturePolicy, sign_message
+from .sign import MessageSignaturePolicy
 from .timecache import FirstSeenCache
 from .trace import EventTracer, RawTracer, Tracer
 from .types import (
@@ -110,6 +110,7 @@ class PubSub:
                  validate_throttle: int = 8192,
                  validate_workers: int = 4,
                  seen_ttl: float = TIME_CACHE_DURATION,
+                 no_author: bool = False,
                  clock: Optional[Callable[[], float]] = None):
         self.host = host
         self.router = router
@@ -122,8 +123,11 @@ class PubSub:
         self.max_message_size = max_message_size
         self.clock = clock or time.monotonic
 
-        self.sign_id: Optional[PeerID] = host.id if sign_policy.must_sign else None
-        self.sign_key = host.key if sign_policy.must_sign else None
+        # the author defaults to the host regardless of signing policy
+        # (reference pubsub.go:230); WithNoAuthor clears it (pubsub.go:366-373)
+        self.sign_id: Optional[PeerID] = None if no_author else host.id
+        self.sign_key = host.key if (sign_policy.must_sign
+                                     and not no_author) else None
 
         # all state below is owned by the process loop
         self.peers: dict[PeerID, PeerConn] = {}
@@ -147,6 +151,7 @@ class PubSub:
         self._queue: asyncio.Queue = asyncio.Queue()
         self._loop_task: Optional[asyncio.Task] = None
         self._tasks: set[asyncio.Task] = set()
+        self._pending_evals: set[asyncio.Future] = set()
         self._closed = False
 
     # -- construction ------------------------------------------------------
@@ -167,6 +172,10 @@ class PubSub:
 
     async def close(self) -> None:
         self._closed = True
+        for fut in list(self._pending_evals):
+            if not fut.done():
+                fut.set_exception(RuntimeError("pubsub instance is closed"))
+        self._pending_evals.clear()
         if self.disc is not None:
             self.disc.stop()
         self.val.stop()
@@ -192,8 +201,12 @@ class PubSub:
         if self._closed:
             raise RuntimeError("pubsub instance is closed")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending_evals.add(fut)
 
         def run():
+            self._pending_evals.discard(fut)
+            if fut.done():  # closed while queued
+                return
             try:
                 fut.set_result(fn())
             except Exception as e:  # propagate to caller

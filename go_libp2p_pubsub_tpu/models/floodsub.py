@@ -1,0 +1,209 @@
+"""FloodSub simulator: every-peer-at-once flood dissemination.
+
+The vectorized counterpart of the protocol core's FloodSubRouter
+(core/floodsub.py; reference /root/reference/floodsub.go): one jitted
+``step`` advances one virtual tick (= one network hop) for ALL simulated
+peers simultaneously.  Message possession is bitpacked (32 message slots per
+uint32 word), subscriptions/relays become forward/deliver masks, and
+first-delivery ticks are recorded per (peer, message) so
+reachability-vs-hops curves fall out as histograms.
+
+State is a flax pytree; sharding the peer axis (leading dim of every [N,...]
+array) over a device mesh makes the same ``step`` run multi-chip unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..ops.graph import (
+    WORD_BITS,
+    count_bits_per_position,
+    pack_bits,
+    propagate,
+    propagate_circulant,
+)
+
+
+@struct.dataclass
+class FloodParams:
+    """Static (per-simulation) arrays.  nbrs/nbr_mask are None for
+    circulant topologies (offsets are compile-time constants instead)."""
+
+    nbrs: jnp.ndarray          # int32 [N, K] or None
+    nbr_mask: jnp.ndarray      # bool  [N, K] or None
+    fwd_words: jnp.ndarray     # uint32 [N, W]: will forward bit m
+    deliver_words: jnp.ndarray # uint32 [N, W]: counts as delivery for bit m
+    origin_words: jnp.ndarray  # uint32 [N, W]: bit m set at origin[m]
+    publish_tick: jnp.ndarray  # int32 [M]
+
+
+@struct.dataclass
+class FloodState:
+    have: jnp.ndarray        # uint32 [N, W]
+    first_tick: jnp.ndarray  # int16 [N, W, 32], -1 = never delivered
+    # (word-aligned layout: bit j of word w is message w*32+j; stored
+    # unreshaped so the hot-loop update never materializes a relayout)
+    tick: jnp.ndarray        # int32 scalar
+
+
+def make_flood_sim(nbrs: np.ndarray, nbr_mask: np.ndarray, subs: np.ndarray,
+                   relays: np.ndarray | None, msg_topic: np.ndarray,
+                   msg_origin: np.ndarray, msg_publish_tick: np.ndarray,
+                   track_first_tick: bool = True):
+    """Build (params, state) for a flood simulation.
+
+    subs/relays: bool [N, T]; msg_*: [M] arrays describing the message table.
+    track_first_tick=False drops the per-(peer, message) delivery-tick array
+    (use flood_run_curve's per-tick counts instead) — the fast path.
+    """
+    n = subs.shape[0]
+    m = len(msg_topic)
+    if relays is None:
+        relays = np.zeros_like(subs)
+    if nbrs is None:
+        nbrs_j = nbr_mask_j = None
+    else:
+        nbrs_j, nbr_mask_j = jnp.asarray(nbrs), jnp.asarray(nbr_mask)
+
+    sub_bits = subs[:, msg_topic]                  # [N, M]
+    relay_bits = relays[:, msg_topic]
+    origin_bits = np.zeros((n, m), dtype=bool)
+    origin_bits[msg_origin, np.arange(m)] = True
+
+    # a peer forwards what it is subscribed/relaying for, plus its own
+    # publishes (publish-without-subscribe floods too, floodsub.go:76-100)
+    fwd = sub_bits | relay_bits | origin_bits
+    params = FloodParams(
+        nbrs=nbrs_j,
+        nbr_mask=nbr_mask_j,
+        fwd_words=pack_bits(jnp.asarray(fwd)),
+        deliver_words=pack_bits(jnp.asarray(sub_bits)),
+        origin_words=pack_bits(jnp.asarray(origin_bits)),
+        publish_tick=jnp.asarray(msg_publish_tick, dtype=jnp.int32),
+    )
+    w = params.fwd_words.shape[1]
+    state = FloodState(
+        have=jnp.zeros((n, w), dtype=jnp.uint32),
+        first_tick=(jnp.full((n, w, WORD_BITS), -1, dtype=jnp.int16)
+                    if track_first_tick else None),
+        tick=jnp.zeros((), dtype=jnp.int32),
+    )
+    return params, state
+
+
+def flood_step(params: FloodParams, state: FloodState) -> FloodState:
+    """One virtual tick: inject due publishes, propagate one hop, record
+    first deliveries.  Pure function — jit/shard_map friendly."""
+    heard = propagate(state.have & params.fwd_words, params.nbrs,
+                      params.nbr_mask)
+    return _finish_step(params, state, heard)[0]
+
+
+def make_circulant_flood_step(offsets):
+    """A flood step over a circulant topology (offsets baked in as
+    compile-time constants; the hop is rolls, not gathers)."""
+    core = make_circulant_step_core(offsets)
+
+    def step(params: FloodParams, state: FloodState) -> FloodState:
+        return core(params, state)[0]
+
+    return step
+
+
+def _finish_step(params: FloodParams, state: FloodState,
+                 heard: jnp.ndarray) -> tuple[FloodState, jnp.ndarray]:
+    # the hop used what peers had at the END of the previous tick —
+    # a publish at tick t reaches direct neighbors at t+1
+    new_bits = heard & ~state.have
+    accepted = new_bits & (params.fwd_words | params.deliver_words)
+
+    # then inject messages whose publish tick is now
+    due = pack_bits(params.publish_tick == state.tick)          # [W]
+    injected = params.origin_words & due[None, :] & ~state.have
+    have = state.have | accepted | injected
+
+    # delivery accounting (origin's own publish counts at inject tick)
+    delivered_now = (accepted & params.deliver_words) | (
+        injected & params.deliver_words)
+    if state.first_tick is not None:
+        shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
+        bits = ((delivered_now[:, :, None] >> shifts) & jnp.uint32(1)) != 0
+        newly = bits & (state.first_tick < 0)
+        # saturate at int16 max so ticks past 32766 can't wrap negative
+        # and collide with the -1 never-delivered sentinel
+        tick16 = jnp.minimum(state.tick, 32766).astype(jnp.int16)
+        first_tick = jnp.where(newly, tick16, state.first_tick)
+    else:
+        first_tick = None
+
+    new_state = FloodState(have=have, first_tick=first_tick,
+                           tick=state.tick + 1)
+    return new_state, delivered_now
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def flood_run(params: FloodParams, state: FloodState, n_ticks: int,
+              step_fn=flood_step) -> FloodState:
+    """Run n_ticks steps under one jit (lax.scan keeps the trace compact)."""
+    def body(s, _):
+        return step_fn(params, s), None
+    state, _ = jax.lax.scan(body, state, None, length=n_ticks)
+    return state
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4))
+def flood_run_curve(params: FloodParams, state: FloodState, n_ticks: int,
+                    step_core, n_msgs: int):
+    """Run n_ticks steps collecting per-tick delivered counts.
+
+    step_core: (params, state) -> (state, delivered_now_words); use
+    ``_core`` variants.  Returns (state, counts [n_ticks, M]).  Keeping the
+    curve as per-tick count reductions (instead of a per-peer first_tick
+    array) removes the dominant memory traffic from the hot loop.
+    """
+    def body(s, _):
+        s2, delivered = step_core(params, s)
+        counts = count_bits_per_position(delivered, n_msgs)
+        return s2, counts
+    state, counts = jax.lax.scan(body, state, None, length=n_ticks)
+    return state, counts
+
+
+def make_circulant_step_core(offsets):
+    """(params, state) -> (state, delivered_words) over a circulant graph."""
+    offsets = tuple(int(o) for o in offsets)
+
+    def core(params: FloodParams, state: FloodState):
+        heard = propagate_circulant(state.have & params.fwd_words, offsets)
+        return _finish_step(params, state, heard)
+
+    return core
+
+
+def first_tick_matrix(state: FloodState, m: int) -> jnp.ndarray:
+    """first_tick as [N, M] (strips word padding)."""
+    n = state.first_tick.shape[0]
+    return state.first_tick.reshape(n, -1)[:, :m]
+
+
+def reach_counts(params: FloodParams, state: FloodState) -> jnp.ndarray:
+    """Per-message delivered-peer counts: int32 [M]."""
+    m = params.publish_tick.shape[0]
+    return (first_tick_matrix(state, m) >= 0).sum(axis=0, dtype=jnp.int32)
+
+
+def reach_by_hops(params: FloodParams, state: FloodState,
+                  max_hops: int) -> jnp.ndarray:
+    """[M, max_hops] cumulative deliveries by hop count — the
+    reachability-vs-hops curve from BASELINE.md."""
+    ft = first_tick_matrix(state, params.publish_tick.shape[0])
+    hops = jnp.arange(max_hops, dtype=jnp.int16)
+    per_hop = (ft[None, :, :] == hops[:, None, None]).sum(
+        axis=1, dtype=jnp.int32)          # [max_hops, M]
+    return jnp.cumsum(per_hop, axis=0).T   # [M, max_hops]

@@ -1,0 +1,178 @@
+"""Peer-graph representation and sparse message propagation ops.
+
+The simulator's "network" (what the reference implements as libp2p streams,
+/root/reference/comm.go) is a device-resident peer graph: a padded
+fixed-degree neighbor table — the protocol's bounded degrees (GossipSub
+Dhi=12, floodsub topology tests use degree<=10) make fixed-shape tensors the
+natural TPU representation — plus bitpacked per-peer message-possession
+words.  One simulation step is a neighbor gather + OR-reduce: the TPU analog
+of every peer's reader goroutine draining its inbound streams at once.
+
+Graph construction runs in numpy at setup time (host); only the propagation
+ops are jitted.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+WORD_BITS = 32
+
+
+def build_random_graph(n_peers: int, degree: int, seed: int = 0,
+                       max_degree: int | None = None):
+    """Build an undirected random graph as a padded neighbor table.
+
+    Each peer draws ``degree`` distinct random neighbors (like the reference
+    test harness's connectSome, /root/reference/floodsub_test.go:65-81);
+    edges are symmetrized.  Returns (nbrs, nbr_mask):
+
+    - nbrs:     int32 [N, K] neighbor indices, padded with N (sentinel)
+    - nbr_mask: bool  [N, K] validity mask
+    """
+    rng = np.random.default_rng(seed)
+    src = np.repeat(np.arange(n_peers, dtype=np.int64), degree)
+    dst = rng.integers(0, n_peers, size=n_peers * degree, dtype=np.int64)
+    keep = src != dst
+    return _edges_to_table(src[keep], dst[keep], n_peers, max_degree)
+
+
+def _edges_to_table(src: np.ndarray, dst: np.ndarray, n_peers: int,
+                    max_degree: int | None):
+    """Symmetrize + dedup an edge list and pack it into a padded
+    fixed-degree neighbor table (sentinel = n_peers)."""
+    a = np.concatenate([src, dst]).astype(np.int64)
+    b = np.concatenate([dst, src]).astype(np.int64)
+    edges = np.unique(a * n_peers + b)
+    a, b = edges // n_peers, edges % n_peers
+
+    counts = np.bincount(a, minlength=n_peers)
+    K = max_degree or int(counts.max() if len(a) else 1)
+    # slot position of each edge within its source's bucket
+    starts = np.zeros(n_peers + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    slot = np.arange(len(a)) - starts[a]
+    ok = slot < K  # truncate over-degree peers at K
+    nbrs = np.full((n_peers, K), n_peers, dtype=np.int32)
+    nbrs[a[ok], slot[ok]] = b[ok]
+    nbr_mask = nbrs != n_peers
+    return nbrs, nbr_mask
+
+
+def build_topic_graph(subs: np.ndarray, degree: int, seed: int = 0,
+                      max_degree: int | None = None):
+    """Build the union of per-topic random graphs among subscribers.
+
+    This is what a deployed pubsub network looks like: discovery connects
+    peers that share topics (reference discovery.go:108-173), so each
+    topic's subscriber set forms its own connected random graph.  Returns
+    (nbrs, nbr_mask) padded tables like build_random_graph.
+    """
+    rng = np.random.default_rng(seed)
+    n_peers, n_topics = subs.shape
+    srcs, dsts = [], []
+    for t in range(n_topics):
+        members = np.nonzero(subs[:, t])[0]
+        if len(members) < 2:
+            continue
+        d = min(degree, len(members) - 1)
+        src = np.repeat(members, d)
+        dst = members[rng.integers(0, len(members), size=len(members) * d)]
+        keep = src != dst
+        srcs.append(src[keep])
+        dsts.append(dst[keep])
+    if not srcs:  # no topic has two subscribers: an empty (edgeless) graph
+        K = max_degree or 1
+        nbrs = np.full((n_peers, K), n_peers, dtype=np.int32)
+        return nbrs, nbrs != n_peers
+    return _edges_to_table(np.concatenate(srcs), np.concatenate(dsts),
+                           n_peers, max_degree)
+
+
+def pack_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    """Pack bool [..., M] into uint32 words [..., ceil(M/32)]."""
+    *lead, m = bits.shape
+    w = (m + WORD_BITS - 1) // WORD_BITS
+    pad = w * WORD_BITS - m
+    if pad:
+        bits = jnp.concatenate(
+            [bits, jnp.zeros((*lead, pad), dtype=bits.dtype)], axis=-1)
+    bits = bits.reshape(*lead, w, WORD_BITS).astype(jnp.uint32)
+    weights = (jnp.uint32(1) << jnp.arange(WORD_BITS, dtype=jnp.uint32))
+    return (bits * weights).sum(axis=-1, dtype=jnp.uint32)
+
+
+def unpack_bits(words: jnp.ndarray, m: int) -> jnp.ndarray:
+    """Unpack uint32 words [..., W] into bool [..., m]."""
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
+    bits = (words[..., None] >> shifts) & jnp.uint32(1)
+    *lead, w, _ = bits.shape
+    return bits.reshape(*lead, w * WORD_BITS)[..., :m].astype(jnp.bool_)
+
+
+def popcount_words(words: jnp.ndarray) -> jnp.ndarray:
+    """Per-element popcount of uint32 words."""
+    return jax.lax.population_count(words)
+
+
+def count_bits_per_position(words: jnp.ndarray, m: int) -> jnp.ndarray:
+    """Count set bits per bit-position over the leading axis.
+
+    words: uint32 [N, W] -> int32 [m]: out[j] = |{n : bit j of row n set}|.
+    Written so the bit expansion fuses into the reduction (no [N, m]
+    materialization — unlike unpack_bits().sum(), which reshapes and forces
+    a full intermediate)."""
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
+    bits = (words[:, :, None] >> shifts) & jnp.uint32(1)   # [N, W, 32]
+    counts = bits.astype(jnp.int32).sum(axis=0)            # [W, 32]
+    return counts.reshape(-1)[:m]
+
+
+def make_circulant_offsets(n_classes: int, degree: int, n_peers: int,
+                           seed: int = 0) -> np.ndarray:
+    """Random circulant offsets, all multiples of ``n_classes``.
+
+    A circulant graph (every peer p linked to p ± offset_k mod N) with
+    offsets ≡ 0 (mod n_classes) keeps each residue class p mod n_classes
+    closed under edges — so 'topic t = peers ≡ t (mod n_classes)' yields one
+    independent random circulant per topic.  Random circulants are expanders
+    with the same locally-tree-like structure as the random graphs the
+    reference's tests wire up, but propagation over them needs no gather at
+    all: one hop = OR of ``roll``s (see propagate_circulant), which runs at
+    full HBM/VMEM bandwidth on TPU.  This is the scale topology; arbitrary
+    graphs use propagate() below.
+    """
+    rng = np.random.default_rng(seed)
+    max_k = n_peers // n_classes
+    ks = rng.choice(np.arange(1, max_k), size=degree // 2, replace=False)
+    offs = np.concatenate([ks, -ks]) * n_classes
+    return offs.astype(np.int64)
+
+
+def propagate_circulant(words: jnp.ndarray, offsets) -> jnp.ndarray:
+    """One hop over a circulant graph: OR of rolled possession words.
+
+    words: uint32 [N, W]; offsets: static python ints (hops along the ring).
+    Pure slices/concats — no gather, runs at memory bandwidth.
+    """
+    out = jnp.zeros_like(words)
+    for off in offsets:
+        out = out | jnp.roll(words, int(off), axis=0)
+    return out
+
+
+def propagate(words: jnp.ndarray, nbrs: jnp.ndarray,
+              nbr_mask: jnp.ndarray) -> jnp.ndarray:
+    """One hop of message spread: OR of each peer's neighbors' words.
+
+    words: uint32 [N, W]; nbrs int32 [N, K] (sentinel N); nbr_mask [N, K].
+    Returns uint32 [N, W] — what each peer hears this tick.
+
+    The gather uses mode='fill' so sentinel rows contribute zero words,
+    making the mask a pure belt-and-braces guard.
+    """
+    gathered = words.at[nbrs].get(mode="fill", fill_value=0)  # [N, K, W]
+    gathered = jnp.where(nbr_mask[..., None], gathered, jnp.uint32(0))
+    return jax.lax.reduce_or(gathered, axes=(1,))

@@ -1,0 +1,49 @@
+"""Device mesh + sharding helpers.
+
+The simulator's scaling axis is the number of simulated peers; every state
+array leads with the peer dimension, so sharding is uniform: peer-major
+arrays split over the 'peers' mesh axis, everything else replicates.  XLA
+inserts the collectives (the neighbor gather becomes an all-gather of the
+bitpacked possession words — a few MB per step at 1M peers), which is the
+TPU-native replacement for the reference's per-peer stream I/O
+(/root/reference/comm.go) — see SURVEY.md §5.8.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PEER_AXIS = "peers"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.array(devices), (PEER_AXIS,))
+
+
+def peer_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(PEER_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_peer_tree(tree, mesh: Mesh, n_peers: int):
+    """Place every array in the pytree: leading-dim==n_peers arrays are
+    sharded over the peer axis, the rest replicated."""
+    peer = peer_sharding(mesh)
+    repl = replicated(mesh)
+
+    def place(x):
+        arr = jax.numpy.asarray(x)
+        if arr.ndim >= 1 and arr.shape[0] == n_peers:
+            return jax.device_put(arr, peer)
+        return jax.device_put(arr, repl)
+
+    return jax.tree_util.tree_map(place, tree)

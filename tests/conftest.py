@@ -9,12 +9,19 @@ import asyncio
 import inspect
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# the environment's site hook pins JAX_PLATFORMS to the TPU tunnel before
+# conftest runs; override via jax.config, which wins as long as no backend
+# has been initialized yet
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 
 def pytest_pyfunc_call(pyfuncitem):

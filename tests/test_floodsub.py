@@ -136,7 +136,34 @@ async def test_no_sign_policy():
     await t0.publish(b"anon")
     msg = await asyncio.wait_for(sub.next(), 5)
     assert msg.data == b"anon"
-    assert msg.rpc.signature is None and msg.rpc.from_peer is None
+    # StrictNoSign leaves the author/seqno intact (reference keeps
+    # signID = host ID unless WithNoAuthor); only the signature is absent
+    assert msg.rpc.signature is None and msg.rpc.from_peer is not None
+    await close_all(psubs, net)
+
+
+async def test_no_author():
+    import hashlib
+    net = InProcNetwork()
+    hosts = get_hosts(net, 2)
+    # no_author requires a content-based message ID (reference pubsub.go:366)
+    psubs = await make_floodsubs(
+        hosts, sign_policy=MessageSignaturePolicy.STRICT_NO_SIGN,
+        no_author=True,
+        msg_id_fn=lambda m: hashlib.sha256(m.data or b"").digest())
+    t0 = await psubs[0].join("t")
+    t1 = await psubs[1].join("t")
+    sub = await t1.subscribe()
+    await connect(hosts[0], hosts[1])
+    await settle(0.1)
+    await t0.publish(b"one")
+    await t0.publish(b"two")
+    got = {(await asyncio.wait_for(sub.next(), 5)).data for _ in range(2)}
+    assert got == {b"one", b"two"}
+    msg_probe = None
+    await t0.publish(b"three")
+    msg_probe = await asyncio.wait_for(sub.next(), 5)
+    assert msg_probe.rpc.from_peer is None and msg_probe.rpc.seqno is None
     await close_all(psubs, net)
 
 

@@ -3,6 +3,16 @@
 from .blacklist import Blacklist, MapBlacklist, TimeCachedBlacklist
 from .crypto import PrivateKey, PublicKey, generate_keypair, peer_id_extract_key
 from .floodsub import FloodSubRouter, create_floodsub
+from .gossipsub import (
+    GOSSIPSUB_DEFAULT_PROTOCOLS,
+    GossipSubParams,
+    GossipSubRouter,
+    PeerScoreThresholds,
+    create_gossipsub,
+    fragment_rpc,
+    gossipsub_default_features,
+)
+from .mcache import MessageCache
 from .host import Host, InProcNetwork, NegotiationError, Stream, StreamResetError
 from .pubsub import PubSub, PubSubRouter
 from .sign import (

@@ -230,3 +230,34 @@ def peer_id_extract_key(pid: PeerID) -> Optional[PublicKey]:
 
 def generate_keypair(seed: Optional[bytes] = None) -> PrivateKey:
     return PrivateKey(seed)
+
+
+# -- signed peer records (PX envelopes) ------------------------------------
+
+_RECORD_DOMAIN = b"libp2p-peer-record:"
+
+
+class SignedRecordEnvelope(Message):
+    """Envelope carried in PRUNE peer exchange: the peer's wrapped public
+    key plus a signature binding it to the peer ID (the role of libp2p's
+    signed routing envelopes in the reference, gossipsub.go:869-887)."""
+
+    FIELDS = (Field(1, "key", BYTES), Field(2, "signature", BYTES))
+
+
+def make_signed_record(key: PrivateKey) -> bytes:
+    pid = key.public.peer_id()
+    sig = key.sign(_RECORD_DOMAIN + pid)
+    return SignedRecordEnvelope(key=key.public.marshal(), signature=sig).encode()
+
+
+def verify_signed_record(data: bytes, expected_pid: PeerID) -> bool:
+    """True iff the envelope is valid and names ``expected_pid``."""
+    try:
+        env = SignedRecordEnvelope.decode(data)
+        pub = PublicKey.unmarshal(env.key)
+    except (ValueError, TypeError):
+        return False
+    if pub.peer_id() != expected_pid:
+        return False
+    return pub.verify(_RECORD_DOMAIN + expected_pid, env.signature or b"")

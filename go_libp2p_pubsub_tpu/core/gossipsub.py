@@ -1,0 +1,983 @@
+"""GossipSub v1.0/v1.1 router.
+
+Behavioral equivalent of the reference router (/root/reference/gossipsub.go):
+mesh overlay with GRAFT/PRUNE links maintained toward degree D ∈ [Dlo, Dhi],
+lazy IHAVE/IWANT gossip to non-mesh peers, fanout for publish-without-join,
+prune backoff, peer exchange, direct peers, flood publishing, control
+piggybacking with retry, RPC fragmentation, and protocol feature negotiation.
+The v1.1 hardening hooks (peer score, peer gater, promise tracking) attach
+through narrow interfaces with null defaults; the real engines live in
+score.py / peer_gater.py / gossip_tracer.py.
+
+Time comes from the PubSub instance's injectable clock, and all randomness
+from a seedable ``random.Random`` — tests and the TPU simulator can run the
+router deterministically.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass
+from typing import Callable, Iterable, Optional
+
+from ..pb import rpc as pb
+from .comm import copy_rpc, rpc_with_control, rpc_with_messages
+from .crypto import verify_signed_record
+from .host import Host
+from .mcache import MessageCache
+from .pubsub import PubSub, PubSubRouter
+from .types import (
+    FLOODSUB_ID,
+    GOSSIPSUB_ID_V10,
+    GOSSIPSUB_ID_V11,
+    AcceptStatus,
+    Message,
+    PeerID,
+)
+
+# -- feature negotiation (reference gossipsub_feat.go) ---------------------
+
+FEATURE_MESH = 0
+FEATURE_PX = 1
+
+GOSSIPSUB_DEFAULT_PROTOCOLS = [GOSSIPSUB_ID_V11, GOSSIPSUB_ID_V10, FLOODSUB_ID]
+
+
+def gossipsub_default_features(feature: int, proto: str) -> bool:
+    if feature == FEATURE_MESH:
+        return proto in (GOSSIPSUB_ID_V11, GOSSIPSUB_ID_V10)
+    if feature == FEATURE_PX:
+        return proto == GOSSIPSUB_ID_V11
+    return False
+
+
+# -- parameters (reference gossipsub.go:31-195) ----------------------------
+
+
+@dataclass
+class GossipSubParams:
+    # overlay
+    d: int = 6
+    d_lo: int = 5
+    d_hi: int = 12
+    d_score: int = 4
+    d_out: int = 2
+    # gossip
+    history_length: int = 5
+    history_gossip: int = 3
+    d_lazy: int = 6
+    gossip_factor: float = 0.25
+    gossip_retransmission: int = 3
+    # heartbeat
+    heartbeat_initial_delay: float = 0.1
+    heartbeat_interval: float = 1.0
+    fanout_ttl: float = 60.0
+    # peer exchange
+    prune_peers: int = 16
+    prune_backoff: float = 60.0
+    connectors: int = 8
+    max_pending_connections: int = 128
+    connection_timeout: float = 30.0
+    # direct peers
+    direct_connect_ticks: int = 300
+    direct_connect_initial_delay: float = 1.0
+    # opportunistic grafting
+    opportunistic_graft_ticks: int = 60
+    opportunistic_graft_peers: int = 2
+    # attack hardening
+    graft_flood_threshold: float = 10.0
+    max_ihave_length: int = 5000
+    max_ihave_messages: int = 10
+    iwant_followup_time: float = 3.0
+
+    def validate(self) -> None:
+        if not (self.d_lo <= self.d <= self.d_hi):
+            raise ValueError("D must lie in [Dlo, Dhi]")
+        if self.d_out >= self.d_lo or self.d_out > self.d // 2:
+            raise ValueError("Dout must be < Dlo and <= D/2")
+        if self.history_gossip > self.history_length:
+            raise ValueError("HistoryGossip must be <= HistoryLength")
+
+
+# -- v1.1 hardening hook interfaces (real engines attach in M5) ------------
+
+
+class ScoreInterface:
+    """What the router needs from the peer-score engine."""
+
+    def score(self, p: PeerID) -> float:
+        return 0.0
+
+    def add_penalty(self, p: PeerID, count: int) -> None:
+        pass
+
+    def start(self, gs: "GossipSubRouter") -> None:
+        pass
+
+
+class GaterInterface:
+    def accept_from(self, p: PeerID) -> AcceptStatus:
+        return AcceptStatus.ALL
+
+    def start(self, gs: "GossipSubRouter") -> None:
+        pass
+
+
+class PromiseTrackerInterface:
+    def add_promise(self, p: PeerID, mids: list[bytes]) -> None:
+        pass
+
+    def get_broken_promises(self) -> dict[PeerID, int]:
+        return {}
+
+    def start(self, gs: "GossipSubRouter") -> None:
+        pass
+
+
+@dataclass
+class PeerScoreThresholds:
+    """Score thresholds wired into the router (reference score_params.go:12-32)."""
+
+    gossip_threshold: float = 0.0
+    publish_threshold: float = 0.0
+    graylist_threshold: float = 0.0
+    accept_px_threshold: float = 0.0
+    opportunistic_graft_threshold: float = 0.0
+
+    def validate(self) -> None:
+        if self.gossip_threshold > 0:
+            raise ValueError("invalid gossip threshold; it must be <= 0")
+        if self.publish_threshold > 0 or self.publish_threshold > self.gossip_threshold:
+            raise ValueError(
+                "invalid publish threshold; it must be <= 0 and <= gossip threshold")
+        if self.graylist_threshold > 0 or (
+                self.graylist_threshold > self.publish_threshold
+                and self.graylist_threshold != 0):
+            raise ValueError(
+                "invalid graylist threshold; it must be <= 0 and <= publish threshold")
+        if self.accept_px_threshold < 0:
+            raise ValueError("invalid accept PX threshold; it must be >= 0")
+        if self.opportunistic_graft_threshold < 0:
+            raise ValueError(
+                "invalid opportunistic grafting threshold; it must be >= 0")
+
+
+class GossipSubRouter(PubSubRouter):
+    def __init__(self, params: Optional[GossipSubParams] = None, *,
+                 protocols: Optional[list[str]] = None,
+                 feature_test: Callable[[int, str], bool] = gossipsub_default_features,
+                 direct_peers: Iterable[PeerID] = (),
+                 do_px: bool = False,
+                 flood_publish: bool = False,
+                 rng: Optional[random.Random] = None):
+        self.params = params or GossipSubParams()
+        self.params.validate()
+        self.ps: Optional[PubSub] = None
+        self.peers: dict[PeerID, str] = {}          # peer -> protocol
+        self.direct: set[PeerID] = set(direct_peers)
+        self.mesh: dict[str, set[PeerID]] = {}
+        self.fanout: dict[str, set[PeerID]] = {}
+        self.lastpub: dict[str, float] = {}
+        self.gossip: dict[PeerID, list[pb.ControlIHave]] = {}
+        self.control: dict[PeerID, pb.ControlMessage] = {}
+        self.peerhave: dict[PeerID, int] = {}
+        self.iasked: dict[PeerID, int] = {}
+        self.outbound: dict[PeerID, bool] = {}
+        self.backoff: dict[str, dict[PeerID, float]] = {}
+        self.protos = list(protocols or GOSSIPSUB_DEFAULT_PROTOCOLS)
+        self.feature = feature_test
+        self.mcache = MessageCache(self.params.history_gossip,
+                                   self.params.history_length)
+        self.do_px = do_px
+        self.flood_publish = flood_publish
+        self.heartbeat_ticks = 0
+        self.rng = rng or random.Random()
+
+        # v1.1 hardening hooks (replaced by WithPeerScore / WithPeerGater)
+        self.score: ScoreInterface = ScoreInterface()
+        self.gate: GaterInterface = GaterInterface()
+        self.promises: PromiseTrackerInterface = PromiseTrackerInterface()
+        self.thresholds = PeerScoreThresholds()
+
+        self._connect_queue: Optional[asyncio.Queue] = None
+        self._tasks: list[asyncio.Task] = []
+
+    # convenience threshold accessors
+    @property
+    def gossip_threshold(self) -> float:
+        return self.thresholds.gossip_threshold
+
+    @property
+    def publish_threshold(self) -> float:
+        return self.thresholds.publish_threshold
+
+    @property
+    def graylist_threshold(self) -> float:
+        return self.thresholds.graylist_threshold
+
+    @property
+    def accept_px_threshold(self) -> float:
+        return self.thresholds.accept_px_threshold
+
+    # -- router contract ---------------------------------------------------
+
+    def protocols(self) -> list[str]:
+        return self.protos
+
+    def attach(self, ps: PubSub) -> None:
+        self.ps = ps
+        self.mcache.set_msg_id_fn(ps.msg_id)
+        self.score.start(self)
+        self.gate.start(self)
+        self.promises.start(self)
+        self._connect_queue = asyncio.Queue(
+            maxsize=self.params.max_pending_connections)
+        self._tasks.append(asyncio.ensure_future(self._heartbeat_timer()))
+        for _ in range(self.params.connectors):
+            self._tasks.append(asyncio.ensure_future(self._connector()))
+        if self.direct:
+            self._tasks.append(asyncio.ensure_future(self._direct_connect_initial()))
+        ps._tasks.update(self._tasks)
+
+    def add_peer(self, pid: PeerID, proto: str) -> None:
+        self.ps.tracer.add_peer(pid, proto)
+        self.peers[pid] = proto
+        # track connection direction (did WE initiate?)
+        outbound = False
+        for conn in self.ps.host.conns.get(pid, ()):
+            if conn.is_outbound_for(self.ps.host.id):
+                outbound = True
+                break
+        self.outbound[pid] = outbound
+
+    def remove_peer(self, pid: PeerID) -> None:
+        self.ps.tracer.remove_peer(pid)
+        self.peers.pop(pid, None)
+        for peers in self.mesh.values():
+            peers.discard(pid)
+        for peers in self.fanout.values():
+            peers.discard(pid)
+        self.gossip.pop(pid, None)
+        self.control.pop(pid, None)
+        self.outbound.pop(pid, None)
+
+    def enough_peers(self, topic: str, suggested: int = 0) -> bool:
+        tmap = self.ps.topics.get(topic)
+        if tmap is None:
+            return False
+        fs_peers = sum(1 for p in tmap
+                       if not self.feature(FEATURE_MESH, self.peers.get(p, "")))
+        gs_peers = len(self.mesh.get(topic, ()))
+        if suggested == 0:
+            suggested = self.params.d_lo
+        return (fs_peers + gs_peers >= suggested
+                or gs_peers >= self.params.d_hi)
+
+    def accept_from(self, pid: PeerID) -> AcceptStatus:
+        if pid in self.direct:
+            return AcceptStatus.ALL
+        if self.score.score(pid) < self.graylist_threshold:
+            return AcceptStatus.NONE
+        return self.gate.accept_from(pid)
+
+    # -- control handling --------------------------------------------------
+
+    def handle_rpc(self, rpc: pb.RPC, from_peer: PeerID) -> None:
+        ctl = rpc.control
+        if ctl is None:
+            return
+        iwant = self._handle_ihave(from_peer, ctl)
+        ihave = self._handle_iwant(from_peer, ctl)
+        prune = self._handle_graft(from_peer, ctl)
+        self._handle_prune(from_peer, ctl)
+
+        if not iwant and not ihave and not prune:
+            return
+        out = rpc_with_control(ihave, [], iwant, [], prune)
+        self.send_rpc(from_peer, out)
+
+    def _handle_ihave(self, p: PeerID, ctl: pb.ControlMessage) -> list[pb.ControlIWant]:
+        # ignore gossip from peers below the gossip score threshold
+        if self.score.score(p) < self.gossip_threshold:
+            return []
+
+        # IHAVE flood protection (reference gossipsub.go:617-628)
+        self.peerhave[p] = self.peerhave.get(p, 0) + 1
+        if self.peerhave[p] > self.params.max_ihave_messages:
+            return []
+        if self.iasked.get(p, 0) >= self.params.max_ihave_length:
+            return []
+
+        iwant: set[bytes] = set()
+        for ihave in ctl.ihave:
+            if ihave.topic_id not in self.mesh:
+                continue
+            for mid in ihave.message_ids:
+                if not self.ps.seen_message(mid):
+                    iwant.add(mid)
+        if not iwant:
+            return []
+
+        iask = min(len(iwant), self.params.max_ihave_length - self.iasked.get(p, 0))
+        iwant_list = list(iwant)
+        self.rng.shuffle(iwant_list)
+        iwant_list = iwant_list[:iask]
+        self.iasked[p] = self.iasked.get(p, 0) + iask
+
+        self.promises.add_promise(p, iwant_list)
+        return [pb.ControlIWant(message_ids=iwant_list)]
+
+    def _handle_iwant(self, p: PeerID, ctl: pb.ControlMessage) -> list[pb.PubMessage]:
+        if self.score.score(p) < self.gossip_threshold:
+            return []
+        ihave: dict[bytes, pb.PubMessage] = {}
+        for iwant in ctl.iwant:
+            for mid in iwant.message_ids:
+                msg, count = self.mcache.get_for_peer(mid, p)
+                if msg is None:
+                    continue
+                if count > self.params.gossip_retransmission:
+                    continue  # IWANT spam cutoff
+                ihave[mid] = msg
+        return list(ihave.values())
+
+    def _handle_graft(self, p: PeerID, ctl: pb.ControlMessage) -> list[pb.ControlPrune]:
+        prune: list[str] = []
+        do_px = self.do_px
+        score = self.score.score(p)
+        now = self.ps.clock()
+
+        for graft in ctl.graft:
+            topic = graft.topic_id
+            peers = self.mesh.get(topic)
+            if peers is None:
+                # spam hardening: ignore GRAFTs for unknown topics, and
+                # don't PX to avoid leaking our peers
+                do_px = False
+                continue
+            if p in peers:
+                continue
+            if p in self.direct:
+                # non-reciprocal configuration: PRUNE but no PX
+                prune.append(topic)
+                do_px = False
+                continue
+
+            expire = self.backoff.get(topic, {}).get(p)
+            if expire is not None and now < expire:
+                # GRAFT during backoff: behavioral penalty (P7)
+                self.score.add_penalty(p, 1)
+                do_px = False
+                # flood cutoff: GRAFT coming way too fast gets extra penalty
+                flood_cutoff = (expire + self.params.graft_flood_threshold
+                                - self.params.prune_backoff)
+                if now < flood_cutoff:
+                    self.score.add_penalty(p, 1)
+                self._add_backoff(p, topic)
+                prune.append(topic)
+                continue
+
+            if score < 0:
+                # never GRAFT negative-score peers; PRUNE for protocol
+                # correctness but no PX
+                prune.append(topic)
+                do_px = False
+                self._add_backoff(p, topic)
+                continue
+
+            if len(peers) >= self.params.d_hi and not self.outbound.get(p, False):
+                # mesh takeover defense: at Dhi only outbound conns may graft
+                prune.append(topic)
+                self._add_backoff(p, topic)
+                continue
+
+            self.ps.tracer.graft(p, topic)
+            peers.add(p)
+
+        return [self._make_prune(p, topic, do_px) for topic in prune]
+
+    def _handle_prune(self, p: PeerID, ctl: pb.ControlMessage) -> None:
+        score = self.score.score(p)
+        for prune in ctl.prune:
+            topic = prune.topic_id
+            peers = self.mesh.get(topic)
+            if peers is None:
+                continue
+            self.ps.tracer.prune(p, topic)
+            peers.discard(p)
+            if prune.backoff and prune.backoff > 0:
+                self._do_add_backoff(p, topic, float(prune.backoff))
+            else:
+                self._add_backoff(p, topic)
+
+            if prune.peers:
+                if score < self.accept_px_threshold:
+                    continue  # ignore PX from low-score peers
+                self._px_connect(prune.peers)
+
+    def _add_backoff(self, p: PeerID, topic: str) -> None:
+        self._do_add_backoff(p, topic, self.params.prune_backoff)
+
+    def _do_add_backoff(self, p: PeerID, topic: str, interval: float) -> None:
+        backoff = self.backoff.setdefault(topic, {})
+        expire = self.ps.clock() + interval
+        if backoff.get(p, 0.0) < expire:
+            backoff[p] = expire
+
+    # -- peer exchange -----------------------------------------------------
+
+    def _px_connect(self, peers: list[pb.PeerInfo]) -> None:
+        if len(peers) > self.params.prune_peers:
+            peers = list(peers)
+            self.rng.shuffle(peers)
+            peers = peers[:self.params.prune_peers]
+        for pi in peers:
+            pid = PeerID(pi.peer_id)
+            if pid in self.peers:
+                continue
+            if pi.signed_peer_record is not None:
+                if not verify_signed_record(pi.signed_peer_record, pid):
+                    continue  # bogus record
+            try:
+                self._connect_queue.put_nowait(pid)
+            except asyncio.QueueFull:
+                break  # too many pending connections
+
+    async def _connector(self) -> None:
+        while True:
+            pid = await self._connect_queue.get()
+            if self.ps.host.connectedness(pid):
+                continue
+            try:
+                await asyncio.wait_for(self.ps.host.connect(pid),
+                                       self.params.connection_timeout)
+            except Exception:
+                pass
+
+    async def _direct_connect_initial(self) -> None:
+        await asyncio.sleep(self.params.direct_connect_initial_delay)
+        for p in self.direct:
+            await self._connect_queue.put(p)
+
+    def _direct_connect(self) -> None:
+        if self.heartbeat_ticks % self.params.direct_connect_ticks != 0:
+            return
+        for p in self.direct:
+            if p not in self.peers:
+                try:
+                    self._connect_queue.put_nowait(p)
+                except asyncio.QueueFull:
+                    break
+
+    # -- publishing --------------------------------------------------------
+
+    def publish(self, msg: Message) -> None:
+        self.mcache.put(msg.rpc)
+        from_peer = msg.received_from
+        topic = msg.topic
+
+        tmap = self.ps.topics.get(topic)
+        if not tmap:
+            return
+        tosend: set[PeerID] = set()
+
+        if self.flood_publish and from_peer == self.ps.host.id:
+            for p in tmap:
+                if p in self.direct or self.score.score(p) >= self.publish_threshold:
+                    tosend.add(p)
+        else:
+            # direct peers always get our messages
+            for p in self.direct:
+                if p in tmap:
+                    tosend.add(p)
+            # floodsub-protocol peers are always flooded
+            for p in tmap:
+                if (not self.feature(FEATURE_MESH, self.peers.get(p, ""))
+                        and self.score.score(p) >= self.publish_threshold):
+                    tosend.add(p)
+            # mesh peers, or fanout when we haven't joined
+            gmap = self.mesh.get(topic)
+            if gmap is None:
+                gmap = self.fanout.get(topic)
+                if not gmap:
+                    peers = self._get_peers(
+                        topic, self.params.d,
+                        lambda p: p not in self.direct
+                        and self.score.score(p) >= self.publish_threshold)
+                    if peers:
+                        gmap = set(peers)
+                        self.fanout[topic] = gmap
+                    else:
+                        gmap = set()
+                self.lastpub[topic] = self.ps.clock()
+            tosend.update(gmap)
+
+        out = rpc_with_messages(msg.rpc)
+        origin = msg.from_peer
+        for pid in tosend:
+            if pid == from_peer or pid == origin:
+                continue
+            self.send_rpc(pid, out)
+
+    def join(self, topic: str) -> None:
+        if topic in self.mesh:
+            return
+        self.ps.tracer.join(topic)
+        gmap = self.fanout.get(topic)
+        if gmap is not None:
+            # fanout peers had score >= publish threshold, possibly negative
+            gmap = {p for p in gmap if self.score.score(p) >= 0}
+            if len(gmap) < self.params.d:
+                more = self._get_peers(
+                    topic, self.params.d - len(gmap),
+                    lambda p: p not in gmap and p not in self.direct
+                    and self.score.score(p) >= 0)
+                gmap.update(more)
+            self.mesh[topic] = gmap
+            self.fanout.pop(topic, None)
+            self.lastpub.pop(topic, None)
+        else:
+            gmap = set(self._get_peers(
+                topic, self.params.d,
+                lambda p: p not in self.direct and self.score.score(p) >= 0))
+            self.mesh[topic] = gmap
+
+        for p in gmap:
+            self.ps.tracer.graft(p, topic)
+            self._send_graft(p, topic)
+
+    def leave(self, topic: str) -> None:
+        gmap = self.mesh.pop(topic, None)
+        if gmap is None:
+            return
+        self.ps.tracer.leave(topic)
+        for p in gmap:
+            self.ps.tracer.prune(p, topic)
+            self._send_prune(p, topic)
+
+    # -- RPC sending: piggyback + fragmentation ----------------------------
+
+    def _send_graft(self, p: PeerID, topic: str) -> None:
+        out = rpc_with_control([], [], [], [pb.ControlGraft(topic_id=topic)], [])
+        self.send_rpc(p, out)
+
+    def _send_prune(self, p: PeerID, topic: str) -> None:
+        out = rpc_with_control([], [], [], [],
+                               [self._make_prune(p, topic, self.do_px)])
+        self.send_rpc(p, out)
+
+    def send_rpc(self, p: PeerID, out: pb.RPC) -> None:
+        own = False
+        ctl = self.control.pop(p, None)
+        if ctl is not None:
+            out = copy_rpc(out)
+            own = True
+            self._piggyback_control(p, out, ctl)
+        ihave = self.gossip.pop(p, None)
+        if ihave is not None:
+            if not own:
+                out = copy_rpc(out)
+            self._piggyback_gossip(p, out, ihave)
+
+        conn = self.ps.peers.get(p)
+        if conn is None:
+            return
+
+        if out.byte_size() < self.ps.max_message_size:
+            self._do_send_rpc(out, p, conn)
+            return
+        try:
+            rpcs = fragment_rpc(out, self.ps.max_message_size)
+        except ValueError:
+            self._do_drop_rpc(out, p)
+            return
+        for rpc in rpcs:
+            self._do_send_rpc(rpc, p, conn)
+
+    def _do_send_rpc(self, rpc: pb.RPC, p: PeerID, conn) -> None:
+        if conn.try_send(rpc):
+            self.ps.tracer.send_rpc(rpc, p)
+        else:
+            self._do_drop_rpc(rpc, p)
+
+    def _do_drop_rpc(self, rpc: pb.RPC, p: PeerID) -> None:
+        self.ps.tracer.drop_rpc(rpc, p)
+        # retry control messages via piggybacking on the next RPC
+        if rpc.control is not None:
+            self._push_control(p, rpc.control)
+
+    def _push_control(self, p: PeerID, ctl: pb.ControlMessage) -> None:
+        # gossip (IHAVE/IWANT) is never retried
+        ctl.ihave = []
+        ctl.iwant = []
+        if ctl.graft or ctl.prune:
+            self.control[p] = ctl
+
+    def _piggyback_control(self, p: PeerID, out: pb.RPC, ctl: pb.ControlMessage) -> None:
+        # staleness check against current mesh state
+        tograft = [g for g in ctl.graft
+                   if p in self.mesh.get(g.topic_id, set())]
+        toprune = [pr for pr in ctl.prune
+                   if p not in self.mesh.get(pr.topic_id, set())]
+        if not tograft and not toprune:
+            return
+        if out.control is None:
+            out.control = pb.ControlMessage()
+        out.control.graft.extend(tograft)
+        out.control.prune.extend(toprune)
+
+    def _piggyback_gossip(self, p: PeerID, out: pb.RPC,
+                          ihave: list[pb.ControlIHave]) -> None:
+        if out.control is None:
+            out.control = pb.ControlMessage()
+        out.control.ihave = list(ihave)
+
+    def _enqueue_gossip(self, p: PeerID, ihave: pb.ControlIHave) -> None:
+        self.gossip.setdefault(p, []).append(ihave)
+
+    def _make_prune(self, p: PeerID, topic: str, do_px: bool) -> pb.ControlPrune:
+        if not self.feature(FEATURE_PX, self.peers.get(p, "")):
+            # v1.0 peer: no PX, no backoff field (it can't parse them)
+            return pb.ControlPrune(topic_id=topic)
+        px: list[pb.PeerInfo] = []
+        if do_px:
+            peers = self._get_peers(
+                topic, self.params.prune_peers,
+                lambda xp: xp != p and self.score.score(xp) >= 0)
+            for xp in peers:
+                # cached signed record learned at connect time (identify);
+                # absent records mean bare peer IDs, like the reference's
+                # uncertified-peerstore case (gossipsub.go:1818-1833)
+                record = self.ps.host.peerstore_records.get(xp)
+                px.append(pb.PeerInfo(peer_id=bytes(xp),
+                                      signed_peer_record=record))
+        return pb.ControlPrune(topic_id=topic, peers=px,
+                               backoff=int(self.params.prune_backoff))
+
+    # -- heartbeat ---------------------------------------------------------
+
+    async def _heartbeat_timer(self) -> None:
+        await asyncio.sleep(self.params.heartbeat_initial_delay)
+        self.ps._post(self.heartbeat)
+        while True:
+            await asyncio.sleep(self.params.heartbeat_interval)
+            self.ps._post(self.heartbeat)
+
+    def heartbeat(self) -> None:
+        self.heartbeat_ticks += 1
+
+        tograft: dict[PeerID, list[str]] = {}
+        toprune: dict[PeerID, list[str]] = {}
+        no_px: set[PeerID] = set()
+
+        self._clear_backoff()
+        self._clear_ihave_counters()
+        self._apply_iwant_penalties()
+        self._direct_connect()
+
+        # cache scores for the duration of the heartbeat
+        scores: dict[PeerID, float] = {}
+
+        def score(p: PeerID) -> float:
+            if p not in scores:
+                scores[p] = self.score.score(p)
+            return scores[p]
+
+        for topic, peers in self.mesh.items():
+            # live lookup: prune_peer() may create the topic's backoff dict
+            # mid-heartbeat and later filters must see those entries
+            def in_backoff(p: PeerID, topic=topic) -> bool:
+                return p in self.backoff.get(topic, {})
+
+            def prune_peer(p: PeerID) -> None:
+                self.ps.tracer.prune(p, topic)
+                peers.discard(p)
+                self._add_backoff(p, topic)
+                toprune.setdefault(p, []).append(topic)
+
+            def graft_peer(p: PeerID) -> None:
+                self.ps.tracer.graft(p, topic)
+                peers.add(p)
+                tograft.setdefault(p, []).append(topic)
+
+            # drop all peers with negative score, without PX
+            for p in list(peers):
+                if score(p) < 0:
+                    prune_peer(p)
+                    no_px.add(p)
+
+            # too few peers: graft up to D
+            if len(peers) < self.params.d_lo:
+                candidates = self._get_peers(
+                    topic, self.params.d - len(peers),
+                    lambda p: p not in peers and not in_backoff(p)
+                    and p not in self.direct and score(p) >= 0)
+                for p in candidates:
+                    graft_peer(p)
+
+            # too many peers: prune down to D
+            if len(peers) > self.params.d_hi:
+                plst = list(peers)
+                # sort by score with random tie ordering
+                self.rng.shuffle(plst)
+                plst.sort(key=score, reverse=True)
+                # keep Dscore best by score, shuffle the rest
+                rest = plst[self.params.d_score:]
+                self.rng.shuffle(rest)
+                plst[self.params.d_score:] = rest
+
+                # anti-sybil: ensure Dout outbound peers among the survivors
+                outbound = sum(1 for p in plst[:self.params.d]
+                               if self.outbound.get(p, False))
+                if outbound < self.params.d_out:
+                    def rotate(i: int) -> None:
+                        plst[:i + 1] = [plst[i]] + plst[:i]
+
+                    if outbound > 0:
+                        have = outbound
+                        i = 1
+                        while i < self.params.d and have > 0:
+                            if self.outbound.get(plst[i], False):
+                                rotate(i)
+                                have -= 1
+                            i += 1
+                    need = self.params.d_out - outbound
+                    i = self.params.d
+                    while i < len(plst) and need > 0:
+                        if self.outbound.get(plst[i], False):
+                            rotate(i)
+                            need -= 1
+                        i += 1
+
+                for p in plst[self.params.d:]:
+                    prune_peer(p)
+
+            # too few outbound peers: graft some
+            if len(peers) >= self.params.d_lo:
+                outbound = sum(1 for p in peers if self.outbound.get(p, False))
+                if outbound < self.params.d_out:
+                    candidates = self._get_peers(
+                        topic, self.params.d_out - outbound,
+                        lambda p: p not in peers and not in_backoff(p)
+                        and p not in self.direct
+                        and self.outbound.get(p, False) and score(p) >= 0)
+                    for p in candidates:
+                        graft_peer(p)
+
+            # opportunistic grafting when the mesh median underperforms
+            if (self.heartbeat_ticks % self.params.opportunistic_graft_ticks == 0
+                    and len(peers) > 1):
+                plst = sorted(peers, key=score)
+                median_score = score(plst[len(plst) // 2])
+                if median_score < self.thresholds.opportunistic_graft_threshold:
+                    candidates = self._get_peers(
+                        topic, self.params.opportunistic_graft_peers,
+                        lambda p: p not in peers and not in_backoff(p)
+                        and p not in self.direct and score(p) > median_score)
+                    for p in candidates:
+                        graft_peer(p)
+
+            self._emit_gossip(topic, peers)
+
+        # fanout expiry + maintenance
+        now = self.ps.clock()
+        for topic in list(self.lastpub):
+            if self.lastpub[topic] + self.params.fanout_ttl < now:
+                self.fanout.pop(topic, None)
+                del self.lastpub[topic]
+
+        for topic, peers in self.fanout.items():
+            tmap = self.ps.topics.get(topic, set())
+            for p in list(peers):
+                if p not in tmap or score(p) < self.publish_threshold:
+                    peers.discard(p)
+            if len(peers) < self.params.d:
+                candidates = self._get_peers(
+                    topic, self.params.d - len(peers),
+                    lambda p: p not in peers and p not in self.direct
+                    and score(p) >= self.publish_threshold)
+                peers.update(candidates)
+            self._emit_gossip(topic, peers)
+
+        self._send_graft_prune(tograft, toprune, no_px)
+        self._flush()
+        self.mcache.shift()
+
+    def _clear_ihave_counters(self) -> None:
+        self.peerhave.clear()
+        self.iasked.clear()
+
+    def _apply_iwant_penalties(self) -> None:
+        for p, count in self.promises.get_broken_promises().items():
+            self.score.add_penalty(p, count)
+
+    def _clear_backoff(self) -> None:
+        # amortized: only sweep every 15 ticks
+        if self.heartbeat_ticks % 15 != 0:
+            return
+        now = self.ps.clock()
+        slack = 2 * self.params.heartbeat_interval
+        for topic in list(self.backoff):
+            entries = self.backoff[topic]
+            for p in list(entries):
+                if entries[p] + slack < now:
+                    del entries[p]
+            if not entries:
+                del self.backoff[topic]
+
+    def _send_graft_prune(self, tograft: dict[PeerID, list[str]],
+                          toprune: dict[PeerID, list[str]],
+                          no_px: set[PeerID]) -> None:
+        for p, topics in tograft.items():
+            graft = [pb.ControlGraft(topic_id=t) for t in topics]
+            prune = []
+            pruning = toprune.pop(p, None)
+            if pruning:
+                prune = [self._make_prune(p, t, self.do_px and p not in no_px)
+                         for t in pruning]
+            out = rpc_with_control([], [], [], graft, prune)
+            self.send_rpc(p, out)
+        for p, topics in toprune.items():
+            prune = [self._make_prune(p, t, self.do_px and p not in no_px)
+                     for t in topics]
+            out = rpc_with_control([], [], [], [], prune)
+            self.send_rpc(p, out)
+
+    def _emit_gossip(self, topic: str, exclude: set[PeerID]) -> None:
+        mids = self.mcache.get_gossip_ids(topic)
+        if not mids:
+            return
+        self.rng.shuffle(mids)
+
+        candidates = [
+            p for p in self.ps.topics.get(topic, set())
+            if p not in exclude and p not in self.direct
+            and self.feature(FEATURE_MESH, self.peers.get(p, ""))
+            and self.score.score(p) >= self.gossip_threshold
+        ]
+        target = max(self.params.d_lazy,
+                     int(self.params.gossip_factor * len(candidates)))
+        if target < len(candidates):
+            self.rng.shuffle(candidates)
+            candidates = candidates[:target]
+
+        for p in candidates:
+            peer_mids = mids
+            if len(mids) > self.params.max_ihave_length:
+                # emit a different truncated subset per peer for coverage
+                self.rng.shuffle(mids)
+                peer_mids = mids[:self.params.max_ihave_length]
+            self._enqueue_gossip(p, pb.ControlIHave(topic_id=topic,
+                                                    message_ids=list(peer_mids)))
+
+    def _flush(self) -> None:
+        # gossip first (piggybacks pending control)
+        for p in list(self.gossip):
+            ihave = self.gossip.pop(p)
+            out = rpc_with_control([], ihave, [], [], [])
+            self.send_rpc(p, out)
+        # remaining control
+        for p in list(self.control):
+            ctl = self.control.pop(p)
+            out = rpc_with_control([], [], [], list(ctl.graft), list(ctl.prune))
+            self.send_rpc(p, out)
+
+    # -- helpers -----------------------------------------------------------
+
+    def _get_peers(self, topic: str, count: int,
+                   predicate: Callable[[PeerID], bool]) -> list[PeerID]:
+        tmap = self.ps.topics.get(topic)
+        if not tmap:
+            return []
+        peers = [p for p in tmap
+                 if self.feature(FEATURE_MESH, self.peers.get(p, ""))
+                 and predicate(p)]
+        self.rng.shuffle(peers)
+        if 0 < count < len(peers):
+            peers = peers[:count]
+        return peers
+
+
+def fragment_rpc(rpc: pb.RPC, limit: int) -> list[pb.RPC]:
+    """Split an oversized RPC into multiple RPCs under ``limit`` bytes
+    (reference gossipsub.go:1158-1247).  A single message larger than the
+    limit is an error."""
+    if rpc.byte_size() < limit:
+        return [rpc]
+
+    rpcs = [pb.RPC()]
+
+    def out_rpc(size_to_add: int, with_ctl: bool = False) -> pb.RPC:
+        current = rpcs[-1]
+        if current.byte_size() + size_to_add + 1 < limit:
+            if with_ctl and current.control is None:
+                current.control = pb.ControlMessage()
+            return current
+        nxt = pb.RPC(control=pb.ControlMessage() if with_ctl else None)
+        rpcs.append(nxt)
+        return nxt
+
+    for msg in rpc.publish:
+        s = msg.byte_size()
+        if s > limit:
+            raise ValueError(f"message with len={s} exceeds limit {limit}")
+        out_rpc(s).publish.append(msg)
+    for sub in rpc.subscriptions:
+        out_rpc(sub.byte_size()).subscriptions.append(sub)
+
+    ctl = rpc.control
+    if ctl is None:
+        return rpcs
+    if pb.RPC(control=ctl).byte_size() < limit:
+        rpcs.append(pb.RPC(control=ctl))
+        return rpcs
+
+    for graft in ctl.graft:
+        out_rpc(graft.byte_size(), True).control.graft.append(graft)
+    for prune in ctl.prune:
+        out_rpc(prune.byte_size(), True).control.prune.append(prune)
+
+    protobuf_overhead = 6
+    for iwant in ctl.iwant:
+        for ids in fragment_message_ids(iwant.message_ids, limit - protobuf_overhead):
+            item = pb.ControlIWant(message_ids=ids)
+            out_rpc(item.byte_size(), True).control.iwant.append(item)
+    for ihave in ctl.ihave:
+        for ids in fragment_message_ids(ihave.message_ids, limit - protobuf_overhead):
+            item = pb.ControlIHave(topic_id=ihave.topic_id, message_ids=ids)
+            out_rpc(item.byte_size(), True).control.ihave.append(item)
+    return rpcs
+
+
+def fragment_message_ids(mids: list[bytes], limit: int) -> list[list[bytes]]:
+    protobuf_overhead = 2
+    out: list[list[bytes]] = [[]]
+    bucket_len = 0
+    for mid in mids:
+        size = len(mid) + protobuf_overhead
+        if size > limit:
+            continue  # pathological single ID over the limit: drop
+        bucket_len += size
+        if bucket_len > limit:
+            out.append([])
+            bucket_len = size
+        out[-1].append(mid)
+    return out
+
+
+async def create_gossipsub(host: Host, *,
+                           gossipsub_params: Optional[GossipSubParams] = None,
+                           direct_peers: Iterable[PeerID] = (),
+                           do_px: bool = False,
+                           flood_publish: bool = False,
+                           router_rng: Optional[random.Random] = None,
+                           protocols: Optional[list[str]] = None,
+                           feature_test=gossipsub_default_features,
+                           **kwargs) -> PubSub:
+    """Construct a gossipsub pubsub instance (reference gossipsub.go:197)."""
+    rt = GossipSubRouter(gossipsub_params, direct_peers=direct_peers,
+                         do_px=do_px, flood_publish=flood_publish,
+                         rng=router_rng, protocols=protocols,
+                         feature_test=feature_test)
+    return await PubSub.create(host, rt, **kwargs)

@@ -205,10 +205,19 @@ class Host:
         self.notifiees: list[Notifiee] = []
         self.conns: dict[PeerID, list[Connection]] = {}
         self.conn_manager = ConnManager()
-        # peerstore: public keys learned out-of-band or via identify
+        # peerstore: public keys and signed records learned via identify
         self.peerstore_keys: dict[PeerID, object] = {self.id: self.key.public}
+        self.peerstore_records: dict[PeerID, bytes] = {}
+        self._own_record: Optional[bytes] = None
         # simulated external IP for score colocation tests ("/ip4/…")
         self.ip: str = ""
+
+    def signed_record(self) -> bytes:
+        """This host's signed peer record (computed once, immutable)."""
+        if self._own_record is None:
+            from .crypto import make_signed_record
+            self._own_record = make_signed_record(self.key)
+        return self._own_record
 
     # -- wiring ------------------------------------------------------------
 
@@ -270,9 +279,11 @@ class InProcNetwork:
         conn = Connection(a, b)
         a.conns.setdefault(b.id, []).append(conn)
         b.conns.setdefault(a.id, []).append(conn)
-        # learn each other's keys (identify protocol equivalent)
+        # learn each other's keys + signed records (identify equivalent)
         a.peerstore_keys[b.id] = b.key.public
         b.peerstore_keys[a.id] = a.key.public
+        a.peerstore_records[b.id] = b.signed_record()
+        b.peerstore_records[a.id] = a.signed_record()
         for n in list(a.notifiees):
             n.connected(conn)
         for n in list(b.notifiees):

@@ -1,0 +1,59 @@
+"""Message cache: sliding window of full messages for gossip.
+
+Behavioral equivalent of the reference mcache (/root/reference/mcache.go):
+``history`` heartbeat slots of message IDs with full payloads, gossip
+advertised from the most recent ``gossip`` slots, and a per-(message, peer)
+transmission counter used to cut off IWANT spam.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..pb.rpc import PubMessage
+from .types import PeerID, default_msg_id_fn
+
+
+class MessageCache:
+    def __init__(self, gossip: int, history: int):
+        if gossip > history:
+            raise ValueError(
+                f"invalid message cache parameters: gossip slots ({gossip}) "
+                f"cannot be larger than history slots ({history})")
+        self.msgs: dict[bytes, PubMessage] = {}
+        self.peertx: dict[bytes, dict[PeerID, int]] = {}
+        self.history: list[list[tuple[bytes, str]]] = [[] for _ in range(history)]
+        self.gossip = gossip
+        self.msg_id: Callable[[PubMessage], bytes] = default_msg_id_fn
+
+    def set_msg_id_fn(self, fn: Callable[[PubMessage], bytes]) -> None:
+        self.msg_id = fn
+
+    def put(self, msg: PubMessage) -> None:
+        mid = self.msg_id(msg)
+        self.msgs[mid] = msg
+        self.history[0].append((mid, msg.topic))
+
+    def get(self, mid: bytes) -> Optional[PubMessage]:
+        return self.msgs.get(mid)
+
+    def get_for_peer(self, mid: bytes, p: PeerID):
+        """Returns (msg, transmit_count) or (None, 0); increments the
+        per-peer transmission counter."""
+        msg = self.msgs.get(mid)
+        if msg is None:
+            return None, 0
+        tx = self.peertx.setdefault(mid, {})
+        tx[p] = tx.get(p, 0) + 1
+        return msg, tx[p]
+
+    def get_gossip_ids(self, topic: str) -> list[bytes]:
+        return [mid for entries in self.history[:self.gossip]
+                for (mid, t) in entries if t == topic]
+
+    def shift(self) -> None:
+        for mid, _ in self.history[-1]:
+            self.msgs.pop(mid, None)
+            self.peertx.pop(mid, None)
+        self.history.pop()
+        self.history.insert(0, [])

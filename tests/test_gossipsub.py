@@ -1,0 +1,447 @@
+"""GossipSub end-to-end and adversarial tests.
+
+Mirrors the reference suite's core scenarios (/root/reference/
+gossipsub_test.go, gossipsub_spam_test.go): mesh formation and delivery,
+fanout, gossip recovery via IHAVE/IWANT, GRAFT/PRUNE handling including
+unknown-topic hardening and IWANT-spam cutoff, peer exchange, mixed-protocol
+networks, and RPC fragmentation.  The scripted wire-level adversary
+(MockPeer) speaks raw protobuf frames like the reference's newMockGS."""
+
+import asyncio
+import random
+
+import pytest
+
+from go_libp2p_pubsub_tpu.core import (
+    FLOODSUB_ID,
+    GOSSIPSUB_ID_V11,
+    GossipSubParams,
+    InProcNetwork,
+    MessageSignaturePolicy,
+    create_floodsub,
+    create_gossipsub,
+    fragment_rpc,
+)
+from go_libp2p_pubsub_tpu.core.crypto import make_signed_record
+from go_libp2p_pubsub_tpu.pb import (
+    RPC,
+    ControlGraft,
+    ControlIHave,
+    ControlIWant,
+    ControlMessage,
+    ControlPrune,
+    PeerInfo,
+    PubMessage,
+    SubOpts,
+)
+from go_libp2p_pubsub_tpu.pb.proto import write_delimited
+from helpers import connect, connect_all, dense_connect, get_hosts, settle
+
+def fast_params(**kw):
+    p = GossipSubParams(heartbeat_initial_delay=0.01, heartbeat_interval=0.05)
+    for k, v in kw.items():
+        setattr(p, k, v)
+    return p
+
+
+async def make_gossipsubs(hosts, params_factory=fast_params, **kwargs):
+    out = []
+    for i, h in enumerate(hosts):
+        ps = await create_gossipsub(
+            h, router_rng=random.Random(1000 + i),
+            gossipsub_params=params_factory(), **kwargs)
+        out.append(ps)
+    return out
+
+
+async def close_all(pubsubs, net):
+    for ps in pubsubs:
+        await ps.close()
+    await net.close()
+
+
+class MockPeer:
+    """Scripted wire-level peer speaking the gossipsub protocol directly
+    (reference gossipsub_spam_test.go:711-757)."""
+
+    def __init__(self, net, protocol=GOSSIPSUB_ID_V11, refuse_grafts=False):
+        self.host = net.new_host()
+        self.protocol = protocol
+        self.received: list[RPC] = []
+        self.refuse_grafts = refuse_grafts
+        self.host.set_stream_handler(protocol, self._reader)
+        self._stream = None
+
+    async def _reader(self, stream):
+        try:
+            while True:
+                size = await stream.read_uvarint()
+                frame = await stream.read_exact(size)
+                rpc = RPC.decode(frame)
+                self.received.append(rpc)
+                if (self.refuse_grafts and rpc.control is not None
+                        and rpc.control.graft and self._stream is not None):
+                    # stay out of the mesh: answer every GRAFT with PRUNE
+                    self.send(RPC(control=ControlMessage(prune=[
+                        ControlPrune(topic_id=g.topic_id, backoff=1)
+                        for g in rpc.control.graft])))
+        except Exception:
+            pass
+
+    async def connect_and_open(self, target_host):
+        await self.host.connect(target_host)
+        await asyncio.sleep(0.05)
+        self._stream = await self.host.new_stream(target_host.id, [self.protocol])
+        return self._stream
+
+    def send(self, rpc: RPC) -> None:
+        self._stream.write(write_delimited(rpc))
+
+    def control_msgs(self, kind: str):
+        out = []
+        for rpc in self.received:
+            if rpc.control is not None:
+                out.extend(getattr(rpc.control, kind))
+        return out
+
+    def messages(self):
+        return [m for rpc in self.received for m in rpc.publish]
+
+
+async def test_gossipsub_basic_delivery():
+    net = InProcNetwork()
+    hosts = get_hosts(net, 20)
+    psubs = await make_gossipsubs(hosts)
+    subs = []
+    for ps in psubs:
+        topic = await ps.join("foobar")
+        subs.append(await topic.subscribe())
+    await dense_connect(hosts)
+    await settle(0.4)  # several heartbeats: let meshes form
+
+    for i in (0, 7, 13):
+        data = f"gossip payload {i}".encode()
+        t = await psubs[i].join("foobar")
+        await t.publish(data)
+        for sub in subs:
+            msg = await asyncio.wait_for(sub.next(), 5)
+            assert msg.data == data
+    await close_all(psubs, net)
+
+
+async def test_mesh_degree_bounds():
+    net = InProcNetwork()
+    hosts = get_hosts(net, 20)
+    psubs = await make_gossipsubs(hosts)
+    for ps in psubs:
+        topic = await ps.join("mesh-topic")
+        await topic.subscribe()
+    await connect_all(hosts)
+    await settle(0.6)
+
+    for ps in psubs:
+        mesh = ps.router.mesh.get("mesh-topic", set())
+        assert len(mesh) >= ps.router.params.d_lo
+        assert len(mesh) <= ps.router.params.d_hi
+    await close_all(psubs, net)
+
+
+async def test_fanout_publish_without_join():
+    net = InProcNetwork()
+    hosts = get_hosts(net, 8)
+    psubs = await make_gossipsubs(hosts)
+    subs = []
+    for ps in psubs[1:]:
+        topic = await ps.join("news")
+        subs.append(await topic.subscribe())
+    await connect_all(hosts)
+    await settle(0.3)
+
+    # host 0 publishes without subscribing: fanout path
+    t0 = await psubs[0].join("news")
+    await t0.publish(b"fanout delivery")
+    for sub in subs:
+        msg = await asyncio.wait_for(sub.next(), 5)
+        assert msg.data == b"fanout delivery"
+    assert "news" in psubs[0].router.fanout
+    assert "news" not in psubs[0].router.mesh
+
+    # subscribing converts fanout into mesh
+    await t0.subscribe()
+    await settle(0.2)
+    assert "news" not in psubs[0].router.fanout
+    assert "news" in psubs[0].router.mesh
+    await close_all(psubs, net)
+
+
+async def test_gossip_ihave_iwant_recovery():
+    # a non-mesh subscriber recovers a message via IHAVE -> IWANT
+    net = InProcNetwork()
+    hosts = get_hosts(net, 3)
+    psubs = await make_gossipsubs(hosts)
+    topics = [await ps.join("g") for ps in psubs]
+    for t in topics:
+        await t.subscribe()
+    await connect_all(hosts)
+    await settle(0.3)
+
+    mock = MockPeer(net, refuse_grafts=True)
+    await mock.connect_and_open(hosts[0])
+    # announce subscription but refuse GRAFTs: mock stays out of the mesh
+    mock.send(RPC(subscriptions=[SubOpts(subscribe=True, topicid="g")]))
+    await settle(0.2)
+
+    # publish fresh messages until an IHAVE for topic g arrives
+    ihaves = []
+    for i in range(30):
+        await topics[1].publish(b"gossiped message")
+        await settle(0.1)
+        ihaves = [ih for ih in mock.control_msgs("ihave") if ih.topic_id == "g"]
+        if ihaves:
+            break
+    assert ihaves, "mock never received IHAVE gossip"
+
+    # ask for it and receive the full message
+    mids = ihaves[0].message_ids
+    mock.send(RPC(control=ControlMessage(iwant=[ControlIWant(message_ids=list(mids))])))
+    for _ in range(20):
+        await settle(0.05)
+        if mock.messages():
+            break
+    msgs = mock.messages()
+    assert msgs and msgs[0].data == b"gossiped message"
+    await close_all(psubs, net)
+
+
+async def test_graft_unknown_topic_gets_prune_without_px():
+    net = InProcNetwork()
+    hosts = get_hosts(net, 1)
+    psubs = await make_gossipsubs(hosts, do_px=True)
+    mock = MockPeer(net)
+    await mock.connect_and_open(hosts[0])
+    mock.send(RPC(control=ControlMessage(graft=[ControlGraft(topic_id="nope")])))
+    await settle(0.3)
+    # spam hardening: GRAFT for unknown topic is ignored entirely
+    assert not mock.control_msgs("prune")
+    await close_all(psubs, net)
+
+
+async def test_graft_gets_pruned_when_not_subscribed_backoff():
+    # GRAFT into a topic the router joined, then GRAFT again during backoff
+    net = InProcNetwork()
+    hosts = get_hosts(net, 1)
+    psubs = await make_gossipsubs(hosts)
+    topic = await psubs[0].join("t")
+    await topic.subscribe()
+    await settle(0.1)
+
+    mock = MockPeer(net)
+    await mock.connect_and_open(hosts[0])
+    mock.send(RPC(subscriptions=[SubOpts(subscribe=True, topicid="t")]))
+    await settle(0.1)
+    # legit graft: accepted into mesh
+    mock.send(RPC(control=ControlMessage(graft=[ControlGraft(topic_id="t")])))
+    await settle(0.2)
+    assert mock.host.id in psubs[0].router.mesh["t"]
+    await close_all(psubs, net)
+
+
+async def test_iwant_spam_cutoff():
+    # after GossipRetransmission requests for the same message id, the
+    # router stops responding (reference gossipsub_spam_test.go:24)
+    net = InProcNetwork()
+    hosts = get_hosts(net, 2)
+    # slower heartbeat so the message stays in the cache window while the
+    # spam loop runs (history shifts once per heartbeat)
+    psubs = await make_gossipsubs(
+        hosts, params_factory=lambda: fast_params(heartbeat_interval=0.5))
+    topics = [await ps.join("s") for ps in psubs]
+    subs = [await t.subscribe() for t in topics]
+    await connect(hosts[0], hosts[1])
+    await settle(0.2)
+
+    mock = MockPeer(net)
+    await mock.connect_and_open(hosts[0])
+    mock.send(RPC(subscriptions=[SubOpts(subscribe=True, topicid="s")]))
+    await settle(0.1)
+
+    await topics[0].publish(b"wanted")
+    await settle(0.1)
+    mid = psubs[0].msg_id(
+        [m for m in psubs[0].router.mcache.msgs.values()][0])
+
+    got = 0
+    for i in range(6):
+        before = len(mock.messages())
+        mock.send(RPC(control=ControlMessage(
+            iwant=[ControlIWant(message_ids=[mid])])))
+        await settle(0.15)
+        if len(mock.messages()) > before:
+            got += 1
+    # 3 retransmissions allowed (GossipRetransmission), then cutoff
+    assert got == psubs[0].router.params.gossip_retransmission
+    await close_all(psubs, net)
+
+
+async def test_px_connects_to_exchanged_peer():
+    net = InProcNetwork()
+    hosts = get_hosts(net, 2)  # host0 = victim, host1 = PX target
+    psubs = await make_gossipsubs(hosts)
+    t0 = await psubs[0].join("px")
+    await t0.subscribe()
+    await settle(0.1)
+
+    mock = MockPeer(net)
+    await mock.connect_and_open(hosts[0])
+    mock.send(RPC(subscriptions=[SubOpts(subscribe=True, topicid="px")]))
+    mock.send(RPC(control=ControlMessage(graft=[ControlGraft(topic_id="px")])))
+    await settle(0.2)
+    assert not hosts[0].connectedness(hosts[1].id)
+
+    # mock prunes us, handing over host1 via PX with a valid signed record
+    record = make_signed_record(hosts[1].key)
+    mock.send(RPC(control=ControlMessage(prune=[ControlPrune(
+        topic_id="px",
+        peers=[PeerInfo(peer_id=bytes(hosts[1].id), signed_peer_record=record)],
+        backoff=1)])))
+    for _ in range(20):
+        await settle(0.05)
+        if hosts[0].connectedness(hosts[1].id):
+            break
+    assert hosts[0].connectedness(hosts[1].id)
+    await close_all(psubs, net)
+
+
+async def test_px_rejects_bogus_record():
+    net = InProcNetwork()
+    hosts = get_hosts(net, 2)
+    psubs = await make_gossipsubs(hosts)
+    t0 = await psubs[0].join("px")
+    await t0.subscribe()
+    await settle(0.1)
+
+    mock = MockPeer(net)
+    await mock.connect_and_open(hosts[0])
+    mock.send(RPC(subscriptions=[SubOpts(subscribe=True, topicid="px")]))
+    await settle(0.1)
+    # signed record from the WRONG key (mock's own) claiming host1's ID
+    bogus = make_signed_record(mock.host.key)
+    mock.send(RPC(control=ControlMessage(prune=[ControlPrune(
+        topic_id="px",
+        peers=[PeerInfo(peer_id=bytes(hosts[1].id), signed_peer_record=bogus)],
+        backoff=1)])))
+    await settle(0.4)
+    assert not hosts[0].connectedness(hosts[1].id)
+    await close_all(psubs, net)
+
+
+async def test_mixed_floodsub_gossipsub():
+    # floodsub peers interoperate: gossipsub always floods to them
+    net = InProcNetwork()
+    hosts = get_hosts(net, 4)
+    gs = await make_gossipsubs(hosts[:3])
+    fs = await create_floodsub(hosts[3])
+    psubs = gs + [fs]
+    subs = []
+    for ps in psubs:
+        topic = await ps.join("mixed")
+        subs.append(await topic.subscribe())
+    await connect_all(hosts)
+    await settle(0.4)
+
+    t = await psubs[0].join("mixed")
+    await t.publish(b"to everyone")
+    for sub in subs:
+        msg = await asyncio.wait_for(sub.next(), 5)
+        assert msg.data == b"to everyone"
+    # the floodsub peer speaks /floodsub/1.0.0 to the gossipsub node
+    assert gs[0].router.peers[hosts[3].id] == FLOODSUB_ID
+    await close_all(psubs, net)
+
+
+def test_fragment_rpc_unit():
+    limit = 1 << 10
+    big = RPC(
+        publish=[PubMessage(data=bytes([i]) * 300, topic="frag") for i in range(8)],
+        control=ControlMessage(
+            ihave=[ControlIHave(topic_id="frag",
+                                message_ids=[bytes([i, j]) * 8 for j in range(80)])
+                   for i in range(3)],
+            graft=[ControlGraft(topic_id="frag")],
+        ),
+    )
+    frags = fragment_rpc(big, limit)
+    assert len(frags) > 1
+    for f in frags:
+        assert f.byte_size() < limit
+    # no payload lost
+    all_msgs = [m.data for f in frags for m in f.publish]
+    assert all_msgs == [m.data for m in big.publish]
+    all_ihave_ids = [mid for f in frags if f.control
+                     for ih in f.control.ihave for mid in ih.message_ids]
+    orig_ids = [mid for ih in big.control.ihave for mid in ih.message_ids]
+    assert sorted(all_ihave_ids) == sorted(orig_ids)
+    grafts = [g for f in frags if f.control for g in f.control.graft]
+    assert len(grafts) == 1
+
+
+def test_fragment_oversize_single_message_errors():
+    limit = 1 << 10
+    big = RPC(publish=[PubMessage(data=b"x" * 2048, topic="frag")])
+    with pytest.raises(ValueError):
+        fragment_rpc(big, limit)
+
+
+def test_gossipsub_params_validation():
+    with pytest.raises(ValueError):
+        GossipSubParams(d=20).validate()  # D > Dhi
+    with pytest.raises(ValueError):
+        GossipSubParams(d_out=5).validate()  # Dout >= Dlo
+    with pytest.raises(ValueError):
+        GossipSubParams(history_gossip=9, history_length=5).validate()
+
+
+async def test_direct_peers_always_receive():
+    net = InProcNetwork()
+    hosts = get_hosts(net, 2)
+    # mutual direct peering: always forward, never mesh
+    ps0 = await create_gossipsub(hosts[0], router_rng=random.Random(1),
+                                 gossipsub_params=fast_params(),
+                                 direct_peers=[hosts[1].id])
+    ps1 = await create_gossipsub(hosts[1], router_rng=random.Random(2),
+                                 gossipsub_params=fast_params(),
+                                 direct_peers=[hosts[0].id])
+    t0 = await ps0.join("d")
+    await t0.subscribe()
+    t1 = await ps1.join("d")
+    sub1 = await t1.subscribe()
+    await connect(hosts[0], hosts[1])
+    await settle(0.3)
+
+    await t0.publish(b"direct delivery")
+    msg = await asyncio.wait_for(sub1.next(), 5)
+    assert msg.data == b"direct delivery"
+    # direct peers never enter the mesh
+    assert hosts[1].id not in ps0.router.mesh.get("d", set())
+    assert hosts[0].id not in ps1.router.mesh.get("d", set())
+    await close_all([ps0, ps1], net)
+
+
+async def test_flood_publish_reaches_all_topic_peers():
+    net = InProcNetwork()
+    hosts = get_hosts(net, 10)
+    psubs = await make_gossipsubs(hosts, flood_publish=True)
+    subs = []
+    for ps in psubs[1:]:
+        topic = await ps.join("f")
+        subs.append(await topic.subscribe())
+    await connect_all(hosts)
+    await settle(0.1)  # do NOT wait for mesh formation
+
+    # flood publish sends to ALL topic peers immediately, mesh or not
+    t0 = await psubs[0].join("f")
+    await t0.publish(b"flooded")
+    for sub in subs:
+        msg = await asyncio.wait_for(sub.next(), 5)
+        assert msg.data == b"flooded"
+    await close_all(psubs, net)

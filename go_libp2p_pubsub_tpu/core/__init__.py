@@ -3,16 +3,25 @@
 from .blacklist import Blacklist, MapBlacklist, TimeCachedBlacklist
 from .crypto import PrivateKey, PublicKey, generate_keypair, peer_id_extract_key
 from .floodsub import FloodSubRouter, create_floodsub
+from .gossip_tracer import GossipTracer
 from .gossipsub import (
     GOSSIPSUB_DEFAULT_PROTOCOLS,
     GossipSubParams,
     GossipSubRouter,
-    PeerScoreThresholds,
     create_gossipsub,
     fragment_rpc,
     gossipsub_default_features,
 )
 from .mcache import MessageCache
+from .peer_gater import PeerGater, PeerGaterParams
+from .score import PeerScore, PeerScoreSnapshot, TopicScoreSnapshot
+from .score_params import (
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+    score_parameter_decay,
+)
+from .tag_tracer import TagTracer
 from .host import Host, InProcNetwork, NegotiationError, Stream, StreamResetError
 from .pubsub import PubSub, PubSubRouter
 from .sign import (

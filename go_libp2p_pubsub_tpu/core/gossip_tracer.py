@@ -1,0 +1,119 @@
+"""Gossip promise tracker: penalize IHAVE advertisers who break IWANT promises.
+
+Behavioral equivalent of the reference tracker
+(/root/reference/gossip_tracer.go): after we send an IWANT, one randomly
+chosen advertised message ID must arrive within ``iwant_followup_time`` or
+the advertiser earns a broken promise — surfaced to the router at each
+heartbeat and converted into a P7 behavioural penalty
+(gossipsub.go:1566-1571).  Tracking one random ID per request keeps memory
+probabilistic-bounded.  A promise is fulfilled the moment the message
+*begins validation* — an invalid message still keeps the promise (the P4
+penalty applies instead), except for obviously-bogus signature failures.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional
+
+from .trace import RawTracer
+from .types import (
+    Message,
+    MsgIdFunction,
+    PeerID,
+    REJECT_INVALID_SIGNATURE,
+    REJECT_MISSING_SIGNATURE,
+    default_msg_id_fn,
+)
+
+
+class GossipTracer(RawTracer):
+    """Implements the router's PromiseTrackerInterface + RawTracer."""
+
+    def __init__(self, *, msg_id_fn: MsgIdFunction = default_msg_id_fn,
+                 follow_up_time: float = 3.0,
+                 clock: Optional[Callable[[], float]] = None,
+                 rng: Optional[random.Random] = None):
+        self.msg_id = msg_id_fn
+        self.follow_up_time = follow_up_time
+        self.clock = clock or time.monotonic
+        self.rng = rng or random.Random()
+        # msg id -> {peer: promise expiry}
+        self.promises: dict[bytes, dict[PeerID, float]] = {}
+        # peer -> promised msg ids (for fast voiding on throttle)
+        self.peer_promises: dict[PeerID, set[bytes]] = {}
+
+    # -- router interface --------------------------------------------------
+
+    def start(self, gs) -> None:
+        self.msg_id = gs.ps.msg_id
+        self.clock = gs.ps.clock
+        self.follow_up_time = gs.params.iwant_followup_time
+        self.rng = gs.rng
+
+    def add_promise(self, p: PeerID, mids: list[bytes]) -> None:
+        if not mids:
+            return
+        mid = mids[self.rng.randrange(len(mids))]
+        promises = self.promises.setdefault(mid, {})
+        if p not in promises:
+            promises[p] = self.clock() + self.follow_up_time
+            self.peer_promises.setdefault(p, set()).add(mid)
+
+    def get_broken_promises(self) -> dict[PeerID, int]:
+        res: dict[PeerID, int] = {}
+        now = self.clock()
+        for mid in list(self.promises):
+            promises = self.promises[mid]
+            for p in list(promises):
+                if promises[p] < now:
+                    res[p] = res.get(p, 0) + 1
+                    del promises[p]
+                    pp = self.peer_promises.get(p)
+                    if pp is not None:
+                        pp.discard(mid)
+                        if not pp:
+                            del self.peer_promises[p]
+            if not promises:
+                del self.promises[mid]
+        return res
+
+    # -- fulfillment --------------------------------------------------------
+
+    def _fulfill_promise(self, msg: Message) -> None:
+        mid = self.msg_id(msg.rpc)
+        promises = self.promises.pop(mid, None)
+        if promises:
+            for p in promises:
+                pp = self.peer_promises.get(p)
+                if pp is not None:
+                    pp.discard(mid)
+                    if not pp:
+                        del self.peer_promises[p]
+
+    # -- RawTracer hooks ---------------------------------------------------
+
+    def validate_message(self, msg: Message) -> None:
+        # fulfilled as soon as validation begins; signature failures never
+        # reach this trace
+        self._fulfill_promise(msg)
+
+    def deliver_message(self, msg: Message) -> None:
+        self._fulfill_promise(msg)
+
+    def reject_message(self, msg: Message, reason: str) -> None:
+        # obviously-invalid messages don't count as followup
+        if reason in (REJECT_MISSING_SIGNATURE, REJECT_INVALID_SIGNATURE):
+            return
+        self._fulfill_promise(msg)
+
+    def throttle_peer(self, p: PeerID) -> None:
+        # a throttled peer's pending promises are voided (it couldn't deliver
+        # through the gater anyway)
+        for mid in self.peer_promises.pop(p, set()):
+            promises = self.promises.get(mid)
+            if promises is not None:
+                promises.pop(p, None)
+                if not promises:
+                    del self.promises[mid]

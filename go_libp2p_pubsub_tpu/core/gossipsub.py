@@ -27,6 +27,8 @@ from .crypto import verify_signed_record
 from .host import Host
 from .mcache import MessageCache
 from .pubsub import PubSub, PubSubRouter
+from .score_params import PeerScoreThresholds
+from .tag_tracer import TagTracer
 from .types import (
     FLOODSUB_ID,
     GOSSIPSUB_ID_V10,
@@ -135,34 +137,6 @@ class PromiseTrackerInterface:
         pass
 
 
-@dataclass
-class PeerScoreThresholds:
-    """Score thresholds wired into the router (reference score_params.go:12-32)."""
-
-    gossip_threshold: float = 0.0
-    publish_threshold: float = 0.0
-    graylist_threshold: float = 0.0
-    accept_px_threshold: float = 0.0
-    opportunistic_graft_threshold: float = 0.0
-
-    def validate(self) -> None:
-        if self.gossip_threshold > 0:
-            raise ValueError("invalid gossip threshold; it must be <= 0")
-        if self.publish_threshold > 0 or self.publish_threshold > self.gossip_threshold:
-            raise ValueError(
-                "invalid publish threshold; it must be <= 0 and <= gossip threshold")
-        if self.graylist_threshold > 0 or (
-                self.graylist_threshold > self.publish_threshold
-                and self.graylist_threshold != 0):
-            raise ValueError(
-                "invalid graylist threshold; it must be <= 0 and <= publish threshold")
-        if self.accept_px_threshold < 0:
-            raise ValueError("invalid accept PX threshold; it must be >= 0")
-        if self.opportunistic_graft_threshold < 0:
-            raise ValueError(
-                "invalid opportunistic grafting threshold; it must be >= 0")
-
-
 class GossipSubRouter(PubSubRouter):
     def __init__(self, params: Optional[GossipSubParams] = None, *,
                  protocols: Optional[list[str]] = None,
@@ -194,11 +168,12 @@ class GossipSubRouter(PubSubRouter):
         self.heartbeat_ticks = 0
         self.rng = rng or random.Random()
 
-        # v1.1 hardening hooks (replaced by WithPeerScore / WithPeerGater)
+        # v1.1 hardening hooks (replaced by score_params= / gater_params=)
         self.score: ScoreInterface = ScoreInterface()
         self.gate: GaterInterface = GaterInterface()
         self.promises: PromiseTrackerInterface = PromiseTrackerInterface()
         self.thresholds = PeerScoreThresholds()
+        self.tag = TagTracer()  # always installed (reference gossipsub.go:215-220)
 
         self._connect_queue: Optional[asyncio.Queue] = None
         self._tasks: list[asyncio.Task] = []
@@ -228,9 +203,17 @@ class GossipSubRouter(PubSubRouter):
     def attach(self, ps: PubSub) -> None:
         self.ps = ps
         self.mcache.set_msg_id_fn(ps.msg_id)
+        # register the hardening engines on the observability bus here, so
+        # both construction paths (create_gossipsub and direct
+        # PubSub.create(host, GossipSubRouter())) wire them identically
+        from .trace import RawTracer
+        for engine in (self.tag, self.score, self.gate, self.promises):
+            if isinstance(engine, RawTracer) and engine not in ps.tracer.raw:
+                ps.tracer.raw.append(engine)
         self.score.start(self)
         self.gate.start(self)
         self.promises.start(self)
+        self.tag.start(self)
         self._connect_queue = asyncio.Queue(
             maxsize=self.params.max_pending_connections)
         self._tasks.append(asyncio.ensure_future(self._heartbeat_timer()))
@@ -974,10 +957,44 @@ async def create_gossipsub(host: Host, *,
                            router_rng: Optional[random.Random] = None,
                            protocols: Optional[list[str]] = None,
                            feature_test=gossipsub_default_features,
+                           score_params=None,
+                           score_thresholds: Optional[PeerScoreThresholds] = None,
+                           score_inspect=None,
+                           score_inspect_extended: bool = False,
+                           score_inspect_period: float = 1.0,
+                           gater_params=None,
+                           raw_tracers=None,
                            **kwargs) -> PubSub:
-    """Construct a gossipsub pubsub instance (reference gossipsub.go:197)."""
+    """Construct a gossipsub pubsub instance (reference gossipsub.go:197).
+
+    ``score_params`` + ``score_thresholds`` enable peer scoring (reference
+    WithPeerScore, gossipsub.go:258); ``gater_params`` enables the peer
+    gater (reference WithPeerGater, peer_gater.go:164).  Both engines hook
+    the observability bus as RawTracers.
+    """
     rt = GossipSubRouter(gossipsub_params, direct_peers=direct_peers,
                          do_px=do_px, flood_publish=flood_publish,
                          rng=router_rng, protocols=protocols,
                          feature_test=feature_test)
-    return await PubSub.create(host, rt, **kwargs)
+
+    if score_params is not None:
+        from .gossip_tracer import GossipTracer
+        from .score import PeerScore
+        thresholds = score_thresholds or PeerScoreThresholds()
+        thresholds.validate()
+        rt.score = PeerScore(score_params, inspect=score_inspect,
+                             inspect_extended=score_inspect_extended,
+                             inspect_period=score_inspect_period)
+        rt.thresholds = thresholds
+        rt.promises = GossipTracer()
+    elif (score_thresholds is not None or score_inspect is not None):
+        # without score_params these options would be silently inert —
+        # the reference API (WithPeerScore) makes that unrepresentable
+        raise ValueError("score_thresholds/score_inspect require score_params")
+
+    if gater_params is not None:
+        from .peer_gater import PeerGater
+        rt.gate = PeerGater(gater_params if gater_params is not True else None)
+
+    return await PubSub.create(host, rt, raw_tracers=list(raw_tracers or []),
+                               **kwargs)

@@ -155,6 +155,10 @@ class Connection:
     def is_outbound_for(self, pid: PeerID) -> bool:
         return self.initiator.id == pid
 
+    def remote_host(self, pid: PeerID) -> "Host":
+        """The endpoint that is NOT ``pid`` (for IP attribution)."""
+        return self.responder if self.initiator.id == pid else self.initiator
+
 
 StreamHandler = Callable[[Stream], Awaitable[None]]
 

@@ -1,0 +1,236 @@
+"""Peer gater: reactive Random-Early-Drop on the validation queue.
+
+Behavioral equivalent of the reference gater (/root/reference/peer_gater.go):
+a circuit breaker that activates when the throttled/validated ratio exceeds
+a threshold, then probabilistically admits payload per *source IP* with
+probability
+
+    (1 + deliver) / (1 + deliver + 0.125·duplicate + 1·ignore + 16·reject)
+
+so sybils colocated behind one address share fate.  Deactivates after a
+quiet period with no throttle events.  Implemented as a RawTracer fed by
+the observability bus, like the other v1.1 engines.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .score_params import (
+    DEFAULT_DECAY_INTERVAL,
+    DEFAULT_DECAY_TO_ZERO,
+    score_parameter_decay,
+)
+from .trace import RawTracer
+from .types import (
+    AcceptStatus,
+    Message,
+    PeerID,
+    REJECT_VALIDATION_IGNORED,
+    REJECT_VALIDATION_QUEUE_FULL,
+    REJECT_VALIDATION_THROTTLED,
+)
+
+DEFAULT_PEER_GATER_RETAIN_STATS = 6 * 3600.0
+DEFAULT_PEER_GATER_QUIET = 60.0
+DEFAULT_PEER_GATER_DUPLICATE_WEIGHT = 0.125
+DEFAULT_PEER_GATER_IGNORE_WEIGHT = 1.0
+DEFAULT_PEER_GATER_REJECT_WEIGHT = 16.0
+DEFAULT_PEER_GATER_THRESHOLD = 0.33
+DEFAULT_PEER_GATER_GLOBAL_DECAY = score_parameter_decay(2 * 60.0)
+DEFAULT_PEER_GATER_SOURCE_DECAY = score_parameter_decay(3600.0)
+
+
+@dataclass
+class PeerGaterParams:
+    """Gater configuration (reference peer_gater.go:31-88)."""
+
+    threshold: float = DEFAULT_PEER_GATER_THRESHOLD
+    global_decay: float = DEFAULT_PEER_GATER_GLOBAL_DECAY
+    source_decay: float = DEFAULT_PEER_GATER_SOURCE_DECAY
+    decay_interval: float = DEFAULT_DECAY_INTERVAL
+    decay_to_zero: float = DEFAULT_DECAY_TO_ZERO
+    retain_stats: float = DEFAULT_PEER_GATER_RETAIN_STATS
+    quiet: float = DEFAULT_PEER_GATER_QUIET
+    duplicate_weight: float = DEFAULT_PEER_GATER_DUPLICATE_WEIGHT
+    ignore_weight: float = DEFAULT_PEER_GATER_IGNORE_WEIGHT
+    reject_weight: float = DEFAULT_PEER_GATER_REJECT_WEIGHT
+    topic_delivery_weights: dict[str, float] = field(default_factory=dict)
+
+    def validate(self) -> None:
+        if self.threshold <= 0:
+            raise ValueError("invalid Threshold; must be > 0")
+        if not (0 < self.global_decay < 1):
+            raise ValueError("invalid GlobalDecay; must be between 0 and 1")
+        if not (0 < self.source_decay < 1):
+            raise ValueError("invalid SourceDecay; must be between 0 and 1")
+        if self.decay_interval < 1.0:
+            raise ValueError("invalid DecayInterval; must be at least 1s")
+        if not (0 < self.decay_to_zero < 1):
+            raise ValueError("invalid DecayToZero; must be between 0 and 1")
+        if self.quiet < 1.0:
+            raise ValueError("invalid Quiet interval; must be at least 1s")
+        if self.duplicate_weight <= 0:
+            raise ValueError("invalid DuplicateWeight; must be > 0")
+        if self.ignore_weight < 1:
+            raise ValueError("invalid IgnoreWeight; must be >= 1")
+        if self.reject_weight < 1:
+            raise ValueError("invalid RejectWeight; must be >= 1")
+
+
+class _GaterStats:
+    __slots__ = ("connected", "expire", "deliver", "duplicate", "ignore", "reject")
+
+    def __init__(self):
+        self.connected = 0
+        self.expire = 0.0
+        self.deliver = 0.0
+        self.duplicate = 0.0
+        self.ignore = 0.0
+        self.reject = 0.0
+
+
+class PeerGater(RawTracer):
+    """Implements the router's GaterInterface + RawTracer."""
+
+    def __init__(self, params: Optional[PeerGaterParams] = None, *,
+                 clock: Optional[Callable[[], float]] = None,
+                 rng: Optional[random.Random] = None,
+                 get_ip: Optional[Callable[[PeerID], str]] = None):
+        self.params = params or PeerGaterParams()
+        self.params.validate()
+        self.clock = clock or time.monotonic
+        self.rng = rng or random.Random()
+        self.host = None
+        self.get_ip = get_ip  # test hook (reference peer_gater.go:140)
+        self.validate = 0.0
+        self.throttle = 0.0
+        self.last_throttle = float("-inf")
+        # multiple peer IDs share one stats object when they share an IP
+        self.peer_stats: dict[PeerID, _GaterStats] = {}
+        self.ip_stats: dict[str, _GaterStats] = {}
+
+    # -- router interface --------------------------------------------------
+
+    def start(self, gs) -> None:
+        self.host = gs.ps.host
+        self.clock = gs.ps.clock
+        self.rng = gs.rng
+        gs.ps._tasks.add(asyncio.ensure_future(self._background()))
+
+    def accept_from(self, p: PeerID) -> AcceptStatus:
+        # quiet period elapsed or throttle counter decayed: breaker off
+        if self.clock() - self.last_throttle > self.params.quiet:
+            return AcceptStatus.ALL
+        if self.throttle == 0:
+            return AcceptStatus.ALL
+        if self.validate != 0 and self.throttle / self.validate < self.params.threshold:
+            return AcceptStatus.ALL
+
+        st = self._get_peer_stats(p)
+        total = (st.deliver
+                 + self.params.duplicate_weight * st.duplicate
+                 + self.params.ignore_weight * st.ignore
+                 + self.params.reject_weight * st.reject)
+        if total == 0:
+            return AcceptStatus.ALL
+
+        # randomized RED biased by +1 so one bad event can't sinkhole a peer
+        threshold = (1 + st.deliver) / (1 + total)
+        if self.rng.random() < threshold:
+            return AcceptStatus.ALL
+        return AcceptStatus.CONTROL
+
+    # -- stats plumbing ----------------------------------------------------
+
+    def _get_peer_stats(self, p: PeerID) -> _GaterStats:
+        st = self.peer_stats.get(p)
+        if st is None:
+            ip = self._get_peer_ip(p)
+            st = self.ip_stats.get(ip)
+            if st is None:
+                st = _GaterStats()
+                self.ip_stats[ip] = st
+            self.peer_stats[p] = st
+        return st
+
+    def _get_peer_ip(self, p: PeerID) -> str:
+        if self.get_ip is not None:
+            return self.get_ip(p)
+        if self.host is None:
+            return "<unknown>"
+        for conn in self.host.conns.get(p, ()):
+            ip = getattr(conn.remote_host(self.host.id), "ip", "")
+            if ip:
+                return ip
+        return "<unknown>"
+
+    # -- periodic decay ----------------------------------------------------
+
+    async def _background(self) -> None:
+        while True:
+            await asyncio.sleep(self.params.decay_interval)
+            self.decay_stats()
+
+    def decay_stats(self) -> None:
+        p = self.params
+        self.validate *= p.global_decay
+        if self.validate < p.decay_to_zero:
+            self.validate = 0.0
+        self.throttle *= p.global_decay
+        if self.throttle < p.decay_to_zero:
+            self.throttle = 0.0
+
+        now = self.clock()
+        for ip in list(self.ip_stats):
+            st = self.ip_stats[ip]
+            if st.connected > 0:
+                st.deliver *= p.source_decay
+                if st.deliver < p.decay_to_zero:
+                    st.deliver = 0.0
+                st.duplicate *= p.source_decay
+                if st.duplicate < p.decay_to_zero:
+                    st.duplicate = 0.0
+                st.ignore *= p.source_decay
+                if st.ignore < p.decay_to_zero:
+                    st.ignore = 0.0
+                st.reject *= p.source_decay
+                if st.reject < p.decay_to_zero:
+                    st.reject = 0.0
+            elif st.expire < now:
+                del self.ip_stats[ip]
+
+    # -- RawTracer hooks ---------------------------------------------------
+
+    def add_peer(self, p: PeerID, proto: str) -> None:
+        self._get_peer_stats(p).connected += 1
+
+    def remove_peer(self, p: PeerID) -> None:
+        st = self._get_peer_stats(p)
+        st.connected -= 1
+        st.expire = self.clock() + self.params.retain_stats
+        del self.peer_stats[p]
+
+    def validate_message(self, msg: Message) -> None:
+        self.validate += 1
+
+    def deliver_message(self, msg: Message) -> None:
+        st = self._get_peer_stats(msg.received_from)
+        weight = self.params.topic_delivery_weights.get(msg.topic, 1.0)
+        st.deliver += weight
+
+    def reject_message(self, msg: Message, reason: str) -> None:
+        if reason in (REJECT_VALIDATION_QUEUE_FULL, REJECT_VALIDATION_THROTTLED):
+            self.last_throttle = self.clock()
+            self.throttle += 1
+        elif reason == REJECT_VALIDATION_IGNORED:
+            self._get_peer_stats(msg.received_from).ignore += 1
+        else:
+            self._get_peer_stats(msg.received_from).reject += 1
+
+    def duplicate_message(self, msg: Message) -> None:
+        self._get_peer_stats(msg.received_from).duplicate += 1

@@ -1,0 +1,578 @@
+"""Peer-score engine: GossipSub v1.1 reputation (P1-P7).
+
+Behavioral equivalent of the reference engine (/root/reference/score.go):
+per-peer, per-topic counters scored as
+
+    score(p) = min_cap(Σ_t w_t · (P1 + P2 + P3 + P3b + P4)) + P5 + P6 + P7
+
+with counter decay on a DecayInterval ticker, score retention for
+disconnected peers (only non-positive scores are retained — the anti
+score-reset defense), a delivery-record state machine crediting first and
+near-first deliverers, and IP colocation tracking with IPv6 /64
+aggregation.  The engine is itself a RawTracer: it learns everything it
+needs from the observability bus (the reference's key architectural idea,
+score.go:88).
+
+Time comes from an injectable clock so tests and the TPU simulator can run
+it on virtual time; the background decay loop is only spawned under a
+running event loop, and all maintenance entry points (``refresh_scores``,
+``refresh_ips``, ``gc_delivery_records``) are directly callable.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ipaddress
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .score_params import PeerScoreParams, TopicScoreParams
+from .trace import RawTracer
+from .types import (
+    Message,
+    MsgIdFunction,
+    PeerID,
+    REJECT_BLACKLISTED_PEER,
+    REJECT_BLACKLISTED_SOURCE,
+    REJECT_INVALID_SIGNATURE,
+    REJECT_MISSING_SIGNATURE,
+    REJECT_SELF_ORIGIN,
+    REJECT_UNEXPECTED_AUTH_INFO,
+    REJECT_UNEXPECTED_SIGNATURE,
+    REJECT_VALIDATION_IGNORED,
+    REJECT_VALIDATION_QUEUE_FULL,
+    REJECT_VALIDATION_THROTTLED,
+    TIME_CACHE_DURATION,
+    default_msg_id_fn,
+)
+
+# delivery-record status (reference score.go:108-118)
+DELIVERY_UNKNOWN = 0    # not yet validated
+DELIVERY_VALID = 1
+DELIVERY_INVALID = 2
+DELIVERY_IGNORED = 3    # validator said ignore: no penalty
+DELIVERY_THROTTLED = 4  # validation throttled: can't tell
+
+
+class _TopicStats:
+    __slots__ = ("in_mesh", "graft_time", "mesh_time",
+                 "first_message_deliveries", "mesh_message_deliveries",
+                 "mesh_message_deliveries_active", "mesh_failure_penalty",
+                 "invalid_message_deliveries")
+
+    def __init__(self):
+        self.in_mesh = False
+        self.graft_time = 0.0
+        self.mesh_time = 0.0
+        self.first_message_deliveries = 0.0
+        self.mesh_message_deliveries = 0.0
+        self.mesh_message_deliveries_active = False
+        self.mesh_failure_penalty = 0.0
+        self.invalid_message_deliveries = 0.0
+
+
+class _PeerStats:
+    __slots__ = ("connected", "expire", "topics", "ips", "ip_whitelist",
+                 "behaviour_penalty")
+
+    def __init__(self):
+        self.connected = False
+        self.expire = 0.0
+        self.topics: dict[str, _TopicStats] = {}
+        self.ips: list[str] = []
+        self.ip_whitelist: dict[str, bool] = {}
+        self.behaviour_penalty = 0.0
+
+    def get_topic_stats(self, topic: str,
+                        params: PeerScoreParams) -> Optional[_TopicStats]:
+        ts = self.topics.get(topic)
+        if ts is not None:
+            return ts
+        if topic not in params.topics:
+            return None  # unscored topic
+        ts = _TopicStats()
+        self.topics[topic] = ts
+        return ts
+
+
+class _DeliveryRecord:
+    __slots__ = ("status", "first_seen", "validated", "peers")
+
+    def __init__(self, first_seen: float):
+        self.status = DELIVERY_UNKNOWN
+        self.first_seen = first_seen
+        self.validated = 0.0
+        self.peers: Optional[set[PeerID]] = set()
+
+
+class _MessageDeliveries:
+    """Delivery records with FIFO TTL expiry (reference score.go:91-106)."""
+
+    def __init__(self, ttl: float = TIME_CACHE_DURATION):
+        self.records: dict[bytes, _DeliveryRecord] = {}
+        self.queue: list[tuple[bytes, float]] = []
+        self._head = 0
+        self.ttl = ttl
+
+    def get_record(self, mid: bytes, now: float) -> _DeliveryRecord:
+        rec = self.records.get(mid)
+        if rec is not None:
+            return rec
+        rec = _DeliveryRecord(first_seen=now)
+        self.records[mid] = rec
+        self.queue.append((mid, now + self.ttl))
+        return rec
+
+    def gc(self, now: float) -> None:
+        q = self.queue
+        while self._head < len(q) and now > q[self._head][1]:
+            self.records.pop(q[self._head][0], None)
+            self._head += 1
+        if self._head:
+            del q[:self._head]
+            self._head = 0
+
+
+@dataclass
+class TopicScoreSnapshot:
+    time_in_mesh: float = 0.0
+    first_message_deliveries: float = 0.0
+    mesh_message_deliveries: float = 0.0
+    invalid_message_deliveries: float = 0.0
+
+
+@dataclass
+class PeerScoreSnapshot:
+    score: float = 0.0
+    topics: dict[str, TopicScoreSnapshot] = field(default_factory=dict)
+    app_specific_score: float = 0.0
+    ip_colocation_factor: float = 0.0
+    behaviour_penalty: float = 0.0
+
+
+class PeerScore(RawTracer):
+    """The score engine; attach via ``with_peer_score`` / gossipsub's
+    ``score_params=`` option (reference WithPeerScore, gossipsub.go:258)."""
+
+    def __init__(self, params: PeerScoreParams, *,
+                 msg_id_fn: MsgIdFunction = default_msg_id_fn,
+                 clock: Optional[Callable[[], float]] = None,
+                 inspect: Optional[Callable] = None,
+                 inspect_extended: bool = False,
+                 inspect_period: float = 1.0):
+        params.validate()
+        self.params = params
+        self.peer_stats: dict[PeerID, _PeerStats] = {}
+        self.peer_ips: dict[str, set[PeerID]] = {}
+        self.deliveries = _MessageDeliveries()
+        self.msg_id = msg_id_fn
+        self.clock = clock or time.monotonic
+        self.host = None
+        self.inspect = inspect
+        self.inspect_extended = inspect_extended
+        self.inspect_period = inspect_period
+        self._whitelist_nets = [ipaddress.ip_network(c)
+                                for c in params.ip_colocation_factor_whitelist]
+
+    # -- router interface (ScoreInterface) ---------------------------------
+
+    def start(self, gs) -> None:
+        self.msg_id = gs.ps.msg_id
+        self.host = gs.ps.host
+        self.clock = gs.ps.clock
+        gs.ps._tasks.add(asyncio.ensure_future(self._background()))
+
+    def score(self, p: PeerID) -> float:
+        pstats = self.peer_stats.get(p)
+        if pstats is None:
+            return 0.0
+
+        score = 0.0
+        for topic, tstats in pstats.topics.items():
+            tp = self.params.topics.get(topic)
+            if tp is None:
+                continue
+            topic_score = 0.0
+
+            # P1: time in mesh
+            if tstats.in_mesh:
+                p1 = min(tstats.mesh_time / tp.time_in_mesh_quantum,
+                         tp.time_in_mesh_cap)
+                topic_score += p1 * tp.time_in_mesh_weight
+
+            # P2: first message deliveries
+            topic_score += (tstats.first_message_deliveries
+                            * tp.first_message_deliveries_weight)
+
+            # P3: mesh message delivery deficit (squared)
+            if (tstats.mesh_message_deliveries_active
+                    and tstats.mesh_message_deliveries
+                    < tp.mesh_message_deliveries_threshold):
+                deficit = (tp.mesh_message_deliveries_threshold
+                           - tstats.mesh_message_deliveries)
+                topic_score += deficit * deficit * tp.mesh_message_deliveries_weight
+
+            # P3b: sticky mesh failure (weight negative)
+            topic_score += (tstats.mesh_failure_penalty
+                            * tp.mesh_failure_penalty_weight)
+
+            # P4: invalid messages (squared, weight negative)
+            p4 = tstats.invalid_message_deliveries ** 2
+            topic_score += p4 * tp.invalid_message_deliveries_weight
+
+            score += topic_score * tp.topic_weight
+
+        if 0 < self.params.topic_score_cap < score:
+            score = self.params.topic_score_cap
+
+        # P5: application-specific
+        score += (self.params.app_specific_score(p)
+                  * self.params.app_specific_weight)
+
+        # P6: IP colocation (squared surplus over threshold, weight negative)
+        score += self._ip_colocation_factor(pstats) * self.params.ip_colocation_factor_weight
+
+        # P7: behavioural penalty (squared excess over threshold, weight negative)
+        if pstats.behaviour_penalty > self.params.behaviour_penalty_threshold:
+            excess = pstats.behaviour_penalty - self.params.behaviour_penalty_threshold
+            score += excess * excess * self.params.behaviour_penalty_weight
+
+        return score
+
+    def add_penalty(self, p: PeerID, count: int) -> None:
+        pstats = self.peer_stats.get(p)
+        if pstats is not None:
+            pstats.behaviour_penalty += count
+
+    # -- P6 helpers --------------------------------------------------------
+
+    def _ip_colocation_factor(self, pstats: _PeerStats) -> float:
+        result = 0.0
+        for ip in pstats.ips:
+            if self._whitelist_nets:
+                whitelisted = pstats.ip_whitelist.get(ip)
+                if whitelisted is None:
+                    try:
+                        addr = ipaddress.ip_address(ip.split("/")[0])
+                        whitelisted = any(addr in net for net in self._whitelist_nets)
+                    except ValueError:
+                        whitelisted = False
+                    pstats.ip_whitelist[ip] = whitelisted
+                if whitelisted:
+                    continue
+            # cliff at the threshold, then quadratic
+            peers_in_ip = len(self.peer_ips.get(ip, ()))
+            if peers_in_ip > self.params.ip_colocation_factor_threshold:
+                surplus = peers_in_ip - self.params.ip_colocation_factor_threshold
+                result += surplus * surplus
+        return result
+
+    def get_ips(self, p: PeerID) -> list[str]:
+        """Current IPs of a peer's connections; IPv6 also contributes its /64
+        subnet so sybils within one allocation share fate
+        (reference score.go:967-1007).  host=None tolerated for unit tests."""
+        if self.host is None:
+            return []
+        res = []
+        for conn in self.host.conns.get(p, ()):
+            ip = getattr(conn.remote_host(self.host.id), "ip", "")
+            if not ip:
+                continue
+            try:
+                addr = ipaddress.ip_address(ip)
+            except ValueError:
+                continue
+            if addr.is_loopback:
+                continue  # loopback is unit-test traffic
+            res.append(ip)
+            if addr.version == 6:
+                net64 = ipaddress.ip_network(f"{ip}/64", strict=False)
+                res.append(str(net64.network_address))
+        return res
+
+    def set_ips(self, p: PeerID, newips: list[str], oldips: list[str]) -> None:
+        for ip in newips:
+            if ip not in oldips:
+                self.peer_ips.setdefault(ip, set()).add(p)
+        for ip in oldips:
+            if ip not in newips:
+                peers = self.peer_ips.get(ip)
+                if peers is not None:
+                    peers.discard(p)
+                    if not peers:
+                        del self.peer_ips[ip]
+
+    def _remove_ips(self, p: PeerID, ips: list[str]) -> None:
+        self.set_ips(p, [], ips)
+
+    # -- periodic maintenance ----------------------------------------------
+
+    async def _background(self) -> None:
+        next_refresh = next_aux = next_inspect = self.clock()
+        while True:
+            await asyncio.sleep(min(self.params.decay_interval, 1.0))
+            now = self.clock()
+            if now >= next_refresh:
+                self.refresh_scores()
+                next_refresh = now + self.params.decay_interval
+            if now >= next_aux:
+                self.refresh_ips()
+                self.gc_delivery_records()
+                next_aux = now + 60.0
+            if self.inspect is not None and now >= next_inspect:
+                self.inspect_scores()
+                next_inspect = now + self.inspect_period
+
+    def refresh_scores(self) -> None:
+        """Decay counters; purge disconnected peers past retention
+        (reference score.go:495-556)."""
+        now = self.clock()
+        to_zero = self.params.decay_to_zero
+        for p in list(self.peer_stats):
+            pstats = self.peer_stats[p]
+            if not pstats.connected:
+                if now > pstats.expire:
+                    self._remove_ips(p, pstats.ips)
+                    del self.peer_stats[p]
+                # retained scores don't decay: disconnect/reconnect can't
+                # launder a negative score
+                continue
+
+            for topic, tstats in pstats.topics.items():
+                tp = self.params.topics.get(topic)
+                if tp is None:
+                    continue
+                tstats.first_message_deliveries *= tp.first_message_deliveries_decay
+                if tstats.first_message_deliveries < to_zero:
+                    tstats.first_message_deliveries = 0.0
+                tstats.mesh_message_deliveries *= tp.mesh_message_deliveries_decay
+                if tstats.mesh_message_deliveries < to_zero:
+                    tstats.mesh_message_deliveries = 0.0
+                tstats.mesh_failure_penalty *= tp.mesh_failure_penalty_decay
+                if tstats.mesh_failure_penalty < to_zero:
+                    tstats.mesh_failure_penalty = 0.0
+                tstats.invalid_message_deliveries *= tp.invalid_message_deliveries_decay
+                if tstats.invalid_message_deliveries < to_zero:
+                    tstats.invalid_message_deliveries = 0.0
+                if tstats.in_mesh:
+                    tstats.mesh_time = now - tstats.graft_time
+                    if tstats.mesh_time > tp.mesh_message_deliveries_activation:
+                        tstats.mesh_message_deliveries_active = True
+
+            pstats.behaviour_penalty *= self.params.behaviour_penalty_decay
+            if pstats.behaviour_penalty < to_zero:
+                pstats.behaviour_penalty = 0.0
+
+    def refresh_ips(self) -> None:
+        for p, pstats in self.peer_stats.items():
+            if pstats.connected:
+                ips = self.get_ips(p)
+                self.set_ips(p, ips, pstats.ips)
+                pstats.ips = ips
+
+    def gc_delivery_records(self) -> None:
+        self.deliveries.gc(self.clock())
+
+    def inspect_scores(self) -> None:
+        if self.inspect is None:
+            return
+        if self.inspect_extended:
+            out = {}
+            for p, pstats in self.peer_stats.items():
+                snap = PeerScoreSnapshot(
+                    score=self.score(p),
+                    app_specific_score=self.params.app_specific_score(p),
+                    ip_colocation_factor=self._ip_colocation_factor(pstats),
+                    behaviour_penalty=pstats.behaviour_penalty)
+                for t, ts in pstats.topics.items():
+                    snap.topics[t] = TopicScoreSnapshot(
+                        time_in_mesh=ts.mesh_time if ts.in_mesh else 0.0,
+                        first_message_deliveries=ts.first_message_deliveries,
+                        mesh_message_deliveries=ts.mesh_message_deliveries,
+                        invalid_message_deliveries=ts.invalid_message_deliveries)
+                out[p] = snap
+            self.inspect(out)
+        else:
+            self.inspect({p: self.score(p) for p in self.peer_stats})
+
+    def set_topic_score_params(self, topic: str, tp: TopicScoreParams) -> None:
+        """Live re-parameterization with counter re-capping
+        (reference score.go:192-232)."""
+        old = self.params.topics.get(topic)
+        self.params.topics[topic] = tp
+        if old is None:
+            return
+        recap = (tp.first_message_deliveries_cap < old.first_message_deliveries_cap
+                 or tp.mesh_message_deliveries_cap < old.mesh_message_deliveries_cap)
+        if not recap:
+            return
+        for pstats in self.peer_stats.values():
+            ts = pstats.topics.get(topic)
+            if ts is None:
+                continue
+            ts.first_message_deliveries = min(ts.first_message_deliveries,
+                                              tp.first_message_deliveries_cap)
+            ts.mesh_message_deliveries = min(ts.mesh_message_deliveries,
+                                             tp.mesh_message_deliveries_cap)
+
+    # -- RawTracer hooks (the bus doubles as the wiring) -------------------
+
+    def add_peer(self, p: PeerID, proto: str) -> None:
+        pstats = self.peer_stats.setdefault(p, _PeerStats())
+        pstats.connected = True
+        ips = self.get_ips(p)
+        self.set_ips(p, ips, pstats.ips)
+        pstats.ips = ips
+
+    def remove_peer(self, p: PeerID) -> None:
+        pstats = self.peer_stats.get(p)
+        if pstats is None:
+            return
+        # only non-positive scores are retained, to dissuade attacks on the
+        # score function; a clean peer forgets nothing of value
+        if self.score(p) > 0:
+            self._remove_ips(p, pstats.ips)
+            del self.peer_stats[p]
+            return
+        # retained: reset P2 and apply the sticky mesh-failure penalty
+        for topic, tstats in pstats.topics.items():
+            tstats.first_message_deliveries = 0.0
+            threshold = self.params.topics[topic].mesh_message_deliveries_threshold
+            if (tstats.in_mesh and tstats.mesh_message_deliveries_active
+                    and tstats.mesh_message_deliveries < threshold):
+                deficit = threshold - tstats.mesh_message_deliveries
+                tstats.mesh_failure_penalty += deficit * deficit
+            tstats.in_mesh = False
+        pstats.connected = False
+        pstats.expire = self.clock() + self.params.retain_score
+
+    def graft(self, p: PeerID, topic: str) -> None:
+        pstats = self.peer_stats.get(p)
+        if pstats is None:
+            return
+        tstats = pstats.get_topic_stats(topic, self.params)
+        if tstats is None:
+            return
+        tstats.in_mesh = True
+        tstats.graft_time = self.clock()
+        tstats.mesh_time = 0.0
+        tstats.mesh_message_deliveries_active = False
+
+    def prune(self, p: PeerID, topic: str) -> None:
+        pstats = self.peer_stats.get(p)
+        if pstats is None:
+            return
+        tstats = pstats.get_topic_stats(topic, self.params)
+        if tstats is None:
+            return
+        # sticky mesh delivery rate failure penalty
+        threshold = self.params.topics[topic].mesh_message_deliveries_threshold
+        if (tstats.mesh_message_deliveries_active
+                and tstats.mesh_message_deliveries < threshold):
+            deficit = threshold - tstats.mesh_message_deliveries
+            tstats.mesh_failure_penalty += deficit * deficit
+        tstats.in_mesh = False
+
+    def validate_message(self, msg: Message) -> None:
+        # create the record now so first_seen is the pipeline entry time
+        self.deliveries.get_record(self.msg_id(msg.rpc), self.clock())
+
+    def deliver_message(self, msg: Message) -> None:
+        self._mark_first_message_delivery(msg.received_from, msg)
+        drec = self.deliveries.get_record(self.msg_id(msg.rpc), self.clock())
+        if drec.status != DELIVERY_UNKNOWN:
+            return  # defensive: not the first delivery trace
+        drec.status = DELIVERY_VALID
+        drec.validated = self.clock()
+        for p in drec.peers:
+            # near-first deliverers (forwarded while we validated) get mesh
+            # delivery credit; the sender can't double-count itself
+            if p != msg.received_from:
+                self._mark_duplicate_message_delivery(p, msg, 0.0)
+
+    def reject_message(self, msg: Message, reason: str) -> None:
+        if reason in (REJECT_MISSING_SIGNATURE, REJECT_INVALID_SIGNATURE,
+                      REJECT_UNEXPECTED_SIGNATURE, REJECT_UNEXPECTED_AUTH_INFO,
+                      REJECT_SELF_ORIGIN):
+            # no delivery tracking, but clearly invalid: penalize
+            self._mark_invalid_message_delivery(msg.received_from, msg)
+            return
+        if reason in (REJECT_BLACKLISTED_PEER, REJECT_BLACKLISTED_SOURCE,
+                      REJECT_VALIDATION_QUEUE_FULL):
+            return  # not a validity judgement
+
+        drec = self.deliveries.get_record(self.msg_id(msg.rpc), self.clock())
+        if drec.status != DELIVERY_UNKNOWN:
+            return
+
+        if reason == REJECT_VALIDATION_THROTTLED:
+            drec.status = DELIVERY_THROTTLED
+            drec.peers = None
+            return
+        if reason == REJECT_VALIDATION_IGNORED:
+            drec.status = DELIVERY_IGNORED
+            drec.peers = None
+            return
+
+        drec.status = DELIVERY_INVALID
+        self._mark_invalid_message_delivery(msg.received_from, msg)
+        for p in drec.peers:
+            self._mark_invalid_message_delivery(p, msg)
+        drec.peers = None
+
+    def duplicate_message(self, msg: Message) -> None:
+        drec = self.deliveries.get_record(self.msg_id(msg.rpc), self.clock())
+        src = msg.received_from
+        if drec.peers is not None and src in drec.peers:
+            return  # already seen this duplicate
+
+        if drec.status == DELIVERY_UNKNOWN:
+            drec.peers.add(src)  # await the Deliver/Reject verdict
+        elif drec.status == DELIVERY_VALID:
+            drec.peers.add(src)
+            self._mark_duplicate_message_delivery(src, msg, drec.validated)
+        elif drec.status == DELIVERY_INVALID:
+            self._mark_invalid_message_delivery(src, msg)
+        # throttled/ignored: we can't tell, do nothing
+
+    # -- counter marks ------------------------------------------------------
+
+    def _mark_invalid_message_delivery(self, p: PeerID, msg: Message) -> None:
+        pstats = self.peer_stats.get(p)
+        if pstats is None:
+            return
+        tstats = pstats.get_topic_stats(msg.topic, self.params)
+        if tstats is None:
+            return
+        tstats.invalid_message_deliveries += 1
+
+    def _mark_first_message_delivery(self, p: PeerID, msg: Message) -> None:
+        pstats = self.peer_stats.get(p)
+        if pstats is None:
+            return
+        tstats = pstats.get_topic_stats(msg.topic, self.params)
+        if tstats is None:
+            return
+        tp = self.params.topics[msg.topic]
+        tstats.first_message_deliveries = min(
+            tstats.first_message_deliveries + 1, tp.first_message_deliveries_cap)
+        if tstats.in_mesh:
+            tstats.mesh_message_deliveries = min(
+                tstats.mesh_message_deliveries + 1, tp.mesh_message_deliveries_cap)
+
+    def _mark_duplicate_message_delivery(self, p: PeerID, msg: Message,
+                                         validated: float) -> None:
+        pstats = self.peer_stats.get(p)
+        if pstats is None:
+            return
+        tstats = pstats.get_topic_stats(msg.topic, self.params)
+        if tstats is None or not tstats.in_mesh:
+            return
+        tp = self.params.topics[msg.topic]
+        # validated == 0 means the duplicate arrived during validation —
+        # inside the window by definition
+        if validated and self.clock() - validated > tp.mesh_message_deliveries_window:
+            return
+        tstats.mesh_message_deliveries = min(
+            tstats.mesh_message_deliveries + 1, tp.mesh_message_deliveries_cap)

@@ -1,0 +1,226 @@
+"""Peer-score parameter schema with validated invariants.
+
+Semantics mirror the reference parameter system
+(/root/reference/score_params.go:12-293): per-topic parameter structs for
+P1-P4, global parameters for P5-P7 plus decay configuration, and the
+threshold set the router consults.  Every sign/range invariant the
+reference validates is validated here too — the invariants double as free
+tests.  Durations are float seconds (the protocol core's clock unit).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .types import PeerID
+
+DEFAULT_DECAY_INTERVAL = 1.0
+DEFAULT_DECAY_TO_ZERO = 0.01
+
+
+def _bad(x: float) -> bool:
+    return math.isnan(x) or math.isinf(x)
+
+
+@dataclass
+class PeerScoreThresholds:
+    """Score thresholds wired into the router (reference score_params.go:12-52)."""
+
+    gossip_threshold: float = 0.0
+    publish_threshold: float = 0.0
+    graylist_threshold: float = 0.0
+    accept_px_threshold: float = 0.0
+    opportunistic_graft_threshold: float = 0.0
+
+    def validate(self) -> None:
+        if self.gossip_threshold > 0 or _bad(self.gossip_threshold):
+            raise ValueError("invalid gossip threshold; it must be <= 0")
+        if (self.publish_threshold > 0 or _bad(self.publish_threshold)
+                or self.publish_threshold > self.gossip_threshold):
+            raise ValueError(
+                "invalid publish threshold; it must be <= 0 and <= gossip threshold")
+        if (self.graylist_threshold > 0 or _bad(self.graylist_threshold)
+                or self.graylist_threshold > self.publish_threshold):
+            raise ValueError(
+                "invalid graylist threshold; it must be <= 0 and <= publish threshold")
+        if self.accept_px_threshold < 0 or _bad(self.accept_px_threshold):
+            raise ValueError("invalid accept PX threshold; it must be >= 0")
+        if (self.opportunistic_graft_threshold < 0
+                or _bad(self.opportunistic_graft_threshold)):
+            raise ValueError(
+                "invalid opportunistic grafting threshold; it must be >= 0")
+
+
+@dataclass
+class TopicScoreParams:
+    """Per-topic P1-P4 parameters (reference score_params.go:98-148)."""
+
+    topic_weight: float = 0.0
+
+    # P1: time in mesh (value = min(mesh_time/quantum, cap); weight >= 0)
+    time_in_mesh_weight: float = 0.0
+    time_in_mesh_quantum: float = 1.0
+    time_in_mesh_cap: float = 0.0
+
+    # P2: first message deliveries (decaying counter, capped; weight >= 0)
+    first_message_deliveries_weight: float = 0.0
+    first_message_deliveries_decay: float = 0.0
+    first_message_deliveries_cap: float = 0.0
+
+    # P3: mesh message delivery deficit (squared below threshold; weight <= 0)
+    mesh_message_deliveries_weight: float = 0.0
+    mesh_message_deliveries_decay: float = 0.0
+    mesh_message_deliveries_cap: float = 0.0
+    mesh_message_deliveries_threshold: float = 0.0
+    mesh_message_deliveries_window: float = 0.0
+    mesh_message_deliveries_activation: float = 1.0
+
+    # P3b: sticky mesh propagation failure (weight <= 0)
+    mesh_failure_penalty_weight: float = 0.0
+    mesh_failure_penalty_decay: float = 0.0
+
+    # P4: invalid messages (squared counter; weight <= 0)
+    invalid_message_deliveries_weight: float = 0.0
+    invalid_message_deliveries_decay: float = 0.0
+
+    def validate(self) -> None:
+        if self.topic_weight < 0 or _bad(self.topic_weight):
+            raise ValueError("invalid topic weight; must be >= 0")
+
+        # P1
+        if self.time_in_mesh_quantum == 0:
+            raise ValueError("invalid TimeInMeshQuantum; must be non zero")
+        if self.time_in_mesh_weight < 0 or _bad(self.time_in_mesh_weight):
+            raise ValueError("invalid TimeInMeshWeight; must be positive (or 0 to disable)")
+        if self.time_in_mesh_weight != 0 and self.time_in_mesh_quantum <= 0:
+            raise ValueError("invalid TimeInMeshQuantum; must be positive")
+        if self.time_in_mesh_weight != 0 and (
+                self.time_in_mesh_cap <= 0 or _bad(self.time_in_mesh_cap)):
+            raise ValueError("invalid TimeInMeshCap; must be positive")
+
+        # P2
+        if (self.first_message_deliveries_weight < 0
+                or _bad(self.first_message_deliveries_weight)):
+            raise ValueError(
+                "invalid FirstMessageDeliveriesWeight; must be positive (or 0 to disable)")
+        if self.first_message_deliveries_weight != 0:
+            if not (0 < self.first_message_deliveries_decay < 1) or _bad(
+                    self.first_message_deliveries_decay):
+                raise ValueError("invalid FirstMessageDeliveriesDecay; must be between 0 and 1")
+            if (self.first_message_deliveries_cap <= 0
+                    or _bad(self.first_message_deliveries_cap)):
+                raise ValueError("invalid FirstMessageDeliveriesCap; must be positive")
+
+        # P3
+        if (self.mesh_message_deliveries_weight > 0
+                or _bad(self.mesh_message_deliveries_weight)):
+            raise ValueError(
+                "invalid MeshMessageDeliveriesWeight; must be negative (or 0 to disable)")
+        if self.mesh_message_deliveries_weight != 0:
+            if not (0 < self.mesh_message_deliveries_decay < 1) or _bad(
+                    self.mesh_message_deliveries_decay):
+                raise ValueError("invalid MeshMessageDeliveriesDecay; must be between 0 and 1")
+            if (self.mesh_message_deliveries_cap <= 0
+                    or _bad(self.mesh_message_deliveries_cap)):
+                raise ValueError("invalid MeshMessageDeliveriesCap; must be positive")
+            if (self.mesh_message_deliveries_threshold <= 0
+                    or _bad(self.mesh_message_deliveries_threshold)):
+                raise ValueError("invalid MeshMessageDeliveriesThreshold; must be positive")
+            if self.mesh_message_deliveries_activation < 1.0:
+                raise ValueError("invalid MeshMessageDeliveriesActivation; must be at least 1s")
+        if self.mesh_message_deliveries_window < 0:
+            raise ValueError("invalid MeshMessageDeliveriesWindow; must be non-negative")
+
+        # P3b
+        if (self.mesh_failure_penalty_weight > 0
+                or _bad(self.mesh_failure_penalty_weight)):
+            raise ValueError(
+                "invalid MeshFailurePenaltyWeight; must be negative (or 0 to disable)")
+        if self.mesh_failure_penalty_weight != 0 and (
+                not (0 < self.mesh_failure_penalty_decay < 1)
+                or _bad(self.mesh_failure_penalty_decay)):
+            raise ValueError("invalid MeshFailurePenaltyDecay; must be between 0 and 1")
+
+        # P4
+        if (self.invalid_message_deliveries_weight > 0
+                or _bad(self.invalid_message_deliveries_weight)):
+            raise ValueError(
+                "invalid InvalidMessageDeliveriesWeight; must be negative (or 0 to disable)")
+        if not (0 < self.invalid_message_deliveries_decay < 1) or _bad(
+                self.invalid_message_deliveries_decay):
+            raise ValueError("invalid InvalidMessageDeliveriesDecay; must be between 0 and 1")
+
+
+@dataclass
+class PeerScoreParams:
+    """Global score parameters (reference score_params.go:53-96)."""
+
+    topics: dict[str, TopicScoreParams] = field(default_factory=dict)
+
+    # aggregate positive-topic-score cap (0 = no cap)
+    topic_score_cap: float = 0.0
+
+    # P5: application-specific score
+    app_specific_score: Optional[Callable[[PeerID], float]] = None
+    app_specific_weight: float = 0.0
+
+    # P6: IP colocation factor (squared surplus over threshold; weight <= 0)
+    ip_colocation_factor_weight: float = 0.0
+    ip_colocation_factor_threshold: int = 0
+    ip_colocation_factor_whitelist: list[str] = field(default_factory=list)  # CIDRs
+
+    # P7: behavioural pattern penalty (squared excess over threshold; weight <= 0)
+    behaviour_penalty_weight: float = 0.0
+    behaviour_penalty_threshold: float = 0.0
+    behaviour_penalty_decay: float = 0.0
+
+    decay_interval: float = DEFAULT_DECAY_INTERVAL
+    decay_to_zero: float = DEFAULT_DECAY_TO_ZERO
+    retain_score: float = 0.0
+
+    def validate(self) -> None:
+        for topic, tp in self.topics.items():
+            try:
+                tp.validate()
+            except ValueError as e:
+                raise ValueError(f"invalid score parameters for topic {topic}: {e}")
+
+        if self.topic_score_cap < 0 or _bad(self.topic_score_cap):
+            raise ValueError("invalid topic score cap; must be positive (or 0 for no cap)")
+
+        if self.app_specific_score is None:
+            raise ValueError("missing application specific score function")
+
+        if self.ip_colocation_factor_weight > 0 or _bad(self.ip_colocation_factor_weight):
+            raise ValueError(
+                "invalid IPColocationFactorWeight; must be negative (or 0 to disable)")
+        if (self.ip_colocation_factor_weight != 0
+                and self.ip_colocation_factor_threshold < 1):
+            raise ValueError("invalid IPColocationFactorThreshold; must be at least 1")
+
+        if self.behaviour_penalty_weight > 0 or _bad(self.behaviour_penalty_weight):
+            raise ValueError(
+                "invalid BehaviourPenaltyWeight; must be negative (or 0 to disable)")
+        if self.behaviour_penalty_weight != 0 and (
+                not (0 < self.behaviour_penalty_decay < 1)
+                or _bad(self.behaviour_penalty_decay)):
+            raise ValueError("invalid BehaviourPenaltyDecay; must be between 0 and 1")
+        if self.behaviour_penalty_threshold < 0 or _bad(self.behaviour_penalty_threshold):
+            raise ValueError("invalid BehaviourPenaltyThreshold; must be >= 0")
+
+        if self.decay_interval < 1.0:
+            raise ValueError("invalid DecayInterval; must be at least 1s")
+        if not (0 < self.decay_to_zero < 1) or _bad(self.decay_to_zero):
+            raise ValueError("invalid DecayToZero; must be between 0 and 1")
+
+
+def score_parameter_decay(decay: float, base: float = DEFAULT_DECAY_INTERVAL,
+                          decay_to_zero: float = DEFAULT_DECAY_TO_ZERO) -> float:
+    """Per-tick decay factor so a counter reaches ``decay_to_zero`` after
+    ``decay`` seconds of ``base``-second ticks (reference
+    score_params.go:277-287); ports directly to the TPU sim's per-tick
+    exponents (SURVEY.md §7.3)."""
+    ticks = decay / base
+    return decay_to_zero ** (1.0 / ticks)

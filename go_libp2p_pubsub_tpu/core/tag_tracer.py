@@ -1,0 +1,143 @@
+"""Tag tracer: feed the connection manager so pubsub-valuable connections
+survive pruning.
+
+Behavioral equivalent of the reference tracer (/root/reference/tag_tracer.go):
+protect direct peers and mesh peers; keep a decaying per-topic delivery tag
+bumped for the first deliverer of each message and for near-first deliverers
+(peers who forwarded a copy while we were still validating).  Tags cap at 15
+and decay by 1 every 10 minutes.  Our host's ConnManager (core/host.py)
+plays the role of libp2p's; decay ticks run on the injectable clock.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Callable, Optional
+
+from .trace import RawTracer
+from .types import (
+    Message,
+    MsgIdFunction,
+    PeerID,
+    REJECT_VALIDATION_FAILED,
+    REJECT_VALIDATION_IGNORED,
+    REJECT_VALIDATION_THROTTLED,
+    default_msg_id_fn,
+)
+
+GOSSIPSUB_CONN_TAG_BUMP_MESSAGE_DELIVERY = 1
+GOSSIPSUB_CONN_TAG_DECAY_INTERVAL = 10 * 60.0
+GOSSIPSUB_CONN_TAG_DECAY_AMOUNT = 1
+GOSSIPSUB_CONN_TAG_MESSAGE_DELIVERY_CAP = 15
+
+
+def _topic_tag(topic: str) -> str:
+    return f"pubsub:{topic}"
+
+
+def _delivery_tag(topic: str) -> str:
+    return f"pubsub-deliveries:{topic}"
+
+
+class TagTracer(RawTracer):
+    def __init__(self, *, msg_id_fn: MsgIdFunction = default_msg_id_fn,
+                 clock: Optional[Callable[[], float]] = None,
+                 decay_interval: float = GOSSIPSUB_CONN_TAG_DECAY_INTERVAL,
+                 decay_amount: int = GOSSIPSUB_CONN_TAG_DECAY_AMOUNT,
+                 cap: int = GOSSIPSUB_CONN_TAG_MESSAGE_DELIVERY_CAP):
+        self.msg_id = msg_id_fn
+        self.clock = clock or time.monotonic
+        self.decay_interval = decay_interval
+        self.decay_amount = decay_amount
+        self.cap = cap
+        self.cmgr = None
+        self.direct: set[PeerID] = set()
+        # registered decaying delivery tags: topic -> {peer: value}
+        self.decaying: dict[str, dict[PeerID, int]] = {}
+        # msg id -> peers who delivered during validation (near-first)
+        self.near_first: dict[bytes, set[PeerID]] = {}
+
+    # -- router interface --------------------------------------------------
+
+    def start(self, gs) -> None:
+        self.msg_id = gs.ps.msg_id
+        self.clock = gs.ps.clock
+        self.cmgr = gs.ps.host.conn_manager
+        self.direct = gs.direct
+        gs.ps._tasks.add(asyncio.ensure_future(self._background()))
+
+    async def _background(self) -> None:
+        while True:
+            await asyncio.sleep(self.decay_interval)
+            self.decay()
+
+    def decay(self) -> None:
+        """One decay tick for all registered delivery tags."""
+        for topic, values in self.decaying.items():
+            tag = _delivery_tag(topic)
+            for p in list(values):
+                values[p] -= self.decay_amount
+                if values[p] <= 0:
+                    del values[p]
+                    if self.cmgr is not None:
+                        self.cmgr.untag_peer(p, tag)
+                elif self.cmgr is not None:
+                    self.cmgr.upsert_tag(p, tag, lambda _, v=values[p]: v)
+
+    def _bump(self, p: PeerID, topic: str) -> None:
+        values = self.decaying.get(topic)
+        if values is None:
+            return  # no tag registered (not joined)
+        values[p] = min(values.get(p, 0) + GOSSIPSUB_CONN_TAG_BUMP_MESSAGE_DELIVERY,
+                        self.cap)
+        if self.cmgr is not None:
+            self.cmgr.upsert_tag(p, _delivery_tag(topic), lambda _, v=values[p]: v)
+
+    # -- RawTracer hooks ---------------------------------------------------
+
+    def add_peer(self, p: PeerID, proto: str) -> None:
+        if p in self.direct and self.cmgr is not None:
+            self.cmgr.protect(p, "pubsub:<direct>")
+
+    def join(self, topic: str) -> None:
+        self.decaying.setdefault(topic, {})
+
+    def leave(self, topic: str) -> None:
+        values = self.decaying.pop(topic, None)
+        if values and self.cmgr is not None:
+            tag = _delivery_tag(topic)
+            for p in values:
+                self.cmgr.untag_peer(p, tag)
+
+    def graft(self, p: PeerID, topic: str) -> None:
+        if self.cmgr is not None:
+            self.cmgr.protect(p, _topic_tag(topic))
+
+    def prune(self, p: PeerID, topic: str) -> None:
+        if self.cmgr is not None:
+            self.cmgr.unprotect(p, _topic_tag(topic))
+
+    def validate_message(self, msg: Message) -> None:
+        # start tracking near-first deliverers for this message
+        self.near_first.setdefault(self.msg_id(msg.rpc), set())
+
+    def duplicate_message(self, msg: Message) -> None:
+        peers = self.near_first.get(self.msg_id(msg.rpc))
+        if peers is not None:
+            peers.add(msg.received_from)
+
+    def deliver_message(self, msg: Message) -> None:
+        mid = self.msg_id(msg.rpc)
+        near_first = self.near_first.pop(mid, set())
+        self._bump(msg.received_from, msg.topic)
+        for p in near_first:
+            if p != msg.received_from:
+                self._bump(p, msg.topic)
+
+    def reject_message(self, msg: Message, reason: str) -> None:
+        # only clear state for messages that actually entered validation;
+        # pre-queue rejections may still be validating another copy
+        if reason in (REJECT_VALIDATION_THROTTLED, REJECT_VALIDATION_IGNORED,
+                      REJECT_VALIDATION_FAILED):
+            self.near_first.pop(self.msg_id(msg.rpc), None)

@@ -1,0 +1,342 @@
+"""Unit tests for the v1.1 hardening engines: peer gater, gossip promise
+tracker, and tag tracer (reference peer_gater_test.go, gossip_tracer_test.go,
+tag_tracer tests in gossipsub_connmgr_test.go)."""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from go_libp2p_pubsub_tpu.core import (
+    AcceptStatus,
+    GossipTracer,
+    Message,
+    PeerGater,
+    PeerGaterParams,
+    PeerID,
+    TagTracer,
+)
+from go_libp2p_pubsub_tpu.core.host import ConnManager
+from go_libp2p_pubsub_tpu.core.types import (
+    REJECT_INVALID_SIGNATURE,
+    REJECT_VALIDATION_FAILED,
+    REJECT_VALIDATION_IGNORED,
+    REJECT_VALIDATION_THROTTLED,
+)
+from go_libp2p_pubsub_tpu.pb import rpc as pb
+
+TOPIC = "test"
+
+
+class Clock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def mk_msg(seq: int, frm: PeerID, topic: str = TOPIC) -> Message:
+    return Message(pb.PubMessage(from_peer=b"owner", data=b"x", topic=topic,
+                                 seqno=seq.to_bytes(8, "big")),
+                   received_from=frm)
+
+
+# -- peer gater ------------------------------------------------------------
+
+
+def mk_gater(clock, rng=None, **kw):
+    params = PeerGaterParams(decay_to_zero=0.01, quiet=60.0, **kw)
+    return PeerGater(params, clock=clock, rng=rng or random.Random(0),
+                     get_ip=lambda p: "1.2.3.4")
+
+
+def test_gater_inactive_by_default():
+    pg = mk_gater(Clock())
+    assert pg.accept_from(PeerID(b"A")) == AcceptStatus.ALL
+
+
+def test_gater_activates_on_throttle_and_gates_bad_peers():
+    clock = Clock()
+    # rng that always gates (random() -> just below 1)
+    class AlwaysGate(random.Random):
+        def random(self):
+            return 0.999999
+
+    pg = mk_gater(clock, rng=AlwaysGate())
+    bad = PeerID(b"B")
+    pg.add_peer(bad, "/meshsub/1.1.0")
+
+    # drive the throttle/validate ratio above threshold (0.33)
+    for i in range(10):
+        pg.validate_message(mk_msg(i, bad))
+        pg.reject_message(mk_msg(i, bad), REJECT_VALIDATION_THROTTLED)
+
+    # the bad peer has rejections on its record -> gated to CONTROL
+    pg.reject_message(mk_msg(100, bad), REJECT_VALIDATION_FAILED)
+    assert pg.accept_from(bad) == AcceptStatus.CONTROL
+
+    # a peer with no stats at its IP...is the same IP here; use a fresh gater
+    # for the no-stats case
+    pg2 = mk_gater(clock, rng=AlwaysGate())
+    for i in range(10):
+        pg2.validate_message(mk_msg(i, bad))
+        pg2.reject_message(mk_msg(i, bad), REJECT_VALIDATION_THROTTLED)
+    clean = PeerID(b"C")
+    assert pg2.accept_from(clean) == AcceptStatus.ALL  # total == 0
+
+
+def test_gater_goodput_probability():
+    """A peer with deliveries is accepted with probability
+    (1+deliver)/(1+total)."""
+    clock = Clock()
+
+    class FixedRng(random.Random):
+        value = 0.5
+
+        def random(self):
+            return self.value
+
+    rng = FixedRng()
+    pg = mk_gater(clock, rng=rng)
+    p = PeerID(b"A")
+    pg.add_peer(p, "/meshsub/1.1.0")
+    for i in range(10):
+        pg.validate_message(mk_msg(i, p))
+        pg.reject_message(mk_msg(i, p), REJECT_VALIDATION_THROTTLED)
+    # 3 deliveries, 1 reject (weight 16): threshold = 4/(1+3+16) = 0.2
+    for i in range(3):
+        pg.deliver_message(mk_msg(i, p))
+    pg.reject_message(mk_msg(50, p), REJECT_VALIDATION_FAILED)
+
+    rng.value = 0.19
+    assert pg.accept_from(p) == AcceptStatus.ALL
+    rng.value = 0.21
+    assert pg.accept_from(p) == AcceptStatus.CONTROL
+
+
+def test_gater_quiet_period_deactivates():
+    clock = Clock()
+
+    class AlwaysGate(random.Random):
+        def random(self):
+            return 0.999999
+
+    pg = mk_gater(clock, rng=AlwaysGate())
+    p = PeerID(b"A")
+    pg.add_peer(p, "/meshsub/1.1.0")
+    for i in range(10):
+        pg.validate_message(mk_msg(i, p))
+        pg.reject_message(mk_msg(i, p), REJECT_VALIDATION_THROTTLED)
+    pg.reject_message(mk_msg(99, p), REJECT_VALIDATION_FAILED)
+    assert pg.accept_from(p) == AcceptStatus.CONTROL
+    clock.advance(61.0)  # past quiet
+    assert pg.accept_from(p) == AcceptStatus.ALL
+
+
+def test_gater_ip_shared_fate():
+    """Two peers behind one IP share one stats record."""
+    pg = mk_gater(Clock())
+    a, b = PeerID(b"A"), PeerID(b"B")
+    pg.add_peer(a, "/meshsub/1.1.0")
+    pg.add_peer(b, "/meshsub/1.1.0")
+    pg.deliver_message(mk_msg(1, a))
+    assert pg._get_peer_stats(b).deliver == 1.0
+
+
+def test_gater_decay_and_retention():
+    clock = Clock()
+    pg = mk_gater(clock)
+    p = PeerID(b"A")
+    pg.add_peer(p, "/meshsub/1.1.0")
+    pg.deliver_message(mk_msg(1, p))
+    pg.validate_message(mk_msg(1, p))
+    st = pg._get_peer_stats(p)
+    before = st.deliver
+    pg.decay_stats()
+    assert 0 < st.deliver < before
+    # disconnected stats expire after retain_stats
+    pg.remove_peer(p)
+    assert p not in pg.peer_stats
+    clock.advance(pg.params.retain_stats + 1)
+    pg.decay_stats()
+    assert "1.2.3.4" not in pg.ip_stats
+
+
+def test_gater_ignore_weight():
+    clock = Clock()
+
+    class FixedRng(random.Random):
+        value = 0.5
+
+        def random(self):
+            return self.value
+
+    rng = FixedRng()
+    pg = mk_gater(clock, rng=rng)
+    p = PeerID(b"A")
+    pg.add_peer(p, "/meshsub/1.1.0")
+    for i in range(10):
+        pg.validate_message(mk_msg(i, p))
+        pg.reject_message(mk_msg(i, p), REJECT_VALIDATION_THROTTLED)
+    pg.reject_message(mk_msg(20, p), REJECT_VALIDATION_IGNORED)
+    # 0 deliveries, 1 ignore (weight 1): threshold = 1/2
+    rng.value = 0.49
+    assert pg.accept_from(p) == AcceptStatus.ALL
+    rng.value = 0.51
+    assert pg.accept_from(p) == AcceptStatus.CONTROL
+
+
+# -- gossip promise tracker ------------------------------------------------
+
+
+def test_promise_broken_after_followup():
+    clock = Clock()
+    gt = GossipTracer(follow_up_time=3.0, clock=clock, rng=random.Random(0))
+    p = PeerID(b"A")
+    mids = [b"m1", b"m2", b"m3"]
+    gt.add_promise(p, mids)
+    assert gt.get_broken_promises() == {}
+    clock.advance(4.0)
+    assert gt.get_broken_promises() == {p: 1}
+    # and the promise is consumed
+    assert gt.get_broken_promises() == {}
+
+
+def test_promise_fulfilled_by_delivery():
+    clock = Clock()
+    gt = GossipTracer(follow_up_time=3.0, clock=clock, rng=random.Random(0))
+    p = PeerID(b"A")
+    msg = mk_msg(1, p)
+    mid = gt.msg_id(msg.rpc)
+    gt.add_promise(p, [mid])
+    gt.deliver_message(msg)
+    clock.advance(4.0)
+    assert gt.get_broken_promises() == {}
+
+
+def test_promise_fulfilled_on_validate_even_if_invalid():
+    clock = Clock()
+    gt = GossipTracer(follow_up_time=3.0, clock=clock, rng=random.Random(0))
+    p = PeerID(b"A")
+    msg = mk_msg(1, p)
+    mid = gt.msg_id(msg.rpc)
+    gt.add_promise(p, [mid])
+    gt.validate_message(msg)  # began validation: promise kept
+    clock.advance(4.0)
+    assert gt.get_broken_promises() == {}
+
+
+def test_promise_not_fulfilled_by_bogus_signature():
+    clock = Clock()
+    gt = GossipTracer(follow_up_time=3.0, clock=clock, rng=random.Random(0))
+    p = PeerID(b"A")
+    msg = mk_msg(1, p)
+    mid = gt.msg_id(msg.rpc)
+    gt.add_promise(p, [mid])
+    gt.reject_message(msg, REJECT_INVALID_SIGNATURE)
+    clock.advance(4.0)
+    assert gt.get_broken_promises() == {p: 1}
+
+
+def test_promise_voided_on_throttle():
+    clock = Clock()
+    gt = GossipTracer(follow_up_time=3.0, clock=clock, rng=random.Random(0))
+    p = PeerID(b"A")
+    gt.add_promise(p, [b"m1"])
+    gt.throttle_peer(p)
+    clock.advance(4.0)
+    assert gt.get_broken_promises() == {}
+
+
+# -- tag tracer ------------------------------------------------------------
+
+
+def mk_tag_tracer(clock):
+    tt = TagTracer(clock=clock)
+    tt.cmgr = ConnManager()
+    return tt
+
+
+def test_tag_tracer_mesh_protection():
+    tt = mk_tag_tracer(Clock())
+    p = PeerID(b"A")
+    tt.graft(p, TOPIC)
+    assert f"pubsub:{TOPIC}" in tt.cmgr.protected[p]
+    tt.prune(p, TOPIC)
+    assert p not in tt.cmgr.protected
+
+
+def test_tag_tracer_direct_peer_protection():
+    tt = mk_tag_tracer(Clock())
+    p = PeerID(b"A")
+    tt.direct = {p}
+    tt.add_peer(p, "/meshsub/1.1.0")
+    assert "pubsub:<direct>" in tt.cmgr.protected[p]
+
+
+def test_tag_tracer_delivery_bump_and_cap():
+    tt = mk_tag_tracer(Clock())
+    p = PeerID(b"A")
+    tt.join(TOPIC)
+    for i in range(20):
+        msg = mk_msg(i, p)
+        tt.validate_message(msg)
+        tt.deliver_message(msg)
+    assert tt.decaying[TOPIC][p] == 15  # capped
+    assert tt.cmgr.tags[p][f"pubsub-deliveries:{TOPIC}"] == 15
+
+
+def test_tag_tracer_near_first_bump():
+    tt = mk_tag_tracer(Clock())
+    a, b, late = PeerID(b"A"), PeerID(b"B"), PeerID(b"L")
+    tt.join(TOPIC)
+    msg = mk_msg(1, a)
+    tt.validate_message(msg)
+    tt.duplicate_message(mk_msg(1, b))      # during validation: near-first
+    tt.deliver_message(msg)
+    tt.duplicate_message(mk_msg(1, late))   # after delivery: no credit
+    assert tt.decaying[TOPIC] == {a: 1, b: 1}
+
+
+def test_tag_tracer_reject_clears_tracking():
+    tt = mk_tag_tracer(Clock())
+    a = PeerID(b"A")
+    tt.join(TOPIC)
+    msg = mk_msg(1, a)
+    tt.validate_message(msg)
+    tt.reject_message(msg, REJECT_VALIDATION_FAILED)
+    assert tt.near_first == {}
+
+
+def test_tag_tracer_decay():
+    tt = mk_tag_tracer(Clock())
+    p = PeerID(b"A")
+    tt.join(TOPIC)
+    for i in range(3):
+        msg = mk_msg(i, p)
+        tt.validate_message(msg)
+        tt.deliver_message(msg)
+    assert tt.decaying[TOPIC][p] == 3
+    tt.decay()
+    assert tt.decaying[TOPIC][p] == 2
+    tt.decay()
+    tt.decay()
+    assert p not in tt.decaying[TOPIC]
+    assert f"pubsub-deliveries:{TOPIC}" not in tt.cmgr.tags.get(p, {})
+
+
+def test_tag_tracer_leave_clears_tags():
+    tt = mk_tag_tracer(Clock())
+    p = PeerID(b"A")
+    tt.join(TOPIC)
+    msg = mk_msg(1, p)
+    tt.validate_message(msg)
+    tt.deliver_message(msg)
+    tt.leave(TOPIC)
+    assert TOPIC not in tt.decaying
+    assert f"pubsub-deliveries:{TOPIC}" not in tt.cmgr.tags.get(p, {})

@@ -1,0 +1,618 @@
+"""Peer-score engine unit tests.
+
+Drive the engine directly with synthetic peers and a virtual clock —
+the reference's pure-unit-test layer (score_test.go:13-1050): each score
+parameter P1..P7 has a dedicated test, plus decay, retention, delivery
+records, and parameter validation.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from go_libp2p_pubsub_tpu.core import (
+    PeerGaterParams,
+    PeerScore,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+    score_parameter_decay,
+)
+from go_libp2p_pubsub_tpu.core.score import (
+    DELIVERY_INVALID,
+    DELIVERY_VALID,
+)
+from go_libp2p_pubsub_tpu.core.types import (
+    Message,
+    PeerID,
+    REJECT_INVALID_SIGNATURE,
+    REJECT_VALIDATION_IGNORED,
+    REJECT_VALIDATION_QUEUE_FULL,
+    REJECT_VALIDATION_THROTTLED,
+)
+from go_libp2p_pubsub_tpu.pb import rpc as pb
+
+TOPIC = "test"
+
+
+class Clock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def mk_params(tp: TopicScoreParams, **kw) -> PeerScoreParams:
+    defaults = dict(topics={TOPIC: tp}, app_specific_score=lambda p: 0.0,
+                    decay_interval=1.0, decay_to_zero=0.01)
+    defaults.update(kw)
+    return PeerScoreParams(**defaults)
+
+
+def mk_msg(seq: int, topic: str = TOPIC, frm: bytes = b"owner") -> Message:
+    return Message(pb.PubMessage(from_peer=frm, data=b"x", topic=topic,
+                                 seqno=seq.to_bytes(8, "big")))
+
+
+def test_score_time_in_mesh():
+    tp = TopicScoreParams(topic_weight=0.5, time_in_mesh_weight=1.0,
+                          time_in_mesh_quantum=1.0, time_in_mesh_cap=3600.0,
+                          invalid_message_deliveries_decay=0.5)
+    clock = Clock()
+    ps = PeerScore(mk_params(tp), clock=clock)
+    pid = PeerID(b"A")
+    ps.add_peer(pid, "/meshsub/1.1.0")
+    assert ps.score(pid) == 0.0
+    ps.graft(pid, TOPIC)
+    elapsed = 200.0
+    clock.advance(elapsed)
+    ps.refresh_scores()
+    expected = tp.topic_weight * tp.time_in_mesh_weight * elapsed / tp.time_in_mesh_quantum
+    assert ps.score(pid) == pytest.approx(expected)
+
+
+def test_score_time_in_mesh_cap():
+    tp = TopicScoreParams(topic_weight=0.5, time_in_mesh_weight=1.0,
+                          time_in_mesh_quantum=1.0, time_in_mesh_cap=10.0,
+                          invalid_message_deliveries_decay=0.5)
+    clock = Clock()
+    ps = PeerScore(mk_params(tp), clock=clock)
+    pid = PeerID(b"A")
+    ps.add_peer(pid, "/meshsub/1.1.0")
+    ps.graft(pid, TOPIC)
+    clock.advance(1000.0)
+    ps.refresh_scores()
+    expected = tp.topic_weight * tp.time_in_mesh_weight * tp.time_in_mesh_cap
+    assert ps.score(pid) == pytest.approx(expected)
+
+
+def test_score_first_message_deliveries():
+    tp = TopicScoreParams(topic_weight=1.0, first_message_deliveries_weight=1.0,
+                          first_message_deliveries_decay=1.0 - 1e-9,
+                          first_message_deliveries_cap=2000.0,
+                          invalid_message_deliveries_decay=0.5)
+    ps = PeerScore(mk_params(tp), clock=Clock())
+    pid = PeerID(b"A")
+    ps.add_peer(pid, "/meshsub/1.1.0")
+    ps.graft(pid, TOPIC)
+    n = 100
+    for i in range(n):
+        msg = mk_msg(i)
+        msg.received_from = pid
+        ps.validate_message(msg)
+        ps.deliver_message(msg)
+    assert ps.score(pid) == pytest.approx(float(n))
+
+
+def test_score_first_message_deliveries_cap():
+    tp = TopicScoreParams(topic_weight=1.0, first_message_deliveries_weight=1.0,
+                          first_message_deliveries_decay=1.0 - 1e-9,
+                          first_message_deliveries_cap=50.0,
+                          invalid_message_deliveries_decay=0.5)
+    ps = PeerScore(mk_params(tp), clock=Clock())
+    pid = PeerID(b"A")
+    ps.add_peer(pid, "/meshsub/1.1.0")
+    ps.graft(pid, TOPIC)
+    for i in range(100):
+        msg = mk_msg(i)
+        msg.received_from = pid
+        ps.validate_message(msg)
+        ps.deliver_message(msg)
+    assert ps.score(pid) == pytest.approx(tp.first_message_deliveries_cap)
+
+
+def test_score_first_message_deliveries_decay():
+    tp = TopicScoreParams(topic_weight=1.0, first_message_deliveries_weight=1.0,
+                          first_message_deliveries_decay=0.9,
+                          first_message_deliveries_cap=2000.0,
+                          invalid_message_deliveries_decay=0.5)
+    ps = PeerScore(mk_params(tp), clock=Clock())
+    pid = PeerID(b"A")
+    ps.add_peer(pid, "/meshsub/1.1.0")
+    ps.graft(pid, TOPIC)
+    for i in range(40):
+        msg = mk_msg(i)
+        msg.received_from = pid
+        ps.validate_message(msg)
+        ps.deliver_message(msg)
+    expected = 40.0
+    for _ in range(10):
+        ps.refresh_scores()
+        expected *= 0.9
+    assert ps.score(pid) == pytest.approx(expected)
+
+
+def test_score_mesh_message_deliveries():
+    """P3: peers below the delivery threshold take the squared-deficit
+    penalty once the activation window has passed."""
+    tp = TopicScoreParams(topic_weight=1.0,
+                          mesh_message_deliveries_weight=-1.0,
+                          mesh_message_deliveries_decay=1.0 - 1e-9,
+                          mesh_message_deliveries_cap=100.0,
+                          mesh_message_deliveries_threshold=20.0,
+                          mesh_message_deliveries_window=0.01,
+                          mesh_message_deliveries_activation=1.0,
+                          invalid_message_deliveries_decay=0.5)
+    clock = Clock()
+    ps = PeerScore(mk_params(tp), clock=clock)
+    # A delivers enough, B delivers nothing, C inactive (just grafted)
+    a, b = PeerID(b"A"), PeerID(b"B")
+    for pid in (a, b):
+        ps.add_peer(pid, "/meshsub/1.1.0")
+        ps.graft(pid, TOPIC)
+    clock.advance(2.0)
+    ps.refresh_scores()  # activates the P3 window for A and B
+    c = PeerID(b"C")
+    ps.add_peer(c, "/meshsub/1.1.0")
+    ps.graft(c, TOPIC)
+
+    for i in range(30):
+        msg = mk_msg(i)
+        msg.received_from = a
+        ps.validate_message(msg)
+        ps.deliver_message(msg)
+
+    assert ps.score(a) == 0.0   # above threshold: no penalty
+    assert ps.score(c) == 0.0   # not activated yet: no penalty
+    deficit = tp.mesh_message_deliveries_threshold
+    assert ps.score(b) == pytest.approx(-deficit * deficit)
+
+
+def test_score_mesh_message_deliveries_window():
+    """Duplicates outside the delivery window earn no P3 credit."""
+    tp = TopicScoreParams(topic_weight=1.0,
+                          mesh_message_deliveries_weight=-1.0,
+                          mesh_message_deliveries_decay=1.0 - 1e-9,
+                          mesh_message_deliveries_cap=100.0,
+                          mesh_message_deliveries_threshold=5.0,
+                          mesh_message_deliveries_window=0.5,
+                          mesh_message_deliveries_activation=1.0,
+                          invalid_message_deliveries_decay=0.5)
+    clock = Clock()
+    ps = PeerScore(mk_params(tp), clock=clock)
+    a, b, c = PeerID(b"A"), PeerID(b"B"), PeerID(b"C")
+    for pid in (a, b, c):
+        ps.add_peer(pid, "/meshsub/1.1.0")
+        ps.graft(pid, TOPIC)
+    clock.advance(2.0)
+    ps.refresh_scores()
+
+    for i in range(10):
+        msg = mk_msg(i)
+        msg.received_from = a
+        ps.validate_message(msg)
+        ps.deliver_message(msg)
+        # B echoes within the window: credited
+        dup = mk_msg(i)
+        dup.received_from = b
+        ps.duplicate_message(dup)
+        # C echoes too late: not credited
+        clock.advance(1.0)
+        dup2 = mk_msg(i)
+        dup2.received_from = c
+        ps.duplicate_message(dup2)
+
+    assert ps.score(a) == 0.0
+    assert ps.score(b) == 0.0
+    deficit = tp.mesh_message_deliveries_threshold
+    assert ps.score(c) == pytest.approx(-deficit * deficit)
+
+
+def test_score_mesh_failure_penalty():
+    """P3b: pruning an underperforming peer makes the deficit sticky."""
+    tp = TopicScoreParams(topic_weight=1.0,
+                          mesh_message_deliveries_weight=0.0,
+                          mesh_message_deliveries_decay=1.0 - 1e-9,
+                          mesh_message_deliveries_cap=100.0,
+                          mesh_message_deliveries_threshold=10.0,
+                          mesh_message_deliveries_activation=1.0,
+                          mesh_failure_penalty_weight=-1.0,
+                          mesh_failure_penalty_decay=1.0 - 1e-9,
+                          invalid_message_deliveries_decay=0.5)
+    clock = Clock()
+    ps = PeerScore(mk_params(tp), clock=clock)
+    a, b = PeerID(b"A"), PeerID(b"B")
+    for pid in (a, b):
+        ps.add_peer(pid, "/meshsub/1.1.0")
+        ps.graft(pid, TOPIC)
+    clock.advance(2.0)
+    ps.refresh_scores()
+
+    # both have a deficit of 10, but only B gets pruned
+    ps.prune(b, TOPIC)
+    assert ps.score(a) == 0.0  # P3 disabled (weight 0), still in mesh
+    deficit = tp.mesh_message_deliveries_threshold
+    assert ps.score(b) == pytest.approx(-deficit * deficit)
+
+
+def test_score_invalid_message_deliveries():
+    tp = TopicScoreParams(topic_weight=1.0,
+                          invalid_message_deliveries_weight=-1.0,
+                          invalid_message_deliveries_decay=0.9)
+    ps = PeerScore(mk_params(tp), clock=Clock())
+    pid = PeerID(b"A")
+    ps.add_peer(pid, "/meshsub/1.1.0")
+    ps.graft(pid, TOPIC)
+    n = 100
+    for i in range(n):
+        msg = mk_msg(i)
+        msg.received_from = pid
+        ps.reject_message(msg, REJECT_INVALID_SIGNATURE)
+    assert ps.score(pid) == pytest.approx(-float(n * n))
+    # and it decays quadratically
+    ps.refresh_scores()
+    assert ps.score(pid) == pytest.approx(-((n * 0.9) ** 2))
+
+
+def test_score_reject_validation_penalizes_forwarders():
+    """A validator reject penalizes both the first deliverer and every peer
+    that forwarded a duplicate while validation was pending."""
+    tp = TopicScoreParams(topic_weight=1.0,
+                          invalid_message_deliveries_weight=-1.0,
+                          invalid_message_deliveries_decay=0.9)
+    ps = PeerScore(mk_params(tp), clock=Clock())
+    a, b = PeerID(b"A"), PeerID(b"B")
+    for pid in (a, b):
+        ps.add_peer(pid, "/meshsub/1.1.0")
+        ps.graft(pid, TOPIC)
+    msg = mk_msg(1)
+    msg.received_from = a
+    ps.validate_message(msg)
+    dup = mk_msg(1)
+    dup.received_from = b
+    ps.duplicate_message(dup)
+    ps.reject_message(msg, "validation failed")
+    assert ps.score(a) == pytest.approx(-1.0)
+    assert ps.score(b) == pytest.approx(-1.0)
+    # the record is marked invalid: late duplicates penalized directly
+    mid = ps.msg_id(msg.rpc)
+    assert ps.deliveries.records[mid].status == DELIVERY_INVALID
+    dup3 = mk_msg(1)
+    dup3.received_from = b
+    ps.duplicate_message(dup3)
+    assert ps.score(b) == pytest.approx(-4.0)
+
+
+def test_score_throttled_and_ignored_not_penalized():
+    tp = TopicScoreParams(topic_weight=1.0,
+                          invalid_message_deliveries_weight=-1.0,
+                          invalid_message_deliveries_decay=0.9)
+    ps = PeerScore(mk_params(tp), clock=Clock())
+    pid = PeerID(b"A")
+    ps.add_peer(pid, "/meshsub/1.1.0")
+    for i, reason in enumerate([REJECT_VALIDATION_THROTTLED,
+                                REJECT_VALIDATION_IGNORED,
+                                REJECT_VALIDATION_QUEUE_FULL]):
+        msg = mk_msg(i)
+        msg.received_from = pid
+        ps.validate_message(msg)
+        ps.reject_message(msg, reason)
+    assert ps.score(pid) == 0.0
+
+
+def test_score_app_specific():
+    tp = TopicScoreParams(topic_weight=1.0, invalid_message_deliveries_decay=0.9)
+    params = mk_params(tp, app_specific_score=lambda p: -1000.0,
+                       app_specific_weight=0.5)
+    ps = PeerScore(params, clock=Clock())
+    pid = PeerID(b"A")
+    ps.add_peer(pid, "/meshsub/1.1.0")
+    assert ps.score(pid) == pytest.approx(-500.0)
+
+
+def test_score_ip_colocation():
+    """P6: peers sharing an IP above the threshold take a squared penalty."""
+    tp = TopicScoreParams(topic_weight=1.0, invalid_message_deliveries_decay=0.9)
+    params = mk_params(tp, ip_colocation_factor_weight=-1.0,
+                       ip_colocation_factor_threshold=1)
+    ps = PeerScore(params, clock=Clock())
+    peers = [PeerID(bytes([i])) for i in range(4)]
+    for pid in peers:
+        ps.add_peer(pid, "/meshsub/1.1.0")
+        ps.peer_stats[pid].ips = ["10.0.0.7"]
+        ps.peer_ips.setdefault("10.0.0.7", set()).add(pid)
+    surplus = len(peers) - params.ip_colocation_factor_threshold
+    for pid in peers:
+        assert ps.score(pid) == pytest.approx(-float(surplus * surplus))
+
+
+def test_score_ip_colocation_whitelist():
+    tp = TopicScoreParams(topic_weight=1.0, invalid_message_deliveries_decay=0.9)
+    params = mk_params(tp, ip_colocation_factor_weight=-1.0,
+                       ip_colocation_factor_threshold=1,
+                       ip_colocation_factor_whitelist=["10.0.0.0/8"])
+    ps = PeerScore(params, clock=Clock())
+    peers = [PeerID(bytes([i])) for i in range(4)]
+    for pid in peers:
+        ps.add_peer(pid, "/meshsub/1.1.0")
+        ps.peer_stats[pid].ips = ["10.0.0.7"]
+        ps.peer_ips.setdefault("10.0.0.7", set()).add(pid)
+    for pid in peers:
+        assert ps.score(pid) == 0.0
+
+
+def test_score_behaviour_penalty():
+    tp = TopicScoreParams(topic_weight=1.0, invalid_message_deliveries_decay=0.9)
+    params = mk_params(tp, behaviour_penalty_weight=-1.0,
+                       behaviour_penalty_threshold=1.0,
+                       behaviour_penalty_decay=0.99)
+    ps = PeerScore(params, clock=Clock())
+    pid = PeerID(b"A")
+    # unknown peer: no-op
+    ps.add_penalty(pid, 1)
+    assert ps.score(pid) == 0.0
+    ps.add_peer(pid, "/meshsub/1.1.0")
+    ps.add_penalty(pid, 1)
+    assert ps.score(pid) == 0.0  # at threshold: no penalty yet
+    ps.add_penalty(pid, 1)
+    assert ps.score(pid) == pytest.approx(-1.0)   # (2-1)^2
+    ps.add_penalty(pid, 2)
+    assert ps.score(pid) == pytest.approx(-9.0)   # (4-1)^2
+
+
+def test_score_retention():
+    """Negative scores survive disconnect for retain_score seconds; positive
+    scores are forgotten immediately (anti score-reset)."""
+    tp = TopicScoreParams(topic_weight=1.0,
+                          invalid_message_deliveries_weight=-1.0,
+                          invalid_message_deliveries_decay=1.0 - 1e-9)
+    clock = Clock()
+    params = mk_params(tp, retain_score=5.0)
+    ps = PeerScore(params, clock=clock)
+    a, b = PeerID(b"A"), PeerID(b"B")
+    for pid in (a, b):
+        ps.add_peer(pid, "/meshsub/1.1.0")
+        ps.graft(pid, TOPIC)
+    msg = mk_msg(1)
+    msg.received_from = a
+    ps.reject_message(msg, REJECT_INVALID_SIGNATURE)
+    assert ps.score(a) < 0
+
+    ps.remove_peer(a)   # negative: retained
+    ps.remove_peer(b)   # zero: retained too (only >0 is dropped)
+    assert ps.score(a) < 0
+    clock.advance(1.0)
+    ps.refresh_scores()
+    assert ps.score(a) < 0  # still within retention; no decay while away
+    clock.advance(10.0)
+    ps.refresh_scores()
+    assert ps.score(a) == 0.0
+    assert a not in ps.peer_stats
+
+
+def test_score_retention_not_positive():
+    tp = TopicScoreParams(topic_weight=1.0,
+                          first_message_deliveries_weight=1.0,
+                          first_message_deliveries_decay=0.9,
+                          first_message_deliveries_cap=100.0,
+                          invalid_message_deliveries_decay=0.9)
+    ps = PeerScore(mk_params(tp, retain_score=100.0), clock=Clock())
+    pid = PeerID(b"A")
+    ps.add_peer(pid, "/meshsub/1.1.0")
+    ps.graft(pid, TOPIC)
+    msg = mk_msg(1)
+    msg.received_from = pid
+    ps.validate_message(msg)
+    ps.deliver_message(msg)
+    assert ps.score(pid) > 0
+    ps.remove_peer(pid)
+    assert pid not in ps.peer_stats  # positive scores are not retained
+
+
+def test_score_recapping():
+    tp = TopicScoreParams(topic_weight=1.0,
+                          first_message_deliveries_weight=1.0,
+                          first_message_deliveries_decay=0.9,
+                          first_message_deliveries_cap=100.0,
+                          invalid_message_deliveries_decay=0.9)
+    ps = PeerScore(mk_params(tp), clock=Clock())
+    pid = PeerID(b"A")
+    ps.add_peer(pid, "/meshsub/1.1.0")
+    ps.graft(pid, TOPIC)
+    for i in range(80):
+        msg = mk_msg(i)
+        msg.received_from = pid
+        ps.validate_message(msg)
+        ps.deliver_message(msg)
+    assert ps.score(pid) == pytest.approx(80.0)
+    tp2 = TopicScoreParams(topic_weight=1.0,
+                           first_message_deliveries_weight=1.0,
+                           first_message_deliveries_decay=0.9,
+                           first_message_deliveries_cap=50.0,
+                           invalid_message_deliveries_decay=0.9)
+    ps.set_topic_score_params(TOPIC, tp2)
+    assert ps.score(pid) == pytest.approx(50.0)
+
+
+def test_score_topic_score_cap():
+    tp = TopicScoreParams(topic_weight=1.0,
+                          first_message_deliveries_weight=1.0,
+                          first_message_deliveries_decay=0.9,
+                          first_message_deliveries_cap=1000.0,
+                          invalid_message_deliveries_decay=0.9)
+    params = mk_params(tp, topic_score_cap=10.0)
+    ps = PeerScore(params, clock=Clock())
+    pid = PeerID(b"A")
+    ps.add_peer(pid, "/meshsub/1.1.0")
+    ps.graft(pid, TOPIC)
+    for i in range(100):
+        msg = mk_msg(i)
+        msg.received_from = pid
+        ps.validate_message(msg)
+        ps.deliver_message(msg)
+    assert ps.score(pid) == pytest.approx(10.0)
+
+
+def test_delivery_record_gc():
+    tp = TopicScoreParams(topic_weight=1.0, invalid_message_deliveries_decay=0.9)
+    clock = Clock()
+    ps = PeerScore(mk_params(tp), clock=clock)
+    pid = PeerID(b"A")
+    ps.add_peer(pid, "/meshsub/1.1.0")
+    msg = mk_msg(1)
+    msg.received_from = pid
+    ps.validate_message(msg)
+    ps.deliver_message(msg)
+    assert len(ps.deliveries.records) == 1
+    clock.advance(121.0)  # past TimeCacheDuration
+    ps.gc_delivery_records()
+    assert len(ps.deliveries.records) == 0
+
+
+def test_near_first_delivery_credit():
+    """Duplicates arriving while validation is pending credit P3
+    retroactively when the message validates."""
+    tp = TopicScoreParams(topic_weight=1.0,
+                          mesh_message_deliveries_weight=-1.0,
+                          mesh_message_deliveries_decay=1.0 - 1e-9,
+                          mesh_message_deliveries_cap=100.0,
+                          mesh_message_deliveries_threshold=2.0,
+                          mesh_message_deliveries_window=0.1,
+                          mesh_message_deliveries_activation=1.0,
+                          invalid_message_deliveries_decay=0.9)
+    clock = Clock()
+    ps = PeerScore(mk_params(tp), clock=clock)
+    a, b = PeerID(b"A"), PeerID(b"B")
+    for pid in (a, b):
+        ps.add_peer(pid, "/meshsub/1.1.0")
+        ps.graft(pid, TOPIC)
+    clock.advance(2.0)
+    ps.refresh_scores()
+    deficit = tp.mesh_message_deliveries_threshold
+    assert ps.score(b) == pytest.approx(-deficit * deficit)
+
+    for i in range(2):
+        msg = mk_msg(i)
+        msg.received_from = a
+        ps.validate_message(msg)
+        dup = mk_msg(i)
+        dup.received_from = b
+        ps.duplicate_message(dup)      # near-first: during validation
+        ps.deliver_message(msg)         # retroactive credit for B
+        mid = ps.msg_id(msg.rpc)
+        assert ps.deliveries.records[mid].status == DELIVERY_VALID
+    assert ps.score(a) == 0.0
+    assert ps.score(b) == 0.0
+
+
+def test_score_parameter_decay():
+    # ~0.01 after (decay / interval) ticks
+    d = score_parameter_decay(600.0)
+    assert 0.99 < d < 1.0
+    v = 1.0
+    for _ in range(600):
+        v *= d
+    assert v == pytest.approx(0.01, rel=1e-6)
+
+
+def test_score_params_validation():
+    def check_bad(**kw):
+        tp_kw = dict(topic_weight=1.0, invalid_message_deliveries_decay=0.5)
+        with pytest.raises(ValueError):
+            p = PeerScoreParams(topics={TOPIC: TopicScoreParams(**tp_kw)},
+                                app_specific_score=lambda p: 0.0, **kw)
+            p.validate()
+
+    check_bad(topic_score_cap=-1.0)
+    check_bad(ip_colocation_factor_weight=1.0)
+    check_bad(ip_colocation_factor_weight=-1.0, ip_colocation_factor_threshold=0)
+    check_bad(behaviour_penalty_weight=1.0)
+    check_bad(behaviour_penalty_weight=-1.0, behaviour_penalty_decay=2.0)
+    check_bad(decay_interval=0.1)
+    check_bad(decay_to_zero=1.5)
+    with pytest.raises(ValueError):
+        PeerScoreParams(app_specific_score=None).validate()
+
+
+def test_topic_params_validation():
+    def check_bad(**kw):
+        with pytest.raises(ValueError):
+            TopicScoreParams(**kw).validate()
+
+    check_bad(topic_weight=-1.0)
+    check_bad(time_in_mesh_quantum=0.0)
+    check_bad(time_in_mesh_weight=-1.0)
+    check_bad(time_in_mesh_weight=1.0, time_in_mesh_quantum=1.0, time_in_mesh_cap=0.0)
+    check_bad(first_message_deliveries_weight=-1.0)
+    check_bad(first_message_deliveries_weight=1.0, first_message_deliveries_decay=2.0)
+    check_bad(mesh_message_deliveries_weight=1.0)
+    check_bad(invalid_message_deliveries_decay=0.5,
+              mesh_message_deliveries_weight=-1.0,
+              mesh_message_deliveries_decay=0.5,
+              mesh_message_deliveries_cap=5.0,
+              mesh_message_deliveries_threshold=0.0)
+    check_bad(mesh_failure_penalty_weight=1.0)
+    check_bad(invalid_message_deliveries_weight=1.0)
+    check_bad(invalid_message_deliveries_decay=0.0)
+    # a fully-populated valid config passes
+    TopicScoreParams(
+        topic_weight=1.0, time_in_mesh_weight=0.01, time_in_mesh_quantum=1.0,
+        time_in_mesh_cap=10.0, first_message_deliveries_weight=1.0,
+        first_message_deliveries_decay=0.5, first_message_deliveries_cap=10.0,
+        mesh_message_deliveries_weight=-1.0, mesh_message_deliveries_decay=0.5,
+        mesh_message_deliveries_cap=10.0, mesh_message_deliveries_threshold=5.0,
+        mesh_message_deliveries_window=0.01,
+        mesh_message_deliveries_activation=1.0,
+        mesh_failure_penalty_weight=-1.0, mesh_failure_penalty_decay=0.5,
+        invalid_message_deliveries_weight=-1.0,
+        invalid_message_deliveries_decay=0.3).validate()
+
+
+def test_thresholds_validation():
+    PeerScoreThresholds(gossip_threshold=-1, publish_threshold=-2,
+                        graylist_threshold=-3, accept_px_threshold=1,
+                        opportunistic_graft_threshold=2).validate()
+    with pytest.raises(ValueError):
+        PeerScoreThresholds(gossip_threshold=1).validate()
+    with pytest.raises(ValueError):
+        PeerScoreThresholds(gossip_threshold=-2, publish_threshold=-1).validate()
+    with pytest.raises(ValueError):
+        PeerScoreThresholds(publish_threshold=-1, graylist_threshold=-0.5).validate()
+    with pytest.raises(ValueError):
+        PeerScoreThresholds(accept_px_threshold=-1).validate()
+    with pytest.raises(ValueError):
+        PeerScoreThresholds(opportunistic_graft_threshold=-1).validate()
+
+
+def test_gater_params_validation():
+    PeerGaterParams().validate()
+    with pytest.raises(ValueError):
+        PeerGaterParams(threshold=0.0).validate()
+    with pytest.raises(ValueError):
+        PeerGaterParams(global_decay=1.5).validate()
+    with pytest.raises(ValueError):
+        PeerGaterParams(duplicate_weight=0.0).validate()
+
+
+def test_score_inspect():
+    tp = TopicScoreParams(topic_weight=1.0, invalid_message_deliveries_decay=0.9)
+    seen = {}
+    ps = PeerScore(mk_params(tp), clock=Clock(), inspect=seen.update)
+    pid = PeerID(b"A")
+    ps.add_peer(pid, "/meshsub/1.1.0")
+    ps.inspect_scores()
+    assert seen == {pid: 0.0}

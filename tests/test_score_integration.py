@@ -1,0 +1,226 @@
+"""Scoring + gater integration over real in-proc gossipsub networks.
+
+Mirrors the reference's score-driven behavioral tests
+(gossipsub_test.go:1388-1817 inspector scenarios) and the spam scenarios
+that drive score collapse (gossipsub_spam_test.go:349,563)."""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+from go_libp2p_pubsub_tpu.core import (
+    AcceptStatus,
+    GossipSubParams,
+    InProcNetwork,
+    MessageSignaturePolicy,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+    create_gossipsub,
+)
+from go_libp2p_pubsub_tpu.pb import (
+    ControlGraft,
+    ControlMessage,
+    PubMessage,
+    RPC,
+    SubOpts,
+)
+from helpers import connect, dense_connect, get_hosts, settle
+
+from test_gossipsub import MockPeer, close_all, fast_params
+
+TOPIC = "scored"
+
+
+def score_params(**kw) -> PeerScoreParams:
+    tp = TopicScoreParams(
+        topic_weight=1.0,
+        time_in_mesh_weight=0.0000001, time_in_mesh_quantum=1.0,
+        time_in_mesh_cap=100.0,
+        first_message_deliveries_weight=1.0,
+        first_message_deliveries_decay=0.999,
+        first_message_deliveries_cap=100.0,
+        invalid_message_deliveries_weight=-1.0,
+        invalid_message_deliveries_decay=0.9999)
+    defaults = dict(topics={TOPIC: tp}, app_specific_score=lambda p: 0.0,
+                    decay_interval=1.0, decay_to_zero=0.01, retain_score=10.0,
+                    behaviour_penalty_weight=-1.0,
+                    behaviour_penalty_threshold=0.0,
+                    behaviour_penalty_decay=0.99)
+    defaults.update(kw)
+    return PeerScoreParams(**defaults)
+
+
+def thresholds() -> PeerScoreThresholds:
+    return PeerScoreThresholds(
+        gossip_threshold=-10.0, publish_threshold=-50.0,
+        graylist_threshold=-100.0, accept_px_threshold=10.0,
+        opportunistic_graft_threshold=1.0)
+
+
+async def make_scored(hosts, **kwargs):
+    out = []
+    for i, h in enumerate(hosts):
+        ps = await create_gossipsub(
+            h, router_rng=random.Random(7000 + i),
+            gossipsub_params=fast_params(),
+            score_params=score_params(), score_thresholds=thresholds(),
+            **kwargs)
+        out.append(ps)
+    return out
+
+
+async def test_delivery_with_scoring_enabled():
+    net = InProcNetwork()
+    hosts = get_hosts(net, 10)
+    psubs = await make_scored(hosts)
+    topics = [await ps.join(TOPIC) for ps in psubs]
+    subs = [await t.subscribe() for t in topics]
+    await dense_connect(hosts)
+    await settle(0.3)
+
+    await topics[0].publish(b"hello scored world")
+    msgs = await asyncio.gather(
+        *[asyncio.wait_for(s.next(), timeout=5) for s in subs])
+    assert all(m.data == b"hello scored world" for m in msgs)
+
+    # first deliverers earned positive P2 on someone's books
+    any_positive = any(
+        ps.router.score.score(p) > 0
+        for ps in psubs for p in ps.router.peers)
+    assert any_positive
+    await close_all(psubs, net)
+
+
+async def test_invalid_messages_collapse_score_to_graylist():
+    """A peer spamming wire-invalid (unsigned) messages collapses its own
+    score quadratically until the router graylists it
+    (reference gossipsub_spam_test.go:563)."""
+    net = InProcNetwork()
+    hosts = get_hosts(net, 2)
+    psubs = await make_scored(hosts)
+    victim = psubs[0]
+    topic = await victim.join(TOPIC)
+    sub = await topic.subscribe()
+    await connect(hosts[0], hosts[1])
+    await settle(0.2)
+
+    mock = MockPeer(net)
+    await mock.connect_and_open(hosts[0])
+    mock.send(RPC(subscriptions=[SubOpts(subscribe=True, topicid=TOPIC)]))
+    await settle(0.1)
+
+    # missing signature under StrictSign => rejected + P4 penalty each
+    for i in range(15):
+        mock.send(RPC(publish=[PubMessage(
+            from_peer=bytes(mock.host.id), data=b"junk %d" % i,
+            seqno=i.to_bytes(8, "big"), topic=TOPIC)]))
+    await settle(0.3)
+
+    score = victim.router.score.score(mock.host.id)
+    assert score < -100.0  # 15^2 over the graylist threshold
+    assert victim.router.accept_from(mock.host.id) == AcceptStatus.NONE
+    await close_all(psubs, net)
+
+
+async def test_graft_during_backoff_earns_behaviour_penalty():
+    """Re-GRAFTing while in backoff accrues P7 and eventually graylists
+    (reference gossipsub_spam_test.go:349)."""
+    net = InProcNetwork()
+    hosts = get_hosts(net, 2)
+    psubs = await make_scored(hosts)
+    victim = psubs[0]
+    topic = await victim.join(TOPIC)
+    await topic.subscribe()
+    await connect(hosts[0], hosts[1])
+    await settle(0.2)
+
+    mock = MockPeer(net)
+    await mock.connect_and_open(hosts[0])
+    mock.send(RPC(subscriptions=[SubOpts(subscribe=True, topicid=TOPIC)]))
+    await settle(0.1)
+
+    # evict from the mesh and impose backoff (what a PRUNE does), then
+    # re-GRAFT repeatedly: each graft during backoff is a penalty (double
+    # when inside the flood threshold)
+    graft = RPC(control=ControlMessage(graft=[ControlGraft(topic_id=TOPIC)]))
+    victim.router.mesh[TOPIC].discard(mock.host.id)
+    victim.router._add_backoff(mock.host.id, TOPIC)
+    for _ in range(5):
+        mock.send(graft)
+        await settle(0.05)
+        victim.router.mesh[TOPIC].discard(mock.host.id)
+
+    assert victim.router.score.score(mock.host.id) < 0
+    penalties = victim.router.score.peer_stats[mock.host.id].behaviour_penalty
+    assert penalties >= 5
+    await close_all(psubs, net)
+
+
+async def test_score_inspect_callback():
+    seen: dict = {}
+    net = InProcNetwork()
+    hosts = get_hosts(net, 2)
+    psubs = []
+    for i, h in enumerate(hosts):
+        psubs.append(await create_gossipsub(
+            h, router_rng=random.Random(i),
+            gossipsub_params=fast_params(),
+            score_params=score_params(), score_thresholds=thresholds(),
+            score_inspect=seen.update, score_inspect_period=0.05))
+    t0 = await psubs[0].join(TOPIC)
+    await t0.subscribe()
+    t1 = await psubs[1].join(TOPIC)
+    await t1.subscribe()
+    await connect(hosts[0], hosts[1])
+    await settle(1.2)  # background inspect ticks at >= 1s granularity
+    assert hosts[1].id in seen or hosts[0].id in seen
+    await close_all(psubs, net)
+
+
+async def test_gater_integration_throttles_spammer():
+    """With a tiny validation queue and the gater enabled, a flood of
+    payload triggers throttle events and flips the breaker."""
+    from go_libp2p_pubsub_tpu.core import PeerGaterParams
+
+    net = InProcNetwork()
+    hosts = get_hosts(net, 2)
+    psubs = []
+    for i, h in enumerate(hosts):
+        psubs.append(await create_gossipsub(
+            h, router_rng=random.Random(i), gossipsub_params=fast_params(),
+            gater_params=PeerGaterParams(),
+            sign_policy=MessageSignaturePolicy.LAX_SIGN,
+            validate_queue_size=1, validate_workers=1))
+    victim = psubs[0]
+    topic = await victim.join(TOPIC)
+    await topic.subscribe()
+
+    # a slow rejecting validator: overflow pushes trip the breaker
+    # (throttle events) while the few validated messages earn rejects,
+    # wrecking the spammer's goodput
+    async def slow_validator(pid, msg):
+        await asyncio.sleep(0.2)
+        return False
+    await victim.register_topic_validator(TOPIC, slow_validator)
+
+    await connect(hosts[0], hosts[1])
+    await settle(0.2)
+    mock = MockPeer(net)
+    await mock.connect_and_open(hosts[0])
+    mock.send(RPC(subscriptions=[SubOpts(subscribe=True, topicid=TOPIC)]))
+    await settle(0.1)
+
+    for i in range(50):
+        mock.send(RPC(publish=[PubMessage(
+            from_peer=bytes(mock.host.id), data=b"flood",
+            seqno=i.to_bytes(8, "big"), topic=TOPIC)]))
+    await settle(0.3)
+
+    gate = victim.router.gate
+    assert gate.throttle > 0  # breaker has tripped at least once
+    # statistically the spammer should now be gated at least sometimes
+    results = {gate.accept_from(mock.host.id) for _ in range(50)}
+    assert AcceptStatus.CONTROL in results
+    await close_all(psubs, net)

@@ -2,6 +2,13 @@
 
 from .blacklist import Blacklist, MapBlacklist, TimeCachedBlacklist
 from .crypto import PrivateKey, PublicKey, generate_keypair, peer_id_extract_key
+from .discovery import (
+    BackoffConnector,
+    DiscoveryPipeline,
+    DiscoveryService,
+    InProcDiscovery,
+    min_topic_size,
+)
 from .floodsub import FloodSubRouter, create_floodsub
 from .gossip_tracer import GossipTracer
 from .gossipsub import (
@@ -14,6 +21,7 @@ from .gossipsub import (
 )
 from .mcache import MessageCache
 from .peer_gater import PeerGater, PeerGaterParams
+from .randomsub import RANDOMSUB_D, RandomSubRouter, create_randomsub
 from .score import PeerScore, PeerScoreSnapshot, TopicScoreSnapshot
 from .score_params import (
     PeerScoreParams,
@@ -21,7 +29,22 @@ from .score_params import (
     TopicScoreParams,
     score_parameter_decay,
 )
+from .subscription_filter import (
+    AllowlistSubscriptionFilter,
+    LimitSubscriptionFilter,
+    RegexpSubscriptionFilter,
+    SubscriptionFilter,
+    TooManySubscriptionsError,
+    filter_subscriptions,
+)
 from .tag_tracer import TagTracer
+from .tracer_sinks import (
+    JSONTracer,
+    PBTracer,
+    RemoteTracer,
+    TraceCollector,
+    proto_to_jsonable,
+)
 from .host import Host, InProcNetwork, NegotiationError, Stream, StreamResetError
 from .pubsub import PubSub, PubSubRouter
 from .sign import (

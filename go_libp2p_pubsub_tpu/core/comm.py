@@ -81,8 +81,10 @@ async def handle_new_peer(ps, conn: PeerConn) -> None:
         stream = await ps.host.new_stream(conn.pid, ps.router.protocols())
     except Exception as e:
         # distinguishes protocol-not-supported from dead peer the way the
-        # reference routes newPeerError vs peerDead (comm.go:96-101)
-        ps._post(lambda: ps._handle_peer_error(conn.pid, e))
+        # reference routes newPeerError vs peerDead (comm.go:96-101);
+        # bind the exception: Python unsets `e` when the except block exits
+        err = e
+        ps._post(lambda: ps._handle_peer_error(conn.pid, err))
         return
     try:
         while True:

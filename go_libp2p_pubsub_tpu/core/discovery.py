@@ -1,0 +1,238 @@
+"""Discovery pipeline: advertise joined topics and find peers when the
+router is short.
+
+Behavioral equivalent of /root/reference/discovery.go: wraps an abstract
+discovery service (rendezvous) with (a) an advertise loop per topic that
+re-advertises when the TTL lapses and retries every 2 minutes on error,
+(b) a 1 s poll that asks the router ``enough_peers`` for every joined topic
+and triggers ``find_peers`` for the starved ones, (c) a backoff connector
+(exponential 10 s → 1 h with full jitter, cache 100) that dials discovered
+peers, and (d) ``bootstrap`` which blocks publish until a router-readiness
+predicate holds.  Namespaces are prefixed ``floodsub:`` on the wire
+(reference discovery.go:317-328).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import Callable, Iterable, Optional
+
+from .types import PeerID
+
+DISCOVERY_POLL_INITIAL_DELAY = 0.0
+DISCOVERY_POLL_INTERVAL = 1.0
+DISCOVERY_ADVERTISE_RETRY_INTERVAL = 120.0
+DISCOVERY_NS_PREFIX = "floodsub:"
+
+# RouterReady: (router, topic) -> bool (reference pubsub.go RouterReady)
+RouterReady = Callable[[object, str], bool]
+
+
+def min_topic_size(size: int) -> RouterReady:
+    """Readiness = router has at least ``size`` topic peers
+    (reference discovery.go:78-82)."""
+    return lambda rt, topic: rt.enough_peers(topic, size)
+
+
+class DiscoveryService:
+    """The abstract rendezvous service (libp2p discovery.Discovery role).
+
+    Implementations: in-proc table for tests (``InProcDiscovery``), or any
+    external system adapted to this interface.
+    """
+
+    async def advertise(self, ns: str) -> float:
+        """Advertise interest; returns TTL seconds until re-advertise."""
+        raise NotImplementedError
+
+    async def find_peers(self, ns: str, limit: int = 0) -> Iterable[PeerID]:
+        raise NotImplementedError
+
+
+class InProcDiscovery(DiscoveryService):
+    """Shared rendezvous table for one in-proc network (test/sim use)."""
+
+    def __init__(self, ttl: float = 60.0):
+        self.table: dict[str, dict[bytes, float]] = {}
+        self.ttl = ttl
+        self.clock: Callable[[], float] = time.monotonic
+
+    def for_host(self, host) -> "_HostDiscovery":
+        return _HostDiscovery(self, host)
+
+
+class _HostDiscovery(DiscoveryService):
+    def __init__(self, root: InProcDiscovery, host):
+        self.root = root
+        self.host = host
+
+    async def advertise(self, ns: str) -> float:
+        entries = self.root.table.setdefault(ns, {})
+        entries[bytes(self.host.id)] = self.root.clock() + self.root.ttl
+        return self.root.ttl
+
+    async def find_peers(self, ns: str, limit: int = 0) -> list[PeerID]:
+        now = self.root.clock()
+        entries = self.root.table.get(ns, {})
+        live = [PeerID(p) for p, exp in entries.items()
+                if exp > now and p != bytes(self.host.id)]
+        return live[:limit] if limit else live
+
+
+class BackoffConnector:
+    """Dial discovered peers with per-peer exponential backoff
+    (reference defaultDiscoverOptions, discovery.go:34-47)."""
+
+    def __init__(self, host, *, min_backoff: float = 10.0,
+                 max_backoff: float = 3600.0, cache_size: int = 100,
+                 dial_timeout: float = 120.0,
+                 rng: Optional[random.Random] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.host = host
+        self.min_backoff = min_backoff
+        self.max_backoff = max_backoff
+        self.cache_size = cache_size
+        self.dial_timeout = dial_timeout
+        self.rng = rng or random.Random()
+        self.clock = clock
+        # peer -> (next allowed attempt time, current backoff)
+        self.cache: dict[PeerID, tuple[float, float]] = {}
+
+    async def connect(self, peers: Iterable[PeerID],
+                      max_concurrency: int = 8) -> None:
+        dials = []
+        for pid in peers:
+            if pid == self.host.id or self.host.connectedness(pid):
+                continue
+            now = self.clock()
+            next_try, backoff = self.cache.get(pid, (0.0, 0.0))
+            if now < next_try:
+                continue
+            # full-jitter exponential backoff
+            backoff = min(self.max_backoff,
+                          (backoff * 5.0) if backoff else self.min_backoff)
+            self.cache[pid] = (now + self.rng.uniform(0, backoff), backoff)
+            if len(self.cache) > self.cache_size:
+                # evict the entry soonest allowed to retry (cheapest loss)
+                victim = min(self.cache, key=lambda p: self.cache[p][0])
+                del self.cache[victim]
+            dials.append(pid)
+
+        # dial concurrently so one black-holed peer can't stall the rest
+        # (the reference connector dials from a goroutine pool)
+        sem = asyncio.Semaphore(max_concurrency)
+
+        async def dial(pid: PeerID) -> None:
+            async with sem:
+                try:
+                    await asyncio.wait_for(self.host.connect(pid),
+                                           self.dial_timeout)
+                except Exception:
+                    pass
+
+        if dials:
+            await asyncio.gather(*(dial(p) for p in dials))
+
+
+class DiscoveryPipeline:
+    """What ``PubSub(discovery=...)`` expects (reference discover struct)."""
+
+    def __init__(self, service: DiscoveryService, *,
+                 connector: Optional[BackoffConnector] = None,
+                 poll_interval: float = DISCOVERY_POLL_INTERVAL):
+        self.service = service
+        self.connector = connector
+        self.poll_interval = poll_interval
+        self.ps = None
+        self.advertising: dict[str, asyncio.Task] = {}
+        self.ongoing: set[str] = set()
+        self._tasks: list[asyncio.Task] = []
+
+    # -- lifecycle (called by PubSub.create/close) --------------------------
+
+    def start(self, ps) -> None:
+        self.ps = ps
+        if self.connector is None:
+            self.connector = BackoffConnector(ps.host)
+        self._tasks.append(asyncio.ensure_future(self._poll_timer()))
+
+    def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self.advertising.values():
+            t.cancel()
+        self.advertising.clear()
+
+    # -- advertising --------------------------------------------------------
+
+    def advertise(self, topic: str) -> None:
+        if topic in self.advertising:
+            return
+        self.advertising[topic] = asyncio.ensure_future(
+            self._advertise_loop(topic))
+
+    def stop_advertise(self, topic: str) -> None:
+        task = self.advertising.pop(topic, None)
+        if task is not None:
+            task.cancel()
+
+    async def _advertise_loop(self, topic: str) -> None:
+        while True:
+            try:
+                ttl = await self.service.advertise(DISCOVERY_NS_PREFIX + topic)
+                if not ttl or ttl <= 0:
+                    ttl = DISCOVERY_ADVERTISE_RETRY_INTERVAL
+            except Exception:
+                ttl = DISCOVERY_ADVERTISE_RETRY_INTERVAL
+            await asyncio.sleep(ttl)
+
+    # -- discovery ----------------------------------------------------------
+
+    async def _poll_timer(self) -> None:
+        await asyncio.sleep(DISCOVERY_POLL_INITIAL_DELAY)
+        while True:
+            starved = await self.ps._eval(
+                lambda: [t for t in self.ps.my_topics
+                         if not self.ps.router.enough_peers(t)])
+            for topic in starved:
+                # spawned, not awaited: a slow find/dial round for one topic
+                # must not stall polling (reference runs these in goroutines)
+                self._spawn(self.discover(topic))
+            await asyncio.sleep(self.poll_interval)
+
+    def _spawn(self, coro) -> None:
+        task = asyncio.ensure_future(coro)
+        self._tasks.append(task)
+        task.add_done_callback(lambda t: self._tasks.remove(t)
+                               if t in self._tasks else None)
+
+    async def discover(self, topic: str) -> None:
+        """Run one discovery round for a topic (dedups concurrent rounds)."""
+        if topic in self.ongoing:
+            return
+        self.ongoing.add(topic)
+        try:
+            peers = await asyncio.wait_for(
+                self.service.find_peers(DISCOVERY_NS_PREFIX + topic),
+                timeout=10.0)
+            await self.connector.connect(peers)
+        except Exception:
+            pass
+        finally:
+            self.ongoing.discard(topic)
+
+    async def bootstrap(self, topic: str, ready: RouterReady,
+                        timeout: Optional[float] = None) -> bool:
+        """Block until the router is ready for publishing on the topic
+        (reference discovery.go:241-296)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok = await self.ps._eval(lambda: ready(self.ps.router, topic))
+            if ok:
+                return True
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            await self.discover(topic)
+            await asyncio.sleep(0.1)

@@ -980,7 +980,12 @@ async def create_gossipsub(host: Host, *,
     if score_params is not None:
         from .gossip_tracer import GossipTracer
         from .score import PeerScore
-        thresholds = score_thresholds or PeerScoreThresholds()
+        if score_thresholds is None:
+            # all-zero thresholds would graylist any peer the moment its
+            # score dips below 0; the reference API (WithPeerScore) takes
+            # both together so the footgun is unrepresentable
+            raise ValueError("score_params requires score_thresholds")
+        thresholds = score_thresholds
         thresholds.validate()
         rt.score = PeerScore(score_params, inspect=score_inspect,
                              inspect_extended=score_inspect_extended,

@@ -93,6 +93,23 @@ class _BytePipe:
             if shift >= 70:
                 raise ValueError("varint too long")
 
+    async def read_some(self) -> bytes:
+        """Return whatever is buffered, waiting for at least one byte."""
+        while True:
+            if self._reset:
+                raise StreamResetError("stream reset")
+            if self._buffered() > 0:
+                out = bytearray()
+                while self._chunks:
+                    chunk = self._chunks.pop(0)
+                    out += chunk[self._pos:]
+                    self._pos = 0
+                return bytes(out)
+            if self._eof:
+                raise EOFError("stream closed")
+            self._wakeup.clear()
+            await self._wakeup.wait()
+
 
 class Stream:
     """One side of a negotiated bidirectional stream."""
@@ -122,6 +139,9 @@ class Stream:
 
     async def read_uvarint(self) -> int:
         return await self._rx.read_uvarint()
+
+    async def read_some(self) -> bytes:
+        return await self._rx.read_some()
 
     def close(self) -> None:
         """Close the write side (remote reader sees EOF)."""
@@ -180,6 +200,9 @@ class ConnManager:
     def tag_peer(self, pid: PeerID, tag: str, value: int) -> None:
         self.tags.setdefault(pid, {})[tag] = self.tags.get(pid, {}).get(tag, 0) + value
 
+    def set_tag(self, pid: PeerID, tag: str, value: int) -> None:
+        self.tags.setdefault(pid, {})[tag] = value
+
     def untag_peer(self, pid: PeerID, tag: str) -> None:
         self.tags.get(pid, {}).pop(tag, None)
 
@@ -201,6 +224,8 @@ class ConnManager:
 class Host:
     """A network participant: identity + streams + lifecycle notifications."""
 
+    _next_ip = 0
+
     def __init__(self, network: "InProcNetwork", key: Optional[PrivateKey] = None):
         self.network = network
         self.key = key or generate_keypair()
@@ -213,8 +238,11 @@ class Host:
         self.peerstore_keys: dict[PeerID, object] = {self.id: self.key.public}
         self.peerstore_records: dict[PeerID, bytes] = {}
         self._own_record: Optional[bytes] = None
-        # simulated external IP for score colocation tests ("/ip4/…")
-        self.ip: str = ""
+        # simulated external IP: unique per host by default (libp2p hosts
+        # always have one), overridable for colocation/sybil scenarios
+        Host._next_ip += 1
+        n = Host._next_ip
+        self.ip: str = f"10.{(n >> 16) & 0xFF}.{(n >> 8) & 0xFF}.{n & 0xFF}"
 
     def signed_record(self) -> bytes:
         """This host's signed peer record (computed once, immutable)."""

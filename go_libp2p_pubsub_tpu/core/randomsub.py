@@ -1,0 +1,100 @@
+"""RandomSub: probabilistic flood routing.
+
+Behavioral equivalent of /root/reference/randomsub.go (168 LoC): each
+message is forwarded to max(RandomSubD, ceil(sqrt(network size))) randomly
+chosen randomsub peers, while floodsub-protocol peers always receive it
+(mixed-protocol support, randomsub.go:117-121).  The sqrt scaling keeps
+per-node fanout sublinear in network size while retaining high delivery
+probability.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Optional
+
+from .comm import rpc_with_messages
+from .pubsub import PubSub, PubSubRouter
+from .types import FLOODSUB_ID, RANDOMSUB_ID, AcceptStatus, Message, PeerID
+
+RANDOMSUB_D = 6
+
+
+class RandomSubRouter(PubSubRouter):
+    def __init__(self, size: int, *, rng: Optional[random.Random] = None):
+        self.ps: PubSub = None
+        self.size = size          # (estimated) network size for sqrt scaling
+        self.peers: dict[PeerID, str] = {}
+        self.rng = rng or random.Random()
+
+    def protocols(self) -> list[str]:
+        return [RANDOMSUB_ID, FLOODSUB_ID]
+
+    def attach(self, ps: PubSub) -> None:
+        self.ps = ps
+
+    def add_peer(self, pid: PeerID, proto: str) -> None:
+        self.ps.tracer.add_peer(pid, proto)
+        self.peers[pid] = proto
+
+    def remove_peer(self, pid: PeerID) -> None:
+        self.ps.tracer.remove_peer(pid)
+        self.peers.pop(pid, None)
+
+    def enough_peers(self, topic: str, suggested: int = 0) -> bool:
+        tmap = self.ps.topics.get(topic)
+        if tmap is None:
+            return False
+        fs_peers = sum(1 for p in tmap if self.peers.get(p) == FLOODSUB_ID)
+        rs_peers = sum(1 for p in tmap if self.peers.get(p) == RANDOMSUB_ID)
+        if suggested == 0:
+            suggested = RANDOMSUB_D
+        return fs_peers + rs_peers >= suggested or rs_peers >= RANDOMSUB_D
+
+    def accept_from(self, pid: PeerID) -> AcceptStatus:
+        return AcceptStatus.ALL
+
+    def handle_rpc(self, rpc, from_peer: PeerID) -> None:
+        pass  # no control messages
+
+    def publish(self, msg: Message) -> None:
+        from_peer = msg.received_from
+        origin = msg.from_peer
+        tmap = self.ps.topics.get(msg.topic)
+        if not tmap:
+            return
+
+        tosend: set[PeerID] = set()
+        rspeers: list[PeerID] = []
+        for p in tmap:
+            if p == from_peer or p == origin:
+                continue
+            if self.peers.get(p) == FLOODSUB_ID:
+                tosend.add(p)  # floodsub peers are always flooded
+            else:
+                rspeers.append(p)
+
+        if len(rspeers) > RANDOMSUB_D:
+            target = max(RANDOMSUB_D, math.ceil(math.sqrt(self.size)))
+            if target < len(rspeers):
+                self.rng.shuffle(rspeers)
+                rspeers = rspeers[:target]
+        tosend.update(rspeers)
+
+        out = rpc_with_messages(msg.rpc)
+        for pid in tosend:
+            self.ps.send_rpc_to(pid, out)
+
+    def join(self, topic: str) -> None:
+        self.ps.tracer.join(topic)
+
+    def leave(self, topic: str) -> None:
+        self.ps.tracer.leave(topic)
+
+
+async def create_randomsub(host, size: int, *,
+                           rng: Optional[random.Random] = None,
+                           **kwargs) -> PubSub:
+    """Construct a randomsub pubsub instance (reference randomsub.go:21)."""
+    return await PubSub.create(host, RandomSubRouter(size, rng=rng), **kwargs)

@@ -1,0 +1,93 @@
+"""Subscription filters: gate which topics we join and which peer
+subscription announcements we track (anti subscription-flood).
+
+Behavioral equivalent of /root/reference/subscription_filter.go: allowlist
+and regexp filters, a dedup-aware filter combinator, and an RPC-size-limit
+wrapper.  The filter is consulted for every subscription notification
+(pubsub.py:_handle_incoming_rpc) and on local Join.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Iterable, Optional
+
+from ..pb import rpc as pb
+from .types import PeerID
+
+
+class TooManySubscriptionsError(ValueError):
+    """An RPC exceeded the allowed number of subscription announcements."""
+
+
+class SubscriptionFilter:
+    """Interface (reference subscription_filter.go:24-32)."""
+
+    def can_subscribe(self, topic: str) -> bool:
+        raise NotImplementedError
+
+    def filter_incoming_subscriptions(
+            self, from_peer: PeerID,
+            subs: list[pb.SubOpts]) -> list[pb.SubOpts]:
+        raise NotImplementedError
+
+
+def filter_subscriptions(subs: Iterable[pb.SubOpts],
+                         allow: Callable[[str], bool]) -> list[pb.SubOpts]:
+    """Filter and deduplicate; a conflicting sub/unsub pair for one topic
+    cancels out, but a later re-statement is accepted again
+    (reference FilterSubscriptions, subscription_filter.go:95-123)."""
+    accept: dict[str, pb.SubOpts] = {}
+    for sub in subs:
+        topic = sub.topicid
+        if not allow(topic):
+            continue
+        other = accept.get(topic)
+        if other is not None:
+            if bool(sub.subscribe) != bool(other.subscribe):
+                del accept[topic]  # conflict cancels; later entries may re-add
+        else:
+            accept[topic] = sub
+    return list(accept.values())
+
+
+class AllowlistSubscriptionFilter(SubscriptionFilter):
+    def __init__(self, *topics: str):
+        self.allow = set(topics)
+
+    def can_subscribe(self, topic: str) -> bool:
+        return topic in self.allow
+
+    def filter_incoming_subscriptions(self, from_peer, subs):
+        return filter_subscriptions(subs, self.can_subscribe)
+
+
+class RegexpSubscriptionFilter(SubscriptionFilter):
+    """Match topics against a regular expression; anchor it yourself or the
+    filter may match unwanted topics (reference subscription_filter.go:71-75)."""
+
+    def __init__(self, pattern: "str | re.Pattern"):
+        self.rx = re.compile(pattern) if isinstance(pattern, str) else pattern
+
+    def can_subscribe(self, topic: str) -> bool:
+        return bool(self.rx.search(topic))
+
+    def filter_incoming_subscriptions(self, from_peer, subs):
+        return filter_subscriptions(subs, self.can_subscribe)
+
+
+class LimitSubscriptionFilter(SubscriptionFilter):
+    """Hard limit on subscription announcements per RPC
+    (reference WrapLimitSubscriptionFilter)."""
+
+    def __init__(self, inner: SubscriptionFilter, limit: int):
+        self.inner = inner
+        self.limit = limit
+
+    def can_subscribe(self, topic: str) -> bool:
+        return self.inner.can_subscribe(topic)
+
+    def filter_incoming_subscriptions(self, from_peer, subs):
+        if len(subs) > self.limit:
+            raise TooManySubscriptionsError("too many subscriptions")
+        return self.inner.filter_incoming_subscriptions(from_peer, subs)

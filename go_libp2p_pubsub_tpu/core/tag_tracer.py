@@ -83,7 +83,7 @@ class TagTracer(RawTracer):
                     if self.cmgr is not None:
                         self.cmgr.untag_peer(p, tag)
                 elif self.cmgr is not None:
-                    self.cmgr.upsert_tag(p, tag, lambda _, v=values[p]: v)
+                    self.cmgr.set_tag(p, tag, values[p])
 
     def _bump(self, p: PeerID, topic: str) -> None:
         values = self.decaying.get(topic)
@@ -92,7 +92,7 @@ class TagTracer(RawTracer):
         values[p] = min(values.get(p, 0) + GOSSIPSUB_CONN_TAG_BUMP_MESSAGE_DELIVERY,
                         self.cap)
         if self.cmgr is not None:
-            self.cmgr.upsert_tag(p, _delivery_tag(topic), lambda _, v=values[p]: v)
+            self.cmgr.set_tag(p, _delivery_tag(topic), values[p])
 
     # -- RawTracer hooks ---------------------------------------------------
 

@@ -1,0 +1,226 @@
+"""Tracer sinks: JSON file, delimited-protobuf file, and remote collector.
+
+Behavioral equivalents of the reference sinks (/root/reference/tracer.go):
+
+- ``JSONTracer``: ndjson file, one JSON object per TraceEvent (bytes fields
+  base64-encoded like protobuf's canonical JSON).
+- ``PBTracer``: varint-delimited TraceEvent file.
+- ``RemoteTracer``: batches >= 16 events (1 s deadline), writes
+  varint-delimited gzip-compressed ``TraceEventBatch`` frames to a collector
+  peer over ``/libp2p/pubsub/tracer/1.0.0``, reconnecting on failure; its
+  buffer is lossy-on-overflow (64K cap) so tracing can never stall pubsub.
+- ``TraceCollector``: the server side of the remote protocol (the reference
+  keeps this in an external `traced` tool; here it is part of the framework).
+
+All sinks buffer in memory and drain from a background task so the
+synchronous ``trace()`` call from the event loop never blocks on IO.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import zlib
+from typing import Callable, Optional
+
+from ..pb import trace as tr
+from ..pb.proto import Message as ProtoMessage, write_delimited, decode_uvarint
+from .trace import EventTracer
+from .types import PeerID
+
+TRACE_BUFFER_SIZE = 1 << 16
+MIN_TRACE_BATCH_SIZE = 16
+REMOTE_TRACER_PROTOCOL = "/libp2p/pubsub/tracer/1.0.0"
+
+
+def proto_to_jsonable(msg: ProtoMessage):
+    """Render a schema-driven proto message as JSON-compatible dicts
+    (bytes -> base64, like protobuf canonical JSON)."""
+    out = {}
+    for f in msg.FIELDS:
+        v = getattr(msg, f.name)
+        if v is None or (f.repeated and not v):
+            continue
+
+        def render(x):
+            if isinstance(x, ProtoMessage):
+                return proto_to_jsonable(x)
+            if isinstance(x, (bytes, bytearray, memoryview)):
+                return base64.b64encode(bytes(x)).decode("ascii")
+            return x
+
+        out[f.name] = [render(x) for x in v] if f.repeated else render(v)
+    return out
+
+
+class _BufferedTracer(EventTracer):
+    """Shared buffer + drain-task machinery (reference basicTracer)."""
+
+    def __init__(self, lossy: bool = False):
+        self.buf: list[tr.TraceEvent] = []
+        self.lossy = lossy
+        self.closed = False
+        self._wake = asyncio.Event()
+        self._task = asyncio.ensure_future(self._run())
+
+    def trace(self, evt: tr.TraceEvent) -> None:
+        if self.closed:
+            return
+        if self.lossy and len(self.buf) > TRACE_BUFFER_SIZE:
+            return  # drop; tracing must never stall the event loop
+        self.buf.append(evt)
+        self._wake.set()
+
+    async def close(self) -> None:
+        """Flush and stop."""
+        self.closed = True
+        self._wake.set()
+        await self._task
+
+    async def _run(self) -> None:
+        while True:
+            await self._wake.wait()
+            self._wake.clear()
+            batch, self.buf = self.buf, []
+            if batch:
+                try:
+                    await self._write(batch)
+                except Exception:
+                    pass
+            if self.closed and not self.buf:
+                await self._close_io()
+                return
+
+    async def _write(self, batch: list[tr.TraceEvent]) -> None:
+        raise NotImplementedError
+
+    async def _close_io(self) -> None:
+        pass
+
+
+class JSONTracer(_BufferedTracer):
+    """ndjson file sink (reference NewJSONTracer, tracer.go:85)."""
+
+    def __init__(self, path: str):
+        self.f = open(path, "w")
+        super().__init__()
+
+    async def _write(self, batch) -> None:
+        for evt in batch:
+            self.f.write(json.dumps(proto_to_jsonable(evt)) + "\n")
+        self.f.flush()
+
+    async def _close_io(self) -> None:
+        self.f.close()
+
+
+class PBTracer(_BufferedTracer):
+    """Varint-delimited protobuf file sink (reference NewPBTracer,
+    tracer.go:137)."""
+
+    def __init__(self, path: str):
+        self.f = open(path, "wb")
+        super().__init__()
+
+    async def _write(self, batch) -> None:
+        for evt in batch:
+            self.f.write(write_delimited(evt))
+        self.f.flush()
+
+    async def _close_io(self) -> None:
+        self.f.close()
+
+
+class RemoteTracer(_BufferedTracer):
+    """Stream batches to a collector peer (reference NewRemoteTracer,
+    tracer.go:194).  Uses a single long-lived gzip stream with sync flushes
+    per batch, so the collector can decode incrementally."""
+
+    def __init__(self, host, collector: PeerID, *,
+                 min_batch: int = MIN_TRACE_BATCH_SIZE,
+                 batch_deadline: float = 1.0):
+        self.host = host
+        self.collector = collector
+        self.min_batch = min_batch
+        self.batch_deadline = batch_deadline
+        self._stream = None
+        self._gzip = None
+        super().__init__(lossy=True)
+
+    async def _ensure_stream(self) -> None:
+        if self._stream is None:
+            self._stream = await self.host.new_stream(
+                self.collector, [REMOTE_TRACER_PROTOCOL])
+            # wbits=31: gzip container, streaming-flushable
+            self._gzip = zlib.compressobj(wbits=31)
+
+    async def _write(self, batch) -> None:
+        # accumulate toward min_batch unless the deadline passes
+        waited = 0.0
+        while (len(batch) + len(self.buf) < self.min_batch
+               and waited < self.batch_deadline and not self.closed):
+            await asyncio.sleep(0.05)
+            waited += 0.05
+        if self.buf:
+            more, self.buf = self.buf, []
+            batch = batch + more
+        try:
+            await self._ensure_stream()
+            payload = write_delimited(tr.TraceEventBatch(batch=batch))
+            data = self._gzip.compress(payload)
+            data += self._gzip.flush(zlib.Z_SYNC_FLUSH)
+            self._stream.write(data)
+        except Exception:
+            # reconnect on next batch
+            if self._stream is not None:
+                self._stream.reset()
+            self._stream = None
+            self._gzip = None
+
+    async def _close_io(self) -> None:
+        if self._stream is not None:
+            try:
+                self._stream.write(self._gzip.flush(zlib.Z_FINISH))
+            except Exception:
+                pass
+            self._stream.close()
+
+
+class TraceCollector:
+    """Server side of the remote tracer protocol: register on a host,
+    collect decoded TraceEvents (reference trace_test.go:32-120 server)."""
+
+    def __init__(self, host,
+                 on_event: Optional[Callable[[tr.TraceEvent], None]] = None):
+        self.host = host
+        self.events: list[tr.TraceEvent] = []
+        self.on_event = on_event
+        host.set_stream_handler(REMOTE_TRACER_PROTOCOL, self._handle)
+
+    async def _handle(self, stream) -> None:
+        decomp = zlib.decompressobj(wbits=47)  # auto-detect gzip/zlib
+        pending = b""
+        try:
+            while True:
+                chunk = await stream.read_some()
+                pending += decomp.decompress(chunk)
+                pending = self._drain(pending)
+        except Exception:
+            pending += decomp.flush()
+            self._drain(pending)
+
+    def _drain(self, pending: bytes) -> bytes:
+        while True:
+            try:
+                size, pos = decode_uvarint(pending, 0)
+            except ValueError:
+                return pending
+            if len(pending) - pos < size:
+                return pending
+            batch = tr.TraceEventBatch.decode(pending[pos:pos + size])
+            for evt in batch.batch:
+                self.events.append(evt)
+                if self.on_event is not None:
+                    self.on_event(evt)
+            pending = pending[pos + size:]

@@ -1,0 +1,267 @@
+"""Subscription filters, discovery pipeline, and tracer sinks.
+
+Mirrors reference subscription_filter_test.go, discovery_test.go, and
+trace_test.go scenarios."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+
+import pytest
+
+from go_libp2p_pubsub_tpu.core import (
+    AllowlistSubscriptionFilter,
+    DiscoveryPipeline,
+    InProcDiscovery,
+    InProcNetwork,
+    JSONTracer,
+    LimitSubscriptionFilter,
+    PBTracer,
+    RegexpSubscriptionFilter,
+    RemoteTracer,
+    TooManySubscriptionsError,
+    TraceCollector,
+    create_floodsub,
+    create_gossipsub,
+    filter_subscriptions,
+    min_topic_size,
+)
+from go_libp2p_pubsub_tpu.pb import SubOpts
+from go_libp2p_pubsub_tpu.pb import trace as tr
+from go_libp2p_pubsub_tpu.pb.proto import read_delimited
+from go_libp2p_pubsub_tpu.core.types import PeerID
+from helpers import connect, get_hosts, settle
+
+from test_gossipsub import close_all, fast_params
+
+
+# -- subscription filters ---------------------------------------------------
+
+
+def test_allowlist_filter():
+    f = AllowlistSubscriptionFilter("test1", "test2")
+    assert f.can_subscribe("test1")
+    assert not f.can_subscribe("test3")
+    out = f.filter_incoming_subscriptions(PeerID(b"A"), [
+        SubOpts(subscribe=True, topicid="test1"),
+        SubOpts(subscribe=True, topicid="test3"),
+    ])
+    assert [s.topicid for s in out] == ["test1"]
+
+
+def test_regexp_filter():
+    f = RegexpSubscriptionFilter("^test[0-9]$")
+    assert f.can_subscribe("test1")
+    assert not f.can_subscribe("nope")
+
+
+def test_filter_dedup_and_cancel():
+    # conflicting sub/unsub for the same topic cancel out; dups collapse
+    subs = [
+        SubOpts(subscribe=True, topicid="a"),
+        SubOpts(subscribe=False, topicid="a"),
+        SubOpts(subscribe=True, topicid="b"),
+        SubOpts(subscribe=True, topicid="b"),
+    ]
+    out = filter_subscriptions(subs, lambda t: True)
+    assert [s.topicid for s in out] == ["b"]
+    # a later re-statement after a conflict is accepted again
+    # (reference subscription_filter.go:104-108 deletes the entry)
+    subs = [
+        SubOpts(subscribe=True, topicid="a"),
+        SubOpts(subscribe=False, topicid="a"),
+        SubOpts(subscribe=True, topicid="a"),
+    ]
+    out = filter_subscriptions(subs, lambda t: True)
+    assert [(s.topicid, bool(s.subscribe)) for s in out] == [("a", True)]
+
+
+def test_limit_filter():
+    f = LimitSubscriptionFilter(AllowlistSubscriptionFilter("t"), 2)
+    f.filter_incoming_subscriptions(PeerID(b"A"), [
+        SubOpts(subscribe=True, topicid="t")])
+    with pytest.raises(TooManySubscriptionsError):
+        f.filter_incoming_subscriptions(PeerID(b"A"), [
+            SubOpts(subscribe=True, topicid="t")] * 3)
+
+
+async def test_subscription_filter_applied_on_wire():
+    """Peer subscriptions for disallowed topics are not tracked, and local
+    joins to disallowed topics error (reference pubsub.go:1096)."""
+    net = InProcNetwork()
+    hosts = get_hosts(net, 2)
+    ps0 = await create_floodsub(
+        hosts[0], subscription_filter=AllowlistSubscriptionFilter("good"))
+    ps1 = await create_floodsub(hosts[1])
+    t_good = await ps1.join("good")
+    await t_good.subscribe()
+    t_bad = await ps1.join("bad")
+    await t_bad.subscribe()
+    await connect(hosts[0], hosts[1])
+    await settle(0.2)
+
+    peers_good = await ps0.list_peers("good")
+    peers_bad = await ps0.list_peers("bad")
+    assert peers_good == [hosts[1].id]
+    assert peers_bad == []
+    with pytest.raises(ValueError):
+        await ps0.join("bad")
+    await close_all([ps0, ps1], net)
+
+
+# -- discovery --------------------------------------------------------------
+
+
+async def test_discovery_connects_topic_peers():
+    """Hosts sharing a topic find each other through the rendezvous table
+    and end up connected (reference discovery_test.go simple scenario)."""
+    net = InProcNetwork()
+    hosts = get_hosts(net, 4)
+    disc = InProcDiscovery()
+    psubs = []
+    for h in hosts:
+        pipeline = DiscoveryPipeline(disc.for_host(h), poll_interval=0.05)
+        psubs.append(await create_floodsub(h, discovery=pipeline))
+    # nobody is connected yet
+    topics = [await ps.join("rendezvous") for ps in psubs]
+    subs = [await t.subscribe() for t in topics]
+    await settle(0.5)
+
+    # discovery should have dialed: everyone connected to everyone
+    for h in hosts:
+        assert len(h.peers()) == len(hosts) - 1, h.peers()
+
+    await topics[0].publish(b"found you")
+    for s in subs:
+        m = await asyncio.wait_for(s.next(), timeout=5)
+        assert m.data == b"found you"
+    await close_all(psubs, net)
+
+
+async def test_bootstrap_blocks_until_ready():
+    net = InProcNetwork()
+    hosts = get_hosts(net, 2)
+    disc = InProcDiscovery()
+    psubs = []
+    for h in hosts:
+        pipeline = DiscoveryPipeline(disc.for_host(h), poll_interval=0.05)
+        psubs.append(await create_floodsub(h, discovery=pipeline))
+    t0 = await psubs[0].join("boot")
+    await t0.subscribe()
+
+    async def late_joiner():
+        await asyncio.sleep(0.2)
+        t1 = await psubs[1].join("boot")
+        await t1.subscribe()
+
+    task = asyncio.ensure_future(late_joiner())
+    ok = await asyncio.wait_for(
+        psubs[0].disc.bootstrap("boot", min_topic_size(1)), timeout=5)
+    assert ok
+    await task
+    await close_all(psubs, net)
+
+
+# -- tracer sinks -----------------------------------------------------------
+
+
+async def test_json_tracer_roundtrip(tmp_path):
+    path = str(tmp_path / "trace.json")
+    net = InProcNetwork()
+    hosts = get_hosts(net, 2)
+    tracer = JSONTracer(path)
+    ps0 = await create_gossipsub(hosts[0], router_rng=random.Random(0),
+                                 gossipsub_params=fast_params(),
+                                 event_tracer=tracer)
+    ps1 = await create_gossipsub(hosts[1], router_rng=random.Random(1),
+                                 gossipsub_params=fast_params())
+    t0 = await ps0.join("traced")
+    s0 = await t0.subscribe()
+    t1 = await ps1.join("traced")
+    await t1.subscribe()
+    await connect(hosts[0], hosts[1])
+    await settle(0.3)
+    await t1.publish(b"traced message")
+    await asyncio.wait_for(s0.next(), timeout=5)
+    await settle(0.2)
+    await tracer.close()
+
+    evts = [json.loads(line) for line in open(path)]
+    types = {e["type"] for e in evts}
+    # joined, peer added, rpcs exchanged, message delivered
+    assert tr.TraceType.JOIN in types
+    assert tr.TraceType.ADD_PEER in types
+    assert tr.TraceType.RECV_RPC in types
+    assert tr.TraceType.DELIVER_MESSAGE in types
+    await close_all([ps0, ps1], net)
+
+
+async def test_pb_tracer_roundtrip(tmp_path):
+    path = str(tmp_path / "trace.pb")
+    net = InProcNetwork()
+    hosts = get_hosts(net, 2)
+    tracer = PBTracer(path)
+    ps0 = await create_gossipsub(hosts[0], router_rng=random.Random(0),
+                                 gossipsub_params=fast_params(),
+                                 event_tracer=tracer)
+    ps1 = await create_gossipsub(hosts[1], router_rng=random.Random(1),
+                                 gossipsub_params=fast_params())
+    t0 = await ps0.join("traced")
+    s0 = await t0.subscribe()
+    t1 = await ps1.join("traced")
+    await t1.subscribe()
+    await connect(hosts[0], hosts[1])
+    await settle(0.3)
+    await t1.publish(b"pb message")
+    await asyncio.wait_for(s0.next(), timeout=5)
+    await settle(0.2)
+    await tracer.close()
+
+    buf = open(path, "rb").read()
+    evts = []
+    pos = 0
+    while pos < len(buf):
+        evt, pos = read_delimited(tr.TraceEvent, buf, pos)
+        evts.append(evt)
+    types = {e.type for e in evts}
+    assert tr.TraceType.DELIVER_MESSAGE in types
+    assert all(e.peer_id == bytes(hosts[0].id) for e in evts)
+    await close_all([ps0, ps1], net)
+
+
+async def test_remote_tracer():
+    """Events stream to a collector peer over the tracer protocol with
+    gzip+delimited framing (reference trace_test.go:301)."""
+    net = InProcNetwork()
+    hosts = get_hosts(net, 3)
+    collector_host = hosts[2]
+    collector = TraceCollector(collector_host)
+
+    await hosts[0].connect(collector_host)
+    tracer = RemoteTracer(hosts[0], collector_host.id, min_batch=4,
+                          batch_deadline=0.2)
+    ps0 = await create_gossipsub(hosts[0], router_rng=random.Random(0),
+                                 gossipsub_params=fast_params(),
+                                 event_tracer=tracer)
+    ps1 = await create_gossipsub(hosts[1], router_rng=random.Random(1),
+                                 gossipsub_params=fast_params())
+    t0 = await ps0.join("remote")
+    s0 = await t0.subscribe()
+    t1 = await ps1.join("remote")
+    await t1.subscribe()
+    await connect(hosts[0], hosts[1])
+    await settle(0.3)
+    for i in range(5):
+        await t1.publish(b"remote %d" % i)
+    for _ in range(5):
+        await asyncio.wait_for(s0.next(), timeout=5)
+    await settle(0.5)
+    await tracer.close()
+    await settle(0.2)
+
+    types = {e.type for e in collector.events}
+    assert tr.TraceType.DELIVER_MESSAGE in types
+    assert len(collector.events) >= 5
+    await close_all([ps0, ps1], net)

@@ -303,3 +303,23 @@ async def test_api_raises_after_close():
     with pytest.raises(RuntimeError):
         await ps.get_topics()
     await net.close()
+
+
+async def test_peer_error_on_protocol_mismatch():
+    """Connecting to a peer with no common protocol routes through the
+    peer-error path (reference newPeerError, comm.go:96-101) and forgets
+    the peer without killing the event loop."""
+    from go_libp2p_pubsub_tpu.core import InProcNetwork, create_floodsub
+    from helpers import settle
+
+    net = InProcNetwork()
+    h1, h2 = net.new_host(), net.new_host()  # h2 has no handlers at all
+    ps = await create_floodsub(h1)
+    await h1.connect(h2)
+    await settle(0.2)
+    assert h2.id not in ps.peers  # negotiation failed: peer forgotten
+    # the loop survived: normal API still works
+    t = await ps.join("alive")
+    await t.subscribe()
+    await ps.close()
+    await net.close()

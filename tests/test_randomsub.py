@@ -1,0 +1,130 @@
+"""RandomSub router tests (reference randomsub_test.go)."""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+from go_libp2p_pubsub_tpu.core import InProcNetwork, create_floodsub
+from go_libp2p_pubsub_tpu.core.randomsub import RANDOMSUB_D, create_randomsub
+from helpers import connect_all, connect_some, get_hosts, settle
+
+
+async def try_receive(sub, timeout=0.1):
+    try:
+        return await asyncio.wait_for(sub.next(), timeout=timeout)
+    except asyncio.TimeoutError:
+        return None
+
+
+async def _run_delivery(psubs, n_publishes=10):
+    subs = []
+    for ps in psubs:
+        topic = await ps.join("test")
+        subs.append(await topic.subscribe())
+    await settle(0.3)
+
+    count = 0
+    for i in range(n_publishes):
+        t = await psubs[i].join("test")
+        await t.publish(b"message %d" % i)
+        for sub in subs:
+            if await try_receive(sub) is not None:
+                count += 1
+    return count
+
+
+async def test_randomsub_small():
+    net = InProcNetwork()
+    hosts = get_hosts(net, 10)
+    psubs = [await create_randomsub(h, 10, rng=random.Random(i))
+             for i, h in enumerate(hosts)]
+    await connect_all(hosts)
+    count = await _run_delivery(psubs)
+    # reference accepts >= 7 * hosts out of 10 * hosts
+    assert count >= 7 * len(hosts), count
+    for ps in psubs:
+        await ps.close()
+    await net.close()
+
+
+async def test_randomsub_big():
+    net = InProcNetwork()
+    hosts = get_hosts(net, 30)
+    psubs = [await create_randomsub(h, 30, rng=random.Random(i))
+             for i, h in enumerate(hosts)]
+    await connect_some(hosts, 12, random.Random(7))
+    count = await _run_delivery(psubs)
+    assert count >= 7 * len(hosts), count
+    for ps in psubs:
+        await ps.close()
+    await net.close()
+
+
+async def test_randomsub_mixed_with_floodsub():
+    """FloodSub-protocol peers always receive (randomsub.go:117-121)."""
+    net = InProcNetwork()
+    hosts = get_hosts(net, 20)
+    psubs = [await create_floodsub(h) for h in hosts[:5]]
+    psubs += [await create_randomsub(h, 15, rng=random.Random(i))
+              for i, h in enumerate(hosts[5:])]
+    await connect_some(hosts, 10, random.Random(7))
+    count = await _run_delivery(psubs)
+    assert count >= 7 * len(hosts), count
+    for ps in psubs:
+        await ps.close()
+    await net.close()
+
+
+async def test_randomsub_enough_peers():
+    net = InProcNetwork()
+    hosts = get_hosts(net, 20)
+    psubs = [await create_floodsub(h) for h in hosts[:5]]
+    psubs += [await create_randomsub(h, 15, rng=random.Random(i))
+              for i, h in enumerate(hosts[5:])]
+    await connect_some(hosts, 12, random.Random(7))
+    for ps in psubs:
+        topic = await ps.join("test")
+        await topic.subscribe()
+    await settle(0.3)
+    rs = psubs[-1]
+    res = await rs._eval(lambda: rs.router.enough_peers("test"))
+    assert res
+
+
+async def test_randomsub_fanout_bounded():
+    """Each publish goes to at most max(D, ceil(sqrt(size))) randomsub
+    peers directly — sqrt scaling, not a full flood
+    (reference randomsub.go:124-138)."""
+    import math
+
+    net = InProcNetwork()
+    hosts = get_hosts(net, 30)
+    psubs = [await create_randomsub(h, 30, rng=random.Random(i))
+             for i, h in enumerate(hosts)]
+    await connect_all(hosts)
+    subs = []
+    for ps in psubs:
+        topic = await ps.join("test")
+        subs.append(await topic.subscribe())
+    await settle(0.3)
+
+    publisher = psubs[0]
+    sent: list = []
+    orig = publisher.send_rpc_to
+
+    def counting_send(pid, rpc):
+        if rpc.publish:
+            sent.append(pid)
+        return orig(pid, rpc)
+
+    publisher.send_rpc_to = counting_send
+    t0 = await publisher.join("test")
+    await t0.publish(b"bounded")
+    await settle(0.1)
+
+    target = max(RANDOMSUB_D, math.ceil(math.sqrt(30)))
+    assert 0 < len(set(sent)) <= target, sent
+    for ps in psubs:
+        await ps.close()
+    await net.close()

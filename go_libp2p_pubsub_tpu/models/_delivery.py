@@ -1,0 +1,52 @@
+"""Shared delivery-tick bookkeeping for the simulators.
+
+Every simulator (floodsub, gossipsub, randomsub) records, per (peer,
+message-bit), the first tick the message was delivered — the raw material
+for the reachability-vs-hops curves BASELINE.md asks to match.  The layout
+is word-aligned int16 [N, W, 32] (bit j of word w = message w*32+j) so the
+hot-loop update is reshape-free; -1 = never delivered; ticks saturate at
+32766 so they can't wrap into the sentinel.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops.graph import WORD_BITS
+
+
+def update_first_tick(first_tick: jnp.ndarray | None,
+                      delivered_now: jnp.ndarray,
+                      tick: jnp.ndarray) -> jnp.ndarray | None:
+    """Record ``tick`` for bits of delivered_now (uint32 [N, W]) that are
+    newly delivered.  No-op when tracking is disabled (first_tick=None)."""
+    if first_tick is None:
+        return None
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
+    bits = ((delivered_now[:, :, None] >> shifts) & jnp.uint32(1)) != 0
+    newly = bits & (first_tick < 0)
+    tick16 = jnp.minimum(tick, 32766).astype(jnp.int16)
+    return jnp.where(newly, tick16, first_tick)
+
+
+def first_tick_to_matrix(first_tick: jnp.ndarray, m: int) -> jnp.ndarray:
+    """first_tick [N, W, 32] as [N, M] (strips word padding)."""
+    n = first_tick.shape[0]
+    return first_tick.reshape(n, -1)[:, :m]
+
+
+def reach_counts_from_first_tick(first_tick: jnp.ndarray,
+                                 m: int) -> jnp.ndarray:
+    """Per-message delivered-peer counts: int32 [M]."""
+    return (first_tick_to_matrix(first_tick, m) >= 0).sum(
+        axis=0, dtype=jnp.int32)
+
+
+def reach_by_hops_from_first_tick(first_tick: jnp.ndarray, m: int,
+                                  max_hops: int) -> jnp.ndarray:
+    """[M, max_hops] cumulative deliveries by hop count."""
+    ft = first_tick_to_matrix(first_tick, m)
+    hops = jnp.arange(max_hops, dtype=jnp.int16)
+    per_hop = (ft[None, :, :] == hops[:, None, None]).sum(
+        axis=1, dtype=jnp.int32)           # [max_hops, M]
+    return jnp.cumsum(per_hop, axis=0).T   # [M, max_hops]

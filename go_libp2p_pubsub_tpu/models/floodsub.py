@@ -28,6 +28,12 @@ from ..ops.graph import (
     propagate,
     propagate_circulant,
 )
+from ._delivery import (
+    first_tick_to_matrix,
+    reach_by_hops_from_first_tick,
+    reach_counts_from_first_tick,
+    update_first_tick,
+)
 
 
 @struct.dataclass
@@ -131,16 +137,8 @@ def _finish_step(params: FloodParams, state: FloodState,
     # delivery accounting (origin's own publish counts at inject tick)
     delivered_now = (accepted & params.deliver_words) | (
         injected & params.deliver_words)
-    if state.first_tick is not None:
-        shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
-        bits = ((delivered_now[:, :, None] >> shifts) & jnp.uint32(1)) != 0
-        newly = bits & (state.first_tick < 0)
-        # saturate at int16 max so ticks past 32766 can't wrap negative
-        # and collide with the -1 never-delivered sentinel
-        tick16 = jnp.minimum(state.tick, 32766).astype(jnp.int16)
-        first_tick = jnp.where(newly, tick16, state.first_tick)
-    else:
-        first_tick = None
+    first_tick = update_first_tick(state.first_tick, delivered_now,
+                                   state.tick)
 
     new_state = FloodState(have=have, first_tick=first_tick,
                            tick=state.tick + 1)
@@ -188,22 +186,18 @@ def make_circulant_step_core(offsets):
 
 def first_tick_matrix(state: FloodState, m: int) -> jnp.ndarray:
     """first_tick as [N, M] (strips word padding)."""
-    n = state.first_tick.shape[0]
-    return state.first_tick.reshape(n, -1)[:, :m]
+    return first_tick_to_matrix(state.first_tick, m)
 
 
 def reach_counts(params: FloodParams, state: FloodState) -> jnp.ndarray:
     """Per-message delivered-peer counts: int32 [M]."""
-    m = params.publish_tick.shape[0]
-    return (first_tick_matrix(state, m) >= 0).sum(axis=0, dtype=jnp.int32)
+    return reach_counts_from_first_tick(state.first_tick,
+                                        params.publish_tick.shape[0])
 
 
 def reach_by_hops(params: FloodParams, state: FloodState,
                   max_hops: int) -> jnp.ndarray:
     """[M, max_hops] cumulative deliveries by hop count — the
     reachability-vs-hops curve from BASELINE.md."""
-    ft = first_tick_matrix(state, params.publish_tick.shape[0])
-    hops = jnp.arange(max_hops, dtype=jnp.int16)
-    per_hop = (ft[None, :, :] == hops[:, None, None]).sum(
-        axis=1, dtype=jnp.int32)          # [max_hops, M]
-    return jnp.cumsum(per_hop, axis=0).T   # [M, max_hops]
+    return reach_by_hops_from_first_tick(
+        state.first_tick, params.publish_tick.shape[0], max_hops)

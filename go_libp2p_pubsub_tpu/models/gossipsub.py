@@ -1,0 +1,406 @@
+"""GossipSub simulator: mesh overlay + lazy gossip, every peer at once.
+
+The vectorized counterpart of the protocol core's GossipSubRouter
+(core/gossipsub.py; reference /root/reference/gossipsub.go).  One jitted
+``step`` advances one heartbeat for ALL simulated peers: mesh forwarding,
+IHAVE/IWANT gossip repair, then the heartbeat maintenance pass
+(graft-to-D / prune-to-D, backoff, fanout TTL — gossipsub.go:1299-1552).
+
+TPU-first representation (see PERF_NOTES.md):
+
+- **Topology = per-topic random circulants.**  Peer p belongs to topic
+  ``p mod T``; the candidate-neighbor set of every peer is a static list of
+  C ring offsets, all multiples of T and closed under negation.  Candidates
+  model what discovery + peer exchange give a deployed node: the topic
+  peers it *could* connect to (discovery.go:108-173, PX gossipsub.go:856).
+- **Mesh/fanout/gossip-targets = bool masks [N, C]** over those candidate
+  columns.  GRAFT/PRUNE flip mask bits; degree bounds (D/Dlo/Dhi,
+  gossipsub.go:33-40) make C a small compile-time constant.
+- **Edge duality is a column permutation + roll.**  The link (p, p+o_c)
+  seen from the partner is column ``cinv[c]`` where ``o_cinv = -o_c``, so
+  sending per-edge data to the partner — GRAFT/PRUNE announcements,
+  message words — is ``roll(x[:, c], o_c)`` landing in column cinv[c].
+  The whole heartbeat is rolls, masks, popcounts, and two tiny per-row
+  argsorts: **no gathers** (XLA gather is ~1000x slower than roll on this
+  topology; PERF_NOTES.md).
+- **Messages are bit positions** in uint32 words, as in models/floodsub.py.
+  The mcache (mcache.go) becomes a ring of recently-acquired words: slot 0
+  = newest heartbeat window; IHAVE advertises the OR of the newest
+  HistoryGossip slots (mcache.go:82, GetGossipIDs).
+
+Timing model: one tick = one heartbeat = one network hop.  Reachability is
+measured in hops (publish-tick-relative), which is exactly the
+reachability-vs-hops contract from BASELINE.md and independent of the
+wall-clock heartbeat/RTT ratio.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..ops.graph import (
+    WORD_BITS,
+    count_bits_per_position,
+    make_circulant_offsets,
+    pack_bits,
+    select_k_per_row,
+)
+from ._delivery import (
+    reach_counts_from_first_tick,
+    first_tick_to_matrix,
+    update_first_tick,
+)
+
+
+# --------------------------------------------------------------------------
+# Static configuration (baked into the compiled step as constants)
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class GossipSimConfig:
+    """Static simulator config.  Protocol defaults mirror GossipSubParams
+    (core/gossipsub.py:61; reference gossipsub.go:31-59)."""
+
+    offsets: tuple[int, ...]       # C candidate ring offsets, ± paired
+    n_topics: int = 1
+    d: int = 6                     # GossipSubD
+    d_lo: int = 5                  # GossipSubDlo
+    d_hi: int = 12                 # GossipSubDhi
+    d_lazy: int = 6                # GossipSubDlazy
+    gossip_factor: float = 0.25    # GossipSubGossipFactor
+    history_gossip: int = 3        # GossipSubHistoryGossip (IHAVE window)
+    backoff_ticks: int = 60        # GossipSubPruneBackoff / heartbeat
+    fanout_ttl_ticks: int = 60     # GossipSubFanoutTTL / heartbeat
+
+    def __post_init__(self):
+        offs = np.asarray(self.offsets, dtype=np.int64)
+        if len(offs) == 0 or len(set(offs.tolist())) != len(offs):
+            raise ValueError("offsets must be distinct and non-empty")
+        if not all((-o) in set(offs.tolist()) for o in offs.tolist()):
+            raise ValueError("offsets must be closed under negation")
+        if any(o % self.n_topics for o in offs.tolist()):
+            raise ValueError("offsets must be multiples of n_topics")
+        if not (self.d_lo <= self.d <= self.d_hi):
+            raise ValueError("need Dlo <= D <= Dhi (gossipsub.go:33-35)")
+        if self.d_hi >= len(offs):
+            raise ValueError("need C > Dhi candidate columns")
+
+    @property
+    def n_candidates(self) -> int:
+        return len(self.offsets)
+
+    @property
+    def cinv(self) -> tuple[int, ...]:
+        """cinv[c] = column of the negated offset (the partner's view of
+        edge column c)."""
+        idx = {o: i for i, o in enumerate(self.offsets)}
+        return tuple(idx[-o] for o in self.offsets)
+
+
+def make_gossip_offsets(n_topics: int, n_candidates: int, n_peers: int,
+                        seed: int = 0) -> tuple[int, ...]:
+    """Random ± paired circulant offsets ≡ 0 (mod n_topics): each residue
+    class (= topic) forms an independent random circulant candidate graph
+    (expander — same locally-tree-like spread as the reference test
+    harness's random topologies, floodsub_test.go:65-81)."""
+    offs = make_circulant_offsets(n_topics, n_candidates, n_peers,
+                                  seed=seed)
+    return tuple(int(o) for o in offs)
+
+
+# --------------------------------------------------------------------------
+# Pytrees
+# --------------------------------------------------------------------------
+
+
+@struct.dataclass
+class GossipParams:
+    """Per-simulation device arrays (dynamic operands of the jitted step)."""
+
+    subscribed: jnp.ndarray      # bool [N]: has a local subscription
+    cand_subscribed: jnp.ndarray # bool [N, C]: candidate q=p+o_c subscribed
+    origin_words: jnp.ndarray    # uint32 [N, W]: bit m set at origin[m]
+    deliver_words: jnp.ndarray   # uint32 [N, W]: msg m counts as delivery
+    publish_tick: jnp.ndarray    # int32 [M]
+
+
+@struct.dataclass
+class GossipState:
+    mesh: jnp.ndarray        # bool [N, C]  my mesh membership per candidate
+    fanout: jnp.ndarray      # bool [N, C]  publish-without-join targets
+    last_pub: jnp.ndarray    # int32 [N]    last publish tick (fanout TTL)
+    backoff: jnp.ndarray     # int32 [N, C] no re-GRAFT until this tick
+    have: jnp.ndarray        # uint32 [N, W]
+    recent: jnp.ndarray      # uint32 [N, Hg, W] newly-acquired ring (mcache)
+    first_tick: jnp.ndarray  # int16 [N, W, 32] or None
+    key: jax.Array           # PRNG key
+    tick: jnp.ndarray        # int32 scalar
+
+
+def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
+                    msg_topic: np.ndarray, msg_origin: np.ndarray,
+                    msg_publish_tick: np.ndarray, seed: int = 0,
+                    track_first_tick: bool = True):
+    """Build (params, state).  subs: bool [N, T] — but each peer may only
+    subscribe to its residue-class topic (circulant classes are closed, so
+    cross-class subscriptions would never receive anything)."""
+    n, t = subs.shape
+    if t != cfg.n_topics:
+        raise ValueError("subs topic dim != cfg.n_topics")
+    own_topic = np.arange(n) % cfg.n_topics
+    cross = subs & ~(np.arange(t)[None, :] == own_topic[:, None])
+    if cross.any():
+        raise ValueError("peers may only subscribe to topic (p mod T)")
+    subscribed = subs[np.arange(n), own_topic]
+
+    m = len(msg_topic)
+    if ((msg_origin % cfg.n_topics) != msg_topic).any():
+        raise ValueError("msg origin must be in the topic's residue class")
+    origin_bits = np.zeros((n, m), dtype=bool)
+    origin_bits[msg_origin, np.arange(m)] = True
+    deliver_bits = subscribed[:, None] & (own_topic[:, None]
+                                          == msg_topic[None, :])
+
+    cand_sub = np.stack([np.roll(subscribed, o) for o in cfg.offsets],
+                        axis=1)
+    params = GossipParams(
+        subscribed=jnp.asarray(subscribed),
+        cand_subscribed=jnp.asarray(cand_sub),
+        origin_words=pack_bits(jnp.asarray(origin_bits)),
+        deliver_words=pack_bits(jnp.asarray(deliver_bits)),
+        publish_tick=jnp.asarray(msg_publish_tick, dtype=jnp.int32),
+    )
+    w = params.origin_words.shape[1]
+    c = cfg.n_candidates
+    state = GossipState(
+        mesh=jnp.zeros((n, c), dtype=bool),
+        fanout=jnp.zeros((n, c), dtype=bool),
+        last_pub=jnp.full((n,), -(10 ** 9), dtype=jnp.int32),
+        backoff=jnp.zeros((n, c), dtype=jnp.int32),
+        have=jnp.zeros((n, w), dtype=jnp.uint32),
+        recent=jnp.zeros((n, cfg.history_gossip, w), dtype=jnp.uint32),
+        first_tick=(jnp.full((n, w, WORD_BITS), -1, dtype=jnp.int16)
+                    if track_first_tick else None),
+        key=jax.random.PRNGKey(seed),
+        tick=jnp.zeros((), dtype=jnp.int32),
+    )
+    return params, state
+
+
+# --------------------------------------------------------------------------
+# Edge transfer: per-edge data -> the partner's view of the same edge
+# --------------------------------------------------------------------------
+
+
+def edge_transfer(cols: list[jnp.ndarray], cfg: GossipSimConfig):
+    """Given per-column arrays (each [N, ...], column c describing edge
+    (p, p+o_c)), return the received per-column list: out[cinv[c]] =
+    roll(cols[c], o_c) — what each peer's partner sent it on that edge."""
+    out = [None] * cfg.n_candidates
+    for c, off in enumerate(cfg.offsets):
+        out[cfg.cinv[c]] = jnp.roll(cols[c], off, axis=0)
+    return out
+
+
+def transfer_mask(mask: jnp.ndarray, cfg: GossipSimConfig) -> jnp.ndarray:
+    """edge_transfer for a bool [N, C] mask (column-stacked form)."""
+    cols = edge_transfer([mask[:, c] for c in range(cfg.n_candidates)], cfg)
+    return jnp.stack(cols, axis=1)
+
+
+def masked_word_or(words: jnp.ndarray, mask: jnp.ndarray,
+                   cfg: GossipSimConfig) -> jnp.ndarray:
+    """OR of ``words`` sent along every masked edge: what each peer hears.
+
+    words: uint32 [N, W] (sender payload); mask: bool [N, C] (sender's
+    out-edges).  One roll per candidate column — the hot op.
+    """
+    out = jnp.zeros_like(words)
+    for c, off in enumerate(cfg.offsets):
+        sent = jnp.where(mask[:, c, None], words, jnp.uint32(0))
+        out = out | jnp.roll(sent, off, axis=0)
+    return out
+
+
+# --------------------------------------------------------------------------
+# The step
+# --------------------------------------------------------------------------
+
+
+def make_gossip_step(cfg: GossipSimConfig):
+    """Build the jittable (params, state) -> (state, delivered_words) core.
+
+    Per tick:
+      1. inject due publishes (Topic.Publish -> rt.Publish, topic.go:207)
+      2. eager forward: newly-acquired words flow one hop along mesh ∪
+         fanout edges (forwardMessage to mesh, gossipsub.go:989-999)
+      3. lazy gossip: IHAVE of the recent window to Dlazy/gossip-factor
+         random non-mesh candidates; receivers pull what they lack
+         (emitGossip gossipsub.go:1656-1712 + handleIHave/IWant :610-711)
+      4. heartbeat maintenance: graft to D when deg<Dlo, prune to D when
+         deg>Dhi, GRAFT/PRUNE handshake with backoff, fanout TTL
+         (heartbeat gossipsub.go:1299-1552)
+    """
+    C = cfg.n_candidates
+
+    def step(params: GossipParams, state: GossipState):
+        key, k_gossip, k_graft, k_prune, k_fanout = jax.random.split(
+            state.key, 5)
+        tick = state.tick
+        sub = params.subscribed
+
+        # -- 1. publish injection ---------------------------------------
+        due = pack_bits(params.publish_tick == tick)            # [W]
+        injected = params.origin_words & due[None, :] & ~state.have
+        publishing = (injected != 0).any(axis=1)                # [N]
+
+        # -- 1b. fanout build/maintenance (BEFORE forwarding: the
+        # reference selects fanout peers on demand at publish time,
+        # gossipsub.go:961-983; TTL expiry + refill per heartbeat
+        # :1505-1542).  Fanout only ever carries the owner's own
+        # publishes — unsubscribed peers accept nothing to relay.
+        last_pub = jnp.where(publishing, tick, state.last_pub)
+        alive = (~sub) & (tick - last_pub < cfg.fanout_ttl_ticks)
+        fanout = state.fanout & alive[:, None]
+        f_deg = fanout.sum(axis=1, dtype=jnp.int32)
+        f_need = jnp.where(alive, cfg.d - f_deg, 0)
+        fanout = fanout | select_k_per_row(
+            params.cand_subscribed & ~fanout, f_need, k_fanout)
+
+        # -- 2. eager mesh forward --------------------------------------
+        # what I acquired last tick + my fresh publishes go to my mesh
+        # (or fanout when publishing unsubscribed)
+        fresh = state.recent[:, 0] | injected
+        out_edges = state.mesh | fanout
+        heard = masked_word_or(fresh, out_edges, cfg)
+        new_mesh_bits = heard & ~state.have & ~injected
+        new_mesh_bits = jnp.where(sub[:, None], new_mesh_bits,
+                                  jnp.uint32(0))
+
+        # -- 3. lazy gossip (IHAVE/IWANT collapsed to one exchange) -----
+        # advertise ids seen in the last HistoryGossip windows; targets =
+        # random non-mesh subscribed candidates, max(Dlazy, factor*elig)
+        adv = jax.lax.reduce_or(state.recent, axes=(1,)) | injected
+        elig = params.cand_subscribed & ~state.mesh & ~state.fanout
+        elig = elig & sub[:, None]          # only subscribed peers gossip
+        n_elig = elig.sum(axis=1, dtype=jnp.int32)
+        n_gossip = jnp.maximum(
+            jnp.int32(cfg.d_lazy),
+            (cfg.gossip_factor * n_elig.astype(jnp.float32)).astype(
+                jnp.int32))
+        targets = select_k_per_row(elig, n_gossip, k_gossip)
+        gossip_heard = masked_word_or(adv, targets, cfg)
+        new_gossip_bits = (gossip_heard & ~state.have & ~injected
+                           & ~new_mesh_bits)
+        new_gossip_bits = jnp.where(sub[:, None], new_gossip_bits,
+                                    jnp.uint32(0))
+
+        new_acquired = new_mesh_bits | new_gossip_bits | injected
+        have = state.have | new_acquired
+        recent = jnp.concatenate(
+            [new_acquired[:, None, :], state.recent[:, :-1]], axis=1)
+
+        delivered_now = new_acquired & params.deliver_words
+        first_tick = update_first_tick(state.first_tick, delivered_now,
+                                       tick)
+
+        # -- 4. heartbeat maintenance -----------------------------------
+        mesh, backoff = state.mesh, state.backoff
+        in_backoff = backoff > tick
+        deg = mesh.sum(axis=1, dtype=jnp.int32)
+
+        # graft up to D when deg < Dlo (gossipsub.go:1340-1360)
+        can_graft = (params.cand_subscribed & ~mesh & ~in_backoff
+                     & sub[:, None])
+        need = jnp.where(deg < cfg.d_lo, cfg.d - deg, 0)
+        grafts = select_k_per_row(can_graft, need, k_graft)
+
+        # prune down to D when deg > Dhi, random retention (v1.0 keeps a
+        # random D; score ranking is the v1.1 extension,
+        # gossipsub.go:1362-1435)
+        keep = select_k_per_row(mesh, jnp.full_like(deg, cfg.d), k_prune)
+        prunes = mesh & ~keep & (deg > cfg.d_hi)[:, None]
+
+        mesh = (mesh | grafts) & ~prunes
+        backoff = jnp.where(prunes, tick + cfg.backoff_ticks, backoff)
+
+        # handshake: partner accepts GRAFT unless unsubscribed or it has
+        # us backed off (handleGraft gossipsub.go:713-804); PRUNE always
+        # removes + backs off (handlePrune :806-838)
+        graft_recv = transfer_mask(grafts, cfg)
+        prune_recv = transfer_mask(prunes, cfg)
+        accept = graft_recv & sub[:, None] & ~(backoff > tick)
+        reject = graft_recv & ~accept
+        mesh = (mesh | accept) & ~prune_recv
+        backoff = jnp.where(prune_recv,
+                            jnp.maximum(backoff, tick + cfg.backoff_ticks),
+                            backoff)
+        # PRUNE response to rejected grafts retracts the optimistic graft
+        reject_back = transfer_mask(reject, cfg)
+        mesh = mesh & ~reject_back
+        backoff = jnp.where(
+            reject_back, jnp.maximum(backoff, tick + cfg.backoff_ticks),
+            backoff)
+
+        new_state = GossipState(
+            mesh=mesh, fanout=fanout, last_pub=last_pub, backoff=backoff,
+            have=have, recent=recent, first_tick=first_tick, key=key,
+            tick=tick + 1)
+        return new_state, delivered_now
+
+    return step
+
+
+# --------------------------------------------------------------------------
+# Runners / metrics (mirror models/floodsub.py)
+# --------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def gossip_run(params: GossipParams, state: GossipState, n_ticks: int,
+               step) -> GossipState:
+    def body(s, _):
+        return step(params, s)[0], None
+    state, _ = jax.lax.scan(body, state, None, length=n_ticks)
+    return state
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4))
+def gossip_run_curve(params: GossipParams, state: GossipState, n_ticks: int,
+                     step, n_msgs: int):
+    """Run n_ticks collecting per-tick delivered counts [n_ticks, M]."""
+    def body(s, _):
+        s2, delivered = step(params, s)
+        return s2, count_bits_per_position(delivered, n_msgs)
+    state, counts = jax.lax.scan(body, state, None, length=n_ticks)
+    return state, counts
+
+
+def first_tick_matrix(state: GossipState, m: int) -> jnp.ndarray:
+    return first_tick_to_matrix(state.first_tick, m)
+
+
+def reach_counts(params: GossipParams, state: GossipState) -> jnp.ndarray:
+    return reach_counts_from_first_tick(state.first_tick,
+                                        params.publish_tick.shape[0])
+
+
+def mesh_degrees(state: GossipState) -> jnp.ndarray:
+    return state.mesh.sum(axis=1, dtype=jnp.int32)
+
+
+def mesh_symmetry_fraction(state: GossipState,
+                           cfg: GossipSimConfig) -> jnp.ndarray:
+    """Fraction of mesh edges whose partner also has the edge (after the
+    GRAFT/PRUNE handshake settles this should approach 1)."""
+    partner = transfer_mask(state.mesh, cfg)
+    agree = (state.mesh & partner).sum()
+    total = state.mesh.sum()
+    return agree / jnp.maximum(total, 1)

@@ -146,7 +146,14 @@ def make_circulant_offsets(n_classes: int, degree: int, n_peers: int,
     """
     rng = np.random.default_rng(seed)
     max_k = n_peers // n_classes
-    ks = rng.choice(np.arange(1, max_k), size=degree // 2, replace=False)
+    # sample k strictly below max_k/2: otherwise two "distinct" offsets can
+    # alias the same peer mod N (k and max_k-k are negatives of each other
+    # on the ring, and k = max_k/2 is its own negative), silently merging
+    # two edges into one
+    half = (max_k - 1) // 2
+    if degree // 2 > half:
+        raise ValueError("degree too large for the residue-class size")
+    ks = rng.choice(np.arange(1, half + 1), size=degree // 2, replace=False)
     offs = np.concatenate([ks, -ks]) * n_classes
     return offs.astype(np.int64)
 
@@ -161,6 +168,38 @@ def propagate_circulant(words: jnp.ndarray, offsets) -> jnp.ndarray:
     for off in offsets:
         out = out | jnp.roll(words, int(off), axis=0)
     return out
+
+
+def select_k_per_row(eligible: jnp.ndarray, k: jnp.ndarray,
+                     key: jax.Array) -> jnp.ndarray:
+    """Uniformly select up to k[i] of the eligible columns in each row.
+
+    eligible: bool [N, C]; k: int32 [N] (clipped to the eligible count).
+    Returns bool [N, C].  This is the TPU form of the reference's
+    shufflePeers + take-first-k idiom (gossipsub.go:1879, used for graft
+    candidate sampling, prune retention, and gossip target selection):
+    random priorities, two small argsorts (C is O(Dhi), so each row sort is
+    tiny), rank-vs-k compare.
+    """
+    prio = jax.random.uniform(key, eligible.shape)
+    prio = jnp.where(eligible, prio, -1.0)
+    order = jnp.argsort(-prio, axis=1)
+    ranks = jnp.argsort(order, axis=1)
+    return eligible & (ranks < k[:, None])
+
+
+def select_k_by_priority(eligible: jnp.ndarray, priority: jnp.ndarray,
+                         k: jnp.ndarray) -> jnp.ndarray:
+    """Select up to k[i] eligible columns per row by DESCENDING priority.
+
+    Composite keys (score ranking with random tie-break, outbound
+    bubble-up — gossipsub.go:1376-1435) are built by the caller into a
+    single float priority.  Ineligible columns never selected.
+    """
+    prio = jnp.where(eligible, priority, -jnp.inf)
+    order = jnp.argsort(-prio, axis=1)
+    ranks = jnp.argsort(order, axis=1)
+    return eligible & (ranks < k[:, None])
 
 
 def propagate(words: jnp.ndarray, nbrs: jnp.ndarray,

@@ -1,0 +1,199 @@
+"""Vectorized GossipSub simulator tests (models/gossipsub.py).
+
+Mirrors the reference's gossipsub_test.go checks at sim scale: mesh degree
+convergence into [Dlo, Dhi], GRAFT/PRUNE handshake symmetry, backoff
+enforcement, full dissemination over the mesh, gossip (IHAVE/IWANT) repair
+for mesh-less peers, and fanout publishing by unsubscribed peers.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSimConfig,
+    GossipState,
+    make_gossip_offsets,
+    make_gossip_sim,
+    make_gossip_step,
+    mesh_degrees,
+    mesh_symmetry_fraction,
+    gossip_run,
+    gossip_run_curve,
+    reach_counts,
+    first_tick_matrix,
+)
+
+
+def build(n=600, t=3, c=16, n_msgs=8, seed=1, subs_mask=None,
+          publish_tick=0, unsubscribe=(), **cfg_kw):
+    cfg = GossipSimConfig(
+        offsets=make_gossip_offsets(t, c, n, seed=seed), n_topics=t,
+        **cfg_kw)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    for p in unsubscribe:
+        subs[p] = False
+    if subs_mask is not None:
+        subs &= subs_mask[:, None]
+    rng = np.random.default_rng(seed)
+    msg_topic = rng.integers(0, t, n_msgs)
+    msg_origin = rng.integers(0, n // t, n_msgs) * t + msg_topic
+    ticks = np.full(n_msgs, publish_tick, dtype=np.int32)
+    params, state = make_gossip_sim(cfg, subs, msg_topic, msg_origin, ticks)
+    return cfg, params, state, msg_topic, msg_origin
+
+
+def test_mesh_degree_converges():
+    cfg, params, state, *_ = build(n_msgs=0)
+    # pad a zero-length message table to one word
+    step = make_gossip_step(cfg)
+    out = gossip_run(params, state, 10, step)
+    deg = np.asarray(mesh_degrees(out))
+    assert (deg[np.asarray(params.subscribed)] >= cfg.d_lo).all()
+    assert (deg[np.asarray(params.subscribed)] <= cfg.d_hi).all()
+
+
+def test_mesh_symmetric_after_each_step():
+    cfg, params, state, *_ = build(n_msgs=0)
+    step = jax.jit(make_gossip_step(cfg))
+    for _ in range(5):
+        state, _ = step(params, state)
+        frac = float(mesh_symmetry_fraction(state, cfg))
+        assert frac == pytest.approx(1.0), frac
+
+
+def test_unsubscribed_peers_stay_out_of_mesh():
+    cfg, params, state, *_ = build(n_msgs=0, unsubscribe=range(0, 60))
+    step = make_gossip_step(cfg)
+    out = gossip_run(params, state, 10, step)
+    deg = np.asarray(mesh_degrees(out))
+    sub = np.asarray(params.subscribed)
+    assert (deg[~sub] == 0).all()
+    assert (deg[sub] >= cfg.d_lo).all()
+
+
+def test_backoff_blocks_regraft():
+    cfg, params, state, *_ = build(n_msgs=0, backoff_ticks=1000)
+    step = jax.jit(make_gossip_step(cfg))
+    for _ in range(3):
+        state, _ = step(params, state)
+    # force-prune everything: clear mesh, set backoff everywhere
+    n, c = state.mesh.shape
+    state = state.replace(
+        mesh=jnp.zeros_like(state.mesh),
+        backoff=jnp.full_like(state.backoff, 10_000))
+    for _ in range(5):
+        state, _ = step(params, state)
+    assert int(mesh_degrees(state).sum()) == 0  # nobody can re-graft
+
+
+def test_full_dissemination_over_mesh():
+    cfg, params, state, msg_topic, _ = build(n=600, t=3, n_msgs=8)
+    step = make_gossip_step(cfg)
+    out = gossip_run(params, state, 40, step)
+    reach = np.asarray(reach_counts(params, out))
+    class_size = 600 // 3
+    np.testing.assert_array_equal(reach, class_size)
+
+
+def test_reach_curve_monotone_and_complete():
+    cfg, params, state, *_ = build(n=600, t=3, n_msgs=8)
+    step = make_gossip_step(cfg)
+    out, counts = gossip_run_curve(params, state, 40, step, 8)
+    counts = np.asarray(counts)  # [ticks, M] per-tick deliveries
+    total = counts.sum(axis=0)
+    np.testing.assert_array_equal(total, 600 // 3)
+    # deliveries start at the publish tick and stop once everyone has it
+    assert (counts[0] >= 1).all()
+    assert (counts[-5:] == 0).all()
+
+
+def test_gossip_repairs_meshless_peers():
+    """Peers that can never graft (eternal backoff both directions) still
+    receive every message via IHAVE/IWANT gossip — the lazy-pull repair
+    path (reference handleIHave/handleIWant gossipsub.go:610-711)."""
+    cfg, params, state, *_ = build(n=600, t=3, n_msgs=8)
+    isolated = np.zeros(600, dtype=bool)
+    isolated[::10] = True  # 10% of peers
+    iso_j = jnp.asarray(isolated)
+    # eternal backoff on every edge touching an isolated peer: they never
+    # graft out, and partners reject their grafts / never graft to them
+    from go_libp2p_pubsub_tpu.models.gossipsub import transfer_mask
+    iso_cols = jnp.broadcast_to(iso_j[:, None], state.backoff.shape)
+    blocked = iso_cols | transfer_mask(iso_cols, cfg)
+    state = state.replace(
+        backoff=jnp.where(blocked, 1_000_000, state.backoff))
+    step = make_gossip_step(cfg)
+    out = gossip_run(params, state, 40, step)
+    deg = np.asarray(mesh_degrees(out))
+    assert (deg[isolated] == 0).all()
+    # every subscriber — including every mesh-less one — still got it
+    reach = np.asarray(reach_counts(params, out))
+    np.testing.assert_array_equal(reach, 600 // 3)
+
+
+def test_fanout_publish_without_subscription():
+    """An unsubscribed publisher floods via fanout (gossipsub.go:961-983)
+    and its fanout set expires FanoutTTL after the last publish."""
+    cfg, params, state, msg_topic, msg_origin = build(
+        n=600, t=3, n_msgs=4, publish_tick=5, fanout_ttl_ticks=10,
+        unsubscribe=set(int(o) for o in
+                        np.random.default_rng(1).integers(0, 200, 4) * 3))
+    # re-point all messages at one known unsubscribed origin
+    origin = int(np.flatnonzero(~np.asarray(params.subscribed))[0])
+    n_msgs = 4
+    topic = origin % 3
+    import numpy as _np
+    origin_bits = _np.zeros((600, n_msgs), dtype=bool)
+    origin_bits[origin, :] = True
+    deliver = _np.asarray(params.subscribed)[:, None] & (
+        (_np.arange(600) % 3 == topic)[:, None])
+    from go_libp2p_pubsub_tpu.ops.graph import pack_bits
+    params = params.replace(
+        origin_words=pack_bits(jnp.asarray(origin_bits)),
+        deliver_words=pack_bits(jnp.asarray(
+            _np.broadcast_to(deliver, (600, n_msgs)))),
+        publish_tick=jnp.full((n_msgs,), 5, dtype=jnp.int32))
+    step = make_gossip_step(cfg)
+    out = gossip_run(params, state, 40, step)
+    reach = np.asarray(reach_counts(params, out))
+    subscribers = int((np.asarray(params.subscribed)
+                       & (np.arange(600) % 3 == topic)).sum())
+    np.testing.assert_array_equal(reach, subscribers)
+    # fanout expired: TTL (10) past last publish (tick 5) < 40 ticks run
+    assert int(out.fanout.sum()) == 0
+
+
+def test_sharded_step_matches_single_device():
+    """The same step over an 8-device peer-sharded mesh is bit-identical
+    to the single-device run (pjit + roll -> collective permutes)."""
+    from go_libp2p_pubsub_tpu.parallel.mesh import make_mesh, shard_peer_tree
+
+    cfg, params, state, *_ = build(n=512, t=2, c=8, n_msgs=8,
+                                   d=3, d_lo=2, d_hi=6, d_lazy=2)
+    step = make_gossip_step(cfg)
+    out_single = gossip_run(params, state, 12, step)
+
+    mesh = make_mesh(8)
+    params_s = shard_peer_tree(params, mesh, 512)
+    state_s = shard_peer_tree(state, mesh, 512)
+    out_shard = gossip_run(params_s, state_s, 12, step)
+
+    np.testing.assert_array_equal(np.asarray(out_single.have),
+                                  np.asarray(out_shard.have))
+    np.testing.assert_array_equal(np.asarray(out_single.mesh),
+                                  np.asarray(out_shard.mesh))
+    np.testing.assert_array_equal(np.asarray(out_single.first_tick),
+                                  np.asarray(out_shard.first_tick))
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        GossipSimConfig(offsets=(3, -3), n_topics=2)  # not mult of T
+    with pytest.raises(ValueError):
+        GossipSimConfig(offsets=(2, 4), n_topics=2)   # not negation-closed
+    with pytest.raises(ValueError):
+        GossipSimConfig(offsets=tuple(range(-6, 0)) + tuple(range(1, 7)),
+                        n_topics=1, d_hi=12)          # C <= Dhi

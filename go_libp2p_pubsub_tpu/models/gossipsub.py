@@ -49,6 +49,7 @@ from ..ops.graph import (
     count_bits_per_position,
     make_circulant_offsets,
     pack_bits,
+    select_k_by_priority,
     select_k_per_row,
 )
 from ._delivery import (
@@ -73,6 +74,8 @@ class GossipSimConfig:
     d: int = 6                     # GossipSubD
     d_lo: int = 5                  # GossipSubDlo
     d_hi: int = 12                 # GossipSubDhi
+    d_score: int = 4               # GossipSubDscore (v1.1 prune retention)
+    d_out: int = 2                 # GossipSubDout (outbound quota)
     d_lazy: int = 6                # GossipSubDlazy
     gossip_factor: float = 0.25    # GossipSubGossipFactor
     history_gossip: int = 3        # GossipSubHistoryGossip (IHAVE window)
@@ -89,6 +92,11 @@ class GossipSimConfig:
             raise ValueError("offsets must be multiples of n_topics")
         if not (self.d_lo <= self.d <= self.d_hi):
             raise ValueError("need Dlo <= D <= Dhi (gossipsub.go:33-35)")
+        if self.d_score > self.d:
+            raise ValueError("need Dscore <= D")
+        if self.d_out >= self.d_lo or self.d_out > self.d // 2:
+            raise ValueError(
+                "need Dout < Dlo and Dout <= D/2 (gossipsub.go:266-272)")
         if self.d_hi >= len(offs):
             raise ValueError("need C > Dhi candidate columns")
 
@@ -115,6 +123,97 @@ def make_gossip_offsets(n_topics: int, n_candidates: int, n_peers: int,
     return tuple(int(o) for o in offs)
 
 
+@dataclass(frozen=True)
+class ScoreSimConfig:
+    """Static v1.1 hardening config: the peer-score formula (P1..P7,
+    score.go:256-333), thresholds (score_params.go:12-32), and the sybil
+    behavior toggles for adversarial runs (gossipsub_spam_test.go).
+
+    Decays are per-tick factors (one tick = one heartbeat); the reference's
+    ScoreParameterDecay math (score_params.go:277-287) converts wall-clock
+    decays to this form.  Weights follow the reference's sign invariants
+    (score_params.go:34-268): P1/P2/P5 >= 0, P3/P3b/P4/P6/P7 <= 0.
+    """
+
+    topic_weight: float = 1.0
+    # P1: time in mesh (capped ramp)
+    time_in_mesh_weight: float = 0.1
+    time_in_mesh_quantum: int = 1           # ticks per unit
+    time_in_mesh_cap: float = 10.0
+    # P2: first message deliveries (decaying, capped counter)
+    first_message_deliveries_weight: float = 1.0
+    first_message_deliveries_decay: float = 0.9
+    first_message_deliveries_cap: float = 50.0
+    # P3: mesh message delivery deficit (squared, below threshold, only
+    # after the edge has been in the mesh for the activation window).
+    # Weight defaults to 0 (disabled): like the reference — which ships
+    # no default score params at all — P3's threshold must be calibrated
+    # to the topic's expected message rate, or quiet meshes churn.
+    mesh_message_deliveries_weight: float = 0.0
+    mesh_message_deliveries_decay: float = 0.9
+    mesh_message_deliveries_cap: float = 20.0
+    mesh_message_deliveries_threshold: float = 1.0
+    mesh_message_deliveries_activation: int = 5   # ticks
+    # P3b: sticky failure penalty applied at prune time
+    mesh_failure_penalty_weight: float = 0.0
+    mesh_failure_penalty_decay: float = 0.9
+    # P4: invalid message deliveries (squared)
+    invalid_message_deliveries_weight: float = -10.0
+    invalid_message_deliveries_decay: float = 0.95
+    # P5: application-specific (per-peer value supplied in params)
+    app_specific_weight: float = 1.0
+    # P6: IP colocation (squared surplus over threshold)
+    ip_colocation_factor_weight: float = -5.0
+    ip_colocation_factor_threshold: float = 1.0
+    # P7: behavioural penalty (squared surplus; broken IWANT promises +
+    # GRAFT-during-backoff violations, gossipsub.go:747-765,1566-1571)
+    behaviour_penalty_weight: float = -10.0
+    behaviour_penalty_decay: float = 0.9
+    behaviour_penalty_threshold: float = 0.0
+    decay_to_zero: float = 0.01
+    # thresholds (PeerScoreThresholds, score_params.go:12-32)
+    gossip_threshold: float = -10.0
+    publish_threshold: float = -50.0
+    graylist_threshold: float = -80.0
+    opportunistic_graft_threshold: float = 1.0
+    opportunistic_graft_ticks: int = 60
+    opportunistic_graft_peers: int = 2
+    # router options
+    flood_publish: bool = False             # WithFloodPublish
+    # sybil behavior toggles (peers flagged sybil in params)
+    sybil_ihave_spam: bool = False          # broken-promise IWANT flood
+    sybil_graft_flood: bool = False         # re-GRAFT while backed off
+
+    def validate(self) -> None:
+        """The reference's sign/range invariants are free tests
+        (score_params.go:34-268)."""
+        if self.topic_weight < 0:
+            raise ValueError("topic_weight must be >= 0")
+        for name in ("time_in_mesh_weight", "first_message_deliveries_weight",
+                     "app_specific_weight"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"{name} must be >= 0")
+        for name in ("mesh_message_deliveries_weight",
+                     "mesh_failure_penalty_weight",
+                     "invalid_message_deliveries_weight",
+                     "ip_colocation_factor_weight",
+                     "behaviour_penalty_weight"):
+            if getattr(self, name) > 0:
+                raise ValueError(f"{name} must be <= 0")
+        for name in ("first_message_deliveries_decay",
+                     "mesh_message_deliveries_decay",
+                     "mesh_failure_penalty_decay",
+                     "invalid_message_deliveries_decay",
+                     "behaviour_penalty_decay"):
+            d = getattr(self, name)
+            if not (0 < d < 1):
+                raise ValueError(f"{name} must be in (0, 1)")
+        if not (self.graylist_threshold <= self.publish_threshold
+                <= self.gossip_threshold <= 0):
+            raise ValueError(
+                "need graylist <= publish <= gossip threshold <= 0")
+
+
 # --------------------------------------------------------------------------
 # Pytrees
 # --------------------------------------------------------------------------
@@ -122,13 +221,36 @@ def make_gossip_offsets(n_topics: int, n_candidates: int, n_peers: int,
 
 @struct.dataclass
 class GossipParams:
-    """Per-simulation device arrays (dynamic operands of the jitted step)."""
+    """Per-simulation device arrays (dynamic operands of the jitted step).
+
+    The v1.1 fields (None when scoring is off) carry per-CANDIDATE views of
+    static per-peer attributes: column c of row p describes peer p+o_c.
+    """
 
     subscribed: jnp.ndarray      # bool [N]: has a local subscription
     cand_subscribed: jnp.ndarray # bool [N, C]: candidate q=p+o_c subscribed
     origin_words: jnp.ndarray    # uint32 [N, W]: bit m set at origin[m]
     deliver_words: jnp.ndarray   # uint32 [N, W]: msg m counts as delivery
     publish_tick: jnp.ndarray    # int32 [M]
+    invalid_words: jnp.ndarray | None = None  # uint32 [W]: msg fails validation
+    cand_app_score: jnp.ndarray | None = None # f32 [N, C]: P5 of candidate
+    cand_colo_excess: jnp.ndarray | None = None  # f32 [N, C]: P6 surplus
+    cand_sybil: jnp.ndarray | None = None     # bool [N, C]: candidate is sybil
+    sybil: jnp.ndarray | None = None          # bool [N]
+
+
+@struct.dataclass
+class ScoreState:
+    """Per-edge v1.1 reputation counters: row p, column c = p's view of
+    candidate p+o_c (the score engine's per-(peer, topic) stats,
+    score.go:95-118, densified on the candidate axis)."""
+
+    time_in_mesh: jnp.ndarray        # f32 [N, C] ticks since graft (P1)
+    first_deliveries: jnp.ndarray    # f32 [N, C] decaying counter (P2)
+    mesh_deliveries: jnp.ndarray     # f32 [N, C] decaying counter (P3)
+    mesh_failure_penalty: jnp.ndarray  # f32 [N, C] sticky deficit² (P3b)
+    invalid_deliveries: jnp.ndarray  # f32 [N, C] decaying counter (P4)
+    behaviour_penalty: jnp.ndarray   # f32 [N, C] decaying counter (P7)
 
 
 @struct.dataclass
@@ -140,6 +262,7 @@ class GossipState:
     have: jnp.ndarray        # uint32 [N, W]
     recent: jnp.ndarray      # uint32 [N, Hg, W] newly-acquired ring (mcache)
     first_tick: jnp.ndarray  # int16 [N, W, 32] or None
+    scores: ScoreState | None  # None when v1.1 scoring is disabled
     key: jax.Array           # PRNG key
     tick: jnp.ndarray        # int32 scalar
 
@@ -147,10 +270,25 @@ class GossipState:
 def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
                     msg_topic: np.ndarray, msg_origin: np.ndarray,
                     msg_publish_tick: np.ndarray, seed: int = 0,
-                    track_first_tick: bool = True):
+                    track_first_tick: bool = True,
+                    score_cfg: ScoreSimConfig | None = None,
+                    app_score: np.ndarray | None = None,
+                    peer_ip: np.ndarray | None = None,
+                    sybil: np.ndarray | None = None,
+                    msg_invalid: np.ndarray | None = None):
     """Build (params, state).  subs: bool [N, T] — but each peer may only
     subscribe to its residue-class topic (circulant classes are closed, so
-    cross-class subscriptions would never receive anything)."""
+    cross-class subscriptions would never receive anything).
+
+    With score_cfg, the v1.1 reputation layer is enabled:
+    - app_score [N] f32: P5 application-specific score per peer
+    - peer_ip [N] int: IP assignment; peers sharing an IP accrue the P6
+      colocation penalty (sybils behind one address share fate,
+      score.go:967-1007)
+    - sybil [N] bool: peers running the configured attack behaviors
+    - msg_invalid [M] bool: messages that fail validation (P4 + no
+      forwarding, validation.go:274-351)
+    """
     n, t = subs.shape
     if t != cfg.n_topics:
         raise ValueError("subs topic dim != cfg.n_topics")
@@ -168,17 +306,44 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
     deliver_bits = subscribed[:, None] & (own_topic[:, None]
                                           == msg_topic[None, :])
 
-    cand_sub = np.stack([np.roll(subscribed, o) for o in cfg.offsets],
-                        axis=1)
+    def cand_view(per_peer):
+        """Per-candidate view: out[p, c] = per_peer[p + o_c]."""
+        return np.stack([np.roll(per_peer, -o) for o in cfg.offsets], axis=1)
+
+    kw = {}
+    if score_cfg is not None:
+        score_cfg.validate()
+        app = (np.zeros(n, dtype=np.float32) if app_score is None
+               else np.asarray(app_score, dtype=np.float32))
+        syb = (np.zeros(n, dtype=bool) if sybil is None
+               else np.asarray(sybil, dtype=bool))
+        if peer_ip is None:
+            peer_ip = np.arange(n)  # everyone on their own address
+        _, ip_idx = np.unique(np.asarray(peer_ip), return_inverse=True)
+        colo_count = np.bincount(ip_idx)[ip_idx].astype(np.float32)
+        colo_excess = np.maximum(
+            0.0, colo_count - score_cfg.ip_colocation_factor_threshold)
+        inv = (np.zeros(m, dtype=bool) if msg_invalid is None
+               else np.asarray(msg_invalid, dtype=bool))
+        kw = dict(
+            invalid_words=pack_bits(jnp.asarray(inv)),
+            cand_app_score=jnp.asarray(cand_view(app)),
+            cand_colo_excess=jnp.asarray(cand_view(colo_excess)),
+            cand_sybil=jnp.asarray(cand_view(syb)),
+            sybil=jnp.asarray(syb),
+        )
+
     params = GossipParams(
         subscribed=jnp.asarray(subscribed),
-        cand_subscribed=jnp.asarray(cand_sub),
+        cand_subscribed=jnp.asarray(cand_view(subscribed)),
         origin_words=pack_bits(jnp.asarray(origin_bits)),
         deliver_words=pack_bits(jnp.asarray(deliver_bits)),
         publish_tick=jnp.asarray(msg_publish_tick, dtype=jnp.int32),
+        **kw,
     )
     w = params.origin_words.shape[1]
     c = cfg.n_candidates
+    zc = lambda: jnp.zeros((n, c), dtype=jnp.float32)  # noqa: E731
     state = GossipState(
         mesh=jnp.zeros((n, c), dtype=bool),
         fanout=jnp.zeros((n, c), dtype=bool),
@@ -188,6 +353,10 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
         recent=jnp.zeros((n, cfg.history_gossip, w), dtype=jnp.uint32),
         first_tick=(jnp.full((n, w, WORD_BITS), -1, dtype=jnp.int16)
                     if track_first_tick else None),
+        scores=(ScoreState(time_in_mesh=zc(), first_deliveries=zc(),
+                           mesh_deliveries=zc(), mesh_failure_penalty=zc(),
+                           invalid_deliveries=zc(), behaviour_penalty=zc())
+                if score_cfg is not None else None),
         key=jax.random.PRNGKey(seed),
         tick=jnp.zeros((), dtype=jnp.int32),
     )
@@ -234,7 +403,36 @@ def masked_word_or(words: jnp.ndarray, mask: jnp.ndarray,
 # --------------------------------------------------------------------------
 
 
-def make_gossip_step(cfg: GossipSimConfig):
+def compute_scores(sc: ScoreSimConfig, params: GossipParams,
+                   st: GossipState) -> jnp.ndarray:
+    """The peer-score formula, densified: f32 [N, C] — row p's opinion of
+    candidate p+o_c (score.go:256-333).  One topic per peer, so the
+    per-topic sum collapses to the single topic's contribution."""
+    s = st.scores
+    p1 = jnp.minimum(s.time_in_mesh / sc.time_in_mesh_quantum,
+                     sc.time_in_mesh_cap)
+    p2 = s.first_deliveries                    # capped at increment time
+    deficit = jnp.maximum(
+        0.0, sc.mesh_message_deliveries_threshold - s.mesh_deliveries)
+    active = s.time_in_mesh > sc.mesh_message_deliveries_activation
+    p3 = jnp.where(st.mesh & active, deficit * deficit, 0.0)
+    topic = (sc.time_in_mesh_weight * p1
+             + sc.first_message_deliveries_weight * p2
+             + sc.mesh_message_deliveries_weight * p3
+             + sc.mesh_failure_penalty_weight * s.mesh_failure_penalty
+             + sc.invalid_message_deliveries_weight
+             * s.invalid_deliveries * s.invalid_deliveries)
+    bp_excess = jnp.maximum(
+        0.0, s.behaviour_penalty - sc.behaviour_penalty_threshold)
+    return (sc.topic_weight * topic
+            + sc.app_specific_weight * params.cand_app_score
+            + sc.ip_colocation_factor_weight
+            * params.cand_colo_excess * params.cand_colo_excess
+            + sc.behaviour_penalty_weight * bp_excess * bp_excess)
+
+
+def make_gossip_step(cfg: GossipSimConfig,
+                     score_cfg: ScoreSimConfig | None = None):
     """Build the jittable (params, state) -> (state, delivered_words) core.
 
     Per tick:
@@ -247,14 +445,57 @@ def make_gossip_step(cfg: GossipSimConfig):
       4. heartbeat maintenance: graft to D when deg<Dlo, prune to D when
          deg>Dhi, GRAFT/PRUNE handshake with backoff, fanout TTL
          (heartbeat gossipsub.go:1299-1552)
+
+    With score_cfg, the v1.1 hardening layer is woven through every phase:
+    start-of-tick scores gate inbound RPCs (graylist), gossip exchange
+    (gossip threshold), and publish flooding (publish threshold); delivery
+    provenance per candidate column feeds the P2/P3/P4 counters; mesh
+    maintenance prunes negative-score peers, keeps the Dscore best + Dout
+    outbound on oversubscription (gossipsub.go:1376-1435), and
+    opportunistically grafts when the mesh median sags
+    (gossipsub.go:1467-1498); a RED gater drops payload from edges with
+    bad goodput under invalid-traffic pressure (peer_gater.go:320-363).
     """
     C = cfg.n_candidates
+    sc = score_cfg
+    outbound_cols = jnp.asarray(
+        np.array([o > 0 for o in cfg.offsets]))    # we dial positive offsets
 
     def step(params: GossipParams, state: GossipState):
-        key, k_gossip, k_graft, k_prune, k_fanout = jax.random.split(
-            state.key, 5)
+        key, k_gossip, k_graft, k_prune, k_fanout, k_og, k_gater = \
+            jax.random.split(state.key, 7)
         tick = state.tick
         sub = params.subscribed
+        n = sub.shape[0]
+
+        # -- 0. start-of-tick scores and the gates they drive -----------
+        if sc is not None:
+            score = compute_scores(sc, params, state)           # [N, C]
+            # graylist: drop ALL inbound on edges below the graylist
+            # threshold (AcceptFrom, gossipsub.go:584-586)
+            edge_accept = score >= sc.graylist_threshold
+            gossip_ok = score >= sc.gossip_threshold
+            # RED gater: under invalid-traffic pressure, payload from an
+            # edge is accepted with its goodput probability
+            # (peer_gater.go:320-363; stats per edge, decayed with the
+            # score counters — sybils behind one IP already share fate
+            # via P6)
+            s0 = state.scores
+            inv_tot = s0.invalid_deliveries.sum(axis=1)
+            del_tot = s0.first_deliveries.sum(axis=1)
+            pressure = 16.0 * inv_tot / (1.0 + del_tot + 16.0 * inv_tot)
+            gater_on = pressure > 0.33
+            goodput = ((1.0 + s0.first_deliveries)
+                       / (1.0 + s0.first_deliveries
+                          + 16.0 * s0.invalid_deliveries))
+            p_accept = jnp.where(gater_on[:, None], goodput, 1.0)
+            gater_ok = jax.random.uniform(k_gater, (n, C)) < p_accept
+            payload_ok = edge_accept & gater_ok
+            valid_words = ~params.invalid_words[None, :]        # [1, W]
+        else:
+            score = None
+            edge_accept = gossip_ok = payload_ok = None
+            valid_words = None
 
         # -- 1. publish injection ---------------------------------------
         due = pack_bits(params.publish_tick == tick)            # [W]
@@ -271,35 +512,109 @@ def make_gossip_step(cfg: GossipSimConfig):
         fanout = state.fanout & alive[:, None]
         f_deg = fanout.sum(axis=1, dtype=jnp.int32)
         f_need = jnp.where(alive, cfg.d - f_deg, 0)
-        fanout = fanout | select_k_per_row(
-            params.cand_subscribed & ~fanout, f_need, k_fanout)
+        f_elig = params.cand_subscribed & ~fanout
+        if sc is not None:  # fanout requires score >= publish threshold
+            f_elig = f_elig & (score >= sc.publish_threshold)
+        fanout = fanout | select_k_per_row(f_elig, f_need, k_fanout)
 
-        # -- 2. eager mesh forward --------------------------------------
-        # what I acquired last tick + my fresh publishes go to my mesh
-        # (or fanout when publishing unsubscribed)
+        # -- 2. eager forward with per-edge provenance ------------------
+        # What I acquired last tick + my fresh publishes go to my mesh /
+        # fanout (forwardMessage, gossipsub.go:989-999).  Honest peers
+        # never forward invalid messages (validation rejects them before
+        # the router sees them, validation.go:274-351); sybils do.
         fresh = state.recent[:, 0] | injected
+        if sc is not None:
+            fresh = jnp.where(params.sybil[:, None], fresh,
+                              fresh & valid_words)
         out_edges = state.mesh | fanout
-        heard = masked_word_or(fresh, out_edges, cfg)
-        new_mesh_bits = heard & ~state.have & ~injected
-        new_mesh_bits = jnp.where(sub[:, None], new_mesh_bits,
-                                  jnp.uint32(0))
+        if sc is not None and sc.flood_publish:
+            # own publishes additionally flood to every candidate above
+            # the publish threshold (gossipsub.go:953-959)
+            flood_edges = params.cand_subscribed & (
+                score >= sc.publish_threshold)
+        else:
+            flood_edges = None
+
+        have_start = state.have
+        claimed = injected          # first-arrival provenance accumulator
+        fd_add = [None] * C         # per-receiver-column popcounts
+        md_new = [None] * C
+        inv_add = [None] * C
+        for c_send, off in enumerate(cfg.offsets):
+            j = cfg.cinv[c_send]    # receiver-side column for this edge
+            sent = jnp.where(out_edges[:, c_send, None], fresh,
+                             jnp.uint32(0))
+            if flood_edges is not None:
+                sent = sent | jnp.where(flood_edges[:, c_send, None],
+                                        injected, jnp.uint32(0))
+            rolled = jnp.roll(sent, off, axis=0)
+            if sc is not None:
+                rolled = jnp.where(payload_ok[:, j, None], rolled,
+                                   jnp.uint32(0))
+            news = rolled & ~have_start & ~claimed
+            claimed = claimed | news
+            if sc is not None:
+                # P2/P4 credit the first deliverer only (later copies are
+                # dropped at the seen-cache, pubsub.go:851-868); P3 also
+                # counts same-tick near-first copies from mesh members
+                # (deliveries window, score.go:684-818)
+                fd_add[j] = _popcount_rows(news & valid_words)
+                md_new[j] = _popcount_rows(rolled & valid_words
+                                           & ~have_start)
+                inv_add[j] = _popcount_rows(news & ~valid_words)
+        heard_new = claimed & ~injected
+        new_mesh_bits = jnp.where(sub[:, None], heard_new, jnp.uint32(0))
 
         # -- 3. lazy gossip (IHAVE/IWANT collapsed to one exchange) -----
         # advertise ids seen in the last HistoryGossip windows; targets =
-        # random non-mesh subscribed candidates, max(Dlazy, factor*elig)
+        # random non-mesh subscribed candidates, max(Dlazy, factor*elig),
+        # both sides above the gossip threshold (gossipsub.go:1656-1712)
         adv = jax.lax.reduce_or(state.recent, axes=(1,)) | injected
+        if sc is not None:
+            adv = jnp.where(params.sybil[:, None], adv, adv & valid_words)
         elig = params.cand_subscribed & ~state.mesh & ~state.fanout
         elig = elig & sub[:, None]          # only subscribed peers gossip
+        if sc is not None:
+            elig = elig & gossip_ok
         n_elig = elig.sum(axis=1, dtype=jnp.int32)
         n_gossip = jnp.maximum(
             jnp.int32(cfg.d_lazy),
             (cfg.gossip_factor * n_elig.astype(jnp.float32)).astype(
                 jnp.int32))
         targets = select_k_per_row(elig, n_gossip, k_gossip)
-        gossip_heard = masked_word_or(adv, targets, cfg)
-        new_gossip_bits = (gossip_heard & ~state.have & ~injected
-                           & ~new_mesh_bits)
-        new_gossip_bits = jnp.where(sub[:, None], new_gossip_bits,
+        if sc is not None and sc.sybil_ihave_spam:
+            # IHAVE-spamming sybils advertise ids they never deliver
+            # (gossipsub_spam_test.go:135): their gossip carries nothing,
+            # and each spammed peer records a broken promise -> P7
+            # (gossip_tracer.go:48-117, applyIwantPenalties)
+            sybil_send = params.sybil[:, None] & params.cand_subscribed
+            targets = jnp.where(params.sybil[:, None], sybil_send, targets)
+        claimed_g = claimed
+        bp_spam = None
+        for c_send, off in enumerate(cfg.offsets):
+            j = cfg.cinv[c_send]
+            send_mask = targets[:, c_send]
+            if sc is not None and sc.sybil_ihave_spam:
+                send_mask = send_mask & ~params.sybil
+            sent = jnp.where(send_mask[:, None], adv, jnp.uint32(0))
+            rolled = jnp.roll(sent, off, axis=0)
+            if sc is not None:
+                ok = payload_ok[:, j] & gossip_ok[:, j]
+                rolled = jnp.where(ok[:, None], rolled, jnp.uint32(0))
+            news = rolled & ~have_start & ~claimed_g
+            claimed_g = claimed_g | news
+            if sc is not None:
+                # IWANT-pulled messages go through validation like any
+                # other delivery: P2 credit for valid, P4 for invalid
+                fd_add[j] = fd_add[j] + _popcount_rows(news & valid_words)
+                inv_add[j] = inv_add[j] + _popcount_rows(
+                    news & ~valid_words)
+        if sc is not None and sc.sybil_ihave_spam:
+            # broken-promise bookkeeping: one P7 unit per sybil IHAVE spam
+            spam_recv = transfer_mask(
+                targets & params.sybil[:, None], cfg)
+            bp_spam = spam_recv.astype(jnp.float32)
+        new_gossip_bits = jnp.where(sub[:, None], claimed_g & ~claimed,
                                     jnp.uint32(0))
 
         new_acquired = new_mesh_bits | new_gossip_bits | injected
@@ -308,35 +623,101 @@ def make_gossip_step(cfg: GossipSimConfig):
             [new_acquired[:, None, :], state.recent[:, :-1]], axis=1)
 
         delivered_now = new_acquired & params.deliver_words
+        if sc is not None:
+            delivered_now = delivered_now & valid_words
         first_tick = update_first_tick(state.first_tick, delivered_now,
                                        tick)
 
         # -- 4. heartbeat maintenance -----------------------------------
         mesh, backoff = state.mesh, state.backoff
         in_backoff = backoff > tick
+        mesh_before = mesh
+
+        if sc is not None:
+            # drop negative-score mesh members first (gossipsub.go:1332)
+            neg = mesh & (score < 0)
+            mesh = mesh & ~neg
+            backoff = jnp.where(neg, tick + cfg.backoff_ticks, backoff)
+        else:
+            neg = None
         deg = mesh.sum(axis=1, dtype=jnp.int32)
 
-        # graft up to D when deg < Dlo (gossipsub.go:1340-1360)
+        # graft up to D when deg < Dlo (gossipsub.go:1340-1360);
+        # candidates need score >= 0 in v1.1
         can_graft = (params.cand_subscribed & ~mesh & ~in_backoff
                      & sub[:, None])
+        if sc is not None:
+            can_graft = can_graft & (score >= 0)
         need = jnp.where(deg < cfg.d_lo, cfg.d - deg, 0)
         grafts = select_k_per_row(can_graft, need, k_graft)
 
-        # prune down to D when deg > Dhi, random retention (v1.0 keeps a
-        # random D; score ranking is the v1.1 extension,
-        # gossipsub.go:1362-1435)
-        keep = select_k_per_row(mesh, jnp.full_like(deg, cfg.d), k_prune)
+        # prune down to D when deg > Dhi.  v1.0: random retention; v1.1:
+        # keep the Dscore best by score, then at least Dout outbound,
+        # random fill to D (anti-sybil bubble-up, gossipsub.go:1376-1435)
+        if sc is None:
+            keep = select_k_per_row(mesh, jnp.full_like(deg, cfg.d),
+                                    k_prune)
+        else:
+            rnd = jax.random.uniform(k_prune, (n, C))
+            top = select_k_by_priority(mesh, score,
+                                       jnp.full_like(deg, cfg.d_score),
+                                       tiebreak=rnd)
+            out_cols = jnp.broadcast_to(outbound_cols[None, :], (n, C))
+            n_out_top = (top & out_cols).sum(axis=1, dtype=jnp.int32)
+            need_out = jnp.maximum(0, cfg.d_out - n_out_top)
+            out_keep = select_k_by_priority(mesh & ~top & out_cols, rnd,
+                                            need_out)
+            taken = top | out_keep
+            n_taken = taken.sum(axis=1, dtype=jnp.int32)
+            fill = select_k_by_priority(mesh & ~taken, rnd,
+                                        jnp.maximum(cfg.d - n_taken, 0))
+            keep = taken | fill
         prunes = mesh & ~keep & (deg > cfg.d_hi)[:, None]
+
+        if sc is not None:
+            # opportunistic grafting: when the mesh's median score sags
+            # below the threshold, graft extra high-scoring peers
+            # (gossipsub.go:1467-1498); median via sort + one-hot (no
+            # gathers)
+            do_og = (tick % sc.opportunistic_graft_ticks) == 0
+            s_sorted = jnp.sort(jnp.where(mesh, score, jnp.inf), axis=1)
+            onehot = (jnp.arange(C)[None, :] == (deg // 2)[:, None])
+            median = jnp.where(deg > 0,
+                               (jnp.where(onehot, s_sorted, 0.0)).sum(1),
+                               0.0)
+            og_row = (do_og & (median < sc.opportunistic_graft_threshold)
+                      & sub)
+            og_elig = (can_graft & ~grafts
+                       & (score > median[:, None]))
+            og_need = jnp.where(og_row, sc.opportunistic_graft_peers, 0)
+            grafts = grafts | select_k_per_row(og_elig, og_need, k_og)
+
+        if sc is not None and sc.sybil_graft_flood:
+            # GRAFT-flooding sybils re-graft every tick, ignoring their
+            # own backoff (gossipsub_spam_test.go:349)
+            sybil_grafts = (params.cand_subscribed & ~mesh
+                            & params.sybil[:, None])
+            grafts = jnp.where(params.sybil[:, None], sybil_grafts, grafts)
 
         mesh = (mesh | grafts) & ~prunes
         backoff = jnp.where(prunes, tick + cfg.backoff_ticks, backoff)
 
-        # handshake: partner accepts GRAFT unless unsubscribed or it has
-        # us backed off (handleGraft gossipsub.go:713-804); PRUNE always
-        # removes + backs off (handlePrune :806-838)
+        # handshake: partner accepts GRAFT unless unsubscribed, backed
+        # off, or (v1.1) negative-scored (handleGraft gossipsub.go:713-
+        # 804); PRUNE always removes + backs off (handlePrune :806-838).
+        # Negative-score prunes notify the partner too (the reference
+        # sends PRUNE for every mesh removal, gossipsub.go:1332-1338).
         graft_recv = transfer_mask(grafts, cfg)
-        prune_recv = transfer_mask(prunes, cfg)
+        prune_recv = transfer_mask(prunes if neg is None else prunes | neg,
+                                   cfg)
+        if sc is not None:
+            # graylisted peers' control traffic is dropped outright
+            graft_recv = graft_recv & edge_accept
+            prune_recv = prune_recv & edge_accept
+        backoff_violation = graft_recv & (backoff > tick)
         accept = graft_recv & sub[:, None] & ~(backoff > tick)
+        if sc is not None:
+            accept = accept & (score >= 0)
         reject = graft_recv & ~accept
         mesh = (mesh | accept) & ~prune_recv
         backoff = jnp.where(prune_recv,
@@ -349,13 +730,59 @@ def make_gossip_step(cfg: GossipSimConfig):
             reject_back, jnp.maximum(backoff, tick + cfg.backoff_ticks),
             backoff)
 
+        # -- 5. score counter updates + decay ---------------------------
+        scores = state.scores
+        if sc is not None:
+            s0 = state.scores
+            fd = jnp.minimum(
+                s0.first_deliveries + jnp.stack(fd_add, axis=1),
+                sc.first_message_deliveries_cap)
+            md = jnp.minimum(
+                s0.mesh_deliveries
+                + jnp.stack(md_new, axis=1) * mesh_before,
+                sc.mesh_message_deliveries_cap)
+            inv = s0.invalid_deliveries + jnp.stack(inv_add, axis=1)
+            # P3b: an edge pruned while active with a delivery deficit
+            # keeps the deficit² as a sticky penalty (score.go Prune)
+            removed = mesh_before & ~mesh
+            was_active = (s0.time_in_mesh
+                          > sc.mesh_message_deliveries_activation)
+            deficit = jnp.maximum(
+                0.0, sc.mesh_message_deliveries_threshold - md)
+            mfp = s0.mesh_failure_penalty + jnp.where(
+                removed & was_active, deficit * deficit, 0.0)
+            # P7: backoff violations + broken gossip promises
+            bp = s0.behaviour_penalty + backoff_violation.astype(
+                jnp.float32)
+            if bp_spam is not None:
+                bp = bp + bp_spam
+            # decay (refreshScores, score.go:495-556)
+            def dk(x, decay):
+                x = x * decay
+                return jnp.where(x < sc.decay_to_zero, 0.0, x)
+            scores = ScoreState(
+                time_in_mesh=jnp.where(mesh, s0.time_in_mesh + 1.0, 0.0),
+                first_deliveries=dk(fd, sc.first_message_deliveries_decay),
+                mesh_deliveries=dk(md, sc.mesh_message_deliveries_decay),
+                mesh_failure_penalty=dk(mfp, sc.mesh_failure_penalty_decay),
+                invalid_deliveries=dk(
+                    inv, sc.invalid_message_deliveries_decay),
+                behaviour_penalty=dk(bp, sc.behaviour_penalty_decay),
+            )
+
         new_state = GossipState(
             mesh=mesh, fanout=fanout, last_pub=last_pub, backoff=backoff,
-            have=have, recent=recent, first_tick=first_tick, key=key,
-            tick=tick + 1)
+            have=have, recent=recent, first_tick=first_tick, scores=scores,
+            key=key, tick=tick + 1)
         return new_state, delivered_now
 
     return step
+
+
+def _popcount_rows(words: jnp.ndarray) -> jnp.ndarray:
+    """Total set bits per row: uint32 [N, W] -> f32 [N]."""
+    return jax.lax.population_count(words).sum(
+        axis=1, dtype=jnp.int32).astype(jnp.float32)
 
 
 # --------------------------------------------------------------------------

@@ -189,15 +189,22 @@ def select_k_per_row(eligible: jnp.ndarray, k: jnp.ndarray,
 
 
 def select_k_by_priority(eligible: jnp.ndarray, priority: jnp.ndarray,
-                         k: jnp.ndarray) -> jnp.ndarray:
+                         k: jnp.ndarray,
+                         tiebreak: jnp.ndarray | None = None) -> jnp.ndarray:
     """Select up to k[i] eligible columns per row by DESCENDING priority.
 
-    Composite keys (score ranking with random tie-break, outbound
-    bubble-up — gossipsub.go:1376-1435) are built by the caller into a
-    single float priority.  Ineligible columns never selected.
+    Used for score ranking with random tie-break and outbound bubble-up
+    (gossipsub.go:1376-1435).  Ineligible columns are never selected.
+    ``tiebreak`` (ascending) breaks priority ties LEXICOGRAPHICALLY — not
+    folded into the float, where adding a small random term to a large
+    score would be absorbed by float32 rounding and ties would fall back
+    to column order.
     """
     prio = jnp.where(eligible, priority, -jnp.inf)
-    order = jnp.argsort(-prio, axis=1)
+    if tiebreak is None:
+        order = jnp.argsort(-prio, axis=1)
+    else:
+        order = jnp.lexsort((tiebreak, -prio), axis=1)
     ranks = jnp.argsort(order, axis=1)
     return eligible & (ranks < k[:, None])
 

@@ -171,8 +171,8 @@ def test_sharded_step_matches_single_device():
     to the single-device run (pjit + roll -> collective permutes)."""
     from go_libp2p_pubsub_tpu.parallel.mesh import make_mesh, shard_peer_tree
 
-    cfg, params, state, *_ = build(n=512, t=2, c=8, n_msgs=8,
-                                   d=3, d_lo=2, d_hi=6, d_lazy=2)
+    cfg, params, state, *_ = build(n=512, t=2, c=8, n_msgs=8, d=3, d_lo=2,
+                                   d_hi=6, d_score=2, d_out=1, d_lazy=2)
     step = make_gossip_step(cfg)
     out_single = gossip_run(params, state, 12, step)
 

@@ -1,0 +1,243 @@
+"""GossipSub v1.1 hardening tests for the vectorized simulator.
+
+Sim-scale counterparts of the reference's score/attack tests
+(score_test.go, gossipsub_spam_test.go): P1-P7 score dynamics, graylist
+enforcement, score-ranked prune retention, invalid-message spam collapsing
+the spammer's score, IHAVE-spam broken-promise penalties, and
+GRAFT-flood backoff violations.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSimConfig,
+    ScoreSimConfig,
+    compute_scores,
+    make_gossip_offsets,
+    make_gossip_sim,
+    make_gossip_step,
+    mesh_degrees,
+    gossip_run,
+    reach_counts,
+)
+
+import pytest
+
+
+def build(n=600, t=3, c=16, n_msgs=8, seed=1, score_kw=None, sim_kw=None,
+          msgs_per_tick=False, **cfg_kw):
+    cfg = GossipSimConfig(
+        offsets=make_gossip_offsets(t, c, n, seed=seed), n_topics=t,
+        **cfg_kw)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    rng = np.random.default_rng(seed)
+    msg_topic = rng.integers(0, t, n_msgs)
+    msg_origin = rng.integers(0, n // t, n_msgs) * t + msg_topic
+    ticks = (np.arange(n_msgs, dtype=np.int32) if msgs_per_tick
+             else np.zeros(n_msgs, dtype=np.int32))
+    sc = ScoreSimConfig(**(score_kw or {}))
+    params, state = make_gossip_sim(
+        cfg, subs, msg_topic, msg_origin, ticks, score_cfg=sc,
+        **(sim_kw or {}))
+    return cfg, sc, params, state
+
+
+def test_scored_run_still_disseminates():
+    """Healthy network with scoring on: full delivery, mesh in bounds."""
+    cfg, sc, params, state = build()
+    step = make_gossip_step(cfg, sc)
+    out = gossip_run(params, state, 40, step)
+    np.testing.assert_array_equal(np.asarray(reach_counts(params, out)),
+                                  600 // 3)
+    deg = np.asarray(mesh_degrees(out))
+    assert (deg >= cfg.d_lo).all() and (deg <= cfg.d_hi).all()
+
+
+def test_positive_scores_accrue_for_honest_mesh():
+    """P1 (time in mesh) + P2 (first deliveries) make healthy mesh edges
+    positive (score.go:256-333)."""
+    cfg, sc, params, state = build(n_msgs=32, msgs_per_tick=True)
+    step = make_gossip_step(cfg, sc)
+    out = gossip_run(params, state, 30, step)
+    score = np.asarray(compute_scores(sc, params, out))
+    mesh = np.asarray(out.mesh)
+    assert (score[mesh] > 0).mean() > 0.9
+    assert float(out.scores.time_in_mesh.max()) > 5
+
+
+def test_app_score_graylist_blocks_delivery():
+    """Peers with catastrophic app-specific score are graylisted: all
+    their inbound is dropped (AcceptFrom, gossipsub.go:584-586), so a
+    message originated by one never spreads."""
+    n = 600
+    app = np.zeros(n, dtype=np.float32)
+    bad = 3  # peer 3 (topic 0): everyone scores it below graylist
+    app[bad] = -1000.0
+    cfg, sc, params, state = build(
+        n=n, n_msgs=4, sim_kw=dict(app_score=app))
+    # all messages originate at the graylisted peer
+    from go_libp2p_pubsub_tpu.ops.graph import pack_bits
+    ob = np.zeros((n, 4), dtype=bool)
+    ob[bad, :] = True
+    deliver = ((np.arange(n) % 3) == (bad % 3))[:, None]
+    params = params.replace(
+        origin_words=pack_bits(jnp.asarray(ob)),
+        deliver_words=pack_bits(jnp.asarray(
+            np.broadcast_to(deliver, (n, 4)).copy())),
+        publish_tick=jnp.zeros((4,), dtype=jnp.int32))
+    step = make_gossip_step(cfg, sc)
+    out = gossip_run(params, state, 30, step)
+    reach = np.asarray(reach_counts(params, out))
+    assert (reach == 1).all(), reach  # only the origin itself
+
+
+def test_invalid_spam_collapses_score_and_containment():
+    """Sybils publishing invalid messages accrue P4 (squared) and go
+    deeply negative at their neighbors (gossipsub_spam_test.go:563);
+    invalid messages are never forwarded by honest peers, so they reach
+    at most one hop."""
+    n, t = 600, 3
+    sybil = np.zeros(n, dtype=bool)
+    sybil[0:30:3] = True  # 10 sybils in topic 0
+    n_msgs = 30
+    msg_topic = np.zeros(n_msgs, dtype=np.int64)
+    sybil_ids = np.flatnonzero(sybil)
+    msg_origin = np.repeat(sybil_ids, 3)
+    msg_invalid = np.ones(n_msgs, dtype=bool)
+    cfg = GossipSimConfig(offsets=make_gossip_offsets(t, 16, n, seed=1),
+                          n_topics=t)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    sc = ScoreSimConfig()
+    params, state = make_gossip_sim(
+        cfg, subs, msg_topic, msg_origin,
+        np.arange(n_msgs, dtype=np.int32) % 10, score_cfg=sc, sybil=sybil,
+        msg_invalid=msg_invalid)
+    step = make_gossip_step(cfg, sc)
+    out = gossip_run(params, state, 15, step)
+    score = np.asarray(compute_scores(sc, params, out))
+    cand_sybil = np.asarray(params.cand_sybil)
+    # peers that took invalid deliveries score the spammer deeply negative
+    # (P4 is squared; decay hasn't washed it out at tick 15)
+    assert score[cand_sybil].min() < -5
+    assert np.asarray(out.scores.invalid_deliveries).max() > 0.5
+    # invalid messages were never delivered to subscribers
+    reach = np.asarray(reach_counts(params, out))
+    assert (reach == 0).all(), reach
+    # sybils end up pruned out of honest meshes
+    mesh_with_sybil = np.asarray(out.mesh) & cand_sybil
+    assert mesh_with_sybil.sum() < cand_sybil.sum() * 0.05
+
+
+def test_ihave_spam_brings_behaviour_penalty():
+    """IHAVE-spamming sybils (advertise, never deliver) accrue P7 broken
+    promises at every spammed peer and get graylisted
+    (gossipsub_spam_test.go:135, gossip_tracer.go)."""
+    n, t = 600, 3
+    sybil = np.zeros(n, dtype=bool)
+    sybil[0:60:3] = True
+    cfg, sc, params, state = build(
+        n=n, t=t, n_msgs=4,
+        score_kw=dict(sybil_ihave_spam=True),
+        sim_kw=dict(sybil=sybil))
+    step = make_gossip_step(cfg, sc)
+    out = gossip_run(params, state, 30, step)
+    cand_sybil = np.asarray(params.cand_sybil)
+    bp = np.asarray(out.scores.behaviour_penalty)
+    assert bp[cand_sybil].max() > 1.0
+    score = np.asarray(compute_scores(sc, params, out))
+    assert np.median(score[cand_sybil]) < sc.gossip_threshold
+
+
+def test_graft_flood_penalized_and_rejected():
+    """Backoff-violating GRAFT flooders never enter honest meshes and
+    accumulate P7 (gossipsub_spam_test.go:349, gossipsub.go:747-765)."""
+    n, t = 600, 3
+    sybil = np.zeros(n, dtype=bool)
+    sybil[0:60:3] = True
+    cfg, sc, params, state = build(
+        n=n, t=t, n_msgs=4,
+        score_kw=dict(sybil_graft_flood=True,
+                      behaviour_penalty_weight=-100.0),
+        sim_kw=dict(sybil=sybil))
+    step = make_gossip_step(cfg, sc)
+    out = gossip_run(params, state, 40, step)
+    cand_sybil = np.asarray(params.cand_sybil)
+    honest_rows = ~np.asarray(params.sybil)
+    # honest meshes contain (almost) no sybil edges at steady state
+    sybil_mesh_edges = (np.asarray(out.mesh) & cand_sybil)[honest_rows]
+    assert sybil_mesh_edges.mean() < 0.02
+    bp = np.asarray(out.scores.behaviour_penalty)
+    assert bp[cand_sybil].max() > 0.5
+
+
+def test_adversarial_network_still_delivers_honest_traffic():
+    """20% sybil IWANT/IHAVE-flood network (the BASELINE.md adversarial
+    config): honest messages still reach every honest subscriber."""
+    n, t = 1000, 5
+    rng = np.random.default_rng(0)
+    sybil = rng.random(n) < 0.2
+    # sybils share one IP per topic class -> P6 colocation
+    ip = np.arange(n)
+    ip[sybil] = -(np.flatnonzero(sybil) % t) - 1
+    n_msgs = 16
+    honest_ids = np.flatnonzero(~sybil)
+    msg_origin = rng.choice(honest_ids, n_msgs)
+    msg_topic = msg_origin % t
+    cfg = GossipSimConfig(offsets=make_gossip_offsets(t, 16, n, seed=2),
+                          n_topics=t)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    sc = ScoreSimConfig(sybil_ihave_spam=True, sybil_graft_flood=True)
+    params, state = make_gossip_sim(
+        cfg, subs, msg_topic, msg_origin,
+        np.full(n_msgs, 10, dtype=np.int32), score_cfg=sc, sybil=sybil,
+        peer_ip=ip)
+    step = make_gossip_step(cfg, sc)
+    out = gossip_run(params, state, 60, step)
+    # honest subscribers of each topic all got the honest messages
+    from go_libp2p_pubsub_tpu.models.gossipsub import first_tick_matrix
+    ft = np.asarray(first_tick_matrix(out, n_msgs))
+    topics = np.arange(n) % t
+    for m in range(n_msgs):
+        want = (~sybil) & (topics == msg_topic[m])
+        got = ft[:, m] >= 0
+        frac = got[want].mean()
+        assert frac > 0.99, (m, frac)
+
+
+def test_mesh_delivery_deficit_penalizes_silent_mesh_edges():
+    """With P3 enabled and steady traffic, edges that deliver nothing run
+    a deficit; pruning such an edge leaves the sticky P3b penalty
+    (score.go:684-818, Prune)."""
+    # steady traffic: one message per tick for 40 ticks
+    cfg, sc, params, state = build(
+        n_msgs=32, msgs_per_tick=True,
+        score_kw=dict(mesh_message_deliveries_weight=-1.0,
+                      mesh_failure_penalty_weight=-1.0,
+                      mesh_message_deliveries_threshold=0.5))
+    step = make_gossip_step(cfg, sc)
+    out = gossip_run(params, state, 40, step)
+    # the run must still deliver (P3 calibrated to actual traffic)
+    np.testing.assert_array_equal(np.asarray(reach_counts(params, out)),
+                                  600 // 3)
+    md = np.asarray(out.scores.mesh_deliveries)
+    assert md[np.asarray(out.mesh)].max() > 0  # mesh edges earn credit
+    # sticky penalties exist only where something was pruned while failing
+    mfp = np.asarray(out.scores.mesh_failure_penalty)
+    assert mfp.min() >= 0
+
+
+def test_score_config_validation():
+    with pytest.raises(ValueError):
+        ScoreSimConfig(time_in_mesh_weight=-1.0).validate()
+    with pytest.raises(ValueError):
+        ScoreSimConfig(invalid_message_deliveries_weight=1.0).validate()
+    with pytest.raises(ValueError):
+        ScoreSimConfig(first_message_deliveries_decay=1.5).validate()
+    with pytest.raises(ValueError):
+        ScoreSimConfig(graylist_threshold=-1.0,
+                       publish_threshold=-2.0).validate()
+    ScoreSimConfig().validate()

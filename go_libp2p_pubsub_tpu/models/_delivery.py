@@ -2,9 +2,15 @@
 
 Every simulator (floodsub, gossipsub, randomsub) records, per (peer,
 message-bit), the first tick the message was delivered — the raw material
-for the reachability-vs-hops curves BASELINE.md asks to match.  The layout
-is word-aligned int16 [N, W, 32] (bit j of word w = message w*32+j) so the
-hot-loop update is reshape-free; -1 = never delivered; ticks saturate at
+for the reachability-vs-hops curves BASELINE.md asks to match.
+
+Layout: the peer axis is MINOR (last) in every hot array — possession
+words are uint32 [W, N], first-tick records int16 [W, 32, N] (bit j of
+word w = message w*32+j).  TPU tiles the last dimension onto the 128
+vector lanes, so a small-minor layout like [N, W] with W=1 wastes most of
+each tile on padding; peer-minor keeps the hot loop at full HBM bandwidth
+and makes each word row a contiguous 1D array that rolls ~12x faster than
+a 2D slice (see PERF_NOTES.md).  -1 = never delivered; ticks saturate at
 32766 so they can't wrap into the sentinel.
 """
 
@@ -18,28 +24,31 @@ from ..ops.graph import WORD_BITS
 def update_first_tick(first_tick: jnp.ndarray | None,
                       delivered_now: jnp.ndarray,
                       tick: jnp.ndarray) -> jnp.ndarray | None:
-    """Record ``tick`` for bits of delivered_now (uint32 [N, W]) that are
-    newly delivered.  No-op when tracking is disabled (first_tick=None)."""
+    """Record ``tick`` for bits of delivered_now (uint32 [W, N]) that are
+    newly delivered.  first_tick: int16 [W, 32, N].  No-op when tracking
+    is disabled (first_tick=None)."""
     if first_tick is None:
         return None
     shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
-    bits = ((delivered_now[:, :, None] >> shifts) & jnp.uint32(1)) != 0
+    bits = ((delivered_now[:, None, :] >> shifts[None, :, None])
+            & jnp.uint32(1)) != 0                      # [W, 32, N]
     newly = bits & (first_tick < 0)
     tick16 = jnp.minimum(tick, 32766).astype(jnp.int16)
     return jnp.where(newly, tick16, first_tick)
 
 
 def first_tick_to_matrix(first_tick: jnp.ndarray, m: int) -> jnp.ndarray:
-    """first_tick [N, W, 32] as [N, M] (strips word padding)."""
-    n = first_tick.shape[0]
-    return first_tick.reshape(n, -1)[:, :m]
+    """first_tick [W, 32, N] as [N, M] (strips word padding)."""
+    w, b, n = first_tick.shape
+    return first_tick.reshape(w * b, n)[:m].T
 
 
 def reach_counts_from_first_tick(first_tick: jnp.ndarray,
                                  m: int) -> jnp.ndarray:
     """Per-message delivered-peer counts: int32 [M]."""
-    return (first_tick_to_matrix(first_tick, m) >= 0).sum(
-        axis=0, dtype=jnp.int32)
+    w, b, _ = first_tick.shape
+    counts = (first_tick >= 0).sum(axis=2, dtype=jnp.int32)  # [W, 32]
+    return counts.reshape(w * b)[:m]
 
 
 def reach_by_hops_from_first_tick(first_tick: jnp.ndarray, m: int,

@@ -8,8 +8,11 @@ uint32 word), subscriptions/relays become forward/deliver masks, and
 first-delivery ticks are recorded per (peer, message) so
 reachability-vs-hops curves fall out as histograms.
 
-State is a flax pytree; sharding the peer axis (leading dim of every [N,...]
-array) over a device mesh makes the same ``step`` run multi-chip unchanged.
+Layout: peer-minor — possession words are uint32 [W, N] and first-tick
+records int16 [W, 32, N], so the peer axis sits on the TPU vector lanes
+and each word row rolls as a contiguous 1D array (see _delivery.py and
+PERF_NOTES.md).  State is a flax pytree; sharding the peer axis over a
+device mesh makes the same ``step`` run multi-chip unchanged.
 """
 
 from __future__ import annotations
@@ -25,8 +28,9 @@ from ..ops.graph import (
     WORD_BITS,
     count_bits_per_position,
     pack_bits,
-    propagate,
+    pack_bits_pm,
     propagate_circulant,
+    propagate_pm,
 )
 from ._delivery import (
     first_tick_to_matrix,
@@ -43,16 +47,16 @@ class FloodParams:
 
     nbrs: jnp.ndarray          # int32 [N, K] or None
     nbr_mask: jnp.ndarray      # bool  [N, K] or None
-    fwd_words: jnp.ndarray     # uint32 [N, W]: will forward bit m
-    deliver_words: jnp.ndarray # uint32 [N, W]: counts as delivery for bit m
-    origin_words: jnp.ndarray  # uint32 [N, W]: bit m set at origin[m]
+    fwd_words: jnp.ndarray     # uint32 [W, N]: will forward bit m
+    deliver_words: jnp.ndarray # uint32 [W, N]: counts as delivery for bit m
+    origin_words: jnp.ndarray  # uint32 [W, N]: bit m set at origin[m]
     publish_tick: jnp.ndarray  # int32 [M]
 
 
 @struct.dataclass
 class FloodState:
-    have: jnp.ndarray        # uint32 [N, W]
-    first_tick: jnp.ndarray  # int16 [N, W, 32], -1 = never delivered
+    have: jnp.ndarray        # uint32 [W, N]
+    first_tick: jnp.ndarray  # int16 [W, 32, N], -1 = never delivered
     # (word-aligned layout: bit j of word w is message w*32+j; stored
     # unreshaped so the hot-loop update never materializes a relayout)
     tick: jnp.ndarray        # int32 scalar
@@ -88,15 +92,15 @@ def make_flood_sim(nbrs: np.ndarray, nbr_mask: np.ndarray, subs: np.ndarray,
     params = FloodParams(
         nbrs=nbrs_j,
         nbr_mask=nbr_mask_j,
-        fwd_words=pack_bits(jnp.asarray(fwd)),
-        deliver_words=pack_bits(jnp.asarray(sub_bits)),
-        origin_words=pack_bits(jnp.asarray(origin_bits)),
+        fwd_words=pack_bits_pm(jnp.asarray(fwd)),
+        deliver_words=pack_bits_pm(jnp.asarray(sub_bits)),
+        origin_words=pack_bits_pm(jnp.asarray(origin_bits)),
         publish_tick=jnp.asarray(msg_publish_tick, dtype=jnp.int32),
     )
-    w = params.fwd_words.shape[1]
+    w = params.fwd_words.shape[0]
     state = FloodState(
-        have=jnp.zeros((n, w), dtype=jnp.uint32),
-        first_tick=(jnp.full((n, w, WORD_BITS), -1, dtype=jnp.int16)
+        have=jnp.zeros((w, n), dtype=jnp.uint32),
+        first_tick=(jnp.full((w, WORD_BITS, n), -1, dtype=jnp.int16)
                     if track_first_tick else None),
         tick=jnp.zeros((), dtype=jnp.int32),
     )
@@ -106,8 +110,8 @@ def make_flood_sim(nbrs: np.ndarray, nbr_mask: np.ndarray, subs: np.ndarray,
 def flood_step(params: FloodParams, state: FloodState) -> FloodState:
     """One virtual tick: inject due publishes, propagate one hop, record
     first deliveries.  Pure function — jit/shard_map friendly."""
-    heard = propagate(state.have & params.fwd_words, params.nbrs,
-                      params.nbr_mask)
+    heard = propagate_pm(state.have & params.fwd_words, params.nbrs,
+                         params.nbr_mask)
     return _finish_step(params, state, heard)[0]
 
 
@@ -131,7 +135,7 @@ def _finish_step(params: FloodParams, state: FloodState,
 
     # then inject messages whose publish tick is now
     due = pack_bits(params.publish_tick == state.tick)          # [W]
-    injected = params.origin_words & due[None, :] & ~state.have
+    injected = params.origin_words & due[:, None] & ~state.have
     have = state.have | accepted | injected
 
     # delivery accounting (origin's own publish counts at inject tick)

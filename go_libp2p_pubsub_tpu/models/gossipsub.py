@@ -13,20 +13,30 @@ TPU-first representation (see PERF_NOTES.md):
   C ring offsets, all multiples of T and closed under negation.  Candidates
   model what discovery + peer exchange give a deployed node: the topic
   peers it *could* connect to (discovery.go:108-173, PX gossipsub.go:856).
-- **Mesh/fanout/gossip-targets = bool masks [N, C]** over those candidate
-  columns.  GRAFT/PRUNE flip mask bits; degree bounds (D/Dlo/Dhi,
+- **Mesh/fanout/gossip-targets = bool masks [C, N]** over those candidate
+  rows.  GRAFT/PRUNE flip mask bits; degree bounds (D/Dlo/Dhi,
   gossipsub.go:33-40) make C a small compile-time constant.
-- **Edge duality is a column permutation + roll.**  The link (p, p+o_c)
-  seen from the partner is column ``cinv[c]`` where ``o_cinv = -o_c``, so
-  sending per-edge data to the partner — GRAFT/PRUNE announcements,
-  message words — is ``roll(x[:, c], o_c)`` landing in column cinv[c].
-  The whole heartbeat is rolls, masks, popcounts, and two tiny per-row
-  argsorts: **no gathers** (XLA gather is ~1000x slower than roll on this
-  topology; PERF_NOTES.md).
+- **Peer-minor layout everywhere.**  The peer axis is the LAST axis of
+  every hot array ([C, N] masks/scores, [W, N] possession words), so it
+  sits on the TPU's 128 vector lanes: full-bandwidth elementwise ops,
+  contiguous [N] rows whose 1D rolls are ~12x faster than 2D column
+  rolls, and no strided candidate slicing.
+- **Edge duality is a row permutation + roll.**  The link (p, p+o_c) seen
+  from the partner is row ``cinv[c]`` where ``o_cinv = -o_c``, so sending
+  per-edge data to the partner — GRAFT/PRUNE announcements, message
+  words — is ``roll(x[c], o_c)`` landing in row cinv[c].  The whole
+  heartbeat is 1D rolls, masks, popcounts, and a few all-pairs rank
+  compares: **no gathers and no sorts** (XLA gather is ~1000x slower than
+  roll on this topology; a C^2 comparison count beats argsort ~6x).
 - **Messages are bit positions** in uint32 words, as in models/floodsub.py.
   The mcache (mcache.go) becomes a ring of recently-acquired words: slot 0
   = newest heartbeat window; IHAVE advertises the OR of the newest
   HistoryGossip slots (mcache.go:82, GetGossipIDs).
+- **Data-dependent maintenance is cond-gated.**  Graft/prune/fanout
+  selection and opportunistic grafting run under ``lax.cond`` on "any
+  peer needs it" — after the mesh converges the expensive selection work
+  is skipped entirely, exactly like the reference's heartbeat doing
+  nothing when every mesh is within [Dlo, Dhi].
 
 Timing model: one tick = one heartbeat = one network hop.  Reachability is
 measured in hops (publish-tick-relative), which is exactly the
@@ -36,7 +46,7 @@ wall-clock heartbeat/RTT ratio.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, replace
+from dataclasses import dataclass
 from functools import partial
 
 import jax
@@ -47,10 +57,13 @@ from flax import struct
 from ..ops.graph import (
     WORD_BITS,
     count_bits_per_position,
+    lane_uniform,
     make_circulant_offsets,
     pack_bits,
+    pack_bits_pm,
+    ranks_desc,
     select_k_by_priority,
-    select_k_per_row,
+    select_k_per_peer,
 )
 from ._delivery import (
     reach_counts_from_first_tick,
@@ -106,8 +119,8 @@ class GossipSimConfig:
 
     @property
     def cinv(self) -> tuple[int, ...]:
-        """cinv[c] = column of the negated offset (the partner's view of
-        edge column c)."""
+        """cinv[c] = row of the negated offset (the partner's view of
+        edge row c)."""
         idx = {o: i for i, o in enumerate(self.offsets)}
         return tuple(idx[-o] for o in self.offsets)
 
@@ -215,7 +228,7 @@ class ScoreSimConfig:
 
 
 # --------------------------------------------------------------------------
-# Pytrees
+# Pytrees (all candidate arrays [C, N], all word arrays [W, N] — peer-minor)
 # --------------------------------------------------------------------------
 
 
@@ -224,44 +237,44 @@ class GossipParams:
     """Per-simulation device arrays (dynamic operands of the jitted step).
 
     The v1.1 fields (None when scoring is off) carry per-CANDIDATE views of
-    static per-peer attributes: column c of row p describes peer p+o_c.
+    static per-peer attributes: row c of column p describes peer p+o_c.
     """
 
     subscribed: jnp.ndarray      # bool [N]: has a local subscription
-    cand_subscribed: jnp.ndarray # bool [N, C]: candidate q=p+o_c subscribed
-    origin_words: jnp.ndarray    # uint32 [N, W]: bit m set at origin[m]
-    deliver_words: jnp.ndarray   # uint32 [N, W]: msg m counts as delivery
+    cand_subscribed: jnp.ndarray # bool [C, N]: candidate q=p+o_c subscribed
+    origin_words: jnp.ndarray    # uint32 [W, N]: bit m set at origin[m]
+    deliver_words: jnp.ndarray   # uint32 [W, N]: msg m counts as delivery
     publish_tick: jnp.ndarray    # int32 [M]
     invalid_words: jnp.ndarray | None = None  # uint32 [W]: msg fails validation
-    cand_app_score: jnp.ndarray | None = None # f32 [N, C]: P5 of candidate
-    cand_colo_excess: jnp.ndarray | None = None  # f32 [N, C]: P6 surplus
-    cand_sybil: jnp.ndarray | None = None     # bool [N, C]: candidate is sybil
+    cand_app_score: jnp.ndarray | None = None # f32 [C, N]: P5 of candidate
+    cand_colo_excess: jnp.ndarray | None = None  # f32 [C, N]: P6 surplus
+    cand_sybil: jnp.ndarray | None = None     # bool [C, N]: candidate is sybil
     sybil: jnp.ndarray | None = None          # bool [N]
 
 
 @struct.dataclass
 class ScoreState:
-    """Per-edge v1.1 reputation counters: row p, column c = p's view of
+    """Per-edge v1.1 reputation counters: row c, column p = p's view of
     candidate p+o_c (the score engine's per-(peer, topic) stats,
     score.go:95-118, densified on the candidate axis)."""
 
-    time_in_mesh: jnp.ndarray        # f32 [N, C] ticks since graft (P1)
-    first_deliveries: jnp.ndarray    # f32 [N, C] decaying counter (P2)
-    mesh_deliveries: jnp.ndarray     # f32 [N, C] decaying counter (P3)
-    mesh_failure_penalty: jnp.ndarray  # f32 [N, C] sticky deficit² (P3b)
-    invalid_deliveries: jnp.ndarray  # f32 [N, C] decaying counter (P4)
-    behaviour_penalty: jnp.ndarray   # f32 [N, C] decaying counter (P7)
+    time_in_mesh: jnp.ndarray        # f32 [C, N] ticks since graft (P1)
+    first_deliveries: jnp.ndarray    # f32 [C, N] decaying counter (P2)
+    mesh_deliveries: jnp.ndarray     # f32 [C, N] decaying counter (P3)
+    mesh_failure_penalty: jnp.ndarray  # f32 [C, N] sticky deficit² (P3b)
+    invalid_deliveries: jnp.ndarray  # f32 [C, N] decaying counter (P4)
+    behaviour_penalty: jnp.ndarray   # f32 [C, N] decaying counter (P7)
 
 
 @struct.dataclass
 class GossipState:
-    mesh: jnp.ndarray        # bool [N, C]  my mesh membership per candidate
-    fanout: jnp.ndarray      # bool [N, C]  publish-without-join targets
+    mesh: jnp.ndarray        # bool [C, N]  my mesh membership per candidate
+    fanout: jnp.ndarray      # bool [C, N]  publish-without-join targets
     last_pub: jnp.ndarray    # int32 [N]    last publish tick (fanout TTL)
-    backoff: jnp.ndarray     # int32 [N, C] no re-GRAFT until this tick
-    have: jnp.ndarray        # uint32 [N, W]
-    recent: jnp.ndarray      # uint32 [N, Hg, W] newly-acquired ring (mcache)
-    first_tick: jnp.ndarray  # int16 [N, W, 32] or None
+    backoff: jnp.ndarray     # int32 [C, N] no re-GRAFT until this tick
+    have: jnp.ndarray        # uint32 [W, N]
+    recent: jnp.ndarray      # uint32 [Hg, W, N] newly-acquired ring (mcache)
+    first_tick: jnp.ndarray  # int16 [W, 32, N] or None
     scores: ScoreState | None  # None when v1.1 scoring is disabled
     key: jax.Array           # PRNG key
     tick: jnp.ndarray        # int32 scalar
@@ -307,8 +320,8 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
                                           == msg_topic[None, :])
 
     def cand_view(per_peer):
-        """Per-candidate view: out[p, c] = per_peer[p + o_c]."""
-        return np.stack([np.roll(per_peer, -o) for o in cfg.offsets], axis=1)
+        """Per-candidate view: out[c, p] = per_peer[p + o_c]."""
+        return np.stack([np.roll(per_peer, -o) for o in cfg.offsets], axis=0)
 
     kw = {}
     if score_cfg is not None:
@@ -336,22 +349,22 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
     params = GossipParams(
         subscribed=jnp.asarray(subscribed),
         cand_subscribed=jnp.asarray(cand_view(subscribed)),
-        origin_words=pack_bits(jnp.asarray(origin_bits)),
-        deliver_words=pack_bits(jnp.asarray(deliver_bits)),
+        origin_words=pack_bits_pm(jnp.asarray(origin_bits)),
+        deliver_words=pack_bits_pm(jnp.asarray(deliver_bits)),
         publish_tick=jnp.asarray(msg_publish_tick, dtype=jnp.int32),
         **kw,
     )
-    w = params.origin_words.shape[1]
+    w = params.origin_words.shape[0]
     c = cfg.n_candidates
-    zc = lambda: jnp.zeros((n, c), dtype=jnp.float32)  # noqa: E731
+    zc = lambda: jnp.zeros((c, n), dtype=jnp.float32)  # noqa: E731
     state = GossipState(
-        mesh=jnp.zeros((n, c), dtype=bool),
-        fanout=jnp.zeros((n, c), dtype=bool),
+        mesh=jnp.zeros((c, n), dtype=bool),
+        fanout=jnp.zeros((c, n), dtype=bool),
         last_pub=jnp.full((n,), -(10 ** 9), dtype=jnp.int32),
-        backoff=jnp.zeros((n, c), dtype=jnp.int32),
-        have=jnp.zeros((n, w), dtype=jnp.uint32),
-        recent=jnp.zeros((n, cfg.history_gossip, w), dtype=jnp.uint32),
-        first_tick=(jnp.full((n, w, WORD_BITS), -1, dtype=jnp.int16)
+        backoff=jnp.zeros((c, n), dtype=jnp.int32),
+        have=jnp.zeros((w, n), dtype=jnp.uint32),
+        recent=jnp.zeros((cfg.history_gossip, w, n), dtype=jnp.uint32),
+        first_tick=(jnp.full((w, WORD_BITS, n), -1, dtype=jnp.int16)
                     if track_first_tick else None),
         scores=(ScoreState(time_in_mesh=zc(), first_deliveries=zc(),
                            mesh_deliveries=zc(), mesh_failure_penalty=zc(),
@@ -368,34 +381,37 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
 # --------------------------------------------------------------------------
 
 
-def edge_transfer(cols: list[jnp.ndarray], cfg: GossipSimConfig):
-    """Given per-column arrays (each [N, ...], column c describing edge
-    (p, p+o_c)), return the received per-column list: out[cinv[c]] =
-    roll(cols[c], o_c) — what each peer's partner sent it on that edge."""
+def edge_transfer(rows: list[jnp.ndarray], cfg: GossipSimConfig):
+    """Given per-row arrays (each [N], row c describing edge (p, p+o_c)),
+    return the received per-row list: out[cinv[c]] = roll(rows[c], o_c) —
+    what each peer's partner sent it on that edge."""
     out = [None] * cfg.n_candidates
     for c, off in enumerate(cfg.offsets):
-        out[cfg.cinv[c]] = jnp.roll(cols[c], off, axis=0)
+        out[cfg.cinv[c]] = jnp.roll(rows[c], off, axis=0)
     return out
 
 
 def transfer_mask(mask: jnp.ndarray, cfg: GossipSimConfig) -> jnp.ndarray:
-    """edge_transfer for a bool [N, C] mask (column-stacked form)."""
-    cols = edge_transfer([mask[:, c] for c in range(cfg.n_candidates)], cfg)
-    return jnp.stack(cols, axis=1)
+    """edge_transfer for a bool [C, N] mask (row-stacked form)."""
+    rows = edge_transfer([mask[c] for c in range(cfg.n_candidates)], cfg)
+    return jnp.stack(rows, axis=0)
 
 
 def masked_word_or(words: jnp.ndarray, mask: jnp.ndarray,
                    cfg: GossipSimConfig) -> jnp.ndarray:
     """OR of ``words`` sent along every masked edge: what each peer hears.
 
-    words: uint32 [N, W] (sender payload); mask: bool [N, C] (sender's
-    out-edges).  One roll per candidate column — the hot op.
+    words: uint32 [W, N] (sender payload); mask: bool [C, N] (sender's
+    out-edges).  One 1D roll per (word, candidate) — the hot op.
     """
-    out = jnp.zeros_like(words)
-    for c, off in enumerate(cfg.offsets):
-        sent = jnp.where(mask[:, c, None], words, jnp.uint32(0))
-        out = out | jnp.roll(sent, off, axis=0)
-    return out
+    out_rows = []
+    for w in range(words.shape[0]):
+        acc = jnp.zeros_like(words[w])
+        for c, off in enumerate(cfg.offsets):
+            sent = jnp.where(mask[c], words[w], jnp.uint32(0))
+            acc = acc | jnp.roll(sent, off, axis=0)
+        out_rows.append(acc)
+    return jnp.stack(out_rows, axis=0)
 
 
 # --------------------------------------------------------------------------
@@ -405,7 +421,7 @@ def masked_word_or(words: jnp.ndarray, mask: jnp.ndarray,
 
 def compute_scores(sc: ScoreSimConfig, params: GossipParams,
                    st: GossipState) -> jnp.ndarray:
-    """The peer-score formula, densified: f32 [N, C] — row p's opinion of
+    """The peer-score formula, densified: f32 [C, N] — peer p's opinion of
     candidate p+o_c (score.go:256-333).  One topic per peer, so the
     per-topic sum collapses to the single topic's contribution."""
     s = st.scores
@@ -449,7 +465,7 @@ def make_gossip_step(cfg: GossipSimConfig,
     With score_cfg, the v1.1 hardening layer is woven through every phase:
     start-of-tick scores gate inbound RPCs (graylist), gossip exchange
     (gossip threshold), and publish flooding (publish threshold); delivery
-    provenance per candidate column feeds the P2/P3/P4 counters; mesh
+    provenance per candidate row feeds the P2/P3/P4 counters; mesh
     maintenance prunes negative-score peers, keeps the Dscore best + Dout
     outbound on oversubscription (gossipsub.go:1376-1435), and
     opportunistically grafts when the mesh median sags
@@ -458,19 +474,38 @@ def make_gossip_step(cfg: GossipSimConfig,
     """
     C = cfg.n_candidates
     sc = score_cfg
-    outbound_cols = jnp.asarray(
-        np.array([o > 0 for o in cfg.offsets]))    # we dial positive offsets
+    offsets = tuple(int(o) for o in cfg.offsets)
+    cinv = cfg.cinv
+    outbound_rows = jnp.asarray(
+        np.array([o > 0 for o in offsets]))    # [C]: we dial positive offsets
+    pc = jax.lax.population_count
 
     def step(params: GossipParams, state: GossipState):
-        key, k_gossip, k_graft, k_prune, k_fanout, k_og, k_gater = \
-            jax.random.split(state.key, 7)
         tick = state.tick
-        sub = params.subscribed
+        sub = params.subscribed            # bool [N]
         n = sub.shape[0]
+        W = state.have.shape[0]
+        # per-phase uniform fields from the counter-based lane hash (the
+        # carried PRNG key's last word is the run seed; threefry per tick
+        # would dominate the elementwise cost of the whole step)
+        salt = jax.random.key_data(state.key)[-1]
+        u_gossip = lane_uniform((C, n), tick, 1, salt)
+        u_graft = lane_uniform((C, n), tick, 2, salt)
+        u_prune = lane_uniform((C, n), tick, 3, salt)
+        u_fanout = lane_uniform((C, n), tick, 4, salt)
+        u_og = lane_uniform((C, n), tick, 5, salt)
+
+        def gated_select(elig, k, u):
+            """select_k_per_peer, skipped entirely when no peer needs it
+            (the common converged state)."""
+            return jax.lax.cond(
+                jnp.any(k > 0),
+                lambda: select_k_per_peer(elig, k, u),
+                lambda: jnp.zeros_like(elig))
 
         # -- 0. start-of-tick scores and the gates they drive -----------
         if sc is not None:
-            score = compute_scores(sc, params, state)           # [N, C]
+            score = compute_scores(sc, params, state)           # [C, N]
             # graylist: drop ALL inbound on edges below the graylist
             # threshold (AcceptFrom, gossipsub.go:584-586)
             edge_accept = score >= sc.graylist_threshold
@@ -481,26 +516,31 @@ def make_gossip_step(cfg: GossipSimConfig,
             # score counters — sybils behind one IP already share fate
             # via P6)
             s0 = state.scores
-            inv_tot = s0.invalid_deliveries.sum(axis=1)
-            del_tot = s0.first_deliveries.sum(axis=1)
+            inv_tot = s0.invalid_deliveries.sum(axis=0)         # [N]
+            del_tot = s0.first_deliveries.sum(axis=0)
             pressure = 16.0 * inv_tot / (1.0 + del_tot + 16.0 * inv_tot)
             gater_on = pressure > 0.33
             goodput = ((1.0 + s0.first_deliveries)
                        / (1.0 + s0.first_deliveries
                           + 16.0 * s0.invalid_deliveries))
-            p_accept = jnp.where(gater_on[:, None], goodput, 1.0)
-            gater_ok = jax.random.uniform(k_gater, (n, C)) < p_accept
-            payload_ok = edge_accept & gater_ok
-            valid_words = ~params.invalid_words[None, :]        # [1, W]
+            p_accept = jnp.where(gater_on[None, :], goodput, 1.0)
+            gater_ok = lane_uniform((C, n), tick, 6, salt) < p_accept
+            payload_ok = edge_accept & gater_ok                 # [C, N]
+            # per-word validity masks (scalar uint32 per word: bit m set
+            # iff message m passes validation)
+            valid_w = [~params.invalid_words[w] for w in range(W)]
         else:
             score = None
             edge_accept = gossip_ok = payload_ok = None
-            valid_words = None
+            valid_w = None
 
         # -- 1. publish injection ---------------------------------------
         due = pack_bits(params.publish_tick == tick)            # [W]
-        injected = params.origin_words & due[None, :] & ~state.have
-        publishing = (injected != 0).any(axis=1)                # [N]
+        injected = [params.origin_words[w] & due[w] & ~state.have[w]
+                    for w in range(W)]
+        publishing = jnp.zeros((n,), dtype=bool)
+        for w in range(W):
+            publishing = publishing | (injected[w] != 0)        # [N]
 
         # -- 1b. fanout build/maintenance (BEFORE forwarding: the
         # reference selects fanout peers on demand at publish time,
@@ -509,24 +549,24 @@ def make_gossip_step(cfg: GossipSimConfig,
         # publishes — unsubscribed peers accept nothing to relay.
         last_pub = jnp.where(publishing, tick, state.last_pub)
         alive = (~sub) & (tick - last_pub < cfg.fanout_ttl_ticks)
-        fanout = state.fanout & alive[:, None]
-        f_deg = fanout.sum(axis=1, dtype=jnp.int32)
+        fanout = state.fanout & alive[None, :]
+        f_deg = fanout.sum(axis=0, dtype=jnp.int32)
         f_need = jnp.where(alive, cfg.d - f_deg, 0)
         f_elig = params.cand_subscribed & ~fanout
         if sc is not None:  # fanout requires score >= publish threshold
             f_elig = f_elig & (score >= sc.publish_threshold)
-        fanout = fanout | select_k_per_row(f_elig, f_need, k_fanout)
+        fanout = fanout | gated_select(f_elig, f_need, u_fanout)
 
         # -- 2. eager forward with per-edge provenance ------------------
         # What I acquired last tick + my fresh publishes go to my mesh /
         # fanout (forwardMessage, gossipsub.go:989-999).  Honest peers
         # never forward invalid messages (validation rejects them before
         # the router sees them, validation.go:274-351); sybils do.
-        fresh = state.recent[:, 0] | injected
+        fresh = [state.recent[0, w] | injected[w] for w in range(W)]
         if sc is not None:
-            fresh = jnp.where(params.sybil[:, None], fresh,
-                              fresh & valid_words)
-        out_edges = state.mesh | fanout
+            fresh = [jnp.where(params.sybil, f, f & valid_w[w])
+                     for w, f in enumerate(fresh)]
+        out_edges = state.mesh | fanout                         # [C, N]
         if sc is not None and sc.flood_publish:
             # own publishes additionally flood to every candidate above
             # the publish threshold (gossipsub.go:953-959)
@@ -536,101 +576,118 @@ def make_gossip_step(cfg: GossipSimConfig,
             flood_edges = None
 
         have_start = state.have
-        claimed = injected          # first-arrival provenance accumulator
-        fd_add = [None] * C         # per-receiver-column popcounts
+        claimed = list(injected)    # first-arrival provenance accumulator
+        fd_add = [None] * C         # per-receiver-row popcounts (int32 [N])
         md_new = [None] * C
         inv_add = [None] * C
-        for c_send, off in enumerate(cfg.offsets):
-            j = cfg.cinv[c_send]    # receiver-side column for this edge
-            sent = jnp.where(out_edges[:, c_send, None], fresh,
-                             jnp.uint32(0))
-            if flood_edges is not None:
-                sent = sent | jnp.where(flood_edges[:, c_send, None],
-                                        injected, jnp.uint32(0))
-            rolled = jnp.roll(sent, off, axis=0)
-            if sc is not None:
-                rolled = jnp.where(payload_ok[:, j, None], rolled,
-                                   jnp.uint32(0))
-            news = rolled & ~have_start & ~claimed
-            claimed = claimed | news
-            if sc is not None:
-                # P2/P4 credit the first deliverer only (later copies are
-                # dropped at the seen-cache, pubsub.go:851-868); P3 also
-                # counts same-tick near-first copies from mesh members
-                # (deliveries window, score.go:684-818)
-                fd_add[j] = _popcount_rows(news & valid_words)
-                md_new[j] = _popcount_rows(rolled & valid_words
-                                           & ~have_start)
-                inv_add[j] = _popcount_rows(news & ~valid_words)
-        heard_new = claimed & ~injected
-        new_mesh_bits = jnp.where(sub[:, None], heard_new, jnp.uint32(0))
+
+        def acc(a, b):
+            return b if a is None else a + b
+
+        for c_send, off in enumerate(offsets):
+            j = cinv[c_send]    # receiver-side row for this edge
+            mask_c = out_edges[c_send]                          # [N]
+            fd_j = md_j = iv_j = None
+            for w in range(W):
+                sent = jnp.where(mask_c, fresh[w], jnp.uint32(0))
+                if flood_edges is not None:
+                    sent = sent | jnp.where(flood_edges[c_send],
+                                            injected[w], jnp.uint32(0))
+                rolled = jnp.roll(sent, off, axis=0)
+                if sc is not None:
+                    rolled = jnp.where(payload_ok[j], rolled,
+                                       jnp.uint32(0))
+                news = rolled & ~have_start[w] & ~claimed[w]
+                claimed[w] = claimed[w] | news
+                if sc is not None:
+                    # P2/P4 credit the first deliverer only (later copies
+                    # are dropped at the seen-cache, pubsub.go:851-868);
+                    # P3 also counts same-tick near-first copies from mesh
+                    # members (deliveries window, score.go:684-818)
+                    fd_j = acc(fd_j, pc(news & valid_w[w]))
+                    md_j = acc(md_j, pc(rolled & valid_w[w]
+                                        & ~have_start[w]))
+                    iv_j = acc(iv_j, pc(news & ~valid_w[w]))
+            fd_add[j], md_new[j], inv_add[j] = fd_j, md_j, iv_j
+        heard_new = [claimed[w] & ~injected[w] for w in range(W)]
+        new_mesh_bits = [jnp.where(sub, hw, jnp.uint32(0))
+                         for hw in heard_new]
 
         # -- 3. lazy gossip (IHAVE/IWANT collapsed to one exchange) -----
         # advertise ids seen in the last HistoryGossip windows; targets =
         # random non-mesh subscribed candidates, max(Dlazy, factor*elig),
         # both sides above the gossip threshold (gossipsub.go:1656-1712)
-        adv = jax.lax.reduce_or(state.recent, axes=(1,)) | injected
-        if sc is not None:
-            adv = jnp.where(params.sybil[:, None], adv, adv & valid_words)
-        elig = params.cand_subscribed & ~state.mesh & ~state.fanout
-        elig = elig & sub[:, None]          # only subscribed peers gossip
+        adv = []
+        for w in range(W):
+            aw = injected[w]
+            for h in range(cfg.history_gossip):
+                aw = aw | state.recent[h, w]
+            if sc is not None:
+                aw = jnp.where(params.sybil, aw, aw & valid_w[w])
+            adv.append(aw)
+        elig = (params.cand_subscribed & ~state.mesh & ~state.fanout
+                & sub[None, :])     # only subscribed peers gossip
         if sc is not None:
             elig = elig & gossip_ok
-        n_elig = elig.sum(axis=1, dtype=jnp.int32)
+        n_elig = elig.sum(axis=0, dtype=jnp.int32)
         n_gossip = jnp.maximum(
             jnp.int32(cfg.d_lazy),
             (cfg.gossip_factor * n_elig.astype(jnp.float32)).astype(
                 jnp.int32))
-        targets = select_k_per_row(elig, n_gossip, k_gossip)
+        targets = select_k_per_peer(elig, n_gossip, u_gossip)
         if sc is not None and sc.sybil_ihave_spam:
             # IHAVE-spamming sybils advertise ids they never deliver
             # (gossipsub_spam_test.go:135): their gossip carries nothing,
             # and each spammed peer records a broken promise -> P7
             # (gossip_tracer.go:48-117, applyIwantPenalties)
-            sybil_send = params.sybil[:, None] & params.cand_subscribed
-            targets = jnp.where(params.sybil[:, None], sybil_send, targets)
-        claimed_g = claimed
+            sybil_send = params.sybil[None, :] & params.cand_subscribed
+            targets = jnp.where(params.sybil[None, :], sybil_send, targets)
+        claimed_g = list(claimed)
         bp_spam = None
-        for c_send, off in enumerate(cfg.offsets):
-            j = cfg.cinv[c_send]
-            send_mask = targets[:, c_send]
+        for c_send, off in enumerate(offsets):
+            j = cinv[c_send]
+            send_mask = targets[c_send]
             if sc is not None and sc.sybil_ihave_spam:
                 send_mask = send_mask & ~params.sybil
-            sent = jnp.where(send_mask[:, None], adv, jnp.uint32(0))
-            rolled = jnp.roll(sent, off, axis=0)
+            ok_j = None
             if sc is not None:
-                ok = payload_ok[:, j] & gossip_ok[:, j]
-                rolled = jnp.where(ok[:, None], rolled, jnp.uint32(0))
-            news = rolled & ~have_start & ~claimed_g
-            claimed_g = claimed_g | news
-            if sc is not None:
-                # IWANT-pulled messages go through validation like any
-                # other delivery: P2 credit for valid, P4 for invalid
-                fd_add[j] = fd_add[j] + _popcount_rows(news & valid_words)
-                inv_add[j] = inv_add[j] + _popcount_rows(
-                    news & ~valid_words)
+                ok_j = payload_ok[j] & gossip_ok[j]
+            for w in range(W):
+                sent = jnp.where(send_mask, adv[w], jnp.uint32(0))
+                rolled = jnp.roll(sent, off, axis=0)
+                if ok_j is not None:
+                    rolled = jnp.where(ok_j, rolled, jnp.uint32(0))
+                news = rolled & ~have_start[w] & ~claimed_g[w]
+                claimed_g[w] = claimed_g[w] | news
+                if sc is not None:
+                    # IWANT-pulled messages go through validation like any
+                    # other delivery: P2 credit for valid, P4 for invalid
+                    fd_add[j] = fd_add[j] + pc(news & valid_w[w])
+                    inv_add[j] = inv_add[j] + pc(news & ~valid_w[w])
         if sc is not None and sc.sybil_ihave_spam:
             # broken-promise bookkeeping: one P7 unit per sybil IHAVE spam
-            spam_recv = transfer_mask(
-                targets & params.sybil[:, None], cfg)
-            bp_spam = spam_recv.astype(jnp.float32)
-        new_gossip_bits = jnp.where(sub[:, None], claimed_g & ~claimed,
-                                    jnp.uint32(0))
+            spam_rows = edge_transfer(
+                [targets[c] & params.sybil for c in range(C)], cfg)
+            bp_spam = jnp.stack(spam_rows, axis=0).astype(jnp.float32)
+        new_gossip_bits = [jnp.where(sub, claimed_g[w] & ~claimed[w],
+                                     jnp.uint32(0)) for w in range(W)]
 
-        new_acquired = new_mesh_bits | new_gossip_bits | injected
+        new_acquired = (jnp.stack(
+            [new_mesh_bits[w] | new_gossip_bits[w] | injected[w]
+             for w in range(W)], axis=0) if W
+            else jnp.zeros((0, n), dtype=jnp.uint32))           # [W, N]
         have = state.have | new_acquired
-        recent = jnp.concatenate(
-            [new_acquired[:, None, :], state.recent[:, :-1]], axis=1)
+        recent = jnp.concatenate([new_acquired[None], state.recent[:-1]],
+                                 axis=0)
 
         delivered_now = new_acquired & params.deliver_words
         if sc is not None:
-            delivered_now = delivered_now & valid_words
+            delivered_now = delivered_now & ~params.invalid_words[:, None]
         first_tick = update_first_tick(state.first_tick, delivered_now,
                                        tick)
 
         # -- 4. heartbeat maintenance -----------------------------------
         mesh, backoff = state.mesh, state.backoff
-        in_backoff = backoff > tick
         mesh_before = mesh
 
         if sc is not None:
@@ -640,64 +697,82 @@ def make_gossip_step(cfg: GossipSimConfig,
             backoff = jnp.where(neg, tick + cfg.backoff_ticks, backoff)
         else:
             neg = None
-        deg = mesh.sum(axis=1, dtype=jnp.int32)
+        in_backoff = backoff > tick
+        deg = mesh.sum(axis=0, dtype=jnp.int32)                 # [N]
 
         # graft up to D when deg < Dlo (gossipsub.go:1340-1360);
         # candidates need score >= 0 in v1.1
         can_graft = (params.cand_subscribed & ~mesh & ~in_backoff
-                     & sub[:, None])
+                     & sub[None, :])
         if sc is not None:
             can_graft = can_graft & (score >= 0)
         need = jnp.where(deg < cfg.d_lo, cfg.d - deg, 0)
-        grafts = select_k_per_row(can_graft, need, k_graft)
+        grafts = gated_select(can_graft, need, u_graft)
 
         # prune down to D when deg > Dhi.  v1.0: random retention; v1.1:
         # keep the Dscore best by score, then at least Dout outbound,
-        # random fill to D (anti-sybil bubble-up, gossipsub.go:1376-1435)
-        if sc is None:
-            keep = select_k_per_row(mesh, jnp.full_like(deg, cfg.d),
-                                    k_prune)
-        else:
-            rnd = jax.random.uniform(k_prune, (n, C))
-            top = select_k_by_priority(mesh, score,
-                                       jnp.full_like(deg, cfg.d_score),
-                                       tiebreak=rnd)
-            out_cols = jnp.broadcast_to(outbound_cols[None, :], (n, C))
-            n_out_top = (top & out_cols).sum(axis=1, dtype=jnp.int32)
-            need_out = jnp.maximum(0, cfg.d_out - n_out_top)
-            out_keep = select_k_by_priority(mesh & ~top & out_cols, rnd,
-                                            need_out)
-            taken = top | out_keep
-            n_taken = taken.sum(axis=1, dtype=jnp.int32)
-            fill = select_k_by_priority(mesh & ~taken, rnd,
-                                        jnp.maximum(cfg.d - n_taken, 0))
-            keep = taken | fill
-        prunes = mesh & ~keep & (deg > cfg.d_hi)[:, None]
+        # random fill to D (anti-sybil bubble-up, gossipsub.go:1376-1435).
+        # The whole selection runs under a cond: once every mesh fits in
+        # [Dlo, Dhi] (the converged state) it costs nothing.
+        over = deg > cfg.d_hi
+
+        def compute_prunes():
+            if sc is None:
+                keep = select_k_per_peer(mesh, jnp.full_like(deg, cfg.d),
+                                         u_prune)
+            else:
+                rnd = u_prune
+                top = select_k_by_priority(mesh, score,
+                                           jnp.full_like(deg, cfg.d_score),
+                                           tiebreak=rnd)
+                out_rows = jnp.broadcast_to(outbound_rows[:, None], (C, n))
+                n_out_top = (top & out_rows).sum(axis=0, dtype=jnp.int32)
+                need_out = jnp.maximum(0, cfg.d_out - n_out_top)
+                out_keep = select_k_by_priority(mesh & ~top & out_rows,
+                                                rnd, need_out)
+                taken = top | out_keep
+                n_taken = taken.sum(axis=0, dtype=jnp.int32)
+                fill = select_k_by_priority(mesh & ~taken, rnd,
+                                            jnp.maximum(cfg.d - n_taken,
+                                                        0))
+                keep = taken | fill
+            return mesh & ~keep & over[None, :]
+
+        prunes = jax.lax.cond(jnp.any(over), compute_prunes,
+                              lambda: jnp.zeros_like(mesh))
 
         if sc is not None:
             # opportunistic grafting: when the mesh's median score sags
             # below the threshold, graft extra high-scoring peers
-            # (gossipsub.go:1467-1498); median via sort + one-hot (no
-            # gathers)
+            # (gossipsub.go:1467-1498).  Runs 1-in-opportunistic_graft_
+            # ticks, so the median rank-compare sits under the cond too.
             do_og = (tick % sc.opportunistic_graft_ticks) == 0
-            s_sorted = jnp.sort(jnp.where(mesh, score, jnp.inf), axis=1)
-            onehot = (jnp.arange(C)[None, :] == (deg // 2)[:, None])
-            median = jnp.where(deg > 0,
-                               (jnp.where(onehot, s_sorted, 0.0)).sum(1),
-                               0.0)
-            og_row = (do_og & (median < sc.opportunistic_graft_threshold)
-                      & sub)
-            og_elig = (can_graft & ~grafts
-                       & (score > median[:, None]))
-            og_need = jnp.where(og_row, sc.opportunistic_graft_peers, 0)
-            grafts = grafts | select_k_per_row(og_elig, og_need, k_og)
+
+            def compute_og():
+                # median = the mesh row at ascending rank deg//2 =
+                # descending rank C-1-deg//2 (non-mesh rows pinned to
+                # +inf rank first); rank-compare instead of a sort
+                mesh_rank = ranks_desc(jnp.where(mesh, score, jnp.inf))
+                med_pick = mesh & (mesh_rank
+                                   == (C - 1 - deg // 2)[None, :])
+                median = jnp.where(
+                    deg > 0, jnp.where(med_pick, score, 0.0).sum(0), 0.0)
+                og_row = (median < sc.opportunistic_graft_threshold) & sub
+                og_elig = can_graft & ~grafts & (score > median[None, :])
+                og_need = jnp.where(og_row, sc.opportunistic_graft_peers,
+                                    0)
+                return select_k_per_peer(og_elig, og_need, u_og)
+
+            grafts = grafts | jax.lax.cond(
+                do_og, compute_og, lambda: jnp.zeros_like(mesh))
 
         if sc is not None and sc.sybil_graft_flood:
             # GRAFT-flooding sybils re-graft every tick, ignoring their
             # own backoff (gossipsub_spam_test.go:349)
             sybil_grafts = (params.cand_subscribed & ~mesh
-                            & params.sybil[:, None])
-            grafts = jnp.where(params.sybil[:, None], sybil_grafts, grafts)
+                            & params.sybil[None, :])
+            grafts = jnp.where(params.sybil[None, :], sybil_grafts,
+                               grafts)
 
         mesh = (mesh | grafts) & ~prunes
         backoff = jnp.where(prunes, tick + cfg.backoff_ticks, backoff)
@@ -715,7 +790,7 @@ def make_gossip_step(cfg: GossipSimConfig,
             graft_recv = graft_recv & edge_accept
             prune_recv = prune_recv & edge_accept
         backoff_violation = graft_recv & (backoff > tick)
-        accept = graft_recv & sub[:, None] & ~(backoff > tick)
+        accept = graft_recv & sub[None, :] & ~(backoff > tick)
         if sc is not None:
             accept = accept & (score >= 0)
         reject = graft_recv & ~accept
@@ -734,14 +809,15 @@ def make_gossip_step(cfg: GossipSimConfig,
         scores = state.scores
         if sc is not None:
             s0 = state.scores
-            fd = jnp.minimum(
-                s0.first_deliveries + jnp.stack(fd_add, axis=1),
-                sc.first_message_deliveries_cap)
+            fd_stack = jnp.stack(fd_add, axis=0).astype(jnp.float32)
+            md_stack = jnp.stack(md_new, axis=0).astype(jnp.float32)
+            iv_stack = jnp.stack(inv_add, axis=0).astype(jnp.float32)
+            fd = jnp.minimum(s0.first_deliveries + fd_stack,
+                             sc.first_message_deliveries_cap)
             md = jnp.minimum(
-                s0.mesh_deliveries
-                + jnp.stack(md_new, axis=1) * mesh_before,
+                s0.mesh_deliveries + md_stack * mesh_before,
                 sc.mesh_message_deliveries_cap)
-            inv = s0.invalid_deliveries + jnp.stack(inv_add, axis=1)
+            inv = s0.invalid_deliveries + iv_stack
             # P3b: an edge pruned while active with a delivery deficit
             # keeps the deficit² as a sticky penalty (score.go Prune)
             removed = mesh_before & ~mesh
@@ -756,10 +832,12 @@ def make_gossip_step(cfg: GossipSimConfig,
                 jnp.float32)
             if bp_spam is not None:
                 bp = bp + bp_spam
+
             # decay (refreshScores, score.go:495-556)
             def dk(x, decay):
                 x = x * decay
                 return jnp.where(x < sc.decay_to_zero, 0.0, x)
+
             scores = ScoreState(
                 time_in_mesh=jnp.where(mesh, s0.time_in_mesh + 1.0, 0.0),
                 first_deliveries=dk(fd, sc.first_message_deliveries_decay),
@@ -773,16 +851,10 @@ def make_gossip_step(cfg: GossipSimConfig,
         new_state = GossipState(
             mesh=mesh, fanout=fanout, last_pub=last_pub, backoff=backoff,
             have=have, recent=recent, first_tick=first_tick, scores=scores,
-            key=key, tick=tick + 1)
+            key=state.key, tick=tick + 1)
         return new_state, delivered_now
 
     return step
-
-
-def _popcount_rows(words: jnp.ndarray) -> jnp.ndarray:
-    """Total set bits per row: uint32 [N, W] -> f32 [N]."""
-    return jax.lax.population_count(words).sum(
-        axis=1, dtype=jnp.int32).astype(jnp.float32)
 
 
 # --------------------------------------------------------------------------
@@ -820,7 +892,7 @@ def reach_counts(params: GossipParams, state: GossipState) -> jnp.ndarray:
 
 
 def mesh_degrees(state: GossipState) -> jnp.ndarray:
-    return state.mesh.sum(axis=1, dtype=jnp.int32)
+    return state.mesh.sum(axis=0, dtype=jnp.int32)
 
 
 def mesh_symmetry_fraction(state: GossipState,

@@ -1,12 +1,14 @@
 """Device mesh + sharding helpers.
 
-The simulator's scaling axis is the number of simulated peers; every state
-array leads with the peer dimension, so sharding is uniform: peer-major
-arrays split over the 'peers' mesh axis, everything else replicates.  XLA
-inserts the collectives (the neighbor gather becomes an all-gather of the
-bitpacked possession words — a few MB per step at 1M peers), which is the
-TPU-native replacement for the reference's per-peer stream I/O
-(/root/reference/comm.go) — see SURVEY.md §5.8.
+The simulator's scaling axis is the number of simulated peers.  State is
+peer-minor (the peer axis is the LAST axis of every hot array — [C, N]
+masks/scores, [W, N] possession words; see models/_delivery.py), so
+sharding is uniform: the axis whose extent equals n_peers splits over the
+'peers' mesh axis, everything else replicates.  XLA inserts the
+collectives (circulant rolls along the sharded peer axis become
+collective-permutes of the shard-boundary slices — a few MB per step at
+1M peers), which is the TPU-native replacement for the reference's
+per-peer stream I/O (/root/reference/comm.go) — see SURVEY.md §5.8.
 """
 
 from __future__ import annotations
@@ -26,8 +28,10 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     return Mesh(np.array(devices), (PEER_AXIS,))
 
 
-def peer_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P(PEER_AXIS))
+def peer_sharding(mesh: Mesh, ndim: int = 1, axis: int = 0) -> NamedSharding:
+    spec = [None] * ndim
+    spec[axis] = PEER_AXIS
+    return NamedSharding(mesh, P(*spec))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -35,15 +39,17 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def shard_peer_tree(tree, mesh: Mesh, n_peers: int):
-    """Place every array in the pytree: leading-dim==n_peers arrays are
-    sharded over the peer axis, the rest replicated."""
-    peer = peer_sharding(mesh)
+    """Place every array in the pytree: arrays with a peer-sized axis are
+    sharded over that axis (the last such axis — peer-minor layout), the
+    rest replicated."""
     repl = replicated(mesh)
 
     def place(x):
         arr = jax.numpy.asarray(x)
-        if arr.ndim >= 1 and arr.shape[0] == n_peers:
-            return jax.device_put(arr, peer)
+        for axis in reversed(range(arr.ndim)):
+            if arr.shape[axis] == n_peers:
+                return jax.device_put(
+                    arr, peer_sharding(mesh, arr.ndim, axis))
         return jax.device_put(arr, repl)
 
     return jax.tree_util.tree_map(place, tree)

@@ -80,7 +80,7 @@ def test_backoff_blocks_regraft():
     for _ in range(3):
         state, _ = step(params, state)
     # force-prune everything: clear mesh, set backoff everywhere
-    n, c = state.mesh.shape
+    c, n = state.mesh.shape
     state = state.replace(
         mesh=jnp.zeros_like(state.mesh),
         backoff=jnp.full_like(state.backoff, 10_000))
@@ -121,7 +121,7 @@ def test_gossip_repairs_meshless_peers():
     # eternal backoff on every edge touching an isolated peer: they never
     # graft out, and partners reject their grafts / never graft to them
     from go_libp2p_pubsub_tpu.models.gossipsub import transfer_mask
-    iso_cols = jnp.broadcast_to(iso_j[:, None], state.backoff.shape)
+    iso_cols = jnp.broadcast_to(iso_j[None, :], state.backoff.shape)
     blocked = iso_cols | transfer_mask(iso_cols, cfg)
     state = state.replace(
         backoff=jnp.where(blocked, 1_000_000, state.backoff))
@@ -150,10 +150,10 @@ def test_fanout_publish_without_subscription():
     origin_bits[origin, :] = True
     deliver = _np.asarray(params.subscribed)[:, None] & (
         (_np.arange(600) % 3 == topic)[:, None])
-    from go_libp2p_pubsub_tpu.ops.graph import pack_bits
+    from go_libp2p_pubsub_tpu.ops.graph import pack_bits_pm
     params = params.replace(
-        origin_words=pack_bits(jnp.asarray(origin_bits)),
-        deliver_words=pack_bits(jnp.asarray(
+        origin_words=pack_bits_pm(jnp.asarray(origin_bits)),
+        deliver_words=pack_bits_pm(jnp.asarray(
             _np.broadcast_to(deliver, (600, n_msgs)))),
         publish_tick=jnp.full((n_msgs,), 5, dtype=jnp.int32))
     step = make_gossip_step(cfg)

@@ -78,13 +78,13 @@ def test_app_score_graylist_blocks_delivery():
     cfg, sc, params, state = build(
         n=n, n_msgs=4, sim_kw=dict(app_score=app))
     # all messages originate at the graylisted peer
-    from go_libp2p_pubsub_tpu.ops.graph import pack_bits
+    from go_libp2p_pubsub_tpu.ops.graph import pack_bits_pm
     ob = np.zeros((n, 4), dtype=bool)
     ob[bad, :] = True
     deliver = ((np.arange(n) % 3) == (bad % 3))[:, None]
     params = params.replace(
-        origin_words=pack_bits(jnp.asarray(ob)),
-        deliver_words=pack_bits(jnp.asarray(
+        origin_words=pack_bits_pm(jnp.asarray(ob)),
+        deliver_words=pack_bits_pm(jnp.asarray(
             np.broadcast_to(deliver, (n, 4)).copy())),
         publish_tick=jnp.zeros((4,), dtype=jnp.int32))
     step = make_gossip_step(cfg, sc)
@@ -167,7 +167,7 @@ def test_graft_flood_penalized_and_rejected():
     cand_sybil = np.asarray(params.cand_sybil)
     honest_rows = ~np.asarray(params.sybil)
     # honest meshes contain (almost) no sybil edges at steady state
-    sybil_mesh_edges = (np.asarray(out.mesh) & cand_sybil)[honest_rows]
+    sybil_mesh_edges = (np.asarray(out.mesh) & cand_sybil)[:, honest_rows]
     assert sybil_mesh_edges.mean() < 0.02
     bp = np.asarray(out.scores.behaviour_penalty)
     assert bp[cand_sybil].max() > 0.5

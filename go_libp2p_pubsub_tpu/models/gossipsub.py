@@ -13,30 +13,28 @@ TPU-first representation (see PERF_NOTES.md):
   C ring offsets, all multiples of T and closed under negation.  Candidates
   model what discovery + peer exchange give a deployed node: the topic
   peers it *could* connect to (discovery.go:108-173, PX gossipsub.go:856).
-- **Mesh/fanout/gossip-targets = bool masks [C, N]** over those candidate
-  rows.  GRAFT/PRUNE flip mask bits; degree bounds (D/Dlo/Dhi,
-  gossipsub.go:33-40) make C a small compile-time constant.
+- **Mesh/fanout/eligibility/handshake masks are uint32 bitmasks [N]** over
+  the candidate bits (C <= 32).  GRAFT/PRUNE flip bits; degree = popcount;
+  all the mask logic of the heartbeat is single-word elementwise ops at
+  4 bytes/peer — the same bit-packing as message possession.  Degree
+  bounds (D/Dhi, gossipsub.go:33-40) keep C a small compile-time constant.
 - **Peer-minor layout everywhere.**  The peer axis is the LAST axis of
-  every hot array ([C, N] masks/scores, [W, N] possession words), so it
-  sits on the TPU's 128 vector lanes: full-bandwidth elementwise ops,
-  contiguous [N] rows whose 1D rolls are ~12x faster than 2D column
-  rolls, and no strided candidate slicing.
-- **Edge duality is a row permutation + roll.**  The link (p, p+o_c) seen
-  from the partner is row ``cinv[c]`` where ``o_cinv = -o_c``, so sending
-  per-edge data to the partner — GRAFT/PRUNE announcements, message
-  words — is ``roll(x[c], o_c)`` landing in row cinv[c].  The whole
-  heartbeat is 1D rolls, masks, popcounts, and a few all-pairs rank
-  compares: **no gathers and no sorts** (XLA gather is ~1000x slower than
-  roll on this topology; a C^2 comparison count beats argsort ~6x).
+  every dense array ([C, N] score counters, [W, N] possession words), so
+  it sits on the TPU's 128 vector lanes: full-bandwidth elementwise ops
+  and contiguous [N] rows whose 1D rolls are ~12x faster than 2D column
+  rolls.
+- **Edge duality is a bit permutation + roll.**  The link (p, p+o_c) seen
+  from the partner is bit ``cinv[c]`` where ``o_cinv = -o_c``, so sending
+  a mask to the partners is roll bit c by o_c into bit cinv[c]
+  (transfer_bits) — no gathers, no stacks.
+- **Selection is rank-compare, not sort.**  Top-k by random or score
+  priority is an all-pairs C² comparison count (ranks_desc) — ~6x faster
+  than argsort at C=16 — wrapped in expand/pack so inputs and outputs
+  stay packed.
 - **Messages are bit positions** in uint32 words, as in models/floodsub.py.
   The mcache (mcache.go) becomes a ring of recently-acquired words: slot 0
   = newest heartbeat window; IHAVE advertises the OR of the newest
   HistoryGossip slots (mcache.go:82, GetGossipIDs).
-- **Data-dependent maintenance is cond-gated.**  Graft/prune/fanout
-  selection and opportunistic grafting run under ``lax.cond`` on "any
-  peer needs it" — after the mesh converges the expensive selection work
-  is skipped entirely, exactly like the reference's heartbeat doing
-  nothing when every mesh is within [Dlo, Dhi].
 
 Timing model: one tick = one heartbeat = one network hop.  Reachability is
 measured in hops (publish-tick-relative), which is exactly the
@@ -56,14 +54,18 @@ from flax import struct
 
 from ..ops.graph import (
     WORD_BITS,
+    bit_row,
     count_bits_per_position,
+    expand_bits,
     lane_uniform,
     make_circulant_offsets,
     pack_bits,
     pack_bits_pm,
+    pack_rows,
+    popcount32,
     ranks_desc,
-    select_k_by_priority,
-    select_k_per_peer,
+    select_k_bits,
+    select_k_by_priority_bits,
 )
 from ._delivery import (
     reach_counts_from_first_tick,
@@ -99,6 +101,8 @@ class GossipSimConfig:
         offs = np.asarray(self.offsets, dtype=np.int64)
         if len(offs) == 0 or len(set(offs.tolist())) != len(offs):
             raise ValueError("offsets must be distinct and non-empty")
+        if len(offs) > 32:
+            raise ValueError("at most 32 candidates (uint32 bitmasks)")
         if not all((-o) in set(offs.tolist()) for o in offs.tolist()):
             raise ValueError("offsets must be closed under negation")
         if any(o % self.n_topics for o in offs.tolist()):
@@ -119,10 +123,17 @@ class GossipSimConfig:
 
     @property
     def cinv(self) -> tuple[int, ...]:
-        """cinv[c] = row of the negated offset (the partner's view of
-        edge row c)."""
+        """cinv[c] = bit of the negated offset (the partner's view of
+        edge bit c)."""
         idx = {o: i for i, o in enumerate(self.offsets)}
         return tuple(idx[-o] for o in self.offsets)
+
+    @property
+    def outbound_mask(self) -> int:
+        """Static bitmask of outbound candidate bits (we dial positive
+        offsets; the reference tracks dial direction per conn,
+        gossipsub.go:1376-1435)."""
+        return sum(1 << c for c, o in enumerate(self.offsets) if o > 0)
 
 
 def make_gossip_offsets(n_topics: int, n_candidates: int, n_peers: int,
@@ -196,6 +207,19 @@ class ScoreSimConfig:
     # sybil behavior toggles (peers flagged sybil in params)
     sybil_ihave_spam: bool = False          # broken-promise IWANT flood
     sybil_graft_flood: bool = False         # re-GRAFT while backed off
+    # counter storage dtype: bfloat16 halves the dominant HBM traffic of
+    # the v1.1 step (6 [C, N] counters r+w per tick); the counters are
+    # small decaying sums where ~3 significant digits is ample.  All
+    # arithmetic still runs in f32 (cast on read, cast on write).
+    counter_dtype: str = "bfloat16"
+
+    @property
+    def track_p3(self) -> bool:
+        """P3/P3b bookkeeping (mesh-delivery deficits) is skipped entirely
+        when both weights are 0 — the shipped default, mirroring that the
+        reference requires explicit per-topic P3 calibration."""
+        return (self.mesh_message_deliveries_weight != 0
+                or self.mesh_failure_penalty_weight != 0)
 
     def validate(self) -> None:
         """The reference's sign/range invariants are free tests
@@ -228,7 +252,8 @@ class ScoreSimConfig:
 
 
 # --------------------------------------------------------------------------
-# Pytrees (all candidate arrays [C, N], all word arrays [W, N] — peer-minor)
+# Pytrees.  Candidate masks are packed uint32 [N]; dense per-edge numeric
+# state (score counters, backoff ticks) is [C, N] peer-minor.
 # --------------------------------------------------------------------------
 
 
@@ -241,7 +266,7 @@ class GossipParams:
     """
 
     subscribed: jnp.ndarray      # bool [N]: has a local subscription
-    cand_subscribed: jnp.ndarray # bool [C, N]: candidate q=p+o_c subscribed
+    cand_sub_bits: jnp.ndarray   # uint32 [N]: bit c = candidate subscribed
     origin_words: jnp.ndarray    # uint32 [W, N]: bit m set at origin[m]
     deliver_words: jnp.ndarray   # uint32 [W, N]: msg m counts as delivery
     publish_tick: jnp.ndarray    # int32 [M]
@@ -258,7 +283,9 @@ class ScoreState:
     candidate p+o_c (the score engine's per-(peer, topic) stats,
     score.go:95-118, densified on the candidate axis)."""
 
-    time_in_mesh: jnp.ndarray        # f32 [C, N] ticks since graft (P1)
+    time_in_mesh: jnp.ndarray        # int16 [C, N] ticks since graft (P1;
+    #   exact integer count — bf16 would silently stick at 256 — saturated
+    #   at 32766)
     first_deliveries: jnp.ndarray    # f32 [C, N] decaying counter (P2)
     mesh_deliveries: jnp.ndarray     # f32 [C, N] decaying counter (P3)
     mesh_failure_penalty: jnp.ndarray  # f32 [C, N] sticky deficit² (P3b)
@@ -268,8 +295,8 @@ class ScoreState:
 
 @struct.dataclass
 class GossipState:
-    mesh: jnp.ndarray        # bool [C, N]  my mesh membership per candidate
-    fanout: jnp.ndarray      # bool [C, N]  publish-without-join targets
+    mesh: jnp.ndarray        # uint32 [N]  mesh membership bitmask
+    fanout: jnp.ndarray      # uint32 [N]  publish-without-join bitmask
     last_pub: jnp.ndarray    # int32 [N]    last publish tick (fanout TTL)
     backoff: jnp.ndarray     # int32 [C, N] no re-GRAFT until this tick
     have: jnp.ndarray        # uint32 [W, N]
@@ -323,6 +350,14 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
         """Per-candidate view: out[c, p] = per_peer[p + o_c]."""
         return np.stack([np.roll(per_peer, -o) for o in cfg.offsets], axis=0)
 
+    def cand_bits(per_peer_bool):
+        """Packed per-candidate view: uint32 [N], bit c set iff
+        per_peer[p + o_c]."""
+        out = np.zeros(n, dtype=np.uint32)
+        for c, o in enumerate(cfg.offsets):
+            out |= np.roll(per_peer_bool, -o).astype(np.uint32) << c
+        return out
+
     kw = {}
     if score_cfg is not None:
         score_cfg.validate()
@@ -348,7 +383,7 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
 
     params = GossipParams(
         subscribed=jnp.asarray(subscribed),
-        cand_subscribed=jnp.asarray(cand_view(subscribed)),
+        cand_sub_bits=jnp.asarray(cand_bits(subscribed)),
         origin_words=pack_bits_pm(jnp.asarray(origin_bits)),
         deliver_words=pack_bits_pm(jnp.asarray(deliver_bits)),
         publish_tick=jnp.asarray(msg_publish_tick, dtype=jnp.int32),
@@ -356,17 +391,21 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
     )
     w = params.origin_words.shape[0]
     c = cfg.n_candidates
-    zc = lambda: jnp.zeros((c, n), dtype=jnp.float32)  # noqa: E731
+    cdt = (jnp.dtype(score_cfg.counter_dtype) if score_cfg is not None
+           else jnp.float32)
+    zc = lambda: jnp.zeros((c, n), dtype=cdt)  # noqa: E731
+    zt = lambda: jnp.zeros((c, n), dtype=jnp.int16)  # noqa: E731
+    zbits = lambda: jnp.zeros((n,), dtype=jnp.uint32)  # noqa: E731
     state = GossipState(
-        mesh=jnp.zeros((c, n), dtype=bool),
-        fanout=jnp.zeros((c, n), dtype=bool),
+        mesh=zbits(),
+        fanout=zbits(),
         last_pub=jnp.full((n,), -(10 ** 9), dtype=jnp.int32),
         backoff=jnp.zeros((c, n), dtype=jnp.int32),
         have=jnp.zeros((w, n), dtype=jnp.uint32),
         recent=jnp.zeros((cfg.history_gossip, w, n), dtype=jnp.uint32),
         first_tick=(jnp.full((w, WORD_BITS, n), -1, dtype=jnp.int16)
                     if track_first_tick else None),
-        scores=(ScoreState(time_in_mesh=zc(), first_deliveries=zc(),
+        scores=(ScoreState(time_in_mesh=zt(), first_deliveries=zc(),
                            mesh_deliveries=zc(), mesh_failure_penalty=zc(),
                            invalid_deliveries=zc(), behaviour_penalty=zc())
                 if score_cfg is not None else None),
@@ -381,37 +420,36 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
 # --------------------------------------------------------------------------
 
 
-def edge_transfer(rows: list[jnp.ndarray], cfg: GossipSimConfig):
-    """Given per-row arrays (each [N], row c describing edge (p, p+o_c)),
-    return the received per-row list: out[cinv[c]] = roll(rows[c], o_c) —
-    what each peer's partner sent it on that edge."""
-    out = [None] * cfg.n_candidates
+def transfer_bits(bits: jnp.ndarray, cfg: GossipSimConfig) -> jnp.ndarray:
+    """Packed-mask edge transfer: what each peer's partners sent it.
+
+    bits: uint32 [N], bit c describing edge (p, p+o_c).  Bit c rolled by
+    o_c lands in the partner's bit cinv[c]: out = OR_c roll(bit_c) <<
+    cinv[c].  C 1D rolls + shifts, no stacking.
+    """
+    out = jnp.zeros_like(bits)
     for c, off in enumerate(cfg.offsets):
-        out[cfg.cinv[c]] = jnp.roll(rows[c], off, axis=0)
+        b = (bits >> jnp.uint32(c)) & jnp.uint32(1)
+        out = out | (jnp.roll(b, off, axis=0) << jnp.uint32(cfg.cinv[c]))
     return out
 
 
 def transfer_mask(mask: jnp.ndarray, cfg: GossipSimConfig) -> jnp.ndarray:
-    """edge_transfer for a bool [C, N] mask (row-stacked form)."""
-    rows = edge_transfer([mask[c] for c in range(cfg.n_candidates)], cfg)
+    """edge_transfer for an UNPACKED bool [C, N] mask (tests/analysis;
+    the hot path uses transfer_bits)."""
+    rows = [None] * cfg.n_candidates
+    for c, off in enumerate(cfg.offsets):
+        rows[cfg.cinv[c]] = jnp.roll(mask[c], off, axis=0)
     return jnp.stack(rows, axis=0)
 
 
-def masked_word_or(words: jnp.ndarray, mask: jnp.ndarray,
-                   cfg: GossipSimConfig) -> jnp.ndarray:
-    """OR of ``words`` sent along every masked edge: what each peer hears.
+def mesh_matrix(state: GossipState, cfg: GossipSimConfig) -> jnp.ndarray:
+    """The mesh bitmask as bool [C, N] (tests/analysis)."""
+    return expand_bits(state.mesh, cfg.n_candidates)
 
-    words: uint32 [W, N] (sender payload); mask: bool [C, N] (sender's
-    out-edges).  One 1D roll per (word, candidate) — the hot op.
-    """
-    out_rows = []
-    for w in range(words.shape[0]):
-        acc = jnp.zeros_like(words[w])
-        for c, off in enumerate(cfg.offsets):
-            sent = jnp.where(mask[c], words[w], jnp.uint32(0))
-            acc = acc | jnp.roll(sent, off, axis=0)
-        out_rows.append(acc)
-    return jnp.stack(out_rows, axis=0)
+
+def fanout_matrix(state: GossipState, cfg: GossipSimConfig) -> jnp.ndarray:
+    return expand_bits(state.fanout, cfg.n_candidates)
 
 
 # --------------------------------------------------------------------------
@@ -425,21 +463,27 @@ def compute_scores(sc: ScoreSimConfig, params: GossipParams,
     candidate p+o_c (score.go:256-333).  One topic per peer, so the
     per-topic sum collapses to the single topic's contribution."""
     s = st.scores
-    p1 = jnp.minimum(s.time_in_mesh / sc.time_in_mesh_quantum,
-                     sc.time_in_mesh_cap)
-    p2 = s.first_deliveries                    # capped at increment time
-    deficit = jnp.maximum(
-        0.0, sc.mesh_message_deliveries_threshold - s.mesh_deliveries)
-    active = s.time_in_mesh > sc.mesh_message_deliveries_activation
-    p3 = jnp.where(st.mesh & active, deficit * deficit, 0.0)
+    c = s.time_in_mesh.shape[0]
+    f32 = lambda x: x.astype(jnp.float32)  # noqa: E731 (counters may be bf16)
+    tim = f32(s.time_in_mesh)
+    invd = f32(s.invalid_deliveries)
+    p1 = jnp.minimum(tim / sc.time_in_mesh_quantum, sc.time_in_mesh_cap)
+    p2 = f32(s.first_deliveries)               # capped at increment time
     topic = (sc.time_in_mesh_weight * p1
              + sc.first_message_deliveries_weight * p2
-             + sc.mesh_message_deliveries_weight * p3
-             + sc.mesh_failure_penalty_weight * s.mesh_failure_penalty
-             + sc.invalid_message_deliveries_weight
-             * s.invalid_deliveries * s.invalid_deliveries)
+             + sc.invalid_message_deliveries_weight * invd * invd)
+    if sc.track_p3:
+        in_mesh = expand_bits(st.mesh, c)
+        deficit = jnp.maximum(
+            0.0, sc.mesh_message_deliveries_threshold
+            - f32(s.mesh_deliveries))
+        active = tim > sc.mesh_message_deliveries_activation
+        p3 = jnp.where(in_mesh & active, deficit * deficit, 0.0)
+        topic = (topic + sc.mesh_message_deliveries_weight * p3
+                 + sc.mesh_failure_penalty_weight
+                 * f32(s.mesh_failure_penalty))
     bp_excess = jnp.maximum(
-        0.0, s.behaviour_penalty - sc.behaviour_penalty_threshold)
+        0.0, f32(s.behaviour_penalty) - sc.behaviour_penalty_threshold)
     return (sc.topic_weight * topic
             + sc.app_specific_weight * params.cand_app_score
             + sc.ip_colocation_factor_weight
@@ -465,7 +509,7 @@ def make_gossip_step(cfg: GossipSimConfig,
     With score_cfg, the v1.1 hardening layer is woven through every phase:
     start-of-tick scores gate inbound RPCs (graylist), gossip exchange
     (gossip threshold), and publish flooding (publish threshold); delivery
-    provenance per candidate row feeds the P2/P3/P4 counters; mesh
+    provenance per candidate bit feeds the P2/P3/P4 counters; mesh
     maintenance prunes negative-score peers, keeps the Dscore best + Dout
     outbound on oversubscription (gossipsub.go:1376-1435), and
     opportunistically grafts when the mesh median sags
@@ -476,62 +520,57 @@ def make_gossip_step(cfg: GossipSimConfig,
     sc = score_cfg
     offsets = tuple(int(o) for o in cfg.offsets)
     cinv = cfg.cinv
-    outbound_rows = jnp.asarray(
-        np.array([o > 0 for o in offsets]))    # [C]: we dial positive offsets
+    OUT_MASK = jnp.uint32(cfg.outbound_mask)
+    ALL = jnp.uint32((1 << C) - 1)
+    Z = jnp.uint32(0)
     pc = jax.lax.population_count
 
     def step(params: GossipParams, state: GossipState):
         tick = state.tick
         sub = params.subscribed            # bool [N]
+        sub_all = jnp.where(sub, ALL, Z)   # uint32 [N] gate
         n = sub.shape[0]
         W = state.have.shape[0]
         # per-phase uniform fields from the counter-based lane hash (the
         # carried PRNG key's last word is the run seed; threefry per tick
         # would dominate the elementwise cost of the whole step)
         salt = jax.random.key_data(state.key)[-1]
-        u_gossip = lane_uniform((C, n), tick, 1, salt)
-        u_graft = lane_uniform((C, n), tick, 2, salt)
-        u_prune = lane_uniform((C, n), tick, 3, salt)
-        u_fanout = lane_uniform((C, n), tick, 4, salt)
-        u_og = lane_uniform((C, n), tick, 5, salt)
-
-        def gated_select(elig, k, u):
-            """select_k_per_peer, skipped entirely when no peer needs it
-            (the common converged state)."""
-            return jax.lax.cond(
-                jnp.any(k > 0),
-                lambda: select_k_per_peer(elig, k, u),
-                lambda: jnp.zeros_like(elig))
+        u_spec = lambda phase: (C, tick, phase, salt)  # noqa: E731
 
         # -- 0. start-of-tick scores and the gates they drive -----------
         if sc is not None:
             score = compute_scores(sc, params, state)           # [C, N]
-            # graylist: drop ALL inbound on edges below the graylist
-            # threshold (AcceptFrom, gossipsub.go:584-586)
-            edge_accept = score >= sc.graylist_threshold
-            gossip_ok = score >= sc.gossip_threshold
+            # packed threshold gates: bit c set iff the candidate edge
+            # clears the threshold (AcceptFrom graylist gossipsub.go:584;
+            # gossip/publish thresholds :610,956; graft score >= 0 :1340)
+            accept_bits = pack_rows(score >= sc.graylist_threshold)
+            gossip_bits = pack_rows(score >= sc.gossip_threshold)
+            pub_ok_bits = pack_rows(score >= sc.publish_threshold)
+            nonneg_bits = pack_rows(score >= 0)
             # RED gater: under invalid-traffic pressure, payload from an
             # edge is accepted with its goodput probability
             # (peer_gater.go:320-363; stats per edge, decayed with the
             # score counters — sybils behind one IP already share fate
             # via P6)
             s0 = state.scores
-            inv_tot = s0.invalid_deliveries.sum(axis=0)         # [N]
-            del_tot = s0.first_deliveries.sum(axis=0)
+            f32 = lambda x: x.astype(jnp.float32)  # noqa: E731
+            invd = f32(s0.invalid_deliveries)
+            fdel = f32(s0.first_deliveries)
+            inv_tot = invd.sum(axis=0)                          # [N]
+            del_tot = fdel.sum(axis=0)
             pressure = 16.0 * inv_tot / (1.0 + del_tot + 16.0 * inv_tot)
             gater_on = pressure > 0.33
-            goodput = ((1.0 + s0.first_deliveries)
-                       / (1.0 + s0.first_deliveries
-                          + 16.0 * s0.invalid_deliveries))
-            p_accept = jnp.where(gater_on[None, :], goodput, 1.0)
-            gater_ok = lane_uniform((C, n), tick, 6, salt) < p_accept
-            payload_ok = edge_accept & gater_ok                 # [C, N]
+            goodput = (1.0 + fdel) / (1.0 + fdel + 16.0 * invd)
+            u_gater = lane_uniform((C, n), tick, 6, salt)
+            gater_bits = pack_rows(u_gater < goodput) | jnp.where(
+                gater_on, Z, ALL)
+            payload_bits = accept_bits & gater_bits             # [N]
             # per-word validity masks (scalar uint32 per word: bit m set
             # iff message m passes validation)
             valid_w = [~params.invalid_words[w] for w in range(W)]
         else:
             score = None
-            edge_accept = gossip_ok = payload_ok = None
+            accept_bits = gossip_bits = payload_bits = None
             valid_w = None
 
         # -- 1. publish injection ---------------------------------------
@@ -549,13 +588,16 @@ def make_gossip_step(cfg: GossipSimConfig,
         # publishes — unsubscribed peers accept nothing to relay.
         last_pub = jnp.where(publishing, tick, state.last_pub)
         alive = (~sub) & (tick - last_pub < cfg.fanout_ttl_ticks)
-        fanout = state.fanout & alive[None, :]
-        f_deg = fanout.sum(axis=0, dtype=jnp.int32)
+        fanout = jnp.where(alive, state.fanout, Z)
+        f_deg = popcount32(fanout)
         f_need = jnp.where(alive, cfg.d - f_deg, 0)
-        f_elig = params.cand_subscribed & ~fanout
+        f_elig = params.cand_sub_bits & ~fanout
         if sc is not None:  # fanout requires score >= publish threshold
-            f_elig = f_elig & (score >= sc.publish_threshold)
-        fanout = fanout | gated_select(f_elig, f_need, u_fanout)
+            f_elig = f_elig & pub_ok_bits
+        fanout = fanout | jax.lax.cond(
+            jnp.any(f_need > 0),
+            lambda: select_k_bits(f_elig, f_need, u_spec(4)),
+            lambda: jnp.zeros_like(fanout))
 
         # -- 2. eager forward with per-edge provenance ------------------
         # What I acquired last tick + my fresh publishes go to my mesh /
@@ -566,52 +608,56 @@ def make_gossip_step(cfg: GossipSimConfig,
         if sc is not None:
             fresh = [jnp.where(params.sybil, f, f & valid_w[w])
                      for w, f in enumerate(fresh)]
-        out_edges = state.mesh | fanout                         # [C, N]
+        out_bits = state.mesh | fanout                          # [N]
         if sc is not None and sc.flood_publish:
             # own publishes additionally flood to every candidate above
             # the publish threshold (gossipsub.go:953-959)
-            flood_edges = params.cand_subscribed & (
-                score >= sc.publish_threshold)
+            flood_bits = params.cand_sub_bits & pub_ok_bits
         else:
-            flood_edges = None
+            flood_bits = None
 
         have_start = state.have
-        claimed = list(injected)    # first-arrival provenance accumulator
-        fd_add = [None] * C         # per-receiver-row popcounts (int32 [N])
+        seen = [have_start[w] | injected[w] for w in range(W)]
+        fd_add = [None] * C         # per-receiver-bit popcounts (int32 [N])
         md_new = [None] * C
         inv_add = [None] * C
+        mesh_heard = [Z] * W
 
         def acc(a, b):
             return b if a is None else a + b
 
+        # Columns are independent: every same-tick deliverer of a new
+        # message gets delivery credit (the reference's near-first window
+        # covers simultaneous copies, score.go:684-818; with one tick =
+        # one heartbeat, same-tick ties ARE the window — and crediting all
+        # of them avoids biasing credit by candidate-bit order).
         for c_send, off in enumerate(offsets):
-            j = cinv[c_send]    # receiver-side row for this edge
-            mask_c = out_edges[c_send]                          # [N]
+            j = cinv[c_send]    # receiver-side bit for this edge
+            mask_c = bit_row(out_bits, c_send)                  # [N]
+            ok_j = bit_row(payload_bits, j) if sc is not None else None
             fd_j = md_j = iv_j = None
             for w in range(W):
-                sent = jnp.where(mask_c, fresh[w], jnp.uint32(0))
-                if flood_edges is not None:
-                    sent = sent | jnp.where(flood_edges[c_send],
-                                            injected[w], jnp.uint32(0))
+                sent = jnp.where(mask_c, fresh[w], Z)
+                if flood_bits is not None:
+                    sent = sent | jnp.where(bit_row(flood_bits, c_send),
+                                            injected[w], Z)
                 rolled = jnp.roll(sent, off, axis=0)
+                if ok_j is not None:
+                    rolled = jnp.where(ok_j, rolled, Z)
+                news = rolled & ~seen[w]
+                mesh_heard[w] = mesh_heard[w] | news
                 if sc is not None:
-                    rolled = jnp.where(payload_ok[j], rolled,
-                                       jnp.uint32(0))
-                news = rolled & ~have_start[w] & ~claimed[w]
-                claimed[w] = claimed[w] | news
-                if sc is not None:
-                    # P2/P4 credit the first deliverer only (later copies
-                    # are dropped at the seen-cache, pubsub.go:851-868);
-                    # P3 also counts same-tick near-first copies from mesh
-                    # members (deliveries window, score.go:684-818)
+                    # P2/P4 credit new-message deliverers (later-tick
+                    # copies are dropped at the seen-cache,
+                    # pubsub.go:851-868); P3 additionally counts duplicate
+                    # copies from mesh members in the window
                     fd_j = acc(fd_j, pc(news & valid_w[w]))
-                    md_j = acc(md_j, pc(rolled & valid_w[w]
-                                        & ~have_start[w]))
+                    if sc.track_p3:
+                        md_j = acc(md_j, pc(rolled & valid_w[w]
+                                            & ~have_start[w]))
                     iv_j = acc(iv_j, pc(news & ~valid_w[w]))
             fd_add[j], md_new[j], inv_add[j] = fd_j, md_j, iv_j
-        heard_new = [claimed[w] & ~injected[w] for w in range(W)]
-        new_mesh_bits = [jnp.where(sub, hw, jnp.uint32(0))
-                         for hw in heard_new]
+        new_mesh_bits = [jnp.where(sub, hw, Z) for hw in mesh_heard]
 
         # -- 3. lazy gossip (IHAVE/IWANT collapsed to one exchange) -----
         # advertise ids seen in the last HistoryGossip windows; targets =
@@ -625,40 +671,41 @@ def make_gossip_step(cfg: GossipSimConfig,
             if sc is not None:
                 aw = jnp.where(params.sybil, aw, aw & valid_w[w])
             adv.append(aw)
-        elig = (params.cand_subscribed & ~state.mesh & ~state.fanout
-                & sub[None, :])     # only subscribed peers gossip
+        elig = (params.cand_sub_bits & ~state.mesh & ~state.fanout
+                & sub_all)          # only subscribed peers gossip
         if sc is not None:
-            elig = elig & gossip_ok
-        n_elig = elig.sum(axis=0, dtype=jnp.int32)
+            elig = elig & gossip_bits
+        n_elig = popcount32(elig)
         n_gossip = jnp.maximum(
             jnp.int32(cfg.d_lazy),
             (cfg.gossip_factor * n_elig.astype(jnp.float32)).astype(
                 jnp.int32))
-        targets = select_k_per_peer(elig, n_gossip, u_gossip)
+        targets = select_k_bits(elig, n_gossip, u_spec(1))
         if sc is not None and sc.sybil_ihave_spam:
             # IHAVE-spamming sybils advertise ids they never deliver
             # (gossipsub_spam_test.go:135): their gossip carries nothing,
             # and each spammed peer records a broken promise -> P7
             # (gossip_tracer.go:48-117, applyIwantPenalties)
-            sybil_send = params.sybil[None, :] & params.cand_subscribed
-            targets = jnp.where(params.sybil[None, :], sybil_send, targets)
-        claimed_g = list(claimed)
-        bp_spam = None
+            targets = jnp.where(params.sybil, params.cand_sub_bits,
+                                targets)
+        seen_g = [seen[w] | mesh_heard[w] for w in range(W)]
+        gossip_heard = [Z] * W
+        bp_spam_bits = None
         for c_send, off in enumerate(offsets):
             j = cinv[c_send]
-            send_mask = targets[c_send]
+            send_mask = bit_row(targets, c_send)
             if sc is not None and sc.sybil_ihave_spam:
                 send_mask = send_mask & ~params.sybil
             ok_j = None
             if sc is not None:
-                ok_j = payload_ok[j] & gossip_ok[j]
+                ok_j = bit_row(payload_bits & gossip_bits, j)
             for w in range(W):
-                sent = jnp.where(send_mask, adv[w], jnp.uint32(0))
+                sent = jnp.where(send_mask, adv[w], Z)
                 rolled = jnp.roll(sent, off, axis=0)
                 if ok_j is not None:
-                    rolled = jnp.where(ok_j, rolled, jnp.uint32(0))
-                news = rolled & ~have_start[w] & ~claimed_g[w]
-                claimed_g[w] = claimed_g[w] | news
+                    rolled = jnp.where(ok_j, rolled, Z)
+                news = rolled & ~seen_g[w]
+                gossip_heard[w] = gossip_heard[w] | news
                 if sc is not None:
                     # IWANT-pulled messages go through validation like any
                     # other delivery: P2 credit for valid, P4 for invalid
@@ -666,11 +713,10 @@ def make_gossip_step(cfg: GossipSimConfig,
                     inv_add[j] = inv_add[j] + pc(news & ~valid_w[w])
         if sc is not None and sc.sybil_ihave_spam:
             # broken-promise bookkeeping: one P7 unit per sybil IHAVE spam
-            spam_rows = edge_transfer(
-                [targets[c] & params.sybil for c in range(C)], cfg)
-            bp_spam = jnp.stack(spam_rows, axis=0).astype(jnp.float32)
-        new_gossip_bits = [jnp.where(sub, claimed_g[w] & ~claimed[w],
-                                     jnp.uint32(0)) for w in range(W)]
+            bp_spam_bits = transfer_bits(
+                jnp.where(params.sybil, targets, Z), cfg)
+        new_gossip_bits = [jnp.where(sub, gossip_heard[w], Z)
+                           for w in range(W)]
 
         new_acquired = (jnp.stack(
             [new_mesh_bits[w] | new_gossip_bits[w] | injected[w]
@@ -692,51 +738,50 @@ def make_gossip_step(cfg: GossipSimConfig,
 
         if sc is not None:
             # drop negative-score mesh members first (gossipsub.go:1332)
-            neg = mesh & (score < 0)
-            mesh = mesh & ~neg
-            backoff = jnp.where(neg, tick + cfg.backoff_ticks, backoff)
+            neg = mesh & ~nonneg_bits
+            mesh = mesh & nonneg_bits
         else:
             neg = None
-        in_backoff = backoff > tick
-        deg = mesh.sum(axis=0, dtype=jnp.int32)                 # [N]
+        deg = popcount32(mesh)                                  # [N]
 
         # graft up to D when deg < Dlo (gossipsub.go:1340-1360);
-        # candidates need score >= 0 in v1.1
-        can_graft = (params.cand_subscribed & ~mesh & ~in_backoff
-                     & sub[None, :])
+        # candidates need score >= 0 in v1.1.  in_backoff is the only
+        # per-edge numeric state: pack the comparison once.
+        backoff_bits = pack_rows(backoff > tick)
+        can_graft = (params.cand_sub_bits & ~mesh & ~backoff_bits
+                     & sub_all)
         if sc is not None:
-            can_graft = can_graft & (score >= 0)
+            can_graft = can_graft & nonneg_bits
         need = jnp.where(deg < cfg.d_lo, cfg.d - deg, 0)
-        grafts = gated_select(can_graft, need, u_graft)
+        grafts = jax.lax.cond(
+            jnp.any(need > 0),
+            lambda: select_k_bits(can_graft, need, u_spec(2)),
+            lambda: jnp.zeros_like(mesh))
 
         # prune down to D when deg > Dhi.  v1.0: random retention; v1.1:
         # keep the Dscore best by score, then at least Dout outbound,
         # random fill to D (anti-sybil bubble-up, gossipsub.go:1376-1435).
-        # The whole selection runs under a cond: once every mesh fits in
-        # [Dlo, Dhi] (the converged state) it costs nothing.
         over = deg > cfg.d_hi
 
         def compute_prunes():
             if sc is None:
-                keep = select_k_per_peer(mesh, jnp.full_like(deg, cfg.d),
-                                         u_prune)
+                keep = select_k_bits(mesh, jnp.full_like(deg, cfg.d),
+                                     u_spec(3))
             else:
-                rnd = u_prune
-                top = select_k_by_priority(mesh, score,
-                                           jnp.full_like(deg, cfg.d_score),
-                                           tiebreak=rnd)
-                out_rows = jnp.broadcast_to(outbound_rows[:, None], (C, n))
-                n_out_top = (top & out_rows).sum(axis=0, dtype=jnp.int32)
+                rnd = lane_uniform((C, n), tick, 3, salt)
+                top = select_k_by_priority_bits(
+                    mesh, score, jnp.full_like(deg, cfg.d_score),
+                    tiebreak=rnd)
+                n_out_top = popcount32(top & OUT_MASK)
                 need_out = jnp.maximum(0, cfg.d_out - n_out_top)
-                out_keep = select_k_by_priority(mesh & ~top & out_rows,
-                                                rnd, need_out)
+                out_keep = select_k_by_priority_bits(
+                    mesh & ~top & OUT_MASK, rnd, need_out)
                 taken = top | out_keep
-                n_taken = taken.sum(axis=0, dtype=jnp.int32)
-                fill = select_k_by_priority(mesh & ~taken, rnd,
-                                            jnp.maximum(cfg.d - n_taken,
-                                                        0))
+                n_taken = popcount32(taken)
+                fill = select_k_by_priority_bits(
+                    mesh & ~taken, rnd, jnp.maximum(cfg.d - n_taken, 0))
                 keep = taken | fill
-            return mesh & ~keep & over[None, :]
+            return mesh & ~keep & jnp.where(over, ALL, Z)
 
         prunes = jax.lax.cond(jnp.any(over), compute_prunes,
                               lambda: jnp.zeros_like(mesh))
@@ -749,19 +794,21 @@ def make_gossip_step(cfg: GossipSimConfig,
             do_og = (tick % sc.opportunistic_graft_ticks) == 0
 
             def compute_og():
-                # median = the mesh row at ascending rank deg//2 =
-                # descending rank C-1-deg//2 (non-mesh rows pinned to
+                # median = the mesh bit at ascending rank deg//2 =
+                # descending rank C-1-deg//2 (non-mesh bits pinned to
                 # +inf rank first); rank-compare instead of a sort
-                mesh_rank = ranks_desc(jnp.where(mesh, score, jnp.inf))
-                med_pick = mesh & (mesh_rank
-                                   == (C - 1 - deg // 2)[None, :])
+                in_mesh = expand_bits(mesh, C)
+                mesh_rank = ranks_desc(jnp.where(in_mesh, score, jnp.inf))
+                med_pick = in_mesh & (mesh_rank
+                                      == (C - 1 - deg // 2)[None, :])
                 median = jnp.where(
                     deg > 0, jnp.where(med_pick, score, 0.0).sum(0), 0.0)
                 og_row = (median < sc.opportunistic_graft_threshold) & sub
-                og_elig = can_graft & ~grafts & (score > median[None, :])
+                og_elig = (can_graft & ~grafts
+                           & pack_rows(score > median[None, :]))
                 og_need = jnp.where(og_row, sc.opportunistic_graft_peers,
                                     0)
-                return select_k_per_peer(og_elig, og_need, u_og)
+                return select_k_bits(og_elig, og_need, u_spec(5))
 
             grafts = grafts | jax.lax.cond(
                 do_og, compute_og, lambda: jnp.zeros_like(mesh))
@@ -769,80 +816,100 @@ def make_gossip_step(cfg: GossipSimConfig,
         if sc is not None and sc.sybil_graft_flood:
             # GRAFT-flooding sybils re-graft every tick, ignoring their
             # own backoff (gossipsub_spam_test.go:349)
-            sybil_grafts = (params.cand_subscribed & ~mesh
-                            & params.sybil[None, :])
-            grafts = jnp.where(params.sybil[None, :], sybil_grafts,
-                               grafts)
+            grafts = jnp.where(params.sybil,
+                               params.cand_sub_bits & ~mesh, grafts)
 
         mesh = (mesh | grafts) & ~prunes
-        backoff = jnp.where(prunes, tick + cfg.backoff_ticks, backoff)
+        # backoff writes (one fused [C, N] pass): negative-score drops and
+        # prunes overwrite to tick+B (gossipsub.go:1332-1338)
+        bo_set = expand_bits(prunes if neg is None else prunes | neg, C)
+        backoff = jnp.where(bo_set, tick + cfg.backoff_ticks, backoff)
 
         # handshake: partner accepts GRAFT unless unsubscribed, backed
         # off, or (v1.1) negative-scored (handleGraft gossipsub.go:713-
         # 804); PRUNE always removes + backs off (handlePrune :806-838).
         # Negative-score prunes notify the partner too (the reference
         # sends PRUNE for every mesh removal, gossipsub.go:1332-1338).
-        graft_recv = transfer_mask(grafts, cfg)
-        prune_recv = transfer_mask(prunes if neg is None else prunes | neg,
+        graft_recv = transfer_bits(grafts, cfg)
+        prune_recv = transfer_bits(prunes if neg is None else prunes | neg,
                                    cfg)
         if sc is not None:
             # graylisted peers' control traffic is dropped outright
-            graft_recv = graft_recv & edge_accept
-            prune_recv = prune_recv & edge_accept
-        backoff_violation = graft_recv & (backoff > tick)
-        accept = graft_recv & sub[None, :] & ~(backoff > tick)
+            graft_recv = graft_recv & accept_bits
+            prune_recv = prune_recv & accept_bits
+        # post-write backoff bits, derived algebraically (the only edges
+        # whose backoff changed are prunes|neg, all set beyond tick) —
+        # saves a second [C, N] reduce
+        backoff_bits2 = backoff_bits | (
+            prunes if neg is None else prunes | neg)
+        backoff_violation = graft_recv & backoff_bits2
+        accept = graft_recv & sub_all & ~backoff_bits2
         if sc is not None:
-            accept = accept & (score >= 0)
+            accept = accept & nonneg_bits
         reject = graft_recv & ~accept
         mesh = (mesh | accept) & ~prune_recv
-        backoff = jnp.where(prune_recv,
-                            jnp.maximum(backoff, tick + cfg.backoff_ticks),
-                            backoff)
         # PRUNE response to rejected grafts retracts the optimistic graft
-        reject_back = transfer_mask(reject, cfg)
+        reject_back = transfer_bits(reject, cfg)
         mesh = mesh & ~reject_back
+        bo_max = expand_bits(prune_recv | reject_back, C)
         backoff = jnp.where(
-            reject_back, jnp.maximum(backoff, tick + cfg.backoff_ticks),
+            bo_max, jnp.maximum(backoff, tick + cfg.backoff_ticks),
             backoff)
 
         # -- 5. score counter updates + decay ---------------------------
         scores = state.scores
         if sc is not None:
             s0 = state.scores
-            fd_stack = jnp.stack(fd_add, axis=0).astype(jnp.float32)
-            md_stack = jnp.stack(md_new, axis=0).astype(jnp.float32)
-            iv_stack = jnp.stack(inv_add, axis=0).astype(jnp.float32)
-            fd = jnp.minimum(s0.first_deliveries + fd_stack,
+            cdt = jnp.dtype(sc.counter_dtype)
+            f32 = lambda x: x.astype(jnp.float32)  # noqa: E731
+            zcn = jnp.zeros((C, n), dtype=jnp.float32)
+            fd_stack = (jnp.stack(fd_add, axis=0).astype(jnp.float32)
+                        if W else zcn)
+            iv_stack = (jnp.stack(inv_add, axis=0).astype(jnp.float32)
+                        if W else zcn)
+            in_mesh_after = expand_bits(mesh, C)
+            fd = jnp.minimum(f32(s0.first_deliveries) + fd_stack,
                              sc.first_message_deliveries_cap)
-            md = jnp.minimum(
-                s0.mesh_deliveries + md_stack * mesh_before,
-                sc.mesh_message_deliveries_cap)
-            inv = s0.invalid_deliveries + iv_stack
-            # P3b: an edge pruned while active with a delivery deficit
-            # keeps the deficit² as a sticky penalty (score.go Prune)
-            removed = mesh_before & ~mesh
-            was_active = (s0.time_in_mesh
-                          > sc.mesh_message_deliveries_activation)
-            deficit = jnp.maximum(
-                0.0, sc.mesh_message_deliveries_threshold - md)
-            mfp = s0.mesh_failure_penalty + jnp.where(
-                removed & was_active, deficit * deficit, 0.0)
+            inv = f32(s0.invalid_deliveries) + iv_stack
+            if sc.track_p3:
+                in_mesh_before = expand_bits(mesh_before, C)
+                md_stack = (jnp.stack(md_new, axis=0).astype(jnp.float32)
+                            if W else zcn)
+                md = jnp.minimum(
+                    f32(s0.mesh_deliveries) + md_stack * in_mesh_before,
+                    sc.mesh_message_deliveries_cap)
+                # P3b: an edge pruned while active with a delivery deficit
+                # keeps the deficit² as a sticky penalty (score.go Prune)
+                removed = in_mesh_before & ~in_mesh_after
+                was_active = (f32(s0.time_in_mesh)
+                              > sc.mesh_message_deliveries_activation)
+                deficit = jnp.maximum(
+                    0.0, sc.mesh_message_deliveries_threshold - md)
+                mfp = f32(s0.mesh_failure_penalty) + jnp.where(
+                    removed & was_active, deficit * deficit, 0.0)
             # P7: backoff violations + broken gossip promises
-            bp = s0.behaviour_penalty + backoff_violation.astype(
-                jnp.float32)
-            if bp_spam is not None:
-                bp = bp + bp_spam
+            bp = f32(s0.behaviour_penalty) + expand_bits(
+                backoff_violation, C).astype(jnp.float32)
+            if bp_spam_bits is not None:
+                bp = bp + expand_bits(bp_spam_bits, C).astype(jnp.float32)
 
-            # decay (refreshScores, score.go:495-556)
+            # decay (refreshScores, score.go:495-556); storage may be
+            # bf16 — the math runs f32, the write casts back
             def dk(x, decay):
                 x = x * decay
-                return jnp.where(x < sc.decay_to_zero, 0.0, x)
+                return jnp.where(x < sc.decay_to_zero, 0.0, x).astype(cdt)
 
             scores = ScoreState(
-                time_in_mesh=jnp.where(mesh, s0.time_in_mesh + 1.0, 0.0),
+                time_in_mesh=jnp.where(
+                    in_mesh_after,
+                    jnp.minimum(s0.time_in_mesh + 1, 32766),
+                    0).astype(jnp.int16),
                 first_deliveries=dk(fd, sc.first_message_deliveries_decay),
-                mesh_deliveries=dk(md, sc.mesh_message_deliveries_decay),
-                mesh_failure_penalty=dk(mfp, sc.mesh_failure_penalty_decay),
+                mesh_deliveries=(dk(md, sc.mesh_message_deliveries_decay)
+                                 if sc.track_p3 else s0.mesh_deliveries),
+                mesh_failure_penalty=(
+                    dk(mfp, sc.mesh_failure_penalty_decay)
+                    if sc.track_p3 else s0.mesh_failure_penalty),
                 invalid_deliveries=dk(
                     inv, sc.invalid_message_deliveries_decay),
                 behaviour_penalty=dk(bp, sc.behaviour_penalty_decay),
@@ -892,14 +959,14 @@ def reach_counts(params: GossipParams, state: GossipState) -> jnp.ndarray:
 
 
 def mesh_degrees(state: GossipState) -> jnp.ndarray:
-    return state.mesh.sum(axis=0, dtype=jnp.int32)
+    return popcount32(state.mesh)
 
 
 def mesh_symmetry_fraction(state: GossipState,
                            cfg: GossipSimConfig) -> jnp.ndarray:
     """Fraction of mesh edges whose partner also has the edge (after the
     GRAFT/PRUNE handshake settles this should approach 1)."""
-    partner = transfer_mask(state.mesh, cfg)
-    agree = (state.mesh & partner).sum()
-    total = state.mesh.sum()
+    partner = transfer_bits(state.mesh, cfg)
+    agree = popcount32(state.mesh & partner).sum()
+    total = popcount32(state.mesh).sum()
     return agree / jnp.maximum(total, 1)

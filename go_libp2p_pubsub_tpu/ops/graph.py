@@ -218,6 +218,67 @@ def lane_uniform(shape: tuple[int, ...], tick: jnp.ndarray, phase: int,
     return (h >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(1 / (1 << 24))
 
 
+def expand_bits(bits: jnp.ndarray, c: int) -> jnp.ndarray:
+    """uint32 [N] candidate bitmask -> bool [C, N] (bit i = row i).
+
+    The expansion is elementwise from a [N] word and fuses into consumers;
+    packed masks keep per-edge boolean state at N*4 bytes instead of
+    N*C bools and turn mask logic into single-word ops.
+    """
+    lanes = jnp.arange(c, dtype=jnp.uint32)[:, None]
+    return ((bits[None, :] >> lanes) & jnp.uint32(1)) != 0
+
+
+def pack_rows(bools: jnp.ndarray) -> jnp.ndarray:
+    """bool [C, N] -> uint32 [N] bitmask (row i -> bit i).  Inverse of
+    expand_bits; lowers to one shift + reduce that fuses with the
+    producer."""
+    c = bools.shape[0]
+    lanes = jnp.arange(c, dtype=jnp.uint32)[:, None]
+    return (bools.astype(jnp.uint32) << lanes).sum(
+        axis=0, dtype=jnp.uint32)
+
+
+def bit_row(bits: jnp.ndarray, c: int) -> jnp.ndarray:
+    """Row c of a packed candidate mask: bool [N]."""
+    return ((bits >> jnp.uint32(c)) & jnp.uint32(1)) != 0
+
+
+def popcount32(bits: jnp.ndarray) -> jnp.ndarray:
+    """Set bits per element, as int32."""
+    return jax.lax.population_count(bits).astype(jnp.int32)
+
+
+def select_k_bits(elig_bits: jnp.ndarray, k: jnp.ndarray,
+                  rand) -> jnp.ndarray:
+    """select_k_per_peer over packed masks: uniformly choose up to k[n]
+    set bits of elig_bits[n].  rand: f32 [C, N] uniform priorities, or a
+    lazy ``(c, tick, phase, salt)`` lane_uniform spec — generated inside
+    the kernel so the field fuses into the rank compare instead of being
+    materialized.  Returns a packed uint32 [N] mask."""
+    if isinstance(rand, tuple):
+        c, tick, phase, salt = rand
+        rand = lane_uniform((c, elig_bits.shape[0]), tick, phase, salt)
+    c = rand.shape[0]
+    elig = expand_bits(elig_bits, c)
+    prio = jnp.where(elig, rand, -1.0)
+    sel = elig & (ranks_desc(prio) < k[None, :])
+    return pack_rows(sel)
+
+
+def select_k_by_priority_bits(elig_bits: jnp.ndarray, priority: jnp.ndarray,
+                              k: jnp.ndarray,
+                              tiebreak: jnp.ndarray | None = None
+                              ) -> jnp.ndarray:
+    """select_k_by_priority over packed masks (descending f32 [C, N]
+    priority, ties by ascending tiebreak)."""
+    c = priority.shape[0]
+    elig = expand_bits(elig_bits, c)
+    prio = jnp.where(elig, priority, -jnp.inf)
+    sel = elig & (ranks_desc(prio, tiebreak) < k[None, :])
+    return pack_rows(sel)
+
+
 def ranks_desc(prio: jnp.ndarray,
                tiebreak: jnp.ndarray | None = None) -> jnp.ndarray:
     """Rank of each candidate row per peer under DESCENDING priority.
@@ -241,33 +302,6 @@ def ranks_desc(prio: jnp.ndarray,
         ti, tj = tiebreak[:, None, :], tiebreak[None, :, :]
         beats = beats | ((pj == pi) & (tj < ti))
     return beats.sum(axis=1, dtype=jnp.int32)
-
-
-def select_k_per_peer(eligible: jnp.ndarray, k: jnp.ndarray,
-                      rand: jnp.ndarray) -> jnp.ndarray:
-    """Uniformly select up to k[n] of each peer's eligible candidates.
-
-    eligible: bool [C, N]; k: int32 [N] (clipped to the eligible count);
-    rand: f32 [C, N] uniform priorities (lane_uniform or jax.random).
-    Returns bool [C, N].  This is the TPU form of the reference's
-    shufflePeers + take-first-k idiom (gossipsub.go:1879, used for graft
-    candidate sampling, prune retention, and gossip target selection).
-    """
-    prio = jnp.where(eligible, rand, -1.0)
-    return eligible & (ranks_desc(prio) < k[None, :])
-
-
-def select_k_by_priority(eligible: jnp.ndarray, priority: jnp.ndarray,
-                         k: jnp.ndarray,
-                         tiebreak: jnp.ndarray | None = None) -> jnp.ndarray:
-    """Select up to k[n] eligible candidates per peer by DESCENDING
-    priority ([C, N] column-major, like select_k_per_peer).
-
-    Used for score ranking with random tie-break and outbound bubble-up
-    (gossipsub.go:1376-1435).  Ineligible columns are never selected.
-    """
-    prio = jnp.where(eligible, priority, -jnp.inf)
-    return eligible & (ranks_desc(prio, tiebreak) < k[None, :])
 
 
 def propagate(words: jnp.ndarray, nbrs: jnp.ndarray,

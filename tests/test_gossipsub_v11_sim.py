@@ -61,8 +61,9 @@ def test_positive_scores_accrue_for_honest_mesh():
     cfg, sc, params, state = build(n_msgs=32, msgs_per_tick=True)
     step = make_gossip_step(cfg, sc)
     out = gossip_run(params, state, 30, step)
+    from go_libp2p_pubsub_tpu.models.gossipsub import mesh_matrix
     score = np.asarray(compute_scores(sc, params, out))
-    mesh = np.asarray(out.mesh)
+    mesh = np.asarray(mesh_matrix(out, cfg))
     assert (score[mesh] > 0).mean() > 0.9
     assert float(out.scores.time_in_mesh.max()) > 5
 
@@ -127,7 +128,8 @@ def test_invalid_spam_collapses_score_and_containment():
     reach = np.asarray(reach_counts(params, out))
     assert (reach == 0).all(), reach
     # sybils end up pruned out of honest meshes
-    mesh_with_sybil = np.asarray(out.mesh) & cand_sybil
+    from go_libp2p_pubsub_tpu.models.gossipsub import mesh_matrix
+    mesh_with_sybil = np.asarray(mesh_matrix(out, cfg)) & cand_sybil
     assert mesh_with_sybil.sum() < cand_sybil.sum() * 0.05
 
 
@@ -167,7 +169,9 @@ def test_graft_flood_penalized_and_rejected():
     cand_sybil = np.asarray(params.cand_sybil)
     honest_rows = ~np.asarray(params.sybil)
     # honest meshes contain (almost) no sybil edges at steady state
-    sybil_mesh_edges = (np.asarray(out.mesh) & cand_sybil)[:, honest_rows]
+    from go_libp2p_pubsub_tpu.models.gossipsub import mesh_matrix
+    sybil_mesh_edges = (np.asarray(mesh_matrix(out, cfg))
+                        & cand_sybil)[:, honest_rows]
     assert sybil_mesh_edges.mean() < 0.02
     bp = np.asarray(out.scores.behaviour_penalty)
     assert bp[cand_sybil].max() > 0.5
@@ -224,7 +228,8 @@ def test_mesh_delivery_deficit_penalizes_silent_mesh_edges():
     np.testing.assert_array_equal(np.asarray(reach_counts(params, out)),
                                   600 // 3)
     md = np.asarray(out.scores.mesh_deliveries)
-    assert md[np.asarray(out.mesh)].max() > 0  # mesh edges earn credit
+    from go_libp2p_pubsub_tpu.models.gossipsub import mesh_matrix
+    assert md[np.asarray(mesh_matrix(out, cfg))].max() > 0  # mesh credit
     # sticky penalties exist only where something was pruned while failing
     mfp = np.asarray(out.scores.mesh_failure_penalty)
     assert mfp.min() >= 0

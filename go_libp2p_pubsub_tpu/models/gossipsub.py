@@ -448,10 +448,6 @@ def mesh_matrix(state: GossipState, cfg: GossipSimConfig) -> jnp.ndarray:
     return expand_bits(state.mesh, cfg.n_candidates)
 
 
-def fanout_matrix(state: GossipState, cfg: GossipSimConfig) -> jnp.ndarray:
-    return expand_bits(state.fanout, cfg.n_candidates)
-
-
 # --------------------------------------------------------------------------
 # The step
 # --------------------------------------------------------------------------
@@ -820,9 +816,10 @@ def make_gossip_step(cfg: GossipSimConfig,
                                params.cand_sub_bits & ~mesh, grafts)
 
         mesh = (mesh | grafts) & ~prunes
+        dropped = prunes if neg is None else prunes | neg
         # backoff writes (one fused [C, N] pass): negative-score drops and
         # prunes overwrite to tick+B (gossipsub.go:1332-1338)
-        bo_set = expand_bits(prunes if neg is None else prunes | neg, C)
+        bo_set = expand_bits(dropped, C)
         backoff = jnp.where(bo_set, tick + cfg.backoff_ticks, backoff)
 
         # handshake: partner accepts GRAFT unless unsubscribed, backed
@@ -831,8 +828,7 @@ def make_gossip_step(cfg: GossipSimConfig,
         # Negative-score prunes notify the partner too (the reference
         # sends PRUNE for every mesh removal, gossipsub.go:1332-1338).
         graft_recv = transfer_bits(grafts, cfg)
-        prune_recv = transfer_bits(prunes if neg is None else prunes | neg,
-                                   cfg)
+        prune_recv = transfer_bits(dropped, cfg)
         if sc is not None:
             # graylisted peers' control traffic is dropped outright
             graft_recv = graft_recv & accept_bits
@@ -840,8 +836,7 @@ def make_gossip_step(cfg: GossipSimConfig,
         # post-write backoff bits, derived algebraically (the only edges
         # whose backoff changed are prunes|neg, all set beyond tick) —
         # saves a second [C, N] reduce
-        backoff_bits2 = backoff_bits | (
-            prunes if neg is None else prunes | neg)
+        backoff_bits2 = backoff_bits | dropped
         backoff_violation = graft_recv & backoff_bits2
         accept = graft_recv & sub_all & ~backoff_bits2
         if sc is not None:

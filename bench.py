@@ -1,103 +1,29 @@
 #!/usr/bin/env python
 """Benchmark: sustained GossipSub v1.1 heartbeats/sec on the flagship
-simulator — the BASELINE.md north-star config (1M peers, 100 topics,
-peer scoring + gater enabled).
+simulator — the BASELINE.md north-star config (1M peers on TPU, 100
+topics, peer scoring + gater enabled).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Baseline target (BASELINE.md): 10k simulated heartbeats/sec on a 1M-peer,
 100-topic GossipSub v1.1 mesh on TPU v5e-8.  vs_baseline = value / 10000
 (measured here on ONE chip; the 8-chip target is the reference point).
 
-Topology: 100 independent per-topic random circulants over 1M peers
-(topic t = peers ≡ t mod 100), C=16 candidate edges/peer, default
-D/Dlo/Dhi mesh params, v1.1 scoring (P1/P2/P4/P5/P6/P7 + thresholds +
-RED gater).  Measures STEADY STATE: the mesh converges during warmup,
-then timed reps continue the same run with publishes spread over every
-rep window (fresh messages keep flowing; mesh maintenance, scoring, and
-gossip repair all stay active).
-
-Timing notes for this platform: only host transfers of dependent values
-are trustworthy sync points (device completion futures resolve early), so
-every rep ends by pulling a value derived from the final state.
+Thin wrapper over bench_suite.bench_gossipsub_v11 (the shared harness
+holds the platform-specific sync idiom: only host transfers of dependent
+values are trustworthy sync points on this platform — device completion
+futures resolve early).  `python bench_suite.py` runs all five BASELINE
+configs.
 """
 
 from __future__ import annotations
 
-import json
-import time
+import os
+import sys
 
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-
-def main() -> None:
-    import jax
-
-    platform = jax.devices()[0].platform
-    on_accel = platform != "cpu"
-
-    import go_libp2p_pubsub_tpu.models.gossipsub as gs
-
-    n_peers = 1_000_000 if on_accel else 100_000
-    n_topics = 100
-    n_msgs = 32
-    n_cand = 16
-    warmup = 100
-    rep_ticks = 100
-    reps = 3
-    horizon = warmup + reps * rep_ticks
-
-    rng = np.random.default_rng(0)
-    offs = gs.make_gossip_offsets(n_topics, n_cand, n_peers, seed=0)
-    cfg = gs.GossipSimConfig(offsets=offs, n_topics=n_topics)
-    sc = gs.ScoreSimConfig()
-
-    idx = np.arange(n_peers)
-    subs = np.zeros((n_peers, n_topics), dtype=bool)
-    subs[idx, idx % n_topics] = True
-    msg_topic = rng.integers(0, n_topics, n_msgs)
-    # origin must be in the topic's residue class
-    msg_origin = (rng.integers(0, n_peers // n_topics, n_msgs) * n_topics
-                  + msg_topic)
-    # publishes spread across the whole horizon: every timed rep carries
-    # fresh traffic through the converged mesh
-    msg_tick = np.sort(rng.integers(0, horizon, n_msgs)).astype(np.int32)
-
-    params, state = gs.make_gossip_sim(cfg, subs, msg_topic, msg_origin,
-                                       msg_tick, score_cfg=sc,
-                                       track_first_tick=True)
-    params = jax.device_put(params)
-    state = jax.device_put(state)
-    step = gs.make_gossip_step(cfg, sc)
-
-    # convergence + compile (forces real execution via host transfer)
-    state = gs.gossip_run(params, state, warmup, step)
-    deg = np.asarray(gs.mesh_degrees(state))[np.asarray(params.subscribed)]
-    assert deg.mean() >= cfg.d_lo, f"mesh failed to form: mean deg {deg.mean()}"
-
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        state = gs.gossip_run(params, state, rep_ticks, step)
-        _ = int(np.asarray(state.tick))  # forced sync via dependent value
-    dt = time.perf_counter() - t0
-
-    # correctness gate: messages published early enough reached every
-    # subscriber in their topic
-    reach = np.asarray(gs.reach_counts(params, state))
-    settled = msg_tick < horizon - 30
-    full = n_peers // n_topics
-    assert (reach[settled] == full).all(), \
-        f"dissemination failed: reach {reach[settled][:8]} of {full}"
-
-    hb_per_sec = rep_ticks * reps / dt
-    result = {
-        "metric": (f"sustained_heartbeats_per_sec_gossipsub_v11_"
-                   f"{n_peers}peers_{n_topics}topics"),
-        "value": round(hb_per_sec, 2),
-        "unit": "heartbeats/s",
-        "vs_baseline": round(hb_per_sec / 10_000.0, 4),
-    }
-    print(json.dumps(result))
+import bench_suite  # noqa: E402
 
 
 if __name__ == "__main__":
-    main()
+    bench_suite.bench_gossipsub_v11()

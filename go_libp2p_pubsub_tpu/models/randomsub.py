@@ -1,0 +1,326 @@
+"""RandomSub simulator: probabilistic flood with sqrt-scaled fanout.
+
+The vectorized counterpart of the protocol core's RandomSubRouter
+(core/randomsub.py; reference /root/reference/randomsub.go): every peer
+forwards each newly-acquired message once, to a random subset of its known
+topic peers of expected size max(D, ceil(sqrt(topic size))) — the
+reference's sqrt scaling (randomsub.go:124-138) with RandomSubD = 6
+(randomsub.go:17).
+
+Differences from the reference's exact-k sample, chosen for the TPU
+formulation and statistically equivalent at BASELINE scale:
+
+- The reference draws an exact-size shuffled subset per forward event
+  (randomsub.go:128-136); the simulator sends along each candidate edge
+  independently with probability p = k / |known topic candidates| — a
+  binomial fanout with the same mean.  For k >= D = 6 the reachability
+  curves are indistinguishable (CLT); the sim's candidate pool is the C
+  circulant edges rather than the full membership list, an expander
+  approximation of "discovery gave me these topic peers"
+  (discovery.go:108-173).
+- RandomSub needs no mesh/score state, so C may exceed 32 (the sqrt
+  fanout at 10k peers needs ~100 targets): candidate subscription masks
+  stay unpacked bool [C, N], and the per-edge Bernoulli draws come from
+  the same counter-based lane hash as the GossipSub step.
+
+Words/first-tick layouts are peer-minor ([W, N] / [W, 32, N]) exactly as
+in models/floodsub.py; one tick = one hop.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..ops.graph import (
+    WORD_BITS,
+    count_bits_per_position,
+    lane_uniform,
+    make_circulant_offsets,
+    pack_bits,
+    pack_bits_pm,
+)
+from ._delivery import (
+    first_tick_to_matrix,
+    reach_by_hops_from_first_tick,
+    reach_counts_from_first_tick,
+    update_first_tick,
+)
+
+
+@dataclass(frozen=True)
+class RandomSubSimConfig:
+    """Static config.  d mirrors RandomSubD (randomsub.go:17)."""
+
+    offsets: tuple[int, ...]       # C candidate ring offsets, ± paired
+    n_topics: int = 1
+    d: int = 6                     # RandomSubD floor
+
+    def __post_init__(self):
+        offs = set(int(o) for o in self.offsets)
+        if not offs or len(offs) != len(self.offsets):
+            raise ValueError("offsets must be distinct and non-empty")
+        if not all(-o in offs for o in offs):
+            raise ValueError("offsets must be closed under negation")
+        if any(o % self.n_topics for o in offs):
+            raise ValueError("offsets must be multiples of n_topics")
+
+    @property
+    def n_candidates(self) -> int:
+        return len(self.offsets)
+
+
+def make_randomsub_offsets(n_topics: int, n_candidates: int, n_peers: int,
+                           seed: int = 0) -> tuple[int, ...]:
+    offs = make_circulant_offsets(n_topics, n_candidates, n_peers,
+                                  seed=seed)
+    return tuple(int(o) for o in offs)
+
+
+@struct.dataclass
+class RandomSubParams:
+    subscribed: jnp.ndarray      # bool [N]
+    cand_subscribed: jnp.ndarray # bool [C, N]: candidate p+o_c subscribed
+    send_prob: jnp.ndarray       # f32 [N]: k / |subscribed candidates|
+    origin_words: jnp.ndarray    # uint32 [W, N]
+    deliver_words: jnp.ndarray   # uint32 [W, N]
+    publish_tick: jnp.ndarray    # int32 [M]
+
+
+@struct.dataclass
+class RandomSubState:
+    have: jnp.ndarray        # uint32 [W, N]
+    fresh: jnp.ndarray       # uint32 [W, N]: acquired last tick (frontier)
+    first_tick: jnp.ndarray  # int16 [W, 32, N] or None
+    key: jax.Array           # PRNG key (seed carrier for the lane hash)
+    tick: jnp.ndarray        # int32 scalar
+
+
+def make_randomsub_sim(cfg: RandomSubSimConfig, subs: np.ndarray,
+                       msg_topic: np.ndarray, msg_origin: np.ndarray,
+                       msg_publish_tick: np.ndarray, seed: int = 0,
+                       track_first_tick: bool = True,
+                       dense: bool = False):
+    """Build (params, state).  Same residue-class topic model as the
+    GossipSub simulator: peer p may only subscribe to topic p mod T.
+
+    dense=True sizes send_prob for the MXU step
+    (make_randomsub_dense_step), whose sampling pool is all topic members
+    rather than the C circulant candidates."""
+    n, t = subs.shape
+    if t != cfg.n_topics:
+        raise ValueError("subs topic dim != cfg.n_topics")
+    own_topic = np.arange(n) % cfg.n_topics
+    cross = subs & ~(np.arange(t)[None, :] == own_topic[:, None])
+    if cross.any():
+        raise ValueError("peers may only subscribe to topic (p mod T)")
+    subscribed = subs[np.arange(n), own_topic]
+
+    m = len(msg_topic)
+    if ((msg_origin % cfg.n_topics) != msg_topic).any():
+        raise ValueError("msg origin must be in the topic's residue class")
+    origin_bits = np.zeros((n, m), dtype=bool)
+    origin_bits[msg_origin, np.arange(m)] = True
+    deliver_bits = subscribed[:, None] & (own_topic[:, None]
+                                          == msg_topic[None, :])
+
+    cand_sub = np.stack([np.roll(subscribed, -o) for o in cfg.offsets],
+                        axis=0)                       # [C, N]
+    # sqrt fanout: k = max(D, ceil(sqrt(topic size))) (randomsub.go:124);
+    # sampling pool = the peer's subscribed candidates
+    topic_size = np.bincount(own_topic[subscribed],
+                             minlength=cfg.n_topics)  # [T]
+    k = np.maximum(cfg.d, np.ceil(np.sqrt(topic_size)))[own_topic]
+    if dense:
+        n_pool = np.maximum(topic_size[own_topic] - 1, 1)
+    else:
+        n_pool = np.maximum(cand_sub.sum(axis=0), 1)
+    # unsubscribed peers keep a send_prob too: their frontier only ever
+    # holds their own publishes (publish-without-subscribe floods to topic
+    # peers, randomsub.go:117-138)
+    send_prob = np.minimum(1.0, k / n_pool).astype(np.float32)
+
+    params = RandomSubParams(
+        subscribed=jnp.asarray(subscribed),
+        cand_subscribed=jnp.asarray(cand_sub),
+        send_prob=jnp.asarray(send_prob),
+        origin_words=pack_bits_pm(jnp.asarray(origin_bits)),
+        deliver_words=pack_bits_pm(jnp.asarray(deliver_bits)),
+        publish_tick=jnp.asarray(msg_publish_tick, dtype=jnp.int32),
+    )
+    w = params.origin_words.shape[0]
+    state = RandomSubState(
+        have=jnp.zeros((w, n), dtype=jnp.uint32),
+        fresh=jnp.zeros((w, n), dtype=jnp.uint32),
+        first_tick=(jnp.full((w, WORD_BITS, n), -1, dtype=jnp.int16)
+                    if track_first_tick else None),
+        key=jax.random.PRNGKey(seed),
+        tick=jnp.zeros((), dtype=jnp.int32),
+    )
+    return params, state
+
+
+def make_randomsub_step(cfg: RandomSubSimConfig):
+    """(params, state) -> (state, delivered_words): one tick = inject due
+    publishes, forward the frontier to a Bernoulli(k/pool) subset of
+    subscribed candidates, record deliveries."""
+    offsets = tuple(int(o) for o in cfg.offsets)
+    C = len(offsets)
+    Z = jnp.uint32(0)
+
+    def step(params: RandomSubParams, state: RandomSubState):
+        tick = state.tick
+        n = params.subscribed.shape[0]
+        W = state.have.shape[0]
+        salt = jax.random.key_data(state.key)[-1]
+
+        due = pack_bits(params.publish_tick == tick)            # [W]
+        injected = [params.origin_words[w] & due[w] & ~state.have[w]
+                    for w in range(W)]
+        frontier = [state.fresh[w] | injected[w] for w in range(W)]
+
+        # per-edge Bernoulli sends of the frontier (fresh draw per tick)
+        u = lane_uniform((C, n), tick, 1, salt)
+        send = params.cand_subscribed & (u < params.send_prob[None, :])
+        heard = [Z] * W
+        for c, off in enumerate(offsets):
+            mask_c = send[c]
+            for w in range(W):
+                sent = jnp.where(mask_c, frontier[w], Z)
+                heard[w] = heard[w] | jnp.roll(sent, off, axis=0)
+
+        new = (jnp.stack([heard[w] & ~state.have[w] & ~injected[w]
+                          for w in range(W)], axis=0) if W
+               else jnp.zeros((0, n), dtype=jnp.uint32))
+        # only subscribers keep/forward (no relay mode in randomsub sim)
+        new = jnp.where(params.subscribed, new, Z)
+        injected_arr = (jnp.stack(injected, axis=0) if W
+                        else jnp.zeros((0, n), dtype=jnp.uint32))
+        acquired = new | injected_arr
+        have = state.have | acquired
+
+        delivered_now = acquired & params.deliver_words
+        first_tick = update_first_tick(state.first_tick, delivered_now,
+                                       tick)
+        new_state = RandomSubState(
+            have=have, fresh=acquired, first_tick=first_tick,
+            key=state.key, tick=tick + 1)
+        return new_state, delivered_now
+
+    return step
+
+
+def make_randomsub_dense_step(cfg: RandomSubSimConfig, n_msgs: int):
+    """MXU formulation for small N (<= ~32k peers): one hop = a bf16
+    matmul ``adjacency [N, N] @ frontier [N, M]``.
+
+    At 10k peers the roll formulation issues C~sqrt(N) tiny kernels per
+    tick and is launch-bound; instead the per-tick Bernoulli send
+    adjacency (adj[p, q] = 1 iff sender q picks receiver p this tick,
+    same-topic, q != p) is hash-generated on the fly and contracted on
+    the MXU — the sampling pool becomes ALL topic members, exactly the
+    reference's known-peer list (randomsub.go:124-138), not a circulant
+    approximation.  ~N²·2 bytes of adjacency traffic per tick, so keep N
+    small; the circulant step remains the path for large N.
+    """
+    T = cfg.n_topics
+    mbits = ((n_msgs + WORD_BITS - 1) // WORD_BITS) * WORD_BITS
+
+    def step(params: RandomSubParams, state: RandomSubState):
+        tick = state.tick
+        n = params.subscribed.shape[0]
+        W = state.have.shape[0]
+        salt = jax.random.key_data(state.key)[-1]
+
+        due = pack_bits(params.publish_tick == tick)            # [W]
+        injected = [params.origin_words[w] & due[w] & ~state.have[w]
+                    for w in range(W)]
+        frontier = [state.fresh[w] | injected[w] for w in range(W)]
+
+        # unpack frontier to bf16 [N, M] (tiny at dense-path scales)
+        shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
+        cols = [((frontier[w][:, None] >> shifts) & jnp.uint32(1))
+                for w in range(W)]                              # [N, 32] each
+        fmat = jnp.concatenate(cols, axis=1).astype(jnp.bfloat16)
+
+        # per-tick Bernoulli adjacency, hash-generated (no storage between
+        # ticks): adj[p, q] = q sends to p.  Self-sends need no masking —
+        # a peer's frontier is already in its own seen set, so they are
+        # no-ops downstream; cross-topic sends only need masking for
+        # T > 1 (same residue class).
+        u = lane_uniform((n, n), tick, 1, salt)
+        adj = u < params.send_prob[None, :]
+        if T > 1:
+            pq = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0) \
+                - jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+            adj = adj & ((pq % T) == 0)
+
+        cnt = jnp.dot(adj.astype(jnp.bfloat16), fmat,
+                      preferred_element_type=jnp.float32)       # [N, M]
+        heard_bits = (cnt > 0.5)
+        heard = [
+            (heard_bits[:, w * WORD_BITS:(w + 1) * WORD_BITS]
+             .astype(jnp.uint32)
+             * (jnp.uint32(1) << shifts)).sum(axis=1, dtype=jnp.uint32)
+            for w in range(W)]
+
+        Z = jnp.uint32(0)
+        new = (jnp.stack([heard[w] & ~state.have[w] & ~injected[w]
+                          for w in range(W)], axis=0) if W
+               else jnp.zeros((0, n), dtype=jnp.uint32))
+        new = jnp.where(params.subscribed, new, Z)
+        injected_arr = (jnp.stack(injected, axis=0) if W
+                        else jnp.zeros((0, n), dtype=jnp.uint32))
+        acquired = new | injected_arr
+        have = state.have | acquired
+
+        delivered_now = acquired & params.deliver_words
+        first_tick = update_first_tick(state.first_tick, delivered_now,
+                                       tick)
+        new_state = RandomSubState(
+            have=have, fresh=acquired, first_tick=first_tick,
+            key=state.key, tick=tick + 1)
+        return new_state, delivered_now
+
+    del mbits
+    return step
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def randomsub_run(params: RandomSubParams, state: RandomSubState,
+                  n_ticks: int, step) -> RandomSubState:
+    def body(s, _):
+        return step(params, s)[0], None
+    state, _ = jax.lax.scan(body, state, None, length=n_ticks)
+    return state
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4))
+def randomsub_run_curve(params: RandomSubParams, state: RandomSubState,
+                        n_ticks: int, step, n_msgs: int):
+    def body(s, _):
+        s2, delivered = step(params, s)
+        return s2, count_bits_per_position(delivered, n_msgs)
+    state, counts = jax.lax.scan(body, state, None, length=n_ticks)
+    return state, counts
+
+
+def first_tick_matrix(state: RandomSubState, m: int) -> jnp.ndarray:
+    return first_tick_to_matrix(state.first_tick, m)
+
+
+def reach_counts(params: RandomSubParams,
+                 state: RandomSubState) -> jnp.ndarray:
+    return reach_counts_from_first_tick(state.first_tick,
+                                        params.publish_tick.shape[0])
+
+
+def reach_by_hops(params: RandomSubParams, state: RandomSubState,
+                  max_hops: int) -> jnp.ndarray:
+    return reach_by_hops_from_first_tick(
+        state.first_tick, params.publish_tick.shape[0], max_hops)

@@ -1,0 +1,125 @@
+"""RandomSub simulator tests: sqrt-fanout probabilistic dissemination
+(reference randomsub.go; sim-scale counterpart of randomsub_test.go)."""
+
+import numpy as np
+
+from go_libp2p_pubsub_tpu.models.randomsub import (
+    RandomSubSimConfig,
+    make_randomsub_offsets,
+    make_randomsub_sim,
+    make_randomsub_step,
+    randomsub_run,
+    reach_by_hops,
+    reach_counts,
+)
+
+
+def build(n=2000, t=1, c=64, n_msgs=8, seed=0, publish_tick=0):
+    cfg = RandomSubSimConfig(
+        offsets=make_randomsub_offsets(t, c, n, seed=seed), n_topics=t)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    rng = np.random.default_rng(seed)
+    msg_topic = rng.integers(0, t, n_msgs)
+    msg_origin = rng.integers(0, n // t, n_msgs) * t + msg_topic
+    ticks = np.full(n_msgs, publish_tick, dtype=np.int32)
+    params, state = make_randomsub_sim(cfg, subs, msg_topic, msg_origin,
+                                       ticks, seed=seed)
+    return cfg, params, state, msg_topic
+
+
+def test_full_dissemination():
+    """sqrt-fanout flood reaches every subscriber (randomsub delivers like
+    floodsub on connected networks, randomsub_test.go:19-60)."""
+    cfg, params, state, _ = build()
+    step = make_randomsub_step(cfg)
+    out = randomsub_run(params, state, 12, step)
+    np.testing.assert_array_equal(np.asarray(reach_counts(params, out)),
+                                  2000)
+
+
+def test_sqrt_fanout_spread_speed():
+    """Fanout k=sqrt(N)~45 covers N=2000 in ~2-3 hops (log_k N); most
+    delivery mass lands by hop 3."""
+    cfg, params, state, _ = build()
+    step = make_randomsub_step(cfg)
+    out = randomsub_run(params, state, 12, step)
+    curve = np.asarray(reach_by_hops(params, out, 6))   # [M, 6] cumulative
+    assert (curve[:, 3] > 0.9 * 2000).all(), curve[:, 3]
+
+
+def test_send_prob_matches_sqrt_scaling():
+    """p = max(D, ceil(sqrt(topic size))) / pool (randomsub.go:124-138)."""
+    cfg, params, state, _ = build(n=2000, c=64)
+    k = max(cfg.d, int(np.ceil(np.sqrt(2000))))
+    pool = np.asarray(params.cand_subscribed).sum(axis=0)
+    np.testing.assert_allclose(np.asarray(params.send_prob),
+                               np.minimum(1.0, k / np.maximum(pool, 1)),
+                               rtol=1e-6)
+    # and with a tiny topic the D floor dominates
+    cfg2, params2, *_ = build(n=60, c=16, t=1)
+    pool2 = np.asarray(params2.cand_subscribed).sum(axis=0)
+    np.testing.assert_allclose(np.asarray(params2.send_prob),
+                               np.minimum(1.0, 8 / np.maximum(pool2, 1)),
+                               rtol=1e-6)  # ceil(sqrt(60))=8 > D=6
+
+
+def test_multi_topic_isolation():
+    """Messages stay inside their topic's residue class."""
+    cfg, params, state, msg_topic = build(n=3000, t=3, c=48, n_msgs=6)
+    step = make_randomsub_step(cfg)
+    out = randomsub_run(params, state, 12, step)
+    reach = np.asarray(reach_counts(params, out))
+    np.testing.assert_array_equal(reach, 3000 // 3)
+
+
+def test_dense_mxu_path_full_dissemination():
+    """The matmul (MXU) step disseminates like the roll step: full reach
+    in log_k(N) hops, same sqrt fanout, all-topic-members pool."""
+    from go_libp2p_pubsub_tpu.models.randomsub import (
+        make_randomsub_dense_step)
+    n, t, m = 1500, 3, 6
+    cfg = RandomSubSimConfig(
+        offsets=make_randomsub_offsets(t, 12, n, seed=2), n_topics=t)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    rng = np.random.default_rng(2)
+    msg_topic = rng.integers(0, t, m)
+    msg_origin = rng.integers(0, n // t, m) * t + msg_topic
+    params, state = make_randomsub_sim(
+        cfg, subs, msg_topic, msg_origin, np.zeros(m, dtype=np.int32),
+        seed=2, dense=True)
+    k = max(cfg.d, int(np.ceil(np.sqrt(n // t))))
+    np.testing.assert_allclose(np.asarray(params.send_prob),
+                               min(1.0, k / (n // t - 1)), rtol=1e-6)
+    step = make_randomsub_dense_step(cfg, m)
+    out = randomsub_run(params, state, 10, step)
+    np.testing.assert_array_equal(np.asarray(reach_counts(params, out)),
+                                  n // t)
+    curve = np.asarray(reach_by_hops(params, out, 6))
+    assert (curve[:, 3] > 0.9 * (n // t)).all()
+
+
+def test_unsubscribed_never_delivered():
+    """Unsubscribed peers neither receive nor forward (no relay mode in
+    randomsub, randomsub.go:76-100)."""
+    n, t = 1200, 1
+    cfg = RandomSubSimConfig(
+        offsets=make_randomsub_offsets(t, 64, n, seed=1), n_topics=t)
+    subs = np.ones((n, t), dtype=bool)
+    subs[::4] = False                     # 25% not subscribed
+    rng = np.random.default_rng(1)
+    origin = int(rng.integers(0, n))
+    while not subs[origin, 0]:
+        origin += 1
+    params, state = make_randomsub_sim(
+        cfg, subs, np.array([0]), np.array([origin]),
+        np.zeros(1, dtype=np.int32), seed=1)
+    step = make_randomsub_step(cfg)
+    out = randomsub_run(params, state, 15, step)
+    ft = np.asarray(
+        __import__("go_libp2p_pubsub_tpu.models.randomsub",
+                   fromlist=["first_tick_matrix"]).first_tick_matrix(out, 1)
+    )[:, 0]
+    assert (ft[~subs[:, 0]] < 0).all()    # never delivered to unsubscribed
+    assert (ft[subs[:, 0]] >= 0).all()    # all subscribers reached

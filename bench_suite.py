@@ -42,9 +42,8 @@ def emit(metric, value, unit, baseline=None):
 
 def bench_floodsub_hosts():
     from go_libp2p_pubsub_tpu.core import InProcNetwork, create_floodsub
-    sys.path.insert(0, os.path.join(os.path.dirname(
-        os.path.abspath(__file__)), "tests"))
-    from helpers import dense_connect, get_hosts, settle
+    from go_libp2p_pubsub_tpu.core.testing import (
+        dense_connect, get_hosts, settle)
 
     async def run():
         net = InProcNetwork()
@@ -106,7 +105,7 @@ def bench_randomsub_10k():
     params, state = rs.make_randomsub_sim(cfg, _subs_matrix(n, t), topic,
                                           origin, tick, dense=True)
     params = jax.device_put(params)
-    step = rs.make_randomsub_dense_step(cfg, m)  # MXU path at small N
+    step = rs.make_randomsub_dense_step(cfg)  # MXU path at small N
     state = rs.randomsub_run(params, jax.device_put(state), warmup, step)
     _ = int(np.asarray(state.tick))
     t0 = time.perf_counter()

@@ -1,0 +1,50 @@
+"""In-proc cluster harness: hosts and topology wiring.
+
+Mirrors the reference test strategy (/root/reference/floodsub_test.go:45-99):
+N real hosts in one process, wired into arbitrary topologies, exchanging real
+varint-delimited protobuf frames.  Lives in the package (not tests/) because
+the interop replay harness and benchmarks build clusters too.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+from .host import Host, InProcNetwork
+
+
+def get_hosts(net: InProcNetwork, n: int) -> list[Host]:
+    return [net.new_host() for _ in range(n)]
+
+
+async def connect(a: Host, b: Host) -> None:
+    await a.connect(b)
+
+
+async def connect_some(hosts: list[Host], d: int, rng: random.Random) -> None:
+    """Connect each host to up to d random later hosts (reference
+    connectSome, floodsub_test.go:65-81)."""
+    for i, a in enumerate(hosts):
+        rest = hosts[i + 1:]
+        for b in rng.sample(rest, min(d, len(rest))):
+            await connect(a, b)
+
+
+async def sparse_connect(hosts: list[Host], seed: int = 42) -> None:
+    await connect_some(hosts, 3, random.Random(seed))
+
+
+async def dense_connect(hosts: list[Host], seed: int = 42) -> None:
+    await connect_some(hosts, 10, random.Random(seed))
+
+
+async def connect_all(hosts: list[Host]) -> None:
+    for i, a in enumerate(hosts):
+        for b in hosts[i + 1:]:
+            await connect(a, b)
+
+
+async def settle(seconds: float = 0.05) -> None:
+    """Let in-flight tasks and queues drain."""
+    await asyncio.sleep(seconds)

@@ -1,0 +1,15 @@
+"""Interop/validation harness: protocol core <-> TPU simulator.
+
+The reference's trace schema is the validation contract (SURVEY.md §5.1):
+runs of the asyncio protocol core emit TraceEvents; this package derives
+reachability-vs-hops curves from those traces and compares them with the
+vectorized simulator's curves on the SAME topology — the cross-check
+BASELINE.md requires (curves matching within 1%).
+"""
+
+from .replay import (
+    TraceRun,
+    hops_from_trace,
+    reach_by_hops_from_trace,
+    run_core_floodsub,
+)

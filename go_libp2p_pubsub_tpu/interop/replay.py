@@ -1,0 +1,173 @@
+"""Trace replay: derive dissemination curves from protocol-core traces.
+
+The asyncio core is the semantics oracle (real varint-delimited frames
+over in-proc streams, reference-equivalent event loop); the simulator is
+the scale engine.  This module runs core clusters under an in-memory
+EventTracer, reconstructs per-(message, peer) hop counts from the
+DELIVER_MESSAGE provenance chain (received_from), and shapes them into
+the same [M, max_hops] cumulative reach curves the simulator emits
+(models/_delivery.reach_by_hops_from_first_tick) so the two can be
+diffed directly.
+
+Hop reconstruction: the origin's PUBLISH_MESSAGE event is hop 0; every
+DELIVER_MESSAGE event at peer p with provenance q gives
+hop(p) = hop(q) + 1 (the reference's tracer records the same provenance,
+trace.pb DeliverMessage.received_from — /root/reference/pb/trace.proto).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..pb import trace as tr
+from ..core import EventTracer
+from ..pb.trace import TraceType
+
+
+class ListTracer(EventTracer):
+    """Collects TraceEvents in memory."""
+
+    def __init__(self):
+        self.events: list[tr.TraceEvent] = []
+
+    def trace(self, evt: tr.TraceEvent) -> None:
+        self.events.append(evt)
+
+
+@dataclass
+class TraceRun:
+    """A finished core-cluster run plus everything needed for replay."""
+
+    events: list            # all TraceEvents from every node
+    msg_ids: list           # bytes msg id per published message, in order
+    origins: list           # peer index per message
+    peer_index: dict        # PeerID bytes -> dense index
+    n_peers: int
+
+
+def hops_from_trace(run: TraceRun) -> np.ndarray:
+    """int [N, M] hop count of first delivery (-1 = not delivered;
+    0 = origin).  Derived from DELIVER_MESSAGE provenance chains."""
+    mid_index = {m: j for j, m in enumerate(run.msg_ids)}
+    n, m = run.n_peers, len(run.msg_ids)
+    hops = np.full((n, m), -1, dtype=np.int32)
+    for j, o in enumerate(run.origins):
+        hops[o, j] = 0
+    # provenance edges: (peer, msg) delivered from q
+    pending: list[tuple[int, int, int]] = []
+    for ev in run.events:
+        if ev.type != TraceType.DELIVER_MESSAGE:
+            continue
+        d = ev.deliver_message
+        j = mid_index.get(d.message_id)
+        if j is None:
+            continue
+        p = run.peer_index[ev.peer_id]
+        q = run.peer_index.get(d.received_from)
+        if q is None:
+            continue
+        pending.append((p, j, q))
+    # chains can arrive out of order across nodes; iterate to fixpoint
+    # (bounded by the longest path)
+    changed = True
+    while changed and pending:
+        changed = False
+        rest = []
+        for p, j, q in pending:
+            if hops[p, j] >= 0:
+                continue
+            if hops[q, j] >= 0:
+                hops[p, j] = hops[q, j] + 1
+                changed = True
+            else:
+                rest.append((p, j, q))
+        pending = rest
+    return hops
+
+
+def reach_by_hops_from_trace(run: TraceRun, max_hops: int) -> np.ndarray:
+    """[M, max_hops] cumulative delivered-peer counts by hop — the same
+    shape as models reach_by_hops (origin counts at hop 0, exactly like
+    the sim's inject-tick delivery)."""
+    hops = hops_from_trace(run)
+    m = hops.shape[1]
+    out = np.zeros((m, max_hops), dtype=np.int32)
+    for h in range(max_hops):
+        out[:, h] = ((hops >= 0) & (hops <= h)).sum(axis=0)
+    return out
+
+
+async def _run_floodsub_cluster(nbrs: np.ndarray, nbr_mask: np.ndarray,
+                                publishers: list[int],
+                                settle_s: float) -> TraceRun:
+    from ..core import InProcNetwork, create_floodsub
+    from ..core.testing import connect, get_hosts
+
+    n = nbrs.shape[0]
+    net = InProcNetwork()
+    hosts = get_hosts(net, n)
+    tracers = [ListTracer() for _ in range(n)]
+    psubs = [await create_floodsub(h, event_tracer=t)
+             for h, t in zip(hosts, tracers)]
+    subs = []
+    for ps in psubs:
+        topic = await ps.join("interop")
+        subs.append(await topic.subscribe())
+    seen = set()
+    for i in range(n):
+        for k in range(nbrs.shape[1]):
+            if not nbr_mask[i, k]:
+                continue
+            j = int(nbrs[i, k])
+            if (min(i, j), max(i, j)) in seen:
+                continue
+            seen.add((min(i, j), max(i, j)))
+            await connect(hosts[i], hosts[j])
+    await asyncio.sleep(0.2)
+
+    msg_ids, origins = [], []
+    for o in publishers:
+        data = f"interop msg from {o}".encode()
+        topic = await psubs[o].join("interop")
+        await topic.publish(data)
+        origins.append(o)
+    # drain every subscription until quiescent
+    await asyncio.sleep(settle_s)
+    for sub in subs:
+        while True:
+            try:
+                await asyncio.wait_for(sub.next(), 0.05)
+            except asyncio.TimeoutError:
+                break
+
+    # recover message ids from the publishers' PUBLISH_MESSAGE events,
+    # in publish order per origin (a publisher may appear several times)
+    by_origin = {
+        o: [ev.publish_message.message_id for ev in tracers[o].events
+            if ev.type == TraceType.PUBLISH_MESSAGE]
+        for o in set(publishers)}
+    taken: dict[int, int] = {}
+    for o in publishers:
+        k = taken.get(o, 0)
+        msg_ids.append(by_origin[o][k])
+        taken[o] = k + 1
+    peer_index = {bytes(h.id): i for i, h in enumerate(hosts)}
+    events = [ev for t in tracers for ev in t.events]
+    for ps in psubs:
+        await ps.close()
+    await net.close()
+    return TraceRun(events=events, msg_ids=msg_ids, origins=origins,
+                    peer_index=peer_index, n_peers=n)
+
+
+def run_core_floodsub(nbrs: np.ndarray, nbr_mask: np.ndarray,
+                      publishers: list[int],
+                      settle_s: float = 1.0) -> TraceRun:
+    """Run a real floodsub cluster over the given padded neighbor table
+    (the sim's own topology format, ops/graph.build_random_graph) and
+    capture every node's trace."""
+    return asyncio.run(
+        _run_floodsub_cluster(nbrs, nbr_mask, publishers, settle_s))

@@ -215,7 +215,7 @@ def make_randomsub_step(cfg: RandomSubSimConfig):
     return step
 
 
-def make_randomsub_dense_step(cfg: RandomSubSimConfig, n_msgs: int):
+def make_randomsub_dense_step(cfg: RandomSubSimConfig):
     """MXU formulation for small N (<= ~32k peers): one hop = a bf16
     matmul ``adjacency [N, N] @ frontier [N, M]``.
 
@@ -229,7 +229,6 @@ def make_randomsub_dense_step(cfg: RandomSubSimConfig, n_msgs: int):
     small; the circulant step remains the path for large N.
     """
     T = cfg.n_topics
-    mbits = ((n_msgs + WORD_BITS - 1) // WORD_BITS) * WORD_BITS
 
     def step(params: RandomSubParams, state: RandomSubState):
         tick = state.tick
@@ -283,11 +282,10 @@ def make_randomsub_dense_step(cfg: RandomSubSimConfig, n_msgs: int):
         first_tick = update_first_tick(state.first_tick, delivered_now,
                                        tick)
         new_state = RandomSubState(
-            have=have, fresh=acquired, first_tick=first_tick,
+            have=have, fresh=new, first_tick=first_tick,
             key=state.key, tick=tick + 1)
         return new_state, delivered_now
 
-    del mbits
     return step
 
 

@@ -1,0 +1,86 @@
+"""Checkpoint/resume of simulation state.
+
+The reference keeps all state in memory and rebuilds it from the network
+on restart (SURVEY.md §5.4 — hello packets resend subscriptions, the
+mesh re-forms via heartbeat); it cannot checkpoint.  The simulator's
+state is a pytree, so snapshots are exact: save mid-run, restore, and
+continue bit-identically — mesh, backoffs, score counters, message
+possession, delivery records, everything.
+
+Format: a single .npz per checkpoint.  Leaves are flattened with their
+tree paths as keys; non-native dtypes (bfloat16) are stored as bit-views
+with the dtype recorded, so no pickling is involved.  Restore requires a
+template state (same treedef), which every caller has — the same
+make_*_sim that built the original.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+
+import jax
+import numpy as np
+
+
+def _key(path) -> str:
+    return "/".join(str(getattr(p, "name", getattr(p, "idx", p)))
+                    for p in path)
+
+
+def save_state(path: str, state) -> None:
+    """Write a pytree snapshot to ``path`` (.npz, atomic rename)."""
+    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    payload: dict[str, np.ndarray] = {}
+    for p, leaf in leaves:
+        arr = np.asarray(leaf)
+        k = _key(p)
+        if arr.dtype.kind not in "biufc?":
+            # non-native dtype (e.g. bfloat16, kind 'V'): store the bit
+            # pattern
+            payload["bits:" + arr.dtype.name + ":" + k] = arr.view(
+                np.dtype(f"u{arr.dtype.itemsize}"))
+        else:
+            payload["raw::" + k] = arr
+    buf = io.BytesIO()
+    np.savez(buf, **payload)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(buf.getvalue())
+    os.replace(tmp, path)
+
+
+def load_state(path: str, template):
+    """Read a snapshot into the structure of ``template`` (the state
+    returned by the same make_*_sim call that produced the original)."""
+    import ml_dtypes  # baked in with jax
+
+    with np.load(path) as z:
+        by_key = {}
+        for full in z.files:
+            tag, dtname, k = full.split(":", 2)
+            arr = z[full]
+            if tag == "bits":
+                arr = arr.view(np.dtype(getattr(ml_dtypes, dtname)))
+            by_key[k] = arr
+
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    out = []
+    for p, leaf in leaves:
+        k = _key(p)
+        if k not in by_key:
+            raise ValueError(f"checkpoint missing leaf {k!r}")
+        arr = by_key[k]
+        want = np.asarray(leaf)
+        if arr.shape != want.shape or arr.dtype != want.dtype:
+            raise ValueError(
+                f"leaf {k!r}: checkpoint {arr.dtype}{arr.shape} vs "
+                f"template {want.dtype}{want.shape}")
+        out.append(jax.numpy.asarray(arr))
+    extra = set(by_key) - {_key(p) for p, _ in leaves}
+    if extra:
+        raise ValueError(
+            f"checkpoint has leaves the template lacks: {sorted(extra)[:4]}"
+            " — wrong sim configuration?")
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(template), out)

@@ -1,49 +1,13 @@
-"""Shared test harness: in-proc hosts and topology wiring.
+"""Shared test harness: re-exports the package's in-proc cluster tools
+(go_libp2p_pubsub_tpu.core.testing), which mirror the reference test
+strategy (/root/reference/floodsub_test.go:45-99)."""
 
-Mirrors the reference test strategy (/root/reference/floodsub_test.go:45-99):
-N real hosts in one process, wired into arbitrary topologies, exchanging real
-varint-delimited protobuf frames.
-"""
-
-from __future__ import annotations
-
-import asyncio
-import random
-
-from go_libp2p_pubsub_tpu.core import Host, InProcNetwork
-
-
-def get_hosts(net: InProcNetwork, n: int) -> list[Host]:
-    return [net.new_host() for _ in range(n)]
-
-
-async def connect(a: Host, b: Host) -> None:
-    await a.connect(b)
-
-
-async def connect_some(hosts: list[Host], d: int, rng: random.Random) -> None:
-    """Connect each host to up to d random later hosts (reference
-    connectSome, floodsub_test.go:65-81)."""
-    for i, a in enumerate(hosts):
-        rest = hosts[i + 1:]
-        for b in rng.sample(rest, min(d, len(rest))):
-            await connect(a, b)
-
-
-async def sparse_connect(hosts: list[Host], seed: int = 42) -> None:
-    await connect_some(hosts, 3, random.Random(seed))
-
-
-async def dense_connect(hosts: list[Host], seed: int = 42) -> None:
-    await connect_some(hosts, 10, random.Random(seed))
-
-
-async def connect_all(hosts: list[Host]) -> None:
-    for i, a in enumerate(hosts):
-        for b in hosts[i + 1:]:
-            await connect(a, b)
-
-
-async def settle(seconds: float = 0.05) -> None:
-    """Let in-flight tasks and queues drain."""
-    await asyncio.sleep(seconds)
+from go_libp2p_pubsub_tpu.core.testing import (  # noqa: F401
+    connect,
+    connect_all,
+    connect_some,
+    dense_connect,
+    get_hosts,
+    settle,
+    sparse_connect,
+)

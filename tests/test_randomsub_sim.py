@@ -92,7 +92,7 @@ def test_dense_mxu_path_full_dissemination():
     k = max(cfg.d, int(np.ceil(np.sqrt(n // t))))
     np.testing.assert_allclose(np.asarray(params.send_prob),
                                min(1.0, k / (n // t - 1)), rtol=1e-6)
-    step = make_randomsub_dense_step(cfg, m)
+    step = make_randomsub_dense_step(cfg)
     out = randomsub_run(params, state, 10, step)
     np.testing.assert_array_equal(np.asarray(reach_counts(params, out)),
                                   n // t)

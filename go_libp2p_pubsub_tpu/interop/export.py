@@ -1,0 +1,90 @@
+"""Simulator -> TraceEvent export.
+
+SURVEY.md §5.1's TPU mapping: the simulator must emit the same event
+stream as the reference tracer so runs can be replayed/compared with the
+reference's own `traced`/`tracestat` tooling.  The sim's delivery record
+is the first_tick array; this module turns it (plus the publish table)
+into PUBLISH_MESSAGE / DELIVER_MESSAGE TraceEvents and writes them in the
+exact format of the core's sinks: ndjson (NewJSONTracer) or
+varint-delimited protobuf (NewPBTracer, reference tracer.go:85,137).
+
+Synthetic identities: sim peer i gets peer id ``b"sim-%d" % i``; message
+m gets id ``b"msg-%d" % m``; tick t maps to timestamp t * 1e9 ns (one
+heartbeat = one second, the reference default interval).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..core.tracer_sinks import proto_to_jsonable
+from ..pb import trace as tr
+from ..pb.proto import write_delimited
+from ..pb.trace import TraceType
+
+NS_PER_TICK = 1_000_000_000  # 1 Hz heartbeat (gossipsub.go:44)
+
+
+def peer_id(i: int) -> bytes:
+    return b"sim-%d" % i
+
+
+def msg_id(m: int) -> bytes:
+    return b"msg-%d" % m
+
+
+def events_from_sim(first_tick_matrix: np.ndarray,
+                    msg_topic: np.ndarray,
+                    msg_origin: np.ndarray,
+                    msg_publish_tick: np.ndarray,
+                    topic_name=lambda t: f"topic-{t}"):
+    """Yield TraceEvents (publish + every first delivery) in tick order.
+
+    first_tick_matrix: int [N, M] (models *.first_tick_matrix output;
+    -1 = not delivered).  Origins' own inject-tick deliveries are emitted
+    as their PUBLISH_MESSAGE events.
+    """
+    n, m = first_tick_matrix.shape
+    items = []                              # (tick, kind, payload)
+    for j in range(m):
+        items.append((int(msg_publish_tick[j]), 0, j, int(msg_origin[j])))
+    peers, msgs = np.nonzero(first_tick_matrix >= 0)
+    ticks = first_tick_matrix[peers, msgs]
+    for p, j, t in zip(peers, msgs, ticks):
+        if int(p) == int(msg_origin[j]):
+            continue                    # origin's copy is the publish
+        items.append((int(t), 1, int(j), int(p)))
+    items.sort()                        # chronological stream, pubs first
+    out = []
+    for t, kind, j, p in items:
+        if kind == 0:
+            out.append(tr.TraceEvent(
+                type=TraceType.PUBLISH_MESSAGE,
+                peer_id=peer_id(p), timestamp=t * NS_PER_TICK,
+                publish_message=tr.PublishMessageEv(
+                    message_id=msg_id(j),
+                    topic=topic_name(int(msg_topic[j])))))
+        else:
+            out.append(tr.TraceEvent(
+                type=TraceType.DELIVER_MESSAGE,
+                peer_id=peer_id(p), timestamp=t * NS_PER_TICK,
+                deliver_message=tr.DeliverMessageEv(
+                    message_id=msg_id(j),
+                    topic=topic_name(int(msg_topic[j])))))
+    return out
+
+
+def write_pb_trace(path: str, events) -> None:
+    """Varint-delimited pb file — the PBTracer/reference format."""
+    with open(path, "wb") as f:
+        for evt in events:
+            f.write(write_delimited(evt))
+
+
+def write_json_trace(path: str, events) -> None:
+    """ndjson file — the JSONTracer/reference format."""
+    with open(path, "w") as f:
+        for evt in events:
+            f.write(json.dumps(proto_to_jsonable(evt)) + "\n")

@@ -275,6 +275,11 @@ class GossipParams:
     cand_colo_excess: jnp.ndarray | None = None  # f32 [C, N]: P6 surplus
     cand_sybil: jnp.ndarray | None = None     # bool [C, N]: candidate is sybil
     sybil: jnp.ndarray | None = None          # bool [N]
+    # mixed-protocol support (None = homogeneous gossipsub network):
+    # floodsub-protocol peers are always flooded and never mesh/gossip
+    # (feature negotiation, gossipsub_feat.go:11-52, gossipsub.go:969-974)
+    flood_proto: jnp.ndarray | None = None       # bool [N]
+    cand_flood_bits: jnp.ndarray | None = None   # uint32 [N]
 
 
 @struct.dataclass
@@ -315,7 +320,8 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
                     app_score: np.ndarray | None = None,
                     peer_ip: np.ndarray | None = None,
                     sybil: np.ndarray | None = None,
-                    msg_invalid: np.ndarray | None = None):
+                    msg_invalid: np.ndarray | None = None,
+                    flood_proto: np.ndarray | None = None):
     """Build (params, state).  subs: bool [N, T] — but each peer may only
     subscribe to its residue-class topic (circulant classes are closed, so
     cross-class subscriptions would never receive anything).
@@ -328,6 +334,11 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
     - sybil [N] bool: peers running the configured attack behaviors
     - msg_invalid [M] bool: messages that fail validation (P4 + no
       forwarding, validation.go:274-351)
+
+    flood_proto [N] bool marks peers speaking /floodsub/1.0.0 in a mixed
+    network: they flood everything they hold to all subscribed candidates
+    and are flooded by gossipsub peers, but never join meshes or exchange
+    gossip (gossipsub_feat.go:11-52, gossipsub.go:969-974).
     """
     n, t = subs.shape
     if t != cfg.n_topics:
@@ -380,6 +391,11 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
             cand_sybil=jnp.asarray(cand_view(syb)),
             sybil=jnp.asarray(syb),
         )
+
+    if flood_proto is not None:
+        fp = np.asarray(flood_proto, dtype=bool)
+        kw.update(flood_proto=jnp.asarray(fp),
+                  cand_flood_bits=jnp.asarray(cand_bits(fp)))
 
     params = GossipParams(
         subscribed=jnp.asarray(subscribed),
@@ -457,34 +473,64 @@ def compute_scores(sc: ScoreSimConfig, params: GossipParams,
                    st: GossipState) -> jnp.ndarray:
     """The peer-score formula, densified: f32 [C, N] — peer p's opinion of
     candidate p+o_c (score.go:256-333).  One topic per peer, so the
-    per-topic sum collapses to the single topic's contribution."""
+    per-topic sum collapses to the single topic's contribution.  Defined
+    as the sum of score_snapshot's components (single source of truth;
+    XLA fuses the sum identically)."""
+    return score_snapshot(sc, params, st)["score"]
+
+
+def score_snapshot(sc: ScoreSimConfig, params: GossipParams,
+                   st: GossipState) -> dict:
+    """Per-component score breakdown for every edge — the simulator's
+    WithPeerScoreInspect (score.go:147-175, PeerScoreSnapshot: inspect
+    per-peer totals plus per-topic P1..P4 and top-level P5..P7).
+
+    Returns a dict of f32 [C, N] arrays: weighted contributions
+    p1..p7 (p3/p3b zero when P3 tracking is off) and their sum 'score'
+    (== compute_scores).  Row c, column p = peer p's view of candidate
+    p+o_c.
+    """
     s = st.scores
     c = s.time_in_mesh.shape[0]
-    f32 = lambda x: x.astype(jnp.float32)  # noqa: E731 (counters may be bf16)
+    f32 = lambda x: x.astype(jnp.float32)  # noqa: E731
     tim = f32(s.time_in_mesh)
     invd = f32(s.invalid_deliveries)
-    p1 = jnp.minimum(tim / sc.time_in_mesh_quantum, sc.time_in_mesh_cap)
-    p2 = f32(s.first_deliveries)               # capped at increment time
-    topic = (sc.time_in_mesh_weight * p1
-             + sc.first_message_deliveries_weight * p2
-             + sc.invalid_message_deliveries_weight * invd * invd)
+    w = sc.topic_weight
+    out = {
+        "p1_time_in_mesh": w * sc.time_in_mesh_weight * jnp.minimum(
+            tim / sc.time_in_mesh_quantum, sc.time_in_mesh_cap),
+        "p2_first_deliveries": (w * sc.first_message_deliveries_weight
+                                * f32(s.first_deliveries)),
+        "p4_invalid_deliveries": (w * sc.invalid_message_deliveries_weight
+                                  * invd * invd),
+        "p5_app_specific": (sc.app_specific_weight
+                            * params.cand_app_score),
+        "p6_ip_colocation": (sc.ip_colocation_factor_weight
+                             * params.cand_colo_excess
+                             * params.cand_colo_excess),
+    }
     if sc.track_p3:
         in_mesh = expand_bits(st.mesh, c)
         deficit = jnp.maximum(
             0.0, sc.mesh_message_deliveries_threshold
             - f32(s.mesh_deliveries))
         active = tim > sc.mesh_message_deliveries_activation
-        p3 = jnp.where(in_mesh & active, deficit * deficit, 0.0)
-        topic = (topic + sc.mesh_message_deliveries_weight * p3
-                 + sc.mesh_failure_penalty_weight
-                 * f32(s.mesh_failure_penalty))
+        out["p3_mesh_delivery_deficit"] = (
+            w * sc.mesh_message_deliveries_weight
+            * jnp.where(in_mesh & active, deficit * deficit, 0.0))
+        out["p3b_mesh_failure_penalty"] = (
+            w * sc.mesh_failure_penalty_weight
+            * f32(s.mesh_failure_penalty))
+    else:
+        zero = jnp.zeros_like(tim)
+        out["p3_mesh_delivery_deficit"] = zero
+        out["p3b_mesh_failure_penalty"] = zero
     bp_excess = jnp.maximum(
         0.0, f32(s.behaviour_penalty) - sc.behaviour_penalty_threshold)
-    return (sc.topic_weight * topic
-            + sc.app_specific_weight * params.cand_app_score
-            + sc.ip_colocation_factor_weight
-            * params.cand_colo_excess * params.cand_colo_excess
-            + sc.behaviour_penalty_weight * bp_excess * bp_excess)
+    out["p7_behaviour_penalty"] = (sc.behaviour_penalty_weight
+                                   * bp_excess * bp_excess)
+    out["score"] = sum(out.values())
+    return out
 
 
 def make_gossip_step(cfg: GossipSimConfig,
@@ -605,6 +651,17 @@ def make_gossip_step(cfg: GossipSimConfig,
             fresh = [jnp.where(params.sybil, f, f & valid_w[w])
                      for w, f in enumerate(fresh)]
         out_bits = state.mesh | fanout                          # [N]
+        if params.flood_proto is not None:
+            # mixed network: gossipsub peers always forward to floodsub-
+            # protocol candidates, and floodsub-protocol peers flood to
+            # every subscribed candidate (gossipsub.go:969-974)
+            out_bits = out_bits | (params.cand_flood_bits
+                                   & params.cand_sub_bits)
+            # (no sub gate: an unsubscribed flood-proto peer still
+            # floods its own publishes; it never holds relayed messages
+            # because new_mesh_bits is gated by sub)
+            out_bits = jnp.where(params.flood_proto,
+                                 params.cand_sub_bits, out_bits)
         if sc is not None and sc.flood_publish:
             # own publishes additionally flood to every candidate above
             # the publish threshold (gossipsub.go:953-959)
@@ -669,6 +726,10 @@ def make_gossip_step(cfg: GossipSimConfig,
             adv.append(aw)
         elig = (params.cand_sub_bits & ~state.mesh & ~state.fanout
                 & sub_all)          # only subscribed peers gossip
+        if params.flood_proto is not None:
+            # no IHAVE to floodsub-protocol peers (they don't speak
+            # control); they send none either
+            elig = elig & ~params.cand_flood_bits
         if sc is not None:
             elig = elig & gossip_bits
         n_elig = popcount32(elig)
@@ -677,6 +738,8 @@ def make_gossip_step(cfg: GossipSimConfig,
             (cfg.gossip_factor * n_elig.astype(jnp.float32)).astype(
                 jnp.int32))
         targets = select_k_bits(elig, n_gossip, u_spec(1))
+        if params.flood_proto is not None:
+            targets = jnp.where(params.flood_proto, Z, targets)
         if sc is not None and sc.sybil_ihave_spam:
             # IHAVE-spamming sybils advertise ids they never deliver
             # (gossipsub_spam_test.go:135): their gossip carries nothing,
@@ -746,6 +809,11 @@ def make_gossip_step(cfg: GossipSimConfig,
         backoff_bits = pack_rows(backoff > tick)
         can_graft = (params.cand_sub_bits & ~mesh & ~backoff_bits
                      & sub_all)
+        if params.flood_proto is not None:
+            # floodsub-protocol peers have no mesh: never graft at them,
+            # and they graft at nobody
+            can_graft = can_graft & ~params.cand_flood_bits
+            can_graft = jnp.where(params.flood_proto, Z, can_graft)
         if sc is not None:
             can_graft = can_graft & nonneg_bits
         need = jnp.where(deg < cfg.d_lo, cfg.d - deg, 0)
@@ -839,6 +907,8 @@ def make_gossip_step(cfg: GossipSimConfig,
         backoff_bits2 = backoff_bits | dropped
         backoff_violation = graft_recv & backoff_bits2
         accept = graft_recv & sub_all & ~backoff_bits2
+        if params.flood_proto is not None:
+            accept = jnp.where(params.flood_proto, Z, accept)
         if sc is not None:
             accept = accept & nonneg_bits
         reject = graft_recv & ~accept

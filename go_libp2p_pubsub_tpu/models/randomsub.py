@@ -207,8 +207,10 @@ def make_randomsub_step(cfg: RandomSubSimConfig):
         delivered_now = acquired & params.deliver_words
         first_tick = update_first_tick(state.first_tick, delivered_now,
                                        tick)
+        # the frontier carries only RECEIVED news (see the dense step):
+        # a publish is forwarded exactly once, at its inject tick
         new_state = RandomSubState(
-            have=have, fresh=acquired, first_tick=first_tick,
+            have=have, fresh=new, first_tick=first_tick,
             key=state.key, tick=tick + 1)
         return new_state, delivered_now
 

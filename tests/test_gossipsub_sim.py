@@ -196,3 +196,40 @@ def test_config_validation():
     with pytest.raises(ValueError):
         GossipSimConfig(offsets=tuple(range(-6, 0)) + tuple(range(1, 7)),
                         n_topics=1, d_hi=12)          # C <= Dhi
+
+
+def test_mixed_protocol_floodsub_peers():
+    """Mixed network (feature negotiation, gossipsub_feat.go:11-52):
+    30% of peers speak /floodsub/1.0.0 — they receive everything, never
+    appear in any mesh, and full dissemination still holds
+    (mirrors the mixed-protocol test, gossipsub_test.go:810)."""
+    import numpy as np
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        make_gossip_sim as _mgs, make_gossip_offsets as _mgo,
+        GossipSimConfig as _Cfg)
+    n, t, m = 600, 3, 8
+    cfg = _Cfg(offsets=_mgo(t, 16, n, seed=9), n_topics=t)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    rng = np.random.default_rng(9)
+    flood_proto = rng.random(n) < 0.3
+    topic = rng.integers(0, t, m)
+    origin = rng.integers(0, n // t, m) * t + topic
+    params, state = _mgs(cfg, subs, topic, origin,
+                         np.zeros(m, dtype=np.int32),
+                         flood_proto=flood_proto)
+    step = make_gossip_step(cfg)
+    out = gossip_run(params, state, 40, step)
+    # full dissemination including the floodsub peers
+    np.testing.assert_array_equal(np.asarray(reach_counts(params, out)),
+                                  n // t)
+    deg = np.asarray(mesh_degrees(out))
+    assert (deg[flood_proto] == 0).all()       # no mesh at flood peers
+    # gossipsub peers' meshes exclude flood-proto candidates
+    from go_libp2p_pubsub_tpu.models.gossipsub import mesh_matrix
+    cand_flood = np.stack([np.roll(flood_proto, -o) for o in cfg.offsets])
+    mesh = np.asarray(mesh_matrix(out, cfg))
+    assert (mesh & cand_flood).sum() == 0
+    # gossipsub-only subnetwork still has healthy degrees
+    gs_rows = ~flood_proto
+    assert (deg[gs_rows] >= 1).all()

@@ -246,3 +246,19 @@ def test_score_config_validation():
         ScoreSimConfig(graylist_threshold=-1.0,
                        publish_threshold=-2.0).validate()
     ScoreSimConfig().validate()
+
+
+def test_score_snapshot_matches_total_and_components():
+    """score_snapshot (the sim's WithPeerScoreInspect, score.go:147-175)
+    decomposes into components that sum to compute_scores exactly."""
+    from go_libp2p_pubsub_tpu.models.gossipsub import score_snapshot
+    cfg, sc, params, state = build(n_msgs=16, msgs_per_tick=True)
+    step = make_gossip_step(cfg, sc)
+    out = gossip_run(params, state, 25, step)
+    snap = {k: np.asarray(v) for k, v in
+            score_snapshot(sc, params, out).items()}
+    total = np.asarray(compute_scores(sc, params, out))
+    np.testing.assert_allclose(snap["score"], total, rtol=1e-5, atol=1e-5)
+    assert (snap["p1_time_in_mesh"] >= 0).all()
+    assert snap["p2_first_deliveries"].max() > 0   # deliveries earned credit
+    assert (snap["p4_invalid_deliveries"] <= 0).all()

@@ -1,0 +1,85 @@
+"""Sim -> TraceEvent export: the reference-format trace files round-trip
+and reproduce the sim's reachability curves (SURVEY.md §5.1 contract)."""
+
+import json
+
+import numpy as np
+
+from go_libp2p_pubsub_tpu.interop.export import (
+    events_from_sim,
+    msg_id,
+    write_json_trace,
+    write_pb_trace,
+)
+from go_libp2p_pubsub_tpu.models.gossipsub import (
+    GossipSimConfig,
+    first_tick_matrix,
+    gossip_run,
+    make_gossip_offsets,
+    make_gossip_sim,
+    make_gossip_step,
+    reach_counts,
+)
+from go_libp2p_pubsub_tpu.pb import trace as tr
+from go_libp2p_pubsub_tpu.pb.proto import read_delimited
+from go_libp2p_pubsub_tpu.pb.trace import TraceType
+
+
+def run_sim():
+    n, t, m = 600, 3, 8
+    cfg = GossipSimConfig(offsets=make_gossip_offsets(t, 16, n, seed=6),
+                          n_topics=t)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    rng = np.random.default_rng(6)
+    topic = rng.integers(0, t, m)
+    origin = rng.integers(0, n // t, m) * t + topic
+    ticks = rng.integers(0, 10, m).astype(np.int32)
+    params, state = make_gossip_sim(cfg, subs, topic, origin, ticks)
+    out = gossip_run(params, state, 30, make_gossip_step(cfg))
+    ft = np.asarray(first_tick_matrix(out, m))
+    reach = np.asarray(reach_counts(params, out))
+    return ft, topic, origin, ticks, reach
+
+
+def test_pb_trace_roundtrip(tmp_path):
+    ft, topic, origin, ticks, reach = run_sim()
+    events = events_from_sim(ft, topic, origin, ticks)
+    path = str(tmp_path / "trace.pb")
+    write_pb_trace(path, events)
+
+    buf = open(path, "rb").read()
+    pos, parsed = 0, []
+    while pos < len(buf):
+        evt, pos = read_delimited(tr.TraceEvent, buf, pos)
+        parsed.append(evt)
+    assert len(parsed) == len(events)
+    pubs = [e for e in parsed if e.type == TraceType.PUBLISH_MESSAGE]
+    assert len(pubs) == len(topic)
+    # reach per message from the trace == the sim's own counts
+    # (origin's publish counts as its delivery)
+    for j in range(len(topic)):
+        n_deliver = sum(1 for e in parsed
+                        if e.type == TraceType.DELIVER_MESSAGE
+                        and e.deliver_message.message_id == msg_id(j))
+        assert n_deliver + 1 == reach[j]
+    # timestamps are tick-ordered
+    deliver_ts = [e.timestamp for e in parsed
+                  if e.type == TraceType.DELIVER_MESSAGE]
+    assert deliver_ts == sorted(deliver_ts)
+
+
+def test_json_trace_has_reference_shape(tmp_path):
+    ft, topic, origin, ticks, _ = run_sim()
+    events = events_from_sim(ft, topic, origin, ticks)
+    path = str(tmp_path / "trace.json")
+    write_json_trace(path, events)
+    lines = [json.loads(line) for line in open(path)]
+    assert len(lines) == len(events)
+    kinds = {ln["type"] for ln in lines}
+    assert kinds == {int(TraceType.PUBLISH_MESSAGE),
+                     int(TraceType.DELIVER_MESSAGE)}
+    deliver = next(ln for ln in lines
+                   if ln["type"] == int(TraceType.DELIVER_MESSAGE))
+    assert "deliver_message" in deliver
+    assert {"message_id", "topic"} <= set(deliver["deliver_message"])

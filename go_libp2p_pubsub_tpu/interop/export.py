@@ -53,8 +53,9 @@ def events_from_sim(first_tick_matrix: np.ndarray,
     peers, msgs = np.nonzero(first_tick_matrix >= 0)
     ticks = first_tick_matrix[peers, msgs]
     for p, j, t in zip(peers, msgs, ticks):
-        if int(p) == int(msg_origin[j]):
-            continue                    # origin's copy is the publish
+        # the origin's own copy gets BOTH events, like the reference
+        # (publishMessage traces DeliverMessage for local publishes,
+        # pubsub.go:1056-1060)
         items.append((int(t), 1, int(j), int(p)))
     items.sort()                        # chronological stream, pubs first
     out = []

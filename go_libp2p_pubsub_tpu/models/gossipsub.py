@@ -634,6 +634,11 @@ def make_gossip_step(cfg: GossipSimConfig,
         f_deg = popcount32(fanout)
         f_need = jnp.where(alive, cfg.d - f_deg, 0)
         f_elig = params.cand_sub_bits & ~fanout
+        if params.flood_proto is not None:
+            # flood-proto peers are flooded unconditionally (out_bits OR
+            # below); spending fanout slots on them would cut the
+            # effective gossipsub fanout degree below D
+            f_elig = f_elig & ~params.cand_flood_bits
         if sc is not None:  # fanout requires score >= publish threshold
             f_elig = f_elig & pub_ok_bits
         fanout = fanout | jax.lax.cond(

@@ -197,6 +197,16 @@ def _fmix32(x: jnp.ndarray) -> jnp.ndarray:
     return x
 
 
+def lane_seed(tick: jnp.ndarray, phase: int,
+              salt: jnp.ndarray) -> jnp.ndarray:
+    """The mixed per-(tick, phase, salt) scalar seed feeding lane_uniform
+    (shared with the pallas select kernel so both paths draw the same
+    stream)."""
+    return _fmix32(tick.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+                   ^ (salt.astype(jnp.uint32)
+                      + jnp.uint32(phase) * jnp.uint32(0x85EBCA6B)))
+
+
 def lane_uniform(shape: tuple[int, ...], tick: jnp.ndarray, phase: int,
                  salt: jnp.ndarray) -> jnp.ndarray:
     """Stateless per-lane uniforms in [0, 1): f32 ``shape`` array hashed
@@ -209,9 +219,7 @@ def lane_uniform(shape: tuple[int, ...], tick: jnp.ndarray, phase: int,
     fuses) and statistically ample for sampling decisions.  ``phase``
     decorrelates draws within a tick; ``salt`` carries the run seed.
     """
-    seed = _fmix32(tick.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
-                   ^ (salt.astype(jnp.uint32)
-                      + jnp.uint32(phase) * jnp.uint32(0x85EBCA6B)))
+    seed = lane_seed(tick, phase, salt)
     total = int(np.prod(shape))
     lane = jax.lax.iota(jnp.uint32, total).reshape(shape)
     h = _fmix32(lane ^ seed)

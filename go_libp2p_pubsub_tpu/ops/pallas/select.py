@@ -1,0 +1,113 @@
+"""Pallas TPU kernel for packed-mask top-k selection.
+
+select_k_bits (ops/graph.py) is one of the hot ops of the GossipSub
+heartbeat: XLA lowers it to an expand -> [C, C, N] compare-count ->
+pack chain.  This kernel keeps the whole chain in VMEM: each grid block
+loads the packed eligibility word and k, generates the SAME splitmix32
+lane-hash priorities as ops.graph.lane_uniform (so results are
+bit-identical to the XLA path), rank-compares in registers, and writes
+only the packed selection word — [N] u32 in, [N] u32 out.
+
+Outcome (see the function docstring): XLA's own fusion already keeps the
+intermediates off HBM, so the kernel does NOT beat the XLA form and is
+kept as a validated mosaic formulation + constraints record, not wired
+into the step.  It is also single-device-only (no GSPMD partitioning
+rule), while the XLA form shards transparently.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_BLOCK = 4096
+
+
+def _fmix32(x):
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> jnp.uint32(15))
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> jnp.uint32(16))
+    return x
+
+
+def _select_kernel(seed_ref, elig_ref, k_ref, out_ref, *, c: int, n: int):
+    block = out_ref.shape[-1]
+    bits = elig_ref[...].reshape(1, block)          # [1, B] uint32
+    k = k_ref[...].reshape(1, block)                # [1, B] int32
+    p0 = pl.program_id(0) * block
+    # identical stream to lane_uniform((C, N), ...): lane = c * N + p
+    peer = (jax.lax.broadcasted_iota(jnp.uint32, (c, block), 1)
+            + jnp.uint32(p0))
+    lane = (jax.lax.broadcasted_iota(jnp.uint32, (c, block), 0)
+            * jnp.uint32(n) + peer)
+    h = _fmix32(lane ^ seed_ref[0])
+    # mosaic lacks a direct u32->f32 cast; h>>8 < 2^24 so the i32 detour
+    # is exact and matches the XLA path bit-for-bit
+    u = ((h >> jnp.uint32(8)).astype(jnp.int32).astype(jnp.float32)
+         * jnp.float32(1 / (1 << 24)))
+
+    cidx = jax.lax.broadcasted_iota(jnp.uint32, (c, block), 0)
+    elig = ((bits >> cidx) & jnp.uint32(1)) != 0    # [C, B]
+    prio = jnp.where(elig, u, -1.0)
+    pi, pj = prio[:, None, :], prio[None, :, :]
+    beats = pj > pi                                 # [C, C, B]
+    # candidate-index tie-break, as in ranks_desc (24-bit priorities DO
+    # collide at 1M-peer scale)
+    ci = jax.lax.broadcasted_iota(jnp.int32, (c, c, block), 0)
+    cj = jax.lax.broadcasted_iota(jnp.int32, (c, c, block), 1)
+    beats = beats | ((pj == pi) & (cj < ci))
+    ranks = beats.sum(axis=1, dtype=jnp.int32)      # [C, B]
+    sel = elig & (ranks < k)
+    # mosaic can't reduce unsigned ints: sum in int32, bit-cast at the end
+    packed = (sel.astype(jnp.int32)
+              << cidx.astype(jnp.int32)).sum(axis=0, dtype=jnp.int32)
+    out_ref[...] = packed.astype(jnp.uint32)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+def select_k_bits_pallas(elig_bits: jnp.ndarray, k: jnp.ndarray,
+                         seed: jnp.ndarray, c: int,
+                         block: int = _BLOCK,
+                         interpret: bool = False) -> jnp.ndarray:
+    """Packed top-k selection, pallas formulation.
+
+    elig_bits: uint32 [N]; k: int32 [N]; seed: uint32 scalar — the
+    already-mixed per-(tick, phase, salt) seed (graph.lane_seed).
+    Bit-identical to select_k_bits(elig, k, lane_uniform((c, N), ...)).
+
+    Measured on v5e (1M peers, C=16): 0.24 ms vs 0.17 ms for the XLA
+    expand/rank/pack chain — XLA's fusion already keeps this op's
+    intermediates out of HBM, so the kernel is kept as a validated
+    mosaic formulation (and the record of its constraints: no u32->f32
+    casts, no unsigned reductions), not wired into the step.
+    ``interpret=True`` runs it anywhere (CI on CPU).
+    """
+    n = elig_bits.shape[0]
+    pad = (-n) % block
+    out_shape = jax.ShapeDtypeStruct((n + pad,), jnp.uint32)
+    if pad:
+        # the lane stream uses the true n, so padded peers never perturb
+        # real peers' draws
+        elig_bits = jnp.concatenate(
+            [elig_bits, jnp.zeros((pad,), jnp.uint32)])
+        k = jnp.concatenate([k, jnp.zeros((pad,), jnp.int32)])
+    grid = ((n + pad) // block,)
+    out = pl.pallas_call(
+        functools.partial(_select_kernel, c=c, n=n),
+        out_shape=out_shape,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+        ],
+        out_specs=pl.BlockSpec((block,), lambda i: (i,)),
+        interpret=interpret,
+    )(seed.reshape(1), elig_bits, k)
+    return out[:n] if pad else out

@@ -1,0 +1,26 @@
+"""Pallas select kernel: bit-identical to the XLA rank/pack chain
+(interpret mode on CPU; the real mosaic lowering is exercised on TPU)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from go_libp2p_pubsub_tpu.ops.graph import (
+    lane_seed,
+    lane_uniform,
+    select_k_bits,
+)
+from go_libp2p_pubsub_tpu.ops.pallas.select import select_k_bits_pallas
+
+
+def test_pallas_select_matches_xla():
+    n, c = 5000, 16     # non-multiple of the block: exercises padding
+    rng = np.random.default_rng(3)
+    elig = jnp.asarray(
+        rng.integers(0, 2 ** c, n, dtype=np.int64).astype(np.uint32))
+    k = jnp.asarray(rng.integers(0, 8, n).astype(np.int32))
+    tick = jnp.int32(11)
+    salt = jnp.uint32(99)
+    ref = select_k_bits(elig, k, lane_uniform((c, n), tick, 2, salt))
+    out = select_k_bits_pallas(elig, k, lane_seed(tick, 2, salt), c,
+                               4096, True)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))

@@ -1,0 +1,71 @@
+"""Multi-host / multi-slice execution (ICI + DCN).
+
+The reference scales across machines with one libp2p connection per peer
+pair (SURVEY.md §5.8); this framework scales by sharding the simulated
+peer axis across every chip JAX can see — XLA emits the collectives.
+Within a pod slice the shard-boundary exchanges of the circulant rolls
+ride ICI; across slices they ride DCN.  Because the peer axis is a ring,
+arranging shards slice-major means each slice exchanges only its two
+boundary shards' halo over DCN per tick (a few MB at 1M peers) — the DCN
+analog of the reference keeping most traffic inside one datacenter.
+
+Usage on a multi-host deployment:
+
+    from go_libp2p_pubsub_tpu.parallel import multihost
+    multihost.init()                   # jax.distributed.initialize()
+    mesh = multihost.make_global_mesh()
+    params = shard_peer_tree(params, mesh, n_peers)
+    state = shard_peer_tree(state, mesh, n_peers)
+    # the same jitted step as single-host; XLA partitions it globally
+
+Every process must build the same mesh and run the same program (SPMD);
+`jax.distributed.initialize` picks up coordinator/process envs on TPU
+pods automatically.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+from .mesh import PEER_AXIS
+
+
+def init(coordinator_address: str | None = None,
+         num_processes: int | None = None,
+         process_id: int | None = None) -> None:
+    """Initialize multi-host JAX.  On TPU pods all arguments are
+    auto-detected from the environment; pass them explicitly for manual
+    (e.g. CPU/GPU) clusters.  No-op if already initialized."""
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except RuntimeError:
+        pass  # already initialized
+
+
+def make_global_mesh() -> Mesh:
+    """One 'peers' axis over every device of every process, ordered so
+    ring-neighboring shards are physically adjacent: within a slice the
+    order follows the ICI interconnect (mesh_utils), and slices are laid
+    end-to-end so only slice-boundary halos cross DCN."""
+    n = len(jax.devices())
+    try:
+        devices = mesh_utils.create_device_mesh((n,))
+    except (ValueError, AssertionError, NotImplementedError):
+        devices = np.array(jax.devices())
+    return Mesh(devices.reshape(-1), (PEER_AXIS,))
+
+
+def process_local_peer_slice(n_peers: int) -> slice:
+    """The contiguous block of simulated peers whose shards live on this
+    process (for host-side IO: loading publish tables, writing trace
+    shards).  Assumes the uniform peer-axis sharding of shard_peer_tree."""
+    i, k = jax.process_index(), jax.process_count()
+    per = n_peers // k
+    start = i * per
+    stop = n_peers if i == k - 1 else start + per
+    return slice(start, stop)

@@ -195,6 +195,23 @@ class GossipSubRouter(PubSubRouter):
     def accept_px_threshold(self) -> float:
         return self.thresholds.accept_px_threshold
 
+    def update_topic_score_params(self, topic: str, tp) -> Optional[Exception]:
+        """Live re-parameterization of one topic's score params, called
+        from Topic.set_score_params via the event loop (reference
+        topic.go:36-74 → score.go:192-232).  Returns the error instead of
+        raising so the eval thunk can carry it back to the caller."""
+        from .score import PeerScore
+
+        if not isinstance(self.score, PeerScore):
+            return ValueError(
+                "cannot set score parameters: peer scoring is not enabled")
+        try:
+            tp.validate()
+        except Exception as e:  # invalid params never reach the engine
+            return e
+        self.score.set_topic_score_params(topic, tp)
+        return None
+
     # -- router contract ---------------------------------------------------
 
     def protocols(self) -> list[str]:

@@ -40,6 +40,19 @@ Timing model: one tick = one heartbeat = one network hop.  Reachability is
 measured in hops (publish-tick-relative), which is exactly the
 reachability-vs-hops contract from BASELINE.md and independent of the
 wall-clock heartbeat/RTT ratio.
+
+Known deviation — same-tick P2/P4 delivery credit: the reference credits
+FirstMessageDeliveries to exactly one peer (score.go
+markFirstMessageDelivery) and routes duplicates to mesh-delivery credit
+only; this sim credits EVERY same-tick deliverer of a new message (one
+tick = the near-first window, score.go:684-818).  With mesh in-degree D
+this can inflate P2 by up to ~D per message relative to a serial
+first-claim, but it is unbiased w.r.t. candidate-bit order and columns
+stay independent (vectorizable).  The steady-state effect is a uniform
+scale on P2 across honest peers (they share the same in-degree
+distribution), so relative ranking — what the thresholds act on — is
+preserved; test_same_tick_credit_uniform_scale quantifies it against a
+serial-claim replay on a small graph.
 """
 
 from __future__ import annotations
@@ -421,9 +434,16 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
         recent=jnp.zeros((cfg.history_gossip, w, n), dtype=jnp.uint32),
         first_tick=(jnp.full((w, WORD_BITS, n), -1, dtype=jnp.int16)
                     if track_first_tick else None),
+        # behaviour_penalty stays f32 regardless of counter_dtype: it
+        # grows by +1.0 per violation, and bf16 absorbs increments past
+        # 256 (the same stick-at-256 hazard that moved time_in_mesh to
+        # int16) — sustained-spam magnitudes would diverge from the
+        # reference.  It is one counter of six, so the HBM cost is small.
         scores=(ScoreState(time_in_mesh=zt(), first_deliveries=zc(),
                            mesh_deliveries=zc(), mesh_failure_penalty=zc(),
-                           invalid_deliveries=zc(), behaviour_penalty=zc())
+                           invalid_deliveries=zc(),
+                           behaviour_penalty=jnp.zeros(
+                               (c, n), dtype=jnp.float32))
                 if score_cfg is not None else None),
         key=jax.random.PRNGKey(seed),
         tick=jnp.zeros((), dtype=jnp.int32),
@@ -965,9 +985,9 @@ def make_gossip_step(cfg: GossipSimConfig,
 
             # decay (refreshScores, score.go:495-556); storage may be
             # bf16 — the math runs f32, the write casts back
-            def dk(x, decay):
+            def dk(x, decay, dtype=cdt):
                 x = x * decay
-                return jnp.where(x < sc.decay_to_zero, 0.0, x).astype(cdt)
+                return jnp.where(x < sc.decay_to_zero, 0.0, x).astype(dtype)
 
             scores = ScoreState(
                 time_in_mesh=jnp.where(
@@ -982,7 +1002,8 @@ def make_gossip_step(cfg: GossipSimConfig,
                     if sc.track_p3 else s0.mesh_failure_penalty),
                 invalid_deliveries=dk(
                     inv, sc.invalid_message_deliveries_decay),
-                behaviour_penalty=dk(bp, sc.behaviour_penalty_decay),
+                behaviour_penalty=dk(bp, sc.behaviour_penalty_decay,
+                                     dtype=jnp.float32),
             )
 
         new_state = GossipState(

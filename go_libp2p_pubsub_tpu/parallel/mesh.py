@@ -45,7 +45,11 @@ def shard_peer_tree(tree, mesh: Mesh, n_peers: int):
     repl = replicated(mesh)
 
     def place(x):
-        arr = jax.numpy.asarray(x)
+        # device_put handles host (numpy) data directly; going through
+        # jnp.asarray first would commit it to the *default* backend,
+        # which may not be the mesh's platform (e.g. a CPU dryrun mesh
+        # on a TPU-default machine).
+        arr = x if isinstance(x, jax.Array) else np.asarray(x)
         for axis in reversed(range(arr.ndim)):
             if arr.shape[axis] == n_peers:
                 return jax.device_put(

@@ -60,12 +60,44 @@ def make_global_mesh() -> Mesh:
     return Mesh(devices.reshape(-1), (PEER_AXIS,))
 
 
-def process_local_peer_slice(n_peers: int) -> slice:
+def process_local_peer_slice(n_peers: int, mesh: Mesh | None = None) -> slice:
     """The contiguous block of simulated peers whose shards live on this
     process (for host-side IO: loading publish tables, writing trace
-    shards).  Assumes the uniform peer-axis sharding of shard_peer_tree."""
-    i, k = jax.process_index(), jax.process_count()
-    per = n_peers // k
-    start = i * per
-    stop = n_peers if i == k - 1 else start + per
-    return slice(start, stop)
+    shards).  Assumes the uniform peer-axis sharding of shard_peer_tree.
+
+    The peer axis shards **per device**, so the process slice is the
+    union of this process's per-device shards — NOT n/process_count
+    peers: e.g. 1008 peers on 2 processes x 8 devices places 63 peers
+    per device, so process 0 owns [0, 504).  The peer count must divide
+    by the device count: jax.device_put (shard_peer_tree) rejects uneven
+    NamedShardings on this stack, so we surface the same contract here."""
+    if mesh is not None:
+        devices = list(mesh.devices.reshape(-1))
+    else:
+        if jax.process_count() > 1:
+            # jax.devices() enumerates process-major, but
+            # make_global_mesh may topology-order devices differently —
+            # guessing here would silently misattribute peers
+            raise ValueError(
+                "multi-process runs must pass the actual mesh so the "
+                "slice follows its device order")
+        devices = jax.devices()
+    if n_peers % len(devices) != 0:
+        raise ValueError(
+            f"n_peers={n_peers} must divide evenly over {len(devices)} "
+            "devices (uneven peer-axis shardings are rejected by "
+            "device_put; pad the peer count)")
+    per = n_peers // len(devices)
+    pid = jax.process_index()
+    mine = [k for k, d in enumerate(devices) if d.process_index == pid]
+    if not mine:
+        return slice(0, 0)  # this process holds no shard of the mesh
+    starts = [min(k * per, n_peers) for k in mine]
+    stops = [min((k + 1) * per, n_peers) for k in mine]
+    lo, hi = min(starts), max(stops)
+    if hi - lo != sum(b - a for a, b in zip(starts, stops)):
+        raise ValueError(
+            "this process's devices are not contiguous along the mesh "
+            "peer axis; pass the actual mesh and keep make_global_mesh's "
+            "slice-major device order")
+    return slice(lo, hi)

@@ -72,10 +72,21 @@ def load_state(path: str, template):
             raise ValueError(f"checkpoint missing leaf {k!r}")
         arr = by_key[k]
         want = np.asarray(leaf)
-        if arr.shape != want.shape or arr.dtype != want.dtype:
+        if arr.shape != want.shape:
             raise ValueError(
                 f"leaf {k!r}: checkpoint {arr.dtype}{arr.shape} vs "
                 f"template {want.dtype}{want.shape}")
+        if arr.dtype != want.dtype:
+            # allow exact-value widening (e.g. old snapshots stored
+            # behaviour_penalty in bf16 before it moved to f32) — any
+            # lossy conversion still errors
+            widened = arr.astype(want.dtype)
+            if not np.array_equal(widened.astype(arr.dtype), arr,
+                                  equal_nan=arr.dtype.kind in "fc"):
+                raise ValueError(
+                    f"leaf {k!r}: checkpoint dtype {arr.dtype} does not "
+                    f"widen losslessly to template {want.dtype}")
+            arr = widened
         out.append(jax.numpy.asarray(arr))
     extra = set(by_key) - {_key(p) for p, _ in leaves}
     if extra:

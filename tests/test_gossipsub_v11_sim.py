@@ -262,3 +262,39 @@ def test_score_snapshot_matches_total_and_components():
     assert (snap["p1_time_in_mesh"] >= 0).all()
     assert snap["p2_first_deliveries"].max() > 0   # deliveries earned credit
     assert (snap["p4_invalid_deliveries"] <= 0).all()
+
+
+def test_same_tick_credit_uniform_scale():
+    """Quantify the sim's all-same-tick-deliverers P2 credit (vs the
+    reference's serial first-claim, score.go markFirstMessageDelivery):
+    per-peer credit-per-new-message multiplicity is >= 1, bounded by the
+    mesh degree bound, and roughly uniform across honest peers — so P2 is
+    a uniform scale-up and score *ranking* is preserved (see the module
+    docstring's Known deviation note)."""
+    cfg, sc, params, state = build(
+        n=900, n_msgs=32, msgs_per_tick=True,
+        score_kw=dict(first_message_deliveries_decay=0.9999,
+                      first_message_deliveries_cap=10000.0))
+    step = make_gossip_step(cfg, sc)
+    out = gossip_run(params, state, 40, step)
+
+    def popcount(words):  # [W, N] uint32 -> [N] int
+        bits = ((words[:, None, :] >> np.arange(32, dtype=np.uint32)
+                 [None, :, None]) & 1)
+        return bits.sum(axis=(0, 1))
+
+    have = popcount(np.asarray(out.have))
+    own = popcount(np.asarray(params.origin_words))
+    received = have - own                     # messages delivered by edges
+    credit = np.asarray(out.scores.first_deliveries, dtype=np.float64)
+    credit_per_peer = credit.sum(axis=0)      # receiver-side issued credit
+
+    mask = received > 4                       # peers with enough samples
+    assert mask.sum() > 500
+    mult = credit_per_peer[mask] / received[mask]
+    # serial first-claim would give exactly 1.0; all-deliverer credit is
+    # bounded by the number of same-tick copies <= mesh in-degree <= d_hi
+    assert (mult >= 0.99).all()
+    assert (mult <= cfg.d_hi + 0.01).all()
+    # uniform-scale claim: concentration across honest peers
+    assert mult.std() / mult.mean() < 0.35, (mult.mean(), mult.std())

@@ -40,3 +40,58 @@ def test_sharded_run_on_global_mesh():
 def test_process_local_slice_partitions():
     s = process_local_peer_slice(1000)
     assert s == slice(0, 1000)   # single process owns everything
+
+
+def test_process_local_slice_matches_actual_shards():
+    """The helper's slice must cover exactly the union of this process's
+    device shards of a really-sharded array (per-device split, 1000/8 =
+    125 each)."""
+    import jax
+    import jax.numpy as jnp
+
+    from go_libp2p_pubsub_tpu.parallel.mesh import (
+        make_mesh, peer_sharding)
+
+    n = 1000
+    mesh = make_mesh(8)
+    arr = jax.device_put(jnp.arange(n), peer_sharding(mesh, 1))
+    spans = sorted((s.index[0].start or 0,
+                    (s.index[0].start or 0) + s.data.shape[0])
+                   for s in arr.addressable_shards)
+    assert spans == [(k * 125, (k + 1) * 125) for k in range(8)]
+
+    s = process_local_peer_slice(n, mesh)
+    assert (s.start, s.stop) == (spans[0][0], spans[-1][1]) == (0, n)
+
+
+def test_process_local_slice_multidevice_processes():
+    """Multi-device processes own n/n_devices-sized shards per device,
+    NOT n/process_count peers: 1008 peers on 2 procs x 8 devs -> 63
+    peers/device, so process 0 owns [0, 504)."""
+    from types import SimpleNamespace
+    from unittest import mock
+
+    import jax
+    import numpy as np
+    import pytest
+
+    fake = SimpleNamespace(devices=np.array(
+        [SimpleNamespace(process_index=k // 8) for k in range(16)]))
+    with mock.patch.object(jax, "process_index", return_value=0):
+        s0 = process_local_peer_slice(1008, fake)
+    with mock.patch.object(jax, "process_index", return_value=1):
+        s1 = process_local_peer_slice(1008, fake)
+    assert s0 == slice(0, 504)       # 8 devices x 63 peers
+    assert s1 == slice(504, 1008)
+
+    # uneven peer counts are refused up front (device_put would reject
+    # the sharding anyway) ...
+    with mock.patch.object(jax, "process_index", return_value=0), \
+         pytest.raises(ValueError, match="divide evenly"):
+        process_local_peer_slice(1000, fake)
+    # ... and so is non-contiguous device ownership
+    interleaved = SimpleNamespace(devices=np.array(
+        [SimpleNamespace(process_index=k % 2) for k in range(16)]))
+    with mock.patch.object(jax, "process_index", return_value=0), \
+         pytest.raises(ValueError, match="contiguous"):
+        process_local_peer_slice(1008, interleaved)

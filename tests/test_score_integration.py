@@ -224,3 +224,61 @@ async def test_gater_integration_throttles_spammer():
     results = {gate.accept_from(mock.host.id) for _ in range(50)}
     assert AcceptStatus.CONTROL in results
     await close_all(psubs, net)
+
+
+async def test_topic_set_score_params_recaps_live_counters():
+    """Topic.set_score_params re-parameterizes a live topic through the
+    router and re-caps existing counters (reference topic.go:36-74 →
+    score.go:192-232)."""
+    import pytest
+
+    net = InProcNetwork()
+    hosts = get_hosts(net, 2)
+    psubs = await make_scored(hosts)
+    t0 = await psubs[0].join(TOPIC)
+    s0 = await t0.subscribe()
+    t1 = await psubs[1].join(TOPIC)
+    await connect(hosts[0], hosts[1])
+    await settle(0.3)
+
+    for i in range(30):
+        await t1.publish(b"msg-%d" % i)
+    for _ in range(30):
+        await asyncio.wait_for(s0.next(), timeout=5)
+    await settle(0.1)
+
+    p1 = hosts[1].id
+    engine = psubs[0].router.score
+    assert engine.score(p1) > 10  # P2 counter built up
+
+    recapped = TopicScoreParams(
+        topic_weight=1.0,
+        time_in_mesh_weight=0.0000001, time_in_mesh_quantum=1.0,
+        time_in_mesh_cap=100.0,
+        first_message_deliveries_weight=1.0,
+        first_message_deliveries_decay=0.999,
+        first_message_deliveries_cap=5.0,
+        invalid_message_deliveries_weight=-1.0,
+        invalid_message_deliveries_decay=0.9999)
+    await t0.set_score_params(recapped)
+    assert engine.score(p1) <= 5.5  # counter re-capped to the new cap
+
+    # invalid params are rejected before reaching the engine
+    with pytest.raises(ValueError):
+        await t0.set_score_params(TopicScoreParams(topic_weight=-1.0))
+    assert engine.score(p1) <= 5.5
+    await close_all(psubs, net)
+
+
+async def test_topic_set_score_params_requires_scoring():
+    """Without peer scoring enabled the API errors rather than silently
+    no-opping (reference topic.go:41-44)."""
+    import pytest
+
+    net = InProcNetwork()
+    hosts = get_hosts(net, 1)
+    ps = await create_gossipsub(hosts[0], gossipsub_params=fast_params())
+    t = await ps.join(TOPIC)
+    with pytest.raises(ValueError):
+        await t.set_score_params(TopicScoreParams(topic_weight=1.0))
+    await close_all([ps], net)

@@ -15,6 +15,7 @@ from typing import Optional
 from ..pb import rpc as pb
 from ..pb.proto import write_delimited
 from .host import Stream, StreamResetError
+from .log import logger
 from .types import PeerID
 
 
@@ -114,6 +115,8 @@ async def handle_new_stream(ps, stream: Stream) -> None:
         while True:
             size = await stream.read_uvarint()
             if size > ps.max_message_size:
+                logger.warning("peer %s sent oversized rpc (%d bytes); "
+                               "resetting stream", pid, size)
                 stream.reset()
                 ps._post(lambda: ps._handle_peer_dead(pid))
                 return
@@ -122,6 +125,8 @@ async def handle_new_stream(ps, stream: Stream) -> None:
                 rpc = pb.RPC.decode(frame)
             except ValueError:
                 # garbage frame: kill the stream like a read error
+                logger.warning("peer %s sent undecodable rpc frame; "
+                               "resetting stream", pid)
                 stream.reset()
                 ps._post(lambda: ps._handle_peer_dead(pid))
                 return

@@ -19,6 +19,7 @@ import random
 import time
 from typing import Callable, Iterable, Optional
 
+from .log import logger
 from .types import PeerID
 
 DISCOVERY_POLL_INITIAL_DELAY = 0.0
@@ -129,8 +130,8 @@ class BackoffConnector:
                 try:
                     await asyncio.wait_for(self.host.connect(pid),
                                            self.dial_timeout)
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("discovery dial to %s failed: %s", pid, e)
 
         if dials:
             await asyncio.gather(*(dial(p) for p in dials))
@@ -184,7 +185,8 @@ class DiscoveryPipeline:
                 ttl = await self.service.advertise(DISCOVERY_NS_PREFIX + topic)
                 if not ttl or ttl <= 0:
                     ttl = DISCOVERY_ADVERTISE_RETRY_INTERVAL
-            except Exception:
+            except Exception as e:
+                logger.debug("advertise %r failed: %s; retrying", topic, e)
                 ttl = DISCOVERY_ADVERTISE_RETRY_INTERVAL
             await asyncio.sleep(ttl)
 
@@ -218,8 +220,8 @@ class DiscoveryPipeline:
                 self.service.find_peers(DISCOVERY_NS_PREFIX + topic),
                 timeout=10.0)
             await self.connector.connect(peers)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("find_peers for %r failed: %s", topic, e)
         finally:
             self.ongoing.discard(topic)
 

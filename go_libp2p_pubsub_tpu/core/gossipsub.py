@@ -25,6 +25,7 @@ from ..pb import rpc as pb
 from .comm import copy_rpc, rpc_with_control, rpc_with_messages
 from .crypto import verify_signed_record
 from .host import Host
+from .log import logger
 from .mcache import MessageCache
 from .pubsub import PubSub, PubSubRouter
 from .score_params import PeerScoreThresholds
@@ -452,8 +453,8 @@ class GossipSubRouter(PubSubRouter):
             try:
                 await asyncio.wait_for(self.ps.host.connect(pid),
                                        self.params.connection_timeout)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("px connect to %s failed: %s", pid, e)
 
     async def _direct_connect_initial(self) -> None:
         await asyncio.sleep(self.params.direct_connect_initial_delay)
@@ -589,7 +590,8 @@ class GossipSubRouter(PubSubRouter):
             return
         try:
             rpcs = fragment_rpc(out, self.ps.max_message_size)
-        except ValueError:
+        except ValueError as e:
+            logger.warning("dropping rpc to %s: %s", p, e)
             self._do_drop_rpc(out, p)
             return
         for rpc in rpcs:

@@ -1,0 +1,19 @@
+"""Package logger (reference: ipfs/go-log package logger, pubsub.go:37).
+
+The reference logs at Debug/Info/Warn throughout the core; this package
+routes the same sites through one stdlib logger so large core/sim runs
+are debuggable and the process loop never swallows exceptions silently.
+Applications configure it the stdlib way::
+
+    logging.getLogger("go_libp2p_pubsub_tpu").setLevel(logging.DEBUG)
+
+By default (no handler configured) records propagate to the root logger,
+matching go-log's default-on stderr behavior only when the app opts in —
+a library must not configure global logging itself.
+"""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger("go_libp2p_pubsub_tpu")

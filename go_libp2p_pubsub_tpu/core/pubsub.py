@@ -19,6 +19,7 @@ from ..pb import rpc as pb
 from .blacklist import Blacklist, MapBlacklist
 from .comm import PeerConn, handle_new_peer, handle_new_stream, rpc_with_subs
 from .host import Host, Notifiee, Stream
+from .log import logger
 from .sign import MessageSignaturePolicy
 from .timecache import FirstSeenCache
 from .trace import EventTracer, RawTracer, Tracer
@@ -227,8 +228,9 @@ class PubSub:
             try:
                 fn()
             except Exception:
-                import traceback
-                traceback.print_exc()
+                # a thunk must never kill the loop (reference processLoop
+                # has no equivalent hazard; here user callbacks run inline)
+                logger.exception("error in process loop thunk")
 
     def _post_incoming_rpc(self, pid: PeerID, rpc: pb.RPC) -> None:
         self._post(lambda: self._handle_incoming_rpc(pid, rpc))
@@ -239,7 +241,9 @@ class PubSub:
         if pid in self.peers:
             return
         if self.blacklist.contains(pid):
+            logger.debug("ignoring connection from blacklisted peer %s", pid)
             return
+        logger.debug("new peer %s", pid)
         conn = PeerConn(self, pid)
         conn.try_send(self._hello_packet())
         conn.task = self._spawn(handle_new_peer(self, conn))
@@ -248,6 +252,7 @@ class PubSub:
     def _handle_peer_error(self, pid: PeerID, err: Exception) -> None:
         # protocol negotiation failure: forget the peer (reference
         # newPeerError path)
+        logger.debug("peer %s protocol negotiation failed: %s", pid, err)
         conn = self.peers.pop(pid, None)
         if conn:
             conn.close()
@@ -279,11 +284,14 @@ class PubSub:
         conn.close()
         if self.host.connectedness(pid):
             # duplicate conn closed while still connected: respawn writer
+            logger.debug("peer %s declared dead but still connected: "
+                         "respawning writer", pid)
             newconn = PeerConn(self, pid)
             newconn.try_send(self._hello_packet())
             newconn.task = self._spawn(handle_new_peer(self, newconn))
             self.peers[pid] = newconn
             return
+        logger.debug("peer %s left", pid)
         del self.peers[pid]
         self.inbound_streams.pop(pid, None)
         for topic, tmap in self.topics.items():
@@ -322,6 +330,9 @@ class PubSub:
                     self.tracer.send_rpc(out, pid)
                 else:
                     self.tracer.drop_rpc(out, pid)
+                    logger.debug(
+                        "announce to %s dropped (queue full); retrying",
+                        pid)
                     self._spawn(self._announce_retry(pid, topic, sub))
 
         self._post(retry)

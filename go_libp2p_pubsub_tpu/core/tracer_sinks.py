@@ -24,6 +24,7 @@ import json
 import zlib
 from typing import Callable, Optional
 
+from .log import logger
 from ..pb import trace as tr
 from ..pb.proto import Message as ProtoMessage, write_delimited, decode_uvarint
 from .trace import EventTracer
@@ -171,8 +172,10 @@ class RemoteTracer(_BufferedTracer):
             data = self._gzip.compress(payload)
             data += self._gzip.flush(zlib.Z_SYNC_FLUSH)
             self._stream.write(data)
-        except Exception:
+        except Exception as e:
             # reconnect on next batch
+            logger.debug("remote tracer write failed: %s; will reconnect",
+                         e)
             if self._stream is not None:
                 self._stream.reset()
             self._stream = None

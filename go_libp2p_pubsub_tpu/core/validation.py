@@ -14,6 +14,7 @@ import inspect
 from typing import Callable, Optional
 
 from .sign import SignatureError, verify_message_signature
+from .log import logger
 from .types import (
     DEFAULT_VALIDATE_QUEUE_SIZE,
     DEFAULT_VALIDATE_THROTTLE,
@@ -126,6 +127,8 @@ class Validation:
             try:
                 self.queue.put_nowait((vals, src, msg))
             except asyncio.QueueFull:
+                logger.debug("validation queue full; dropping message "
+                             "from %s", src)
                 self.ps.tracer.reject_message(msg, REJECT_VALIDATION_QUEUE_FULL)
             return False
         return True
@@ -140,8 +143,7 @@ class Validation:
             except ValidationError:
                 pass
             except Exception:  # user validator bug must not kill the worker
-                import traceback
-                traceback.print_exc()
+                logger.exception("validation worker error")
 
     async def _validate(self, vals: list[TopicValidator], src: Optional[PeerID],
                         msg: Message, synchronous: bool) -> None:
@@ -177,6 +179,8 @@ class Validation:
 
         if async_vals:
             if self.throttle.locked():
+                logger.debug("validation throttled; dropping message "
+                             "from %s", src)
                 self.ps.tracer.reject_message(msg, REJECT_VALIDATION_THROTTLED)
                 return
             await self.throttle.acquire()

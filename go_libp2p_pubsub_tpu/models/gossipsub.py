@@ -70,6 +70,7 @@ from ..ops.graph import (
     bit_row,
     count_bits_per_position,
     expand_bits,
+    lane_seed,
     lane_uniform,
     make_circulant_offsets,
     pack_bits,
@@ -456,16 +457,24 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
 # --------------------------------------------------------------------------
 
 
-def transfer_bits(bits: jnp.ndarray, cfg: GossipSimConfig) -> jnp.ndarray:
+def transfer_bits(bits: jnp.ndarray, cfg: GossipSimConfig,
+                  pair: bool = False) -> jnp.ndarray:
     """Packed-mask edge transfer: what each peer's partners sent it.
 
     bits: uint32 [N], bit c describing edge (p, p+o_c).  Bit c rolled by
     o_c lands in the partner's bit cinv[c]: out = OR_c roll(bit_c) <<
     cinv[c].  C 1D rolls + shifts, no stacking.
+
+    With ``pair=True`` (requires C <= 16) the word carries TWO C-bit
+    masks — low 16 and high 16 bits — and both transfer in the same C
+    rolls: the rolls dominate the cost, so two masks for the price of
+    one (used for GRAFT+PRUNE handshakes and the packed payload/gossip
+    score gates).
     """
+    sel = jnp.uint32(0x1_0001) if pair else jnp.uint32(1)
     out = jnp.zeros_like(bits)
     for c, off in enumerate(cfg.offsets):
-        b = (bits >> jnp.uint32(c)) & jnp.uint32(1)
+        b = (bits >> jnp.uint32(c)) & sel
         out = out | (jnp.roll(b, off, axis=0) << jnp.uint32(cfg.cinv[c]))
     return out
 
@@ -554,7 +563,8 @@ def score_snapshot(sc: ScoreSimConfig, params: GossipParams,
 
 
 def make_gossip_step(cfg: GossipSimConfig,
-                     score_cfg: ScoreSimConfig | None = None):
+                     score_cfg: ScoreSimConfig | None = None,
+                     use_pallas_select: bool | None = None):
     """Build the jittable (params, state) -> (state, delivered_words) core.
 
     Per tick:
@@ -586,6 +596,25 @@ def make_gossip_step(cfg: GossipSimConfig,
     ALL = jnp.uint32((1 << C) - 1)
     Z = jnp.uint32(0)
     pc = jax.lax.population_count
+
+    # random-k selection backend.  The mosaic kernel (bit-identical
+    # output) is kept as an option, but measured inside the real scanned
+    # step (tools/profile_ablate.py, state loop-carried) XLA's fusion
+    # already makes selection nearly free (ablating select_k_bits moves
+    # the step < 0.1 ms), and the kernel is marginally slower end to end
+    # — so it stays off by default.  It also has no GSPMD partitioning
+    # rule; sharded runs must keep the XLA form.
+    if use_pallas_select is None:
+        use_pallas_select = False
+    if use_pallas_select:
+        from ..ops.pallas.select import select_k_bits_pallas
+
+        def sel_k(elig, k, spec):
+            c, tick, phase, salt = spec
+            return select_k_bits_pallas(
+                elig, k, lane_seed(tick, phase, salt), c)
+    else:
+        sel_k = select_k_bits
 
     def step(params: GossipParams, state: GossipState):
         tick = state.tick
@@ -663,7 +692,7 @@ def make_gossip_step(cfg: GossipSimConfig,
             f_elig = f_elig & pub_ok_bits
         fanout = fanout | jax.lax.cond(
             jnp.any(f_need > 0),
-            lambda: select_k_bits(f_elig, f_need, u_spec(4)),
+            lambda: sel_k(f_elig, f_need, u_spec(4)),
             lambda: jnp.zeros_like(fanout))
 
         # -- 2. eager forward with per-edge provenance ------------------
@@ -699,45 +728,14 @@ def make_gossip_step(cfg: GossipSimConfig,
         fd_add = [None] * C         # per-receiver-bit popcounts (int32 [N])
         md_new = [None] * C
         inv_add = [None] * C
-        mesh_heard = [Z] * W
 
         def acc(a, b):
             return b if a is None else a + b
 
-        # Columns are independent: every same-tick deliverer of a new
-        # message gets delivery credit (the reference's near-first window
-        # covers simultaneous copies, score.go:684-818; with one tick =
-        # one heartbeat, same-tick ties ARE the window — and crediting all
-        # of them avoids biasing credit by candidate-bit order).
-        for c_send, off in enumerate(offsets):
-            j = cinv[c_send]    # receiver-side bit for this edge
-            mask_c = bit_row(out_bits, c_send)                  # [N]
-            ok_j = bit_row(payload_bits, j) if sc is not None else None
-            fd_j = md_j = iv_j = None
-            for w in range(W):
-                sent = jnp.where(mask_c, fresh[w], Z)
-                if flood_bits is not None:
-                    sent = sent | jnp.where(bit_row(flood_bits, c_send),
-                                            injected[w], Z)
-                rolled = jnp.roll(sent, off, axis=0)
-                if ok_j is not None:
-                    rolled = jnp.where(ok_j, rolled, Z)
-                news = rolled & ~seen[w]
-                mesh_heard[w] = mesh_heard[w] | news
-                if sc is not None:
-                    # P2/P4 credit new-message deliverers (later-tick
-                    # copies are dropped at the seen-cache,
-                    # pubsub.go:851-868); P3 additionally counts duplicate
-                    # copies from mesh members in the window
-                    fd_j = acc(fd_j, pc(news & valid_w[w]))
-                    if sc.track_p3:
-                        md_j = acc(md_j, pc(rolled & valid_w[w]
-                                            & ~have_start[w]))
-                    iv_j = acc(iv_j, pc(news & ~valid_w[w]))
-            fd_add[j], md_new[j], inv_add[j] = fd_j, md_j, iv_j
-        new_mesh_bits = [jnp.where(sub, hw, Z) for hw in mesh_heard]
-
-        # -- 3. lazy gossip (IHAVE/IWANT collapsed to one exchange) -----
+        # -- 3a. lazy gossip advertisement + targets --------------------
+        # (selected before forwarding so phases 2+3 can share one roll
+        # per edge below; this block reads only pre-maintenance state,
+        # the same inputs the separate phase-3 loop consumed)
         # advertise ids seen in the last HistoryGossip windows; targets =
         # random non-mesh subscribed candidates, max(Dlazy, factor*elig),
         # both sides above the gossip threshold (gossipsub.go:1656-1712)
@@ -762,7 +760,7 @@ def make_gossip_step(cfg: GossipSimConfig,
             jnp.int32(cfg.d_lazy),
             (cfg.gossip_factor * n_elig.astype(jnp.float32)).astype(
                 jnp.int32))
-        targets = select_k_bits(elig, n_gossip, u_spec(1))
+        targets = sel_k(elig, n_gossip, u_spec(1))
         if params.flood_proto is not None:
             targets = jnp.where(params.flood_proto, Z, targets)
         if sc is not None and sc.sybil_ihave_spam:
@@ -772,38 +770,130 @@ def make_gossip_step(cfg: GossipSimConfig,
             # (gossip_tracer.go:48-117, applyIwantPenalties)
             targets = jnp.where(params.sybil, params.cand_sub_bits,
                                 targets)
-        seen_g = [seen[w] | mesh_heard[w] for w in range(W)]
-        gossip_heard = [Z] * W
         bp_spam_bits = None
-        for c_send, off in enumerate(offsets):
-            j = cinv[c_send]
-            send_mask = bit_row(targets, c_send)
-            if sc is not None and sc.sybil_ihave_spam:
-                send_mask = send_mask & ~params.sybil
-            ok_j = None
-            if sc is not None:
-                ok_j = bit_row(payload_bits & gossip_bits, j)
-            for w in range(W):
-                sent = jnp.where(send_mask, adv[w], Z)
-                rolled = jnp.roll(sent, off, axis=0)
-                if ok_j is not None:
-                    rolled = jnp.where(ok_j, rolled, Z)
-                news = rolled & ~seen_g[w]
-                gossip_heard[w] = gossip_heard[w] | news
-                if sc is not None:
-                    # IWANT-pulled messages go through validation like any
-                    # other delivery: P2 credit for valid, P4 for invalid
-                    fd_add[j] = fd_add[j] + pc(news & valid_w[w])
-                    inv_add[j] = inv_add[j] + pc(news & ~valid_w[w])
         if sc is not None and sc.sybil_ihave_spam:
             # broken-promise bookkeeping: one P7 unit per sybil IHAVE spam
             bp_spam_bits = transfer_bits(
                 jnp.where(params.sybil, targets, Z), cfg)
-        new_gossip_bits = [jnp.where(sub, gossip_heard[w], Z)
-                           for w in range(W)]
+
+        # Columns are independent: every same-tick deliverer of a new
+        # message gets delivery credit (the reference's near-first window
+        # covers simultaneous copies, score.go:684-818; with one tick =
+        # one heartbeat, same-tick ties ARE the window — and crediting all
+        # of them avoids biasing credit by candidate-bit order).
+        combined = C <= 16 and (sc is None or not sc.track_p3)
+        if combined:
+            # -- 2+3 fused: ONE roll per edge carries the eager-forward,
+            # flood-publish, AND lazy-gossip payloads.  The receiver-side
+            # score gates (payload at graylist, payload∧gossip at gossip
+            # threshold — gossipsub.go:584,610) travel to the sender as
+            # one packed pair-transfer, so gating happens before the roll
+            # and the rolled word needs no receiver-side mask.  Rolls
+            # dominate the step (tools/profile_ablate.py: ~1/3 of it), so
+            # halving the payload rolls is the single biggest win.  Falls
+            # back to the split loops when P3 bookkeeping needs the
+            # mesh/gossip provenance distinction, or when C > 16.
+            # Credit-policy note: the split gossip loop denies credit to a
+            # gossip edge whose message was mesh-delivered the SAME tick
+            # (news vs seen|mesh_heard); here both deliverers are
+            # credited, uniformly extending the documented all-same-tick-
+            # deliverers P2/P4 policy (module docstring, Known deviation).
+            send_gsp = targets
+            if sc is not None and sc.sybil_ihave_spam:
+                send_gsp = jnp.where(params.sybil, Z, send_gsp)
+            if sc is not None:
+                packed = (payload_bits
+                          | ((payload_bits & gossip_bits)
+                             << jnp.uint32(16)))
+                gate_recv = transfer_bits(packed, cfg, pair=True)
+                send_fwd = out_bits & gate_recv
+                send_gsp = send_gsp & (gate_recv >> jnp.uint32(16))
+                send_flood = (flood_bits & gate_recv
+                              if flood_bits is not None else None)
+            else:
+                send_fwd, send_flood = out_bits, flood_bits
+            heard = [Z] * W
+            for c_send, off in enumerate(offsets):
+                j = cinv[c_send]    # receiver-side bit for this edge
+                m_f = bit_row(send_fwd, c_send)                 # [N]
+                m_g = bit_row(send_gsp, c_send)
+                fd_j = iv_j = None
+                for w in range(W):
+                    sent = (jnp.where(m_f, fresh[w], Z)
+                            | jnp.where(m_g, adv[w], Z))
+                    if send_flood is not None:
+                        sent = sent | jnp.where(
+                            bit_row(send_flood, c_send), injected[w], Z)
+                    rolled = jnp.roll(sent, off, axis=0)
+                    news = rolled & ~seen[w]
+                    heard[w] = heard[w] | news
+                    if sc is not None:
+                        # P2/P4 credit new-message deliverers, eager and
+                        # gossip alike (later-tick copies are dropped at
+                        # the seen-cache, pubsub.go:851-868)
+                        fd_j = acc(fd_j, pc(news & valid_w[w]))
+                        iv_j = acc(iv_j, pc(news & ~valid_w[w]))
+                fd_add[j], inv_add[j] = fd_j, iv_j
+            new_heard_bits = [jnp.where(sub, hw, Z) for hw in heard]
+        else:
+            # -- 2. eager forward with per-edge provenance --------------
+            mesh_heard = [Z] * W
+            for c_send, off in enumerate(offsets):
+                j = cinv[c_send]    # receiver-side bit for this edge
+                mask_c = bit_row(out_bits, c_send)              # [N]
+                ok_j = (bit_row(payload_bits, j) if sc is not None
+                        else None)
+                fd_j = md_j = iv_j = None
+                for w in range(W):
+                    sent = jnp.where(mask_c, fresh[w], Z)
+                    if flood_bits is not None:
+                        sent = sent | jnp.where(
+                            bit_row(flood_bits, c_send), injected[w], Z)
+                    rolled = jnp.roll(sent, off, axis=0)
+                    if ok_j is not None:
+                        rolled = jnp.where(ok_j, rolled, Z)
+                    news = rolled & ~seen[w]
+                    mesh_heard[w] = mesh_heard[w] | news
+                    if sc is not None:
+                        # P3 counts duplicate copies from mesh members in
+                        # the window — the provenance that forces the
+                        # split loops
+                        fd_j = acc(fd_j, pc(news & valid_w[w]))
+                        if sc.track_p3:
+                            md_j = acc(md_j, pc(rolled & valid_w[w]
+                                                & ~have_start[w]))
+                        iv_j = acc(iv_j, pc(news & ~valid_w[w]))
+                fd_add[j], md_new[j], inv_add[j] = fd_j, md_j, iv_j
+
+            # -- 3. lazy gossip exchange --------------------------------
+            seen_g = [seen[w] | mesh_heard[w] for w in range(W)]
+            gossip_heard = [Z] * W
+            for c_send, off in enumerate(offsets):
+                j = cinv[c_send]
+                send_mask = bit_row(targets, c_send)
+                if sc is not None and sc.sybil_ihave_spam:
+                    send_mask = send_mask & ~params.sybil
+                ok_j = None
+                if sc is not None:
+                    ok_j = bit_row(payload_bits & gossip_bits, j)
+                for w in range(W):
+                    sent = jnp.where(send_mask, adv[w], Z)
+                    rolled = jnp.roll(sent, off, axis=0)
+                    if ok_j is not None:
+                        rolled = jnp.where(ok_j, rolled, Z)
+                    news = rolled & ~seen_g[w]
+                    gossip_heard[w] = gossip_heard[w] | news
+                    if sc is not None:
+                        # IWANT-pulled messages go through validation
+                        # like any other delivery: P2 valid, P4 invalid
+                        fd_add[j] = fd_add[j] + pc(news & valid_w[w])
+                        inv_add[j] = inv_add[j] + pc(news & ~valid_w[w])
+            new_heard_bits = [
+                jnp.where(sub, mesh_heard[w] | gossip_heard[w], Z)
+                for w in range(W)]
 
         new_acquired = (jnp.stack(
-            [new_mesh_bits[w] | new_gossip_bits[w] | injected[w]
+            [new_heard_bits[w] | injected[w]
              for w in range(W)], axis=0) if W
             else jnp.zeros((0, n), dtype=jnp.uint32))           # [W, N]
         have = state.have | new_acquired
@@ -844,7 +934,7 @@ def make_gossip_step(cfg: GossipSimConfig,
         need = jnp.where(deg < cfg.d_lo, cfg.d - deg, 0)
         grafts = jax.lax.cond(
             jnp.any(need > 0),
-            lambda: select_k_bits(can_graft, need, u_spec(2)),
+            lambda: sel_k(can_graft, need, u_spec(2)),
             lambda: jnp.zeros_like(mesh))
 
         # prune down to D when deg > Dhi.  v1.0: random retention; v1.1:
@@ -854,8 +944,7 @@ def make_gossip_step(cfg: GossipSimConfig,
 
         def compute_prunes():
             if sc is None:
-                keep = select_k_bits(mesh, jnp.full_like(deg, cfg.d),
-                                     u_spec(3))
+                keep = sel_k(mesh, jnp.full_like(deg, cfg.d), u_spec(3))
             else:
                 rnd = lane_uniform((C, n), tick, 3, salt)
                 top = select_k_by_priority_bits(
@@ -897,7 +986,7 @@ def make_gossip_step(cfg: GossipSimConfig,
                            & pack_rows(score > median[None, :]))
                 og_need = jnp.where(og_row, sc.opportunistic_graft_peers,
                                     0)
-                return select_k_bits(og_elig, og_need, u_spec(5))
+                return sel_k(og_elig, og_need, u_spec(5))
 
             grafts = grafts | jax.lax.cond(
                 do_og, compute_og, lambda: jnp.zeros_like(mesh))
@@ -920,8 +1009,15 @@ def make_gossip_step(cfg: GossipSimConfig,
         # 804); PRUNE always removes + backs off (handlePrune :806-838).
         # Negative-score prunes notify the partner too (the reference
         # sends PRUNE for every mesh removal, gossipsub.go:1332-1338).
-        graft_recv = transfer_bits(grafts, cfg)
-        prune_recv = transfer_bits(dropped, cfg)
+        if C <= 16:
+            # GRAFT and PRUNE masks ride the same C rolls (pair packing)
+            recv = transfer_bits(grafts | (dropped << jnp.uint32(16)),
+                                 cfg, pair=True)
+            graft_recv = recv & ALL
+            prune_recv = recv >> jnp.uint32(16)
+        else:
+            graft_recv = transfer_bits(grafts, cfg)
+            prune_recv = transfer_bits(dropped, cfg)
         if sc is not None:
             # graylisted peers' control traffic is dropped outright
             graft_recv = graft_recv & accept_bits
@@ -1023,6 +1119,9 @@ def make_gossip_step(cfg: GossipSimConfig,
 @partial(jax.jit, static_argnums=(2, 3))
 def gossip_run(params: GossipParams, state: GossipState, n_ticks: int,
                step) -> GossipState:
+    # jit (with step static) is load-bearing: a bare lax.scan call misses
+    # the C++ dispatch fast path and costs ~4 ms/call of host overhead at
+    # 1M peers — as much as the step itself
     def body(s, _):
         return step(params, s)[0], None
     state, _ = jax.lax.scan(body, state, None, length=n_ticks)
@@ -1047,6 +1146,22 @@ def first_tick_matrix(state: GossipState, m: int) -> jnp.ndarray:
 def reach_counts(params: GossipParams, state: GossipState) -> jnp.ndarray:
     return reach_counts_from_first_tick(state.first_tick,
                                         params.publish_tick.shape[0])
+
+
+def reach_counts_from_have(params: GossipParams, state: GossipState,
+                           mask: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Per-message reached-peer counts from the packed possession words.
+
+    Works with ``track_first_tick=False`` — the bench path, where the
+    timed loop must not carry per-delivery record traffic (the final
+    reach is the correctness gate, hop curves are not needed).  Optional
+    [N] bool ``mask`` restricts the count (e.g. honest peers only)."""
+    m = params.publish_tick.shape[0]
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
+    bits = (state.have[:, None, :] >> shifts[None, :, None]) & jnp.uint32(1)
+    if mask is not None:
+        bits = bits * jnp.asarray(mask).astype(jnp.uint32)[None, None, :]
+    return bits.astype(jnp.int32).sum(axis=2).reshape(-1)[:m]
 
 
 def mesh_degrees(state: GossipState) -> jnp.ndarray:

@@ -265,3 +265,36 @@ async def test_remote_tracer():
     assert tr.TraceType.DELIVER_MESSAGE in types
     assert len(collector.events) >= 5
     await close_all([ps0, ps1], net)
+
+
+# -- logging (§5.5; reference logs via ipfs/go-log, pubsub.go:37) -----------
+
+
+async def test_logging_at_core_sites(caplog):
+    """Peer lifecycle and drop sites emit records on the package logger,
+    and process-loop exceptions are logged instead of printed."""
+    import logging
+
+    net = InProcNetwork()
+    hosts = get_hosts(net, 3)
+    psubs = [await create_gossipsub(h, gossipsub_params=fast_params())
+             for h in hosts]
+    with caplog.at_level(logging.DEBUG, logger="go_libp2p_pubsub_tpu"):
+        await connect(hosts[0], hosts[1])
+        await settle(0.2)
+        assert any("new peer" in r.message for r in caplog.records)
+
+        # blacklisted connect attempt
+        await psubs[0].blacklist_peer(hosts[2].id)
+        await connect(hosts[0], hosts[2])
+        await settle(0.2)
+        assert any("blacklisted" in r.message for r in caplog.records)
+
+        # a crashing thunk is logged, and the loop survives
+        psubs[0]._post(lambda: 1 / 0)
+        await settle(0.1)
+        errors = [r for r in caplog.records if r.levelno >= logging.ERROR]
+        assert any("process loop" in r.message for r in errors)
+        assert await psubs[0].list_peers("") is not None  # loop alive
+
+    await close_all(psubs, net)

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Subtractive ablation profile: time the REAL v1.1 step with individual
+components monkeypatched to no-ops, so each line's delta vs baseline is
+that component's true marginal cost inside the fused graph (CSE and
+fusion included — unlike tools/profile_step.py's standalone phases).
+
+State does not evolve between timed iterations (the loop carry only
+jiggles the tick), so patched semantics can't destabilize the run.
+
+Usage: python tools/profile_ablate.py [n_peers] [K]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    k = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+    t, m, C = 100, 32, 16
+    rng = np.random.default_rng(0)
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, C, n, seed=0), n_topics=t)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    topic = rng.integers(0, t, m)
+    origin = rng.integers(0, n // t, m) * t + topic
+    tick0 = np.zeros(m, dtype=np.int32)
+    sc = gs.ScoreSimConfig()
+    params, state = gs.make_gossip_sim(cfg, subs, topic, origin, tick0,
+                                       score_cfg=sc,
+                                       track_first_tick=False)
+    params = jax.device_put(params)
+    state = jax.device_put(state)
+    state = gs.gossip_run(params, state, 50, gs.make_gossip_step(cfg, sc))
+    _ = int(np.asarray(state.tick))
+
+    def time_step(step):
+        # state must be loop-CARRIED (gossip_run's scan), not closed
+        # over: with invariant state XLA hoists the score/counter work
+        # out of the loop and the step looks ~2x faster than it is
+        st = gs.gossip_run(params, state, k, step)
+        _ = int(np.asarray(st.tick))
+        best = 1e9
+        for _r in range(2):
+            t0 = time.perf_counter()
+            st = gs.gossip_run(params, st, k, step)
+            _ = int(np.asarray(st.tick))
+            best = min(best, time.perf_counter() - t0)
+        return best / k
+
+    saved = {}
+
+    def patch(**kw):
+        for name, fn in kw.items():
+            saved[name] = getattr(gs, name)
+            setattr(gs, name, fn)
+
+    def unpatch():
+        for name, fn in saved.items():
+            setattr(gs, name, fn)
+        saved.clear()
+
+    base = time_step(gs.make_gossip_step(cfg, sc))
+    print(f"n={n} C={C} k={k}")
+    print(f"{'baseline full step':32s} {base * 1e3:8.3f} ms")
+
+    def report(name, **patches):
+        patch(**patches)
+        try:
+            dt = time_step(gs.make_gossip_step(cfg, sc))
+        finally:
+            unpatch()
+        print(f"{'-' + name:32s} {dt * 1e3:8.3f} ms  "
+              f"(delta {(base - dt) * 1e3:+7.3f})")
+
+    # all jnp.roll sites (forward C, gossip C, transfer_bits 3C)
+    class FakeJnp:
+        def __getattr__(self, a):
+            return getattr(jnp, a)
+
+        @staticmethod
+        def roll(x, off, axis=0):
+            return x
+
+    report("all rolls", jnp=FakeJnp())
+    report("transfer_bits",
+           transfer_bits=lambda bits, cfg, pair=False: bits)
+    report("select_k_bits",
+           select_k_bits=lambda elig, k_, spec=None, **kw: elig)
+    report("select_k_by_priority",
+           select_k_by_priority_bits=lambda elig, prio, k_, **kw: elig)
+    report("lane_uniform",
+           lane_uniform=lambda shape, tick, phase, salt: jnp.full(
+               shape, 0.5, dtype=jnp.float32))
+    report("compute_scores",
+           compute_scores=lambda sc_, p, s: jnp.zeros(
+               (C, n), dtype=jnp.float32))
+    report("ranks_desc",
+           ranks_desc=lambda prio, tiebreak=None: jnp.zeros(
+               prio.shape, dtype=jnp.int32))
+
+
+if __name__ == "__main__":
+    main()

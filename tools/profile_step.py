@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""Per-phase ablation profile of the v1.1 gossip step on the real chip.
+
+Each candidate phase is rebuilt standalone from the same state the full
+step sees, wrapped in a jitted fori_loop of K iterations (stable call
+signature; the carry feeds back into the inputs so nothing hoists), and
+timed with a data-dependent host transfer as the sync point (PERF_NOTES:
+block_until_ready is not trustworthy on this platform).
+
+Usage: python tools/profile_step.py [n_peers] [K]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def timeit(name, fn, *args, k=50):
+    import jax
+
+    def loop(a):
+        def body(i, carry):
+            out = fn(i, carry, *a)
+            return out
+
+        return jax.lax.fori_loop(0, k, body, jnp.uint32(1))
+
+    import jax.numpy as jnp
+    jl = jax.jit(loop)
+    out = jl(args)
+    _ = int(out)  # warmup + compile
+    t0 = time.perf_counter()
+    out = jl(args)
+    _ = int(out)
+    dt = (time.perf_counter() - t0) / k
+    print(f"{name:34s} {dt * 1e3:8.3f} ms/iter")
+    return dt
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    from go_libp2p_pubsub_tpu.ops.graph import (
+        expand_bits, lane_uniform, pack_rows, popcount32,
+        select_k_bits, select_k_by_priority_bits)
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        compute_scores, transfer_bits)
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    k = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+    t, m, C = 100, 32, 16
+    rng = np.random.default_rng(0)
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, C, n, seed=0), n_topics=t)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    topic = rng.integers(0, t, m)
+    origin = rng.integers(0, n // t, m) * t + topic
+    tick0 = np.zeros(m, dtype=np.int32)
+    sc = gs.ScoreSimConfig()
+    params, state = gs.make_gossip_sim(cfg, subs, topic, origin, tick0,
+                                       score_cfg=sc,
+                                       track_first_tick=False)
+    params = jax.device_put(params)
+    state = jax.device_put(state)
+    # settle the mesh so the profile reflects steady state
+    step = gs.make_gossip_step(cfg, sc)
+    state = gs.gossip_run(params, state, 50, step)
+    _ = int(np.asarray(state.tick))
+
+    offsets = tuple(int(o) for o in cfg.offsets)
+    cinv = cfg.cinv
+    ALL = jnp.uint32((1 << C) - 1)
+    Z = jnp.uint32(0)
+    pc = jax.lax.population_count
+    W = int(state.have.shape[0])
+    salt = jax.random.key_data(state.key)[-1]
+
+    # -- full step reference -------------------------------------------
+    def full(i, carry, params, state):
+        st = state.replace(tick=state.tick + (carry & 1).astype(jnp.int32))
+        new, _ = step(params, st)
+        return carry ^ new.mesh.sum() ^ new.have.sum()
+
+    # -- phase 0: scores + packed gates + gater -------------------------
+    def phase0(i, carry, params, state):
+        st = state.replace(tick=state.tick + (carry & 1).astype(jnp.int32))
+        score = compute_scores(sc, params, st)
+        accept = pack_rows(score >= sc.graylist_threshold)
+        gossip = pack_rows(score >= sc.gossip_threshold)
+        pubok = pack_rows(score >= sc.publish_threshold)
+        nonneg = pack_rows(score >= 0)
+        f32 = lambda x: x.astype(jnp.float32)  # noqa: E731
+        invd = f32(st.scores.invalid_deliveries)
+        fdel = f32(st.scores.first_deliveries)
+        inv_tot = invd.sum(axis=0)
+        del_tot = fdel.sum(axis=0)
+        pressure = 16.0 * inv_tot / (1.0 + del_tot + 16.0 * inv_tot)
+        gater_on = pressure > 0.33
+        goodput = (1.0 + fdel) / (1.0 + fdel + 16.0 * invd)
+        u = lane_uniform((C, n), st.tick, 6, salt)
+        gater = pack_rows(u < goodput) | jnp.where(gater_on, Z, ALL)
+        return (carry ^ accept.sum() ^ gossip.sum() ^ pubok.sum()
+                ^ nonneg.sum() ^ gater.sum())
+
+    # -- phase 2 core: forward rolls (C edges, W words) -----------------
+    def forward(i, carry, params, state):
+        out_bits = state.mesh ^ (carry & 1).astype(jnp.uint32)
+        fresh = [state.recent[0, w] for w in range(W)]
+        seen = [state.have[w] for w in range(W)]
+        heard = [Z] * W
+        fd = [None] * C
+        for c_send, off in enumerate(offsets):
+            j = cinv[c_send]
+            mask_c = (out_bits >> jnp.uint32(c_send)) & jnp.uint32(1)
+            mask_c = mask_c != 0
+            fj = None
+            for w in range(W):
+                sent = jnp.where(mask_c, fresh[w], Z)
+                rolled = jnp.roll(sent, off, axis=0)
+                news = rolled & ~seen[w]
+                heard[w] = heard[w] | news
+                fj = pc(news) if fj is None else fj + pc(news)
+            fd[j] = fj
+        acc = carry
+        for w in range(W):
+            acc = acc ^ heard[w].sum()
+        return acc ^ jnp.stack(fd, 0).sum().astype(jnp.uint32)
+
+    # -- phase 4-ish: maintenance selections ----------------------------
+    def maintenance(i, carry, params, state):
+        mesh = state.mesh ^ (carry & 1).astype(jnp.uint32)
+        score = compute_scores(sc, params, state)
+        deg = popcount32(mesh)
+        backoff_bits = pack_rows(state.backoff > state.tick)
+        sub_all = jnp.where(params.subscribed, ALL, Z)
+        can_graft = params.cand_sub_bits & ~mesh & ~backoff_bits & sub_all
+        need = jnp.where(deg < cfg.d_lo, cfg.d - deg, 0)
+        grafts = select_k_bits(can_graft, need, (C, state.tick, 2, salt))
+        rnd = lane_uniform((C, n), state.tick, 3, salt)
+        top = select_k_by_priority_bits(
+            mesh, score, jnp.full_like(deg, cfg.d_score), tiebreak=rnd)
+        graft_recv = transfer_bits(grafts, cfg)
+        return carry ^ grafts.sum() ^ top.sum() ^ graft_recv.sum()
+
+    # -- phase 5: counter update + decay --------------------------------
+    def counters(i, carry, params, state):
+        s0 = state.scores
+        cdt = jnp.dtype(sc.counter_dtype)
+        f32 = lambda x: x.astype(jnp.float32)  # noqa: E731
+        bump = (carry & 1).astype(jnp.float32)
+        fd = jnp.minimum(f32(s0.first_deliveries) + bump,
+                         sc.first_message_deliveries_cap)
+        inv = f32(s0.invalid_deliveries) + bump
+        bp = f32(s0.behaviour_penalty) + bump
+        in_mesh = expand_bits(state.mesh, C)
+
+        def dk(x, decay, dtype=cdt):
+            x = x * decay
+            return jnp.where(x < sc.decay_to_zero, 0.0, x).astype(dtype)
+
+        tim = jnp.where(in_mesh, jnp.minimum(s0.time_in_mesh + 1, 32766),
+                        0).astype(jnp.int16)
+        a = dk(fd, sc.first_message_deliveries_decay)
+        b = dk(inv, sc.invalid_message_deliveries_decay)
+        c = dk(bp, sc.behaviour_penalty_decay, dtype=jnp.float32)
+        return (carry ^ tim.astype(jnp.uint32).sum()
+                ^ a.astype(jnp.uint32).sum() ^ b.astype(jnp.uint32).sum()
+                ^ c.astype(jnp.uint32).sum())
+
+    # -- raw roll cost: C rolls, nothing else ---------------------------
+    def rolls_only(i, carry, params, state):
+        acc = carry
+        row = state.have[0] ^ (carry & 1).astype(jnp.uint32)
+        for off in offsets:
+            acc = acc ^ jnp.roll(row, off, axis=0).sum()
+        return acc
+
+    print(f"n={n} C={C} W={W} k={k}")
+    timeit("full v1.1 step", full, params, state, k=k)
+    timeit("phase0 scores+gates+gater", phase0, params, state, k=k)
+    timeit("forward rolls (C edges)", forward, params, state, k=k)
+    timeit("maintenance selections", maintenance, params, state, k=k)
+    timeit("counter update+decay", counters, params, state, k=k)
+    timeit(f"{C} bare rolls", rolls_only, params, state, k=k)
+
+
+if __name__ == "__main__":
+    main()

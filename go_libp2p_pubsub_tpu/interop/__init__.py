@@ -9,7 +9,11 @@ BASELINE.md requires (curves matching within 1%).
 
 from .replay import (
     TraceRun,
+    circulant_edges,
     hops_from_trace,
+    mean_reach_fraction,
     reach_by_hops_from_trace,
     run_core_floodsub,
+    run_core_gossipsub,
+    run_core_randomsub,
 )

@@ -1143,6 +1143,21 @@ def first_tick_matrix(state: GossipState, m: int) -> jnp.ndarray:
     return first_tick_to_matrix(state.first_tick, m)
 
 
+def reach_by_hops(params: GossipParams, state: GossipState,
+                  max_hops: int) -> jnp.ndarray:
+    """[M, max_hops] cumulative deliveries by hop (publish-relative) —
+    the reachability-vs-hops curve of the BASELINE.md contract, directly
+    comparable with interop.reach_by_hops_from_trace."""
+    m = params.publish_tick.shape[0]
+    ft = first_tick_to_matrix(state.first_tick, m)          # [N, M] abs
+    rel = jnp.where(ft >= 0, ft - params.publish_tick[None, :],
+                    jnp.int32(-1))
+    hops = jnp.arange(max_hops, dtype=jnp.int32)
+    per_hop = (rel[None, :, :] == hops[:, None, None]).sum(
+        axis=1, dtype=jnp.int32)
+    return jnp.cumsum(per_hop, axis=0).T
+
+
 def reach_counts(params: GossipParams, state: GossipState) -> jnp.ndarray:
     return reach_counts_from_first_tick(state.first_tick,
                                         params.publish_tick.shape[0])

@@ -1144,14 +1144,23 @@ def first_tick_matrix(state: GossipState, m: int) -> jnp.ndarray:
 
 
 def reach_by_hops(params: GossipParams, state: GossipState,
-                  max_hops: int) -> jnp.ndarray:
+                  max_hops: int,
+                  mask: jnp.ndarray | None = None) -> jnp.ndarray:
     """[M, max_hops] cumulative deliveries by hop (publish-relative) —
     the reachability-vs-hops curve of the BASELINE.md contract, directly
-    comparable with interop.reach_by_hops_from_trace."""
+    comparable with interop.reach_by_hops_from_trace.
+
+    Optional [N] bool ``mask`` restricts the count to a peer subset
+    (e.g. honest peers only, matching the population semantics of the
+    reference's spam tests where attackers are out-of-band mocks and
+    reach is stated over the honest nodes —
+    gossipsub_spam_test.go:563-709)."""
     m = params.publish_tick.shape[0]
     ft = first_tick_to_matrix(state.first_tick, m)          # [N, M] abs
     rel = jnp.where(ft >= 0, ft - params.publish_tick[None, :],
                     jnp.int32(-1))
+    if mask is not None:
+        rel = jnp.where(jnp.asarray(mask)[:, None], rel, jnp.int32(-1))
     hops = jnp.arange(max_hops, dtype=jnp.int32)
     per_hop = (rel[None, :, :] == hops[:, None, None]).sum(
         axis=1, dtype=jnp.int32)

@@ -24,6 +24,13 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-host cluster tests with wall-clock warm-up "
+        "(deselect with '-m \"not slow\"')")
+
+
 def pytest_pyfunc_call(pyfuncitem):
     """Run ``async def`` tests under asyncio.run (no plugin dependency)."""
     fn = pyfuncitem.obj

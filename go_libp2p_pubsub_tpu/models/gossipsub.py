@@ -287,6 +287,17 @@ class GossipParams:
     invalid_words: jnp.ndarray | None = None  # uint32 [W]: msg fails validation
     cand_app_score: jnp.ndarray | None = None # f32 [C, N]: P5 of candidate
     cand_colo_excess: jnp.ndarray | None = None  # f32 [C, N]: P6 surplus
+    # P5 + P6 are static per-run, so their weighted sum is precomputed
+    # once (make_gossip_sim) instead of re-deriving colo² + the two
+    # multiply-adds from 128 MB of f32 inputs every tick
+    cand_static_score: jnp.ndarray | None = None  # f32 [C, N]
+    # bake-time (app_specific_weight, ip_colocation_factor_weight):
+    # compute_scores only trusts cand_static_score when the config it is
+    # called with still matches these, else it falls back to the
+    # component path (a re-weighted ScoreSimConfig must not silently
+    # read a stale baked term)
+    static_score_weights: tuple | None = struct.field(
+        pytree_node=False, default=None)
     cand_sybil: jnp.ndarray | None = None     # bool [C, N]: candidate is sybil
     sybil: jnp.ndarray | None = None          # bool [N]
     # mixed-protocol support (None = homogeneous gossipsub network):
@@ -398,10 +409,17 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
             0.0, colo_count - score_cfg.ip_colocation_factor_threshold)
         inv = (np.zeros(m, dtype=bool) if msg_invalid is None
                else np.asarray(msg_invalid, dtype=bool))
+        app_v = cand_view(app)
+        colo_v = cand_view(colo_excess)
         kw = dict(
             invalid_words=pack_bits(jnp.asarray(inv)),
-            cand_app_score=jnp.asarray(cand_view(app)),
-            cand_colo_excess=jnp.asarray(cand_view(colo_excess)),
+            cand_app_score=jnp.asarray(app_v),
+            cand_colo_excess=jnp.asarray(colo_v),
+            cand_static_score=jnp.asarray(
+                score_cfg.app_specific_weight * app_v
+                + score_cfg.ip_colocation_factor_weight * colo_v * colo_v),
+            static_score_weights=(score_cfg.app_specific_weight,
+                                  score_cfg.ip_colocation_factor_weight),
             cand_sybil=jnp.asarray(cand_view(syb)),
             sybil=jnp.asarray(syb),
         )
@@ -502,10 +520,45 @@ def compute_scores(sc: ScoreSimConfig, params: GossipParams,
                    st: GossipState) -> jnp.ndarray:
     """The peer-score formula, densified: f32 [C, N] — peer p's opinion of
     candidate p+o_c (score.go:256-333).  One topic per peer, so the
-    per-topic sum collapses to the single topic's contribution.  Defined
-    as the sum of score_snapshot's components (single source of truth;
-    XLA fuses the sum identically)."""
-    return score_snapshot(sc, params, st)["score"]
+    per-topic sum collapses to the single topic's contribution.
+
+    Hot-path form: the static P5+P6 term comes precomputed from
+    make_gossip_sim (``cand_static_score``) so the tick reads one f32
+    array instead of two plus a square.  score_snapshot (the inspection
+    path) derives the same sum from components;
+    test_score_snapshot_matches_total_and_components pins the two
+    together."""
+    if (params.cand_static_score is None
+            or params.static_score_weights
+            != (sc.app_specific_weight, sc.ip_colocation_factor_weight)):
+        return score_snapshot(sc, params, st)["score"]
+    s = st.scores
+    f32 = lambda x: x.astype(jnp.float32)  # noqa: E731
+    tim = f32(s.time_in_mesh)
+    invd = f32(s.invalid_deliveries)
+    w = sc.topic_weight
+    score = (w * sc.time_in_mesh_weight
+             * jnp.minimum(tim / sc.time_in_mesh_quantum,
+                           sc.time_in_mesh_cap)
+             + (w * sc.first_message_deliveries_weight)
+             * f32(s.first_deliveries)
+             + (w * sc.invalid_message_deliveries_weight) * invd * invd
+             + params.cand_static_score)
+    if sc.track_p3:
+        c = s.time_in_mesh.shape[0]
+        in_mesh = expand_bits(st.mesh, c)
+        deficit = jnp.maximum(
+            0.0, sc.mesh_message_deliveries_threshold
+            - f32(s.mesh_deliveries))
+        active = tim > sc.mesh_message_deliveries_activation
+        score = (score
+                 + (w * sc.mesh_message_deliveries_weight)
+                 * jnp.where(in_mesh & active, deficit * deficit, 0.0)
+                 + (w * sc.mesh_failure_penalty_weight)
+                 * f32(s.mesh_failure_penalty))
+    bp_excess = jnp.maximum(
+        0.0, f32(s.behaviour_penalty) - sc.behaviour_penalty_threshold)
+    return score + sc.behaviour_penalty_weight * bp_excess * bp_excess
 
 
 def score_snapshot(sc: ScoreSimConfig, params: GossipParams,
@@ -999,18 +1052,36 @@ def make_gossip_step(cfg: GossipSimConfig,
 
         mesh = (mesh | grafts) & ~prunes
         dropped = prunes if neg is None else prunes | neg
-        # backoff writes (one fused [C, N] pass): negative-score drops and
-        # prunes overwrite to tick+B (gossipsub.go:1332-1338)
-        bo_set = expand_bits(dropped, C)
-        backoff = jnp.where(bo_set, tick + cfg.backoff_ticks, backoff)
+        # (backoff writes for dropped edges land in the single row-wise
+        # backoff pass of section 5, fused with the handshake's)
 
         # handshake: partner accepts GRAFT unless unsubscribed, backed
         # off, or (v1.1) negative-scored (handleGraft gossipsub.go:713-
         # 804); PRUNE always removes + backs off (handlePrune :806-838).
         # Negative-score prunes notify the partner too (the reference
         # sends PRUNE for every mesh removal, gossipsub.go:1332-1338).
+        #
+        # The PRUNE-response round trip is folded into the SAME transfer
+        # pass: each side ships a "no PRUNE would come back" mask
+        # A = would-accept | would-silently-drop (a graylisted GRAFT is
+        # ignored without a PRUNE response, AcceptFrom gossipsub.go:584),
+        # so the grafter keeps exactly the edges the old explicit
+        # reject-back retraction kept — bit-identical, one transfer round
+        # (C rolls) and one serial dependency shorter.
+        backoff_bits2 = backoff_bits | dropped  # post-write backoff bits,
+        # derived algebraically (the only edges whose backoff changed are
+        # prunes|neg, all set beyond tick) — saves a second [C, N] reduce
+        would_accept = sub_all & ~backoff_bits2
+        if params.flood_proto is not None:
+            would_accept = jnp.where(params.flood_proto, Z, would_accept)
+        if sc is not None:
+            would_accept = would_accept & nonneg_bits
+            a_sent = would_accept | ~accept_bits
+        else:
+            a_sent = would_accept
         if C <= 16:
-            # GRAFT and PRUNE masks ride the same C rolls (pair packing)
+            # GRAFT+PRUNE masks ride one pair-packed transfer, the
+            # A mask a second (2C rolls total; was 3C with reject-back)
             recv = transfer_bits(grafts | (dropped << jnp.uint32(16)),
                                  cfg, pair=True)
             graft_recv = recv & ALL
@@ -1018,31 +1089,31 @@ def make_gossip_step(cfg: GossipSimConfig,
         else:
             graft_recv = transfer_bits(grafts, cfg)
             prune_recv = transfer_bits(dropped, cfg)
+        a_recv = transfer_bits(a_sent, cfg)
         if sc is not None:
             # graylisted peers' control traffic is dropped outright
             graft_recv = graft_recv & accept_bits
             prune_recv = prune_recv & accept_bits
-        # post-write backoff bits, derived algebraically (the only edges
-        # whose backoff changed are prunes|neg, all set beyond tick) —
-        # saves a second [C, N] reduce
-        backoff_bits2 = backoff_bits | dropped
         backoff_violation = graft_recv & backoff_bits2
-        accept = graft_recv & sub_all & ~backoff_bits2
-        if params.flood_proto is not None:
-            accept = jnp.where(params.flood_proto, Z, accept)
-        if sc is not None:
-            accept = accept & nonneg_bits
-        reject = graft_recv & ~accept
-        mesh = (mesh | accept) & ~prune_recv
-        # PRUNE response to rejected grafts retracts the optimistic graft
-        reject_back = transfer_bits(reject, cfg)
-        mesh = mesh & ~reject_back
-        bo_max = expand_bits(prune_recv | reject_back, C)
-        backoff = jnp.where(
-            bo_max, jnp.maximum(backoff, tick + cfg.backoff_ticks),
-            backoff)
+        accept = graft_recv & would_accept
+        retract = grafts & ~a_recv   # partner would PRUNE-respond
+        # retract LAST: when accept and retract coincide on an edge
+        # (possible only under sybil_graft_flood, whose grafts bypass
+        # the grafter's own backoff check) the PRUNE response wins,
+        # as in the explicit reject-back form (handlePrune semantics)
+        mesh = ((mesh | accept) & ~prune_recv) & ~retract
 
         # -- 5. score counter updates + decay ---------------------------
+        # (array-level on purpose: a row-wise variant was measured 1.7x
+        # slower — [C, N] row slices read whole (sublane, 128) tiles)
+        tick_b = tick + cfg.backoff_ticks
+        bo_trigger = dropped | prune_recv | retract
+        # dropped edges overwrite to tick+B (gossipsub.go:1332-1338);
+        # PRUNE receipt / retraction takes max(existing, tick+B) — equal
+        # here, since any existing backoff was set at an earlier tick
+        # with the same constant B
+        backoff = jnp.where(expand_bits(bo_trigger, C), tick_b, backoff)
+
         scores = state.scores
         if sc is not None:
             s0 = state.scores

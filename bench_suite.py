@@ -14,8 +14,10 @@ suite is the full matrix for tracking all baseline configs.)
   gossipsub_v11    1M (TPU) / 100k (CPU) peers, 100 topics, scoring +
                    gater — heartbeats/s (same as bench.py)
   gossipsub_v11_adversarial
-                   same + 20% sybils running the IHAVE-spam attack —
-                   heartbeats/s, gated on honest-traffic delivery
+                   same + 20% sybils running the IHAVE broken-promise
+                   spam AND the IWANT retransmission flood —
+                   heartbeats/s, gated on honest-traffic delivery and
+                   the retransmission-cutoff load bound
 
 Usage: python bench_suite.py [config ...]   (default: all)
 """
@@ -168,6 +170,14 @@ def _bench_gossip(metric, n, t, score_cfg, sybil=None, gate_honest=False,
         want = np.full(m, n // t)
     ok = reach[settled] == want[settled]
     assert ok.all(), (reach[settled][~ok], want[settled][~ok])
+    if state.iwant_serves is not None:
+        # IWANT-flood containment gate (gossipsub_spam_test.go:24): the
+        # retransmission cutoff bounds every victim edge's served load
+        # at (retrans + 1 overshoot batch) x window ids
+        serves = np.asarray(state.iwant_serves)
+        per_edge_cap = ((cfg.gossip_retransmission + 1) * 32
+                        * params.origin_words.shape[0])
+        assert serves.max() <= per_edge_cap, serves.max()
     emit(metric, T * reps / dt, "heartbeats/s", baseline=baseline)
 
 
@@ -187,6 +197,11 @@ def bench_gossipsub_v11():
 
 
 def bench_gossipsub_v11_adversarial():
+    """20% sybils running BOTH gossip-repair attacks at once: IHAVE
+    broken-promise spam (gossipsub_spam_test.go:135) and the IWANT
+    retransmission flood (gossipsub_spam_test.go:24).  Gated on full
+    honest delivery and on the retransmission cutoff's served-load
+    bound."""
     import jax
     import go_libp2p_pubsub_tpu.models.gossipsub as gs
     on_accel = jax.devices()[0].platform != "cpu"
@@ -195,7 +210,8 @@ def bench_gossipsub_v11_adversarial():
     sybil = rng.random(n) < 0.2
     _bench_gossip(
         f"gossipsub_v11_adversarial_{n}peers_20pct_sybil_heartbeats_per_sec",
-        n, 100, gs.ScoreSimConfig(sybil_ihave_spam=True),
+        n, 100, gs.ScoreSimConfig(sybil_ihave_spam=True,
+                                  sybil_iwant_spam=True),
         sybil=sybil, gate_honest=True, baseline=10_000.0)
 
 

@@ -108,8 +108,17 @@ class GossipSimConfig:
     d_lazy: int = 6                # GossipSubDlazy
     gossip_factor: float = 0.25    # GossipSubGossipFactor
     history_gossip: int = 3        # GossipSubHistoryGossip (IHAVE window)
+    history_length: int = 5        # GossipSubHistoryLength (mcache span)
     backoff_ticks: int = 60        # GossipSubPruneBackoff / heartbeat
     fanout_ttl_ticks: int = 60     # GossipSubFanoutTTL / heartbeat
+    # gossip-repair abuse bounds (gossipsub.go:56-59, mcache.go:66-80):
+    # a message is retransmitted to one peer at most gossip_retransmission
+    # times before that peer's IWANTs for it are ignored.  The IHAVE
+    # advert caps are carried for parity/validation; with messages as
+    # word bits (<= 32W ids in flight) they never bind at sim scale.
+    gossip_retransmission: int = 3   # GossipSubGossipRetransmission
+    max_ihave_length: int = 5000     # GossipSubMaxIHaveLength
+    max_ihave_messages: int = 10     # GossipSubMaxIHaveMessages
 
     def __post_init__(self):
         offs = np.asarray(self.offsets, dtype=np.int64)
@@ -130,6 +139,13 @@ class GossipSimConfig:
                 "need Dout < Dlo and Dout <= D/2 (gossipsub.go:266-272)")
         if self.d_hi >= len(offs):
             raise ValueError("need C > Dhi candidate columns")
+        if self.history_gossip > self.history_length:
+            raise ValueError(
+                "need HistoryGossip <= HistoryLength (gossipsub.go:47)")
+        if self.gossip_retransmission < 1:
+            raise ValueError("gossip_retransmission must be >= 1")
+        if self.max_ihave_length < 1 or self.max_ihave_messages < 1:
+            raise ValueError("IHAVE caps must be >= 1")
 
     @property
     def n_candidates(self) -> int:
@@ -221,6 +237,11 @@ class ScoreSimConfig:
     # sybil behavior toggles (peers flagged sybil in params)
     sybil_ihave_spam: bool = False          # broken-promise IWANT flood
     sybil_graft_flood: bool = False         # re-GRAFT while backed off
+    # IWANT-flood (gossipsub_spam_test.go:24): sybils re-request the
+    # full advertised window from every candidate every tick; victims
+    # serve until the per-edge retransmission budget saturates
+    # (mcache.go:66-80 + gossipsub.go:690-693)
+    sybil_iwant_spam: bool = False
     # counter storage dtype: bfloat16 halves the dominant HBM traffic of
     # the v1.1 step (6 [C, N] counters r+w per tick); the counters are
     # small decaying sums where ~3 significant digits is ample.  All
@@ -298,8 +319,18 @@ class GossipParams:
     # read a stale baked term)
     static_score_weights: tuple | None = struct.field(
         pytree_node=False, default=None)
+    # true peer count when the peer axis is padded for the pallas step
+    # (make_gossip_sim pad_to_block); None = unpadded.  Peers >= n_true
+    # are inert: unsubscribed, candidate-invisible, and the circulant
+    # views wrap at n_true, so they can neither send nor retain state.
+    n_true: int | None = struct.field(pytree_node=False, default=None)
     cand_sybil: jnp.ndarray | None = None     # bool [C, N]: candidate is sybil
     sybil: jnp.ndarray | None = None          # bool [N]
+    # peers that advertise gossip but withhold the payload (broken
+    # IWANT promises) WITHOUT being flagged sybil — stealthy spammers.
+    # P7 is behavioral (derived from advertised-vs-delivered traffic,
+    # gossip_tracer.go:48-153), so these accrue it like flagged ones.
+    promise_break: jnp.ndarray | None = None  # bool [N]
     # mixed-protocol support (None = homogeneous gossipsub network):
     # floodsub-protocol peers are always flooded and never mesh/gossip
     # (feature negotiation, gossipsub_feat.go:11-52, gossipsub.go:969-974)
@@ -335,6 +366,10 @@ class GossipState:
     scores: ScoreState | None  # None when v1.1 scoring is disabled
     key: jax.Array           # PRNG key
     tick: jnp.ndarray        # int32 scalar
+    # IWANT-flood defense state (only under sybil_iwant_spam): per-edge
+    # count of gossip retransmissions served, decayed as mcache entries
+    # expire (mcache.go:66-80 aggregated per edge over the window)
+    iwant_serves: jnp.ndarray | None = None  # int16 [C, N]
 
 
 def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
@@ -346,7 +381,9 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
                     peer_ip: np.ndarray | None = None,
                     sybil: np.ndarray | None = None,
                     msg_invalid: np.ndarray | None = None,
-                    flood_proto: np.ndarray | None = None):
+                    flood_proto: np.ndarray | None = None,
+                    promise_break: np.ndarray | None = None,
+                    pad_to_block: int | None = None):
     """Build (params, state).  subs: bool [N, T] — but each peer may only
     subscribe to its residue-class topic (circulant classes are closed, so
     cross-class subscriptions would never receive anything).
@@ -394,6 +431,28 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
             out |= np.roll(per_peer_bool, -o).astype(np.uint32) << c
         return out
 
+    # optional peer-axis padding for the pallas step (its grid needs
+    # n % block == 0 with a 128-aligned block, which 10^6-style peer
+    # counts never satisfy).  Pad peers are inert: unsubscribed, absent
+    # from every candidate mask, and the kernel's circulant views wrap
+    # at the TRUE n — they can neither send nor be counted.
+    n_pad = n if pad_to_block is None else -(-n // pad_to_block
+                                             ) * pad_to_block
+
+    def padl(a, fill=0):
+        """Pad the LAST axis (peer-minor arrays) from n to n_pad."""
+        if n_pad == n:
+            return a
+        return np.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, n_pad - n)],
+                      constant_values=fill)
+
+    def pad0(a, fill=0):
+        """Pad axis 0 (peer-major arrays) from n to n_pad."""
+        if n_pad == n:
+            return a
+        return np.pad(a, [(0, n_pad - n)] + [(0, 0)] * (a.ndim - 1),
+                      constant_values=fill)
+
     kw = {}
     if score_cfg is not None:
         score_cfg.validate()
@@ -413,30 +472,38 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
         colo_v = cand_view(colo_excess)
         kw = dict(
             invalid_words=pack_bits(jnp.asarray(inv)),
-            cand_app_score=jnp.asarray(app_v),
-            cand_colo_excess=jnp.asarray(colo_v),
-            cand_static_score=jnp.asarray(
+            cand_app_score=jnp.asarray(padl(app_v)),
+            cand_colo_excess=jnp.asarray(padl(colo_v)),
+            cand_static_score=jnp.asarray(padl(
                 score_cfg.app_specific_weight * app_v
-                + score_cfg.ip_colocation_factor_weight * colo_v * colo_v),
+                + score_cfg.ip_colocation_factor_weight * colo_v * colo_v)),
             static_score_weights=(score_cfg.app_specific_weight,
                                   score_cfg.ip_colocation_factor_weight),
-            cand_sybil=jnp.asarray(cand_view(syb)),
-            sybil=jnp.asarray(syb),
+            cand_sybil=jnp.asarray(padl(cand_view(syb))),
+            sybil=jnp.asarray(padl(syb)),
         )
 
     if flood_proto is not None:
         fp = np.asarray(flood_proto, dtype=bool)
-        kw.update(flood_proto=jnp.asarray(fp),
-                  cand_flood_bits=jnp.asarray(cand_bits(fp)))
+        kw.update(flood_proto=jnp.asarray(padl(fp)),
+                  cand_flood_bits=jnp.asarray(padl(cand_bits(fp))))
+
+    if promise_break is not None:
+        if score_cfg is None:
+            raise ValueError("promise_break requires score_cfg (P7)")
+        kw.update(promise_break=jnp.asarray(
+            padl(np.asarray(promise_break, dtype=bool))))
 
     params = GossipParams(
-        subscribed=jnp.asarray(subscribed),
-        cand_sub_bits=jnp.asarray(cand_bits(subscribed)),
-        origin_words=pack_bits_pm(jnp.asarray(origin_bits)),
-        deliver_words=pack_bits_pm(jnp.asarray(deliver_bits)),
+        subscribed=jnp.asarray(padl(subscribed)),
+        cand_sub_bits=jnp.asarray(padl(cand_bits(subscribed))),
+        origin_words=pack_bits_pm(jnp.asarray(pad0(origin_bits))),
+        deliver_words=pack_bits_pm(jnp.asarray(pad0(deliver_bits))),
         publish_tick=jnp.asarray(msg_publish_tick, dtype=jnp.int32),
+        n_true=(n if pad_to_block is not None else None),
         **kw,
     )
+    n = n_pad
     w = params.origin_words.shape[0]
     c = cfg.n_candidates
     cdt = (jnp.dtype(score_cfg.counter_dtype) if score_cfg is not None
@@ -466,6 +533,8 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
                 if score_cfg is not None else None),
         key=jax.random.PRNGKey(seed),
         tick=jnp.zeros((), dtype=jnp.int32),
+        iwant_serves=(zt() if score_cfg is not None
+                      and score_cfg.sybil_iwant_spam else None),
     )
     return params, state
 
@@ -617,7 +686,11 @@ def score_snapshot(sc: ScoreSimConfig, params: GossipParams,
 
 def make_gossip_step(cfg: GossipSimConfig,
                      score_cfg: ScoreSimConfig | None = None,
-                     use_pallas_select: bool | None = None):
+                     use_pallas_select: bool | None = None,
+                     use_pallas_receive: bool | None = None,
+                     receive_block: int = 8192,
+                     receive_interpret: bool = False,
+                     force_split: bool = False):
     """Build the jittable (params, state) -> (state, delivered_words) core.
 
     Per tick:
@@ -663,11 +736,105 @@ def make_gossip_step(cfg: GossipSimConfig,
         from ..ops.pallas.select import select_k_bits_pallas
 
         def sel_k(elig, k, spec):
-            c, tick, phase, salt = spec
+            c, tick, phase, salt = spec[:4]
+            stride = spec[4] if len(spec) > 4 else elig.shape[0]
             return select_k_bits_pallas(
-                elig, k, lane_seed(tick, phase, salt), c)
+                elig, k, lane_seed(tick, phase, salt), c,
+                stride=stride)
     else:
         sel_k = select_k_bits
+
+    def _finish_kernel(*, params, state, fanout, last_pub, injected,
+                       fresh, adv, targets, withhold, out_bits, grafts,
+                       dropped, mesh_sel, a_sent, would_accept,
+                       backoff_bits2, sub_all, payload_bits,
+                       gossip_bits, accept_bits, valid_w, tick):
+        """Pallas path: one mega-kernel does the payload receive,
+        handshake resolution, and per-edge counter/backoff updates in
+        a single HBM pass over the [C, N] state (ops/pallas/receive)."""
+        from ..ops.pallas.receive import (
+            ALIGN8, ALIGN32, CTRL_A, CTRL_DROP, CTRL_GRAFT,
+            CTRL_OUT, CTRL_ADV, CTRL_TGT, extend_wrap,
+            make_receive_update, plan)
+
+        n_true = params.n_true
+        n_pad = params.subscribed.shape[0]
+        W = state.have.shape[0]
+        pln = plan(n_true, offsets, receive_block)
+        if pln["n_pad"] != n_pad:
+            raise ValueError(
+                f"state padded to {n_pad}, kernel plan wants "
+                f"{pln['n_pad']} (pad_to_block == receive_block?)")
+        # raw advert (CTRL_ADV) vs delivering advert (CTRL_TGT): their
+        # difference at the receiver IS the broken promise — behavioral
+        # P7, no oracle flag in the kernel
+        tgt_deliver = (targets if withhold is None
+                       else jnp.where(withhold, Z, targets))
+        track_promises = withhold is not None
+
+        def bit_of(word, c):
+            return (word >> jnp.uint32(c)) & jnp.uint32(1)
+
+        rows = []
+        for c in range(C):
+            b = ((bit_of(out_bits, c) << jnp.uint32(CTRL_OUT))
+                 | (bit_of(tgt_deliver, c) << jnp.uint32(CTRL_TGT))
+                 | (bit_of(grafts, c) << jnp.uint32(CTRL_GRAFT))
+                 | (bit_of(dropped, c) << jnp.uint32(CTRL_DROP))
+                 | (bit_of(a_sent, c) << jnp.uint32(CTRL_A))
+                 | (bit_of(targets, c) << jnp.uint32(CTRL_ADV)))
+            rows.append(extend_wrap(b.astype(jnp.uint8), n_true, n_pad,
+                                    pln["p8"], ALIGN8))
+        ctrl_flat = jnp.concatenate(rows)
+        fresh_flat = jnp.concatenate(
+            [extend_wrap(fresh[w], n_true, n_pad, pln["p32"], ALIGN32)
+             for w in range(W)])
+        adv_flat = jnp.concatenate(
+            [extend_wrap(adv[w], n_true, n_pad, pln["p32"], ALIGN32)
+             for w in range(W)])
+        seen_st = jnp.stack([state.have[w] | injected[w]
+                             for w in range(W)])
+        inj_st = jnp.stack(injected)
+        tickb = (tick + cfg.backoff_ticks).astype(jnp.int32).reshape(1)
+        cdt = (jnp.dtype(sc.counter_dtype) if sc is not None else None)
+        krn = make_receive_update(cfg, sc, n_true, receive_block, cdt,
+                                  W, track_promises=track_promises,
+                                  interpret=receive_interpret)
+        args = []
+        if sc is not None:
+            args.append(jnp.stack(valid_w))
+        args += [tickb, ctrl_flat, fresh_flat, adv_flat]
+        if sc is not None:
+            args += [payload_bits, gossip_bits, accept_bits]
+        args += [sub_all, would_accept, backoff_bits2, grafts, dropped,
+                 mesh_sel, seen_st, inj_st, state.backoff]
+        if sc is not None:
+            s0 = state.scores
+            args += [s0.first_deliveries, s0.invalid_deliveries,
+                     s0.behaviour_penalty, s0.time_in_mesh]
+        outs = krn(*args)
+        new_acq, mesh_new, backoff_new = outs[0], outs[1], outs[2]
+        have = state.have | new_acq
+        recent = jnp.concatenate([new_acq[None], state.recent[:-1]],
+                                 axis=0)
+        delivered_now = new_acq & params.deliver_words
+        if sc is not None:
+            delivered_now = delivered_now & ~params.invalid_words[:, None]
+        first_tick = update_first_tick(state.first_tick, delivered_now,
+                                       tick)
+        scores = state.scores
+        if sc is not None:
+            scores = ScoreState(
+                time_in_mesh=outs[6], first_deliveries=outs[3],
+                mesh_deliveries=state.scores.mesh_deliveries,
+                mesh_failure_penalty=state.scores.mesh_failure_penalty,
+                invalid_deliveries=outs[4], behaviour_penalty=outs[5])
+        new_state = GossipState(
+            mesh=mesh_new, fanout=fanout, last_pub=last_pub,
+            backoff=backoff_new, have=have, recent=recent,
+            first_tick=first_tick, scores=scores, key=state.key,
+            tick=tick + 1, iwant_serves=state.iwant_serves)
+        return new_state, delivered_now
 
     def step(params: GossipParams, state: GossipState):
         tick = state.tick
@@ -675,11 +842,32 @@ def make_gossip_step(cfg: GossipSimConfig,
         sub_all = jnp.where(sub, ALL, Z)   # uint32 [N] gate
         n = sub.shape[0]
         W = state.have.shape[0]
+        kernel_on = (params.n_true is not None
+                     if use_pallas_receive is None else use_pallas_receive)
+        if kernel_on:
+            if params.n_true is None:
+                raise ValueError(
+                    "pallas step needs make_gossip_sim(pad_to_block=...)")
+            if (C > 16 or W == 0 or params.flood_proto is not None
+                    or (sc is not None and (sc.track_p3
+                                            or sc.flood_publish
+                                            or sc.sybil_iwant_spam))):
+                raise ValueError(
+                    "config not supported by the pallas step (needs "
+                    "C<=16, W>=1, no flood_proto/track_p3/"
+                    "flood_publish/sybil_iwant_spam)")
+        elif params.n_true is not None:
+            raise ValueError(
+                "padded sim state requires the pallas step (XLA rolls "
+                "would wrap at the padded length)")
         # per-phase uniform fields from the counter-based lane hash (the
         # carried PRNG key's last word is the run seed; threefry per tick
-        # would dominate the elementwise cost of the whole step)
+        # would dominate the elementwise cost of the whole step).  The
+        # lane stride pins the stream to the TRUE peer count so padded
+        # (pallas) and unpadded (XLA) formulations draw identically.
         salt = jax.random.key_data(state.key)[-1]
-        u_spec = lambda phase: (C, tick, phase, salt)  # noqa: E731
+        n_stream = params.n_true if params.n_true is not None else n
+        u_spec = lambda phase: (C, tick, phase, salt, n_stream)  # noqa: E731
 
         # -- 0. start-of-tick scores and the gates they drive -----------
         if sc is not None:
@@ -705,7 +893,8 @@ def make_gossip_step(cfg: GossipSimConfig,
             pressure = 16.0 * inv_tot / (1.0 + del_tot + 16.0 * inv_tot)
             gater_on = pressure > 0.33
             goodput = (1.0 + fdel) / (1.0 + fdel + 16.0 * invd)
-            u_gater = lane_uniform((C, n), tick, 6, salt)
+            u_gater = lane_uniform((C, n), tick, 6, salt,
+                                   stride=n_stream)
             gater_bits = pack_rows(u_gater < goodput) | jnp.where(
                 gater_on, Z, ALL)
             payload_bits = accept_bits & gater_bits             # [N]
@@ -817,24 +1006,199 @@ def make_gossip_step(cfg: GossipSimConfig,
         if params.flood_proto is not None:
             targets = jnp.where(params.flood_proto, Z, targets)
         if sc is not None and sc.sybil_ihave_spam:
-            # IHAVE-spamming sybils advertise ids they never deliver
-            # (gossipsub_spam_test.go:135): their gossip carries nothing,
-            # and each spammed peer records a broken promise -> P7
-            # (gossip_tracer.go:48-117, applyIwantPenalties)
+            # IHAVE-spamming sybils advertise to every subscribed
+            # candidate ids they never deliver (gossipsub_spam_test.go:135)
             targets = jnp.where(params.sybil, params.cand_sub_bits,
                                 targets)
-        bp_spam_bits = None
+        # Promise withholding is BEHAVIORAL from here on: the P7 broken-
+        # promise penalty is derived from advertised-vs-delivered traffic
+        # at the receiver (gossip_tracer.go:48-153 + applyIwantPenalties
+        # gossipsub.go:1566-1571), not from the sybil flag — a stealthy
+        # spammer (promise_break) accrues it identically.
+        withhold = None
         if sc is not None and sc.sybil_ihave_spam:
-            # broken-promise bookkeeping: one P7 unit per sybil IHAVE spam
-            bp_spam_bits = transfer_bits(
-                jnp.where(params.sybil, targets, Z), cfg)
+            withhold = params.sybil
+        if sc is not None and params.promise_break is not None:
+            withhold = (params.promise_break if withhold is None
+                        else withhold | params.promise_break)
+
+        # -- 3b. IWANT-flood defense (mcache.go:66-80, gossipsub.go:
+        # 690-693; attack: gossipsub_spam_test.go:24).  Sybil candidates
+        # re-request the victim's full advertised window every tick; the
+        # victim serves until the per-edge retransmission budget
+        # (GossipRetransmission x window ids) is spent, then ignores
+        # that peer's IWANTs.  Serves decay as mcache entries expire
+        # (1/HistoryLength per tick), so the steady served rate is
+        # capped at retransmission/history_length of the uncapped flood
+        # — the same bound the reference's per-message counter yields.
+        iwant_serves = state.iwant_serves
+        if sc is not None and sc.sybil_iwant_spam:
+            adv_count = None
+            for w in range(W):
+                pcw = pc(adv[w])
+                adv_count = pcw if adv_count is None else adv_count + pcw
+            budget = cfg.gossip_retransmission * adv_count[None, :]
+            cutoff = state.iwant_serves.astype(jnp.int32) >= budget
+            served_now = jnp.where(
+                params.cand_sybil & ~cutoff & (adv_count[None, :] > 0),
+                adv_count[None, :], 0)
+            decayed = (state.iwant_serves.astype(jnp.int32)
+                       - state.iwant_serves.astype(jnp.int32)
+                       // cfg.history_length)
+            iwant_serves = jnp.clip(decayed + served_now, 0,
+                                    30000).astype(jnp.int16)
+
+        # -- heartbeat maintenance SELECTIONS (gossipsub.go:1299-1552).
+        # Read-only on start-of-tick state (score, mesh, backoff,
+        # uniforms), so they run before forwarding and are shared by the
+        # two execution paths (XLA transfer rolls / pallas kernel) that
+        # diverge below.
+        mesh_before = state.mesh
+        backoff = state.backoff
+        if sc is not None:
+            # drop negative-score mesh members first (gossipsub.go:1332)
+            neg = mesh_before & ~nonneg_bits
+            mesh_ng = mesh_before & nonneg_bits
+        else:
+            neg = None
+            mesh_ng = mesh_before
+        deg = popcount32(mesh_ng)                               # [N]
+
+        # graft up to D when deg < Dlo (gossipsub.go:1340-1360);
+        # candidates need score >= 0 in v1.1.  in_backoff is the only
+        # per-edge numeric state: pack the comparison once.
+        backoff_bits = pack_rows(backoff > tick)
+        can_graft = (params.cand_sub_bits & ~mesh_ng & ~backoff_bits
+                     & sub_all)
+        if params.flood_proto is not None:
+            # floodsub-protocol peers have no mesh: never graft at them,
+            # and they graft at nobody
+            can_graft = can_graft & ~params.cand_flood_bits
+            can_graft = jnp.where(params.flood_proto, Z, can_graft)
+        if sc is not None:
+            can_graft = can_graft & nonneg_bits
+        need = jnp.where(deg < cfg.d_lo, cfg.d - deg, 0)
+        grafts = jax.lax.cond(
+            jnp.any(need > 0),
+            lambda: sel_k(can_graft, need, u_spec(2)),
+            lambda: jnp.zeros_like(mesh_ng))
+
+        # prune down to D when deg > Dhi.  v1.0: random retention; v1.1:
+        # keep the Dscore best by score, then at least Dout outbound,
+        # random fill to D (anti-sybil bubble-up, gossipsub.go:1376-1435).
+        over = deg > cfg.d_hi
+
+        def compute_prunes():
+            if sc is None:
+                keep = sel_k(mesh_ng, jnp.full_like(deg, cfg.d),
+                             u_spec(3))
+            else:
+                rnd = lane_uniform((C, n), tick, 3, salt,
+                                   stride=n_stream)
+                top = select_k_by_priority_bits(
+                    mesh_ng, score, jnp.full_like(deg, cfg.d_score),
+                    tiebreak=rnd)
+                n_out_top = popcount32(top & OUT_MASK)
+                need_out = jnp.maximum(0, cfg.d_out - n_out_top)
+                out_keep = select_k_by_priority_bits(
+                    mesh_ng & ~top & OUT_MASK, rnd, need_out)
+                taken = top | out_keep
+                n_taken = popcount32(taken)
+                fill = select_k_by_priority_bits(
+                    mesh_ng & ~taken, rnd,
+                    jnp.maximum(cfg.d - n_taken, 0))
+                keep = taken | fill
+            return mesh_ng & ~keep & jnp.where(over, ALL, Z)
+
+        prunes = jax.lax.cond(jnp.any(over), compute_prunes,
+                              lambda: jnp.zeros_like(mesh_ng))
+
+        if sc is not None:
+            # opportunistic grafting: when the mesh's median score sags
+            # below the threshold, graft extra high-scoring peers
+            # (gossipsub.go:1467-1498).  Runs 1-in-opportunistic_graft_
+            # ticks, so the median rank-compare sits under the cond too.
+            do_og = (tick % sc.opportunistic_graft_ticks) == 0
+
+            def compute_og():
+                # median = the mesh bit at ascending rank deg//2 =
+                # descending rank C-1-deg//2 (non-mesh bits pinned to
+                # +inf rank first); rank-compare instead of a sort
+                in_mesh = expand_bits(mesh_ng, C)
+                mesh_rank = ranks_desc(jnp.where(in_mesh, score, jnp.inf))
+                med_pick = in_mesh & (mesh_rank
+                                      == (C - 1 - deg // 2)[None, :])
+                median = jnp.where(
+                    deg > 0, jnp.where(med_pick, score, 0.0).sum(0), 0.0)
+                og_row = (median < sc.opportunistic_graft_threshold) & sub
+                og_elig = (can_graft & ~grafts
+                           & pack_rows(score > median[None, :]))
+                og_need = jnp.where(og_row, sc.opportunistic_graft_peers,
+                                    0)
+                return sel_k(og_elig, og_need, u_spec(5))
+
+            grafts = grafts | jax.lax.cond(
+                do_og, compute_og, lambda: jnp.zeros_like(mesh_ng))
+
+        if sc is not None and sc.sybil_graft_flood:
+            # GRAFT-flooding sybils re-graft every tick, ignoring their
+            # own backoff (gossipsub_spam_test.go:349)
+            grafts = jnp.where(params.sybil,
+                               params.cand_sub_bits & ~mesh_ng, grafts)
+
+        mesh_sel = (mesh_ng | grafts) & ~prunes
+        dropped = prunes if neg is None else prunes | neg
+        backoff_bits2 = backoff_bits | dropped  # post-write backoff
+        # bits, derived algebraically (the only edges whose backoff
+        # changed are prunes|neg, all set beyond tick)
+        would_accept = sub_all & ~backoff_bits2
+        if params.flood_proto is not None:
+            would_accept = jnp.where(params.flood_proto, Z, would_accept)
+        if sc is not None:
+            would_accept = would_accept & nonneg_bits
+            a_sent = would_accept | ~accept_bits
+        else:
+            a_sent = would_accept
+
+        if kernel_on:
+            return _finish_kernel(
+                params=params, state=state, fanout=fanout,
+                last_pub=last_pub, injected=injected, fresh=fresh,
+                adv=adv, targets=targets, withhold=withhold,
+                out_bits=out_bits,
+                grafts=grafts, dropped=dropped, mesh_sel=mesh_sel,
+                a_sent=a_sent, would_accept=would_accept,
+                backoff_bits2=backoff_bits2, sub_all=sub_all,
+                payload_bits=payload_bits, gossip_bits=gossip_bits,
+                accept_bits=accept_bits, valid_w=valid_w, tick=tick)
+
+        # behavioral broken-promise detection: a withholding peer's
+        # IHAVE claims ids the receiver doesn't hold (the reference
+        # attack advertises bogus ids, gossipsub_spam_test.go:135); the
+        # receiver IWANTs what it lacks, nothing arrives, and it counts
+        # one P7 unit for the edge that tick (gossip_tracer.go:48-153 +
+        # applyIwantPenalties) — derived from traffic, not the flag
+        cheat_src = (jnp.where(withhold, targets, Z)
+                     if withhold is not None else None)
+        broken_add = [None] * C
+        lack_any = None
+        if cheat_src is not None:
+            # the receiver lacks SOME advertised id (bogus ids lie
+            # outside its possession set almost surely)
+            lack_any = jnp.zeros((n,), dtype=bool)
+            for w in range(W):
+                lack_any = lack_any | ((~seen[w]) != 0)
 
         # Columns are independent: every same-tick deliverer of a new
         # message gets delivery credit (the reference's near-first window
         # covers simultaneous copies, score.go:684-818; with one tick =
         # one heartbeat, same-tick ties ARE the window — and crediting all
         # of them avoids biasing credit by candidate-bit order).
-        combined = C <= 16 and (sc is None or not sc.track_p3)
+        # force_split pins the split loops for equivalence testing: the
+        # two formulations must produce identical possession/mesh
+        # trajectories (credit-policy differences are documented above).
+        combined = (C <= 16 and (sc is None or not sc.track_p3)
+                    and not force_split)
         if combined:
             # -- 2+3 fused: ONE roll per edge carries the eager-forward,
             # flood-publish, AND lazy-gossip payloads.  The receiver-side
@@ -851,9 +1215,9 @@ def make_gossip_step(cfg: GossipSimConfig,
             # (news vs seen|mesh_heard); here both deliverers are
             # credited, uniformly extending the documented all-same-tick-
             # deliverers P2/P4 policy (module docstring, Known deviation).
-            send_gsp = targets
-            if sc is not None and sc.sybil_ihave_spam:
-                send_gsp = jnp.where(params.sybil, Z, send_gsp)
+            send_gsp = (targets if withhold is None
+                        else jnp.where(withhold, Z, targets))
+            send_cheat = cheat_src
             if sc is not None:
                 packed = (payload_bits
                           | ((payload_bits & gossip_bits)
@@ -861,6 +1225,11 @@ def make_gossip_step(cfg: GossipSimConfig,
                 gate_recv = transfer_bits(packed, cfg, pair=True)
                 send_fwd = out_bits & gate_recv
                 send_gsp = send_gsp & (gate_recv >> jnp.uint32(16))
+                if send_cheat is not None:
+                    # the receiver only IWANTs (and so only records a
+                    # broken promise for) adverts it accepts: same
+                    # gossip-threshold gate as real gossip
+                    send_cheat = send_cheat & (gate_recv >> jnp.uint32(16))
                 send_flood = (flood_bits & gate_recv
                               if flood_bits is not None else None)
             else:
@@ -886,6 +1255,10 @@ def make_gossip_step(cfg: GossipSimConfig,
                         # the seen-cache, pubsub.go:851-868)
                         fd_j = acc(fd_j, pc(news & valid_w[w]))
                         iv_j = acc(iv_j, pc(news & ~valid_w[w]))
+                if send_cheat is not None:
+                    got_cheat = jnp.roll(bit_row(send_cheat, c_send),
+                                         off, axis=0)
+                    broken_add[j] = got_cheat & lack_any
                 fd_add[j], inv_add[j] = fd_j, iv_j
             new_heard_bits = [jnp.where(sub, hw, Z) for hw in heard]
         else:
@@ -924,8 +1297,8 @@ def make_gossip_step(cfg: GossipSimConfig,
             for c_send, off in enumerate(offsets):
                 j = cinv[c_send]
                 send_mask = bit_row(targets, c_send)
-                if sc is not None and sc.sybil_ihave_spam:
-                    send_mask = send_mask & ~params.sybil
+                if withhold is not None:
+                    send_mask = send_mask & ~withhold
                 ok_j = None
                 if sc is not None:
                     ok_j = bit_row(payload_bits & gossip_bits, j)
@@ -941,6 +1314,12 @@ def make_gossip_step(cfg: GossipSimConfig,
                         # like any other delivery: P2 valid, P4 invalid
                         fd_add[j] = fd_add[j] + pc(news & valid_w[w])
                         inv_add[j] = inv_add[j] + pc(news & ~valid_w[w])
+                if cheat_src is not None:
+                    got_cheat = jnp.roll(bit_row(cheat_src, c_send),
+                                         off, axis=0)
+                    if ok_j is not None:
+                        got_cheat = got_cheat & ok_j
+                    broken_add[j] = got_cheat & lack_any
             new_heard_bits = [
                 jnp.where(sub, mesh_heard[w] | gossip_heard[w], Z)
                 for w in range(W)]
@@ -959,102 +1338,7 @@ def make_gossip_step(cfg: GossipSimConfig,
         first_tick = update_first_tick(state.first_tick, delivered_now,
                                        tick)
 
-        # -- 4. heartbeat maintenance -----------------------------------
-        mesh, backoff = state.mesh, state.backoff
-        mesh_before = mesh
-
-        if sc is not None:
-            # drop negative-score mesh members first (gossipsub.go:1332)
-            neg = mesh & ~nonneg_bits
-            mesh = mesh & nonneg_bits
-        else:
-            neg = None
-        deg = popcount32(mesh)                                  # [N]
-
-        # graft up to D when deg < Dlo (gossipsub.go:1340-1360);
-        # candidates need score >= 0 in v1.1.  in_backoff is the only
-        # per-edge numeric state: pack the comparison once.
-        backoff_bits = pack_rows(backoff > tick)
-        can_graft = (params.cand_sub_bits & ~mesh & ~backoff_bits
-                     & sub_all)
-        if params.flood_proto is not None:
-            # floodsub-protocol peers have no mesh: never graft at them,
-            # and they graft at nobody
-            can_graft = can_graft & ~params.cand_flood_bits
-            can_graft = jnp.where(params.flood_proto, Z, can_graft)
-        if sc is not None:
-            can_graft = can_graft & nonneg_bits
-        need = jnp.where(deg < cfg.d_lo, cfg.d - deg, 0)
-        grafts = jax.lax.cond(
-            jnp.any(need > 0),
-            lambda: sel_k(can_graft, need, u_spec(2)),
-            lambda: jnp.zeros_like(mesh))
-
-        # prune down to D when deg > Dhi.  v1.0: random retention; v1.1:
-        # keep the Dscore best by score, then at least Dout outbound,
-        # random fill to D (anti-sybil bubble-up, gossipsub.go:1376-1435).
-        over = deg > cfg.d_hi
-
-        def compute_prunes():
-            if sc is None:
-                keep = sel_k(mesh, jnp.full_like(deg, cfg.d), u_spec(3))
-            else:
-                rnd = lane_uniform((C, n), tick, 3, salt)
-                top = select_k_by_priority_bits(
-                    mesh, score, jnp.full_like(deg, cfg.d_score),
-                    tiebreak=rnd)
-                n_out_top = popcount32(top & OUT_MASK)
-                need_out = jnp.maximum(0, cfg.d_out - n_out_top)
-                out_keep = select_k_by_priority_bits(
-                    mesh & ~top & OUT_MASK, rnd, need_out)
-                taken = top | out_keep
-                n_taken = popcount32(taken)
-                fill = select_k_by_priority_bits(
-                    mesh & ~taken, rnd, jnp.maximum(cfg.d - n_taken, 0))
-                keep = taken | fill
-            return mesh & ~keep & jnp.where(over, ALL, Z)
-
-        prunes = jax.lax.cond(jnp.any(over), compute_prunes,
-                              lambda: jnp.zeros_like(mesh))
-
-        if sc is not None:
-            # opportunistic grafting: when the mesh's median score sags
-            # below the threshold, graft extra high-scoring peers
-            # (gossipsub.go:1467-1498).  Runs 1-in-opportunistic_graft_
-            # ticks, so the median rank-compare sits under the cond too.
-            do_og = (tick % sc.opportunistic_graft_ticks) == 0
-
-            def compute_og():
-                # median = the mesh bit at ascending rank deg//2 =
-                # descending rank C-1-deg//2 (non-mesh bits pinned to
-                # +inf rank first); rank-compare instead of a sort
-                in_mesh = expand_bits(mesh, C)
-                mesh_rank = ranks_desc(jnp.where(in_mesh, score, jnp.inf))
-                med_pick = in_mesh & (mesh_rank
-                                      == (C - 1 - deg // 2)[None, :])
-                median = jnp.where(
-                    deg > 0, jnp.where(med_pick, score, 0.0).sum(0), 0.0)
-                og_row = (median < sc.opportunistic_graft_threshold) & sub
-                og_elig = (can_graft & ~grafts
-                           & pack_rows(score > median[None, :]))
-                og_need = jnp.where(og_row, sc.opportunistic_graft_peers,
-                                    0)
-                return sel_k(og_elig, og_need, u_spec(5))
-
-            grafts = grafts | jax.lax.cond(
-                do_og, compute_og, lambda: jnp.zeros_like(mesh))
-
-        if sc is not None and sc.sybil_graft_flood:
-            # GRAFT-flooding sybils re-graft every tick, ignoring their
-            # own backoff (gossipsub_spam_test.go:349)
-            grafts = jnp.where(params.sybil,
-                               params.cand_sub_bits & ~mesh, grafts)
-
-        mesh = (mesh | grafts) & ~prunes
-        dropped = prunes if neg is None else prunes | neg
-        # (backoff writes for dropped edges land in the single row-wise
-        # backoff pass of section 5, fused with the handshake's)
-
+        # -- 4. apply maintenance + handshake (XLA transfer path) -------
         # handshake: partner accepts GRAFT unless unsubscribed, backed
         # off, or (v1.1) negative-scored (handleGraft gossipsub.go:713-
         # 804); PRUNE always removes + backs off (handlePrune :806-838).
@@ -1068,17 +1352,7 @@ def make_gossip_step(cfg: GossipSimConfig,
         # so the grafter keeps exactly the edges the old explicit
         # reject-back retraction kept — bit-identical, one transfer round
         # (C rolls) and one serial dependency shorter.
-        backoff_bits2 = backoff_bits | dropped  # post-write backoff bits,
-        # derived algebraically (the only edges whose backoff changed are
-        # prunes|neg, all set beyond tick) — saves a second [C, N] reduce
-        would_accept = sub_all & ~backoff_bits2
-        if params.flood_proto is not None:
-            would_accept = jnp.where(params.flood_proto, Z, would_accept)
-        if sc is not None:
-            would_accept = would_accept & nonneg_bits
-            a_sent = would_accept | ~accept_bits
-        else:
-            a_sent = would_accept
+        mesh = mesh_sel
         if C <= 16:
             # GRAFT+PRUNE masks ride one pair-packed transfer, the
             # A mask a second (2C rolls total; was 3C with reject-back)
@@ -1147,8 +1421,14 @@ def make_gossip_step(cfg: GossipSimConfig,
             # P7: backoff violations + broken gossip promises
             bp = f32(s0.behaviour_penalty) + expand_bits(
                 backoff_violation, C).astype(jnp.float32)
-            if bp_spam_bits is not None:
-                bp = bp + expand_bits(bp_spam_bits, C).astype(jnp.float32)
+            if cheat_src is not None:
+                # one P7 unit per edge per tick with >= 1 broken promise
+                # (applyIwantPenalties adds per-peer counts once per
+                # heartbeat; magnitudes calibrated the same way)
+                broken = jnp.stack(
+                    [jnp.zeros((n,), dtype=bool) if broken_add[j] is None
+                     else broken_add[j] != 0 for j in range(C)])
+                bp = bp + broken.astype(jnp.float32)
 
             # decay (refreshScores, score.go:495-556); storage may be
             # bf16 — the math runs f32, the write casts back
@@ -1176,7 +1456,7 @@ def make_gossip_step(cfg: GossipSimConfig,
         new_state = GossipState(
             mesh=mesh, fanout=fanout, last_pub=last_pub, backoff=backoff,
             have=have, recent=recent, first_tick=first_tick, scores=scores,
-            key=state.key, tick=tick + 1)
+            key=state.key, tick=tick + 1, iwant_serves=iwant_serves)
         return new_state, delivered_now
 
     return step
@@ -1261,6 +1541,15 @@ def reach_counts_from_have(params: GossipParams, state: GossipState,
 
 def mesh_degrees(state: GossipState) -> jnp.ndarray:
     return popcount32(state.mesh)
+
+
+def iwant_serve_level(state: GossipState) -> jnp.ndarray:
+    """Per-victim outstanding IWANT retransmission load [N] (sum of the
+    per-edge served counters).  With the cutoff active this is bounded
+    by C * gossip_retransmission * window_ids regardless of flood
+    pressure (TestGossipsubAttackSpamIWANT's assertion,
+    gossipsub_spam_test.go:24)."""
+    return state.iwant_serves.astype(jnp.int32).sum(axis=0)
 
 
 def mesh_symmetry_fraction(state: GossipState,

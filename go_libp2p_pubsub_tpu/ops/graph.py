@@ -208,7 +208,8 @@ def lane_seed(tick: jnp.ndarray, phase: int,
 
 
 def lane_uniform(shape: tuple[int, ...], tick: jnp.ndarray, phase: int,
-                 salt: jnp.ndarray) -> jnp.ndarray:
+                 salt: jnp.ndarray, stride: int | None = None
+                 ) -> jnp.ndarray:
     """Stateless per-lane uniforms in [0, 1): f32 ``shape`` array hashed
     from (lane index, tick, phase, salt).
 
@@ -218,10 +219,22 @@ def lane_uniform(shape: tuple[int, ...], tick: jnp.ndarray, phase: int,
     elementwise phase of the step; a finalizer-hash per lane is free (it
     fuses) and statistically ample for sampling decisions.  ``phase``
     decorrelates draws within a tick; ``salt`` carries the run seed.
+
+    ``stride`` overrides the row stride of the 2-D lane numbering
+    (lane = row * stride + col; default = shape[-1], the flat row-major
+    order).  Peer-axis-padded sims pass the TRUE peer count so real
+    peers draw the same stream as the unpadded formulation — padded
+    lanes then alias real ones, which is harmless since pad peers'
+    draws are never acted on.
     """
     seed = lane_seed(tick, phase, salt)
-    total = int(np.prod(shape))
-    lane = jax.lax.iota(jnp.uint32, total).reshape(shape)
+    if stride is None or len(shape) != 2:
+        total = int(np.prod(shape))
+        lane = jax.lax.iota(jnp.uint32, total).reshape(shape)
+    else:
+        lane = (jax.lax.broadcasted_iota(jnp.uint32, shape, 0)
+                * jnp.uint32(stride)
+                + jax.lax.broadcasted_iota(jnp.uint32, shape, 1))
     h = _fmix32(lane ^ seed)
     return (h >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(1 / (1 << 24))
 
@@ -268,8 +281,10 @@ def select_k_bits(elig_bits: jnp.ndarray, k: jnp.ndarray,
     the kernel so the field fuses into the rank compare instead of being
     materialized.  Returns a packed uint32 [N] mask."""
     if isinstance(rand, tuple):
-        c, tick, phase, salt = rand
-        rand = lane_uniform((c, elig_bits.shape[0]), tick, phase, salt)
+        c, tick, phase, salt = rand[:4]
+        stride = rand[4] if len(rand) > 4 else None
+        rand = lane_uniform((c, elig_bits.shape[0]), tick, phase, salt,
+                            stride=stride)
     c = rand.shape[0]
     elig = expand_bits(elig_bits, c)
     prio = jnp.where(elig, rand, -1.0)

@@ -1,0 +1,404 @@
+"""Pallas TPU mega-kernel for the GossipSub heartbeat's receive half.
+
+One kernel invocation per tick replaces the step's entire inter-peer
+exchange and per-edge state update (models/gossipsub.py combined path):
+
+- payload receive: for each of the C candidate edges, read the SENDER's
+  fresh/advertised words through a shifted view of a wrap-extended flat
+  array (the circulant edge (p, p+o_j) needs index (p+o_j) mod N — a
+  static-offset view, no gather, no materialized rolled copies);
+- per-edge receiver gating (graylist/gater payload gate, gossip
+  threshold — AcceptFrom gossipsub.go:584, handleIHave :610);
+- per-edge delivery provenance: popcounts of new valid/invalid words
+  feed the P2/P4 counters (score.go:684-818) without ever materializing
+  [C, N] int stacks in HBM;
+- the GRAFT/PRUNE/A-mask handshake (handleGraft/handlePrune
+  gossipsub.go:713-838) from the same views, plus the mesh and backoff
+  writes;
+- the counter decay pass (refreshScores score.go:495-556).
+
+Everything a peer block needs lives in VMEM for the whole tick: the
+[C, B] counter blocks stream through HBM exactly once (the XLA form
+re-read them for stacks, converts, and decay passes).
+
+Why the wrap-extension: Mosaic DMA slice starts must be tile-aligned
+(1024 elements for u32, 4096 for u8), and ``(i*B + o) mod N`` is not.
+The sender arrays are laid out as ``T[k] = S[(k - P) mod N]`` for
+k in [0, N_pad + 2P): every view start becomes ``i*B + P + o`` which
+splits into an aligned base plus a static in-VMEM lane-roll remainder
+(Mosaic can't roll 1-D vectors, so the remainder roll runs on a
+(1, L) reshape).
+
+The kernel is semantically identical to the XLA combined path (same op
+order, so counter bits match exactly); tests pin kernel==XLA
+trajectories on shared seeds.  It is single-device only (no GSPMD
+partitioning rule) — sharded runs keep the XLA form.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ALIGN32 = 1024     # u32 1-D DMA slice alignment (8 x 128 tile)
+ALIGN8 = 4096      # u8 alignment (32 x 128 tile)
+
+# ctrl byte layout: per sender edge bit c, one byte packing the six
+# sender-side masks the receiver on that edge needs
+CTRL_OUT = 0       # eager-forward member (mesh | fanout)
+CTRL_TGT = 1       # lazy-gossip target (delivering, i.e. non-spam)
+CTRL_GRAFT = 2     # GRAFT sent
+CTRL_DROP = 3      # PRUNE sent (prunes | negative-score drops)
+CTRL_A = 4         # "no PRUNE would come back" (would-accept | silent)
+CTRL_ADV = 5       # raw IHAVE advert (incl. withheld promises);
+#                    CTRL_TGT is the DELIVERING advert, so
+#                    ADV & ~TGT marks a broken promise behaviorally
+
+
+def _align_up(x: int, a: int) -> int:
+    return ((x + a - 1) // a) * a
+
+
+def plan(n_true: int, offsets, block: int):
+    """Static layout plan shared by the kernel and its XLA composer.
+
+    Each view DMA fetches [start, start + B + ALIGN) with
+    start = i*B + p + o - delta, so the wrap-extended array needs an
+    extra ALIGN of slack past n_pad + 2p: when max|o| is itself aligned
+    (delta = 0) the fetch otherwise runs exactly ALIGN past the end."""
+    n_pad = _align_up(n_true, block)
+    p32 = _align_up(max(abs(int(o)) for o in offsets), ALIGN32)
+    p8 = _align_up(p32, ALIGN8)
+    return dict(n_pad=n_pad, p32=p32, p8=p8,
+                l32=n_pad + 2 * p32 + ALIGN32,
+                l8=n_pad + 2 * p8 + ALIGN8,
+                grid=n_pad // block)
+
+
+def extend_wrap(row: jnp.ndarray, n_true: int, n_pad: int,
+                p: int, extra: int) -> jnp.ndarray:
+    """[>=n] -> [n_pad + 2p + extra] with T[k] = row[(k - p) mod n].
+
+    Built from whole-row copies + one static slice so it lowers to
+    concatenates (no gather) for any p/n ratio — the alignment padding
+    p can exceed n for small sims."""
+    row = row[:n_true]
+    length = n_pad + 2 * p + extra
+    start = (-p) % n_true
+    reps = -(-(start + length) // n_true)
+    big = jnp.concatenate([row] * reps) if reps > 1 else row
+    return big[start:start + length]
+
+
+def _flat_roll(vec: jnp.ndarray, delta: int, take: int) -> jnp.ndarray:
+    """vec[delta:delta+take] for arbitrary (unaligned) static delta:
+    1-row lane roll, then an aligned static slice."""
+    if delta == 0:
+        return vec[:take]
+    ln = vec.shape[0]
+    r = pltpu.roll(vec.reshape(1, ln), ln - delta, 1)
+    return r.reshape(ln)[:take]
+
+
+def _expand(word: jnp.ndarray, c: int) -> jnp.ndarray:
+    """packed u32 [B] -> bool [C, B]."""
+    cidx = jax.lax.broadcasted_iota(jnp.uint32, (c, word.shape[0]), 0)
+    return ((word[None, :] >> cidx) & jnp.uint32(1)) != 0
+
+
+def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
+                    counter_dtype, track_promises):
+    C = cfg.n_candidates
+    B = block
+    cinv = cfg.cinv
+    offsets = [int(o) for o in cfg.offsets]
+    pln = plan(n_true, offsets, block)
+    p32, p8 = pln["p32"], pln["p8"]
+    has_sc = sc is not None
+    W = w_words
+    Z = jnp.uint32(0)
+    u1 = jnp.uint32(1)
+
+    it = iter(refs)
+    nxt = lambda: next(it)  # noqa: E731
+    valid_ref = nxt() if has_sc else None
+    tickb_ref = nxt()
+    ctrl_hbm = nxt()
+    fresh_hbm = nxt()
+    adv_hbm = nxt()
+    pay_ref = nxt() if has_sc else None
+    gsp_ref = nxt() if has_sc else None
+    acc_ref = nxt() if has_sc else None
+    sub_ref = nxt()
+    wa_ref = nxt()
+    bo2_ref = nxt()
+    graft_ref = nxt()
+    drop_ref = nxt()
+    meshsel_ref = nxt()
+    seen_ref = nxt()
+    inj_ref = nxt()
+    bo_in = nxt()
+    if has_sc:
+        fd_in, inv_in, bp_in, tim_in = nxt(), nxt(), nxt(), nxt()
+    out_acq = nxt()
+    out_mesh = nxt()
+    out_bo = nxt()
+    if has_sc:
+        out_fd, out_inv, out_bp, out_tim = nxt(), nxt(), nxt(), nxt()
+    cbufs = [nxt(), nxt()]
+    # payload buffers: [slot][fresh w... adv w...], all separate 1-D
+    # scratches (DMA into a row of a 2-D VMEM buffer hits sublane
+    # alignment limits)
+    pbufs = [[nxt() for _ in range(2 * W)] for _ in range(2)]
+    sems = nxt()
+
+    i = pl.program_id(0)
+    c_deltas = [o % ALIGN8 for o in offsets]
+    c_bases = [p8 + o - d for o, d in zip(offsets, c_deltas)]
+    p_deltas = [o % ALIGN32 for o in offsets]
+    p_bases = [p32 + o - d for o, d in zip(offsets, p_deltas)]
+    lc, lp = pln["l8"], pln["l32"]
+
+    def dma_ctrl(slot, j):
+        start = cinv[j] * lc + i * B + c_bases[j]
+        return pltpu.make_async_copy(
+            ctrl_hbm.at[pl.ds(start, B + ALIGN8)], cbufs[slot],
+            sems.at[slot])
+
+    def dma_pay(slot, j, k, w):
+        hbm = fresh_hbm if k == 0 else adv_hbm
+        start = w * lp + i * B + p_bases[j]
+        return pltpu.make_async_copy(
+            hbm.at[pl.ds(start, B + ALIGN32)],
+            pbufs[slot][k * W + w],
+            sems.at[2 + slot * 2 * W + k * W + w])
+
+    def start_all(slot, j):
+        dma_ctrl(slot, j).start()
+        for w in range(W):
+            dma_pay(slot, j, 0, w).start()
+            dma_pay(slot, j, 1, w).start()
+
+    def wait_all(slot, j):
+        dma_ctrl(slot, j).wait()
+        for w in range(W):
+            dma_pay(slot, j, 0, w).wait()
+            dma_pay(slot, j, 1, w).wait()
+
+    start_all(0, 0)
+
+    sub_all = sub_ref[...]
+    if has_sc:
+        pay_bits = pay_ref[...]
+        gsp_bits = gsp_ref[...]
+        valid = [valid_ref[w] for w in range(W)]
+    seen_a = seen_ref[...]
+    seen = [seen_a[w] for w in range(W)]
+
+    heard = [jnp.zeros((B,), jnp.uint32) for _ in range(W)]
+    fd_cnt = [None] * C
+    inv_cnt = [None] * C
+    graft_recv = jnp.zeros((B,), jnp.uint32)
+    prune_recv = jnp.zeros((B,), jnp.uint32)
+    a_recv = jnp.zeros((B,), jnp.uint32)
+    broken_recv = jnp.zeros((B,), jnp.uint32)
+
+    for j in range(C):
+        if j + 1 < C:
+            start_all((j + 1) % 2, j + 1)
+        wait_all(j % 2, j)
+        slot = j % 2
+        # widen BEFORE the realign roll: mosaic has no i8 lane-rotate
+        ctrl = _flat_roll(cbufs[slot][...].astype(jnp.uint32),
+                          c_deltas[j], B)
+        m_f = (ctrl >> jnp.uint32(CTRL_OUT)) & u1
+        m_g = (ctrl >> jnp.uint32(CTRL_TGT)) & u1
+        g_r = (ctrl >> jnp.uint32(CTRL_GRAFT)) & u1
+        d_r = (ctrl >> jnp.uint32(CTRL_DROP)) & u1
+        a_r = (ctrl >> jnp.uint32(CTRL_A)) & u1
+        adv_r = (ctrl >> jnp.uint32(CTRL_ADV)) & u1
+        graft_recv = graft_recv | (g_r << jnp.uint32(j))
+        prune_recv = prune_recv | (d_r << jnp.uint32(j))
+        a_recv = a_recv | (a_r << jnp.uint32(j))
+
+        fwd_on = m_f != 0
+        gsp_on = m_g != 0
+        if has_sc:
+            ok_p = ((pay_bits >> jnp.uint32(j)) & u1) != 0
+            ok_g = ok_p & (((gsp_bits >> jnp.uint32(j)) & u1) != 0)
+            fwd_on = fwd_on & ok_p
+            gsp_on = gsp_on & ok_g
+        fd_j = iv_j = None
+        for w in range(W):
+            fresh_q = _flat_roll(pbufs[slot][w][...], p_deltas[j], B)
+            adv_q = _flat_roll(pbufs[slot][W + w][...], p_deltas[j], B)
+            got = (jnp.where(fwd_on, fresh_q, Z)
+                   | jnp.where(gsp_on, adv_q, Z))
+            news = got & ~seen[w]
+            heard[w] = heard[w] | news
+            if has_sc:
+                # popcount yields u32; mosaic can't cast u32->f32, so
+                # counts go to i32 immediately
+                nv = jax.lax.population_count(
+                    news & valid[w]).astype(jnp.int32)
+                ni = jax.lax.population_count(
+                    news & ~valid[w]).astype(jnp.int32)
+                fd_j = nv if fd_j is None else fd_j + nv
+                iv_j = ni if iv_j is None else iv_j + ni
+        fd_cnt[j], inv_cnt[j] = fd_j, iv_j
+        if track_promises:
+            # behavioral broken promise: advertised (ADV), not
+            # delivering (~TGT), receiver accepts the IHAVE (gossip
+            # gate) and lacks some claimed id (bogus ids lie outside
+            # its possession set) — gossip_tracer.go:48-153
+            okg_u = jnp.where(ok_g, u1, Z)  # receiver gossip gate (NOT
+            #   gsp_on: a withholding sender has the deliver bit clear)
+            lacked = jnp.uint32(0)
+            for w in range(W):
+                lacked = lacked | jnp.where((~seen[w]) != 0, u1, Z)
+            broken_recv = broken_recv | (
+                (adv_r & (u1 ^ m_g) & okg_u & lacked) << jnp.uint32(j))
+
+    if has_sc:
+        accb = acc_ref[...]
+        graft_recv = graft_recv & accb
+        prune_recv = prune_recv & accb
+    wa = wa_ref[...]
+    bo2 = bo2_ref[...]
+    grafts = graft_ref[...]
+    dropped = drop_ref[...]
+    viol = graft_recv & bo2
+    accept = graft_recv & wa
+    retract = grafts & ~a_recv
+    mesh = ((meshsel_ref[...] | accept) & ~prune_recv) & ~retract
+    out_mesh[...] = mesh
+    bo_trig = dropped | prune_recv | retract
+
+    tick_b = tickb_ref[0]
+    inj_a = inj_ref[...]
+    # sub_all is the C-bit candidate gate (ALL or 0); for MESSAGE words
+    # it must act as a full-word predicate, not a bitmask
+    subbed = sub_all != 0
+    out_acq[...] = jnp.stack(
+        [jnp.where(subbed, heard[w], jnp.uint32(0)) | inj_a[w]
+         for w in range(W)])
+    out_bo[...] = jnp.where(_expand(bo_trig, C), tick_b, bo_in[...])
+
+    if has_sc:
+        cdt = counter_dtype
+        f32 = lambda x: x.astype(jnp.float32)  # noqa: E731
+
+        def dk(x, decay, dtype=cdt):
+            x = x * decay
+            return jnp.where(x < sc.decay_to_zero, 0.0, x).astype(dtype)
+
+        in_mesh = _expand(mesh, C)
+        # min/compare in i32: mosaic lacks 16-bit minsi
+        tim32 = tim_in[...].astype(jnp.int32)
+        out_tim[...] = jnp.where(
+            in_mesh, jnp.minimum(tim32 + 1, 32766),
+            0).astype(jnp.int16)
+        zrow = jnp.zeros((B,), jnp.int32)
+        fd_stack = jnp.stack(
+            [zrow if r is None else r for r in fd_cnt]).astype(
+            jnp.float32)
+        iv_stack = jnp.stack(
+            [zrow if r is None else r for r in inv_cnt]).astype(
+            jnp.float32)
+        fd = jnp.minimum(f32(fd_in[...]) + fd_stack,
+                         sc.first_message_deliveries_cap)
+        out_fd[...] = dk(fd, sc.first_message_deliveries_decay)
+        out_inv[...] = dk(f32(inv_in[...]) + iv_stack,
+                          sc.invalid_message_deliveries_decay)
+        bp = f32(bp_in[...]) + _expand(viol, C).astype(jnp.float32)
+        if track_promises:
+            bp = bp + _expand(broken_recv, C).astype(jnp.float32)
+        out_bp[...] = dk(bp, sc.behaviour_penalty_decay,
+                         dtype=jnp.float32)
+
+
+def make_receive_update(cfg, sc, n_true: int, block: int,
+                        counter_dtype, w_words: int,
+                        track_promises: bool = False,
+                        interpret: bool = False):
+    """Build the kernel caller.
+
+    Operand order (args): [valid u32 [W] (sc only)], tick_b i32 [1],
+    ctrl_flat u8 [C*L8], fresh_flat u32 [W*L32], adv_flat u32 [W*L32],
+    [pay, gsp, acc u32 [N_pad] (sc only)], sub, wa, bo2, grafts,
+    dropped, meshsel u32 [N_pad], seen u32 [W, N_pad], injected
+    [W, N_pad], backoff i32 [C, N_pad], [fd, inv (counter_dtype), bp
+    f32, tim i16 [C, N_pad] (sc only)].
+
+    Returns (new_acq [W, N_pad], mesh [N_pad], backoff [C, N_pad]
+    [, fd, inv, bp, tim]).
+    """
+    C = cfg.n_candidates
+    has_sc = sc is not None
+    pln = plan(n_true, cfg.offsets, block)
+    n_pad, grid = pln["n_pad"], pln["grid"]
+    B = block
+    W = w_words
+
+    kern = functools.partial(
+        _receive_kernel, cfg=cfg, sc=sc, block=block, n_true=n_true,
+        w_words=w_words, counter_dtype=counter_dtype,
+        track_promises=track_promises)
+
+    b1 = lambda: pl.BlockSpec((B,), lambda i: (i,))  # noqa: E731
+    bw = lambda: pl.BlockSpec((W, B), lambda i: (0, i))  # noqa: E731
+    bc = lambda: pl.BlockSpec((C, B), lambda i: (0, i))  # noqa: E731
+
+    in_specs = []
+    if has_sc:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))  # valid
+    in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))      # tick_b
+    in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * 3      # flats
+    if has_sc:
+        in_specs += [b1(), b1(), b1()]        # pay, gsp, acc
+    in_specs += [b1()] * 6    # sub, wa, bo2, grafts, dropped, meshsel
+    in_specs += [bw(), bw()]                  # seen, injected
+    in_specs += [bc()]                        # backoff in
+    if has_sc:
+        in_specs += [bc()] * 4                # fd, inv, bp, tim
+
+    out_shape = [
+        jax.ShapeDtypeStruct((W, n_pad), jnp.uint32),   # new_acq
+        jax.ShapeDtypeStruct((n_pad,), jnp.uint32),     # mesh
+        jax.ShapeDtypeStruct((C, n_pad), jnp.int32),    # backoff
+    ]
+    out_specs = [bw(), b1(), bc()]
+    if has_sc:
+        out_shape += [
+            jax.ShapeDtypeStruct((C, n_pad), counter_dtype),  # fd
+            jax.ShapeDtypeStruct((C, n_pad), counter_dtype),  # inv
+            jax.ShapeDtypeStruct((C, n_pad), jnp.float32),    # bp
+            jax.ShapeDtypeStruct((C, n_pad), jnp.int16),      # tim
+        ]
+        out_specs += [bc()] * 4
+
+    scratch = (
+        [pltpu.VMEM((B + ALIGN8,), jnp.uint8)] * 2
+        + [pltpu.VMEM((B + ALIGN32,), jnp.uint32)] * (4 * W)
+        + [pltpu.SemaphoreType.DMA((2 + 4 * W,))]
+    )
+
+    return pl.pallas_call(
+        kern,
+        out_shape=tuple(out_shape),
+        grid=(grid,),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs),
+        scratch_shapes=scratch,
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            # the default 16 MiB scoped-vmem budget is just short of the
+            # double-buffered [C, B] counter blocks at B=8192; v5e has
+            # headroom above it
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+    )

@@ -36,7 +36,8 @@ def _fmix32(x):
     return x
 
 
-def _select_kernel(seed_ref, elig_ref, k_ref, out_ref, *, c: int, n: int):
+def _select_kernel(seed_ref, elig_ref, k_ref, out_ref, *, c: int,
+                   n: int):
     block = out_ref.shape[-1]
     bits = elig_ref[...].reshape(1, block)          # [1, B] uint32
     k = k_ref[...].reshape(1, block)                # [1, B] int32
@@ -70,11 +71,12 @@ def _select_kernel(seed_ref, elig_ref, k_ref, out_ref, *, c: int, n: int):
     out_ref[...] = packed.astype(jnp.uint32)
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6))
 def select_k_bits_pallas(elig_bits: jnp.ndarray, k: jnp.ndarray,
                          seed: jnp.ndarray, c: int,
                          block: int = _BLOCK,
-                         interpret: bool = False) -> jnp.ndarray:
+                         interpret: bool = False,
+                         stride: int | None = None) -> jnp.ndarray:
     """Packed top-k selection, pallas formulation.
 
     elig_bits: uint32 [N]; k: int32 [N]; seed: uint32 scalar — the
@@ -89,6 +91,9 @@ def select_k_bits_pallas(elig_bits: jnp.ndarray, k: jnp.ndarray,
     ``interpret=True`` runs it anywhere (CI on CPU).
     """
     n = elig_bits.shape[0]
+    # lane-stream row stride: the TRUE peer count for padded sims
+    # (lane_uniform stride semantics), default the array length
+    lane_n = n if stride is None else stride
     pad = (-n) % block
     out_shape = jax.ShapeDtypeStruct((n + pad,), jnp.uint32)
     if pad:
@@ -99,7 +104,7 @@ def select_k_bits_pallas(elig_bits: jnp.ndarray, k: jnp.ndarray,
         k = jnp.concatenate([k, jnp.zeros((pad,), jnp.int32)])
     grid = ((n + pad) // block,)
     out = pl.pallas_call(
-        functools.partial(_select_kernel, c=c, n=n),
+        functools.partial(_select_kernel, c=c, n=lane_n),
         out_shape=out_shape,
         grid=grid,
         in_specs=[

@@ -153,6 +153,74 @@ def test_ihave_spam_brings_behaviour_penalty():
     assert np.median(score[cand_sybil]) < sc.gossip_threshold
 
 
+def test_unflagged_promise_breaker_accrues_p7():
+    """P7 is derived from advertised-vs-delivered traffic, not from the
+    sybil flag: a STEALTHY spammer (promise_break, not marked sybil)
+    that advertises ids and withholds the payload accrues the same
+    broken-promise penalty (gossip_tracer.go:48-153 + applyIwantPenalties
+    gossipsub.go:1566-1571), while honest peers accrue none."""
+    n, t = 600, 3
+    breaker = np.zeros(n, dtype=bool)
+    breaker[0:60:3] = True
+    cfg, sc, params, state = build(
+        n=n, t=t, n_msgs=4,
+        sim_kw=dict(promise_break=breaker))
+    assert params.sybil is not None and not np.asarray(params.sybil).any()
+    step = make_gossip_step(cfg, sc)
+    out = gossip_run(params, state, 30, step)
+    bp = np.asarray(out.scores.behaviour_penalty)
+    cand_breaker = np.stack(
+        [np.roll(breaker, -o) for o in cfg.offsets])
+    assert bp[cand_breaker].max() > 0.5      # breakers penalized...
+    assert bp[~cand_breaker].max() == 0.0    # ...honest edges never
+    score = np.asarray(compute_scores(sc, params, out))
+    # the worst breaker edges fall below the gossip threshold (ignored)
+    assert score[cand_breaker].min() < sc.gossip_threshold
+
+
+def test_iwant_flood_retransmission_cutoff():
+    """IWANT-flood containment (gossipsub_spam_test.go:24): sybils
+    re-request the full advertised window from every candidate every
+    tick.  The per-edge retransmission budget (mcache.go:66-80,
+    GossipRetransmission) bounds the victim's served load; raising the
+    budget to effectively-unbounded measurably raises it.  Honest
+    dissemination is unaffected either way."""
+    from go_libp2p_pubsub_tpu.models.gossipsub import iwant_serve_level
+
+    n, t = 600, 3
+    sybil = np.zeros(n, dtype=bool)
+    sybil[np.arange(0, 60, 3)] = True
+
+    def run(retrans):
+        # sustained publish stream so the flood reaches steady state
+        cfg, sc, params, state = build(
+            n=n, t=t, n_msgs=28, msgs_per_tick=True,
+            score_kw=dict(sybil_iwant_spam=True),
+            sim_kw=dict(sybil=sybil),
+            gossip_retransmission=retrans)
+        step = make_gossip_step(cfg, sc)
+        out = gossip_run(params, state, 26, step)
+        level = np.asarray(iwant_serve_level(out))
+        serves = np.asarray(out.iwant_serves)
+        out2 = gossip_run(params, out, 14, step)  # let publishes settle
+        reach = np.asarray(reach_counts(params, out2))
+        return cfg, reach, level, serves
+
+    cfg, reach_c, level_c, serves_c = run(3)
+    _, reach_u, level_u, serves_u = run(1000)
+    # honest traffic delivered fully in both runs
+    assert (reach_c == n // t).all() and (reach_u == n // t).all()
+    # the cutoff bounds each edge's served budget: <= (retrans + 1)
+    # window loads (the counter can overshoot by one request batch)
+    assert serves_c.max() <= 4 * 32
+    # and the steady victim-side load is measurably below the uncapped
+    # flood (analysis: capped rate = retrans/history_length = 3/5)
+    assert level_c.max() > 0
+    assert level_c.sum() < 0.8 * level_u.sum(), (
+        level_c.sum(), level_u.sum())
+
+
+
 def test_graft_flood_penalized_and_rejected():
     """Backoff-violating GRAFT flooders never enter honest meshes and
     accumulate P7 (gossipsub_spam_test.go:349, gossipsub.go:747-765)."""

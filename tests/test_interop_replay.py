@@ -275,8 +275,6 @@ def test_randomsub_core_vs_sim_reach_curves():
     n, M = 40, 24
     rng = np.random.default_rng(5)
     publishers = list(rng.integers(0, n, M))
-    run = run_core_randomsub(n, publishers, settle_s=1.0)
-    core_mean = mean_reach_fraction(reach_by_hops_from_trace(run, 10), n)
 
     cfg = rs.RandomSubSimConfig(
         offsets=rs.make_randomsub_offsets(1, 8, n, seed=0), n_topics=1)
@@ -288,6 +286,18 @@ def test_randomsub_core_vs_sim_reach_curves():
                            rs.make_randomsub_dense_step(cfg))
     sim_mean = mean_reach_fraction(
         np.asarray(rs.reach_by_hops(params, out, 9)), n)
-    delta = np.abs(core_mean[1:10] - sim_mean)
-    assert delta.max() < 0.07, (delta.max(), core_mean, sim_mean)
-    assert core_mean[-1] == 1.0 and sim_mean[-1] == 1.0
+    assert sim_mean[-1] == 1.0
+
+    # retry-once on envelope breach: machine load can cut the cluster's
+    # settle window short (same policy as the gossipsub curve gates)
+    last = None
+    for settle_s in (1.0, 2.0):
+        run = run_core_randomsub(n, publishers, settle_s=settle_s)
+        core_mean = mean_reach_fraction(
+            reach_by_hops_from_trace(run, 10), n)
+        delta = np.abs(core_mean[1:10] - sim_mean)
+        last = (delta.max(), core_mean, sim_mean)
+        if delta.max() < 0.07 and core_mean[-1] == 1.0:
+            break
+    else:
+        raise AssertionError(f"envelope breach after retry: {last}")

@@ -1,0 +1,133 @@
+"""The pallas receive/update mega-kernel vs the XLA transfer path.
+
+Both paths implement the SAME tick (models/gossipsub.py docstring):
+identical uniforms (counter-based lane hash), identical op order in the
+counter updates — so entire state trajectories must match bit-for-bit,
+padding or not.  Runs the kernel in interpreter mode so CI needs no TPU
+(the mosaic lowering itself is exercised by the bench on hardware).
+"""
+
+import numpy as np
+import pytest
+
+import go_libp2p_pubsub_tpu.models.gossipsub as gs
+
+
+def _build(n, n_topics, C, m, *, score, sybil_frac=0.0, spam=False,
+           graft_flood=False, invalid_frac=0.0, breaker_frac=0.0,
+           pad_block=None, seed=3):
+    rng = np.random.default_rng(seed)
+    offsets = gs.make_gossip_offsets(n_topics, C, n, seed=seed)
+    cfg = gs.GossipSimConfig(offsets=offsets, n_topics=n_topics,
+                             d=3, d_lo=2, d_hi=6, d_score=2, d_out=1,
+                             d_lazy=2, gossip_factor=0.25,
+                             backoff_ticks=8)
+    sc = (gs.ScoreSimConfig(sybil_ihave_spam=spam,
+                            sybil_graft_flood=graft_flood)
+          if score else None)
+    idx = np.arange(n)
+    subs = np.zeros((n, n_topics), dtype=bool)
+    subs[idx, idx % n_topics] = True
+    topic = rng.integers(0, n_topics, m)
+    origin = rng.integers(0, n // n_topics, m) * n_topics + topic
+    ticks = np.sort(rng.integers(0, 12, m)).astype(np.int32)
+    kw = {}
+    if score:
+        sybil = rng.random(n) < sybil_frac
+        kw = dict(sybil=sybil,
+                  msg_invalid=rng.random(m) < invalid_frac,
+                  app_score=rng.normal(0, 0.1, n).astype(np.float32))
+        if breaker_frac:
+            kw["promise_break"] = rng.random(n) < breaker_frac
+    params, state = gs.make_gossip_sim(
+        cfg, subs, topic, origin, ticks, score_cfg=sc,
+        pad_to_block=pad_block, **kw)
+    return cfg, sc, params, state
+
+
+def _run_pair(n, n_topics, C, m, n_ticks, block, **kw):
+    cfg, sc, p_x, s_x = _build(n, n_topics, C, m, **kw)
+    cfg2, sc2, p_k, s_k = _build(n, n_topics, C, m, pad_block=block,
+                                 **kw)
+    step_x = gs.make_gossip_step(cfg, sc)
+    step_k = gs.make_gossip_step(cfg2, sc2, receive_block=block,
+                                 receive_interpret=True)
+    out_x = gs.gossip_run(p_x, s_x, n_ticks, step_x)
+    out_k = gs.gossip_run(p_k, s_k, n_ticks, step_k)
+    return cfg, sc, out_x, out_k
+
+
+def _assert_state_equal(out_x, out_k, n, sc):
+    """Kernel trajectory == XLA trajectory on the true peers."""
+    np.testing.assert_array_equal(np.asarray(out_x.mesh),
+                                  np.asarray(out_k.mesh)[:n])
+    np.testing.assert_array_equal(np.asarray(out_x.have),
+                                  np.asarray(out_k.have)[:, :n])
+    np.testing.assert_array_equal(np.asarray(out_x.backoff),
+                                  np.asarray(out_k.backoff)[:, :n])
+    np.testing.assert_array_equal(np.asarray(out_x.fanout),
+                                  np.asarray(out_k.fanout)[:n])
+    np.testing.assert_array_equal(np.asarray(out_x.recent),
+                                  np.asarray(out_k.recent)[:, :, :n])
+    np.testing.assert_array_equal(
+        np.asarray(out_x.first_tick), np.asarray(out_k.first_tick)
+        [:, :, :n])
+    if sc is not None:
+        for f in ("time_in_mesh", "first_deliveries",
+                  "invalid_deliveries", "behaviour_penalty"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(out_x.scores, f)),
+                np.asarray(getattr(out_k.scores, f))[:, :n], err_msg=f)
+
+
+def test_kernel_matches_xla_v10():
+    n = 900
+    cfg, sc, out_x, out_k = _run_pair(n, 4, 8, 8, 30, 128, score=False)
+    _assert_state_equal(out_x, out_k, n, sc)
+    # and the run did something: meshes formed, messages moved
+    assert np.asarray(gs.mesh_degrees(out_x)).mean() > 0
+    assert np.asarray(out_x.have).any()
+
+
+def test_kernel_matches_xla_v11_scored():
+    n = 900
+    cfg, sc, out_x, out_k = _run_pair(n, 4, 8, 8, 30, 128, score=True)
+    _assert_state_equal(out_x, out_k, n, sc)
+    assert np.asarray(out_x.scores.first_deliveries).max() > 0
+
+
+def test_kernel_matches_xla_v11_adversarial():
+    """IHAVE-spam sybils + invalid traffic: the spam/valid gating and
+    broken-promise P7 bookkeeping ride the kernel's ctrl bytes."""
+    n = 640
+    cfg, sc, out_x, out_k = _run_pair(
+        n, 2, 8, 10, 30, 128, score=True, sybil_frac=0.2, spam=True,
+        invalid_frac=0.3)
+    _assert_state_equal(out_x, out_k, n, sc)
+    assert np.asarray(out_x.scores.behaviour_penalty).max() > 0
+
+
+def test_kernel_matches_xla_v11_promise_breakers():
+    """Stealthy (unflagged) promise-breakers: the behavioral P7 rides
+    the kernel's ADV-vs-TGT ctrl bits."""
+    n = 640
+    cfg, sc, out_x, out_k = _run_pair(
+        n, 2, 8, 10, 30, 128, score=True, breaker_frac=0.1)
+    _assert_state_equal(out_x, out_k, n, sc)
+    assert np.asarray(out_x.scores.behaviour_penalty).max() > 0
+
+
+def test_kernel_matches_xla_v11_graft_flood():
+    n = 640
+    cfg, sc, out_x, out_k = _run_pair(
+        n, 2, 8, 6, 30, 128, score=True, sybil_frac=0.15,
+        graft_flood=True)
+    _assert_state_equal(out_x, out_k, n, sc)
+
+
+def test_padded_state_requires_kernel():
+    cfg, sc, params, state = _build(900, 4, 8, 8, score=True,
+                                    pad_block=128)
+    step = gs.make_gossip_step(cfg, sc, use_pallas_receive=False)
+    with pytest.raises(ValueError, match="padded"):
+        step(params, state)

@@ -121,7 +121,7 @@ def bench_randomsub_10k():
 
 
 def _bench_gossip(metric, n, t, score_cfg, sybil=None, gate_honest=False,
-                  baseline=None):
+                  baseline=None, paired=False):
     import jax
     import go_libp2p_pubsub_tpu.models.gossipsub as gs
 
@@ -130,7 +130,8 @@ def _bench_gossip(metric, n, t, score_cfg, sybil=None, gate_honest=False,
     horizon = warmup + T * reps
     rng = np.random.default_rng(0)
     cfg = gs.GossipSimConfig(
-        offsets=gs.make_gossip_offsets(t, C, n, seed=0), n_topics=t)
+        offsets=gs.make_gossip_offsets(t, C, n, seed=0, paired=paired),
+        n_topics=t, paired_topics=paired)
     topic, origin, tick = _msgs(rng, n, t, m, horizon)
     if sybil is not None and gate_honest:
         # honest origins only, so the delivery gate is meaningful
@@ -142,8 +143,12 @@ def _bench_gossip(metric, n, t, score_cfg, sybil=None, gate_honest=False,
     # from the packed possession words) is the delivery gate, so the
     # int16 [W, 32, N] first-tick delivery records stay out of the
     # benchmark — hop curves come from the validation runs, not the bench
+    subs = _subs_matrix(n, t)
+    if paired:
+        # overlapping membership: every peer in BOTH its pair topics
+        subs[np.arange(n), (np.arange(n) % t + t // 2) % t] = True
     params, state = gs.make_gossip_sim(
-        cfg, _subs_matrix(n, t), topic, origin, tick,
+        cfg, subs, topic, origin, tick,
         score_cfg=score_cfg, sybil=sybil, track_first_tick=False)
     params = jax.device_put(params)
     step = gs.make_gossip_step(cfg, score_cfg)
@@ -167,7 +172,7 @@ def _bench_gossip(metric, n, t, score_cfg, sybil=None, gate_honest=False,
                          for j in range(m)])
     else:
         reach = np.asarray(gs.reach_counts_from_have(params, state))
-        want = np.full(m, n // t)
+        want = np.full(m, (2 * n // t) if paired else (n // t))
     ok = reach[settled] == want[settled]
     assert ok.all(), (reach[settled][~ok], want[settled][~ok])
     if state.iwant_serves is not None:
@@ -196,6 +201,22 @@ def bench_gossipsub_v11():
                   n, 100, gs.ScoreSimConfig(), baseline=10_000.0)
 
 
+def bench_gossipsub_v11_multitopic():
+    """1M peers with OVERLAPPING topic membership (paired-topic mode:
+    every peer subscribes two topics and keeps a mesh per topic, so the
+    per-topic score sum and TopicScoreCap are live — the network is no
+    longer T disjoint layers)."""
+    import jax
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    on_accel = jax.devices()[0].platform != "cpu"
+    n = 1_000_000 if on_accel else 100_000
+    _bench_gossip(
+        f"gossipsub_v11_multitopic_{n}peers_100topics_2per_peer"
+        "_heartbeats_per_sec",
+        n, 100, gs.ScoreSimConfig(topic_score_cap=50.0), paired=True,
+        baseline=10_000.0)
+
+
 def bench_gossipsub_v11_adversarial():
     """20% sybils running BOTH gossip-repair attacks at once: IHAVE
     broken-promise spam (gossipsub_spam_test.go:135) and the IWANT
@@ -220,6 +241,7 @@ BENCHES = {
     "randomsub_10k": bench_randomsub_10k,
     "gossipsub_v10": bench_gossipsub_v10,
     "gossipsub_v11": bench_gossipsub_v11,
+    "gossipsub_v11_multitopic": bench_gossipsub_v11_multitopic,
     "gossipsub_v11_adversarial": bench_gossipsub_v11_adversarial,
 }
 

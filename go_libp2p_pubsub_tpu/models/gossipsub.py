@@ -13,6 +13,11 @@ TPU-first representation (see PERF_NOTES.md):
   C ring offsets, all multiples of T and closed under negation.  Candidates
   model what discovery + peer exchange give a deployed node: the topic
   peers it *could* connect to (discovery.go:108-173, PX gossipsub.go:856).
+  With ``paired_topics`` every peer additionally subscribes its pair
+  topic ``p mod T + T/2`` and keeps a SECOND mesh/backoff for it
+  (offsets become multiples of T/2, so each candidate shares both
+  topics); per-topic score contributions sum under TopicScoreCap — see
+  GossipSimConfig.paired_topics and tests/test_gossipsub_paired.py.
 - **Mesh/fanout/eligibility/handshake masks are uint32 bitmasks [N]** over
   the candidate bits (C <= 32).  GRAFT/PRUNE flip bits; degree = popcount;
   all the mask logic of the heartbeat is single-word elementwise ops at
@@ -100,6 +105,14 @@ class GossipSimConfig:
 
     offsets: tuple[int, ...]       # C candidate ring offsets, ± paired
     n_topics: int = 1
+    # paired-topic mode: every peer subscribes TWO topics — its residue
+    # class r = p mod T and r + T/2 — and keeps a separate mesh per
+    # topic slot.  Offsets are then multiples of T/2 (not T), so each
+    # candidate shares BOTH topics with its partner and the per-topic
+    # circulants stay closed over the union of the two classes.  With
+    # equal topic weights the per-topic score sum uses the aggregate
+    # delivery counters plus per-slot P1 terms (see compute_scores).
+    paired_topics: bool = False
     d: int = 6                     # GossipSubD
     d_lo: int = 5                  # GossipSubDlo
     d_hi: int = 12                 # GossipSubDhi
@@ -128,8 +141,15 @@ class GossipSimConfig:
             raise ValueError("at most 32 candidates (uint32 bitmasks)")
         if not all((-o) in set(offs.tolist()) for o in offs.tolist()):
             raise ValueError("offsets must be closed under negation")
-        if any(o % self.n_topics for o in offs.tolist()):
-            raise ValueError("offsets must be multiples of n_topics")
+        if self.paired_topics and (self.n_topics < 2
+                                   or self.n_topics % 2):
+            raise ValueError("paired_topics needs an even n_topics >= 2")
+        modulus = (self.n_topics // 2 if self.paired_topics
+                   else self.n_topics)
+        if any(o % modulus for o in offs.tolist()):
+            raise ValueError(
+                "offsets must be multiples of n_topics"
+                + ("/2 (paired mode)" if self.paired_topics else ""))
         if not (self.d_lo <= self.d <= self.d_hi):
             raise ValueError("need Dlo <= D <= Dhi (gossipsub.go:33-35)")
         if self.d_score > self.d:
@@ -167,12 +187,17 @@ class GossipSimConfig:
 
 
 def make_gossip_offsets(n_topics: int, n_candidates: int, n_peers: int,
-                        seed: int = 0) -> tuple[int, ...]:
+                        seed: int = 0,
+                        paired: bool = False) -> tuple[int, ...]:
     """Random ± paired circulant offsets ≡ 0 (mod n_topics): each residue
     class (= topic) forms an independent random circulant candidate graph
     (expander — same locally-tree-like spread as the reference test
-    harness's random topologies, floodsub_test.go:65-81)."""
-    offs = make_circulant_offsets(n_topics, n_candidates, n_peers,
+    harness's random topologies, floodsub_test.go:65-81).
+
+    With ``paired=True`` the offsets are multiples of n_topics/2 for the
+    overlapping two-topics-per-peer mode (GossipSimConfig.paired_topics)."""
+    modulus = n_topics // 2 if paired else n_topics
+    offs = make_circulant_offsets(modulus, n_candidates, n_peers,
                                   seed=seed)
     return tuple(int(o) for o in offs)
 
@@ -190,6 +215,10 @@ class ScoreSimConfig:
     """
 
     topic_weight: float = 1.0
+    # cap on the summed per-topic contribution (P1..P4 across topics,
+    # before P5..P7 are added) — score.go:256-268 TopicScoreCap.
+    # 0 disables, like the reference default.
+    topic_score_cap: float = 0.0
     # P1: time in mesh (capped ramp)
     time_in_mesh_weight: float = 0.1
     time_in_mesh_quantum: int = 1           # ticks per unit
@@ -305,6 +334,9 @@ class GossipParams:
     origin_words: jnp.ndarray    # uint32 [W, N]: bit m set at origin[m]
     deliver_words: jnp.ndarray   # uint32 [W, N]: msg m counts as delivery
     publish_tick: jnp.ndarray    # int32 [M]
+    # paired-topic mode: bit m set iff msg m's topic is peer p's SECOND
+    # topic slot (so it forwards on mesh_b rather than mesh)
+    slot_b_words: jnp.ndarray | None = None   # uint32 [W, N]
     invalid_words: jnp.ndarray | None = None  # uint32 [W]: msg fails validation
     cand_app_score: jnp.ndarray | None = None # f32 [C, N]: P5 of candidate
     cand_colo_excess: jnp.ndarray | None = None  # f32 [C, N]: P6 surplus
@@ -326,6 +358,11 @@ class GossipParams:
     n_true: int | None = struct.field(pytree_node=False, default=None)
     cand_sybil: jnp.ndarray | None = None     # bool [C, N]: candidate is sybil
     sybil: jnp.ndarray | None = None          # bool [N]
+    # per-IP shared fate at the gater (peer_gater.go:119-151): word at
+    # [c, p] has bit c' set iff candidates p+o_c and p+o_c' share a
+    # source IP.  Built only when some IP is actually shared, so
+    # unique-IP sims (the common case) skip the grouping pass entirely.
+    cand_same_ip: jnp.ndarray | None = None   # uint32 [C, N]
     # peers that advertise gossip but withhold the payload (broken
     # IWANT promises) WITHOUT being flagged sybil — stealthy spammers.
     # P7 is behavioral (derived from advertised-vs-delivered traffic,
@@ -352,6 +389,10 @@ class ScoreState:
     mesh_failure_penalty: jnp.ndarray  # f32 [C, N] sticky deficit² (P3b)
     invalid_deliveries: jnp.ndarray  # f32 [C, N] decaying counter (P4)
     behaviour_penalty: jnp.ndarray   # f32 [C, N] decaying counter (P7)
+    # paired-topic mode only: P1 for the SECOND topic slot's mesh (the
+    # other counters aggregate across the two equal-weight topics; time
+    # in mesh is per-topic because the meshes differ)
+    time_in_mesh_b: jnp.ndarray | None = None  # int16 [C, N]
 
 
 @struct.dataclass
@@ -370,6 +411,10 @@ class GossipState:
     # count of gossip retransmissions served, decayed as mcache entries
     # expire (mcache.go:66-80 aggregated per edge over the window)
     iwant_serves: jnp.ndarray | None = None  # int16 [C, N]
+    # paired-topic mode: the SECOND topic slot's mesh and backoff (each
+    # topic keeps its own mesh + per-edge backoff, gossipsub.go:135)
+    mesh_b: jnp.ndarray | None = None        # uint32 [N]
+    backoff_b: jnp.ndarray | None = None     # int32 [C, N]
 
 
 def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
@@ -406,18 +451,48 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
     if t != cfg.n_topics:
         raise ValueError("subs topic dim != cfg.n_topics")
     own_topic = np.arange(n) % cfg.n_topics
-    cross = subs & ~(np.arange(t)[None, :] == own_topic[:, None])
-    if cross.any():
-        raise ValueError("peers may only subscribe to topic (p mod T)")
-    subscribed = subs[np.arange(n), own_topic]
-
     m = len(msg_topic)
-    if ((msg_origin % cfg.n_topics) != msg_topic).any():
-        raise ValueError("msg origin must be in the topic's residue class")
     origin_bits = np.zeros((n, m), dtype=bool)
     origin_bits[msg_origin, np.arange(m)] = True
-    deliver_bits = subscribed[:, None] & (own_topic[:, None]
-                                          == msg_topic[None, :])
+    if cfg.paired_topics:
+        # overlapping membership: peer p subscribes to BOTH topics
+        # {r, r + T/2}; subs rows must be exactly that pair (or empty
+        # for non-participants)
+        second = (own_topic + cfg.n_topics // 2) % cfg.n_topics
+        pair = ((np.arange(t)[None, :] == own_topic[:, None])
+                | (np.arange(t)[None, :] == second[:, None]))
+        if (subs & ~pair).any():
+            raise ValueError(
+                "paired mode: peers may only subscribe to "
+                "{p mod T, p mod T + T/2}")
+        both = subs[np.arange(n), own_topic] & subs[np.arange(n), second]
+        neither = ~(subs[np.arange(n), own_topic]
+                    | subs[np.arange(n), second])
+        if not (both | neither).all():
+            raise ValueError("paired mode: subscribe to both topics of "
+                             "the pair, or neither")
+        subscribed = both
+        org_t = own_topic[msg_origin]
+        org_t2 = second[msg_origin]
+        if (~((org_t == msg_topic) | (org_t2 == msg_topic))).any():
+            raise ValueError(
+                "msg origin must subscribe to the message topic")
+        deliver_bits = subscribed[:, None] & (
+            (own_topic[:, None] == msg_topic[None, :])
+            | (second[:, None] == msg_topic[None, :]))
+        # slot-B classification: msg m rides peer p's SECOND topic slot
+        slot_b_bits = (second[:, None] == msg_topic[None, :])
+    else:
+        cross = subs & ~(np.arange(t)[None, :] == own_topic[:, None])
+        if cross.any():
+            raise ValueError("peers may only subscribe to topic (p mod T)")
+        subscribed = subs[np.arange(n), own_topic]
+        if ((msg_origin % cfg.n_topics) != msg_topic).any():
+            raise ValueError(
+                "msg origin must be in the topic's residue class")
+        deliver_bits = subscribed[:, None] & (own_topic[:, None]
+                                              == msg_topic[None, :])
+        slot_b_bits = None
 
     def cand_view(per_peer):
         """Per-candidate view: out[c, p] = per_peer[p + o_c]."""
@@ -470,7 +545,18 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
                else np.asarray(msg_invalid, dtype=bool))
         app_v = cand_view(app)
         colo_v = cand_view(colo_excess)
+        same_ip = None
+        if (colo_count > 1).any():
+            # shared addresses exist: build the same-IP sibling masks
+            # for the gater's per-IP stat grouping
+            ips_v = cand_view(ip_idx)
+            same = np.zeros((len(cfg.offsets), n), dtype=np.uint32)
+            for c2 in range(len(cfg.offsets)):
+                same |= (ips_v == ips_v[c2][None, :]).astype(
+                    np.uint32) << c2
+            same_ip = jnp.asarray(padl(same))
         kw = dict(
+            cand_same_ip=same_ip,
             invalid_words=pack_bits(jnp.asarray(inv)),
             cand_app_score=jnp.asarray(padl(app_v)),
             cand_colo_excess=jnp.asarray(padl(colo_v)),
@@ -500,6 +586,8 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
         origin_words=pack_bits_pm(jnp.asarray(pad0(origin_bits))),
         deliver_words=pack_bits_pm(jnp.asarray(pad0(deliver_bits))),
         publish_tick=jnp.asarray(msg_publish_tick, dtype=jnp.int32),
+        slot_b_words=(pack_bits_pm(jnp.asarray(pad0(slot_b_bits)))
+                      if slot_b_bits is not None else None),
         n_true=(n if pad_to_block is not None else None),
         **kw,
     )
@@ -529,12 +617,17 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
                            mesh_deliveries=zc(), mesh_failure_penalty=zc(),
                            invalid_deliveries=zc(),
                            behaviour_penalty=jnp.zeros(
-                               (c, n), dtype=jnp.float32))
+                               (c, n), dtype=jnp.float32),
+                           time_in_mesh_b=(zt() if cfg.paired_topics
+                                           else None))
                 if score_cfg is not None else None),
         key=jax.random.PRNGKey(seed),
         tick=jnp.zeros((), dtype=jnp.int32),
         iwant_serves=(zt() if score_cfg is not None
                       and score_cfg.sybil_iwant_spam else None),
+        mesh_b=(zbits() if cfg.paired_topics else None),
+        backoff_b=(jnp.zeros((c, n), dtype=jnp.int32)
+                   if cfg.paired_topics else None),
     )
     return params, state
 
@@ -606,13 +699,28 @@ def compute_scores(sc: ScoreSimConfig, params: GossipParams,
     tim = f32(s.time_in_mesh)
     invd = f32(s.invalid_deliveries)
     w = sc.topic_weight
-    score = (w * sc.time_in_mesh_weight
-             * jnp.minimum(tim / sc.time_in_mesh_quantum,
-                           sc.time_in_mesh_cap)
-             + (w * sc.first_message_deliveries_weight)
-             * f32(s.first_deliveries)
-             + (w * sc.invalid_message_deliveries_weight) * invd * invd
-             + params.cand_static_score)
+    # summed per-topic contribution (P1..P4).  With equal topic weights
+    # the LINEAR terms' per-topic sums collapse into the aggregate
+    # counters exactly (P1 stays per-slot because the meshes differ).
+    # Known deviation in paired mode: P4's square and the P2 cap apply
+    # to the aggregate across the pair rather than per topic — exact
+    # when the traffic concentrates in one of the two topics, and up to
+    # 2x the P4 penalty (conservative, anti-attacker) when an invalid
+    # spammer splits evenly; test_multi_topic_score_sum_matches_core
+    # pins the exact regime against core/score.py.
+    topic_part = (w * sc.time_in_mesh_weight
+                  * jnp.minimum(tim / sc.time_in_mesh_quantum,
+                                sc.time_in_mesh_cap)
+                  + (w * sc.first_message_deliveries_weight)
+                  * f32(s.first_deliveries)
+                  + (w * sc.invalid_message_deliveries_weight)
+                  * invd * invd)
+    if s.time_in_mesh_b is not None:
+        tim_b = f32(s.time_in_mesh_b)
+        topic_part = topic_part + (w * sc.time_in_mesh_weight
+                                   * jnp.minimum(
+                                       tim_b / sc.time_in_mesh_quantum,
+                                       sc.time_in_mesh_cap))
     if sc.track_p3:
         c = s.time_in_mesh.shape[0]
         in_mesh = expand_bits(st.mesh, c)
@@ -620,14 +728,20 @@ def compute_scores(sc: ScoreSimConfig, params: GossipParams,
             0.0, sc.mesh_message_deliveries_threshold
             - f32(s.mesh_deliveries))
         active = tim > sc.mesh_message_deliveries_activation
-        score = (score
-                 + (w * sc.mesh_message_deliveries_weight)
-                 * jnp.where(in_mesh & active, deficit * deficit, 0.0)
-                 + (w * sc.mesh_failure_penalty_weight)
-                 * f32(s.mesh_failure_penalty))
+        topic_part = (topic_part
+                      + (w * sc.mesh_message_deliveries_weight)
+                      * jnp.where(in_mesh & active, deficit * deficit,
+                                  0.0)
+                      + (w * sc.mesh_failure_penalty_weight)
+                      * f32(s.mesh_failure_penalty))
+    if sc.topic_score_cap > 0:
+        # the cap applies to the summed topic contribution only,
+        # before P5..P7 (score.go:256-268)
+        topic_part = jnp.minimum(topic_part, sc.topic_score_cap)
     bp_excess = jnp.maximum(
         0.0, f32(s.behaviour_penalty) - sc.behaviour_penalty_threshold)
-    return score + sc.behaviour_penalty_weight * bp_excess * bp_excess
+    return (topic_part + params.cand_static_score
+            + sc.behaviour_penalty_weight * bp_excess * bp_excess)
 
 
 def score_snapshot(sc: ScoreSimConfig, params: GossipParams,
@@ -676,11 +790,26 @@ def score_snapshot(sc: ScoreSimConfig, params: GossipParams,
         zero = jnp.zeros_like(tim)
         out["p3_mesh_delivery_deficit"] = zero
         out["p3b_mesh_failure_penalty"] = zero
+    if s.time_in_mesh_b is not None:
+        out["p1b_time_in_mesh"] = (
+            w * sc.time_in_mesh_weight * jnp.minimum(
+                f32(s.time_in_mesh_b) / sc.time_in_mesh_quantum,
+                sc.time_in_mesh_cap))
     bp_excess = jnp.maximum(
         0.0, f32(s.behaviour_penalty) - sc.behaviour_penalty_threshold)
     out["p7_behaviour_penalty"] = (sc.behaviour_penalty_weight
                                    * bp_excess * bp_excess)
-    out["score"] = sum(out.values())
+    topic_part = (out["p1_time_in_mesh"] + out["p2_first_deliveries"]
+                  + out["p3_mesh_delivery_deficit"]
+                  + out["p3b_mesh_failure_penalty"]
+                  + out["p4_invalid_deliveries"]
+                  + out.get("p1b_time_in_mesh", 0.0))
+    if sc.topic_score_cap > 0:
+        # cap binds the summed topic contribution only (score.go:256-268)
+        topic_part = jnp.minimum(topic_part, sc.topic_score_cap)
+    out["score"] = (topic_part + out["p5_app_specific"]
+                    + out["p6_ip_colocation"]
+                    + out["p7_behaviour_penalty"])
     return out
 
 
@@ -716,12 +845,17 @@ def make_gossip_step(cfg: GossipSimConfig,
     """
     C = cfg.n_candidates
     sc = score_cfg
+    paired = cfg.paired_topics
     offsets = tuple(int(o) for o in cfg.offsets)
     cinv = cfg.cinv
     OUT_MASK = jnp.uint32(cfg.outbound_mask)
     ALL = jnp.uint32((1 << C) - 1)
     Z = jnp.uint32(0)
     pc = jax.lax.population_count
+    if paired and (C > 16 or force_split
+                   or (sc is not None and sc.track_p3)):
+        raise ValueError("paired_topics needs the combined path "
+                        "(C<=16, no track_p3/force_split)")
 
     # random-k selection backend.  The mosaic kernel (bit-identical
     # output) is kept as an option, but measured inside the real scanned
@@ -828,7 +962,8 @@ def make_gossip_step(cfg: GossipSimConfig,
                 time_in_mesh=outs[6], first_deliveries=outs[3],
                 mesh_deliveries=state.scores.mesh_deliveries,
                 mesh_failure_penalty=state.scores.mesh_failure_penalty,
-                invalid_deliveries=outs[4], behaviour_penalty=outs[5])
+                invalid_deliveries=outs[4], behaviour_penalty=outs[5],
+                time_in_mesh_b=None)
         new_state = GossipState(
             mesh=mesh_new, fanout=fanout, last_pub=last_pub,
             backoff=backoff_new, have=have, recent=recent,
@@ -849,13 +984,14 @@ def make_gossip_step(cfg: GossipSimConfig,
                 raise ValueError(
                     "pallas step needs make_gossip_sim(pad_to_block=...)")
             if (C > 16 or W == 0 or params.flood_proto is not None
+                    or paired
                     or (sc is not None and (sc.track_p3
                                             or sc.flood_publish
                                             or sc.sybil_iwant_spam))):
                 raise ValueError(
                     "config not supported by the pallas step (needs "
                     "C<=16, W>=1, no flood_proto/track_p3/"
-                    "flood_publish/sybil_iwant_spam)")
+                    "flood_publish/sybil_iwant_spam/paired_topics)")
         elif params.n_true is not None:
             raise ValueError(
                 "padded sim state requires the pallas step (XLA rolls "
@@ -881,9 +1017,13 @@ def make_gossip_step(cfg: GossipSimConfig,
             nonneg_bits = pack_rows(score >= 0)
             # RED gater: under invalid-traffic pressure, payload from an
             # edge is accepted with its goodput probability
-            # (peer_gater.go:320-363; stats per edge, decayed with the
-            # score counters — sybils behind one IP already share fate
-            # via P6)
+            # (peer_gater.go:320-363).  Gater stats are keyed by SOURCE
+            # IP, not per peer (peer_gater.go:119-151): when candidates
+            # share an address (cand_same_ip built at sim time, only if
+            # any IP is actually shared) each edge's goodput uses the
+            # sums over its same-IP siblings, so sybils behind one
+            # address share fate at the gater exactly as in the
+            # reference — not just through the P6 score term.
             s0 = state.scores
             f32 = lambda x: x.astype(jnp.float32)  # noqa: E731
             invd = f32(s0.invalid_deliveries)
@@ -892,7 +1032,17 @@ def make_gossip_step(cfg: GossipSimConfig,
             del_tot = fdel.sum(axis=0)
             pressure = 16.0 * inv_tot / (1.0 + del_tot + 16.0 * inv_tot)
             gater_on = pressure > 0.33
-            goodput = (1.0 + fdel) / (1.0 + fdel + 16.0 * invd)
+            if params.cand_same_ip is not None:
+                inv_g = jnp.zeros_like(invd)
+                fd_g = jnp.zeros_like(fdel)
+                for cc in range(C):
+                    sib = expand_bits(
+                        params.cand_same_ip[cc], C)             # [C, N]
+                    inv_g = inv_g + jnp.where(sib, invd[cc][None, :], 0.0)
+                    fd_g = fd_g + jnp.where(sib, fdel[cc][None, :], 0.0)
+            else:
+                inv_g, fd_g = invd, fdel
+            goodput = (1.0 + fd_g) / (1.0 + fd_g + 16.0 * inv_g)
             u_gater = lane_uniform((C, n), tick, 6, salt,
                                    stride=n_stream)
             gater_bits = pack_rows(u_gater < goodput) | jnp.where(
@@ -946,6 +1096,17 @@ def make_gossip_step(cfg: GossipSimConfig,
         if sc is not None:
             fresh = [jnp.where(params.sybil, f, f & valid_w[w])
                      for w, f in enumerate(fresh)]
+        if paired:
+            # messages split by the SENDER's topic slot: slot-A content
+            # forwards on mesh, slot-B content on mesh_b (the reference
+            # forwards on the mesh of the message's topic,
+            # gossipsub.go:989-999).  Unsubscribed (fanout-only) peers
+            # have no meshes and send their full fresh set on the
+            # slot-A/fanout path.
+            fresh_a = [jnp.where(sub, f & ~params.slot_b_words[w], f)
+                       for w, f in enumerate(fresh)]
+            fresh_b = [f & params.slot_b_words[w]
+                       for w, f in enumerate(fresh)]
         out_bits = state.mesh | fanout                          # [N]
         if params.flood_proto is not None:
             # mixed network: gossipsub peers always forward to floodsub-
@@ -991,6 +1152,11 @@ def make_gossip_step(cfg: GossipSimConfig,
             adv.append(aw)
         elig = (params.cand_sub_bits & ~state.mesh & ~state.fanout
                 & sub_all)          # only subscribed peers gossip
+        if paired:
+            # shared gossip stream across the two topic slots (one
+            # Dlazy selection covers both; documented deviation from
+            # per-topic emission): exclude slot-B mesh members too
+            elig = elig & ~state.mesh_b
         if params.flood_proto is not None:
             # no IHAVE to floodsub-protocol peers (they don't speak
             # control); they send none either
@@ -1042,9 +1208,11 @@ def make_gossip_step(cfg: GossipSimConfig,
             served_now = jnp.where(
                 params.cand_sybil & ~cutoff & (adv_count[None, :] > 0),
                 adv_count[None, :], 0)
-            decayed = (state.iwant_serves.astype(jnp.int32)
-                       - state.iwant_serves.astype(jnp.int32)
-                       // cfg.history_length)
+            s32 = state.iwant_serves.astype(jnp.int32)
+            # ceil-division decay: plain s//H stalls below H and would
+            # leave phantom load after the flood stops
+            decayed = s32 - (s32 + cfg.history_length - 1
+                             ) // cfg.history_length
             iwant_serves = jnp.clip(decayed + served_now, 0,
                                     30000).astype(jnp.int16)
 
@@ -1052,113 +1220,133 @@ def make_gossip_step(cfg: GossipSimConfig,
         # Read-only on start-of-tick state (score, mesh, backoff,
         # uniforms), so they run before forwarding and are shared by the
         # two execution paths (XLA transfer rolls / pallas kernel) that
-        # diverge below.
+        # diverge below.  Parameterized over the topic slot: paired-
+        # topic mode runs one full maintenance pass per topic's
+        # mesh/backoff with decorrelated uniform phases, exactly as the
+        # reference heartbeat loops over topics (gossipsub.go:1299).
         mesh_before = state.mesh
-        backoff = state.backoff
-        if sc is not None:
-            # drop negative-score mesh members first (gossipsub.go:1332)
-            neg = mesh_before & ~nonneg_bits
-            mesh_ng = mesh_before & nonneg_bits
-        else:
-            neg = None
-            mesh_ng = mesh_before
-        deg = popcount32(mesh_ng)                               # [N]
 
-        # graft up to D when deg < Dlo (gossipsub.go:1340-1360);
-        # candidates need score >= 0 in v1.1.  in_backoff is the only
-        # per-edge numeric state: pack the comparison once.
-        backoff_bits = pack_rows(backoff > tick)
-        can_graft = (params.cand_sub_bits & ~mesh_ng & ~backoff_bits
-                     & sub_all)
-        if params.flood_proto is not None:
-            # floodsub-protocol peers have no mesh: never graft at them,
-            # and they graft at nobody
-            can_graft = can_graft & ~params.cand_flood_bits
-            can_graft = jnp.where(params.flood_proto, Z, can_graft)
-        if sc is not None:
-            can_graft = can_graft & nonneg_bits
-        need = jnp.where(deg < cfg.d_lo, cfg.d - deg, 0)
-        grafts = jax.lax.cond(
-            jnp.any(need > 0),
-            lambda: sel_k(can_graft, need, u_spec(2)),
-            lambda: jnp.zeros_like(mesh_ng))
-
-        # prune down to D when deg > Dhi.  v1.0: random retention; v1.1:
-        # keep the Dscore best by score, then at least Dout outbound,
-        # random fill to D (anti-sybil bubble-up, gossipsub.go:1376-1435).
-        over = deg > cfg.d_hi
-
-        def compute_prunes():
-            if sc is None:
-                keep = sel_k(mesh_ng, jnp.full_like(deg, cfg.d),
-                             u_spec(3))
+        def maintain(mesh0, backoff0, ph_graft, ph_prune, ph_og):
+            if sc is not None:
+                # drop negative-score mesh members first (:1332)
+                neg = mesh0 & ~nonneg_bits
+                mesh_ng = mesh0 & nonneg_bits
             else:
-                rnd = lane_uniform((C, n), tick, 3, salt,
-                                   stride=n_stream)
-                top = select_k_by_priority_bits(
-                    mesh_ng, score, jnp.full_like(deg, cfg.d_score),
-                    tiebreak=rnd)
-                n_out_top = popcount32(top & OUT_MASK)
-                need_out = jnp.maximum(0, cfg.d_out - n_out_top)
-                out_keep = select_k_by_priority_bits(
-                    mesh_ng & ~top & OUT_MASK, rnd, need_out)
-                taken = top | out_keep
-                n_taken = popcount32(taken)
-                fill = select_k_by_priority_bits(
-                    mesh_ng & ~taken, rnd,
-                    jnp.maximum(cfg.d - n_taken, 0))
-                keep = taken | fill
-            return mesh_ng & ~keep & jnp.where(over, ALL, Z)
+                neg = None
+                mesh_ng = mesh0
+            deg = popcount32(mesh_ng)                           # [N]
 
-        prunes = jax.lax.cond(jnp.any(over), compute_prunes,
-                              lambda: jnp.zeros_like(mesh_ng))
+            # graft up to D when deg < Dlo (gossipsub.go:1340-1360);
+            # candidates need score >= 0 in v1.1.  in_backoff is the
+            # only per-edge numeric state: pack the comparison once.
+            backoff_bits = pack_rows(backoff0 > tick)
+            can_graft = (params.cand_sub_bits & ~mesh_ng & ~backoff_bits
+                         & sub_all)
+            if params.flood_proto is not None:
+                # floodsub-protocol peers have no mesh: never graft at
+                # them, and they graft at nobody
+                can_graft = can_graft & ~params.cand_flood_bits
+                can_graft = jnp.where(params.flood_proto, Z, can_graft)
+            if sc is not None:
+                can_graft = can_graft & nonneg_bits
+            need = jnp.where(deg < cfg.d_lo, cfg.d - deg, 0)
+            grafts = jax.lax.cond(
+                jnp.any(need > 0),
+                lambda: sel_k(can_graft, need, u_spec(ph_graft)),
+                lambda: jnp.zeros_like(mesh_ng))
 
-        if sc is not None:
-            # opportunistic grafting: when the mesh's median score sags
-            # below the threshold, graft extra high-scoring peers
-            # (gossipsub.go:1467-1498).  Runs 1-in-opportunistic_graft_
-            # ticks, so the median rank-compare sits under the cond too.
-            do_og = (tick % sc.opportunistic_graft_ticks) == 0
+            # prune down to D when deg > Dhi.  v1.0: random retention;
+            # v1.1: keep the Dscore best by score, then at least Dout
+            # outbound, random fill to D (gossipsub.go:1376-1435).
+            over = deg > cfg.d_hi
 
-            def compute_og():
-                # median = the mesh bit at ascending rank deg//2 =
-                # descending rank C-1-deg//2 (non-mesh bits pinned to
-                # +inf rank first); rank-compare instead of a sort
-                in_mesh = expand_bits(mesh_ng, C)
-                mesh_rank = ranks_desc(jnp.where(in_mesh, score, jnp.inf))
-                med_pick = in_mesh & (mesh_rank
-                                      == (C - 1 - deg // 2)[None, :])
-                median = jnp.where(
-                    deg > 0, jnp.where(med_pick, score, 0.0).sum(0), 0.0)
-                og_row = (median < sc.opportunistic_graft_threshold) & sub
-                og_elig = (can_graft & ~grafts
-                           & pack_rows(score > median[None, :]))
-                og_need = jnp.where(og_row, sc.opportunistic_graft_peers,
-                                    0)
-                return sel_k(og_elig, og_need, u_spec(5))
+            def compute_prunes():
+                if sc is None:
+                    keep = sel_k(mesh_ng, jnp.full_like(deg, cfg.d),
+                                 u_spec(ph_prune))
+                else:
+                    rnd = lane_uniform((C, n), tick, ph_prune, salt,
+                                       stride=n_stream)
+                    top = select_k_by_priority_bits(
+                        mesh_ng, score, jnp.full_like(deg, cfg.d_score),
+                        tiebreak=rnd)
+                    n_out_top = popcount32(top & OUT_MASK)
+                    need_out = jnp.maximum(0, cfg.d_out - n_out_top)
+                    out_keep = select_k_by_priority_bits(
+                        mesh_ng & ~top & OUT_MASK, rnd, need_out)
+                    taken = top | out_keep
+                    n_taken = popcount32(taken)
+                    fill = select_k_by_priority_bits(
+                        mesh_ng & ~taken, rnd,
+                        jnp.maximum(cfg.d - n_taken, 0))
+                    keep = taken | fill
+                return mesh_ng & ~keep & jnp.where(over, ALL, Z)
 
-            grafts = grafts | jax.lax.cond(
-                do_og, compute_og, lambda: jnp.zeros_like(mesh_ng))
+            prunes = jax.lax.cond(jnp.any(over), compute_prunes,
+                                  lambda: jnp.zeros_like(mesh_ng))
 
-        if sc is not None and sc.sybil_graft_flood:
-            # GRAFT-flooding sybils re-graft every tick, ignoring their
-            # own backoff (gossipsub_spam_test.go:349)
-            grafts = jnp.where(params.sybil,
-                               params.cand_sub_bits & ~mesh_ng, grafts)
+            if sc is not None:
+                # opportunistic grafting: when the mesh's median score
+                # sags below the threshold, graft extra high-scoring
+                # peers (gossipsub.go:1467-1498).  Runs 1-in-
+                # opportunistic_graft_ticks, so the median rank-compare
+                # sits under the cond too.
+                do_og = (tick % sc.opportunistic_graft_ticks) == 0
 
-        mesh_sel = (mesh_ng | grafts) & ~prunes
-        dropped = prunes if neg is None else prunes | neg
-        backoff_bits2 = backoff_bits | dropped  # post-write backoff
-        # bits, derived algebraically (the only edges whose backoff
-        # changed are prunes|neg, all set beyond tick)
-        would_accept = sub_all & ~backoff_bits2
-        if params.flood_proto is not None:
-            would_accept = jnp.where(params.flood_proto, Z, would_accept)
-        if sc is not None:
-            would_accept = would_accept & nonneg_bits
-            a_sent = would_accept | ~accept_bits
-        else:
-            a_sent = would_accept
+                def compute_og():
+                    # median = the mesh bit at ascending rank deg//2 =
+                    # descending rank C-1-deg//2 (non-mesh bits pinned
+                    # to +inf rank first); rank-compare, not a sort
+                    in_mesh = expand_bits(mesh_ng, C)
+                    mesh_rank = ranks_desc(
+                        jnp.where(in_mesh, score, jnp.inf))
+                    med_pick = in_mesh & (mesh_rank
+                                          == (C - 1 - deg // 2)[None, :])
+                    median = jnp.where(
+                        deg > 0,
+                        jnp.where(med_pick, score, 0.0).sum(0), 0.0)
+                    og_row = ((median < sc.opportunistic_graft_threshold)
+                              & sub)
+                    og_elig = (can_graft & ~grafts
+                               & pack_rows(score > median[None, :]))
+                    og_need = jnp.where(
+                        og_row, sc.opportunistic_graft_peers, 0)
+                    return sel_k(og_elig, og_need, u_spec(ph_og))
+
+                grafts = grafts | jax.lax.cond(
+                    do_og, compute_og, lambda: jnp.zeros_like(mesh_ng))
+
+            if sc is not None and sc.sybil_graft_flood:
+                # GRAFT-flooding sybils re-graft every tick, ignoring
+                # their own backoff (gossipsub_spam_test.go:349)
+                grafts = jnp.where(params.sybil,
+                                   params.cand_sub_bits & ~mesh_ng,
+                                   grafts)
+
+            mesh_sel = (mesh_ng | grafts) & ~prunes
+            dropped = prunes if neg is None else prunes | neg
+            backoff_bits2 = backoff_bits | dropped  # post-write backoff
+            # bits, derived algebraically (the only edges whose backoff
+            # changed are prunes|neg, all set beyond tick)
+            would_accept = sub_all & ~backoff_bits2
+            if params.flood_proto is not None:
+                would_accept = jnp.where(params.flood_proto, Z,
+                                         would_accept)
+            if sc is not None:
+                would_accept = would_accept & nonneg_bits
+                a_sent = would_accept | ~accept_bits
+            else:
+                a_sent = would_accept
+            return dict(grafts=grafts, dropped=dropped,
+                        mesh_sel=mesh_sel, backoff_bits2=backoff_bits2,
+                        would_accept=would_accept, a_sent=a_sent)
+
+        sel_a = maintain(state.mesh, state.backoff, 2, 3, 5)
+        sel_b = (maintain(state.mesh_b, state.backoff_b, 12, 13, 15)
+                 if paired else None)
+        grafts, dropped = sel_a["grafts"], sel_a["dropped"]
+        mesh_sel, backoff_bits2 = sel_a["mesh_sel"], sel_a["backoff_bits2"]
+        would_accept, a_sent = sel_a["would_accept"], sel_a["a_sent"]
 
         if kernel_on:
             return _finish_kernel(
@@ -1218,12 +1406,15 @@ def make_gossip_step(cfg: GossipSimConfig,
             send_gsp = (targets if withhold is None
                         else jnp.where(withhold, Z, targets))
             send_cheat = cheat_src
+            send_fwd_b = state.mesh_b if paired else None
             if sc is not None:
                 packed = (payload_bits
                           | ((payload_bits & gossip_bits)
                              << jnp.uint32(16)))
                 gate_recv = transfer_bits(packed, cfg, pair=True)
                 send_fwd = out_bits & gate_recv
+                if paired:
+                    send_fwd_b = send_fwd_b & gate_recv
                 send_gsp = send_gsp & (gate_recv >> jnp.uint32(16))
                 if send_cheat is not None:
                     # the receiver only IWANTs (and so only records a
@@ -1239,10 +1430,15 @@ def make_gossip_step(cfg: GossipSimConfig,
                 j = cinv[c_send]    # receiver-side bit for this edge
                 m_f = bit_row(send_fwd, c_send)                 # [N]
                 m_g = bit_row(send_gsp, c_send)
+                m_fb = (bit_row(send_fwd_b, c_send) if paired else None)
                 fd_j = iv_j = None
                 for w in range(W):
-                    sent = (jnp.where(m_f, fresh[w], Z)
+                    sent = (jnp.where(m_f,
+                                      fresh_a[w] if paired else fresh[w],
+                                      Z)
                             | jnp.where(m_g, adv[w], Z))
+                    if paired:
+                        sent = sent | jnp.where(m_fb, fresh_b[w], Z)
                     if send_flood is not None:
                         sent = sent | jnp.where(
                             bit_row(send_flood, c_send), injected[w], Z)
@@ -1352,41 +1548,75 @@ def make_gossip_step(cfg: GossipSimConfig,
         # so the grafter keeps exactly the edges the old explicit
         # reject-back retraction kept — bit-identical, one transfer round
         # (C rolls) and one serial dependency shorter.
-        mesh = mesh_sel
-        if C <= 16:
-            # GRAFT+PRUNE masks ride one pair-packed transfer, the
-            # A mask a second (2C rolls total; was 3C with reject-back)
-            recv = transfer_bits(grafts | (dropped << jnp.uint32(16)),
-                                 cfg, pair=True)
-            graft_recv = recv & ALL
-            prune_recv = recv >> jnp.uint32(16)
+        def raw_transfers(sel):
+            grafts_s, dropped_s = sel["grafts"], sel["dropped"]
+            if C <= 16:
+                # GRAFT+PRUNE masks ride one pair-packed transfer, the
+                # A mask a second (2C rolls; was 3C with reject-back)
+                recv = transfer_bits(
+                    grafts_s | (dropped_s << jnp.uint32(16)), cfg,
+                    pair=True)
+                graft_recv = recv & ALL
+                prune_recv = recv >> jnp.uint32(16)
+            else:
+                graft_recv = transfer_bits(grafts_s, cfg)
+                prune_recv = transfer_bits(dropped_s, cfg)
+            a_recv = transfer_bits(sel["a_sent"], cfg)
+            return graft_recv, prune_recv, a_recv
+
+        def resolve(sel, graft_recv, prune_recv, a_recv):
+            if sc is not None:
+                # graylisted peers' control traffic is dropped outright
+                graft_recv = graft_recv & accept_bits
+                prune_recv = prune_recv & accept_bits
+            violation = graft_recv & sel["backoff_bits2"]
+            accept = graft_recv & sel["would_accept"]
+            retract = sel["grafts"] & ~a_recv  # partner would PRUNE back
+            # retract LAST: when accept and retract coincide on an edge
+            # (possible only under sybil_graft_flood, whose grafts
+            # bypass the grafter's own backoff check) the PRUNE response
+            # wins, as in the explicit reject-back form (handlePrune)
+            mesh_new = ((sel["mesh_sel"] | accept) & ~prune_recv
+                        ) & ~retract
+            bo_trig = sel["dropped"] | prune_recv | retract
+            return mesh_new, bo_trig, violation
+
+        if not paired:
+            mesh, bo_trigger, backoff_violation = resolve(
+                sel_a, *raw_transfers(sel_a))
+            mesh_b_new = violation_b = None
         else:
-            graft_recv = transfer_bits(grafts, cfg)
-            prune_recv = transfer_bits(dropped, cfg)
-        a_recv = transfer_bits(a_sent, cfg)
-        if sc is not None:
-            # graylisted peers' control traffic is dropped outright
-            graft_recv = graft_recv & accept_bits
-            prune_recv = prune_recv & accept_bits
-        backoff_violation = graft_recv & backoff_bits2
-        accept = graft_recv & would_accept
-        retract = grafts & ~a_recv   # partner would PRUNE-respond
-        # retract LAST: when accept and retract coincide on an edge
-        # (possible only under sybil_graft_flood, whose grafts bypass
-        # the grafter's own backoff check) the PRUNE response wins,
-        # as in the explicit reject-back form (handlePrune semantics)
-        mesh = ((mesh | accept) & ~prune_recv) & ~retract
+            # cross-slot routing: the topic p calls slot X lives in the
+            # PARTNER's other slot on edges whose offset is an odd
+            # multiple of T/2 (class(p+o) = class(p) + T/2), so control
+            # received from the sender's slot A pertains to MY slot B
+            # there.  Edge parity is static; bit c and its partner bit
+            # cinv[c] share it (o and -o are congruent mod T).
+            even = jnp.uint32(sum(
+                1 << c_ for c_, o_ in enumerate(offsets)
+                if (o_ % cfg.n_topics) == 0))
+            odd = ~even & ALL
+            ga, pa, aa = raw_transfers(sel_a)
+            gb, pb, ab = raw_transfers(sel_b)
+            mesh, bo_trigger, backoff_violation = resolve(
+                sel_a, (ga & even) | (gb & odd),
+                (pa & even) | (pb & odd), (aa & even) | (ab & odd))
+            mesh_b_new, bo_trigger_b, violation_b = resolve(
+                sel_b, (gb & even) | (ga & odd),
+                (pb & even) | (pa & odd), (ab & even) | (aa & odd))
 
         # -- 5. score counter updates + decay ---------------------------
         # (array-level on purpose: a row-wise variant was measured 1.7x
         # slower — [C, N] row slices read whole (sublane, 128) tiles)
         tick_b = tick + cfg.backoff_ticks
-        bo_trigger = dropped | prune_recv | retract
         # dropped edges overwrite to tick+B (gossipsub.go:1332-1338);
         # PRUNE receipt / retraction takes max(existing, tick+B) — equal
         # here, since any existing backoff was set at an earlier tick
         # with the same constant B
-        backoff = jnp.where(expand_bits(bo_trigger, C), tick_b, backoff)
+        backoff = jnp.where(expand_bits(bo_trigger, C), tick_b,
+                            state.backoff)
+        backoff_b = (jnp.where(expand_bits(bo_trigger_b, C), tick_b,
+                               state.backoff_b) if paired else None)
 
         scores = state.scores
         if sc is not None:
@@ -1419,8 +1649,11 @@ def make_gossip_step(cfg: GossipSimConfig,
                 mfp = f32(s0.mesh_failure_penalty) + jnp.where(
                     removed & was_active, deficit * deficit, 0.0)
             # P7: backoff violations + broken gossip promises
+            # (per-topic violations each count, gossipsub.go:747-765)
             bp = f32(s0.behaviour_penalty) + expand_bits(
                 backoff_violation, C).astype(jnp.float32)
+            if paired:
+                bp = bp + expand_bits(violation_b, C).astype(jnp.float32)
             if cheat_src is not None:
                 # one P7 unit per edge per tick with >= 1 broken promise
                 # (applyIwantPenalties adds per-peer counts once per
@@ -1451,12 +1684,17 @@ def make_gossip_step(cfg: GossipSimConfig,
                     inv, sc.invalid_message_deliveries_decay),
                 behaviour_penalty=dk(bp, sc.behaviour_penalty_decay,
                                      dtype=jnp.float32),
+                time_in_mesh_b=(jnp.where(
+                    expand_bits(mesh_b_new, C),
+                    jnp.minimum(s0.time_in_mesh_b + 1, 32766),
+                    0).astype(jnp.int16) if paired else None),
             )
 
         new_state = GossipState(
             mesh=mesh, fanout=fanout, last_pub=last_pub, backoff=backoff,
             have=have, recent=recent, first_tick=first_tick, scores=scores,
-            key=state.key, tick=tick + 1, iwant_serves=iwant_serves)
+            key=state.key, tick=tick + 1, iwant_serves=iwant_serves,
+            mesh_b=mesh_b_new, backoff_b=backoff_b)
         return new_state, delivered_now
 
     return step

@@ -200,6 +200,12 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     seen = [seen_a[w] for w in range(W)]
 
     heard = [jnp.zeros((B,), jnp.uint32) for _ in range(W)]
+    if track_promises:
+        # edge-invariant: the receiver lacks SOME possible id (hoisted
+        # out of the edge loop)
+        lacked = jnp.uint32(0)
+        for w in range(W):
+            lacked = lacked | jnp.where((~seen[w]) != 0, u1, Z)
     fd_cnt = [None] * C
     inv_cnt = [None] * C
     graft_recv = jnp.zeros((B,), jnp.uint32)
@@ -257,9 +263,6 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
             # its possession set) — gossip_tracer.go:48-153
             okg_u = jnp.where(ok_g, u1, Z)  # receiver gossip gate (NOT
             #   gsp_on: a withholding sender has the deliver bit clear)
-            lacked = jnp.uint32(0)
-            for w in range(W):
-                lacked = lacked | jnp.where((~seen[w]) != 0, u1, Z)
             broken_recv = broken_recv | (
                 (adv_r & (u1 ^ m_g) & okg_u & lacked) << jnp.uint32(j))
 

@@ -1,0 +1,196 @@
+"""Overlapping topic membership (paired-topic mode) for the simulator.
+
+VERDICT r3 missing-4 / weak-7: with one topic per peer the per-topic
+score sum and topic_score_cap collapse away and a T-topic flagship is T
+disjoint networks.  Paired mode subscribes every peer to TWO topics
+(its residue class r and r + T/2), keeps a separate mesh + backoff per
+topic slot (the reference keeps per-topic meshes, gossipsub.go:135),
+and scores candidates over the summed per-topic contributions with the
+TopicScoreCap (score.go:256-268).
+"""
+
+import numpy as np
+import pytest
+
+import go_libp2p_pubsub_tpu.models.gossipsub as gs
+
+
+def _build_paired(n=600, t=4, C=12, m=12, seed=2, score=True,
+                  score_kw=None, n_ticks=35):
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, C, n, seed=seed, paired=True),
+        n_topics=t, paired_topics=True,
+        d=3, d_lo=2, d_hi=6, d_score=2, d_out=1, d_lazy=2)
+    rng = np.random.default_rng(seed)
+    own = np.arange(n) % t
+    second = (own + t // 2) % t
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), own] = True
+    subs[np.arange(n), second] = True
+    topic = rng.integers(0, t, m)
+    # any member of the topic may publish (origin's primary OR secondary)
+    members = [np.flatnonzero((own == tau) | (second == tau))
+               for tau in range(t)]
+    origin = np.array([rng.choice(members[tau]) for tau in topic])
+    ticks = np.sort(rng.integers(0, 10, m)).astype(np.int32)
+    sc = gs.ScoreSimConfig(**(score_kw or {})) if score else None
+    params, state = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                                       score_cfg=sc)
+    out = gs.gossip_run(params, state, n_ticks,
+                        gs.make_gossip_step(cfg, sc))
+    return cfg, sc, params, out, topic, own, second
+
+
+def test_paired_dissemination_and_dual_meshes():
+    """Every topic reaches BOTH of its residue classes (the overlapping
+    membership is real), and each peer maintains two bounded meshes."""
+    n, t = 600, 4
+    cfg, sc, params, out, topic, own, second = _build_paired(n=n, t=t)
+    reach = np.asarray(gs.reach_counts(params, out))
+    # members of topic tau = classes {tau, tau + t/2} = half the network
+    assert (reach == n // 2).all(), reach
+    deg_a = np.asarray(gs.mesh_degrees(out))
+    deg_b = np.asarray(np.vectorize(lambda v: bin(v).count("1"))(
+        np.asarray(out.mesh_b)))
+    assert cfg.d_lo <= deg_a.mean() <= cfg.d_hi
+    assert cfg.d_lo <= deg_b.mean() <= cfg.d_hi
+    # the two slot meshes are genuinely distinct selections
+    assert (np.asarray(out.mesh) != np.asarray(out.mesh_b)).mean() > 0.5
+    # per-slot P1 accrues on both meshes
+    assert np.asarray(out.scores.time_in_mesh).max() > 5
+    assert np.asarray(out.scores.time_in_mesh_b).max() > 5
+
+
+def test_paired_cross_slot_mesh_symmetry():
+    """On edges whose offset is an ODD multiple of T/2, a topic lives in
+    the two endpoints' DIFFERENT slots (class(p+o) = class(p) + T/2).
+    After the GRAFT/PRUNE handshake settles, a mesh edge in my slot X
+    must appear in the partner's matching slot for the SAME topic —
+    pinning the cross-slot control routing (a same-slot handshake would
+    leave odd-parity edges unilateral)."""
+    cfg, sc, params, out, *_ = _build_paired(n_ticks=40)
+    t = cfg.n_topics
+    mesh_a = np.asarray(out.mesh)
+    mesh_b = np.asarray(out.mesh_b)
+    agree = total = 0
+    odd_edges = 0
+    for c, o in enumerate(cfg.offsets):
+        cb = cfg.cinv[c]
+        even = (o % t) == 0
+        odd_edges += int(not even)
+        for mine_w, partner_w in (
+                (mesh_a, mesh_a if even else mesh_b),
+                (mesh_b, mesh_b if even else mesh_a)):
+            mine = (mine_w >> c) & 1
+            partner = (np.roll(partner_w, -o) >> cb) & 1
+            agree += int((mine & partner).sum())
+            total += int(mine.sum())
+    assert odd_edges > 0          # the topology exercises the odd case
+    assert total > 0
+    assert agree / total > 0.95, agree / total
+
+
+def test_multi_topic_score_sum_matches_core():
+    """The sim's multi-topic score formula == the protocol core's score
+    engine (core/score.py, reference score.go:256-333) for a peer in
+    TWO topics: per-topic P1 terms, aggregated equal-weight P2/P4, and
+    the TopicScoreCap binding the summed topic contribution."""
+    from go_libp2p_pubsub_tpu.core import (
+        PeerScore, PeerScoreParams, TopicScoreParams)
+    from go_libp2p_pubsub_tpu.core.types import (
+        Message, PeerID, REJECT_INVALID_SIGNATURE)
+    from go_libp2p_pubsub_tpu.pb import rpc as pb
+
+    w = 0.7
+    fd_w, inv_w = 1.3, -2.0
+    t1, t2 = 5.0, 3.0          # time in mesh per topic (ticks==seconds)
+    k1, k2 = 4, 2              # first deliveries per topic
+
+    def run_core(cap, n_inv):
+        class Clock:
+            t = 1000.0
+
+            def __call__(self):
+                return self.t
+
+        def tp():
+            return TopicScoreParams(
+                topic_weight=w, time_in_mesh_weight=1.0,
+                time_in_mesh_quantum=1.0, time_in_mesh_cap=100.0,
+                first_message_deliveries_weight=fd_w,
+                first_message_deliveries_decay=1.0 - 1e-12,
+                first_message_deliveries_cap=1000.0,
+                invalid_message_deliveries_weight=inv_w,
+                invalid_message_deliveries_decay=1.0 - 1e-12)
+
+        clock = Clock()
+        ps = PeerScore(PeerScoreParams(
+            topics={"ta": tp(), "tb": tp()},
+            app_specific_score=lambda p: 0.0,
+            topic_score_cap=cap,
+            decay_interval=1.0, decay_to_zero=1e-9), clock=clock)
+        pid = PeerID(b"A")
+        ps.add_peer(pid, "/meshsub/1.1.0")
+        ps.graft(pid, "ta")
+        ps.graft(pid, "tb")
+        seq = [0]
+
+        def deliver(topic, n_msgs, valid=True):
+            for _ in range(n_msgs):
+                seq[0] += 1
+                msg = Message(pb.PubMessage(
+                    from_peer=b"owner", data=b"x", topic=topic,
+                    seqno=seq[0].to_bytes(8, "big")))
+                msg.received_from = pid
+                if valid:
+                    ps.validate_message(msg)
+                    ps.deliver_message(msg)
+                else:
+                    ps.reject_message(msg, REJECT_INVALID_SIGNATURE)
+
+        deliver("ta", k1)
+        deliver("tb", k2)
+        deliver("tb", n_inv, valid=False)
+        # graft times differ so the per-topic P1 terms differ
+        ps.peer_stats[pid].topics["ta"].graft_time = clock.t - t1
+        ps.peer_stats[pid].topics["tb"].graft_time = clock.t - t2
+        ps.refresh_scores()
+        return ps.score(pid)
+
+    def run_sim(cap, n_inv):
+        cfg, sc, params, out, *_ = _build_paired(
+            n=96, t=4, C=8, m=4, n_ticks=1,
+            score_kw=dict(
+                topic_weight=w, time_in_mesh_weight=1.0,
+                time_in_mesh_quantum=1, time_in_mesh_cap=100.0,
+                first_message_deliveries_weight=fd_w,
+                invalid_message_deliveries_weight=inv_w,
+                topic_score_cap=cap))
+        # overwrite one edge's counters with the core scenario's stats
+        s = out.scores
+        tim = np.zeros(np.asarray(s.time_in_mesh).shape, np.int16)
+        tim_b = np.zeros_like(tim)
+        fd = np.zeros(np.asarray(s.first_deliveries).shape, np.float32)
+        inv = np.zeros_like(fd)
+        tim[2, 7], tim_b[2, 7] = int(t1), int(t2)
+        fd[2, 7] = k1 + k2      # equal weights: per-topic P2 aggregates
+        inv[2, 7] = n_inv
+        st = out.replace(scores=s.replace(
+            time_in_mesh=np.asarray(tim),
+            time_in_mesh_b=np.asarray(tim_b),
+            first_deliveries=fd.astype(s.first_deliveries.dtype),
+            invalid_deliveries=inv.astype(s.invalid_deliveries.dtype),
+            behaviour_penalty=np.zeros_like(fd)))
+        return float(np.asarray(
+            gs.compute_scores(sc, params, st))[2, 7])
+
+    # uncapped with invalid penalties; capped with a BINDING cap (the
+    # positive topic part 0.7*(8 + 1.3*6) = 11.06 > 4)
+    for cap, n_inv in ((0.0, 3), (4.0, 0)):
+        core_score = run_core(cap, n_inv)
+        sim_score = run_sim(cap, n_inv)
+        assert sim_score == pytest.approx(core_score, rel=1e-5), (
+            cap, sim_score, core_score)
+    # sanity: the binding cap actually changed the value
+    assert run_core(4.0, 0) == pytest.approx(4.0)
+    assert run_core(0.0, 0) > 10.0

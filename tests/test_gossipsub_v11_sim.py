@@ -221,6 +221,68 @@ def test_iwant_flood_retransmission_cutoff():
 
 
 
+def test_gater_shared_ip_fate():
+    """Gater stats are keyed by source IP (peer_gater.go:119-151): a
+    CLEAN sybil sharing an address with an invalid-spamming one inherits
+    its bad goodput, so victims that see both throttle the clean twin's
+    payload too.  P6 is disabled to isolate the gater (the colocation
+    score term would otherwise graylist the pair on its own).
+
+    Topology: arithmetic-progression offsets (±3k) so a spammer at s and
+    its twin at s+3 are co-candidates of most common victims — with
+    random circulant offsets IP siblings are almost never visible to the
+    same receiver and the grouping has nothing to act on."""
+    n, t = 600, 3
+    spammer = np.zeros(n, dtype=bool)
+    spammer[0:120:12] = True                # 10 spammers (topic 0)
+    twin = np.zeros(n, dtype=bool)
+    twin[3:123:12] = True                   # 10 clean twins (topic 0)
+    offsets = tuple(3 * k for k in range(1, 9)) + tuple(
+        -3 * k for k in range(1, 9))
+
+    def run(shared_ip):
+        ip = np.arange(n)
+        if shared_ip:                       # twin k shares spammer k's IP
+            ip[3:123:12] = ip[0:120:12]
+        # spammers flood invalid traffic; twins publish valid messages
+        n_inv, n_val = 60, 10
+        sp_ids = np.flatnonzero(spammer)
+        tw_ids = np.flatnonzero(twin)
+        origin = np.concatenate([np.repeat(sp_ids, n_inv // 10), tw_ids])
+        topic = np.zeros(len(origin), dtype=np.int64)
+        invalid = np.array([True] * n_inv + [False] * n_val)
+        ticks = np.concatenate([
+            np.arange(n_inv, dtype=np.int32) % 12,
+            np.full(n_val, 14, dtype=np.int32)])
+        cfg = GossipSimConfig(offsets=offsets, n_topics=t)
+        subs = np.zeros((n, t), dtype=bool)
+        subs[np.arange(n), np.arange(n) % t] = True
+        sc = ScoreSimConfig(ip_colocation_factor_weight=0.0)
+        params, state = make_gossip_sim(
+            cfg, subs, topic, origin, ticks, score_cfg=sc,
+            sybil=spammer, peer_ip=ip, msg_invalid=invalid)
+        assert (params.cand_same_ip is not None) == shared_ip
+        step = make_gossip_step(cfg, sc)
+        out = gossip_run(params, state, 20, step)
+        # delivery credit earned by twin edges at victims that also see
+        # the paired spammer (the edges the IP grouping acts on)
+        twin_edges = np.stack([np.roll(twin, -o) for o in offsets])
+        spam_sib = np.stack(
+            [np.roll(spammer, -(o - 3)) for o in offsets])
+        gated = twin_edges & spam_sib
+        assert gated.any()
+        fd = np.asarray(out.scores.first_deliveries, dtype=np.float64)
+        return fd[gated].sum()
+
+    fd_shared = run(True)
+    fd_separate = run(False)
+    # with separate IPs those same edges earn normal delivery credit...
+    assert fd_separate > 0.5, fd_separate
+    # ...behind the spammer's IP the gater throttles them hard
+    # (measured ~4x suppression on this deterministic seed)
+    assert fd_shared < 0.35 * fd_separate, (fd_shared, fd_separate)
+
+
 def test_graft_flood_penalized_and_rejected():
     """Backoff-violating GRAFT flooders never enter honest meshes and
     accumulate P7 (gossipsub_spam_test.go:349, gossipsub.go:747-765)."""

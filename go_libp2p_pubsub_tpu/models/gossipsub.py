@@ -105,6 +105,10 @@ class GossipSimConfig:
 
     offsets: tuple[int, ...]       # C candidate ring offsets, ± paired
     n_topics: int = 1
+    # PX rotation toggle (only meaningful with make_gossip_sim's
+    # px_candidates): when False the active candidate set is frozen —
+    # the no-peer-exchange control for recovery experiments.
+    px_rotation: bool = True
     # paired-topic mode: every peer subscribes TWO topics — its residue
     # class r = p mod T and r + T/2 — and keeps a separate mesh per
     # topic slot.  Offsets are then multiples of T/2 (not T), so each
@@ -415,6 +419,13 @@ class GossipState:
     # topic keeps its own mesh + per-edge backoff, gossipsub.go:135)
     mesh_b: jnp.ndarray | None = None        # uint32 [N]
     backoff_b: jnp.ndarray | None = None     # int32 [C, N]
+    # PX-driven candidate refresh (px_candidates): the ACTIVE subset of
+    # the candidate pool a peer currently knows/dials.  PRUNE receipt
+    # rotates the pruned bit out and a fresh candidate in — the sim's
+    # analog of PRUNE-carried peer exchange feeding the connector
+    # (gossipsub.go:856-937): the static pool models the addresses PX
+    # could hand out, the active mask models which are currently held.
+    active: jnp.ndarray | None = None        # uint32 [N]
 
 
 def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
@@ -428,6 +439,7 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
                     msg_invalid: np.ndarray | None = None,
                     flood_proto: np.ndarray | None = None,
                     promise_break: np.ndarray | None = None,
+                    px_candidates: int | None = None,
                     pad_to_block: int | None = None):
     """Build (params, state).  subs: bool [N, T] — but each peer may only
     subscribe to its residue-class topic (circulant classes are closed, so
@@ -599,6 +611,24 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
     zc = lambda: jnp.zeros((c, n), dtype=cdt)  # noqa: E731
     zt = lambda: jnp.zeros((c, n), dtype=jnp.int16)  # noqa: E731
     zbits = lambda: jnp.zeros((n,), dtype=jnp.uint32)  # noqa: E731
+    active0 = None
+    if px_candidates is not None:
+        if not (cfg.d_hi < px_candidates <= c):
+            raise ValueError("need Dhi < px_candidates <= C")
+        # each peer starts knowing a random px_candidates-subset of its
+        # pool (what discovery handed it before any PX)
+        rng0 = np.random.default_rng(seed ^ 0x5F3759DF)
+        act = np.zeros(n_pad, dtype=np.uint32)
+        for p_chunk in range(0, n, 1 << 16):
+            hi = min(n, p_chunk + (1 << 16))
+            rows = np.argsort(
+                rng0.random((hi - p_chunk, c)), axis=1)[:, :px_candidates]
+            bits = np.zeros((hi - p_chunk,), dtype=np.uint32)
+            for k in range(px_candidates):
+                bits |= np.uint32(1) << rows[:, k].astype(np.uint32)
+            act[p_chunk:hi] = bits
+        active0 = jnp.asarray(act)
+
     state = GossipState(
         mesh=zbits(),
         fanout=zbits(),
@@ -628,6 +658,7 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
         mesh_b=(zbits() if cfg.paired_topics else None),
         backoff_b=(jnp.zeros((c, n), dtype=jnp.int32)
                    if cfg.paired_topics else None),
+        active=active0,
     )
     return params, state
 
@@ -968,7 +999,9 @@ def make_gossip_step(cfg: GossipSimConfig,
             mesh=mesh_new, fanout=fanout, last_pub=last_pub,
             backoff=backoff_new, have=have, recent=recent,
             first_tick=first_tick, scores=scores, key=state.key,
-            tick=tick + 1, iwant_serves=state.iwant_serves)
+            tick=tick + 1, iwant_serves=state.iwant_serves,
+            mesh_b=state.mesh_b, backoff_b=state.backoff_b,
+            active=state.active)
         return new_state, delivered_now
 
     def step(params: GossipParams, state: GossipState):
@@ -984,14 +1017,15 @@ def make_gossip_step(cfg: GossipSimConfig,
                 raise ValueError(
                     "pallas step needs make_gossip_sim(pad_to_block=...)")
             if (C > 16 or W == 0 or params.flood_proto is not None
-                    or paired
+                    or paired or state.active is not None
                     or (sc is not None and (sc.track_p3
                                             or sc.flood_publish
                                             or sc.sybil_iwant_spam))):
                 raise ValueError(
                     "config not supported by the pallas step (needs "
                     "C<=16, W>=1, no flood_proto/track_p3/"
-                    "flood_publish/sybil_iwant_spam/paired_topics)")
+                    "flood_publish/sybil_iwant_spam/paired_topics/"
+                    "px_candidates)")
         elif params.n_true is not None:
             raise ValueError(
                 "padded sim state requires the pallas step (XLA rolls "
@@ -1075,6 +1109,8 @@ def make_gossip_step(cfg: GossipSimConfig,
         f_deg = popcount32(fanout)
         f_need = jnp.where(alive, cfg.d - f_deg, 0)
         f_elig = params.cand_sub_bits & ~fanout
+        if state.active is not None:
+            f_elig = f_elig & state.active
         if params.flood_proto is not None:
             # flood-proto peers are flooded unconditionally (out_bits OR
             # below); spending fanout slots on them would cut the
@@ -1152,6 +1188,8 @@ def make_gossip_step(cfg: GossipSimConfig,
             adv.append(aw)
         elig = (params.cand_sub_bits & ~state.mesh & ~state.fanout
                 & sub_all)          # only subscribed peers gossip
+        if state.active is not None:
+            elig = elig & state.active
         if paired:
             # shared gossip stream across the two topic slots (one
             # Dlazy selection covers both; documented deviation from
@@ -1242,6 +1280,8 @@ def make_gossip_step(cfg: GossipSimConfig,
             backoff_bits = pack_rows(backoff0 > tick)
             can_graft = (params.cand_sub_bits & ~mesh_ng & ~backoff_bits
                          & sub_all)
+            if state.active is not None:
+                can_graft = can_graft & state.active
             if params.flood_proto is not None:
                 # floodsub-protocol peers have no mesh: never graft at
                 # them, and they graft at nobody
@@ -1337,7 +1377,7 @@ def make_gossip_step(cfg: GossipSimConfig,
                 a_sent = would_accept | ~accept_bits
             else:
                 a_sent = would_accept
-            return dict(grafts=grafts, dropped=dropped,
+            return dict(grafts=grafts, dropped=dropped, neg=neg,
                         mesh_sel=mesh_sel, backoff_bits2=backoff_bits2,
                         would_accept=would_accept, a_sent=a_sent)
 
@@ -1548,7 +1588,7 @@ def make_gossip_step(cfg: GossipSimConfig,
         # so the grafter keeps exactly the edges the old explicit
         # reject-back retraction kept — bit-identical, one transfer round
         # (C rolls) and one serial dependency shorter.
-        def raw_transfers(sel):
+        def raw_transfers(sel, skip_a=False):
             grafts_s, dropped_s = sel["grafts"], sel["dropped"]
             if C <= 16:
                 # GRAFT+PRUNE masks ride one pair-packed transfer, the
@@ -1561,7 +1601,8 @@ def make_gossip_step(cfg: GossipSimConfig,
             else:
                 graft_recv = transfer_bits(grafts_s, cfg)
                 prune_recv = transfer_bits(dropped_s, cfg)
-            a_recv = transfer_bits(sel["a_sent"], cfg)
+            a_recv = (None if skip_a
+                      else transfer_bits(sel["a_sent"], cfg))
             return graft_recv, prune_recv, a_recv
 
         def resolve(sel, graft_recv, prune_recv, a_recv):
@@ -1579,10 +1620,12 @@ def make_gossip_step(cfg: GossipSimConfig,
             mesh_new = ((sel["mesh_sel"] | accept) & ~prune_recv
                         ) & ~retract
             bo_trig = sel["dropped"] | prune_recv | retract
-            return mesh_new, bo_trig, violation
+            # PRUNE receipt and PRUNE-responses both carry PX records
+            # in the reference (gossipsub.go:856-937)
+            return mesh_new, bo_trig, violation, prune_recv | retract
 
         if not paired:
-            mesh, bo_trigger, backoff_violation = resolve(
+            mesh, bo_trigger, backoff_violation, px_rot = resolve(
                 sel_a, *raw_transfers(sel_a))
             mesh_b_new = violation_b = None
         else:
@@ -1596,14 +1639,56 @@ def make_gossip_step(cfg: GossipSimConfig,
                 1 << c_ for c_, o_ in enumerate(offsets)
                 if (o_ % cfg.n_topics) == 0))
             odd = ~even & ALL
-            ga, pa, aa = raw_transfers(sel_a)
-            gb, pb, ab = raw_transfers(sel_b)
-            mesh, bo_trigger, backoff_violation = resolve(
+            ga, pa, _ = raw_transfers(sel_a, skip_a=True)
+            gb, pb, _ = raw_transfers(sel_b, skip_a=True)
+            # both slots' A masks ride ONE pair-packed transfer
+            # (paired mode enforces C <= 16)
+            a_both = transfer_bits(
+                sel_a["a_sent"] | (sel_b["a_sent"] << jnp.uint32(16)),
+                cfg, pair=True)
+            aa = a_both & ALL
+            ab = a_both >> jnp.uint32(16)
+            mesh, bo_trigger, backoff_violation, px_a = resolve(
                 sel_a, (ga & even) | (gb & odd),
                 (pa & even) | (pb & odd), (aa & even) | (ab & odd))
-            mesh_b_new, bo_trigger_b, violation_b = resolve(
+            mesh_b_new, bo_trigger_b, violation_b, px_b = resolve(
                 sel_b, (gb & even) | (ga & odd),
                 (pb & even) | (pa & odd), (ab & even) | (aa & odd))
+            px_rot = px_a | px_b
+
+        # -- 4b. PX-driven candidate refresh (gossipsub.go:856-937).
+        # A received PRUNE (or PRUNE-response) carries peer-exchange
+        # records; the pruned peer drops that address from its active
+        # set and dials a fresh candidate from the pool instead —
+        # modeling topology recovery after mass pruning.  Edges still
+        # in any mesh/fanout are never deactivated.
+        active_new = state.active
+        if state.active is not None and cfg.px_rotation:
+            # rotation triggers: received PRUNEs / PRUNE-responses (the
+            # PX carriers) AND our own negative-score drops — after
+            # cutting a misbehaving peer, its address slot is re-filled
+            # from the pool (the connector dialing PX-learned addresses,
+            # gossipsub.go:1594-1616)
+            rot = px_rot
+            if sel_a["neg"] is not None:
+                rot = rot | sel_a["neg"]
+            if paired and sel_b["neg"] is not None:
+                rot = rot | sel_b["neg"]
+            keep = mesh | fanout
+            if paired:
+                keep = keep | mesh_b_new
+            deact = rot & state.active & ~keep
+            n_rot = popcount32(deact)
+            pool_new = ~state.active & params.cand_sub_bits & ALL
+            repl = jax.lax.cond(
+                jnp.any(n_rot > 0),
+                lambda: sel_k(pool_new, n_rot, u_spec(7)),
+                lambda: jnp.zeros_like(state.active))
+            # live connections are held addresses: an ACCEPTED inbound
+            # GRAFT teaches the grafter's address even if it wasn't in
+            # the active set (the dialer always knows the dialee), so
+            # mesh/fanout edges fold in and mesh ⊆ active is invariant
+            active_new = (state.active & ~deact) | repl | keep
 
         # -- 5. score counter updates + decay ---------------------------
         # (array-level on purpose: a row-wise variant was measured 1.7x
@@ -1694,7 +1779,7 @@ def make_gossip_step(cfg: GossipSimConfig,
             mesh=mesh, fanout=fanout, last_pub=last_pub, backoff=backoff,
             have=have, recent=recent, first_tick=first_tick, scores=scores,
             key=state.key, tick=tick + 1, iwant_serves=iwant_serves,
-            mesh_b=mesh_b_new, backoff_b=backoff_b)
+            mesh_b=mesh_b_new, backoff_b=backoff_b, active=active_new)
         return new_state, delivered_now
 
     return step

@@ -15,5 +15,6 @@ from .replay import (
     reach_by_hops_from_trace,
     run_core_floodsub,
     run_core_gossipsub,
+    run_core_gossipsub_multitopic,
     run_core_randomsub,
 )

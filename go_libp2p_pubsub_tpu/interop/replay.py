@@ -186,27 +186,34 @@ def circulant_edges(offsets, n: int) -> list[tuple[int, int]]:
 
 async def _run_cluster(n: int, edges, publishers, make_psub,
                        warm_s: float, settle_s: float,
-                       spam=None, collect=None) -> TraceRun:
+                       spam=None, collect=None,
+                       topics_for=None) -> TraceRun:
     """Shared cluster driver: build n hosts + pubsubs (make_psub(host,
     tracer, i)), join/subscribe all, wire ``edges``, wait ``warm_s`` for
     the overlay to settle (gossipsub mesh formation), publish, drain.
 
     ``spam``: optional async callable(hosts, net) run after warm-up to
-    inject adversarial wire traffic (scripted mock peers)."""
+    inject adversarial wire traffic (scripted mock peers).
+    ``topics_for(i)``: topic names host i joins (default: ["interop"]).
+    ``publishers`` entries are peer indices (topic "interop") or
+    (peer index, topic name) pairs."""
     import random as _random
 
     from ..core import InProcNetwork
     from ..core.testing import connect, get_hosts
 
+    if topics_for is None:
+        topics_for = lambda i: ["interop"]  # noqa: E731
     net = InProcNetwork()
     hosts = get_hosts(net, n)
     tracers = [ListTracer() for _ in range(n)]
     psubs = [await make_psub(h, t, i)
              for i, (h, t) in enumerate(zip(hosts, tracers))]
     subs = []
-    for ps in psubs:
-        topic = await ps.join("interop")
-        subs.append(await topic.subscribe())
+    for i, ps in enumerate(psubs):
+        for tname in topics_for(i):
+            topic = await ps.join(tname)
+            subs.append(await topic.subscribe())
     seen = set()
     for i, j in edges:
         key = (min(i, j), max(i, j))
@@ -219,8 +226,10 @@ async def _run_cluster(n: int, edges, publishers, make_psub,
         await spam(hosts, net)
 
     origins = []
-    for o in publishers:
-        topic = await psubs[o].join("interop")
+    for entry in publishers:
+        o, tname = (entry if isinstance(entry, tuple)
+                    else (entry, "interop"))
+        topic = await psubs[o].join(tname)
         await topic.publish(b"interop msg %d from %d"
                             % (len(origins), o))
         origins.append(o)
@@ -236,10 +245,10 @@ async def _run_cluster(n: int, edges, publishers, make_psub,
     by_origin = {
         o: [ev.publish_message.message_id for ev in tracers[o].events
             if ev.type == TraceType.PUBLISH_MESSAGE]
-        for o in set(publishers)}
+        for o in set(origins)}
     taken: dict[int, int] = {}
     msg_ids = []
-    for o in publishers:
+    for o in origins:
         k = taken.get(o, 0)
         msg_ids.append(by_origin[o][k])
         taken[o] = k + 1
@@ -317,3 +326,47 @@ def mean_reach_fraction(curve: np.ndarray, n_members: int) -> np.ndarray:
     """[max_hops] mean (over messages) fraction of members reached by
     each hop — the statistic the 1% BASELINE envelope is stated over."""
     return np.asarray(curve, dtype=np.float64).mean(axis=0) / n_members
+
+
+def run_core_gossipsub_multitopic(offsets, n: int, n_topics: int,
+                                  publishers, *,
+                                  d: int = 3, d_lo: int = 2,
+                                  d_hi: int = 6, d_score: int = 2,
+                                  d_out: int = 1, d_lazy: int = 2,
+                                  heartbeat_s: float = 0.05,
+                                  warm_s: float = 1.5,
+                                  settle_s: float = 1.2,
+                                  seed: int = 42) -> TraceRun:
+    """Real gossipsub cluster with OVERLAPPING topic membership: host i
+    joins topics t{r} and t{r2} (r = i mod T, r2 = r + T/2 — the
+    simulator's paired-topic model), the reference router keeps a mesh
+    per topic (gossipsub.go:135), and each (origin, topic_index) pair
+    publishes on the named topic — the core-side twin of paired mode."""
+    import random as _random
+
+    from ..core import GossipSubParams, create_gossipsub
+
+    async def make_psub(host, tracer, i):
+        gp = GossipSubParams(
+            d=d, d_lo=d_lo, d_hi=d_hi, d_score=d_score, d_out=d_out,
+            d_lazy=d_lazy,
+            heartbeat_initial_delay=0.01, heartbeat_interval=heartbeat_s)
+        return await create_gossipsub(
+            host, gossipsub_params=gp, event_tracer=tracer,
+            router_rng=_random.Random(seed * 1000 + i))
+
+    def topics_for(i):
+        r = i % n_topics
+        r2 = (r + n_topics // 2) % n_topics
+        return [f"t{r}", f"t{r2}"]
+
+    def collect(psubs):
+        return {"mesh_degrees": [
+            [len(ps.router.mesh.get(f"t{tau}", ()))
+             for tau in range(n_topics)] for ps in psubs]}
+
+    pubs = [(o, f"t{tau}") for o, tau in publishers]
+    edges = circulant_edges(offsets, n)
+    return asyncio.run(_run_cluster(
+        n, edges, pubs, make_psub, warm_s, settle_s,
+        collect=collect, topics_for=topics_for))

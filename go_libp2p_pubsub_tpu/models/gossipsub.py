@@ -1679,7 +1679,9 @@ def make_gossip_step(cfg: GossipSimConfig,
                 keep = keep | mesh_b_new
             deact = rot & state.active & ~keep
             n_rot = popcount32(deact)
-            pool_new = ~state.active & params.cand_sub_bits & ALL
+            # exclude edges already folding in via keep, or a rotation
+            # slot would be wasted re-selecting one of them
+            pool_new = ~state.active & ~keep & params.cand_sub_bits & ALL
             repl = jax.lax.cond(
                 jnp.any(n_rot > 0),
                 lambda: sel_k(pool_new, n_rot, u_spec(7)),

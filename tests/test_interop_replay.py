@@ -301,3 +301,60 @@ def test_randomsub_core_vs_sim_reach_curves():
             break
     else:
         raise AssertionError(f"envelope breach after retry: {last}")
+
+
+@pytest.mark.slow
+def test_gossipsub_multitopic_core_vs_sim_reach_curves():
+    """Overlapping topic membership, core vs sim: a real cluster whose
+    hosts each join TWO topics (the reference router keeps a mesh per
+    topic) against the paired-topic simulator on the SAME multiples-of-
+    T/2 circulant.  Every message must reach its topic's full
+    membership (both residue classes) on both sides, with the mean
+    reach curves matching within the same envelope/retry policy as the
+    single-topic gate.  Sim hop h aligns with core hop h+1."""
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    from go_libp2p_pubsub_tpu.interop import (
+        mean_reach_fraction, run_core_gossipsub_multitopic)
+
+    n, T, C, M = 64, 4, 10, 16
+    offsets = gs.make_gossip_offsets(T, C, n, seed=6, paired=True)
+    rng = np.random.default_rng(8)
+    own = np.arange(n) % T
+    second = (own + T // 2) % T
+    pubs = []
+    for j in range(M):
+        tau = int(rng.integers(0, T))
+        members = np.flatnonzero((own == tau) | (second == tau))
+        pubs.append((int(rng.choice(members)), tau))
+
+    cfg = gs.GossipSimConfig(
+        offsets=offsets, n_topics=T, paired_topics=True,
+        d=3, d_lo=2, d_hi=6, d_score=2, d_out=1, d_lazy=0,
+        gossip_factor=0.0)
+    subs = np.zeros((n, T), dtype=bool)
+    subs[np.arange(n), own] = True
+    subs[np.arange(n), second] = True
+    params, state = gs.make_gossip_sim(
+        cfg, subs, np.array([t for _, t in pubs], np.int64),
+        np.array([o for o, _ in pubs]),
+        np.full(M, 90, np.int32))
+    out = gs.gossip_run(params, state, 110, gs.make_gossip_step(cfg))
+    sim_curve = np.asarray(gs.reach_by_hops(params, out, 12))
+    sim_mean = mean_reach_fraction(sim_curve, n // 2)
+    assert sim_mean[-1] == 1.0, sim_mean    # fail fast on sim regression
+
+    last = None
+    for warm_s, settle_s in ((1.5, 1.2), (3.0, 2.0)):
+        run = run_core_gossipsub_multitopic(
+            offsets, n, T, pubs, warm_s=warm_s, settle_s=settle_s)
+        core_mean = mean_reach_fraction(
+            reach_by_hops_from_trace(run, 13), n // 2)
+        delta = np.abs(core_mean[1:13] - sim_mean)
+        last = (delta.max(), core_mean, sim_mean)
+        if core_mean[-1] == 1.0 and delta.max() < 0.17:
+            break
+    else:
+        raise AssertionError(f"multitopic envelope breach: {last}")
+    # and the reference router really kept two meshes per host
+    degs = np.array(run.extra["mesh_degrees"])   # [n, T]
+    assert ((degs > 0).sum(axis=1) == 2).mean() > 0.9

@@ -263,13 +263,14 @@ async def _run_cluster(n: int, edges, publishers, make_psub,
                     peer_index=peer_index, n_peers=n, extra=extra)
 
 
-def run_core_gossipsub(offsets, n: int, publishers: list[int], *,
+def run_core_gossipsub(offsets, n: int, publishers, *,
                        d: int = 3, d_lo: int = 2, d_hi: int = 6,
                        d_score: int = 2, d_out: int = 1, d_lazy: int = 2,
                        score_params=None, score_thresholds=None,
                        heartbeat_s: float = 0.05, warm_s: float = 1.0,
                        settle_s: float = 1.0, seed: int = 42,
-                       spam=None) -> TraceRun:
+                       spam=None, topics_for=None,
+                       collect=None) -> TraceRun:
     """Real gossipsub cluster over the SAME circulant candidate graph the
     simulator uses: hosts connect only along candidate edges, the mesh
     forms as a random D-degree subgraph of them via GRAFT/PRUNE — the
@@ -292,14 +293,16 @@ def run_core_gossipsub(offsets, n: int, publishers: list[int], *,
             host, gossipsub_params=gp, event_tracer=tracer,
             router_rng=_random.Random(seed * 1000 + i), **kw)
 
-    def collect(psubs):
-        return {"mesh_degrees": [
-            len(ps.router.mesh.get("interop", ())) for ps in psubs]}
+    if collect is None:
+        def collect(psubs):
+            return {"mesh_degrees": [
+                len(ps.router.mesh.get("interop", ())) for ps in psubs]}
 
     edges = circulant_edges(offsets, n)
     return asyncio.run(_run_cluster(n, edges, publishers, make_psub,
                                     warm_s, settle_s, spam=spam,
-                                    collect=collect))
+                                    collect=collect,
+                                    topics_for=topics_for))
 
 
 def run_core_randomsub(n: int, publishers: list[int], *,
@@ -330,30 +333,15 @@ def mean_reach_fraction(curve: np.ndarray, n_members: int) -> np.ndarray:
 
 def run_core_gossipsub_multitopic(offsets, n: int, n_topics: int,
                                   publishers, *,
-                                  d: int = 3, d_lo: int = 2,
-                                  d_hi: int = 6, d_score: int = 2,
-                                  d_out: int = 1, d_lazy: int = 2,
-                                  heartbeat_s: float = 0.05,
                                   warm_s: float = 1.5,
                                   settle_s: float = 1.2,
-                                  seed: int = 42) -> TraceRun:
+                                  **kw) -> TraceRun:
     """Real gossipsub cluster with OVERLAPPING topic membership: host i
     joins topics t{r} and t{r2} (r = i mod T, r2 = r + T/2 — the
     simulator's paired-topic model), the reference router keeps a mesh
     per topic (gossipsub.go:135), and each (origin, topic_index) pair
-    publishes on the named topic — the core-side twin of paired mode."""
-    import random as _random
-
-    from ..core import GossipSubParams, create_gossipsub
-
-    async def make_psub(host, tracer, i):
-        gp = GossipSubParams(
-            d=d, d_lo=d_lo, d_hi=d_hi, d_score=d_score, d_out=d_out,
-            d_lazy=d_lazy,
-            heartbeat_initial_delay=0.01, heartbeat_interval=heartbeat_s)
-        return await create_gossipsub(
-            host, gossipsub_params=gp, event_tracer=tracer,
-            router_rng=_random.Random(seed * 1000 + i))
+    publishes on the named topic — the core-side twin of paired mode.
+    Thin wrapper over run_core_gossipsub (all its options apply)."""
 
     def topics_for(i):
         r = i % n_topics
@@ -366,7 +354,6 @@ def run_core_gossipsub_multitopic(offsets, n: int, n_topics: int,
              for tau in range(n_topics)] for ps in psubs]}
 
     pubs = [(o, f"t{tau}") for o, tau in publishers]
-    edges = circulant_edges(offsets, n)
-    return asyncio.run(_run_cluster(
-        n, edges, pubs, make_psub, warm_s, settle_s,
-        collect=collect, topics_for=topics_for))
+    return run_core_gossipsub(
+        offsets, n, pubs, warm_s=warm_s, settle_s=settle_s,
+        topics_for=topics_for, collect=collect, **kw)

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+N_SLOTS = 4        # DMA prefetch depth (edges in flight)
 ALIGN32 = 1024     # u32 1-D DMA slice alignment (8 x 128 tile)
 ALIGN8 = 4096      # u8 alignment (32 x 128 tile)
 
@@ -149,11 +150,11 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     out_bo = nxt()
     if has_sc:
         out_fd, out_inv, out_bp, out_tim = nxt(), nxt(), nxt(), nxt()
-    cbufs = [nxt(), nxt()]
+    cbufs = [nxt() for _ in range(N_SLOTS)]
     # payload buffers: [slot][fresh w... adv w...], all separate 1-D
     # scratches (DMA into a row of a 2-D VMEM buffer hits sublane
     # alignment limits)
-    pbufs = [[nxt() for _ in range(2 * W)] for _ in range(2)]
+    pbufs = [[nxt() for _ in range(2 * W)] for _ in range(N_SLOTS)]
     sems = nxt()
 
     i = pl.program_id(0)
@@ -175,7 +176,7 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
         return pltpu.make_async_copy(
             hbm.at[pl.ds(start, B + ALIGN32)],
             pbufs[slot][k * W + w],
-            sems.at[2 + slot * 2 * W + k * W + w])
+            sems.at[N_SLOTS + slot * 2 * W + k * W + w])
 
     def start_all(slot, j):
         dma_ctrl(slot, j).start()
@@ -189,7 +190,8 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
             dma_pay(slot, j, 0, w).wait()
             dma_pay(slot, j, 1, w).wait()
 
-    start_all(0, 0)
+    for j0 in range(min(N_SLOTS - 1, C)):
+        start_all(j0 % N_SLOTS, j0)
 
     sub_all = sub_ref[...]
     if has_sc:
@@ -214,10 +216,10 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     broken_recv = jnp.zeros((B,), jnp.uint32)
 
     for j in range(C):
-        if j + 1 < C:
-            start_all((j + 1) % 2, j + 1)
-        wait_all(j % 2, j)
-        slot = j % 2
+        if j + N_SLOTS - 1 < C:
+            start_all((j + N_SLOTS - 1) % N_SLOTS, j + N_SLOTS - 1)
+        wait_all(j % N_SLOTS, j)
+        slot = j % N_SLOTS
         # widen BEFORE the realign roll: mosaic has no i8 lane-rotate
         ctrl = _flat_roll(cbufs[slot][...].astype(jnp.uint32),
                           c_deltas[j], B)
@@ -385,9 +387,9 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
         out_specs += [bc()] * 4
 
     scratch = (
-        [pltpu.VMEM((B + ALIGN8,), jnp.uint8)] * 2
-        + [pltpu.VMEM((B + ALIGN32,), jnp.uint32)] * (4 * W)
-        + [pltpu.SemaphoreType.DMA((2 + 4 * W,))]
+        [pltpu.VMEM((B + ALIGN8,), jnp.uint8)] * N_SLOTS
+        + [pltpu.VMEM((B + ALIGN32,), jnp.uint32)] * (N_SLOTS * 2 * W)
+        + [pltpu.SemaphoreType.DMA((N_SLOTS * (1 + 2 * W),))]
     )
 
     return pl.pallas_call(

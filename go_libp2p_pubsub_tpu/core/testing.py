@@ -48,3 +48,22 @@ async def connect_all(hosts: list[Host]) -> None:
 async def settle(seconds: float = 0.05) -> None:
     """Let in-flight tasks and queues drain."""
     await asyncio.sleep(seconds)
+
+
+async def settle_until(predicate, timeout: float = 5.0,
+                       interval: float = 0.05) -> bool:
+    """Poll ``predicate()`` until true or ``timeout`` elapses.
+
+    Condition-based settling replaces fixed sleeps in cluster tests: under
+    suite load the event loop may run heartbeats late, so a wall-clock
+    sleep admits states mid-convergence (the fragility SURVEY.md §4 notes
+    in the reference's sleep-based tests). Returns the final predicate
+    value so callers can still assert it.
+    """
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        if predicate():
+            return True
+        if asyncio.get_event_loop().time() >= deadline:
+            return bool(predicate())
+        await asyncio.sleep(interval)

@@ -9,5 +9,6 @@ from go_libp2p_pubsub_tpu.core.testing import (  # noqa: F401
     dense_connect,
     get_hosts,
     settle,
+    settle_until,
     sparse_connect,
 )

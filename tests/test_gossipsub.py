@@ -35,7 +35,8 @@ from go_libp2p_pubsub_tpu.pb import (
     SubOpts,
 )
 from go_libp2p_pubsub_tpu.pb.proto import write_delimited
-from helpers import connect, connect_all, dense_connect, get_hosts, settle
+from helpers import (connect, connect_all, dense_connect, get_hosts, settle,
+                     settle_until)
 
 def fast_params(**kw):
     p = GossipSubParams(heartbeat_initial_delay=0.01, heartbeat_interval=0.05)
@@ -137,8 +138,18 @@ async def test_mesh_degree_bounds():
         topic = await ps.join("mesh-topic")
         await topic.subscribe()
     await connect_all(hosts)
-    await settle(0.6)
 
+    def converged():
+        for ps in psubs:
+            mesh = ps.router.mesh.get("mesh-topic", set())
+            if not (ps.router.params.d_lo <= len(mesh)
+                    <= ps.router.params.d_hi):
+                return False
+        return True
+
+    # Heartbeats fire late under suite load; poll for convergence instead
+    # of a fixed sleep.
+    await settle_until(converged, timeout=8.0)
     for ps in psubs:
         mesh = ps.router.mesh.get("mesh-topic", set())
         assert len(mesh) >= ps.router.params.d_lo

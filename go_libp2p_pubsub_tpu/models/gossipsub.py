@@ -136,6 +136,19 @@ class GossipSimConfig:
     gossip_retransmission: int = 3   # GossipSubGossipRetransmission
     max_ihave_length: int = 5000     # GossipSubMaxIHaveLength
     max_ihave_messages: int = 10     # GossipSubMaxIHaveMessages
+    # Gossip-target sampling backend.  The reference draws an exact
+    # uniform k-subset of the eligible peers per heartbeat (emitGossip
+    # gossipsub.go:1656-1712).  True = per-edge Bernoulli(k/|elig|):
+    # identical per-edge inclusion probability, so gossip coverage and
+    # every score/penalty rate driven by it match in expectation; only
+    # the per-peer target-count distribution widens (binomial vs
+    # degenerate, same mean — the CLT equivalence argument documented
+    # for the RandomSub fanout, models/randomsub.py).  On TPU the
+    # Bernoulli form is one hashed-uniform compare, while exact-k needs
+    # the [C, C, N] rank-compare — ~600 us/tick of the v1.1 flagship
+    # step, the single largest always-on cost after the payload rolls.
+    # False restores exact-k (validation/equivalence studies).
+    binomial_gossip_sampling: bool = True
 
     def __post_init__(self):
         offs = np.asarray(self.offsets, dtype=np.int64)
@@ -168,6 +181,9 @@ class GossipSimConfig:
                 "need HistoryGossip <= HistoryLength (gossipsub.go:47)")
         if self.gossip_retransmission < 1:
             raise ValueError("gossip_retransmission must be >= 1")
+        if not (1 <= self.backoff_ticks <= 32767):
+            raise ValueError(
+                "backoff_ticks must fit int16 remaining-tick storage")
         if self.max_ihave_length < 1 or self.max_ihave_messages < 1:
             raise ValueError("IHAVE caps must be >= 1")
 
@@ -282,6 +298,22 @@ class ScoreSimConfig:
     counter_dtype: str = "bfloat16"
 
     @property
+    def bp_dtype(self) -> str:
+        """behaviour_penalty storage dtype.
+
+        P7 increments are at most +2 per edge-tick (a backoff violation
+        plus a broken promise), so the decaying counter's worst-case
+        steady state is 2/(1-decay).  When that stays far below bf16's
+        +1-absorption point (256) the counter stores in counter_dtype
+        like the others; configs with very slow decay keep f32 (the
+        stick-at-256 hazard the round-1 note recorded)."""
+        if jnp.dtype(self.counter_dtype) == jnp.float32:
+            return "float32"
+        if 2.0 / (1.0 - self.behaviour_penalty_decay) < 128.0:
+            return self.counter_dtype
+        return "float32"
+
+    @property
     def track_p3(self) -> bool:
         """P3/P3b bookkeeping (mesh-delivery deficits) is skipped entirely
         when both weights are 0 — the shipped default, mirroring that the
@@ -392,7 +424,8 @@ class ScoreState:
     mesh_deliveries: jnp.ndarray     # f32 [C, N] decaying counter (P3)
     mesh_failure_penalty: jnp.ndarray  # f32 [C, N] sticky deficit² (P3b)
     invalid_deliveries: jnp.ndarray  # f32 [C, N] decaying counter (P4)
-    behaviour_penalty: jnp.ndarray   # f32 [C, N] decaying counter (P7)
+    behaviour_penalty: jnp.ndarray   # [C, N] decaying counter (P7;
+    #   dtype = ScoreSimConfig.bp_dtype)
     # paired-topic mode only: P1 for the SECOND topic slot's mesh (the
     # other counters aggregate across the two equal-weight topics; time
     # in mesh is per-topic because the meshes differ)
@@ -426,6 +459,21 @@ class GossipState:
     # (gossipsub.go:856-937): the static pool models the addresses PX
     # could hand out, the active mask models which are currently held.
     active: jnp.ndarray | None = None        # uint32 [N]
+    # pipelined score gates: THIS tick's packed threshold/gater/backoff
+    # gate words, emitted at the END of the previous tick while the
+    # updated counters were still in registers (or in the pallas
+    # kernel's VMEM) — so the tick prologue never re-reads the [C, N]
+    # counter state.  Bit-identical to recomputing at tick start: the
+    # gates are pure functions of (counters, backoff, mesh) and the
+    # emission applies the same storage rounding the prologue would
+    # read back.  A TUPLE of separate [N] words, NOT a stacked [G, N]
+    # array: slicing row g of a [G, N] T(8,128) array reads whole
+    # sublane tiles and discards (G-1)/G of the bandwidth (measured
+    # ~160 us/row at 1M — the same penalty PERF_NOTES records for
+    # row-wise counter ops).  Order (see compute_gates): scored
+    # (accept, gossip, publish, nonneg, payload, backoff(, backoff_b));
+    # unscored (backoff(, backoff_b)).
+    gates: tuple | None = None               # tuple of uint32 [N]
 
 
 def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
@@ -633,21 +681,24 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
         mesh=zbits(),
         fanout=zbits(),
         last_pub=jnp.full((n,), -(10 ** 9), dtype=jnp.int32),
-        backoff=jnp.zeros((c, n), dtype=jnp.int32),
+        # backoff is REMAINING ticks (int16, decremented each tick;
+        # 0 = free) rather than an absolute expiry tick: same blocking
+        # semantics, half the per-tick HBM traffic of an i32 [C, N]
+        # array, and the gate row becomes tick-independent (> 0)
+        backoff=jnp.zeros((c, n), dtype=jnp.int16),
         have=jnp.zeros((w, n), dtype=jnp.uint32),
         recent=jnp.zeros((cfg.history_gossip, w, n), dtype=jnp.uint32),
         first_tick=(jnp.full((w, WORD_BITS, n), -1, dtype=jnp.int16)
                     if track_first_tick else None),
-        # behaviour_penalty stays f32 regardless of counter_dtype: it
-        # grows by +1.0 per violation, and bf16 absorbs increments past
-        # 256 (the same stick-at-256 hazard that moved time_in_mesh to
-        # int16) — sustained-spam magnitudes would diverge from the
-        # reference.  It is one counter of six, so the HBM cost is small.
+        # behaviour_penalty storage: counter_dtype when the config's
+        # decay bounds its magnitude safely below bf16's +1-absorption
+        # point, else f32 (ScoreSimConfig.bp_dtype)
         scores=(ScoreState(time_in_mesh=zt(), first_deliveries=zc(),
                            mesh_deliveries=zc(), mesh_failure_penalty=zc(),
                            invalid_deliveries=zc(),
                            behaviour_penalty=jnp.zeros(
-                               (c, n), dtype=jnp.float32),
+                               (c, n),
+                               dtype=jnp.dtype(score_cfg.bp_dtype)),
                            time_in_mesh_b=(zt() if cfg.paired_topics
                                            else None))
                 if score_cfg is not None else None),
@@ -656,10 +707,15 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
         iwant_serves=(zt() if score_cfg is not None
                       and score_cfg.sybil_iwant_spam else None),
         mesh_b=(zbits() if cfg.paired_topics else None),
-        backoff_b=(jnp.zeros((c, n), dtype=jnp.int32)
+        backoff_b=(jnp.zeros((c, n), dtype=jnp.int16)
                    if cfg.paired_topics else None),
         active=active0,
     )
+    # seed the gate pipeline: tick 0's gate words, exactly what the
+    # step's epilogue would have emitted at the end of tick -1
+    state = state.replace(gates=compute_gates(
+        cfg, score_cfg, params, state,
+        jax.random.key_data(state.key)[-1]))
     return params, state
 
 
@@ -844,13 +900,109 @@ def score_snapshot(sc: ScoreSimConfig, params: GossipParams,
     return out
 
 
+def compute_gates(cfg: GossipSimConfig, sc: ScoreSimConfig | None,
+                  params: GossipParams, st: GossipState,
+                  salt: jnp.ndarray) -> tuple:
+    """Packed per-tick gate words (tuple of G uint32 [N]) for ``st.tick``.
+
+    The tick prologue's entire read of the [C, N] numeric state, packed
+    into G uint32 words per peer.  Scored rows (in order):
+
+      0 accept   — score >= graylist threshold (AcceptFrom,
+                   gossipsub.go:584)
+      1 gossip   — score >= gossip threshold (handleIHave/emitGossip,
+                   gossipsub.go:610,1681)
+      2 publish  — score >= publish threshold (gossipsub.go:956)
+      3 nonneg   — score >= 0 (mesh retention/graft, gossipsub.go:1340)
+      4 payload  — accept ∧ RED-gater draw (peer_gater.go:320-363)
+      5 backoff  — remaining backoff > 0 (no re-GRAFT, gossipsub.go:747)
+      6 backoff_b (paired mode only)
+
+    Unscored sims carry only the backoff row(s).
+
+    The step normally does NOT call this at tick start: the previous
+    tick's epilogue (or the pallas receive kernel) emits the same rows
+    while the updated counters are still in registers/VMEM, and the
+    result rides the state (``GossipState.gates``).  Emission applies
+    the same storage rounding (bf16 counters) a tick-start recompute
+    would read back, so the two formulations are bit-identical;
+    tests/test_gossipsub_sim.py::test_pipelined_gates_match_recompute
+    pins them against each other.
+    """
+    C = cfg.n_candidates
+    n = st.mesh.shape[0]
+    n_stream = params.n_true if params.n_true is not None else n
+    tick = st.tick
+    ALL = jnp.uint32((1 << C) - 1)
+    Z = jnp.uint32(0)
+    rows = []
+    if sc is not None:
+        score = compute_scores(sc, params, st)              # [C, N]
+        accept_bits = pack_rows(score >= sc.graylist_threshold)
+        rows = [accept_bits,
+                pack_rows(score >= sc.gossip_threshold),
+                pack_rows(score >= sc.publish_threshold),
+                pack_rows(score >= 0)]
+        # RED gater: under invalid-traffic pressure, payload from an
+        # edge is accepted with its goodput probability
+        # (peer_gater.go:320-363).  Stats are keyed by SOURCE IP
+        # (peer_gater.go:119-151): when candidates share an address
+        # (cand_same_ip, built only if some IP is shared) each edge's
+        # goodput uses the sums over its same-IP siblings, so sybils
+        # behind one address share fate at the gater as in the
+        # reference — not just through the P6 score term.
+        s0 = st.scores
+        f32 = lambda x: x.astype(jnp.float32)  # noqa: E731
+        invd = f32(s0.invalid_deliveries)
+        fdel = f32(s0.first_deliveries)
+        inv_tot = invd.sum(axis=0)                          # [N]
+        del_tot = fdel.sum(axis=0)
+        pressure = 16.0 * inv_tot / (1.0 + del_tot + 16.0 * inv_tot)
+        gater_on = pressure > 0.33
+        if params.cand_same_ip is not None:
+            inv_g = jnp.zeros_like(invd)
+            fd_g = jnp.zeros_like(fdel)
+            for cc in range(C):
+                sib = expand_bits(params.cand_same_ip[cc], C)  # [C, N]
+                inv_g = inv_g + jnp.where(sib, invd[cc][None, :], 0.0)
+                fd_g = fd_g + jnp.where(sib, fdel[cc][None, :], 0.0)
+        else:
+            inv_g, fd_g = invd, fdel
+        goodput = (1.0 + fd_g) / (1.0 + fd_g + 16.0 * inv_g)
+        u_gater = lane_uniform((C, n), tick, 6, salt, stride=n_stream)
+        gater_bits = pack_rows(u_gater < goodput) | jnp.where(
+            gater_on, Z, ALL)
+        rows.append(accept_bits & gater_bits)               # payload
+    rows.append(pack_rows(st.backoff > 0))
+    if cfg.paired_topics:
+        rows.append(pack_rows(st.backoff_b > 0))
+    # a TUPLE of [N] words — stacking into [G, N] would make every row
+    # read a sublane-sliced tile read (see GossipState.gates)
+    return tuple(rows)
+
+
+def refresh_gates(cfg: GossipSimConfig, sc: ScoreSimConfig | None,
+                  params: GossipParams, st: GossipState) -> GossipState:
+    """Recompute the carried gate words after manual state surgery.
+
+    The pipelined gates are a pure function of (counters, backoff,
+    mesh); any test/tool that edits those fields directly via
+    ``state.replace`` must refresh them or the next tick acts on stale
+    gates."""
+    if st.gates is None:
+        return st
+    return st.replace(gates=compute_gates(
+        cfg, sc, params, st, jax.random.key_data(st.key)[-1]))
+
+
 def make_gossip_step(cfg: GossipSimConfig,
                      score_cfg: ScoreSimConfig | None = None,
                      use_pallas_select: bool | None = None,
                      use_pallas_receive: bool | None = None,
                      receive_block: int = 8192,
                      receive_interpret: bool = False,
-                     force_split: bool = False):
+                     force_split: bool = False,
+                     pipeline_gates: bool = True):
     """Build the jittable (params, state) -> (state, delivered_words) core.
 
     Per tick:
@@ -913,7 +1065,7 @@ def make_gossip_step(cfg: GossipSimConfig,
                        fresh, adv, targets, withhold, out_bits, grafts,
                        dropped, mesh_sel, a_sent, would_accept,
                        backoff_bits2, sub_all, payload_bits,
-                       gossip_bits, accept_bits, valid_w, tick):
+                       gossip_bits, accept_bits, valid_w, tick, salt):
         """Pallas path: one mega-kernel does the payload receive,
         handshake resolution, and per-edge counter/backoff updates in
         a single HBM pass over the [C, N] state (ops/pallas/receive)."""
@@ -960,7 +1112,8 @@ def make_gossip_step(cfg: GossipSimConfig,
         seen_st = jnp.stack([state.have[w] | injected[w]
                              for w in range(W)])
         inj_st = jnp.stack(injected)
-        tickb = (tick + cfg.backoff_ticks).astype(jnp.int32).reshape(1)
+        # the mixed gater seed for the next tick's phase-6 uniform draw
+        gseed = lane_seed(tick + 1, 6, salt).reshape(1)
         cdt = (jnp.dtype(sc.counter_dtype) if sc is not None else None)
         krn = make_receive_update(cfg, sc, n_true, receive_block, cdt,
                                   W, track_promises=track_promises,
@@ -968,17 +1121,21 @@ def make_gossip_step(cfg: GossipSimConfig,
         args = []
         if sc is not None:
             args.append(jnp.stack(valid_w))
-        args += [tickb, ctrl_flat, fresh_flat, adv_flat]
+        args += [gseed, ctrl_flat, fresh_flat, adv_flat]
         if sc is not None:
             args += [payload_bits, gossip_bits, accept_bits]
         args += [sub_all, would_accept, backoff_bits2, grafts, dropped,
                  mesh_sel, seen_st, inj_st, state.backoff]
         if sc is not None:
             s0 = state.scores
-            args += [s0.first_deliveries, s0.invalid_deliveries,
+            args += [params.cand_static_score,
+                     s0.first_deliveries, s0.invalid_deliveries,
                      s0.behaviour_penalty, s0.time_in_mesh]
         outs = krn(*args)
-        new_acq, mesh_new, backoff_new = outs[0], outs[1], outs[2]
+        new_acq, mesh_new, backoff_new = outs[:3]
+        n_gates = 6 if sc is not None else 1
+        gates_new = tuple(outs[3:3 + n_gates])
+        outs = outs[3 + n_gates:]
         have = state.have | new_acq
         recent = jnp.concatenate([new_acq[None], state.recent[:-1]],
                                  axis=0)
@@ -990,10 +1147,10 @@ def make_gossip_step(cfg: GossipSimConfig,
         scores = state.scores
         if sc is not None:
             scores = ScoreState(
-                time_in_mesh=outs[6], first_deliveries=outs[3],
+                time_in_mesh=outs[3], first_deliveries=outs[0],
                 mesh_deliveries=state.scores.mesh_deliveries,
                 mesh_failure_penalty=state.scores.mesh_failure_penalty,
-                invalid_deliveries=outs[4], behaviour_penalty=outs[5],
+                invalid_deliveries=outs[1], behaviour_penalty=outs[2],
                 time_in_mesh_b=None)
         new_state = GossipState(
             mesh=mesh_new, fanout=fanout, last_pub=last_pub,
@@ -1001,7 +1158,7 @@ def make_gossip_step(cfg: GossipSimConfig,
             first_tick=first_tick, scores=scores, key=state.key,
             tick=tick + 1, iwant_serves=state.iwant_serves,
             mesh_b=state.mesh_b, backoff_b=state.backoff_b,
-            active=state.active)
+            active=state.active, gates=gates_new)
         return new_state, delivered_now
 
     def step(params: GossipParams, state: GossipState):
@@ -1018,14 +1175,23 @@ def make_gossip_step(cfg: GossipSimConfig,
                     "pallas step needs make_gossip_sim(pad_to_block=...)")
             if (C > 16 or W == 0 or params.flood_proto is not None
                     or paired or state.active is not None
+                    or params.cand_same_ip is not None
+                    or state.gates is None
                     or (sc is not None and (sc.track_p3
                                             or sc.flood_publish
-                                            or sc.sybil_iwant_spam))):
+                                            or sc.sybil_iwant_spam
+                                            # the kernel adds the baked
+                                            # static P5+P6 term as-is;
+                                            # a re-weighted config must
+                                            # not read a stale bake
+                                            or params.static_score_weights
+                                            != (sc.app_specific_weight,
+                                                sc.ip_colocation_factor_weight)))):
                 raise ValueError(
                     "config not supported by the pallas step (needs "
-                    "C<=16, W>=1, no flood_proto/track_p3/"
-                    "flood_publish/sybil_iwant_spam/paired_topics/"
-                    "px_candidates)")
+                    "C<=16, W>=1, carried gates, no flood_proto/"
+                    "track_p3/flood_publish/sybil_iwant_spam/"
+                    "paired_topics/px_candidates/shared-IP gater)")
         elif params.n_true is not None:
             raise ValueError(
                 "padded sim state requires the pallas step (XLA rolls "
@@ -1039,56 +1205,37 @@ def make_gossip_step(cfg: GossipSimConfig,
         n_stream = params.n_true if params.n_true is not None else n
         u_spec = lambda phase: (C, tick, phase, salt, n_stream)  # noqa: E731
 
-        # -- 0. start-of-tick scores and the gates they drive -----------
+        # -- 0. start-of-tick gate words --------------------------------
+        # Normally READ from the state: the previous tick's epilogue (or
+        # the pallas kernel) emitted them while the updated counters
+        # were in registers, so the prologue touches no [C, N] numeric
+        # state.  A state built without gates (or pipeline_gates=False)
+        # recomputes them here — bit-identical by construction.
+        emit_gates = pipeline_gates and state.gates is not None
+        g = (state.gates if emit_gates
+             else compute_gates(cfg, sc, params, state, salt))
         if sc is not None:
-            score = compute_scores(sc, params, state)           # [C, N]
             # packed threshold gates: bit c set iff the candidate edge
             # clears the threshold (AcceptFrom graylist gossipsub.go:584;
             # gossip/publish thresholds :610,956; graft score >= 0 :1340)
-            accept_bits = pack_rows(score >= sc.graylist_threshold)
-            gossip_bits = pack_rows(score >= sc.gossip_threshold)
-            pub_ok_bits = pack_rows(score >= sc.publish_threshold)
-            nonneg_bits = pack_rows(score >= 0)
-            # RED gater: under invalid-traffic pressure, payload from an
-            # edge is accepted with its goodput probability
-            # (peer_gater.go:320-363).  Gater stats are keyed by SOURCE
-            # IP, not per peer (peer_gater.go:119-151): when candidates
-            # share an address (cand_same_ip built at sim time, only if
-            # any IP is actually shared) each edge's goodput uses the
-            # sums over its same-IP siblings, so sybils behind one
-            # address share fate at the gater exactly as in the
-            # reference — not just through the P6 score term.
-            s0 = state.scores
-            f32 = lambda x: x.astype(jnp.float32)  # noqa: E731
-            invd = f32(s0.invalid_deliveries)
-            fdel = f32(s0.first_deliveries)
-            inv_tot = invd.sum(axis=0)                          # [N]
-            del_tot = fdel.sum(axis=0)
-            pressure = 16.0 * inv_tot / (1.0 + del_tot + 16.0 * inv_tot)
-            gater_on = pressure > 0.33
-            if params.cand_same_ip is not None:
-                inv_g = jnp.zeros_like(invd)
-                fd_g = jnp.zeros_like(fdel)
-                for cc in range(C):
-                    sib = expand_bits(
-                        params.cand_same_ip[cc], C)             # [C, N]
-                    inv_g = inv_g + jnp.where(sib, invd[cc][None, :], 0.0)
-                    fd_g = fd_g + jnp.where(sib, fdel[cc][None, :], 0.0)
-            else:
-                inv_g, fd_g = invd, fdel
-            goodput = (1.0 + fd_g) / (1.0 + fd_g + 16.0 * inv_g)
-            u_gater = lane_uniform((C, n), tick, 6, salt,
-                                   stride=n_stream)
-            gater_bits = pack_rows(u_gater < goodput) | jnp.where(
-                gater_on, Z, ALL)
-            payload_bits = accept_bits & gater_bits             # [N]
+            accept_bits, gossip_bits = g[0], g[1]
+            pub_ok_bits, nonneg_bits, payload_bits = g[2], g[3], g[4]
+            bo_row = g[5]
+            bo_row_b = g[6] if paired else None
             # per-word validity masks (scalar uint32 per word: bit m set
             # iff message m passes validation)
             valid_w = [~params.invalid_words[w] for w in range(W)]
         else:
-            score = None
             accept_bits = gossip_bits = payload_bits = None
             valid_w = None
+            bo_row = g[0]
+            bo_row_b = g[1] if paired else None
+        # the dense [C, N] score is only needed inside the rarely-taken
+        # maintenance cond bodies (prune ranking, opportunistic-graft
+        # median) — recomputed lazily there so the common path never
+        # materializes it
+        score_fn = ((lambda: compute_scores(sc, params, state))
+                    if sc is not None else None)
 
         # -- 1. publish injection ---------------------------------------
         due = pack_bits(params.publish_tick == tick)            # [W]
@@ -1206,7 +1353,17 @@ def make_gossip_step(cfg: GossipSimConfig,
             jnp.int32(cfg.d_lazy),
             (cfg.gossip_factor * n_elig.astype(jnp.float32)).astype(
                 jnp.int32))
-        targets = sel_k(elig, n_gossip, u_spec(1))
+        if cfg.binomial_gossip_sampling:
+            # Bernoulli(k/|elig|) per eligible edge: same inclusion
+            # probability as the exact k-subset, no [C, C, N] rank
+            # (see GossipSimConfig.binomial_gossip_sampling)
+            p_g = jnp.minimum(
+                1.0, n_gossip.astype(jnp.float32)
+                / jnp.maximum(n_elig, 1).astype(jnp.float32))
+            u_g = lane_uniform((C, n), tick, 1, salt, stride=n_stream)
+            targets = elig & pack_rows(u_g < p_g[None, :])
+        else:
+            targets = sel_k(elig, n_gossip, u_spec(1))
         if params.flood_proto is not None:
             targets = jnp.where(params.flood_proto, Z, targets)
         if sc is not None and sc.sybil_ihave_spam:
@@ -1264,7 +1421,7 @@ def make_gossip_step(cfg: GossipSimConfig,
         # reference heartbeat loops over topics (gossipsub.go:1299).
         mesh_before = state.mesh
 
-        def maintain(mesh0, backoff0, ph_graft, ph_prune, ph_og):
+        def maintain(mesh0, bo_row0, ph_graft, ph_prune, ph_og):
             if sc is not None:
                 # drop negative-score mesh members first (:1332)
                 neg = mesh0 & ~nonneg_bits
@@ -1276,8 +1433,9 @@ def make_gossip_step(cfg: GossipSimConfig,
 
             # graft up to D when deg < Dlo (gossipsub.go:1340-1360);
             # candidates need score >= 0 in v1.1.  in_backoff is the
-            # only per-edge numeric state: pack the comparison once.
-            backoff_bits = pack_rows(backoff0 > tick)
+            # only per-edge numeric state — its packed comparison
+            # arrives as a gate row (compute_gates row 5)
+            backoff_bits = bo_row0
             can_graft = (params.cand_sub_bits & ~mesh_ng & ~backoff_bits
                          & sub_all)
             if state.active is not None:
@@ -1305,6 +1463,7 @@ def make_gossip_step(cfg: GossipSimConfig,
                     keep = sel_k(mesh_ng, jnp.full_like(deg, cfg.d),
                                  u_spec(ph_prune))
                 else:
+                    score = score_fn()
                     rnd = lane_uniform((C, n), tick, ph_prune, salt,
                                        stride=n_stream)
                     top = select_k_by_priority_bits(
@@ -1337,6 +1496,7 @@ def make_gossip_step(cfg: GossipSimConfig,
                     # median = the mesh bit at ascending rank deg//2 =
                     # descending rank C-1-deg//2 (non-mesh bits pinned
                     # to +inf rank first); rank-compare, not a sort
+                    score = score_fn()
                     in_mesh = expand_bits(mesh_ng, C)
                     mesh_rank = ranks_desc(
                         jnp.where(in_mesh, score, jnp.inf))
@@ -1381,8 +1541,8 @@ def make_gossip_step(cfg: GossipSimConfig,
                         mesh_sel=mesh_sel, backoff_bits2=backoff_bits2,
                         would_accept=would_accept, a_sent=a_sent)
 
-        sel_a = maintain(state.mesh, state.backoff, 2, 3, 5)
-        sel_b = (maintain(state.mesh_b, state.backoff_b, 12, 13, 15)
+        sel_a = maintain(state.mesh, bo_row, 2, 3, 5)
+        sel_b = (maintain(state.mesh_b, bo_row_b, 12, 13, 15)
                  if paired else None)
         grafts, dropped = sel_a["grafts"], sel_a["dropped"]
         mesh_sel, backoff_bits2 = sel_a["mesh_sel"], sel_a["backoff_bits2"]
@@ -1398,7 +1558,8 @@ def make_gossip_step(cfg: GossipSimConfig,
                 a_sent=a_sent, would_accept=would_accept,
                 backoff_bits2=backoff_bits2, sub_all=sub_all,
                 payload_bits=payload_bits, gossip_bits=gossip_bits,
-                accept_bits=accept_bits, valid_w=valid_w, tick=tick)
+                accept_bits=accept_bits, valid_w=valid_w, tick=tick,
+                salt=salt)
 
         # behavioral broken-promise detection: a withholding peer's
         # IHAVE claims ids the receiver doesn't hold (the reference
@@ -1484,6 +1645,14 @@ def make_gossip_step(cfg: GossipSimConfig,
                             bit_row(send_flood, c_send), injected[w], Z)
                     rolled = jnp.roll(sent, off, axis=0)
                     news = rolled & ~seen[w]
+                    if sc is not None:
+                        # barrier: force ONE materialization of this
+                        # edge's news word.  Without it XLA fuses the
+                        # roll separately into the heard-OR chain AND
+                        # into each provenance-popcount fusion,
+                        # recomputing every roll twice (profiler:
+                        # ~1.2 ms/tick of duplicated pad chains at 1M)
+                        news = jax.lax.optimization_barrier(news)
                     heard[w] = heard[w] | news
                     if sc is not None:
                         # P2/P4 credit new-message deliverers, eager and
@@ -1695,15 +1864,20 @@ def make_gossip_step(cfg: GossipSimConfig,
         # -- 5. score counter updates + decay ---------------------------
         # (array-level on purpose: a row-wise variant was measured 1.7x
         # slower — [C, N] row slices read whole (sublane, 128) tiles)
-        tick_b = tick + cfg.backoff_ticks
-        # dropped edges overwrite to tick+B (gossipsub.go:1332-1338);
-        # PRUNE receipt / retraction takes max(existing, tick+B) — equal
-        # here, since any existing backoff was set at an earlier tick
-        # with the same constant B
-        backoff = jnp.where(expand_bits(bo_trigger, C), tick_b,
-                            state.backoff)
-        backoff_b = (jnp.where(expand_bits(bo_trigger_b, C), tick_b,
-                               state.backoff_b) if paired else None)
+        # backoff as remaining ticks: dropped edges restart the clock at
+        # B-1 (gossipsub.go:1332-1338; blocked for ticks t+1..t+B-1,
+        # free at t+B — identical to the absolute-expiry form); PRUNE
+        # receipt / retraction takes max(existing, B-1) — the overwrite,
+        # since remaining never exceeds B-1
+        bo16 = jnp.int16(cfg.backoff_ticks - 1)
+
+        def bo_update(bo_old, trig):
+            dec = jnp.maximum(bo_old - jnp.int16(1), jnp.int16(0))
+            return jnp.where(expand_bits(trig, C), bo16, dec)
+
+        backoff = bo_update(state.backoff, bo_trigger)
+        backoff_b = (bo_update(state.backoff_b, bo_trigger_b)
+                     if paired else None)
 
         scores = state.scores
         if sc is not None:
@@ -1711,9 +1885,16 @@ def make_gossip_step(cfg: GossipSimConfig,
             cdt = jnp.dtype(sc.counter_dtype)
             f32 = lambda x: x.astype(jnp.float32)  # noqa: E731
             zcn = jnp.zeros((C, n), dtype=jnp.float32)
-            fd_stack = (jnp.stack(fd_add, axis=0).astype(jnp.float32)
+            # provenance counts are <= 32*W per edge-tick: stage the
+            # [C, N] stacks through u8 when that fits (4x less
+            # concatenate traffic than u32; exact — counts are small
+            # integers either way)
+            cnt_dt = jnp.uint8 if W * 32 <= 255 else jnp.uint32
+            fd_stack = (jnp.stack([r.astype(cnt_dt) for r in fd_add],
+                                  axis=0).astype(jnp.float32)
                         if W else zcn)
-            iv_stack = (jnp.stack(inv_add, axis=0).astype(jnp.float32)
+            iv_stack = (jnp.stack([r.astype(cnt_dt) for r in inv_add],
+                                  axis=0).astype(jnp.float32)
                         if W else zcn)
             in_mesh_after = expand_bits(mesh, C)
             fd = jnp.minimum(f32(s0.first_deliveries) + fd_stack,
@@ -1721,7 +1902,8 @@ def make_gossip_step(cfg: GossipSimConfig,
             inv = f32(s0.invalid_deliveries) + iv_stack
             if sc.track_p3:
                 in_mesh_before = expand_bits(mesh_before, C)
-                md_stack = (jnp.stack(md_new, axis=0).astype(jnp.float32)
+                md_stack = (jnp.stack([r.astype(cnt_dt) for r in md_new],
+                                      axis=0).astype(jnp.float32)
                             if W else zcn)
                 md = jnp.minimum(
                     f32(s0.mesh_deliveries) + md_stack * in_mesh_before,
@@ -1770,7 +1952,7 @@ def make_gossip_step(cfg: GossipSimConfig,
                 invalid_deliveries=dk(
                     inv, sc.invalid_message_deliveries_decay),
                 behaviour_penalty=dk(bp, sc.behaviour_penalty_decay,
-                                     dtype=jnp.float32),
+                                     dtype=jnp.dtype(sc.bp_dtype)),
                 time_in_mesh_b=(jnp.where(
                     expand_bits(mesh_b_new, C),
                     jnp.minimum(s0.time_in_mesh_b + 1, 32766),
@@ -1781,7 +1963,19 @@ def make_gossip_step(cfg: GossipSimConfig,
             mesh=mesh, fanout=fanout, last_pub=last_pub, backoff=backoff,
             have=have, recent=recent, first_tick=first_tick, scores=scores,
             key=state.key, tick=tick + 1, iwant_serves=iwant_serves,
-            mesh_b=mesh_b_new, backoff_b=backoff_b, active=active_new)
+            mesh_b=mesh_b_new, backoff_b=backoff_b, active=active_new,
+            gates=state.gates)
+        if state.gates is not None:
+            # emit the NEXT tick's gate words now, while the updated
+            # counters are live in registers (XLA fuses the score math
+            # and packs into the decay pass) — the next prologue then
+            # reads G words/peer instead of the [C, N] counter state.
+            # Emitted even with pipeline_gates=False (whose prologue
+            # recomputes rather than trusting the carry): the returned
+            # state must never hold STALE gates that a later pipelined
+            # step would silently act on.
+            new_state = new_state.replace(gates=compute_gates(
+                cfg, sc, params, new_state, salt))
         return new_state, delivered_now
 
     return step

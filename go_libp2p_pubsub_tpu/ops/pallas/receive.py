@@ -15,7 +15,16 @@ exchange and per-edge state update (models/gossipsub.py combined path):
 - the GRAFT/PRUNE/A-mask handshake (handleGraft/handlePrune
   gossipsub.go:713-838) from the same views, plus the mesh and backoff
   writes;
-- the counter decay pass (refreshScores score.go:495-556).
+- the counter decay pass (refreshScores score.go:495-556);
+- stage 2: NEXT tick's score-threshold gates.  The updated counters are
+  already in VMEM, so the kernel evaluates the peer-score formula
+  (score.go:256-333) and emits the packed gate words the next tick's
+  XLA prologue needs — accept/gossip/publish/nonneg threshold packs,
+  the RED-gater payload gate (peer_gater.go:320-363, per-edge stats),
+  and the backoff comparison pack.  The XLA residue then never re-reads
+  the [C, N] counters on the common path (prune/opportunistic-graft
+  cond bodies lazily recompute the dense score on the rare ticks they
+  fire).
 
 Everything a peer block needs lives in VMEM for the whole tick: the
 [C, B] counter blocks stream through HBM exactly once (the XLA form
@@ -43,6 +52,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from .select import _fmix32
 
 N_SLOTS = 4        # DMA prefetch depth (edges in flight)
 ALIGN32 = 1024     # u32 1-D DMA slice alignment (8 x 128 tile)
@@ -127,7 +138,7 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     it = iter(refs)
     nxt = lambda: next(it)  # noqa: E731
     valid_ref = nxt() if has_sc else None
-    tickb_ref = nxt()
+    gseed_ref = nxt()       # u32 [1]: mixed gater seed for tick + 1
     ctrl_hbm = nxt()
     fresh_hbm = nxt()
     adv_hbm = nxt()
@@ -144,10 +155,12 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     inj_ref = nxt()
     bo_in = nxt()
     if has_sc:
+        static_ref = nxt()
         fd_in, inv_in, bp_in, tim_in = nxt(), nxt(), nxt(), nxt()
     out_acq = nxt()
     out_mesh = nxt()
     out_bo = nxt()
+    out_gates = [nxt() for _ in range(6 if has_sc else 1)]
     if has_sc:
         out_fd, out_inv, out_bp, out_tim = nxt(), nxt(), nxt(), nxt()
     cbufs = [nxt() for _ in range(N_SLOTS)]
@@ -283,7 +296,6 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     out_mesh[...] = mesh
     bo_trig = dropped | prune_recv | retract
 
-    tick_b = tickb_ref[0]
     inj_a = inj_ref[...]
     # sub_all is the C-bit candidate gate (ALL or 0); for MESSAGE words
     # it must act as a full-word predicate, not a bitmask
@@ -291,7 +303,22 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     out_acq[...] = jnp.stack(
         [jnp.where(subbed, heard[w], jnp.uint32(0)) | inj_a[w]
          for w in range(W)])
-    out_bo[...] = jnp.where(_expand(bo_trig, C), tick_b, bo_in[...])
+    # backoff = remaining ticks: triggers restart at B-1, else
+    # decrement toward 0 (i32 detour: mosaic lacks 16-bit min/max)
+    bo32 = bo_in[...].astype(jnp.int32)
+    bo_new = jnp.where(_expand(bo_trig, C), cfg.backoff_ticks - 1,
+                       jnp.maximum(bo32 - 1, 0))
+    out_bo[...] = bo_new.astype(jnp.int16)
+
+    # packed-row helper matching ops.graph.pack_rows bit-for-bit
+    # (mosaic can't reduce unsigned ints: sum i32, bit-cast after)
+    cidx_i = jax.lax.broadcasted_iota(jnp.int32, (C, B), 0)
+
+    def packb(cond):
+        return (cond.astype(jnp.int32) << cidx_i).sum(
+            axis=0, dtype=jnp.int32).astype(jnp.uint32)
+
+    bo_gate = packb(bo_new > 0)
 
     if has_sc:
         cdt = counter_dtype
@@ -304,9 +331,10 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
         in_mesh = _expand(mesh, C)
         # min/compare in i32: mosaic lacks 16-bit minsi
         tim32 = tim_in[...].astype(jnp.int32)
-        out_tim[...] = jnp.where(
+        tim_new = jnp.where(
             in_mesh, jnp.minimum(tim32 + 1, 32766),
             0).astype(jnp.int16)
+        out_tim[...] = tim_new
         zrow = jnp.zeros((B,), jnp.int32)
         fd_stack = jnp.stack(
             [zrow if r is None else r for r in fd_cnt]).astype(
@@ -316,14 +344,67 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
             jnp.float32)
         fd = jnp.minimum(f32(fd_in[...]) + fd_stack,
                          sc.first_message_deliveries_cap)
-        out_fd[...] = dk(fd, sc.first_message_deliveries_decay)
-        out_inv[...] = dk(f32(inv_in[...]) + iv_stack,
-                          sc.invalid_message_deliveries_decay)
+        fd_new = dk(fd, sc.first_message_deliveries_decay)
+        out_fd[...] = fd_new
+        inv_new = dk(f32(inv_in[...]) + iv_stack,
+                     sc.invalid_message_deliveries_decay)
+        out_inv[...] = inv_new
         bp = f32(bp_in[...]) + _expand(viol, C).astype(jnp.float32)
         if track_promises:
             bp = bp + _expand(broken_recv, C).astype(jnp.float32)
-        out_bp[...] = dk(bp, sc.behaviour_penalty_decay,
-                         dtype=jnp.float32)
+        bp_new = dk(bp, sc.behaviour_penalty_decay,
+                    dtype=jnp.dtype(sc.bp_dtype))
+        out_bp[...] = bp_new
+
+        # ---- stage 2: NEXT tick's gate words (compute_gates rows),
+        # evaluated from the freshly-updated counters while they are
+        # still in VMEM — the peer-score formula score.go:256-333 on
+        # the STORED (rounded) counter values, exactly what a tick-
+        # start recompute would read back.
+        fd_n = fd_new.astype(jnp.float32)
+        inv_n = inv_new.astype(jnp.float32)
+        tim_n = tim_new.astype(jnp.int32).astype(jnp.float32)
+        w_t = sc.topic_weight
+        topic_part = (w_t * sc.time_in_mesh_weight
+                      * jnp.minimum(tim_n / sc.time_in_mesh_quantum,
+                                    sc.time_in_mesh_cap)
+                      + (w_t * sc.first_message_deliveries_weight)
+                      * fd_n
+                      + (w_t * sc.invalid_message_deliveries_weight)
+                      * inv_n * inv_n)
+        if sc.topic_score_cap > 0:
+            topic_part = jnp.minimum(topic_part, sc.topic_score_cap)
+        bp_ex = jnp.maximum(0.0, bp_new.astype(jnp.float32)
+                            - sc.behaviour_penalty_threshold)
+        score = (topic_part + static_ref[...]
+                 + sc.behaviour_penalty_weight * bp_ex * bp_ex)
+        accept_g = packb(score >= sc.graylist_threshold)
+        gossip_g = packb(score >= sc.gossip_threshold)
+        pub_g = packb(score >= sc.publish_threshold)
+        nonneg_g = packb(score >= 0)
+        # RED gater, per-edge stats (the shared-IP grouping is not
+        # supported by the kernel path — guarded at the step)
+        inv_tot = inv_n.sum(axis=0)
+        del_tot = fd_n.sum(axis=0)
+        pressure = 16.0 * inv_tot / (1.0 + del_tot + 16.0 * inv_tot)
+        gater_on = pressure > 0.33
+        goodput = (1.0 + fd_n) / (1.0 + fd_n + 16.0 * inv_n)
+        # phase-6 lane_uniform for tick + 1: lane = c * n_true + peer
+        peer = (jax.lax.broadcasted_iota(jnp.uint32, (C, B), 1)
+                + jnp.uint32(i * B))
+        lane = (jax.lax.broadcasted_iota(jnp.uint32, (C, B), 0)
+                * jnp.uint32(n_true) + peer)
+        h = _fmix32(lane ^ gseed_ref[0])
+        u = ((h >> jnp.uint32(8)).astype(jnp.int32).astype(jnp.float32)
+             * jnp.float32(1 / (1 << 24)))
+        ALLC = jnp.uint32((1 << C) - 1)
+        gater_bits = packb(u < goodput) | jnp.where(gater_on, Z, ALLC)
+        for ref, val in zip(out_gates,
+                            [accept_g, gossip_g, pub_g, nonneg_g,
+                             accept_g & gater_bits, bo_gate]):
+            ref[...] = val
+    else:
+        out_gates[0][...] = bo_gate
 
 
 def make_receive_update(cfg, sc, n_true: int, block: int,
@@ -332,15 +413,17 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
                         interpret: bool = False):
     """Build the kernel caller.
 
-    Operand order (args): [valid u32 [W] (sc only)], tick_b i32 [1],
+    Operand order (args): [valid u32 [W] (sc only)], gseed u32 [1],
     ctrl_flat u8 [C*L8], fresh_flat u32 [W*L32], adv_flat u32 [W*L32],
     [pay, gsp, acc u32 [N_pad] (sc only)], sub, wa, bo2, grafts,
     dropped, meshsel u32 [N_pad], seen u32 [W, N_pad], injected
-    [W, N_pad], backoff i32 [C, N_pad], [fd, inv (counter_dtype), bp
-    f32, tim i16 [C, N_pad] (sc only)].
+    [W, N_pad], backoff-remaining i16 [C, N_pad], [static f32
+    [C, N_pad], fd, inv (counter_dtype), bp f32, tim i16 [C, N_pad]
+    (sc only)].
 
-    Returns (new_acq [W, N_pad], mesh [N_pad], backoff [C, N_pad]
-    [, fd, inv, bp, tim]).
+    Returns (new_acq [W, N_pad], mesh [N_pad], backoff [C, N_pad],
+    *gates (G separate u32 [N_pad] words — compute_gates order),
+    [, fd, inv, bp, tim]) where G = 6 scored / 1 unscored.
     """
     C = cfg.n_candidates
     has_sc = sc is not None
@@ -358,10 +441,11 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
     bw = lambda: pl.BlockSpec((W, B), lambda i: (0, i))  # noqa: E731
     bc = lambda: pl.BlockSpec((C, B), lambda i: (0, i))  # noqa: E731
 
+    n_gates = 6 if has_sc else 1
     in_specs = []
     if has_sc:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))  # valid
-    in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))      # tick_b
+    in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))      # gseed
     in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * 3      # flats
     if has_sc:
         in_specs += [b1(), b1(), b1()]        # pay, gsp, acc
@@ -369,19 +453,20 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
     in_specs += [bw(), bw()]                  # seen, injected
     in_specs += [bc()]                        # backoff in
     if has_sc:
-        in_specs += [bc()] * 4                # fd, inv, bp, tim
+        in_specs += [bc()] * 5                # static, fd, inv, bp, tim
 
-    out_shape = [
-        jax.ShapeDtypeStruct((W, n_pad), jnp.uint32),   # new_acq
-        jax.ShapeDtypeStruct((n_pad,), jnp.uint32),     # mesh
-        jax.ShapeDtypeStruct((C, n_pad), jnp.int32),    # backoff
-    ]
-    out_specs = [bw(), b1(), bc()]
+    out_shape = ([
+        jax.ShapeDtypeStruct((W, n_pad), jnp.uint32),       # new_acq
+        jax.ShapeDtypeStruct((n_pad,), jnp.uint32),         # mesh
+        jax.ShapeDtypeStruct((C, n_pad), jnp.int16),        # backoff
+    ] + [jax.ShapeDtypeStruct((n_pad,), jnp.uint32)] * n_gates)
+    out_specs = [bw(), b1(), bc()] + [b1() for _ in range(n_gates)]
     if has_sc:
         out_shape += [
             jax.ShapeDtypeStruct((C, n_pad), counter_dtype),  # fd
             jax.ShapeDtypeStruct((C, n_pad), counter_dtype),  # inv
-            jax.ShapeDtypeStruct((C, n_pad), jnp.float32),    # bp
+            jax.ShapeDtypeStruct((C, n_pad),
+                                 jnp.dtype(sc.bp_dtype)),     # bp
             jax.ShapeDtypeStruct((C, n_pad), jnp.int16),      # tim
         ]
         out_specs += [bc()] * 4

@@ -22,6 +22,7 @@ from go_libp2p_pubsub_tpu.models.gossipsub import (
     gossip_run,
     gossip_run_curve,
     reach_counts,
+    refresh_gates,
     first_tick_matrix,
 )
 
@@ -80,9 +81,10 @@ def test_backoff_blocks_regraft():
     for _ in range(3):
         state, _ = step(params, state)
     # force-prune everything: clear mesh, set backoff everywhere
-    state = state.replace(
+    # (manual surgery -> the carried gate words must be refreshed)
+    state = refresh_gates(cfg, None, params, state.replace(
         mesh=jnp.zeros_like(state.mesh),
-        backoff=jnp.full_like(state.backoff, 10_000))
+        backoff=jnp.full_like(state.backoff, 10_000)))
     for _ in range(5):
         state, _ = step(params, state)
     assert int(mesh_degrees(state).sum()) == 0  # nobody can re-graft
@@ -122,8 +124,8 @@ def test_gossip_repairs_meshless_peers():
     from go_libp2p_pubsub_tpu.models.gossipsub import transfer_mask
     iso_cols = jnp.broadcast_to(iso_j[None, :], state.backoff.shape)
     blocked = iso_cols | transfer_mask(iso_cols, cfg)
-    state = state.replace(
-        backoff=jnp.where(blocked, 1_000_000, state.backoff))
+    state = refresh_gates(cfg, None, params, state.replace(
+        backoff=jnp.where(blocked, 30_000, state.backoff)))
     step = make_gossip_step(cfg)
     out = gossip_run(params, state, 40, step)
     deg = np.asarray(mesh_degrees(out))
@@ -300,3 +302,49 @@ def test_fused_equals_split_v10_with_gossip():
             np.asarray(getattr(out_f, f)), np.asarray(getattr(out_s, f)),
             err_msg=f)
     assert np.asarray(out_f.have).any()
+
+
+def test_pipelined_gates_match_recompute():
+    """The carried gate words (emitted by the previous tick's epilogue)
+    must be bit-identical to recomputing the gates at tick start —
+    including the v1.1 thresholds, the RED gater draw, and adversarial
+    counter dynamics (invalid traffic keeps the gater under pressure)."""
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+
+    n, t, C, m = 600, 3, 16, 10
+    rng = np.random.default_rng(5)
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, C, n, seed=5), n_topics=t,
+        d=3, d_lo=2, d_hi=6, d_score=2, d_out=1, backoff_ticks=6)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    topic = rng.integers(0, t, m)
+    origin = rng.integers(0, n // t, m) * t + topic
+    ticks = np.sort(rng.integers(0, 10, m)).astype(np.int32)
+    sc = gs.ScoreSimConfig(sybil_ihave_spam=True)
+    params, state = gs.make_gossip_sim(
+        cfg, subs, topic, origin, ticks, score_cfg=sc,
+        sybil=rng.random(n) < 0.2, msg_invalid=rng.random(m) < 0.4,
+        app_score=rng.normal(0, 0.1, n).astype(np.float32))
+    out_p = gs.gossip_run(params, state, 25, gs.make_gossip_step(cfg, sc))
+    out_r = gs.gossip_run(params, state, 25,
+                          gs.make_gossip_step(cfg, sc,
+                                              pipeline_gates=False))
+    for f in ("have", "mesh", "backoff", "fanout", "recent",
+              "first_tick"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(out_p, f)), np.asarray(getattr(out_r, f)),
+            err_msg=f)
+    for f in ("time_in_mesh", "first_deliveries", "invalid_deliveries",
+              "behaviour_penalty"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(out_p.scores, f)),
+            np.asarray(getattr(out_r.scores, f)), err_msg=f)
+    # the carried gates themselves equal a fresh recompute on the
+    # final state
+    np.testing.assert_array_equal(
+        np.asarray(out_p.gates),
+        np.asarray(gs.compute_gates(
+            cfg, sc, params, out_p,
+            jax.random.key_data(out_p.key)[-1])))
+    assert np.asarray(out_p.scores.behaviour_penalty).max() > 0

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Time the v1.1 flagship step: XLA path vs pallas receive-kernel path.
+
+One process, strictly sequential TPU use (PERF_NOTES: concurrent TPU
+clients wedge the axon tunnel).  Sync points are data-dependent host
+transfers (block_until_ready resolves early on this platform).
+
+Usage: python tools/bench_kernel.py [n] [which ...]
+  which in {xla, kernel}; default both.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def build(n, pad_block=None):
+    import jax
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+
+    t, m, C = 100, 32, 16
+    if n < 100 * t:
+        raise SystemExit(f"n must be >= {100 * t} (t={t} topics)")
+    rng = np.random.default_rng(0)
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, C, n, seed=0), n_topics=t)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    topic = rng.integers(0, t, m)
+    origin = rng.integers(0, n // t, m) * t + topic
+    tick0 = np.sort(rng.integers(0, 80, m)).astype(np.int32)
+    sc = gs.ScoreSimConfig()
+    params, state = gs.make_gossip_sim(
+        cfg, subs, topic, origin, tick0, score_cfg=sc,
+        track_first_tick=False, pad_to_block=pad_block)
+    return cfg, sc, jax.device_put(params), jax.device_put(state)
+
+
+def timed(name, cfg, sc, params, state, **step_kw):
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+
+    step = gs.make_gossip_step(cfg, sc, **step_kw)
+    t0 = time.perf_counter()
+    state = gs.gossip_run(params, state, 100, step)
+    _ = int(np.asarray(state.tick))
+    print(f"{name}: warmup+compile {time.perf_counter() - t0:.1f}s",
+          flush=True)
+    T, reps = 100, 3
+    t0 = time.perf_counter()
+    for _r in range(reps):
+        state = gs.gossip_run(params, state, T, step)
+        _ = int(np.asarray(state.tick))
+    dt = (time.perf_counter() - t0) / (T * reps)
+    deg = np.asarray(gs.mesh_degrees(state))
+    sub = np.asarray(params.subscribed)
+    print(f"{name}: {dt * 1e3:.3f} ms/tick ({1 / dt:.1f} hb/s)  "
+          f"mean mesh deg {deg[sub].mean():.2f}", flush=True)
+    return dt
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    which = sys.argv[2:] or ["xla", "kernel"]
+    if "xla" in which:
+        cfg, sc, params, state = build(n)
+        timed("xla", cfg, sc, params, state)
+    if "kernel" in which:
+        cfg, sc, params, state = build(n, pad_block=8192)
+        timed("kernel-b8192", cfg, sc, params, state,
+              receive_block=8192)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Dump the optimized HLO of the scanned v1.1 step and summarize the
+named fusions (to map profiler trace names -> source ops).
+
+Usage: python tools/dump_hlo.py [n] [xla|kernel] [fusion-name ...]
+With fusion names: print those computations in full.  Without: print a
+one-line op-mix summary per >=16-op fusion.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from collections import Counter
+
+import numpy as np
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "tools")
+
+from bench_kernel import build  # noqa: E402
+
+
+def main():
+    import jax
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    which = sys.argv[2] if len(sys.argv) > 2 else "xla"
+    want = sys.argv[3:]
+    kw = {}
+    pad = 8192 if which == "kernel" else None
+    if which == "kernel":
+        kw = dict(receive_block=8192)
+    cfg, sc, params, state = build(n, pad_block=pad)
+    step = gs.make_gossip_step(cfg, sc, **kw)
+
+    def run(params, state):
+        return gs.gossip_run(params, state, 100, step)
+
+    txt = jax.jit(run).lower(params, state).compile().as_text()
+    with open("/tmp/step_hlo.txt", "w") as f:
+        f.write(txt)
+    print(f"HLO: {len(txt.splitlines())} lines -> /tmp/step_hlo.txt")
+
+    # split computations
+    comps = {}
+    cur = None
+    for line in txt.splitlines():
+        m = re.match(r"%?([\w.\-]+)\s*(\([^)]*\))?\s*->.*{$", line.strip())
+        if line.strip().endswith("{") and ("fused_computation" in line
+                                           or line.startswith("%")
+                                           or "ENTRY" in line):
+            name = line.strip().split()[0].lstrip("%")
+            cur = name
+            comps[cur] = []
+        elif cur is not None:
+            if line.strip() == "}":
+                cur = None
+            else:
+                comps[cur].append(line)
+
+    if want:
+        # fusion.N in the trace corresponds to the instruction name;
+        # find its computation via the fusion instruction line
+        for w in want:
+            pat = re.compile(rf"%?{re.escape(w)}\s*=.*calls=%?([\w.\-]+)")
+            for line in txt.splitlines():
+                m = pat.search(line)
+                if m:
+                    print("=" * 70)
+                    print(line.strip()[:300])
+                    body = comps.get(m.group(1), [])
+                    for b in body:
+                        print(b[:240])
+                    break
+        return
+
+    # summary: op mix for each fusion instruction
+    for line in txt.splitlines():
+        m = re.search(
+            r"%?([\w.\-]+) = (\S+) fusion\((.*?)\), kind=(\S+), "
+            r"calls=%?([\w.\-]+)", line)
+        if not m:
+            continue
+        name, shape, _args, kind, comp = m.groups()
+        body = comps.get(comp, [])
+        ops = Counter()
+        for b in body:
+            mo = re.match(r"\s*%?[\w.\-]+ = \S+ ([\w\-]+)\(", b)
+            if mo:
+                ops[mo.group(1)] += 1
+        if sum(ops.values()) < 10:
+            continue
+        top = ", ".join(f"{k}x{v}" for k, v in ops.most_common(8))
+        print(f"{name:28s} {shape:24s} {kind:18s} {top}")
+
+
+if __name__ == "__main__":
+    main()

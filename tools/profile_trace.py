@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Capture a jax.profiler trace of the scanned v1.1 step and print the
+top device ops by total time.
+
+Usage: python tools/profile_trace.py [n] [xla|kernel] [out_dir]
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import sys
+from collections import defaultdict
+
+import numpy as np
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "tools")
+
+from bench_kernel import build  # noqa: E402
+
+
+def main():
+    import jax
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    which = sys.argv[2] if len(sys.argv) > 2 else "xla"
+    out = sys.argv[3] if len(sys.argv) > 3 else "/tmp/jaxtrace"
+    kw = {}
+    pad = None
+    if which == "kernel":
+        pad = 8192
+        kw = dict(receive_block=8192)
+    cfg, sc, params, state = build(n, pad_block=pad)
+    step = gs.make_gossip_step(cfg, sc, **kw)
+    state = gs.gossip_run(params, state, 100, step)
+    _ = int(np.asarray(state.tick))
+    with jax.profiler.trace(out):
+        state = gs.gossip_run(params, state, 50, step)
+        _ = int(np.asarray(state.tick))
+
+    paths = sorted(glob.glob(out + "/**/*.trace.json.gz", recursive=True))
+    if not paths:
+        raise SystemExit(f"no trace under {out}")
+    with gzip.open(paths[-1], "rt") as f:
+        trace = json.load(f)
+    # device-track events only: keep events whose pid is a device track
+    # (name contains TPU/device); fall back to all complete events
+    pids = {}
+    for ev in trace["traceEvents"]:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            pids[ev["pid"]] = ev["args"].get("name", "")
+    dev_pids = {p for p, nm in pids.items()
+                if "TPU" in nm or "/device" in nm.lower()}
+    tot = defaultdict(float)
+    cnt = defaultdict(int)
+    for ev in trace["traceEvents"]:
+        if ev.get("ph") != "X":
+            continue
+        if dev_pids and ev.get("pid") not in dev_pids:
+            continue
+        tot[ev["name"]] += ev.get("dur", 0)
+        cnt[ev["name"]] += 1
+    items = sorted(tot.items(), key=lambda kv: -kv[1])
+    grand = sum(tot.values())
+    print(f"pids: { {p: pids.get(p, '?') for p in dev_pids} }")
+    print(f"total device-op time: {grand / 1e3:.2f} ms over 50 ticks "
+          f"({grand / 1e3 / 50:.3f} ms/tick)")
+    for name, us in items[:40]:
+        print(f"{us / 50:9.1f} us/tick  x{cnt[name] // 50:<4d} {name[:90]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1070,7 +1070,7 @@ def make_gossip_step(cfg: GossipSimConfig,
         handshake resolution, and per-edge counter/backoff updates in
         a single HBM pass over the [C, N] state (ops/pallas/receive)."""
         from ..ops.pallas.receive import (
-            ALIGN8, ALIGN32, CTRL_A, CTRL_DROP, CTRL_GRAFT,
+            CTRL_A, CTRL_DROP, CTRL_GRAFT,
             CTRL_OUT, CTRL_ADV, CTRL_TGT, extend_wrap,
             make_receive_update, plan)
 
@@ -1101,13 +1101,15 @@ def make_gossip_step(cfg: GossipSimConfig,
                  | (bit_of(a_sent, c) << jnp.uint32(CTRL_A))
                  | (bit_of(targets, c) << jnp.uint32(CTRL_ADV)))
             rows.append(extend_wrap(b.astype(jnp.uint8), n_true, n_pad,
-                                    pln["p8"], ALIGN8))
+                                    pln["p8"], pln["e8"]))
         ctrl_flat = jnp.concatenate(rows)
         fresh_flat = jnp.concatenate(
-            [extend_wrap(fresh[w], n_true, n_pad, pln["p32"], ALIGN32)
+            [extend_wrap(fresh[w], n_true, n_pad, pln["p32"],
+                         pln["e32"])
              for w in range(W)])
         adv_flat = jnp.concatenate(
-            [extend_wrap(adv[w], n_true, n_pad, pln["p32"], ALIGN32)
+            [extend_wrap(adv[w], n_true, n_pad, pln["p32"],
+                         pln["e32"])
              for w in range(W)])
         seen_st = jnp.stack([state.have[w] | injected[w]
                              for w in range(W)])
@@ -1211,6 +1213,16 @@ def make_gossip_step(cfg: GossipSimConfig,
         # were in registers, so the prologue touches no [C, N] numeric
         # state.  A state built without gates (or pipeline_gates=False)
         # recomputes them here — bit-identical by construction.
+        n_gate_rows = (5 if sc is not None else 0) + (2 if paired else 1)
+        if state.gates is not None and len(state.gates) != n_gate_rows:
+            # a carried gate tuple from a DIFFERENT score config would
+            # be silently misread row-for-row (e.g. an accept-threshold
+            # word consumed as the backoff row)
+            raise ValueError(
+                f"state carries {len(state.gates)} gate words but this "
+                f"step's config expects {n_gate_rows} — the state was "
+                "built for a different score config; rebuild it or "
+                "refresh_gates with the matching config")
         emit_gates = pipeline_gates and state.gates is not None
         g = (state.gates if emit_gates
              else compute_gates(cfg, sc, params, state, salt))

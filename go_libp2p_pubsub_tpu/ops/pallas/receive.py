@@ -78,16 +78,33 @@ def _align_up(x: int, a: int) -> int:
 def plan(n_true: int, offsets, block: int):
     """Static layout plan shared by the kernel and its XLA composer.
 
-    Each view DMA fetches [start, start + B + ALIGN) with
-    start = i*B + p + o - delta, so the wrap-extended array needs an
-    extra ALIGN of slack past n_pad + 2p: when max|o| is itself aligned
-    (delta = 0) the fetch otherwise runs exactly ALIGN past the end."""
+    Two modes:
+
+    - **extended** (any n): source arrays are wrap-extended to
+      n_pad + 2p + ALIGN with T[k] = S[(k - p) mod n]; each view DMA
+      fetches [i*B + p + o - delta, + B + ALIGN) — static-offset,
+      always in range.  The composes copy ~2 max|o| elements per
+      array per tick.
+    - **aligned** (n divisible by ALIGN8 and by the block): DMA starts
+      are computed mod n at run time — (i*B + o - delta) mod n stays
+      tile-aligned because n is — so the source only needs B + ALIGN
+      of tail slack (the wrap continued past n).  Composes shrink to
+      one small tail copy per array; p = 0.
+    """
     n_pad = _align_up(n_true, block)
-    p32 = _align_up(max(abs(int(o)) for o in offsets), ALIGN32)
-    p8 = _align_up(p32, ALIGN8)
-    return dict(n_pad=n_pad, p32=p32, p8=p8,
-                l32=n_pad + 2 * p32 + ALIGN32,
-                l8=n_pad + 2 * p8 + ALIGN8,
+    aligned = (n_true % ALIGN8 == 0 and n_pad == n_true)
+    if aligned:
+        p32 = p8 = 0
+        e32 = block + ALIGN32
+        e8 = block + ALIGN8
+    else:
+        p32 = _align_up(max(abs(int(o)) for o in offsets), ALIGN32)
+        p8 = _align_up(p32, ALIGN8)
+        e32, e8 = ALIGN32, ALIGN8
+    return dict(n_pad=n_pad, p32=p32, p8=p8, e32=e32, e8=e8,
+                aligned=aligned,
+                l32=n_pad + 2 * p32 + e32,
+                l8=n_pad + 2 * p8 + e8,
                 grid=n_pad // block)
 
 
@@ -97,12 +114,15 @@ def extend_wrap(row: jnp.ndarray, n_true: int, n_pad: int,
 
     Built from whole-row copies + one static slice so it lowers to
     concatenates (no gather) for any p/n ratio — the alignment padding
-    p can exceed n for small sims."""
+    p can exceed n for small sims.  With p == 0 (aligned plan) this is
+    just the row plus a small head-wrap tail."""
     row = row[:n_true]
     length = n_pad + 2 * p + extra
     start = (-p) % n_true
     reps = -(-(start + length) // n_true)
     big = jnp.concatenate([row] * reps) if reps > 1 else row
+    # XLA's slice-of-concat simplification keeps this from writing the
+    # full reps*n intermediate (p == 0 aligned plans: one small tail)
     return big[start:start + length]
 
 
@@ -171,21 +191,29 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     sems = nxt()
 
     i = pl.program_id(0)
+    aligned = pln["aligned"]
     c_deltas = [o % ALIGN8 for o in offsets]
-    c_bases = [p8 + o - d for o, d in zip(offsets, c_deltas)]
+    c_bases = [(o - d) % n_true if aligned else p8 + o - d
+               for o, d in zip(offsets, c_deltas)]
     p_deltas = [o % ALIGN32 for o in offsets]
-    p_bases = [p32 + o - d for o, d in zip(offsets, p_deltas)]
+    p_bases = [(o - d) % n_true if aligned else p32 + o - d
+               for o, d in zip(offsets, p_deltas)]
     lc, lp = pln["l8"], pln["l32"]
 
+    def view_start(base):
+        # aligned plan: the wrap lands back in [0, n) at run time and
+        # stays tile-aligned because n is a multiple of the alignment
+        return (i * B + base) % n_true if aligned else i * B + base
+
     def dma_ctrl(slot, j):
-        start = cinv[j] * lc + i * B + c_bases[j]
+        start = cinv[j] * lc + view_start(c_bases[j])
         return pltpu.make_async_copy(
             ctrl_hbm.at[pl.ds(start, B + ALIGN8)], cbufs[slot],
             sems.at[slot])
 
     def dma_pay(slot, j, k, w):
         hbm = fresh_hbm if k == 0 else adv_hbm
-        start = w * lp + i * B + p_bases[j]
+        start = w * lp + view_start(p_bases[j])
         return pltpu.make_async_copy(
             hbm.at[pl.ds(start, B + ALIGN32)],
             pbufs[slot][k * W + w],

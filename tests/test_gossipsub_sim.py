@@ -348,3 +348,24 @@ def test_pipelined_gates_match_recompute():
             cfg, sc, params, out_p,
             jax.random.key_data(out_p.key)[-1])))
     assert np.asarray(out_p.scores.behaviour_penalty).max() > 0
+
+
+def test_gossip_repair_with_exact_sampling():
+    """binomial_gossip_sampling=False restores the reference's exact
+    uniform k-subset target selection (rank-compare path) — gossip
+    repair must work identically well."""
+    cfg, params, state, *_ = build(n=600, t=3, n_msgs=8,
+                                   binomial_gossip_sampling=False)
+    isolated = np.zeros(600, dtype=bool)
+    isolated[::10] = True
+    iso_j = jnp.asarray(isolated)
+    from go_libp2p_pubsub_tpu.models.gossipsub import transfer_mask
+    iso_cols = jnp.broadcast_to(iso_j[None, :], state.backoff.shape)
+    blocked = iso_cols | transfer_mask(iso_cols, cfg)
+    state = refresh_gates(cfg, None, params, state.replace(
+        backoff=jnp.where(blocked, 30_000, state.backoff)))
+    step = make_gossip_step(cfg)
+    out = gossip_run(params, state, 40, step)
+    assert (np.asarray(mesh_degrees(out))[isolated] == 0).all()
+    np.testing.assert_array_equal(
+        np.asarray(reach_counts(params, out)), 600 // 3)

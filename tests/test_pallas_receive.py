@@ -131,3 +131,17 @@ def test_padded_state_requires_kernel():
     step = gs.make_gossip_step(cfg, sc, use_pallas_receive=False)
     with pytest.raises(ValueError, match="padded"):
         step(params, state)
+
+
+def test_kernel_matches_xla_aligned_wrap():
+    """Aligned plan (n divisible by the u8 tile alignment and the
+    block): DMA starts computed mod n at run time, composes reduced to
+    a small tail — must stay bit-identical to the XLA path."""
+    from go_libp2p_pubsub_tpu.ops.pallas.receive import plan
+
+    n = 4096
+    cfg, sc, out_x, out_k = _run_pair(n, 4, 8, 8, 25, 128, score=True,
+                                      sybil_frac=0.1, spam=True)
+    assert plan(n, cfg.offsets, 128)["aligned"]
+    _assert_state_equal(out_x, out_k, n, sc)
+    assert np.asarray(out_x.scores.first_deliveries).max() > 0

@@ -13,8 +13,6 @@ import re
 import sys
 from collections import Counter
 
-import numpy as np
-
 sys.path.insert(0, ".")
 sys.path.insert(0, "tools")
 
@@ -47,7 +45,6 @@ def main():
     comps = {}
     cur = None
     for line in txt.splitlines():
-        m = re.match(r"%?([\w.\-]+)\s*(\([^)]*\))?\s*->.*{$", line.strip())
         if line.strip().endswith("{") and ("fused_computation" in line
                                            or line.startswith("%")
                                            or "ENTRY" in line):

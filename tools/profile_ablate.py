@@ -102,14 +102,33 @@ def main():
     report("select_k_by_priority",
            select_k_by_priority_bits=lambda elig, prio, k_, **kw: elig)
     report("lane_uniform",
-           lane_uniform=lambda shape, tick, phase, salt: jnp.full(
+           lane_uniform=lambda shape, tick, phase, salt, **kw: jnp.full(
                shape, 0.5, dtype=jnp.float32))
-    report("compute_scores",
+    report("compute_scores (cond bodies)",
            compute_scores=lambda sc_, p, s: jnp.zeros(
                (C, n), dtype=jnp.float32))
+    zw = lambda s_: jnp.zeros_like(s_.mesh)  # noqa: E731
+    report("compute_gates (emission)",
+           compute_gates=lambda cfg_, sc_, p, s, salt: tuple(
+               zw(s) for _ in range(6)))
     report("ranks_desc",
            ranks_desc=lambda prio, tiebreak=None: jnp.zeros(
                prio.shape, dtype=jnp.int32))
+    class FakeLax:
+        def __getattr__(self, a):
+            return getattr(jax.lax, a)
+
+        @staticmethod
+        def optimization_barrier(x):
+            return x
+
+    class FakeJax:
+        lax = FakeLax()
+
+        def __getattr__(self, a):
+            return getattr(jax, a)
+
+    report("no optimization_barrier (news fused)", jax=FakeJax())
 
 
 if __name__ == "__main__":

@@ -409,6 +409,14 @@ class GossipParams:
     # (feature negotiation, gossipsub_feat.go:11-52, gossipsub.go:969-974)
     flood_proto: jnp.ndarray | None = None       # bool [N]
     cand_flood_bits: jnp.ndarray | None = None   # uint32 [N]
+    # operator-pinned DIRECT peers, per edge (bit c = candidate p+o_c
+    # is a direct peer of p; symmetric).  Direct edges always receive
+    # eager forwards (gossipsub.go:945-950), bypass the graylist/gater
+    # on both payload and control (AcceptFrom, gossipsub.go:578-586),
+    # and never enter meshes — GRAFT at a direct edge is rejected
+    # (gossipsub.go:737-745).  The sim's always-on edge is the analog
+    # of the periodic directConnect reconnection (gossipsub.go:1594).
+    cand_direct: jnp.ndarray | None = None       # uint32 [N]
 
 
 @struct.dataclass
@@ -488,6 +496,7 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
                     flood_proto: np.ndarray | None = None,
                     promise_break: np.ndarray | None = None,
                     px_candidates: int | None = None,
+                    direct_edges: np.ndarray | None = None,
                     pad_to_block: int | None = None):
     """Build (params, state).  subs: bool [N, T] — but each peer may only
     subscribe to its residue-class topic (circulant classes are closed, so
@@ -633,6 +642,33 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
         fp = np.asarray(flood_proto, dtype=bool)
         kw.update(flood_proto=jnp.asarray(padl(fp)),
                   cand_flood_bits=jnp.asarray(padl(cand_bits(fp))))
+
+    if direct_edges is not None:
+        if cfg.paired_topics:
+            raise ValueError("direct_edges not supported in paired mode")
+        if px_candidates is not None:
+            raise ValueError(
+                "direct_edges + px_candidates not supported together "
+                "(PX rotation would deactivate pinned edges)")
+        if pad_to_block is not None:
+            raise ValueError(
+                "direct_edges not supported by the pallas (padded) "
+                "step — build without pad_to_block")
+        de = np.asarray(direct_edges, dtype=bool)
+        if de.shape != (n, cfg.n_candidates):
+            raise ValueError("direct_edges must be bool [N, C]")
+        # operators configure both ends (WithDirectPeers on each node,
+        # gossipsub.go:338): the edge view must be symmetric —
+        # de[p, c] == de[p + o_c, cinv_c] (np.roll(x, -o)[p] = x[p+o])
+        for c, o in enumerate(cfg.offsets):
+            if not (de[:, c] == np.roll(de[:, cfg.cinv[c]], -o)).all():
+                raise ValueError(
+                    "direct_edges must be symmetric: peer p's bit c "
+                    "and peer p+o_c's bit cinv[c] describe one edge")
+        packed = np.zeros(n, dtype=np.uint32)
+        for c in range(cfg.n_candidates):
+            packed |= de[:, c].astype(np.uint32) << c
+        kw.update(cand_direct=jnp.asarray(padl(packed)))
 
     if promise_break is not None:
         if score_cfg is None:
@@ -1178,6 +1214,7 @@ def make_gossip_step(cfg: GossipSimConfig,
             if (C > 16 or W == 0 or params.flood_proto is not None
                     or paired or state.active is not None
                     or params.cand_same_ip is not None
+                    or params.cand_direct is not None
                     or state.gates is None
                     or (sc is not None and (sc.track_p3
                                             or sc.flood_publish
@@ -1191,9 +1228,10 @@ def make_gossip_step(cfg: GossipSimConfig,
                                                 sc.ip_colocation_factor_weight)))):
                 raise ValueError(
                     "config not supported by the pallas step (needs "
-                    "C<=16, W>=1, carried gates, no flood_proto/"
-                    "track_p3/flood_publish/sybil_iwant_spam/"
-                    "paired_topics/px_candidates/shared-IP gater)")
+                    "C<=16, W>=1, carried gates, matching static score "
+                    "weights, no flood_proto/track_p3/flood_publish/"
+                    "sybil_iwant_spam/paired_topics/px_candidates/"
+                    "direct peers/shared-IP gater)")
         elif params.n_true is not None:
             raise ValueError(
                 "padded sim state requires the pallas step (XLA rolls "
@@ -1234,6 +1272,11 @@ def make_gossip_step(cfg: GossipSimConfig,
             pub_ok_bits, nonneg_bits, payload_bits = g[2], g[3], g[4]
             bo_row = g[5]
             bo_row_b = g[6] if paired else None
+            if params.cand_direct is not None:
+                # direct peers bypass the graylist and the gater for
+                # both control and payload (AcceptFrom gossipsub.go:578)
+                accept_bits = accept_bits | params.cand_direct
+                payload_bits = payload_bits | params.cand_direct
             # per-word validity masks (scalar uint32 per word: bit m set
             # iff message m passes validation)
             valid_w = [~params.invalid_words[w] for w in range(W)]
@@ -1268,6 +1311,10 @@ def make_gossip_step(cfg: GossipSimConfig,
         f_deg = popcount32(fanout)
         f_need = jnp.where(alive, cfg.d - f_deg, 0)
         f_elig = params.cand_sub_bits & ~fanout
+        if params.cand_direct is not None:
+            # direct peers receive everything anyway; spending fanout
+            # slots on them would cut the effective fanout degree
+            f_elig = f_elig & ~params.cand_direct
         if state.active is not None:
             f_elig = f_elig & state.active
         if params.flood_proto is not None:
@@ -1303,6 +1350,11 @@ def make_gossip_step(cfg: GossipSimConfig,
             fresh_b = [f & params.slot_b_words[w]
                        for w, f in enumerate(fresh)]
         out_bits = state.mesh | fanout                          # [N]
+        if params.cand_direct is not None:
+            # direct peers are always eager-forward targets
+            # (gossipsub.go:945-950), subscription-gated like any edge
+            out_bits = out_bits | (params.cand_direct
+                                   & params.cand_sub_bits)
         if params.flood_proto is not None:
             # mixed network: gossipsub peers always forward to floodsub-
             # protocol candidates, and floodsub-protocol peers flood to
@@ -1450,6 +1502,9 @@ def make_gossip_step(cfg: GossipSimConfig,
             backoff_bits = bo_row0
             can_graft = (params.cand_sub_bits & ~mesh_ng & ~backoff_bits
                          & sub_all)
+            if params.cand_direct is not None:
+                # never GRAFT at a direct peer (gossipsub.go:1340-1345)
+                can_graft = can_graft & ~params.cand_direct
             if state.active is not None:
                 can_graft = can_graft & state.active
             if params.flood_proto is not None:
@@ -1541,6 +1596,11 @@ def make_gossip_step(cfg: GossipSimConfig,
             # bits, derived algebraically (the only edges whose backoff
             # changed are prunes|neg, all set beyond tick)
             would_accept = sub_all & ~backoff_bits2
+            if params.cand_direct is not None:
+                # GRAFT from a direct peer is rejected with a PRUNE
+                # response (gossipsub.go:737-745) — the A-mask carries
+                # the rejection back in the same transfer round
+                would_accept = would_accept & ~params.cand_direct
             if params.flood_proto is not None:
                 would_accept = jnp.where(params.flood_proto, Z,
                                          would_accept)

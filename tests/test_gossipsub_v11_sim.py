@@ -428,3 +428,75 @@ def test_same_tick_credit_uniform_scale():
     assert (mult <= cfg.d_hi + 0.01).all()
     # uniform-scale claim: concentration across honest peers
     assert mult.std() / mult.mean() < 0.35, (mult.mean(), mult.std())
+
+
+def test_direct_peers_always_forward_never_mesh():
+    """Operator-pinned direct peers (gossipsub.go:945-950, 737-745,
+    1594-1616): always eager-forwarded, never grafted, graylist/gater
+    bypassed.  With gossip disabled (d_lazy=0, factor=0) a fully
+    mesh-isolated peer can ONLY receive through its direct edge."""
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+
+    n, t, C, m = 600, 3, 16, 8
+    rng = np.random.default_rng(9)
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, C, n, seed=9), n_topics=t,
+        d=3, d_lo=2, d_hi=6, d_score=2, d_out=1,
+        d_lazy=0, gossip_factor=0.0)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    isolated = np.zeros(n, dtype=bool)
+    isolated[::30] = True
+    origin_pool = np.flatnonzero(~isolated)
+    origin = origin_pool[rng.integers(0, len(origin_pool), m)]
+    topic = (origin % t).astype(np.int64)
+    ticks = np.zeros(m, dtype=np.int32)
+
+    # every isolated peer gets ONE direct edge (candidate bit 0);
+    # operators configure both ends, so the partner's cinv bit mirrors
+    o0 = int(cfg.offsets[0])
+    cinv0 = cfg.cinv[0]
+    de = np.zeros((n, C), dtype=bool)
+    de[:, 0] = isolated
+    # partner q = p + o0 marks the same edge on bit cinv0:
+    # de[q, cinv0] = isolated[q - o0]  (np.roll(x, o)[q] = x[q-o])
+    de[:, cinv0] = np.roll(isolated, o0)
+    sc = gs.ScoreSimConfig()
+    params, state = gs.make_gossip_sim(
+        cfg, subs, topic, origin, ticks, score_cfg=sc,
+        direct_edges=de)
+    # eternal backoff on every edge touching an isolated peer: no
+    # mesh membership for them, ever
+    iso_cols = jnp.broadcast_to(jnp.asarray(isolated)[None, :],
+                                state.backoff.shape)
+    blocked = iso_cols | gs.transfer_mask(iso_cols, cfg)
+    state = gs.refresh_gates(cfg, sc, params, state.replace(
+        backoff=jnp.where(blocked, 30_000, state.backoff)))
+    out = gs.gossip_run(params, state, 40, gs.make_gossip_step(cfg, sc))
+
+    # direct edges never entered any mesh
+    assert int(jnp.sum(out.mesh & params.cand_direct)) == 0
+    # non-isolated subscribers all converged and received everything
+    have = np.asarray(out.have)[0]
+    members = np.arange(n) % t
+    want_bits = np.zeros(n, dtype=np.uint32)
+    for j in range(m):
+        want_bits[members == topic[j]] |= np.uint32(1 << j)
+    ok_honest = (have[~isolated] & want_bits[~isolated]) == \
+        want_bits[~isolated]
+    assert ok_honest.all()
+    # isolated peers: received exactly iff their direct partner exists
+    # and subscribes the same topic (always true here: offsets are
+    # multiples of t, so partners share the class)
+    got = (have[isolated] & want_bits[isolated]) == want_bits[isolated]
+    assert got.all(), "direct edge failed to deliver"
+    # control: the same scenario WITHOUT direct edges delivers nothing
+    # to the isolated peers (no gossip, no mesh)
+    params2, state2 = gs.make_gossip_sim(
+        cfg, subs, topic, origin, ticks, score_cfg=sc)
+    state2 = gs.refresh_gates(cfg, sc, params2, state2.replace(
+        backoff=jnp.where(blocked, 30_000, state2.backoff)))
+    out2 = gs.gossip_run(params2, state2, 40,
+                         gs.make_gossip_step(cfg, sc))
+    have2 = np.asarray(out2.have)[0]
+    assert (have2[isolated] & want_bits[isolated]).max() == 0

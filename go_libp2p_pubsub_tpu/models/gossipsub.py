@@ -37,9 +37,11 @@ TPU-first representation (see PERF_NOTES.md):
   than argsort at C=16 — wrapped in expand/pack so inputs and outputs
   stay packed.
 - **Messages are bit positions** in uint32 words, as in models/floodsub.py.
-  The mcache (mcache.go) becomes a ring of recently-acquired words: slot 0
-  = newest heartbeat window; IHAVE advertises the OR of the newest
-  HistoryGossip slots (mcache.go:82, GetGossipIDs).
+  The mcache (mcache.go) becomes a ROTATING ring of recently-acquired
+  words: slot (t-1) mod HistoryGossip holds the newest heartbeat window,
+  and each tick overwrites one slot in place (no full-ring shift); IHAVE
+  advertises the OR of all HistoryGossip slots (mcache.go:82,
+  GetGossipIDs — order-independent, so slot rotation is free).
 
 Timing model: one tick = one heartbeat = one network hop.  Reachability is
 measured in hops (publish-tick-relative), which is exactly the
@@ -1175,8 +1177,9 @@ def make_gossip_step(cfg: GossipSimConfig,
         gates_new = tuple(outs[3:3 + n_gates])
         outs = outs[3 + n_gates:]
         have = state.have | new_acq
-        recent = jnp.concatenate([new_acq[None], state.recent[:-1]],
-                                 axis=0)
+        recent = jax.lax.dynamic_update_slice_in_dim(
+            state.recent, new_acq[None],
+            jnp.mod(tick, cfg.history_gossip), axis=0)
         delivered_now = new_acq & params.deliver_words
         if sc is not None:
             delivered_now = delivered_now & ~params.invalid_words[:, None]
@@ -1334,7 +1337,14 @@ def make_gossip_step(cfg: GossipSimConfig,
         # fanout (forwardMessage, gossipsub.go:989-999).  Honest peers
         # never forward invalid messages (validation rejects them before
         # the router sees them, validation.go:274-351); sybils do.
-        fresh = [state.recent[0, w] | injected[w] for w in range(W)]
+        # the mcache ring is ROTATING-SLOT: slot (t-1) mod Hg holds tick
+        # t-1's acquisitions (the newest window); the epilogue overwrites
+        # slot t mod Hg in place instead of shifting the whole ring
+        # (jnp.mod, not lax.rem: tick 0 must read slot Hg-1, zeros)
+        newest = jnp.mod(tick - 1, cfg.history_gossip)
+        recent_new = jax.lax.dynamic_index_in_dim(
+            state.recent, newest, axis=0, keepdims=False)   # [W, N]
+        fresh = [recent_new[w] | injected[w] for w in range(W)]
         if sc is not None:
             fresh = [jnp.where(params.sybil, f, f & valid_w[w])
                      for w, f in enumerate(fresh)]
@@ -1806,8 +1816,12 @@ def make_gossip_step(cfg: GossipSimConfig,
              for w in range(W)], axis=0) if W
             else jnp.zeros((0, n), dtype=jnp.uint32))           # [W, N]
         have = state.have | new_acquired
-        recent = jnp.concatenate([new_acquired[None], state.recent[:-1]],
-                                 axis=0)
+        # rotating-slot ring write: overwrite slot t mod Hg in place
+        # (lowers to an in-place dynamic-update inside the scan; the
+        # old full-ring concatenate shift re-wrote every slot per tick)
+        recent = jax.lax.dynamic_update_slice_in_dim(
+            state.recent, new_acquired[None],
+            jnp.mod(tick, cfg.history_gossip), axis=0)
 
         delivered_now = new_acquired & params.deliver_words
         if sc is not None:

@@ -6,7 +6,7 @@ clients wedge the axon tunnel).  Sync points are data-dependent host
 transfers (block_until_ready resolves early on this platform).
 
 Usage: python tools/bench_kernel.py [n] [which ...]
-  which in {xla, kernel}; default both.
+  which in {xla, kernel, kernela}; default xla+kernel.
 """
 
 from __future__ import annotations
@@ -72,6 +72,17 @@ def main():
     if "kernel" in which:
         cfg, sc, params, state = build(n, pad_block=8192)
         timed("kernel-b8192", cfg, sc, params, state,
+              receive_block=8192)
+    if "kernela" in which:
+        # aligned-wrap plan: n divisible by lcm(t=100, ALIGN8, block)
+        na = 1_024_000 if n == 1_000_000 else n
+        from go_libp2p_pubsub_tpu.ops.pallas.receive import plan
+        cfg, sc, params, state = build(na, pad_block=8192)
+        if not plan(na, cfg.offsets, 8192)["aligned"]:
+            raise SystemExit(
+                f"n={na} does not satisfy the aligned plan "
+                "(need n % 4096 == 0 and n % 8192 == 0)")
+        timed(f"kernel-aligned-n{na}", cfg, sc, params, state,
               receive_block=8192)
 
 

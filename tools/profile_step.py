@@ -113,7 +113,12 @@ def main():
     # -- phase 2 core: forward rolls (C edges, W words) -----------------
     def forward(i, carry, params, state):
         out_bits = state.mesh ^ (carry & 1).astype(jnp.uint32)
-        fresh = [state.recent[0, w] for w in range(W)]
+        # rotating-slot ring: the newest window is slot (t-1) mod Hg,
+        # read via the same dynamic index the real step performs
+        newest = jax.lax.dynamic_index_in_dim(
+            state.recent, jnp.mod(state.tick - 1, cfg.history_gossip),
+            axis=0, keepdims=False)
+        fresh = [newest[w] for w in range(W)]
         seen = [state.have[w] for w in range(W)]
         heard = [Z] * W
         fd = [None] * C

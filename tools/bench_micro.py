@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Micro-benchmarks for step-formulation experiments on the real chip.
+
+Each variant runs inside a jitted lax.scan with loop-carried state
+(PERF_NOTES: eager timings and loop-invariant formulations are not
+trustworthy here), synced by a data-dependent host transfer.
+
+Usage: python tools/bench_micro.py [n] [k]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    k = int(sys.argv[2]) if len(sys.argv) > 2 else 200
+    t, C = 100, 16
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, C, n, seed=0), n_topics=t)
+    offsets = [int(o) for o in cfg.offsets]
+    cinv = cfg.cinv
+    rng = np.random.default_rng(0)
+    bits0 = jnp.asarray(rng.integers(0, 1 << 32, n, dtype=np.uint32))
+
+    def timed(name, body):
+        def run(b):
+            def sc(carry, _):
+                return body(carry), None
+            out, _ = jax.lax.scan(sc, b, None, length=k)
+            return out
+
+        jr = jax.jit(run)
+        out = jr(bits0)
+        _ = int(np.asarray(out)[0])  # compile+warm
+        best = 1e9
+        for _r in range(3):
+            t0 = time.perf_counter()
+            out = jr(out)
+            _ = int(np.asarray(out)[0])
+            best = min(best, (time.perf_counter() - t0) / k)
+        print(f"{name:40s} {best * 1e6:9.2f} us/iter", flush=True)
+
+    u1 = jnp.uint32(1)
+
+    def transfer_fused(b):
+        out = jnp.zeros_like(b)
+        for c, off in enumerate(offsets):
+            r = jnp.roll((b >> jnp.uint32(c)) & u1, off, axis=0)
+            out = out | (r << jnp.uint32(cinv[c]))
+        return out
+
+    def transfer_barrier(b):
+        out = jnp.zeros_like(b)
+        for c, off in enumerate(offsets):
+            r = jnp.roll((b >> jnp.uint32(c)) & u1, off, axis=0)
+            r = jax.lax.optimization_barrier(r)
+            out = out | (r << jnp.uint32(cinv[c]))
+        return out
+
+    def transfer_barrier_preshift(b):
+        # barrier AFTER the shift: materialized word is the final
+        # contribution, OR chain reads C materialized words
+        out = jnp.zeros_like(b)
+        for c, off in enumerate(offsets):
+            r = jnp.roll((b >> jnp.uint32(c)) & u1, off, axis=0)
+            out = out | jax.lax.optimization_barrier(
+                r << jnp.uint32(cinv[c]))
+        return out
+
+    def transfer_fullword_rolls(b):
+        # roll the FULL word per edge, mask after: C rolls of 4 MB
+        # instead of C bit-extract+roll chains (more traffic, simpler
+        # access pattern)
+        out = jnp.zeros_like(b)
+        for c, off in enumerate(offsets):
+            r = jnp.roll(b, off, axis=0)
+            out = out | (((r >> jnp.uint32(c)) & u1)
+                         << jnp.uint32(cinv[c]))
+        return out
+
+    def pair_fused(b):
+        sel = jnp.uint32(0x1_0001)
+        out = jnp.zeros_like(b)
+        for c, off in enumerate(offsets):
+            r = jnp.roll((b >> jnp.uint32(c)) & sel, off, axis=0)
+            out = out | (r << jnp.uint32(cinv[c]))
+        return out
+
+    def pair_barrier(b):
+        sel = jnp.uint32(0x1_0001)
+        out = jnp.zeros_like(b)
+        for c, off in enumerate(offsets):
+            r = jnp.roll((b >> jnp.uint32(c)) & sel, off, axis=0)
+            r = jax.lax.optimization_barrier(r)
+            out = out | (r << jnp.uint32(cinv[c]))
+        return out
+
+    timed("transfer_bits fused (current)", transfer_fused)
+    timed("transfer_bits barrier-roll", transfer_barrier)
+    timed("transfer_bits barrier-postshift", transfer_barrier_preshift)
+    timed("transfer_bits full-word rolls", transfer_fullword_rolls)
+    timed("pair transfer fused (current)", pair_fused)
+    timed("pair transfer barrier-roll", pair_barrier)
+
+
+if __name__ == "__main__":
+    main()

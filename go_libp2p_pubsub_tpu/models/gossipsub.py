@@ -997,19 +997,33 @@ def compute_gates(cfg: GossipSimConfig, sc: ScoreSimConfig | None,
         del_tot = fdel.sum(axis=0)
         pressure = 16.0 * inv_tot / (1.0 + del_tot + 16.0 * inv_tot)
         gater_on = pressure > 0.33
-        if params.cand_same_ip is not None:
-            inv_g = jnp.zeros_like(invd)
-            fd_g = jnp.zeros_like(fdel)
-            for cc in range(C):
-                sib = expand_bits(params.cand_same_ip[cc], C)  # [C, N]
-                inv_g = inv_g + jnp.where(sib, invd[cc][None, :], 0.0)
-                fd_g = fd_g + jnp.where(sib, fdel[cc][None, :], 0.0)
-        else:
-            inv_g, fd_g = invd, fdel
-        goodput = (1.0 + fd_g) / (1.0 + fd_g + 16.0 * inv_g)
-        u_gater = lane_uniform((C, n), tick, 6, salt, stride=n_stream)
-        gater_bits = pack_rows(u_gater < goodput) | jnp.where(
-            gater_on, Z, ALL)
+        def gater_draw():
+            # the same-IP sibling aggregation lives INSIDE the cond:
+            # built outside, it would be a cond operand and run on
+            # every clean tick too
+            if params.cand_same_ip is not None:
+                inv_g = jnp.zeros_like(invd)
+                fd_g = jnp.zeros_like(fdel)
+                for cc in range(C):
+                    sib = expand_bits(params.cand_same_ip[cc], C)
+                    inv_g = inv_g + jnp.where(sib, invd[cc][None, :],
+                                              0.0)
+                    fd_g = fd_g + jnp.where(sib, fdel[cc][None, :],
+                                            0.0)
+            else:
+                inv_g, fd_g = invd, fdel
+            goodput = (1.0 + fd_g) / (1.0 + fd_g + 16.0 * inv_g)
+            u_gater = lane_uniform((C, n), tick, 6, salt,
+                                   stride=n_stream)
+            return pack_rows(u_gater < goodput) | jnp.where(
+                gater_on, Z, ALL)
+
+        # the RED draw only matters while some peer is under pressure
+        # (invalid traffic present); clean runs skip the [C, N] hash +
+        # compare + pack entirely
+        gater_bits = jax.lax.cond(
+            jnp.any(gater_on), gater_draw,
+            lambda: jnp.full_like(accept_bits, ALL))
         rows.append(accept_bits & gater_bits)               # payload
     rows.append(pack_rows(st.backoff > 0))
     if cfg.paired_topics:

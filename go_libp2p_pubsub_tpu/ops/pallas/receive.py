@@ -126,10 +126,15 @@ def extend_wrap(row: jnp.ndarray, n_true: int, n_pad: int,
     return big[start:start + length]
 
 
+_SKIP_REALIGN = False  # timing-isolation knob (tools/bench_kernel.py
+#   --noroll): skip the in-VMEM realign lane rolls.  WRONG RESULTS —
+#   only for costing the rolls inside the real kernel schedule.
+
+
 def _flat_roll(vec: jnp.ndarray, delta: int, take: int) -> jnp.ndarray:
     """vec[delta:delta+take] for arbitrary (unaligned) static delta:
     1-row lane roll, then an aligned static slice."""
-    if delta == 0:
+    if delta == 0 or _SKIP_REALIGN:
         return vec[:take]
     ln = vec.shape[0]
     r = pltpu.roll(vec.reshape(1, ln), ln - delta, 1)

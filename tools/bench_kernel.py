@@ -66,6 +66,14 @@ def timed(name, cfg, sc, params, state, **step_kw):
 def main():
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
     which = sys.argv[2:] or ["xla", "kernel"]
+    if "--noroll" in which:
+        # timing isolation: cost of the kernel's in-VMEM realign rolls
+        # (results are WRONG; only ms/tick is meaningful)
+        which.remove("--noroll")
+        which = which or ["xla", "kernel"]
+        import go_libp2p_pubsub_tpu.ops.pallas.receive as rcv
+        rcv._SKIP_REALIGN = True
+        print("!! realign rolls skipped: timings only, results wrong")
     if "xla" in which:
         cfg, sc, params, state = build(n)
         timed("xla", cfg, sc, params, state)

@@ -68,7 +68,7 @@ def main():
             out = out | (r << jnp.uint32(cinv[c]))
         return out
 
-    def transfer_barrier_preshift(b):
+    def transfer_barrier_postshift(b):
         # barrier AFTER the shift: materialized word is the final
         # contribution, OR chain reads C materialized words
         out = jnp.zeros_like(b)
@@ -108,7 +108,7 @@ def main():
 
     timed("transfer_bits fused (current)", transfer_fused)
     timed("transfer_bits barrier-roll", transfer_barrier)
-    timed("transfer_bits barrier-postshift", transfer_barrier_preshift)
+    timed("transfer_bits barrier-postshift", transfer_barrier_postshift)
     timed("transfer_bits full-word rolls", transfer_fullword_rolls)
     timed("pair transfer fused (current)", pair_fused)
     timed("pair transfer barrier-roll", pair_barrier)

@@ -259,3 +259,43 @@ def test_px_candidate_refresh_recovers_starved_peers():
     assert useful_px.mean() > 1.15 * useful_no.mean(), (
         useful_px.mean(), useful_no.mean())
     assert deg_px.mean() > deg_no.mean(), (deg_px.mean(), deg_no.mean())
+
+
+def test_paired_pipelined_gates_match_recompute():
+    """Paired mode carries a seventh gate row (slot-B backoff); the
+    pipelined emission must match a tick-start recompute bit-for-bit
+    across both meshes."""
+    import jax
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(4, 12, 600, seed=3, paired=True),
+        n_topics=4, paired_topics=True,
+        d=3, d_lo=2, d_hi=6, d_score=2, d_out=1, d_lazy=2)
+    rng = np.random.default_rng(3)
+    own = np.arange(600) % 4
+    second = (own + 2) % 4
+    subs = np.zeros((600, 4), dtype=bool)
+    subs[np.arange(600), own] = True
+    subs[np.arange(600), second] = True
+    topic = rng.integers(0, 4, 10)
+    members = [np.flatnonzero((own == tau) | (second == tau))
+               for tau in range(4)]
+    origin = np.array([rng.choice(members[tau]) for tau in topic])
+    ticks = np.sort(rng.integers(0, 10, 10)).astype(np.int32)
+    sc = gs.ScoreSimConfig()
+    params, state = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                                       score_cfg=sc)
+    assert len(state.gates) == 7
+    out_p = gs.gossip_run(params, state, 25, gs.make_gossip_step(cfg, sc))
+    out_r = gs.gossip_run(params, state, 25,
+                          gs.make_gossip_step(cfg, sc,
+                                              pipeline_gates=False))
+    for f in ("have", "mesh", "mesh_b", "backoff", "backoff_b",
+              "recent"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(out_p, f)), np.asarray(getattr(out_r, f)),
+            err_msg=f)
+    np.testing.assert_array_equal(
+        np.asarray(out_p.gates),
+        np.asarray(gs.compute_gates(
+            cfg, sc, params, out_p,
+            jax.random.key_data(out_p.key)[-1])))

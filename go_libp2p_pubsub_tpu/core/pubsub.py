@@ -112,6 +112,7 @@ class PubSub:
                  validate_workers: int = 4,
                  seen_ttl: float = TIME_CACHE_DURATION,
                  no_author: bool = False,
+                 message_author: Optional[PeerID] = None,
                  clock: Optional[Callable[[], float]] = None):
         self.host = host
         self.router = router
@@ -125,8 +126,29 @@ class PubSub:
         self.clock = clock or time.monotonic
 
         # the author defaults to the host regardless of signing policy
-        # (reference pubsub.go:230); WithNoAuthor clears it (pubsub.go:366-373)
-        self.sign_id: Optional[PeerID] = None if no_author else host.id
+        # (reference pubsub.go:230); WithNoAuthor clears it
+        # (pubsub.go:366-373); WithMessageAuthor overrides it
+        # (pubsub.go:352-364 — the reference then resolves that
+        # author's key from the peerstore; this host only holds its
+        # own key, so a foreign author is limited to non-signing
+        # policies)
+        if message_author is not None and no_author:
+            raise ValueError("message_author conflicts with no_author")
+        if (message_author is not None and sign_policy.must_sign
+                and message_author != host.id):
+            raise ValueError(
+                "cannot sign as a foreign author: no key for "
+                f"{message_author}")
+        if no_author and sign_policy.must_sign:
+            # WithNoAuthor clears the signing bit (pubsub.go:371,
+            # `p.signPolicy &^= msgSigning`) — without this, peers
+            # would emit unsigned messages yet reject each other's
+            # for the missing signature
+            sign_policy = MessageSignaturePolicy(
+                sign_policy & ~MessageSignaturePolicy.LAX_SIGN)
+            self.sign_policy = sign_policy
+        self.sign_id: Optional[PeerID] = (
+            None if no_author else (message_author or host.id))
         self.sign_key = host.key if (sign_policy.must_sign
                                      and not no_author) else None
 

@@ -323,3 +323,62 @@ async def test_peer_error_on_protocol_mismatch():
     await t.subscribe()
     await ps.close()
     await net.close()
+
+
+async def test_custom_message_author():
+    """WithMessageAuthor (reference pubsub.go:352-364): messages carry
+    the configured author instead of the host ID.  Signing as a foreign
+    author is rejected (no key for it)."""
+    import pytest
+    from go_libp2p_pubsub_tpu.core.crypto import generate_keypair
+
+    other_id = generate_keypair().public.peer_id()
+    net = InProcNetwork()
+    hosts = get_hosts(net, 2)
+    psubs = await make_floodsubs(
+        hosts, sign_policy=MessageSignaturePolicy.LAX_NO_SIGN,
+        message_author=other_id)
+    t0 = await psubs[0].join("t")
+    t1 = await psubs[1].join("t")
+    sub = await t1.subscribe()
+    await connect(hosts[0], hosts[1])
+    await settle(0.1)
+    await t0.publish(b"attributed")
+    msg = await asyncio.wait_for(sub.next(), 5)
+    assert msg.rpc.from_peer == bytes(other_id)
+    await close_all(psubs, net)
+
+    from go_libp2p_pubsub_tpu.core import PubSub
+    from go_libp2p_pubsub_tpu.core.floodsub import FloodSubRouter
+    net2 = InProcNetwork()
+    h = get_hosts(net2, 1)[0]
+    try:
+        with pytest.raises(ValueError, match="foreign author"):
+            PubSub(h, FloodSubRouter(),
+                   sign_policy=MessageSignaturePolicy.STRICT_SIGN,
+                   message_author=other_id)
+    finally:
+        await net2.close()
+
+
+async def test_no_author_with_default_policy_still_delivers():
+    """WithNoAuthor downgrades the signing bit of the policy
+    (pubsub.go:371): two no_author nodes on the DEFAULT StrictSign
+    policy must accept each other's unsigned messages rather than
+    rejecting them for the missing signature."""
+    import hashlib
+    net = InProcNetwork()
+    hosts = get_hosts(net, 2)
+    psubs = await make_floodsubs(
+        hosts, no_author=True,
+        msg_id_fn=lambda m: hashlib.sha256(m.data or b"").digest())
+    t0 = await psubs[0].join("t")
+    t1 = await psubs[1].join("t")
+    sub = await t1.subscribe()
+    await connect(hosts[0], hosts[1])
+    await settle(0.1)
+    await t0.publish(b"unsigned but accepted")
+    msg = await asyncio.wait_for(sub.next(), 5)
+    assert msg.data == b"unsigned but accepted"
+    assert msg.rpc.signature is None
+    await close_all(psubs, net)

@@ -1,0 +1,18 @@
+#!/bin/bash
+# One recovery-day measurement pass: strictly sequential TPU processes,
+# generous timeouts (never kill mid-run unless truly wedged).
+set -u
+cd /root/repo
+log=/tmp/measure_all.log
+: > "$log"
+run() {
+  echo "=== $* ===" | tee -a "$log"
+  timeout -k 10 1800 "$@" 2>&1 | grep -v WARNING | tee -a "$log"
+  local rc=${PIPESTATUS[0]}
+  echo "--- rc=$rc ---" | tee -a "$log"
+}
+run python tools/bench_kernel.py 1000000 xla kernel kernela
+run python tools/bench_kernel.py 1000000 kernela --noroll
+run python tools/bench_micro.py 1000000 100
+run python tools/profile_trace.py 1000000 xla
+echo DONE | tee -a "$log"

@@ -141,12 +141,13 @@ class PubSub:
                 f"{message_author}")
         if no_author and sign_policy.must_sign:
             # WithNoAuthor clears the signing bit (pubsub.go:371,
-            # `p.signPolicy &^= msgSigning`) — without this, peers
-            # would emit unsigned messages yet reject each other's
-            # for the missing signature
+            # `p.signPolicy &^= msgSigning`; LAX_SIGN is exactly that
+            # bit) — without this, peers would emit unsigned messages
+            # yet reject each other's for the missing signature
             sign_policy = MessageSignaturePolicy(
                 sign_policy & ~MessageSignaturePolicy.LAX_SIGN)
-            self.sign_policy = sign_policy
+            self.sign_policy = sign_policy  # keep the line-119 binding
+            #   and this one in sync: both must hold the EFFECTIVE policy
         self.sign_id: Optional[PeerID] = (
             None if no_author else (message_author or host.id))
         self.sign_key = host.key if (sign_policy.must_sign

@@ -953,10 +953,12 @@ def compute_gates(cfg: GossipSimConfig, sc: ScoreSimConfig | None,
       2 publish  — score >= publish threshold (gossipsub.go:956)
       3 nonneg   — score >= 0 (mesh retention/graft, gossipsub.go:1340)
       4 payload  — accept ∧ RED-gater draw (peer_gater.go:320-363)
-      5 backoff  — remaining backoff > 0 (no re-GRAFT, gossipsub.go:747)
-      6 backoff_b (paired mode only)
+      5 targets  — this tick's lazy-gossip IHAVE targets (emitGossip,
+                   gossipsub.go:1656-1712; the only always-on selection)
+      6 backoff  — remaining backoff > 0 (no re-GRAFT, gossipsub.go:747)
+      7 backoff_b (paired mode only)
 
-    Unscored sims carry only the backoff row(s).
+    Unscored sims carry (targets, backoff(, backoff_b)).
 
     The step normally does NOT call this at tick start: the previous
     tick's epilogue (or the pallas receive kernel) emits the same rows
@@ -1025,6 +1027,52 @@ def compute_gates(cfg: GossipSimConfig, sc: ScoreSimConfig | None,
             jnp.any(gater_on), gater_draw,
             lambda: jnp.full_like(accept_bits, ALL))
         rows.append(accept_bits & gater_bits)               # payload
+
+    # lazy-gossip targets: random non-mesh subscribed candidates,
+    # max(Dlazy, factor * |elig|), both sides above the gossip
+    # threshold (emitGossip gossipsub.go:1656-1712).  st.fanout is
+    # pre-tick state (fanout-only peers are unsubscribed, already
+    # zeroed by the sub gate — the ~fanout term is belt-and-braces).
+    sub_all = jnp.where(params.subscribed, ALL, Z)
+    elig = params.cand_sub_bits & ~st.mesh & ~st.fanout & sub_all
+    if st.active is not None:
+        elig = elig & st.active
+    if cfg.paired_topics:
+        # shared gossip stream across the two topic slots (one Dlazy
+        # selection covers both; documented deviation from per-topic
+        # emission): exclude slot-B mesh members too
+        elig = elig & ~st.mesh_b
+    if params.flood_proto is not None:
+        # no IHAVE to floodsub-protocol peers (no control protocol)
+        elig = elig & ~params.cand_flood_bits
+    if sc is not None:
+        elig = elig & rows[1]                               # gossip gate
+    n_elig = popcount32(elig)
+    n_gossip = jnp.maximum(
+        jnp.int32(cfg.d_lazy),
+        (cfg.gossip_factor * n_elig.astype(jnp.float32)).astype(
+            jnp.int32))
+    if cfg.binomial_gossip_sampling:
+        # Bernoulli(k/|elig|) per eligible edge: same inclusion
+        # probability as the exact k-subset, no [C, C, N] rank
+        # (see GossipSimConfig.binomial_gossip_sampling)
+        p_g = jnp.minimum(
+            1.0, n_gossip.astype(jnp.float32)
+            / jnp.maximum(n_elig, 1).astype(jnp.float32))
+        u_g = lane_uniform((C, n), tick, 1, salt, stride=n_stream)
+        targets = elig & pack_rows(u_g < p_g[None, :])
+    else:
+        targets = select_k_bits(elig, n_gossip,
+                                (C, tick, 1, salt, n_stream))
+    if params.flood_proto is not None:
+        targets = jnp.where(params.flood_proto, Z, targets)
+    if sc is not None and sc.sybil_ihave_spam:
+        # IHAVE-spamming sybils advertise to every subscribed
+        # candidate ids they never deliver (gossipsub_spam_test.go:135)
+        targets = jnp.where(params.sybil, params.cand_sub_bits,
+                            targets)
+    rows.append(targets)
+
     rows.append(pack_rows(st.backoff > 0))
     if cfg.paired_topics:
         rows.append(pack_rows(st.backoff_b > 0))
@@ -1037,8 +1085,9 @@ def refresh_gates(cfg: GossipSimConfig, sc: ScoreSimConfig | None,
                   params: GossipParams, st: GossipState) -> GossipState:
     """Recompute the carried gate words after manual state surgery.
 
-    The pipelined gates are a pure function of (counters, backoff,
-    mesh); any test/tool that edits those fields directly via
+    The pipelined gates are a pure function of the state fields they
+    read — counters, backoff(_b), mesh(_b), fanout, active — plus the
+    static params; any test/tool that edits ANY of those via
     ``state.replace`` must refresh them or the next tick acts on stale
     gates."""
     if st.gates is None:
@@ -1166,8 +1215,10 @@ def make_gossip_step(cfg: GossipSimConfig,
         seen_st = jnp.stack([state.have[w] | injected[w]
                              for w in range(W)])
         inj_st = jnp.stack(injected)
-        # the mixed gater seed for the next tick's phase-6 uniform draw
-        gseed = lane_seed(tick + 1, 6, salt).reshape(1)
+        # mixed lane seeds for the next tick's emissions: phase-6
+        # gater draw, phase-1 gossip-target sampling
+        gseeds = jnp.stack([lane_seed(tick + 1, 6, salt),
+                            lane_seed(tick + 1, 1, salt)])
         cdt = (jnp.dtype(sc.counter_dtype) if sc is not None else None)
         krn = make_receive_update(cfg, sc, n_true, receive_block, cdt,
                                   W, track_promises=track_promises,
@@ -1175,10 +1226,14 @@ def make_gossip_step(cfg: GossipSimConfig,
         args = []
         if sc is not None:
             args.append(jnp.stack(valid_w))
-        args += [gseed, ctrl_flat, fresh_flat, adv_flat]
+        args += [gseeds, ctrl_flat, fresh_flat, adv_flat]
         if sc is not None:
             args += [payload_bits, gossip_bits, accept_bits]
-        args += [sub_all, would_accept, backoff_bits2, grafts, dropped,
+        syb_mask = (jnp.where(params.sybil, ALL, Z)
+                    if sc is not None and sc.sybil_ihave_spam
+                    else jnp.zeros_like(sub_all))
+        args += [sub_all, params.cand_sub_bits, fanout, syb_mask,
+                 would_accept, backoff_bits2, grafts, dropped,
                  mesh_sel, seen_st, inj_st, state.backoff]
         if sc is not None:
             s0 = state.scores
@@ -1187,7 +1242,7 @@ def make_gossip_step(cfg: GossipSimConfig,
                      s0.behaviour_penalty, s0.time_in_mesh]
         outs = krn(*args)
         new_acq, mesh_new, backoff_new = outs[:3]
-        n_gates = 6 if sc is not None else 1
+        n_gates = 7 if sc is not None else 2
         gates_new = tuple(outs[3:3 + n_gates])
         outs = outs[3 + n_gates:]
         have = state.have | new_acq
@@ -1232,6 +1287,7 @@ def make_gossip_step(cfg: GossipSimConfig,
                     or paired or state.active is not None
                     or params.cand_same_ip is not None
                     or params.cand_direct is not None
+                    or not cfg.binomial_gossip_sampling
                     or state.gates is None
                     or (sc is not None and (sc.track_p3
                                             or sc.flood_publish
@@ -1246,9 +1302,10 @@ def make_gossip_step(cfg: GossipSimConfig,
                 raise ValueError(
                     "config not supported by the pallas step (needs "
                     "C<=16, W>=1, carried gates, matching static score "
-                    "weights, no flood_proto/track_p3/flood_publish/"
-                    "sybil_iwant_spam/paired_topics/px_candidates/"
-                    "direct peers/shared-IP gater)")
+                    "weights, binomial gossip sampling, no flood_proto/"
+                    "track_p3/flood_publish/sybil_iwant_spam/"
+                    "paired_topics/px_candidates/direct peers/"
+                    "shared-IP gater)")
         elif params.n_true is not None:
             raise ValueError(
                 "padded sim state requires the pallas step (XLA rolls "
@@ -1268,7 +1325,8 @@ def make_gossip_step(cfg: GossipSimConfig,
         # were in registers, so the prologue touches no [C, N] numeric
         # state.  A state built without gates (or pipeline_gates=False)
         # recomputes them here — bit-identical by construction.
-        n_gate_rows = (5 if sc is not None else 0) + (2 if paired else 1)
+        n_gate_rows = (5 if sc is not None else 0) + 1 \
+            + (2 if paired else 1)
         if state.gates is not None and len(state.gates) != n_gate_rows:
             # a carried gate tuple from a DIFFERENT score config would
             # be silently misread row-for-row (e.g. an accept-threshold
@@ -1287,8 +1345,9 @@ def make_gossip_step(cfg: GossipSimConfig,
             # gossip/publish thresholds :610,956; graft score >= 0 :1340)
             accept_bits, gossip_bits = g[0], g[1]
             pub_ok_bits, nonneg_bits, payload_bits = g[2], g[3], g[4]
-            bo_row = g[5]
-            bo_row_b = g[6] if paired else None
+            targets = g[5]
+            bo_row = g[6]
+            bo_row_b = g[7] if paired else None
             if params.cand_direct is not None:
                 # direct peers bypass the graylist and the gater for
                 # both control and payload (AcceptFrom gossipsub.go:578)
@@ -1300,8 +1359,9 @@ def make_gossip_step(cfg: GossipSimConfig,
         else:
             accept_bits = gossip_bits = payload_bits = None
             valid_w = None
-            bo_row = g[0]
-            bo_row_b = g[1] if paired else None
+            targets = g[0]
+            bo_row = g[1]
+            bo_row_b = g[2] if paired else None
         # the dense [C, N] score is only needed inside the rarely-taken
         # maintenance cond bodies (prune ranking, opportunistic-graft
         # median) — recomputed lazily there so the common path never
@@ -1421,44 +1481,9 @@ def make_gossip_step(cfg: GossipSimConfig,
             if sc is not None:
                 aw = jnp.where(params.sybil, aw, aw & valid_w[w])
             adv.append(aw)
-        elig = (params.cand_sub_bits & ~state.mesh & ~state.fanout
-                & sub_all)          # only subscribed peers gossip
-        if state.active is not None:
-            elig = elig & state.active
-        if paired:
-            # shared gossip stream across the two topic slots (one
-            # Dlazy selection covers both; documented deviation from
-            # per-topic emission): exclude slot-B mesh members too
-            elig = elig & ~state.mesh_b
-        if params.flood_proto is not None:
-            # no IHAVE to floodsub-protocol peers (they don't speak
-            # control); they send none either
-            elig = elig & ~params.cand_flood_bits
-        if sc is not None:
-            elig = elig & gossip_bits
-        n_elig = popcount32(elig)
-        n_gossip = jnp.maximum(
-            jnp.int32(cfg.d_lazy),
-            (cfg.gossip_factor * n_elig.astype(jnp.float32)).astype(
-                jnp.int32))
-        if cfg.binomial_gossip_sampling:
-            # Bernoulli(k/|elig|) per eligible edge: same inclusion
-            # probability as the exact k-subset, no [C, C, N] rank
-            # (see GossipSimConfig.binomial_gossip_sampling)
-            p_g = jnp.minimum(
-                1.0, n_gossip.astype(jnp.float32)
-                / jnp.maximum(n_elig, 1).astype(jnp.float32))
-            u_g = lane_uniform((C, n), tick, 1, salt, stride=n_stream)
-            targets = elig & pack_rows(u_g < p_g[None, :])
-        else:
-            targets = sel_k(elig, n_gossip, u_spec(1))
-        if params.flood_proto is not None:
-            targets = jnp.where(params.flood_proto, Z, targets)
-        if sc is not None and sc.sybil_ihave_spam:
-            # IHAVE-spamming sybils advertise to every subscribed
-            # candidate ids they never deliver (gossipsub_spam_test.go:135)
-            targets = jnp.where(params.sybil, params.cand_sub_bits,
-                                targets)
+        # targets arrive as a gate row (compute_gates row 5/0) — the
+        # selection runs in the emission epilogue where mesh/fanout and
+        # the gossip gate are already live.
         # Promise withholding is BEHAVIORAL from here on: the P7 broken-
         # promise penalty is derived from advertised-vs-delivered traffic
         # at the receiver (gossip_tracer.go:48-153 + applyIwantPenalties

@@ -163,7 +163,9 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     it = iter(refs)
     nxt = lambda: next(it)  # noqa: E731
     valid_ref = nxt() if has_sc else None
-    gseed_ref = nxt()       # u32 [1]: mixed gater seed for tick + 1
+    gseed_ref = nxt()       # u32 [2]: mixed lane seeds for tick + 1
+    #                         [0] gater draw (phase 6), [1] gossip
+    #                         targets (phase 1)
     ctrl_hbm = nxt()
     fresh_hbm = nxt()
     adv_hbm = nxt()
@@ -171,6 +173,10 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     gsp_ref = nxt() if has_sc else None
     acc_ref = nxt() if has_sc else None
     sub_ref = nxt()
+    csub_ref = nxt()        # cand_sub_bits
+    fan_ref = nxt()         # updated fanout (tick t's phase-1b output)
+    syb_ref = nxt()         # ALL/0 per peer: IHAVE-spamming sybil
+    #                         (targets override; zeros when inactive)
     wa_ref = nxt()
     bo2_ref = nxt()
     graft_ref = nxt()
@@ -185,7 +191,7 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     out_acq = nxt()
     out_mesh = nxt()
     out_bo = nxt()
-    out_gates = [nxt() for _ in range(6 if has_sc else 1)]
+    out_gates = [nxt() for _ in range(7 if has_sc else 2)]
     if has_sc:
         out_fd, out_inv, out_bp, out_tim = nxt(), nxt(), nxt(), nxt()
     cbufs = [nxt() for _ in range(N_SLOTS)]
@@ -353,6 +359,40 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
 
     bo_gate = packb(bo_new > 0)
 
+    def lane_u(seed):
+        """Phase uniform for tick+1, matching ops.graph.lane_uniform
+        ((C, n) shape, stride n_true) bit-for-bit."""
+        peer = (jax.lax.broadcasted_iota(jnp.uint32, (C, B), 1)
+                + jnp.uint32(i * B))
+        lane = (jax.lax.broadcasted_iota(jnp.uint32, (C, B), 0)
+                * jnp.uint32(n_true) + peer)
+        h = _fmix32(lane ^ seed)
+        return ((h >> jnp.uint32(8)).astype(jnp.int32)
+                .astype(jnp.float32) * jnp.float32(1 / (1 << 24)))
+
+    def targets_gate(gossip_g):
+        # next tick's lazy-gossip targets (emitGossip, compute_gates
+        # row 5/0): Bernoulli(k/|elig|) over non-mesh subscribed
+        # candidates — the kernel path requires binomial sampling
+        elig = csub_ref[...] & ~mesh & ~fan_ref[...] & sub_all
+        if gossip_g is not None:
+            elig = elig & gossip_g
+        n_el = jax.lax.population_count(elig).astype(jnp.int32)
+        n_go = jnp.maximum(
+            jnp.int32(cfg.d_lazy),
+            (cfg.gossip_factor * n_el.astype(jnp.float32)).astype(
+                jnp.int32))
+        p_g = jnp.minimum(
+            1.0, n_go.astype(jnp.float32)
+            / jnp.maximum(n_el, 1).astype(jnp.float32))
+        u_g = lane_u(gseed_ref[1])
+        tgt = elig & packb(u_g < p_g[None, :])
+        # IHAVE-spamming sybils advertise to every subscribed
+        # candidate (gossipsub_spam_test.go:135); syb_ref is zeros
+        # unless that attack is configured
+        syb = syb_ref[...]
+        return (tgt & ~syb) | (csub_ref[...] & syb)
+
     if has_sc:
         cdt = counter_dtype
         f32 = lambda x: x.astype(jnp.float32)  # noqa: E731
@@ -422,22 +462,17 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
         pressure = 16.0 * inv_tot / (1.0 + del_tot + 16.0 * inv_tot)
         gater_on = pressure > 0.33
         goodput = (1.0 + fd_n) / (1.0 + fd_n + 16.0 * inv_n)
-        # phase-6 lane_uniform for tick + 1: lane = c * n_true + peer
-        peer = (jax.lax.broadcasted_iota(jnp.uint32, (C, B), 1)
-                + jnp.uint32(i * B))
-        lane = (jax.lax.broadcasted_iota(jnp.uint32, (C, B), 0)
-                * jnp.uint32(n_true) + peer)
-        h = _fmix32(lane ^ gseed_ref[0])
-        u = ((h >> jnp.uint32(8)).astype(jnp.int32).astype(jnp.float32)
-             * jnp.float32(1 / (1 << 24)))
+        u = lane_u(gseed_ref[0])
         ALLC = jnp.uint32((1 << C) - 1)
         gater_bits = packb(u < goodput) | jnp.where(gater_on, Z, ALLC)
         for ref, val in zip(out_gates,
                             [accept_g, gossip_g, pub_g, nonneg_g,
-                             accept_g & gater_bits, bo_gate]):
+                             accept_g & gater_bits,
+                             targets_gate(gossip_g), bo_gate]):
             ref[...] = val
     else:
-        out_gates[0][...] = bo_gate
+        out_gates[0][...] = targets_gate(None)
+        out_gates[1][...] = bo_gate
 
 
 def make_receive_update(cfg, sc, n_true: int, block: int,
@@ -446,17 +481,19 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
                         interpret: bool = False):
     """Build the kernel caller.
 
-    Operand order (args): [valid u32 [W] (sc only)], gseed u32 [1],
-    ctrl_flat u8 [C*L8], fresh_flat u32 [W*L32], adv_flat u32 [W*L32],
-    [pay, gsp, acc u32 [N_pad] (sc only)], sub, wa, bo2, grafts,
-    dropped, meshsel u32 [N_pad], seen u32 [W, N_pad], injected
+    Operand order (args): [valid u32 [W] (sc only)], gseeds u32 [2]
+    (tick+1 gater + targets lane seeds), ctrl_flat u8 [C*L8],
+    fresh_flat u32 [W*L32], adv_flat u32 [W*L32], [pay, gsp, acc u32
+    [N_pad] (sc only)], sub, cand_sub, fanout, sybil-override, wa,
+    bo2, grafts, dropped, meshsel u32 [N_pad], seen u32 [W, N_pad],
+    injected
     [W, N_pad], backoff-remaining i16 [C, N_pad], [static f32
-    [C, N_pad], fd, inv (counter_dtype), bp f32, tim i16 [C, N_pad]
-    (sc only)].
+    [C, N_pad], fd, inv (counter_dtype), bp f32(/counter_dtype), tim
+    i16 [C, N_pad] (sc only)].
 
     Returns (new_acq [W, N_pad], mesh [N_pad], backoff [C, N_pad],
     *gates (G separate u32 [N_pad] words — compute_gates order),
-    [, fd, inv, bp, tim]) where G = 6 scored / 1 unscored.
+    [, fd, inv, bp, tim]) where G = 7 scored / 2 unscored.
     """
     C = cfg.n_candidates
     has_sc = sc is not None
@@ -474,15 +511,16 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
     bw = lambda: pl.BlockSpec((W, B), lambda i: (0, i))  # noqa: E731
     bc = lambda: pl.BlockSpec((C, B), lambda i: (0, i))  # noqa: E731
 
-    n_gates = 6 if has_sc else 1
+    n_gates = 7 if has_sc else 2
     in_specs = []
     if has_sc:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))  # valid
-    in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))      # gseed
+    in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))      # gseeds
     in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * 3      # flats
     if has_sc:
         in_specs += [b1(), b1(), b1()]        # pay, gsp, acc
-    in_specs += [b1()] * 6    # sub, wa, bo2, grafts, dropped, meshsel
+    # sub, cand_sub, fanout, sybil, wa, bo2, grafts, dropped, meshsel
+    in_specs += [b1()] * 9
     in_specs += [bw(), bw()]                  # seen, injected
     in_specs += [bc()]                        # backoff in
     if has_sc:

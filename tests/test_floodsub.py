@@ -329,7 +329,6 @@ async def test_custom_message_author():
     """WithMessageAuthor (reference pubsub.go:352-364): messages carry
     the configured author instead of the host ID.  Signing as a foreign
     author is rejected (no key for it)."""
-    import pytest
     from go_libp2p_pubsub_tpu.core.crypto import generate_keypair
 
     other_id = generate_keypair().public.peer_id()

@@ -284,7 +284,7 @@ def test_paired_pipelined_gates_match_recompute():
     sc = gs.ScoreSimConfig()
     params, state = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
                                        score_cfg=sc)
-    assert len(state.gates) == 7
+    assert len(state.gates) == 8
     out_p = gs.gossip_run(params, state, 25, gs.make_gossip_step(cfg, sc))
     out_r = gs.gossip_run(params, state, 25,
                           gs.make_gossip_step(cfg, sc,

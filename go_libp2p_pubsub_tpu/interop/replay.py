@@ -207,7 +207,13 @@ async def _run_cluster(n: int, edges, publishers, make_psub,
     net = InProcNetwork()
     hosts = get_hosts(net, n)
     tracers = [ListTracer() for _ in range(n)]
-    psubs = [await make_psub(h, t, i)
+    # a make_psub that declares a ``hosts`` parameter gets the full
+    # host list (e.g. to resolve direct-peer IDs at construction)
+    import inspect
+    extra = ({"hosts": hosts}
+             if "hosts" in inspect.signature(make_psub).parameters
+             else {})
+    psubs = [await make_psub(h, t, i, **extra)
              for i, (h, t) in enumerate(zip(hosts, tracers))]
     subs = []
     for i, ps in enumerate(psubs):
@@ -270,6 +276,7 @@ def run_core_gossipsub(offsets, n: int, publishers, *,
                        heartbeat_s: float = 0.05, warm_s: float = 1.0,
                        settle_s: float = 1.0, seed: int = 42,
                        spam=None, topics_for=None,
+                       direct_index=None,
                        collect=None) -> TraceRun:
     """Real gossipsub cluster over the SAME circulant candidate graph the
     simulator uses: hosts connect only along candidate edges, the mesh
@@ -280,7 +287,7 @@ def run_core_gossipsub(offsets, n: int, publishers, *,
 
     from ..core import GossipSubParams, create_gossipsub
 
-    async def make_psub(host, tracer, i):
+    async def make_psub(host, tracer, i, hosts=None):
         gp = GossipSubParams(
             d=d, d_lo=d_lo, d_hi=d_hi, d_score=d_score, d_out=d_out,
             d_lazy=d_lazy,
@@ -289,14 +296,25 @@ def run_core_gossipsub(offsets, n: int, publishers, *,
         if score_params is not None:
             kw = dict(score_params=score_params,
                       score_thresholds=score_thresholds)
+        if direct_index is not None:
+            # operator-pinned direct peers (WithDirectPeers,
+            # gossipsub.go:338), resolved to peer IDs at construction
+            kw["direct_peers"] = [hosts[j].id for j in direct_index(i)]
         return await create_gossipsub(
             host, gossipsub_params=gp, event_tracer=tracer,
             router_rng=_random.Random(seed * 1000 + i), **kw)
 
     if collect is None:
         def collect(psubs):
-            return {"mesh_degrees": [
+            out = {"mesh_degrees": [
                 len(ps.router.mesh.get("interop", ())) for ps in psubs]}
+            if direct_index is not None:
+                # direct peers must never be mesh members
+                # (gossipsub.go:737-745)
+                out["direct_in_mesh"] = sum(
+                    len(ps.router.mesh.get("interop", set())
+                        & ps.router.direct) for ps in psubs)
+            return out
 
     edges = circulant_edges(offsets, n)
     return asyncio.run(_run_cluster(n, edges, publishers, make_psub,

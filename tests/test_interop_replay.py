@@ -358,3 +358,71 @@ def test_gossipsub_multitopic_core_vs_sim_reach_curves():
     # and the reference router really kept two meshes per host
     degs = np.array(run.extra["mesh_degrees"])   # [n, T]
     assert ((degs > 0).sum(axis=1) == 2).mean() > 0.9
+
+
+@pytest.mark.slow
+def test_gossipsub_direct_peers_core_vs_sim():
+    """Direct peers twin (WithDirectPeers, gossipsub.go:338): the same
+    circulant cluster with pinned direct edges on both sides.  Direct
+    edges are never mesh members in either implementation, and the
+    reach curves still match within the envelope — direct forwarding
+    adds the same always-on links to both."""
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    from go_libp2p_pubsub_tpu.interop import (
+        mean_reach_fraction, run_core_gossipsub)
+
+    n, C, M = 60, 8, 24
+    offsets = gs.make_gossip_offsets(1, C, n, seed=3)
+    rng = np.random.default_rng(6)
+    publishers = list(rng.integers(0, n, M))
+
+    # every third peer pins its offset-0 candidate as a direct peer
+    # (both ends configured, as operators would)
+    o0 = int(offsets[0])
+    cfg_probe = gs.GossipSimConfig(
+        offsets=offsets, n_topics=1, d=3, d_lo=2, d_hi=6, d_score=2,
+        d_out=1, d_lazy=0, gossip_factor=0.0)
+    cinv0 = cfg_probe.cinv[0]
+    pinned = np.zeros(n, dtype=bool)
+    pinned[::3] = True
+    de = np.zeros((n, C), dtype=bool)
+    de[:, 0] = pinned
+    de[:, cinv0] = np.roll(pinned, o0)
+
+    def direct_index(i):
+        out = []
+        if pinned[i]:
+            out.append((i + o0) % n)
+        if pinned[(i - o0) % n]:
+            out.append((i - o0) % n)
+        return sorted(set(out))
+
+    # sim twin on the same graph + direct set (mesh-only comparison:
+    # gossip off, as in the main curve test)
+    m = len(publishers)
+    sc = gs.ScoreSimConfig()
+    params, state = gs.make_gossip_sim(
+        cfg_probe, np.ones((n, 1), dtype=bool), np.zeros(m, np.int64),
+        np.array(publishers), np.full(m, 90, np.int32), score_cfg=sc,
+        direct_edges=de)
+    out = gs.gossip_run(params, state, 110,
+                        gs.make_gossip_step(cfg_probe, sc))
+    assert int(np.asarray(out.mesh & params.cand_direct).sum()) == 0
+    sim_mean = mean_reach_fraction(
+        np.asarray(gs.reach_by_hops(params, out, 12)), n)
+    assert sim_mean[-1] == 1.0, sim_mean
+
+    last = None
+    for warm_s, settle_s in ((2.0, 1.2), (3.5, 2.0)):
+        run = run_core_gossipsub(offsets, n, publishers,
+                                 warm_s=warm_s, settle_s=settle_s,
+                                 direct_index=direct_index)
+        assert run.extra["direct_in_mesh"] == 0
+        core_mean = mean_reach_fraction(
+            reach_by_hops_from_trace(run, 13), n)
+        delta = np.abs(core_mean[1:13] - sim_mean)
+        last = (delta.max(), core_mean, sim_mean)
+        if delta.max() < 0.075 and core_mean[-1] == 1.0:
+            break
+    else:
+        raise AssertionError(f"envelope breach after retry: {last}")

@@ -431,8 +431,11 @@ class ScoreState:
     #   exact integer count — bf16 would silently stick at 256 — saturated
     #   at 32766)
     first_deliveries: jnp.ndarray    # f32 [C, N] decaying counter (P2)
-    mesh_deliveries: jnp.ndarray     # f32 [C, N] decaying counter (P3)
-    mesh_failure_penalty: jnp.ndarray  # f32 [C, N] sticky deficit² (P3b)
+    # P3/P3b state exists only when the config tracks it
+    # (ScoreSimConfig.track_p3) — None otherwise, so the scan carry
+    # doesn't thread two dead [C, N] arrays per tick
+    mesh_deliveries: jnp.ndarray | None      # f32 [C, N] (P3)
+    mesh_failure_penalty: jnp.ndarray | None  # f32 [C, N] deficit² (P3b)
     invalid_deliveries: jnp.ndarray  # f32 [C, N] decaying counter (P4)
     behaviour_penalty: jnp.ndarray   # [C, N] decaying counter (P7;
     #   dtype = ScoreSimConfig.bp_dtype)
@@ -732,7 +735,11 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
         # decay bounds its magnitude safely below bf16's +1-absorption
         # point, else f32 (ScoreSimConfig.bp_dtype)
         scores=(ScoreState(time_in_mesh=zt(), first_deliveries=zc(),
-                           mesh_deliveries=zc(), mesh_failure_penalty=zc(),
+                           mesh_deliveries=(zc() if score_cfg.track_p3
+                                            else None),
+                           mesh_failure_penalty=(zc()
+                                                 if score_cfg.track_p3
+                                                 else None),
                            invalid_deliveries=zc(),
                            behaviour_penalty=jnp.zeros(
                                (c, n),

@@ -89,6 +89,14 @@ def load_state(path: str, template):
             arr = widened
         out.append(jax.numpy.asarray(arr))
     extra = set(by_key) - {_key(p) for p, _ in leaves}
+    # legacy shim: snapshots taken before P3/P3b state became None for
+    # track_p3-off configs carry all-zero mesh-delivery leaves; accept
+    # (and discard) them iff they are exactly zero — nonzero P3 state
+    # in a non-P3 template is still a config mismatch
+    for k in list(extra):
+        if (k.endswith(("mesh_deliveries", "mesh_failure_penalty"))
+                and not np.any(by_key[k])):
+            extra.discard(k)
     if extra:
         raise ValueError(
             f"checkpoint has leaves the template lacks: {sorted(extra)[:4]}"

@@ -64,3 +64,32 @@ def test_template_mismatch_rejected(tmp_path):
     _, _, _, other = build(False)   # no score state: different tree
     with pytest.raises(ValueError):
         load_state(path, other)
+
+
+def test_legacy_zero_p3_leaves_load(tmp_path):
+    """Snapshots taken before P3/P3b state became None (track_p3-off
+    configs) carry all-zero mesh-delivery leaves; they must still load
+    into a None-P3 template — nonzero P3 state must still error."""
+    cfg, sc, params, state = build(score=True)
+    assert state.scores.mesh_deliveries is None
+    # fabricate a legacy snapshot: same state with zero P3 arrays
+    legacy = state.replace(scores=state.scores.replace(
+        mesh_deliveries=np.zeros_like(np.asarray(
+            state.scores.first_deliveries), dtype=np.float32),
+        mesh_failure_penalty=np.zeros(
+            np.asarray(state.scores.first_deliveries).shape,
+            dtype=np.float32)))
+    path = tmp_path / "legacy.npz"
+    save_state(str(path), legacy)
+    restored = load_state(str(path), state)
+    assert restored.scores.mesh_deliveries is None
+    assert int(restored.tick) == int(state.tick)
+
+    # nonzero P3 state in a non-P3 template is a config mismatch
+    bad = legacy.replace(scores=legacy.scores.replace(
+        mesh_deliveries=np.full_like(
+            np.asarray(legacy.scores.mesh_deliveries), 1.0)))
+    path2 = tmp_path / "bad.npz"
+    save_state(str(path2), bad)
+    with pytest.raises(ValueError, match="lacks"):
+        load_state(str(path2), state)

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import sys
 import time
 
@@ -121,7 +122,7 @@ def bench_randomsub_10k():
 
 
 def _bench_gossip(metric, n, t, score_cfg, sybil=None, gate_honest=False,
-                  baseline=None, paired=False):
+                  baseline=None, paired=False, kernel=False):
     import jax
     import go_libp2p_pubsub_tpu.models.gossipsub as gs
 
@@ -129,6 +130,17 @@ def _bench_gossip(metric, n, t, score_cfg, sybil=None, gate_honest=False,
     warmup, T, reps = 100, 100, 3
     horizon = warmup + T * reps
     rng = np.random.default_rng(0)
+    block = 8192
+    if kernel:
+        assert sybil is None and not paired, \
+            "kernel bench path supports the clean flagship only"
+
+        # the pallas step wants n divisible by the u8 tile alignment
+        # (4096) and the block (aligned-wrap plan) — round UP so the
+        # simulated network is never smaller than the named config
+        import math
+        quantum = math.lcm(t, 4096, block)
+        n = -(-n // quantum) * quantum
     cfg = gs.GossipSimConfig(
         offsets=gs.make_gossip_offsets(t, C, n, seed=0, paired=paired),
         n_topics=t, paired_topics=paired)
@@ -149,9 +161,11 @@ def _bench_gossip(metric, n, t, score_cfg, sybil=None, gate_honest=False,
         subs[np.arange(n), (np.arange(n) % t + t // 2) % t] = True
     params, state = gs.make_gossip_sim(
         cfg, subs, topic, origin, tick,
-        score_cfg=score_cfg, sybil=sybil, track_first_tick=False)
+        score_cfg=score_cfg, sybil=sybil, track_first_tick=False,
+        pad_to_block=(block if kernel else None))
     params = jax.device_put(params)
-    step = gs.make_gossip_step(cfg, score_cfg)
+    # invariant: pad_to_block == receive_block (the kernel plan checks)
+    step = gs.make_gossip_step(cfg, score_cfg, receive_block=block)
     state = gs.gossip_run(params, jax.device_put(state), warmup, step)
     deg = np.asarray(gs.mesh_degrees(state))[np.asarray(params.subscribed)]
     if sybil is not None:
@@ -183,7 +197,8 @@ def _bench_gossip(metric, n, t, score_cfg, sybil=None, gate_honest=False,
         per_edge_cap = ((cfg.gossip_retransmission + 1) * 32
                         * params.origin_words.shape[0])
         assert serves.max() <= per_edge_cap, serves.max()
-    emit(metric, T * reps / dt, "heartbeats/s", baseline=baseline)
+    emit(metric.format(n=n), T * reps / dt, "heartbeats/s",
+         baseline=baseline)
 
 
 def bench_gossipsub_v10():
@@ -197,8 +212,12 @@ def bench_gossipsub_v11():
     on_accel = jax.devices()[0].platform != "cpu"
     n = 1_000_000 if on_accel else 100_000
     # the 10k hb/s BASELINE.md target is defined for this config (v5e-8)
-    _bench_gossip(f"gossipsub_v11_{n}peers_100topics_heartbeats_per_sec",
-                  n, 100, gs.ScoreSimConfig(), baseline=10_000.0)
+    # kernel path needs the TPU mosaic lowering — never on CPU hosts
+    kernel = (os.environ.get("GOSSIP_BENCH_KERNEL", "0") == "1"
+              and on_accel)
+    _bench_gossip("gossipsub_v11_{n}peers_100topics_heartbeats_per_sec",
+                  n, 100, gs.ScoreSimConfig(), baseline=10_000.0,
+                  kernel=kernel)
 
 
 def bench_gossipsub_v11_multitopic():

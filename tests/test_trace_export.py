@@ -84,3 +84,45 @@ def test_json_trace_has_reference_shape(tmp_path):
                    if ln["type"] == int(TraceType.DELIVER_MESSAGE))
     assert "deliver_message" in deliver
     assert {"message_id", "topic"} <= set(deliver["deliver_message"])
+
+
+def test_tracestat_summarizes_both_formats(tmp_path):
+    """tools/tracestat.py (the native tracestat analog) computes the
+    same aggregate from the ndjson and delimited-pb sinks."""
+    import subprocess
+    import sys as _sys
+
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    from go_libp2p_pubsub_tpu.interop.export import (
+        events_from_sim, write_json_trace, write_pb_trace)
+
+    n, t, m = 300, 3, 6
+    rng = np.random.default_rng(2)
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, 16, n, seed=2), n_topics=t)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    topic = rng.integers(0, t, m)
+    origin = rng.integers(0, n // t, m) * t + topic
+    ticks = np.zeros(m, dtype=np.int32)
+    params, state = gs.make_gossip_sim(cfg, subs, topic, origin, ticks)
+    out = gs.gossip_run(params, state, 25, gs.make_gossip_step(cfg))
+    ftm = np.asarray(gs.first_tick_matrix(out, m))
+    evs = list(events_from_sim(ftm, topic, origin, ticks))
+    pj = tmp_path / "t.json"
+    pp = tmp_path / "t.pb"
+    write_json_trace(str(pj), evs)
+    write_pb_trace(str(pp), evs)
+
+    import json as _json
+    outs = []
+    for p in (pj, pp):
+        r = subprocess.run(
+            [_sys.executable, "tools/tracestat.py", str(p), "--json"],
+            capture_output=True, text=True, cwd="/root/repo")
+        assert r.returncode == 0, r.stderr
+        outs.append(_json.loads(r.stdout))
+    assert outs[0] == outs[1]
+    assert outs[0]["messages_published"] == m
+    assert outs[0]["total_deliveries"] == m * (n // t)
+    assert outs[0]["events"]["DELIVER_MESSAGE"] == m * (n // t)

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Aggregate statistics over pubsub trace files — the native analog of
+the reference ecosystem's external `tracestat` tool (reference
+README.md:100-105 delegates trace analysis to `traced`/`tracestat`;
+here it ships with the framework).
+
+Reads either sink format (core/tracer_sinks.py and interop/export.py
+write both): ndjson (NewJSONTracer, tracer.go:85) or varint-delimited
+protobuf (NewPBTracer, tracer.go:137).  Prints per-event-type counts,
+per-message delivery coverage, and the publish->deliver latency
+distribution.
+
+Usage: python tools/tracestat.py trace.json [trace2.pb ...] [--json]
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+from go_libp2p_pubsub_tpu.pb import trace as tr  # noqa: E402
+from go_libp2p_pubsub_tpu.pb.proto import iter_delimited  # noqa: E402
+from go_libp2p_pubsub_tpu.pb.trace import TraceType  # noqa: E402
+
+_SUB_KEYS = ("publish_message", "deliver_message", "reject_message",
+             "duplicate_message")
+
+
+def iter_events(path: str):
+    """Yield (type:int, msg_id:bytes|None, ts:int|None) from either
+    sink format."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if data[:1] == b"{":
+        for line in data.decode("utf-8", "surrogateescape").splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            ev = json.loads(line)
+            mid = None
+            for k in _SUB_KEYS:
+                sub = ev.get(k)
+                if sub and "message_id" in sub:
+                    mid = base64.b64decode(sub["message_id"])
+                    break
+            yield ev.get("type"), mid, ev.get("timestamp")
+    else:
+        for ev in iter_delimited(tr.TraceEvent, data):
+            sub = (ev.publish_message or ev.deliver_message
+                   or ev.reject_message or ev.duplicate_message)
+            mid = sub.message_id if sub else None
+            yield ev.type, mid, ev.timestamp
+
+
+def stats(paths):
+    counts = {}
+    publish_ts = {}
+    deliveries = {}
+    latencies = []
+    for path in paths:
+        for typ, mid, ts in iter_events(path):
+            name = TraceType.NAMES.get(typ, str(typ))
+            counts[name] = counts.get(name, 0) + 1
+            if typ == TraceType.PUBLISH_MESSAGE and mid is not None:
+                publish_ts.setdefault(mid, ts)
+            elif typ == TraceType.DELIVER_MESSAGE and mid is not None:
+                deliveries[mid] = deliveries.get(mid, 0) + 1
+                if ts is not None and publish_ts.get(mid) is not None:
+                    latencies.append(ts - publish_ts[mid])
+    out = {
+        "events": counts,
+        "messages_published": len(publish_ts),
+        "messages_delivered": len(deliveries),
+        "total_deliveries": sum(deliveries.values()),
+        "min_deliveries_per_msg": (min(deliveries.values())
+                                   if deliveries else 0),
+        "max_deliveries_per_msg": (max(deliveries.values())
+                                   if deliveries else 0),
+    }
+    if latencies:
+        latencies.sort()
+        k = len(latencies)
+        out["latency_ns"] = {
+            "min": latencies[0],
+            "p50": latencies[k // 2],
+            "p99": latencies[min(k - 1, (k * 99) // 100)],
+            "max": latencies[-1],
+            "mean": sum(latencies) / k,
+        }
+    return out
+
+
+def main():
+    args = [a for a in sys.argv[1:] if a != "--json"]
+    as_json = "--json" in sys.argv[1:]
+    if not args:
+        raise SystemExit(__doc__)
+    out = stats(args)
+    if as_json:
+        print(json.dumps(out, indent=2))
+        return
+    print("events:")
+    for name, cnt in sorted(out["events"].items()):
+        print(f"  {name:24s} {cnt}")
+    print(f"messages published : {out['messages_published']}")
+    print(f"messages delivered : {out['messages_delivered']}")
+    print(f"total deliveries   : {out['total_deliveries']} "
+          f"(per msg {out['min_deliveries_per_msg']}"
+          f"..{out['max_deliveries_per_msg']})")
+    if "latency_ns" in out:
+        la = out["latency_ns"]
+        print("publish->deliver latency (ns): "
+              f"min {la['min']}  p50 {la['p50']}  p99 {la['p99']}  "
+              f"max {la['max']}  mean {la['mean']:.0f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1737,10 +1737,18 @@ def make_gossip_step(cfg: GossipSimConfig,
             send_cheat = cheat_src
             send_fwd_b = state.mesh_b if paired else None
             if sc is not None:
-                packed = (payload_bits
-                          | ((payload_bits & gossip_bits)
-                             << jnp.uint32(16)))
-                gate_recv = transfer_bits(packed, cfg, pair=True)
+                # with every edge's payload AND gossip gate open (no
+                # attackers, no graylisting — the clean steady state)
+                # the pair transfer of the packed gates is a transfer
+                # of all-ones: skip the C rolls and use the constant
+                open_word = ALL | (ALL << jnp.uint32(16))
+                gate_recv = jax.lax.cond(
+                    jnp.all((payload_bits & gossip_bits) == ALL),
+                    lambda: jnp.full_like(payload_bits, open_word),
+                    lambda: transfer_bits(
+                        payload_bits
+                        | ((payload_bits & gossip_bits)
+                           << jnp.uint32(16)), cfg, pair=True))
                 send_fwd = out_bits & gate_recv
                 if paired:
                     send_fwd_b = send_fwd_b & gate_recv
@@ -1891,20 +1899,35 @@ def make_gossip_step(cfg: GossipSimConfig,
         # (C rolls) and one serial dependency shorter.
         def raw_transfers(sel, skip_a=False):
             grafts_s, dropped_s = sel["grafts"], sel["dropped"]
-            if C <= 16:
-                # GRAFT+PRUNE masks ride one pair-packed transfer, the
-                # A mask a second (2C rolls; was 3C with reject-back)
-                recv = transfer_bits(
-                    grafts_s | (dropped_s << jnp.uint32(16)), cfg,
-                    pair=True)
-                graft_recv = recv & ALL
-                prune_recv = recv >> jnp.uint32(16)
-            else:
-                graft_recv = transfer_bits(grafts_s, cfg)
-                prune_recv = transfer_bits(dropped_s, cfg)
-            a_recv = (None if skip_a
-                      else transfer_bits(sel["a_sent"], cfg))
-            return graft_recv, prune_recv, a_recv
+
+            def live():
+                if C <= 16:
+                    # GRAFT+PRUNE masks ride one pair-packed transfer,
+                    # the A mask a second (2C rolls; was 3C with
+                    # reject-back)
+                    recv = transfer_bits(
+                        grafts_s | (dropped_s << jnp.uint32(16)), cfg,
+                        pair=True)
+                    graft_recv = recv & ALL
+                    prune_recv = recv >> jnp.uint32(16)
+                else:
+                    graft_recv = transfer_bits(grafts_s, cfg)
+                    prune_recv = transfer_bits(dropped_s, cfg)
+                a_recv = (jnp.zeros_like(grafts_s) if skip_a
+                          else transfer_bits(sel["a_sent"], cfg))
+                return graft_recv, prune_recv, a_recv
+
+            def idle():
+                z = jnp.zeros_like(grafts_s)
+                return z, z, z
+
+            # steady state: NOBODY grafted or dropped this tick, so the
+            # handshake transfers carry nothing — graft/prune receives
+            # are zero and retract = grafts & ~a_recv is zero for any
+            # a_recv value, making the zero stand-in exact
+            graft_recv, prune_recv, a_recv = jax.lax.cond(
+                jnp.any((grafts_s | dropped_s) != 0), live, idle)
+            return graft_recv, prune_recv, (None if skip_a else a_recv)
 
         def resolve(sel, graft_recv, prune_recv, a_recv):
             if sc is not None:
@@ -1943,10 +1966,15 @@ def make_gossip_step(cfg: GossipSimConfig,
             ga, pa, _ = raw_transfers(sel_a, skip_a=True)
             gb, pb, _ = raw_transfers(sel_b, skip_a=True)
             # both slots' A masks ride ONE pair-packed transfer
-            # (paired mode enforces C <= 16)
-            a_both = transfer_bits(
-                sel_a["a_sent"] | (sel_b["a_sent"] << jnp.uint32(16)),
-                cfg, pair=True)
+            # (paired mode enforces C <= 16); skipped when neither slot
+            # grafted (retract = grafts & ~a is zero regardless)
+            a_both = jax.lax.cond(
+                jnp.any((sel_a["grafts"] | sel_b["grafts"]) != 0),
+                lambda: transfer_bits(
+                    sel_a["a_sent"] | (sel_b["a_sent"]
+                                       << jnp.uint32(16)),
+                    cfg, pair=True),
+                lambda: jnp.zeros_like(sel_a["grafts"]))
             aa = a_both & ALL
             ab = a_both >> jnp.uint32(16)
             mesh, bo_trigger, backoff_violation, px_a = resolve(

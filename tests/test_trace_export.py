@@ -117,9 +117,11 @@ def test_tracestat_summarizes_both_formats(tmp_path):
     import json as _json
     outs = []
     for p in (pj, pp):
+        from pathlib import Path
+        repo = Path(__file__).resolve().parents[1]
         r = subprocess.run(
             [_sys.executable, "tools/tracestat.py", str(p), "--json"],
-            capture_output=True, text=True, cwd="/root/repo")
+            capture_output=True, text=True, cwd=str(repo))
         assert r.returncode == 0, r.stderr
         outs.append(_json.loads(r.stdout))
     assert outs[0] == outs[1]

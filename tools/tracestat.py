@@ -29,12 +29,26 @@ _SUB_KEYS = ("publish_message", "deliver_message", "reject_message",
              "duplicate_message")
 
 
+def _is_json(data: bytes) -> bool:
+    """Sniff the sink format: a delimited-pb stream could by chance
+    start with 0x7b ('{' — a 123-byte first event), so actually try to
+    parse the first line as JSON."""
+    if data[:1] != b"{":
+        return False
+    first = data.split(b"\n", 1)[0]
+    try:
+        json.loads(first.decode("utf-8", "surrogateescape"))
+        return True
+    except (ValueError, UnicodeDecodeError):
+        return False
+
+
 def iter_events(path: str):
     """Yield (type:int, msg_id:bytes|None, ts:int|None) from either
     sink format."""
     with open(path, "rb") as f:
         data = f.read()
-    if data[:1] == b"{":
+    if _is_json(data):
         for line in data.decode("utf-8", "surrogateescape").splitlines():
             line = line.strip()
             if not line:
@@ -60,25 +74,34 @@ def stats(paths):
     publish_ts = {}
     deliveries = {}
     latencies = []
+    # first pass: publish timestamps across ALL files — per-node traces
+    # put publishes and deliveries in different files, and argument
+    # order must not change the latency pairing
+    for path in paths:
+        for typ, mid, ts in iter_events(path):
+            if typ == TraceType.PUBLISH_MESSAGE and mid is not None:
+                publish_ts.setdefault(mid, ts)
     for path in paths:
         for typ, mid, ts in iter_events(path):
             name = TraceType.NAMES.get(typ, str(typ))
             counts[name] = counts.get(name, 0) + 1
-            if typ == TraceType.PUBLISH_MESSAGE and mid is not None:
-                publish_ts.setdefault(mid, ts)
-            elif typ == TraceType.DELIVER_MESSAGE and mid is not None:
+            if typ == TraceType.DELIVER_MESSAGE and mid is not None:
                 deliveries[mid] = deliveries.get(mid, 0) + 1
                 if ts is not None and publish_ts.get(mid) is not None:
                     latencies.append(ts - publish_ts[mid])
+    # coverage is per PUBLISHED message: a lost message counts as 0,
+    # not as absent
+    per_pub = ({mid: deliveries.get(mid, 0) for mid in publish_ts}
+               or deliveries)
     out = {
         "events": counts,
         "messages_published": len(publish_ts),
         "messages_delivered": len(deliveries),
         "total_deliveries": sum(deliveries.values()),
-        "min_deliveries_per_msg": (min(deliveries.values())
-                                   if deliveries else 0),
-        "max_deliveries_per_msg": (max(deliveries.values())
-                                   if deliveries else 0),
+        "min_deliveries_per_msg": (min(per_pub.values())
+                                   if per_pub else 0),
+        "max_deliveries_per_msg": (max(per_pub.values())
+                                   if per_pub else 0),
     }
     if latencies:
         latencies.sort()

@@ -1,6 +1,13 @@
 #!/usr/bin/env python
 """Per-phase ablation profile of the v1.1 gossip step on the real chip.
 
+NOTE: the standalone phase replicas below predate the pipelined-gates
+step (gates/targets now emitted in the epilogue); they still measure
+the underlying op costs but no longer mirror the step's phase
+boundaries.  Prefer tools/profile_ablate.py (in-context subtractive
+ablation) and tools/profile_trace.py (real fusion-level trace) for
+current numbers.
+
 Each candidate phase is rebuilt standalone from the same state the full
 step sees, wrapped in a jitted fori_loop of K iterations (stable call
 signature; the carry feeds back into the inputs so nothing hoists), and

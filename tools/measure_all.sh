@@ -15,4 +15,7 @@ run python tools/bench_kernel.py 1000000 xla kernel kernela
 run python tools/bench_kernel.py 1000000 kernela --noroll
 run python tools/bench_micro.py 1000000 100
 run python tools/profile_trace.py 1000000 xla
+run python bench.py
+run python bench_suite.py gossipsub_v10 gossipsub_v11_multitopic \
+    gossipsub_v11_adversarial
 echo DONE | tee -a "$log"

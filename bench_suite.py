@@ -192,8 +192,11 @@ def _bench_gossip(metric, n, t, score_cfg, sybil=None, gate_honest=False,
     if state.iwant_serves is not None:
         # IWANT-flood containment gate (gossipsub_spam_test.go:24): the
         # retransmission cutoff bounds every victim edge's served load
-        # at (retrans + 1 overshoot batch) x window ids
-        serves = np.asarray(state.iwant_serves)
+        # at (retrans + 1 overshoot batch) x window ids.  True peers
+        # only: pad-lane ledger rows of the kernel path carry garbage
+        # (see iwant_serve_level)
+        n_t = params.n_true if params.n_true is not None else n
+        serves = np.asarray(state.iwant_serves)[:, :n_t]
         per_edge_cap = ((cfg.gossip_retransmission + 1) * 32
                         * params.origin_words.shape[0])
         assert serves.max() <= per_edge_cap, serves.max()

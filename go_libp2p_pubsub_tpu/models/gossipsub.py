@@ -132,9 +132,15 @@ class GossipSimConfig:
     fanout_ttl_ticks: int = 60     # GossipSubFanoutTTL / heartbeat
     # gossip-repair abuse bounds (gossipsub.go:56-59, mcache.go:66-80):
     # a message is retransmitted to one peer at most gossip_retransmission
-    # times before that peer's IWANTs for it are ignored.  The IHAVE
-    # advert caps are carried for parity/validation; with messages as
-    # word bits (<= 32W ids in flight) they never bind at sim scale.
+    # times before that peer's IWANTs for it are ignored (the serve
+    # ledger is ALWAYS-ON when scoring is — see GossipState.
+    # iwant_serves).  The IHAVE advert caps are STATICALLY enforced
+    # invariants rather than run-time truncation: the sim's whole id
+    # space (one bit per message) must fit a single IHAVE
+    # (make_gossip_sim rejects n_msgs > max_ihave_length), and the sim
+    # emits exactly ONE merged IHAVE per edge per tick, within
+    # max_ihave_messages >= 1 by construction — so a config the sim
+    # accepts can never exceed either reference cap.
     gossip_retransmission: int = 3   # GossipSubGossipRetransmission
     max_ihave_length: int = 5000     # GossipSubMaxIHaveLength
     max_ihave_messages: int = 10     # GossipSubMaxIHaveMessages
@@ -457,9 +463,16 @@ class GossipState:
     scores: ScoreState | None  # None when v1.1 scoring is disabled
     key: jax.Array           # PRNG key
     tick: jnp.ndarray        # int32 scalar
-    # IWANT-flood defense state (only under sybil_iwant_spam): per-edge
-    # count of gossip retransmissions served, decayed as mcache entries
-    # expire (mcache.go:66-80 aggregated per edge over the window)
+    # Gossip-repair abuse-bound state (ALWAYS allocated when scoring is
+    # on, matching the reference's unconditional per-message
+    # transmission tally, mcache.go:66-80): iwant_serves[c, p] counts
+    # the ids peer p has been SERVED (pulled) over its candidate-c edge,
+    # decayed as mcache entries expire — i.e. the partner's per-edge
+    # retransmission ledger for p, stored at the requester so the hot
+    # path reuses the receiver-side provenance popcounts (no extra
+    # rolls).  Honest edges stay far below the GossipRetransmission x
+    # window budget (each id is news over an edge at most once); an
+    # IWANT-flooding sybil's rows saturate at it.
     iwant_serves: jnp.ndarray | None = None  # int16 [C, N]
     # paired-topic mode: the SECOND topic slot's mesh and backoff (each
     # topic keeps its own mesh + per-edge backoff, gossipsub.go:135)
@@ -484,9 +497,16 @@ class GossipState:
     # sublane tiles and discards (G-1)/G of the bandwidth (measured
     # ~160 us/row at 1M — the same penalty PERF_NOTES records for
     # row-wise counter ops).  Order (see compute_gates): scored
-    # (accept, gossip, publish, nonneg, payload, backoff(, backoff_b));
-    # unscored (backoff(, backoff_b)).
+    # (accept, gossip, publish, nonneg, payload, targets,
+    # backoff(, backoff_b)); unscored (targets, backoff(, backoff_b)).
     gates: tuple | None = None               # tuple of uint32 [N]
+    # fingerprint of the (cfg, score_cfg) the carried gates were emitted
+    # under (gates_fingerprint): a same-SHAPE but different-threshold
+    # config would otherwise silently act on the old config's gates for
+    # its first tick — the row-count guard can't see value changes.
+    # Static aux data (not a leaf): never checkpointed, restored from
+    # the template.
+    gates_fp: int | None = struct.field(pytree_node=False, default=None)
 
 
 def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
@@ -526,6 +546,15 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
         raise ValueError("subs topic dim != cfg.n_topics")
     own_topic = np.arange(n) % cfg.n_topics
     m = len(msg_topic)
+    if m > cfg.max_ihave_length:
+        # the sim advertises its whole id space in one merged IHAVE per
+        # edge per tick; IHAVE truncation above MaxIHaveLength
+        # (gossipsub.go:610-672) is not modeled, so the cap is enforced
+        # as a static invariant instead of run-time truncation
+        raise ValueError(
+            f"n_msgs={m} exceeds max_ihave_length="
+            f"{cfg.max_ihave_length}: the sim's one-IHAVE-per-edge "
+            "advert must fit the reference cap")
     origin_bits = np.zeros((n, m), dtype=bool)
     origin_bits[msg_origin, np.arange(m)] = True
     if cfg.paired_topics:
@@ -749,8 +778,9 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
                 if score_cfg is not None else None),
         key=jax.random.PRNGKey(seed),
         tick=jnp.zeros((), dtype=jnp.int32),
-        iwant_serves=(zt() if score_cfg is not None
-                      and score_cfg.sybil_iwant_spam else None),
+        # defense state exists on the no-attack path too (the cutoff is
+        # unconditional in the reference, mcache.go:66-80)
+        iwant_serves=(zt() if score_cfg is not None else None),
         mesh_b=(zbits() if cfg.paired_topics else None),
         backoff_b=(jnp.zeros((c, n), dtype=jnp.int16)
                    if cfg.paired_topics else None),
@@ -758,9 +788,10 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
     )
     # seed the gate pipeline: tick 0's gate words, exactly what the
     # step's epilogue would have emitted at the end of tick -1
-    state = state.replace(gates=compute_gates(
-        cfg, score_cfg, params, state,
-        jax.random.key_data(state.key)[-1]))
+    state = state.replace(
+        gates=compute_gates(cfg, score_cfg, params, state,
+                            jax.random.key_data(state.key)[-1]),
+        gates_fp=gates_fingerprint(cfg, score_cfg))
     return params, state
 
 
@@ -945,6 +976,26 @@ def score_snapshot(sc: ScoreSimConfig, params: GossipParams,
     return out
 
 
+def gates_fingerprint(cfg: GossipSimConfig,
+                      sc: ScoreSimConfig | None) -> int:
+    """Stable fingerprint of the scalar config fields the carried gate
+    words depend on (thresholds, decays, weights, sampling mode, ...).
+    Stored as ``GossipState.gates_fp`` when gates are emitted; the step
+    refuses a state whose fingerprint differs from its own config's."""
+    import zlib
+    from dataclasses import fields as _dc_fields
+
+    def scalars(obj):
+        return tuple(
+            (f.name, getattr(obj, f.name)) for f in _dc_fields(obj)
+            if isinstance(getattr(obj, f.name),
+                          (bool, int, float, str, type(None))))
+
+    desc = (("C", cfg.n_candidates), scalars(cfg),
+            None if sc is None else scalars(sc))
+    return zlib.crc32(repr(desc).encode())
+
+
 def compute_gates(cfg: GossipSimConfig, sc: ScoreSimConfig | None,
                   params: GossipParams, st: GossipState,
                   salt: jnp.ndarray) -> tuple:
@@ -1099,8 +1150,10 @@ def refresh_gates(cfg: GossipSimConfig, sc: ScoreSimConfig | None,
     gates."""
     if st.gates is None:
         return st
-    return st.replace(gates=compute_gates(
-        cfg, sc, params, st, jax.random.key_data(st.key)[-1]))
+    return st.replace(
+        gates=compute_gates(cfg, sc, params, st,
+                            jax.random.key_data(st.key)[-1]),
+        gates_fp=gates_fingerprint(cfg, sc))
 
 
 def make_gossip_step(cfg: GossipSimConfig,
@@ -1137,6 +1190,7 @@ def make_gossip_step(cfg: GossipSimConfig,
     C = cfg.n_candidates
     sc = score_cfg
     paired = cfg.paired_topics
+    step_gates_fp = gates_fingerprint(cfg, sc)
     offsets = tuple(int(o) for o in cfg.offsets)
     cinv = cfg.cinv
     OUT_MASK = jnp.uint32(cfg.outbound_mask)
@@ -1246,7 +1300,8 @@ def make_gossip_step(cfg: GossipSimConfig,
             s0 = state.scores
             args += [params.cand_static_score,
                      s0.first_deliveries, s0.invalid_deliveries,
-                     s0.behaviour_penalty, s0.time_in_mesh]
+                     s0.behaviour_penalty, s0.time_in_mesh,
+                     state.iwant_serves]
         outs = krn(*args)
         new_acq, mesh_new, backoff_new = outs[:3]
         n_gates = 7 if sc is not None else 2
@@ -1273,9 +1328,12 @@ def make_gossip_step(cfg: GossipSimConfig,
             mesh=mesh_new, fanout=fanout, last_pub=last_pub,
             backoff=backoff_new, have=have, recent=recent,
             first_tick=first_tick, scores=scores, key=state.key,
-            tick=tick + 1, iwant_serves=state.iwant_serves,
+            tick=tick + 1,
+            iwant_serves=(outs[4] if sc is not None
+                          else state.iwant_serves),
             mesh_b=state.mesh_b, backoff_b=state.backoff_b,
-            active=state.active, gates=gates_new)
+            active=state.active, gates=gates_new,
+            gates_fp=state.gates_fp)
         return new_state, delivered_now
 
     def step(params: GossipParams, state: GossipState):
@@ -1343,6 +1401,14 @@ def make_gossip_step(cfg: GossipSimConfig,
                 f"step's config expects {n_gate_rows} — the state was "
                 "built for a different score config; rebuild it or "
                 "refresh_gates with the matching config")
+        if (state.gates is not None and state.gates_fp is not None
+                and state.gates_fp != step_gates_fp):
+            # same SHAPE, different config values: the first tick would
+            # silently act on gates computed under the old thresholds
+            raise ValueError(
+                "state's carried gates were emitted under a different "
+                "(cfg, score_cfg) than this step's — refresh_gates with "
+                "the new config before stepping")
         emit_gates = pipeline_gates and state.gates is not None
         g = (state.gates if emit_gates
              else compute_gates(cfg, sc, params, state, salt))
@@ -1504,32 +1570,13 @@ def make_gossip_step(cfg: GossipSimConfig,
                         else withhold | params.promise_break)
 
         # -- 3b. IWANT-flood defense (mcache.go:66-80, gossipsub.go:
-        # 690-693; attack: gossipsub_spam_test.go:24).  Sybil candidates
-        # re-request the victim's full advertised window every tick; the
-        # victim serves until the per-edge retransmission budget
-        # (GossipRetransmission x window ids) is spent, then ignores
-        # that peer's IWANTs.  Serves decay as mcache entries expire
-        # (1/HistoryLength per tick), so the steady served rate is
-        # capped at retransmission/history_length of the uncapped flood
-        # — the same bound the reference's per-message counter yields.
+        # 690-693; attack: gossipsub_spam_test.go:24) is ALWAYS-ON when
+        # scoring is: the per-edge serve ledger updates in the score
+        # epilogue (phase 5), where the receiver-side provenance
+        # popcounts it reuses are live — see the iwant_serves update
+        # there.  Honest and attacked runs share that code path, as in
+        # the reference's unconditional mcache transmission tally.
         iwant_serves = state.iwant_serves
-        if sc is not None and sc.sybil_iwant_spam:
-            adv_count = None
-            for w in range(W):
-                pcw = pc(adv[w])
-                adv_count = pcw if adv_count is None else adv_count + pcw
-            budget = cfg.gossip_retransmission * adv_count[None, :]
-            cutoff = state.iwant_serves.astype(jnp.int32) >= budget
-            served_now = jnp.where(
-                params.cand_sybil & ~cutoff & (adv_count[None, :] > 0),
-                adv_count[None, :], 0)
-            s32 = state.iwant_serves.astype(jnp.int32)
-            # ceil-division decay: plain s//H stalls below H and would
-            # leave phantom load after the flood stops
-            decayed = s32 - (s32 + cfg.history_length - 1
-                             ) // cfg.history_length
-            iwant_serves = jnp.clip(decayed + served_now, 0,
-                                    30000).astype(jnp.int16)
 
         # -- heartbeat maintenance SELECTIONS (gossipsub.go:1299-1552).
         # Read-only on start-of-tick state (score, mesh, backoff,
@@ -1554,7 +1601,8 @@ def make_gossip_step(cfg: GossipSimConfig,
             # graft up to D when deg < Dlo (gossipsub.go:1340-1360);
             # candidates need score >= 0 in v1.1.  in_backoff is the
             # only per-edge numeric state — its packed comparison
-            # arrives as a gate row (compute_gates row 5)
+            # arrives as a gate row (compute_gates: row 6 scored /
+            # row 1 unscored; row 7/2 for slot B in paired mode)
             backoff_bits = bo_row0
             can_graft = (params.cand_sub_bits & ~mesh_ng & ~backoff_bits
                          & sub_all)
@@ -2056,6 +2104,38 @@ def make_gossip_step(cfg: GossipSimConfig,
             iv_stack = (jnp.stack([r.astype(cnt_dt) for r in inv_add],
                                   axis=0).astype(jnp.float32)
                         if W else zcn)
+            # -- 3b (cont.): gossip-repair serve ledger, ALWAYS-ON.
+            # Pulls over an edge = the same receiver-side news counts
+            # that feed P2/P4 (ids newly received this tick; in the
+            # combined path eager-forward copies tally too — a
+            # conservative deviation, the budget only sees MORE load).
+            # Decay matches mcache expiry: ceil-div by HistoryLength
+            # (plain s//H stalls below H and would leave phantom load
+            # after a flood stops).
+            s32 = state.iwant_serves.astype(jnp.int32)
+            pulls = (fd_stack + iv_stack).astype(jnp.int32)
+            if sc.sybil_iwant_spam and params.sybil is not None:
+                # sybils re-request their partner's FULL advertised
+                # window every tick (gossipsub_spam_test.go:24); the
+                # partner serves until the per-edge budget
+                # (GossipRetransmission x window ids, mcache.go:66-80 +
+                # gossipsub.go:690-693) is spent, then ignores that
+                # peer's IWANTs — the retransmission cutoff.
+                adv_count = None
+                for w in range(W):
+                    pcw = pc(adv[w])
+                    adv_count = (pcw if adv_count is None
+                                 else adv_count + pcw)
+                partner_adv = jnp.stack(
+                    [jnp.roll(adv_count, -off) for off in offsets])
+                budget = cfg.gossip_retransmission * partner_adv
+                flood = jnp.where((s32 < budget) & (partner_adv > 0),
+                                  partner_adv, 0)
+                pulls = jnp.where(params.sybil[None, :], flood, pulls)
+            decayed = s32 - (s32 + cfg.history_length - 1
+                             ) // cfg.history_length
+            iwant_serves = jnp.clip(decayed + pulls, 0,
+                                    30000).astype(jnp.int16)
             in_mesh_after = expand_bits(mesh, C)
             fd = jnp.minimum(f32(s0.first_deliveries) + fd_stack,
                              sc.first_message_deliveries_cap)
@@ -2124,7 +2204,7 @@ def make_gossip_step(cfg: GossipSimConfig,
             have=have, recent=recent, first_tick=first_tick, scores=scores,
             key=state.key, tick=tick + 1, iwant_serves=iwant_serves,
             mesh_b=mesh_b_new, backoff_b=backoff_b, active=active_new,
-            gates=state.gates)
+            gates=state.gates, gates_fp=state.gates_fp)
         if state.gates is not None:
             # emit the NEXT tick's gate words now, while the updated
             # counters are live in registers (XLA fuses the score math
@@ -2222,13 +2302,33 @@ def mesh_degrees(state: GossipState) -> jnp.ndarray:
     return popcount32(state.mesh)
 
 
-def iwant_serve_level(state: GossipState) -> jnp.ndarray:
-    """Per-victim outstanding IWANT retransmission load [N] (sum of the
-    per-edge served counters).  With the cutoff active this is bounded
-    by C * gossip_retransmission * window_ids regardless of flood
-    pressure (TestGossipsubAttackSpamIWANT's assertion,
-    gossipsub_spam_test.go:24)."""
-    return state.iwant_serves.astype(jnp.int32).sum(axis=0)
+def iwant_serve_level(state: GossipState, cfg: GossipSimConfig,
+                      n_true: int | None = None) -> jnp.ndarray:
+    """Per-SERVER outstanding gossip-retransmission load [n].
+
+    ``iwant_serves[c, p]`` is stored at the REQUESTER p (receiver-side,
+    so the hot path reuses the provenance popcounts); the load it
+    represents lands on p's candidate-c partner at p + offset_c.  The
+    read-time transfer rolls each row back to the serving peer.  With
+    the cutoff active a victim's load is bounded by
+    C * gossip_retransmission * window_ids regardless of flood pressure
+    (TestGossipsubAttackSpamIWANT's assertion,
+    gossipsub_spam_test.go:24).
+
+    For pallas-padded states pass ``n_true`` (GossipParams.n_true): the
+    topology wraps at the TRUE peer count, not the padded length.  Pad-
+    lane LEDGER rows can carry nonzero garbage (the kernel's edge views
+    read wrapped data through them even though pad peers never own
+    state) — they are excluded here by slicing, and must be excluded by
+    any other consumer of ``state.iwant_serves`` on a padded state."""
+    s32 = state.iwant_serves.astype(jnp.int32)
+    n = s32.shape[1] if n_true is None else n_true
+    level = jnp.zeros((n,), dtype=jnp.int32)
+    for c, off in enumerate(cfg.offsets):
+        # requester row c at peer p burdens the server at p + off_c:
+        # roll(x, off)[p + off] = x[p]
+        level = level + jnp.roll(s32[c, :n], int(off))
+    return level
 
 
 def mesh_symmetry_fraction(state: GossipState,

@@ -188,12 +188,14 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     if has_sc:
         static_ref = nxt()
         fd_in, inv_in, bp_in, tim_in = nxt(), nxt(), nxt(), nxt()
+        iws_in = nxt()
     out_acq = nxt()
     out_mesh = nxt()
     out_bo = nxt()
     out_gates = [nxt() for _ in range(7 if has_sc else 2)]
     if has_sc:
         out_fd, out_inv, out_bp, out_tim = nxt(), nxt(), nxt(), nxt()
+        out_iws = nxt()
     cbufs = [nxt() for _ in range(N_SLOTS)]
     # payload buffers: [slot][fresh w... adv w...], all separate 1-D
     # scratches (DMA into a row of a 2-D VMEM buffer hits sublane
@@ -428,6 +430,17 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
         bp_new = dk(bp, sc.behaviour_penalty_decay,
                     dtype=jnp.dtype(sc.bp_dtype))
         out_bp[...] = bp_new
+        # gossip-repair serve ledger (always-on abuse bound, mcache.go:
+        # 66-80): pulls over an edge = the same news counts that feed
+        # P2/P4 — already live in VMEM.  Mirrors the XLA epilogue
+        # bit-for-bit: ceil-div decay by HistoryLength, clip to int16.
+        # (Attack configs — sybil_iwant_spam — are refused by the
+        # kernel guard, so only the honest accrual is needed here.)
+        pull = jnp.stack([fd_cnt[j] + inv_cnt[j] for j in range(C)])
+        s32 = iws_in[...].astype(jnp.int32)
+        H = cfg.history_length
+        dec = s32 - (s32 + (H - 1)) // H
+        out_iws[...] = jnp.clip(dec + pull, 0, 30000).astype(jnp.int16)
 
         # ---- stage 2: NEXT tick's gate words (compute_gates rows),
         # evaluated from the freshly-updated counters while they are
@@ -489,11 +502,12 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
     injected
     [W, N_pad], backoff-remaining i16 [C, N_pad], [static f32
     [C, N_pad], fd, inv (counter_dtype), bp f32(/counter_dtype), tim
-    i16 [C, N_pad] (sc only)].
+    i16 [C, N_pad], iwant_serves i16 [C, N_pad] (sc only)].
 
     Returns (new_acq [W, N_pad], mesh [N_pad], backoff [C, N_pad],
     *gates (G separate u32 [N_pad] words — compute_gates order),
-    [, fd, inv, bp, tim]) where G = 7 scored / 2 unscored.
+    [, fd, inv, bp, tim, iwant_serves]) where G = 7 scored / 2
+    unscored.
     """
     C = cfg.n_candidates
     has_sc = sc is not None
@@ -524,7 +538,7 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
     in_specs += [bw(), bw()]                  # seen, injected
     in_specs += [bc()]                        # backoff in
     if has_sc:
-        in_specs += [bc()] * 5                # static, fd, inv, bp, tim
+        in_specs += [bc()] * 6    # static, fd, inv, bp, tim, iws
 
     out_shape = ([
         jax.ShapeDtypeStruct((W, n_pad), jnp.uint32),       # new_acq
@@ -539,8 +553,9 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
             jax.ShapeDtypeStruct((C, n_pad),
                                  jnp.dtype(sc.bp_dtype)),     # bp
             jax.ShapeDtypeStruct((C, n_pad), jnp.int16),      # tim
+            jax.ShapeDtypeStruct((C, n_pad), jnp.int16),      # iws
         ]
-        out_specs += [bc()] * 4
+        out_specs += [bc()] * 5
 
     scratch = (
         [pltpu.VMEM((B + ALIGN8,), jnp.uint8)] * N_SLOTS

@@ -28,6 +28,42 @@ def _key(path) -> str:
                     for p in path)
 
 
+_META_GATES_FP = "meta!gates_fp"   # '!' can't collide with tree keys
+
+
+def _read_npz(path: str) -> dict[str, np.ndarray]:
+    """Decode a snapshot file into {tree_key: array}, undoing the
+    bit-view encoding of non-native dtypes.  Single home of the
+    "bits:dtype:key" / "raw::key" format knowledge."""
+    import ml_dtypes  # baked in with jax
+
+    with np.load(path) as z:
+        by_key = {}
+        for full in z.files:
+            tag, dtname, k = full.split(":", 2)
+            arr = z[full]
+            if tag == "bits":
+                arr = arr.view(np.dtype(getattr(ml_dtypes, dtname)))
+            by_key[k] = arr
+    return by_key
+
+
+def _widen_exact(arr: np.ndarray, want_dtype, k: str,
+                 what: str = "checkpoint") -> np.ndarray:
+    """Allow exact-value widening (e.g. old snapshots stored
+    behaviour_penalty in bf16 before it moved to f32); any lossy
+    conversion errors."""
+    if arr.dtype == want_dtype:
+        return arr
+    widened = arr.astype(want_dtype)
+    if not np.array_equal(widened.astype(arr.dtype), arr,
+                          equal_nan=arr.dtype.kind in "fc"):
+        raise ValueError(
+            f"leaf {k!r}: {what} dtype {arr.dtype} does not widen "
+            f"losslessly to template {want_dtype}")
+    return widened
+
+
 def save_state(path: str, state) -> None:
     """Write a pytree snapshot to ``path`` (.npz, atomic rename)."""
     leaves = jax.tree_util.tree_flatten_with_path(state)[0]
@@ -42,6 +78,14 @@ def save_state(path: str, state) -> None:
                 np.dtype(f"u{arr.dtype.itemsize}"))
         else:
             payload["raw::" + k] = arr
+    # the gates config fingerprint is static aux data (not a leaf) but
+    # must survive the round trip: on restore the gate WORDS come from
+    # the snapshot, so a same-shape different-threshold template would
+    # otherwise re-tag them with its own fingerprint and bypass the
+    # step's guard exactly where a mismatch is most likely
+    fp = getattr(state, "gates_fp", None)
+    if fp is not None:
+        payload["raw::" + _META_GATES_FP] = np.int64(fp)
     buf = io.BytesIO()
     np.savez(buf, **payload)
     tmp = path + ".tmp"
@@ -53,40 +97,59 @@ def save_state(path: str, state) -> None:
 def load_state(path: str, template):
     """Read a snapshot into the structure of ``template`` (the state
     returned by the same make_*_sim call that produced the original)."""
-    import ml_dtypes  # baked in with jax
+    by_key = _read_npz(path)
 
-    with np.load(path) as z:
-        by_key = {}
-        for full in z.files:
-            tag, dtname, k = full.split(":", 2)
-            arr = z[full]
-            if tag == "bits":
-                arr = arr.view(np.dtype(getattr(ml_dtypes, dtname)))
-            by_key[k] = arr
+    snap_fp = by_key.pop(_META_GATES_FP, None)
+    tmpl_fp = getattr(template, "gates_fp", None)
+    if (snap_fp is not None and tmpl_fp is not None
+            and int(snap_fp) != int(tmpl_fp)):
+        raise ValueError(
+            "snapshot's carried gates were emitted under a different "
+            "(cfg, score_cfg) than the template's — restore with the "
+            "original config, or refresh_gates after loading into a "
+            "template whose gates_fp you explicitly cleared")
 
     leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    legacy_gossip = (
+        any(_key(p).startswith("gates") for p, _ in leaves)
+        and not any(k.startswith("gates") for k in by_key))
     out = []
     for p, leaf in leaves:
         k = _key(p)
         if k not in by_key:
+            if legacy_gossip and k.startswith("gates"):
+                raise ValueError(
+                    "snapshot predates the gate-pipeline format (no "
+                    "carried gate words, backoff stored as absolute "
+                    "expiry ticks) — migrate it with "
+                    "utils.checkpoint.load_legacy_gossip_state(path, "
+                    "template, cfg, score_cfg, params)")
+            if k == "iwant_serves":
+                # scored snapshots taken before the serve ledger became
+                # always-on (no-attack configs stored None): zero-init,
+                # exactly what make_gossip_sim does; the decaying
+                # ledger self-heals within ~history_length ticks
+                out.append(jax.numpy.zeros_like(leaf))
+                continue
             raise ValueError(f"checkpoint missing leaf {k!r}")
         arr = by_key[k]
         want = np.asarray(leaf)
+        if (k.split("/")[-1].startswith("backoff")
+                and arr.dtype == np.int32 and want.dtype == np.int16):
+            # pre-pipeline snapshots stored backoff as int32 ABSOLUTE
+            # expiry ticks; the current format is int16 REMAINING ticks.
+            # Small expiry values would widen "losslessly" and be
+            # silently misread as remaining counts — never auto-convert.
+            raise ValueError(
+                f"leaf {k!r}: int32 absolute-expiry backoff from a "
+                "pre-gate-pipeline snapshot cannot be loaded as int16 "
+                "remaining ticks — migrate with "
+                "utils.checkpoint.load_legacy_gossip_state")
         if arr.shape != want.shape:
             raise ValueError(
                 f"leaf {k!r}: checkpoint {arr.dtype}{arr.shape} vs "
                 f"template {want.dtype}{want.shape}")
-        if arr.dtype != want.dtype:
-            # allow exact-value widening (e.g. old snapshots stored
-            # behaviour_penalty in bf16 before it moved to f32) — any
-            # lossy conversion still errors
-            widened = arr.astype(want.dtype)
-            if not np.array_equal(widened.astype(arr.dtype), arr,
-                                  equal_nan=arr.dtype.kind in "fc"):
-                raise ValueError(
-                    f"leaf {k!r}: checkpoint dtype {arr.dtype} does not "
-                    f"widen losslessly to template {want.dtype}")
-            arr = widened
+        arr = _widen_exact(arr, want.dtype, k)
         out.append(jax.numpy.asarray(arr))
     extra = set(by_key) - {_key(p) for p, _ in leaves}
     # legacy shim: snapshots taken before P3/P3b state became None for
@@ -103,3 +166,49 @@ def load_state(path: str, template):
             " — wrong sim configuration?")
     return jax.tree_util.tree_unflatten(
         jax.tree_util.tree_structure(template), out)
+
+
+def load_legacy_gossip_state(path: str, template, cfg, score_cfg, params):
+    """Migrate a pre-gate-pipeline gossip snapshot into the current
+    format: convert int32 absolute-expiry backoff to int16 remaining
+    ticks (relative to the snapshot's own tick) and recompute the
+    carried gate words with ``refresh_gates`` under the given config.
+
+    ``template`` is the state from the same ``make_gossip_sim`` call
+    that would restore a current-format snapshot; ``cfg``/``score_cfg``/
+    ``params`` are that sim's config and params (needed to re-emit the
+    gates the old format never stored)."""
+    from ..models.gossipsub import refresh_gates
+
+    by_key = _read_npz(path)
+    by_key.pop(_META_GATES_FP, None)    # pre-pipeline: absent anyway
+
+    tick = int(by_key["tick"])
+    leaves, _ = jax.tree_util.tree_flatten_with_path(template)
+    out = []
+    for p, leaf in leaves:
+        k = _key(p)
+        want = np.asarray(leaf)
+        if k.startswith("gates"):
+            out.append(None)            # re-emitted below
+            continue
+        if k not in by_key:
+            if k == "iwant_serves":
+                out.append(jax.numpy.zeros_like(leaf))  # see load_state
+                continue
+            raise ValueError(f"legacy checkpoint missing leaf {k!r}")
+        arr = by_key[k]
+        if (k.split("/")[-1].startswith("backoff")
+                and arr.dtype == np.int32 and want.dtype == np.int16):
+            arr = np.minimum(np.maximum(arr - tick, 0),
+                             np.iinfo(np.int16).max).astype(np.int16)
+        else:
+            arr = _widen_exact(arr, want.dtype, k, what="legacy")
+        if arr.shape != want.shape:
+            raise ValueError(
+                f"leaf {k!r}: legacy {arr.dtype}{arr.shape} vs "
+                f"template {want.dtype}{want.shape}")
+        out.append(jax.numpy.asarray(arr))
+    state = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(template), out)
+    return refresh_gates(cfg, score_cfg, params, state)

@@ -93,3 +93,128 @@ def test_legacy_zero_p3_leaves_load(tmp_path):
     save_state(str(path2), bad)
     with pytest.raises(ValueError, match="lacks"):
         load_state(str(path2), state)
+
+
+def _write_pre_gate_pipeline_snapshot(path, state):
+    """Fabricate a pre-gate-pipeline snapshot: no gates leaves, backoff
+    as int32 ABSOLUTE expiry ticks (the old format)."""
+    import io
+    import os
+
+    tick = int(np.asarray(state.tick))
+    payload = {}
+    import jax
+
+    for p, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        k = "/".join(str(getattr(q, "name", getattr(q, "idx", q)))
+                     for q in p)
+        if k.startswith("gates"):
+            continue
+        arr = np.asarray(leaf)
+        if k.split("/")[-1].startswith("backoff"):
+            # remaining -> absolute expiry (old semantics)
+            arr = np.where(arr > 0, arr.astype(np.int32) + tick, 0)
+        if arr.dtype.kind not in "biufc?":
+            payload["bits:" + arr.dtype.name + ":" + k] = arr.view(
+                np.dtype(f"u{arr.dtype.itemsize}"))
+        else:
+            payload["raw::" + k] = arr
+    buf = io.BytesIO()
+    np.savez(buf, **payload)
+    with open(path, "wb") as f:
+        f.write(buf.getvalue())
+    return tick
+
+
+def test_pre_gate_pipeline_snapshot_rejected_and_migrates(tmp_path):
+    """A snapshot from before the gate pipeline (no gates leaves, int32
+    absolute-expiry backoff) must fail load_state with a targeted error
+    — not the generic missing-leaf message, and never a silent
+    expiry-as-remaining reinterpretation — and must migrate correctly
+    through load_legacy_gossip_state."""
+    from go_libp2p_pubsub_tpu.utils.checkpoint import (
+        load_legacy_gossip_state,
+    )
+
+    cfg, sc, params, state = build(True)
+    step = make_gossip_step(cfg, sc)
+    mid = gossip_run(params, state, 25, step)
+    path = str(tmp_path / "old.npz")
+    _write_pre_gate_pipeline_snapshot(path, mid)
+
+    # whichever legacy symptom is hit first (absolute-expiry backoff or
+    # missing gates), the error must point at the migration helper
+    with pytest.raises(ValueError, match="load_legacy_gossip_state"):
+        load_state(path, mid)
+
+    migrated = load_legacy_gossip_state(path, mid, cfg, sc, params)
+    # backoff round-trips expiry -> remaining exactly, gates re-emitted
+    np.testing.assert_array_equal(np.asarray(migrated.backoff),
+                                  np.asarray(mid.backoff))
+    assert migrated.gates is not None
+    for g_m, g_o in zip(migrated.gates, mid.gates):
+        np.testing.assert_array_equal(np.asarray(g_m), np.asarray(g_o))
+    # and the migrated state continues bit-identically
+    a = gossip_run(params, mid, 10, step)
+    b = gossip_run(params, migrated, 10, step)
+    assert_tree_equal(a, b)
+
+
+def test_snapshot_gates_fp_survives_roundtrip(tmp_path):
+    """The gates config fingerprint is persisted with the snapshot: a
+    same-shape different-threshold template must be rejected at LOAD
+    time (the restored gate words are the old config's; re-tagging them
+    with the template's fingerprint would bypass the step guard)."""
+    cfg, sc, params, state = build(True)
+    path = str(tmp_path / "snap.npz")
+    save_state(path, state)
+
+    n, t, m = 600, 3, 8
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    rng = np.random.default_rng(4)
+    topic = rng.integers(0, t, m)
+    origin = rng.integers(0, n // t, m) * t + topic
+    ticks = rng.integers(0, 40, m).astype(np.int32)
+    sc2 = ScoreSimConfig(gossip_threshold=-20.0)
+    _, tmpl2 = make_gossip_sim(cfg, subs, topic, origin, ticks,
+                               score_cfg=sc2)
+    with pytest.raises(ValueError, match="different"):
+        load_state(path, tmpl2)
+    # the matching template still round-trips
+    restored = load_state(path, state)
+    assert restored.gates_fp == state.gates_fp
+
+
+def test_pre_ledger_scored_snapshot_zero_fills(tmp_path):
+    """Scored snapshots taken before the serve ledger became always-on
+    have no iwant_serves leaf; they must load with a zero-initialized
+    ledger (what make_gossip_sim does), not fail."""
+    cfg, sc, params, state = build(True)
+    assert state.iwant_serves is not None
+    path = str(tmp_path / "snap.npz")
+    save_state(path, state)
+    # strip the ledger leaf, as a pre-change save would have omitted it
+    with np.load(path) as z:
+        kept = {k: z[k] for k in z.files if "iwant_serves" not in k}
+    np.savez(str(tmp_path / "old.npz"), **kept)
+    restored = load_state(str(tmp_path / "old.npz"), state)
+    assert np.asarray(restored.iwant_serves).max() == 0
+    np.testing.assert_array_equal(np.asarray(restored.have),
+                                  np.asarray(state.have))
+
+
+def test_carried_gates_config_fingerprint_guard():
+    """A state seeded under one ScoreSimConfig must be rejected by a
+    step built with a same-shape but different-threshold config — the
+    carried gate words were computed under the old thresholds."""
+    cfg, sc, params, state = build(True)
+    sc2 = ScoreSimConfig(gossip_threshold=-20.0)
+    step2 = make_gossip_step(cfg, sc2)
+    with pytest.raises(ValueError, match="refresh_gates"):
+        step2(params, state)
+
+    # refresh_gates with the new config clears the mismatch
+    from go_libp2p_pubsub_tpu.models.gossipsub import refresh_gates
+    state2 = refresh_gates(cfg, sc2, params, state)
+    step2(params, state2)   # traces and runs

@@ -200,14 +200,37 @@ def test_iwant_flood_retransmission_cutoff():
             gossip_retransmission=retrans)
         step = make_gossip_step(cfg, sc)
         out = gossip_run(params, state, 26, step)
-        level = np.asarray(iwant_serve_level(out))
+        level = np.asarray(iwant_serve_level(out, cfg))
         serves = np.asarray(out.iwant_serves)
+        # the attack accrues at the sybil requesters' rows (receiver-
+        # side ledger); honest rows stay at honest-pull levels
+        cand_rows_sybil = np.asarray(out.iwant_serves)[
+            :, np.flatnonzero(sybil)]
+        assert cand_rows_sybil.max() > 0
         out2 = gossip_run(params, out, 14, step)  # let publishes settle
         reach = np.asarray(reach_counts(params, out2))
         return cfg, reach, level, serves
 
     cfg, reach_c, level_c, serves_c = run(3)
     _, reach_u, level_u, serves_u = run(1000)
+    # defense state exists on the NO-attack path too (unconditional in
+    # the reference, mcache.go:66-80): an honest run's ledger is live
+    # but stays well below the flood's saturated rows, on the same code
+    # path the attack saturates
+    hcfg, hsc, hparams, hstate = build(
+        n=600, t=3, n_msgs=28, msgs_per_tick=True,
+        gossip_retransmission=3)
+    assert hstate.iwant_serves is not None      # no attack configured
+    hout = gossip_run(hparams, hstate, 26, make_gossip_step(hcfg, hsc))
+    hserves = np.asarray(hout.iwant_serves)
+    assert hserves.max() > 0                    # ledger is live
+    # structural bound: an id is news over an edge at most once, so an
+    # honest edge's cumulative (pre-decay) pulls can never exceed the
+    # id space — the flood has no such bound without the cutoff
+    assert hserves.max() <= 28, hserves.max()
+    # sybil rows under sustained flood sit above every honest row
+    syb_rows_max = serves_c[:, np.flatnonzero(sybil)].max()
+    assert hserves.max() < syb_rows_max, (hserves.max(), syb_rows_max)
     # honest traffic delivered fully in both runs
     assert (reach_c == n // t).all() and (reach_u == n // t).all()
     # the cutoff bounds each edge's served budget: <= (retrans + 1)

@@ -78,6 +78,10 @@ def _assert_state_equal(out_x, out_k, n, sc):
             np.testing.assert_array_equal(
                 np.asarray(getattr(out_x.scores, f)),
                 np.asarray(getattr(out_k.scores, f))[:, :n], err_msg=f)
+        np.testing.assert_array_equal(
+            np.asarray(out_x.iwant_serves),
+            np.asarray(out_k.iwant_serves)[:, :n],
+            err_msg="iwant_serves")
 
 
 def test_kernel_matches_xla_v10():
@@ -94,6 +98,17 @@ def test_kernel_matches_xla_v11_scored():
     cfg, sc, out_x, out_k = _run_pair(n, 4, 8, 8, 30, 128, score=True)
     _assert_state_equal(out_x, out_k, n, sc)
     assert np.asarray(out_x.scores.first_deliveries).max() > 0
+
+
+def test_kernel_matches_xla_serve_ledger_live():
+    """The in-kernel gossip-repair serve ledger must match the XLA
+    epilogue at a tick where it is LIVE (by tick 30 both paths have
+    decayed it to zero, which would make the trajectory-end parity
+    check vacuous for this field)."""
+    n = 900
+    cfg, sc, out_x, out_k = _run_pair(n, 4, 8, 8, 10, 128, score=True)
+    _assert_state_equal(out_x, out_k, n, sc)
+    assert np.asarray(out_x.iwant_serves).max() > 0   # non-vacuous
 
 
 def test_kernel_matches_xla_v11_adversarial():

@@ -108,9 +108,16 @@ def main():
            compute_scores=lambda sc_, p, s: jnp.zeros(
                (C, n), dtype=jnp.float32))
     zw = lambda s_: jnp.zeros_like(s_.mesh)  # noqa: E731
-    report("compute_gates (emission)",
-           compute_gates=lambda cfg_, sc_, p, s, salt: tuple(
-               zw(s) for _ in range(6)))
+
+    def fake_gates(cfg_, sc_, p, s, salt):
+        # same row count the real step derives: 5 scored rows
+        # (accept/gossip/publish/nonneg/payload) + targets + backoff
+        # (+ backoff_b in paired mode)
+        g = (5 if sc_ is not None else 0) + 2 \
+            + (1 if cfg_.paired_topics else 0)
+        return tuple(zw(s) for _ in range(g))
+
+    report("compute_gates (emission)", compute_gates=fake_gates)
     report("ranks_desc",
            ranks_desc=lambda prio, tiebreak=None: jnp.zeros(
                prio.shape, dtype=jnp.int32))

@@ -122,7 +122,9 @@ def bench_randomsub_10k():
 
 
 def _bench_gossip(metric, n, t, score_cfg, sybil=None, gate_honest=False,
-                  baseline=None, paired=False, kernel=False):
+                  baseline=None, paired=False, kernel=False,
+                  px_candidates=None, with_direct=False,
+                  shared_sybil_ips=False):
     import jax
     import go_libp2p_pubsub_tpu.models.gossipsub as gs
 
@@ -159,10 +161,29 @@ def _bench_gossip(metric, n, t, score_cfg, sybil=None, gate_honest=False,
     if paired:
         # overlapping membership: every peer in BOTH its pair topics
         subs[np.arange(n), (np.arange(n) % t + t // 2) % t] = True
+    extra = {}
+    if with_direct:
+        # a sparse operator-pinned direct overlay: ~n/1009 peers get a
+        # direct edge on candidate pair (0, cinv[0]); symmetric by
+        # construction (edge marked iff EITHER endpoint is pinned)
+        f = (np.arange(n) % 1009) == 0
+        de = np.zeros((n, C), dtype=bool)
+        for c_ in (0, cfg.cinv[0]):
+            de[:, c_] = f | np.roll(f, -int(cfg.offsets[c_]))
+        extra["direct_edges"] = de
+    if px_candidates is not None:
+        extra["px_candidates"] = px_candidates
+    if shared_sybil_ips and sybil is not None:
+        # sybil clusters behind shared addresses: P6 colocation and the
+        # gater's per-IP grouping are live (peer_gater.go:119-151)
+        ip = np.arange(n)
+        sid = np.flatnonzero(sybil)
+        ip[sid] = n + np.arange(len(sid)) // 4
+        extra["peer_ip"] = ip
     params, state = gs.make_gossip_sim(
         cfg, subs, topic, origin, tick,
         score_cfg=score_cfg, sybil=sybil, track_first_tick=False,
-        pad_to_block=(block if kernel else None))
+        pad_to_block=(block if kernel else None), **extra)
     params = jax.device_put(params)
     # invariant: pad_to_block == receive_block (the kernel plan checks)
     step = gs.make_gossip_step(cfg, score_cfg, receive_block=block)
@@ -182,7 +203,12 @@ def _bench_gossip(metric, n, t, score_cfg, sybil=None, gate_honest=False,
         honest = ~sybil
         reach = np.asarray(gs.reach_counts_from_have(params, state,
                                                      mask=honest))
-        want = np.array([(honest & (members == topic[j])).sum()
+        if paired:
+            member_of = lambda tau: ((members == tau)  # noqa: E731
+                                     | ((members + t // 2) % t == tau))
+        else:
+            member_of = lambda tau: members == tau  # noqa: E731
+        want = np.array([(honest & member_of(topic[j])).sum()
                          for j in range(m)])
     else:
         reach = np.asarray(gs.reach_counts_from_have(params, state))
@@ -258,6 +284,29 @@ def bench_gossipsub_v11_adversarial():
         sybil=sybil, gate_honest=True, baseline=10_000.0)
 
 
+def bench_gossipsub_v11_everything():
+    """The EVERYTHING-ON flagship: overlapping topic membership (paired
+    meshes + TopicScoreCap) + PX candidate rotation + operator-pinned
+    direct peers + sybil clusters behind shared IPs (P6 + per-IP gater
+    grouping) running BOTH gossip-repair attacks — the full feature set
+    active at once, as the reference router runs it by construction
+    (gossipsub.go:197-297).  Gated on full honest delivery."""
+    import jax
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    on_accel = jax.devices()[0].platform != "cpu"
+    n = 1_000_000 if on_accel else 100_000
+    rng = np.random.default_rng(7)
+    sybil = rng.random(n) < 0.2
+    _bench_gossip(
+        f"gossipsub_v11_everything_{n}peers_heartbeats_per_sec",
+        n, 100, gs.ScoreSimConfig(topic_score_cap=50.0,
+                                  sybil_ihave_spam=True,
+                                  sybil_iwant_spam=True),
+        sybil=sybil, gate_honest=True, paired=True,
+        px_candidates=14, with_direct=True, shared_sybil_ips=True,
+        baseline=10_000.0)
+
+
 BENCHES = {
     "floodsub_hosts": bench_floodsub_hosts,
     "randomsub_10k": bench_randomsub_10k,
@@ -265,6 +314,7 @@ BENCHES = {
     "gossipsub_v11": bench_gossipsub_v11,
     "gossipsub_v11_multitopic": bench_gossipsub_v11_multitopic,
     "gossipsub_v11_adversarial": bench_gossipsub_v11_adversarial,
+    "gossipsub_v11_everything": bench_gossipsub_v11_everything,
 }
 
 

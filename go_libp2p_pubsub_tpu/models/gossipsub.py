@@ -48,6 +48,33 @@ measured in hops (publish-tick-relative), which is exactly the
 reachability-vs-hops contract from BASELINE.md and independent of the
 wall-clock heartbeat/RTT ratio.
 
+Design bound — topic membership is k <= 2 per peer (paired mode), by
+decision rather than omission:
+
+- The reference's per-peer score is a weighted linear fold over
+  per-topic terms (score.go:264-316).  Paired mode exercises every
+  term class of that fold at k = 2: per-slot P1, delivery-driven
+  P2/P4 summed across the pair, the cap binding on a true multi-topic
+  sum, per-topic meshes/backoffs, and the cross-slot control routing
+  (class(p+o) = class(p) + T/2 on odd edges).  k = 3 or 4 repeats the
+  same fold and routing mechanism with more cases — no new interaction
+  class appears, while mesh/backoff/P1 state, the maintenance
+  selections, and the handshake transfers all multiply by k (the
+  pair-packed transfer tops out at two 16-bit masks per u32 word, so
+  k > 2 also forfeits the packed-handshake optimization).
+- Arbitrary-k membership with the EXACT per-topic weighted sum is
+  already expressible in the framework — in the protocol core
+  (core/score.py mirrors score.go:256-333 with per-topic params and
+  arbitrary topic sets), which is the semantics oracle the sim is
+  validated against (interop/replay.py).  The sim trades arbitrary-k
+  for the circulant scale design; the 100-topic flagship covers
+  many-topic scale, the paired overlay covers overlap dynamics.
+- Equal pair weights keep the aggregated P2 fold EXACT (P2 is linear);
+  P4 aggregation is exact when one topic carries the invalid traffic
+  (the adversarial configs) and conservative otherwise (the squared
+  aggregate >= the per-topic sum of squares at equal weights).
+  Unequal weights remain expressible in the core.
+
 Known deviation — same-tick P2/P4 delivery credit: the reference credits
 FirstMessageDeliveries to exactly one peer (score.go
 markFirstMessageDelivery) and routes duplicates to mesh-delivery credit
@@ -677,13 +704,8 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
         kw.update(flood_proto=jnp.asarray(padl(fp)),
                   cand_flood_bits=jnp.asarray(padl(cand_bits(fp))))
 
+    direct_packed = None
     if direct_edges is not None:
-        if cfg.paired_topics:
-            raise ValueError("direct_edges not supported in paired mode")
-        if px_candidates is not None:
-            raise ValueError(
-                "direct_edges + px_candidates not supported together "
-                "(PX rotation would deactivate pinned edges)")
         if pad_to_block is not None:
             raise ValueError(
                 "direct_edges not supported by the pallas (padded) "
@@ -699,10 +721,10 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
                 raise ValueError(
                     "direct_edges must be symmetric: peer p's bit c "
                     "and peer p+o_c's bit cinv[c] describe one edge")
-        packed = np.zeros(n, dtype=np.uint32)
+        direct_packed = np.zeros(n, dtype=np.uint32)
         for c in range(cfg.n_candidates):
-            packed |= de[:, c].astype(np.uint32) << c
-        kw.update(cand_direct=jnp.asarray(padl(packed)))
+            direct_packed |= de[:, c].astype(np.uint32) << c
+        kw.update(cand_direct=jnp.asarray(padl(direct_packed)))
 
     if promise_break is not None:
         if score_cfg is None:
@@ -745,6 +767,12 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
             for k in range(px_candidates):
                 bits |= np.uint32(1) << rows[:, k].astype(np.uint32)
             act[p_chunk:hi] = bits
+        if direct_packed is not None:
+            # direct peers are operator-pinned addresses: always held
+            # (the reference's direct connect loop re-dials them
+            # unconditionally, gossipsub.go:1594-1616) — PX rotation
+            # never evicts them (see the rotation site)
+            act[:len(direct_packed)] |= direct_packed
         active0 = jnp.asarray(act)
 
     state = GossipState(
@@ -991,8 +1019,12 @@ def gates_fingerprint(cfg: GossipSimConfig,
             if isinstance(getattr(obj, f.name),
                           (bool, int, float, str, type(None))))
 
-    desc = (("C", cfg.n_candidates), scalars(cfg),
-            None if sc is None else scalars(sc))
+    # offsets are a tuple (not caught by the scalar filter) but define
+    # the ring topology the backoff/target rows were computed over —
+    # same-shape different-seed rings must fingerprint differently
+    desc = (("C", cfg.n_candidates),
+            ("offsets", tuple(int(o) for o in cfg.offsets)),
+            scalars(cfg), None if sc is None else scalars(sc))
     return zlib.crc32(repr(desc).encode())
 
 
@@ -1784,6 +1816,13 @@ def make_gossip_step(cfg: GossipSimConfig,
                         else jnp.where(withhold, Z, targets))
             send_cheat = cheat_src
             send_fwd_b = state.mesh_b if paired else None
+            if paired and params.cand_direct is not None:
+                # direct peers are eager-forward targets on EVERY topic
+                # (gossipsub.go:945-950): slot-B fresh content reaches
+                # them too (slot A rides out_bits, which already
+                # includes the direct word)
+                send_fwd_b = send_fwd_b | (params.cand_direct
+                                           & params.cand_sub_bits)
             if sc is not None:
                 # with every edge's payload AND gossip gate open (no
                 # attackers, no graylisting — the clean steady state)
@@ -2054,6 +2093,11 @@ def make_gossip_step(cfg: GossipSimConfig,
             keep = mesh | fanout
             if paired:
                 keep = keep | mesh_b_new
+            if params.cand_direct is not None:
+                # operator-pinned direct addresses are re-dialed
+                # unconditionally (gossipsub.go:1594-1616): PX churn
+                # never evicts them from the active set
+                keep = keep | params.cand_direct
             deact = rot & state.active & ~keep
             n_rot = popcount32(deact)
             # exclude edges already folding in via keep, or a rotation

@@ -209,6 +209,18 @@ def load_legacy_gossip_state(path: str, template, cfg, score_cfg, params):
                 f"leaf {k!r}: legacy {arr.dtype}{arr.shape} vs "
                 f"template {want.dtype}{want.shape}")
         out.append(jax.numpy.asarray(arr))
+    # same extra-leaves guard as load_state (with the zero-P3 shim): a
+    # legacy snapshot from a config the template doesn't model must
+    # fail loudly, not silently drop its state
+    extra = set(by_key) - {_key(p) for p, _ in leaves}
+    for k in list(extra):
+        if (k.endswith(("mesh_deliveries", "mesh_failure_penalty"))
+                and not np.any(by_key[k])):
+            extra.discard(k)
+    if extra:
+        raise ValueError(
+            f"legacy checkpoint has leaves the template lacks: "
+            f"{sorted(extra)[:4]} — wrong sim configuration?")
     state = jax.tree_util.tree_unflatten(
         jax.tree_util.tree_structure(template), out)
     return refresh_gates(cfg, score_cfg, params, state)

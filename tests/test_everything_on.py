@@ -1,0 +1,155 @@
+"""The EVERYTHING-ON configuration: every v1.1 feature active at once.
+
+The reference router runs all features simultaneously by construction
+(gossipsub.go:197-297); a sim whose features only exist in mutually-
+exclusive modes quietly stops being a model of the real system (VERDICT
+r4 weak-3).  This config combines:
+
+- paired-topic overlapping membership (two meshes/peer, TopicScoreCap)
+- PX candidate rotation (active-subset refresh on PRUNE)
+- operator-pinned direct peers (graylist/gater bypass, never meshed)
+- sybil clusters behind shared IPs (P6 colocation + per-IP gater)
+- BOTH gossip-repair attacks (IHAVE broken-promise spam + the IWANT
+  retransmission flood) plus GRAFT-flood backoff violations
+- invalid-message spam from the sybils (P4 + gater pressure)
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+import go_libp2p_pubsub_tpu.models.gossipsub as gs
+
+
+def _build_everything(n=600, t=4, C=16, m=20, seed=5):
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, C, n, seed=seed, paired=True),
+        n_topics=t, paired_topics=True,
+        d=3, d_lo=2, d_hi=6, d_score=2, d_out=1, d_lazy=2)
+    rng = np.random.default_rng(seed)
+    own = np.arange(n) % t
+    second = (own + t // 2) % t
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), own] = True
+    subs[np.arange(n), second] = True
+
+    sybil = np.zeros(n, dtype=bool)
+    sybil[rng.choice(n, n // 10, replace=False)] = True
+
+    # honest origins; sybils additionally inject invalid traffic
+    honest_ids = np.flatnonzero(~sybil)
+    sybil_ids = np.flatnonzero(sybil)
+    n_valid, n_inv = m, m // 2
+    origin = np.concatenate([
+        honest_ids[rng.integers(0, len(honest_ids), n_valid)],
+        sybil_ids[rng.integers(0, len(sybil_ids), n_inv)]])
+    topic = (origin % t).astype(np.int64)
+    invalid = np.array([False] * n_valid + [True] * n_inv)
+    ticks = np.concatenate([
+        np.sort(rng.integers(0, 12, n_valid)),
+        rng.integers(0, 12, n_inv)]).astype(np.int32)
+
+    # sparse symmetric direct overlay on candidate pair (0, cinv[0])
+    f = (np.arange(n) % 53) == 0
+    de = np.zeros((n, C), dtype=bool)
+    for c_ in (0, cfg.cinv[0]):
+        de[:, c_] = f | np.roll(f, -int(cfg.offsets[c_]))
+
+    # sybil pairs share source addresses (P6 + per-IP gater grouping)
+    ip = np.arange(n)
+    ip[sybil_ids] = n + np.arange(len(sybil_ids)) // 2
+
+    sc = gs.ScoreSimConfig(topic_score_cap=50.0,
+                           sybil_ihave_spam=True,
+                           sybil_iwant_spam=True,
+                           sybil_graft_flood=True)
+    params, state = gs.make_gossip_sim(
+        cfg, subs, topic, origin, ticks, score_cfg=sc,
+        sybil=sybil, msg_invalid=invalid, peer_ip=ip,
+        px_candidates=10, direct_edges=de)
+    return cfg, sc, params, state, sybil, topic, invalid, own, second
+
+
+def test_everything_on_constructs_and_disseminates():
+    """The combined config constructs, runs, and still delivers every
+    VALID message to every honest member of its topic pair."""
+    (cfg, sc, params, state, sybil, topic, invalid, own,
+     second) = _build_everything()
+    n, t = len(sybil), cfg.n_topics
+    # all features are genuinely wired, not silently dropped
+    assert params.cand_direct is not None
+    assert params.cand_same_ip is not None
+    assert state.active is not None
+    assert state.mesh_b is not None
+    assert state.iwant_serves is not None
+
+    step = gs.make_gossip_step(cfg, sc)
+    out = gs.gossip_run(params, state, 45, step)
+
+    have = np.asarray(out.have)
+    honest = ~sybil
+    member = lambda tau: (own == tau) | (second == tau)  # noqa: E731
+    for j in np.flatnonzero(~invalid):
+        w, b = j // 32, np.uint32(1 << (j % 32))
+        got = (have[w] & b) != 0
+        need = honest & member(topic[j])
+        assert (got[need]).all(), f"valid msg {j} failed honest delivery"
+
+
+def test_everything_on_defenses_live():
+    """Each defense observably engages in the combined run: direct
+    edges never meshed but pinned in the active set, the serve ledger
+    saturates at sybil rows, P7/P6 penalties accrue on attacker edges."""
+    (cfg, sc, params, state, sybil, topic, invalid, own,
+     second) = _build_everything()
+    step = gs.make_gossip_step(cfg, sc)
+    mid = gs.gossip_run(params, state, 18, step)
+    out = gs.gossip_run(params, mid, 27, step)
+
+    # direct edges: no HONEST peer ever meshes one (graft-flooding
+    # sybils may hold a unilateral delusion — their GRAFT at a direct
+    # peer is silently dropped at the graylist, so no PRUNE comes back
+    # to retract it, exactly as in the reference) — and pins stay active
+    cd = np.asarray(params.cand_direct)
+    hon = ~sybil
+    assert cd.any()
+    assert (np.asarray(out.mesh)[hon] & cd[hon]).max() == 0
+    assert (np.asarray(out.mesh_b)[hon] & cd[hon]).max() == 0
+    assert ((np.asarray(out.active) & cd) == cd).all(), \
+        "PX rotation must never evict pinned direct edges"
+
+    # serve ledger: live mid-run, sybil rows above every honest row
+    serves = np.asarray(mid.iwant_serves)
+    syb_max = serves[:, sybil].max()
+    hon_max = serves[:, ~sybil].max()
+    assert syb_max > hon_max, (syb_max, hon_max)
+
+    # P7 (graft flood + broken promises) accrues on sybil edges only
+    bp = np.asarray(out.scores.behaviour_penalty)
+    cand_sybil = np.stack(
+        [np.roll(sybil, -int(o)) for o in cfg.offsets])
+    assert bp[cand_sybil].max() > 0
+    assert bp[~cand_sybil].max() == 0
+
+    # P6/static score: shared-IP sybil edges carry a colocation penalty
+    stat = np.asarray(params.cand_static_score)
+    assert stat[cand_sybil].min() < 0
+
+    # the paired gates pipeline stayed consistent throughout
+    ref = gs.refresh_gates(cfg, sc, params, out)
+    for g_a, g_b in zip(out.gates, ref.gates):
+        np.testing.assert_array_equal(np.asarray(g_a), np.asarray(g_b))
+
+
+def test_everything_on_px_rotation_active():
+    """PX rotation actually rotates under the PRUNE churn the attacks
+    cause: the active sets at t=18 and t=45 differ somewhere (while
+    direct pins never move)."""
+    (cfg, sc, params, state, sybil, *_rest) = _build_everything()
+    step = gs.make_gossip_step(cfg, sc)
+    mid = gs.gossip_run(params, state, 18, step)
+    out = gs.gossip_run(params, mid, 27, step)
+    a0, a1 = np.asarray(mid.active), np.asarray(out.active)
+    assert (a0 != a1).any(), "no PX rotation happened in 45 ticks"
+    cd = np.asarray(params.cand_direct)
+    assert ((a0 & cd) == cd).all() and ((a1 & cd) == cd).all()

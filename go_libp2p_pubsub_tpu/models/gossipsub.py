@@ -1195,7 +1195,9 @@ def make_gossip_step(cfg: GossipSimConfig,
                      receive_block: int = 8192,
                      receive_interpret: bool = False,
                      force_split: bool = False,
-                     pipeline_gates: bool = True):
+                     pipeline_gates: bool = True,
+                     shard_mesh=None,
+                     shard_axis: str = "peers"):
     """Build the jittable (params, state) -> (state, delivered_words) core.
 
     Per tick:
@@ -1266,7 +1268,7 @@ def make_gossip_step(cfg: GossipSimConfig,
         from ..ops.pallas.receive import (
             CTRL_A, CTRL_DROP, CTRL_GRAFT,
             CTRL_OUT, CTRL_ADV, CTRL_TGT, extend_wrap,
-            make_receive_update, plan)
+            make_receive_update, plan, sharded_receive)
 
         n_true = params.n_true
         n_pad = params.subscribed.shape[0]
@@ -1286,7 +1288,7 @@ def make_gossip_step(cfg: GossipSimConfig,
         def bit_of(word, c):
             return (word >> jnp.uint32(c)) & jnp.uint32(1)
 
-        rows = []
+        ctrl_rows = []              # u8 [n_pad] per sender edge
         for c in range(C):
             b = ((bit_of(out_bits, c) << jnp.uint32(CTRL_OUT))
                  | (bit_of(tgt_deliver, c) << jnp.uint32(CTRL_TGT))
@@ -1294,17 +1296,7 @@ def make_gossip_step(cfg: GossipSimConfig,
                  | (bit_of(dropped, c) << jnp.uint32(CTRL_DROP))
                  | (bit_of(a_sent, c) << jnp.uint32(CTRL_A))
                  | (bit_of(targets, c) << jnp.uint32(CTRL_ADV)))
-            rows.append(extend_wrap(b.astype(jnp.uint8), n_true, n_pad,
-                                    pln["p8"], pln["e8"]))
-        ctrl_flat = jnp.concatenate(rows)
-        fresh_flat = jnp.concatenate(
-            [extend_wrap(fresh[w], n_true, n_pad, pln["p32"],
-                         pln["e32"])
-             for w in range(W)])
-        adv_flat = jnp.concatenate(
-            [extend_wrap(adv[w], n_true, n_pad, pln["p32"],
-                         pln["e32"])
-             for w in range(W)])
+            ctrl_rows.append(b.astype(jnp.uint8))
         seen_st = jnp.stack([state.have[w] | injected[w]
                              for w in range(W)])
         inj_st = jnp.stack(injected)
@@ -1313,28 +1305,57 @@ def make_gossip_step(cfg: GossipSimConfig,
         gseeds = jnp.stack([lane_seed(tick + 1, 6, salt),
                             lane_seed(tick + 1, 1, salt)])
         cdt = (jnp.dtype(sc.counter_dtype) if sc is not None else None)
-        krn = make_receive_update(cfg, sc, n_true, receive_block, cdt,
-                                  W, track_promises=track_promises,
-                                  interpret=receive_interpret)
-        args = []
-        if sc is not None:
-            args.append(jnp.stack(valid_w))
-        args += [gseeds, ctrl_flat, fresh_flat, adv_flat]
-        if sc is not None:
-            args += [payload_bits, gossip_bits, accept_bits]
+        head = ([jnp.stack(valid_w)] if sc is not None else []) + [gseeds]
         syb_mask = (jnp.where(params.sybil, ALL, Z)
                     if sc is not None and sc.sybil_ihave_spam
                     else jnp.zeros_like(sub_all))
-        args += [sub_all, params.cand_sub_bits, fanout, syb_mask,
-                 would_accept, backoff_bits2, grafts, dropped,
-                 mesh_sel, seen_st, inj_st, state.backoff]
+        blocked = []
+        if sc is not None:
+            blocked += [payload_bits, gossip_bits, accept_bits]
+        blocked += [sub_all, params.cand_sub_bits, fanout, syb_mask,
+                    would_accept, backoff_bits2, grafts, dropped,
+                    mesh_sel, seen_st, inj_st, state.backoff]
         if sc is not None:
             s0 = state.scores
-            args += [params.cand_static_score,
-                     s0.first_deliveries, s0.invalid_deliveries,
-                     s0.behaviour_penalty, s0.time_in_mesh,
-                     state.iwant_serves]
-        outs = krn(*args)
+            blocked += [params.cand_static_score,
+                        s0.first_deliveries, s0.invalid_deliveries,
+                        s0.behaviour_penalty, s0.time_in_mesh,
+                        state.iwant_serves]
+        if shard_mesh is not None:
+            # multi-chip: shard_map over the peer axis — per-shard
+            # halo exchange (ICI collective-permutes) + the unmodified
+            # kernel on a force-extended local plan.  Requires the
+            # unpadded ring: the halos wrap at n_true, so pad lanes
+            # between (d+1)S and the true ring would corrupt them.
+            if n_pad != n_true:
+                raise ValueError(
+                    "sharded kernel path needs n_true == n_pad (no pad "
+                    "lanes): pick n divisible by the block so "
+                    "pad_to_block adds nothing")
+            outs = sharded_receive(
+                cfg, sc, n_true, receive_block, cdt, W,
+                track_promises, receive_interpret, shard_mesh,
+                shard_axis, head, jnp.stack(ctrl_rows),
+                jnp.stack(fresh), jnp.stack(adv), blocked)
+        else:
+            ctrl_flat = jnp.concatenate(
+                [extend_wrap(r, n_true, n_pad, pln["p8"], pln["e8"])
+                 for r in ctrl_rows])
+            fresh_flat = jnp.concatenate(
+                [extend_wrap(fresh[w], n_true, n_pad, pln["p32"],
+                             pln["e32"])
+                 for w in range(W)])
+            adv_flat = jnp.concatenate(
+                [extend_wrap(adv[w], n_true, n_pad, pln["p32"],
+                             pln["e32"])
+                 for w in range(W)])
+            krn = make_receive_update(
+                cfg, sc, n_true, receive_block, cdt, W,
+                track_promises=track_promises,
+                interpret=receive_interpret)
+            base0 = jnp.zeros((1,), dtype=jnp.uint32)
+            outs = krn(*head, base0, ctrl_flat, fresh_flat, adv_flat,
+                       *blocked)
         new_acq, mesh_new, backoff_new = outs[:3]
         n_gates = 7 if sc is not None else 2
         gates_new = tuple(outs[3:3 + n_gates])

@@ -40,8 +40,15 @@ splits into an aligned base plus a static in-VMEM lane-roll remainder
 
 The kernel is semantically identical to the XLA combined path (same op
 order, so counter bits match exactly); tests pin kernel==XLA
-trajectories on shared seeds.  It is single-device only (no GSPMD
-partitioning rule) — sharded runs keep the XLA form.
+trajectories on shared seeds.
+
+Multi-chip: ``sharded_receive`` runs the kernel under ``shard_map``
+over the peer axis — each shard halo-exchanges max|offset| of boundary
+data with its ring neighbors (``ppermute`` → ICI collective-permute,
+the same boundary traffic GSPMD shards the XLA rolls into) and invokes
+the unmodified kernel on a force-extended local plan; the in-kernel
+uniform streams draw by global peer index, so sharded == single-device
+bit-for-bit (tests/test_pallas_receive.py::test_sharded_kernel_*).
 """
 
 from __future__ import annotations
@@ -75,7 +82,7 @@ def _align_up(x: int, a: int) -> int:
     return ((x + a - 1) // a) * a
 
 
-def plan(n_true: int, offsets, block: int):
+def plan(n_true: int, offsets, block: int, force_extended: bool = False):
     """Static layout plan shared by the kernel and its XLA composer.
 
     Two modes:
@@ -90,9 +97,16 @@ def plan(n_true: int, offsets, block: int):
       tile-aligned because n is — so the source only needs B + ALIGN
       of tail slack (the wrap continued past n).  Composes shrink to
       one small tail copy per array; p = 0.
+
+    ``force_extended`` pins the extended layout even when n qualifies
+    for the aligned one: the SHARDED kernel path feeds each shard a
+    halo-extended view of its local slice, where mod-n wraparound
+    arithmetic would be wrong (the wrap data arrives via the halos, not
+    by index wrapping).
     """
     n_pad = _align_up(n_true, block)
-    aligned = (n_true % ALIGN8 == 0 and n_pad == n_true)
+    aligned = (not force_extended and n_true % ALIGN8 == 0
+               and n_pad == n_true)
     if aligned:
         p32 = p8 = 0
         e32 = block + ALIGN32
@@ -148,12 +162,13 @@ def _expand(word: jnp.ndarray, c: int) -> jnp.ndarray:
 
 
 def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
-                    counter_dtype, track_promises):
+                    counter_dtype, track_promises,
+                    force_extended=False, stream_n=None):
     C = cfg.n_candidates
     B = block
     cinv = cfg.cinv
     offsets = [int(o) for o in cfg.offsets]
-    pln = plan(n_true, offsets, block)
+    pln = plan(n_true, offsets, block, force_extended=force_extended)
     p32, p8 = pln["p32"], pln["p8"]
     has_sc = sc is not None
     W = w_words
@@ -166,6 +181,10 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     gseed_ref = nxt()       # u32 [2]: mixed lane seeds for tick + 1
     #                         [0] gater draw (phase 6), [1] gossip
     #                         targets (phase 1)
+    base_ref = nxt()        # u32 [1]: global peer index of local
+    #                         position 0 (nonzero on the sharded
+    #                         path: each shard's kernel must draw
+    #                         the GLOBAL peer's uniform stream)
     ctrl_hbm = nxt()
     fresh_hbm = nxt()
     adv_hbm = nxt()
@@ -363,11 +382,12 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
 
     def lane_u(seed):
         """Phase uniform for tick+1, matching ops.graph.lane_uniform
-        ((C, n) shape, stride n_true) bit-for-bit."""
+        ((C, n) shape, stride stream_n) bit-for-bit."""
         peer = (jax.lax.broadcasted_iota(jnp.uint32, (C, B), 1)
-                + jnp.uint32(i * B))
+                + jnp.uint32(i * B) + base_ref[0])
         lane = (jax.lax.broadcasted_iota(jnp.uint32, (C, B), 0)
-                * jnp.uint32(n_true) + peer)
+                * jnp.uint32(n_true if stream_n is None else stream_n)
+                + peer)
         h = _fmix32(lane ^ seed)
         return ((h >> jnp.uint32(8)).astype(jnp.int32)
                 .astype(jnp.float32) * jnp.float32(1 / (1 << 24)))
@@ -488,17 +508,142 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
         out_gates[1][...] = bo_gate
 
 
+def _ring_halo(x, p_l: int, p_r: int, axis_name: str, D: int):
+    """Per-shard halo extension along the last axis of a D-shard ring.
+
+    Inside a ``shard_map`` body whose last axis tiles a ring of global
+    extent D*S, returns ``concat(global[(d*S - p_l) mod DS : ...])`` of
+    length ``S + p_l + p_r`` for each shard d — the localized
+    equivalent of ``extend_wrap``'s mod-n indexing, built from
+    neighbor-shard ``ppermute`` transfers (ICI collectives) instead of
+    global slicing.  Halos larger than S chain hops (tiny dryrun
+    shapes); halos that wrap the whole ring repeat it, exactly as
+    ``extend_wrap`` repeats rows when p > n."""
+    S = x.shape[-1]
+
+    def from_left(seg, h):      # receive seg from the shard h to my left
+        return jax.lax.ppermute(
+            seg, axis_name, [(i, (i + h) % D) for i in range(D)])
+
+    def from_right(seg, h):
+        return jax.lax.ppermute(
+            seg, axis_name, [(i, (i - h) % D) for i in range(D)])
+
+    left = []
+    need, h = p_l, 1
+    while need > 0:
+        take = min(S, need)
+        seg = x[..., S - take:] if take < S else x
+        left.append(from_left(seg, h))
+        need -= take
+        h += 1
+    left.reverse()              # farthest (partial) segment first
+    parts = left + [x]
+    need, h = p_r, 1
+    while need > 0:
+        take = min(S, need)
+        seg = x[..., :take] if take < S else x
+        parts.append(from_right(seg, h))
+        need -= take
+        h += 1
+    return jnp.concatenate(parts, axis=-1)
+
+
+def sharded_receive(cfg, sc, n_true: int, block: int, counter_dtype,
+                    w_words: int, track_promises: bool, interpret: bool,
+                    mesh, axis_name: str,
+                    head, ctrl_rows, fresh_st, adv_st, blocked):
+    """Multi-chip kernel dispatch: shard_map over the peer axis, one
+    pallas kernel invocation per shard with ring-halo exchange.
+
+    The circulant edge views only ever reach max|offset| beyond a
+    shard's slice, so each shard fetches p elements of halo from its
+    ring neighbors (``ppermute`` → ICI collective-permute — the same
+    boundary traffic the XLA path's rolls shard into) and runs the
+    unmodified kernel over a force-extended local plan.  The in-kernel
+    uniform streams draw by GLOBAL peer index (``stream_n`` +
+    per-shard ``base``), so the sharded trajectory is bit-identical to
+    the single-device kernel.
+
+    Constraints: the state must be unpadded (n_true == n_pad — the
+    halo ring must be the true ring) and n_true must divide evenly
+    into D shards of whole blocks (n_true % (D * block) == 0).
+
+    ``head`` = [valid (sc only), gseeds]; ``ctrl_rows`` u8 [C, N];
+    ``fresh_st``/``adv_st`` u32 [W, N]; ``blocked`` = the per-peer
+    operands in make_receive_update order.  Returns the kernel's
+    outputs with global [*, N] shapes.
+    """
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:        # older jax
+        from jax.experimental.shard_map import shard_map
+
+    D = mesh.shape[axis_name]
+    if n_true % (D * block) != 0:
+        raise ValueError(
+            f"sharded kernel needs n_true divisible by D*block = "
+            f"{D}*{block}; got {n_true} (choose n as a multiple of "
+            "lcm(n_topics, D*block))")
+    S = n_true // D
+    pln = plan(S, cfg.offsets, block, force_extended=True)
+    assert pln["n_pad"] == S
+    p8, e8 = pln["p8"], pln["e8"]
+    p32, e32 = pln["p32"], pln["e32"]
+    krn = make_receive_update(
+        cfg, sc, S, block, counter_dtype, w_words,
+        track_promises=track_promises, interpret=interpret,
+        force_extended=True, stream_n=n_true)
+    n_head = len(head)
+    n_gates = 7 if sc is not None else 2
+
+    def body(*ops):
+        it = iter(ops)
+        head_l = [next(it) for _ in range(n_head)]
+        ctrl = next(it)
+        fr = next(it)
+        ad = next(it)
+        blk = list(it)
+        d = jax.lax.axis_index(axis_name)
+        base = (jnp.uint32(S) * d.astype(jnp.uint32)).reshape(1)
+        ctrl_e = _ring_halo(ctrl, p8, p8 + e8, axis_name, D)
+        fr_e = _ring_halo(fr, p32, p32 + e32, axis_name, D)
+        ad_e = _ring_halo(ad, p32, p32 + e32, axis_name, D)
+        return tuple(krn(*head_l, base, ctrl_e.reshape(-1),
+                         fr_e.reshape(-1), ad_e.reshape(-1), *blk))
+
+    shard_last = lambda x: P(*([None] * (x.ndim - 1)), axis_name)  # noqa: E731
+    in_specs = tuple(
+        [P()] * n_head + [P(None, axis_name)] * 3
+        + [shard_last(x) for x in blocked])
+    out_specs = tuple(
+        [P(None, axis_name), P(axis_name), P(None, axis_name)]
+        + [P(axis_name)] * n_gates
+        + ([P(None, axis_name)] * 5 if sc is not None else []))
+    try:
+        fn = shard_map(body, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False)
+    except TypeError:          # older jax: check_rep instead
+        fn = shard_map(body, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_rep=False)
+    return fn(*head, ctrl_rows, fresh_st, adv_st, *blocked)
+
+
 def make_receive_update(cfg, sc, n_true: int, block: int,
                         counter_dtype, w_words: int,
                         track_promises: bool = False,
-                        interpret: bool = False):
+                        interpret: bool = False,
+                        force_extended: bool = False,
+                        stream_n: int | None = None):
     """Build the kernel caller.
 
     Operand order (args): [valid u32 [W] (sc only)], gseeds u32 [2]
-    (tick+1 gater + targets lane seeds), ctrl_flat u8 [C*L8],
-    fresh_flat u32 [W*L32], adv_flat u32 [W*L32], [pay, gsp, acc u32
-    [N_pad] (sc only)], sub, cand_sub, fanout, sybil-override, wa,
-    bo2, grafts, dropped, meshsel u32 [N_pad], seen u32 [W, N_pad],
+    (tick+1 gater + targets lane seeds), base u32 [1] (global peer
+    index of local position 0 — 0 off the sharded path), ctrl_flat u8
+    [C*L8], fresh_flat u32 [W*L32], adv_flat u32 [W*L32], [pay, gsp,
+    acc u32 [N_pad] (sc only)], sub, cand_sub, fanout, sybil-override,
+    wa, bo2, grafts, dropped, meshsel u32 [N_pad], seen u32 [W, N_pad],
     injected
     [W, N_pad], backoff-remaining i16 [C, N_pad], [static f32
     [C, N_pad], fd, inv (counter_dtype), bp f32(/counter_dtype), tim
@@ -508,10 +653,16 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
     *gates (G separate u32 [N_pad] words — compute_gates order),
     [, fd, inv, bp, tim, iwant_serves]) where G = 7 scored / 2
     unscored.
+
+    Sharded use (models/gossipsub.py sharded kernel path): build with
+    ``n_true`` = the LOCAL shard extent, ``force_extended=True`` (halo
+    layout, no mod-n wraparound), and ``stream_n`` = the GLOBAL true
+    peer count so the in-kernel uniform streams match the unsharded
+    draw bit-for-bit; pass each shard's global offset as ``base``.
     """
     C = cfg.n_candidates
     has_sc = sc is not None
-    pln = plan(n_true, cfg.offsets, block)
+    pln = plan(n_true, cfg.offsets, block, force_extended=force_extended)
     n_pad, grid = pln["n_pad"], pln["grid"]
     B = block
     W = w_words
@@ -519,7 +670,8 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
     kern = functools.partial(
         _receive_kernel, cfg=cfg, sc=sc, block=block, n_true=n_true,
         w_words=w_words, counter_dtype=counter_dtype,
-        track_promises=track_promises)
+        track_promises=track_promises, force_extended=force_extended,
+        stream_n=stream_n)
 
     b1 = lambda: pl.BlockSpec((B,), lambda i: (i,))  # noqa: E731
     bw = lambda: pl.BlockSpec((W, B), lambda i: (0, i))  # noqa: E731
@@ -530,6 +682,7 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
     if has_sc:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))  # valid
     in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))      # gseeds
+    in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))      # base
     in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * 3      # flats
     if has_sc:
         in_specs += [b1(), b1(), b1()]        # pay, gsp, acc

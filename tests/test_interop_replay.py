@@ -261,6 +261,7 @@ def test_gossipsub_v11_adversarial_containment_core_vs_sim():
         assert band_ok(core_mean), (sim_mean, core_mean)
 
 
+@pytest.mark.slow
 def test_randomsub_core_vs_sim_reach_curves():
     """Real randomsub cluster (exact max(D, ceil(sqrt N))-peer sampling,
     randomsub.go:124-138) vs the sim's binomial approximation
@@ -288,10 +289,12 @@ def test_randomsub_core_vs_sim_reach_curves():
         np.asarray(rs.reach_by_hops(params, out, 9)), n)
     assert sim_mean[-1] == 1.0
 
-    # retry-once on envelope breach: machine load can cut the cluster's
-    # settle window short (same policy as the gossipsub curve gates)
+    # retry on envelope breach with growing settle windows: machine
+    # load can cut the cluster's settle window short (same policy as
+    # the gossipsub curve gates; the third rung rides out heavy
+    # co-located load, e.g. a parallel compile)
     last = None
-    for settle_s in (1.0, 2.0):
+    for settle_s in (1.0, 2.0, 4.0):
         run = run_core_randomsub(n, publishers, settle_s=settle_s)
         core_mean = mean_reach_fraction(
             reach_by_hops_from_trace(run, 10), n)

@@ -13,9 +13,11 @@ run() {
 }
 run python tools/bench_kernel.py 1000000 xla kernel kernela
 run python tools/bench_kernel.py 1000000 kernela --noroll
+run python tools/kernel_identity.py 200000 KERNEL_IDENTITY_r05.json
+run python tools/bench_sharded.py
 run python tools/bench_micro.py 1000000 100
 run python tools/profile_trace.py 1000000 xla
 run python bench.py
 run python bench_suite.py gossipsub_v10 gossipsub_v11_multitopic \
-    gossipsub_v11_adversarial
+    gossipsub_v11_adversarial gossipsub_v11_everything
 echo DONE | tee -a "$log"

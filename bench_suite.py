@@ -121,9 +121,9 @@ def bench_randomsub_10k():
          "heartbeats/s")
 
 
-def _bench_gossip(metric, n, t, score_cfg, sybil=None, gate_honest=False,
-                  baseline=None, paired=False, kernel=False,
-                  px_candidates=None, with_direct=False,
+def _bench_gossip(metric, n, t, score_cfg, sybil_frac=None,
+                  gate_honest=False, baseline=None, paired=False,
+                  kernel=False, px_candidates=None, with_direct=False,
                   shared_sybil_ips=False):
     import jax
     import go_libp2p_pubsub_tpu.models.gossipsub as gs
@@ -134,8 +134,11 @@ def _bench_gossip(metric, n, t, score_cfg, sybil=None, gate_honest=False,
     rng = np.random.default_rng(0)
     block = 8192
     if kernel:
-        assert sybil is None and not paired, \
-            "kernel bench path supports the clean flagship only"
+        # kernel coverage now includes the sybil attack configs; still
+        # no paired/PX/shared-IP (see the step's guard)
+        assert not paired and px_candidates is None \
+            and not shared_sybil_ips, \
+            "kernel bench path: no paired/px/shared-IP configs"
 
         # the pallas step wants n divisible by the u8 tile alignment
         # (4096) and the block (aligned-wrap plan) — round UP so the
@@ -143,6 +146,9 @@ def _bench_gossip(metric, n, t, score_cfg, sybil=None, gate_honest=False,
         import math
         quantum = math.lcm(t, 4096, block)
         n = -(-n // quantum) * quantum
+    # sybil flags are drawn AFTER any kernel rounding of n
+    sybil = (np.random.default_rng(7).random(n) < sybil_frac
+             if sybil_frac is not None else None)
     cfg = gs.GossipSimConfig(
         offsets=gs.make_gossip_offsets(t, C, n, seed=0, paired=paired),
         n_topics=t, paired_topics=paired)
@@ -270,18 +276,21 @@ def bench_gossipsub_v11_adversarial():
     broken-promise spam (gossipsub_spam_test.go:135) and the IWANT
     retransmission flood (gossipsub_spam_test.go:24).  Gated on full
     honest delivery and on the retransmission cutoff's served-load
-    bound."""
+    bound.  GOSSIP_BENCH_KERNEL=1 runs it on the pallas kernel path
+    (the in-kernel attack accrual is parity-pinned)."""
     import jax
     import go_libp2p_pubsub_tpu.models.gossipsub as gs
     on_accel = jax.devices()[0].platform != "cpu"
     n = 1_000_000 if on_accel else 100_000
-    rng = np.random.default_rng(7)
-    sybil = rng.random(n) < 0.2
+    kernel = (os.environ.get("GOSSIP_BENCH_KERNEL", "0") == "1"
+              and on_accel)
     _bench_gossip(
-        f"gossipsub_v11_adversarial_{n}peers_20pct_sybil_heartbeats_per_sec",
+        "gossipsub_v11_adversarial_{n}peers_20pct_sybil"
+        + ("_kernel" if kernel else "") + "_heartbeats_per_sec",
         n, 100, gs.ScoreSimConfig(sybil_ihave_spam=True,
                                   sybil_iwant_spam=True),
-        sybil=sybil, gate_honest=True, baseline=10_000.0)
+        sybil_frac=0.2, gate_honest=True, baseline=10_000.0,
+        kernel=kernel)
 
 
 def bench_gossipsub_v11_everything():
@@ -295,14 +304,12 @@ def bench_gossipsub_v11_everything():
     import go_libp2p_pubsub_tpu.models.gossipsub as gs
     on_accel = jax.devices()[0].platform != "cpu"
     n = 1_000_000 if on_accel else 100_000
-    rng = np.random.default_rng(7)
-    sybil = rng.random(n) < 0.2
     _bench_gossip(
         f"gossipsub_v11_everything_{n}peers_heartbeats_per_sec",
         n, 100, gs.ScoreSimConfig(topic_score_cap=50.0,
                                   sybil_ihave_spam=True,
                                   sybil_iwant_spam=True),
-        sybil=sybil, gate_honest=True, paired=True,
+        sybil_frac=0.2, gate_honest=True, paired=True,
         px_candidates=14, with_direct=True, shared_sybil_ips=True,
         baseline=10_000.0)
 

@@ -706,10 +706,6 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
 
     direct_packed = None
     if direct_edges is not None:
-        if pad_to_block is not None:
-            raise ValueError(
-                "direct_edges not supported by the pallas (padded) "
-                "step — build without pad_to_block")
         de = np.asarray(direct_edges, dtype=bool)
         if de.shape != (n, cfg.n_candidates):
             raise ValueError("direct_edges must be bool [N, C]")
@@ -1306,8 +1302,12 @@ def make_gossip_step(cfg: GossipSimConfig,
                             lane_seed(tick + 1, 1, salt)])
         cdt = (jnp.dtype(sc.counter_dtype) if sc is not None else None)
         head = ([jnp.stack(valid_w)] if sc is not None else []) + [gseeds]
+        # the sybil word serves BOTH attack paths in-kernel: the IHAVE
+        # advert override (gated there on sc.sybil_ihave_spam) and the
+        # IWANT-flood serve accrual (gated on sc.sybil_iwant_spam)
         syb_mask = (jnp.where(params.sybil, ALL, Z)
-                    if sc is not None and sc.sybil_ihave_spam
+                    if sc is not None and params.sybil is not None
+                    and (sc.sybil_ihave_spam or sc.sybil_iwant_spam)
                     else jnp.zeros_like(sub_all))
         blocked = []
         if sc is not None:
@@ -1404,12 +1404,9 @@ def make_gossip_step(cfg: GossipSimConfig,
             if (C > 16 or W == 0 or params.flood_proto is not None
                     or paired or state.active is not None
                     or params.cand_same_ip is not None
-                    or params.cand_direct is not None
-                    or not cfg.binomial_gossip_sampling
                     or state.gates is None
                     or (sc is not None and (sc.track_p3
                                             or sc.flood_publish
-                                            or sc.sybil_iwant_spam
                                             # the kernel adds the baked
                                             # static P5+P6 term as-is;
                                             # a re-weighted config must
@@ -1420,10 +1417,8 @@ def make_gossip_step(cfg: GossipSimConfig,
                 raise ValueError(
                     "config not supported by the pallas step (needs "
                     "C<=16, W>=1, carried gates, matching static score "
-                    "weights, binomial gossip sampling, no flood_proto/"
-                    "track_p3/flood_publish/sybil_iwant_spam/"
-                    "paired_topics/px_candidates/direct peers/"
-                    "shared-IP gater)")
+                    "weights, no flood_proto/track_p3/flood_publish/"
+                    "paired_topics/px_candidates/shared-IP gater)")
         elif params.n_true is not None:
             raise ValueError(
                 "padded sim state requires the pallas step (XLA rolls "

@@ -283,6 +283,9 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
             lacked = lacked | jnp.where((~seen[w]) != 0, u1, Z)
     fd_cnt = [None] * C
     inv_cnt = [None] * C
+    padv_cnt = [None] * C       # partner's advertised-window size per
+    #                             edge (IWANT-flood accrual input)
+    iwant_spam = has_sc and sc.sybil_iwant_spam
     graft_recv = jnp.zeros((B,), jnp.uint32)
     prune_recv = jnp.zeros((B,), jnp.uint32)
     a_recv = jnp.zeros((B,), jnp.uint32)
@@ -313,7 +316,7 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
             ok_g = ok_p & (((gsp_bits >> jnp.uint32(j)) & u1) != 0)
             fwd_on = fwd_on & ok_p
             gsp_on = gsp_on & ok_g
-        fd_j = iv_j = None
+        fd_j = iv_j = pa_j = None
         for w in range(W):
             fresh_q = _flat_roll(pbufs[slot][w][...], p_deltas[j], B)
             adv_q = _flat_roll(pbufs[slot][W + w][...], p_deltas[j], B)
@@ -330,7 +333,14 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
                     news & ~valid[w]).astype(jnp.int32)
                 fd_j = nv if fd_j is None else fd_j + nv
                 iv_j = ni if iv_j is None else iv_j + ni
+            if iwant_spam:
+                # the partner's raw advertised window is already in
+                # VMEM: its size feeds the flood budget (XLA twin
+                # rolls adv_count per edge; here it is a popcount)
+                np_ = jax.lax.population_count(adv_q).astype(jnp.int32)
+                pa_j = np_ if pa_j is None else pa_j + np_
         fd_cnt[j], inv_cnt[j] = fd_j, iv_j
+        padv_cnt[j] = pa_j
         if track_promises:
             # behavioral broken promise: advertised (ADV), not
             # delivering (~TGT), receiver accepts the IHAVE (gossip
@@ -394,8 +404,9 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
 
     def targets_gate(gossip_g):
         # next tick's lazy-gossip targets (emitGossip, compute_gates
-        # row 5/0): Bernoulli(k/|elig|) over non-mesh subscribed
-        # candidates — the kernel path requires binomial sampling
+        # row 5/0) over non-mesh subscribed candidates: Bernoulli
+        # (k/|elig|) fast path, or the exact uniform k-subset matching
+        # ops.graph.select_k_bits bit-for-bit (rank-compare in VMEM)
         elig = csub_ref[...] & ~mesh & ~fan_ref[...] & sub_all
         if gossip_g is not None:
             elig = elig & gossip_g
@@ -404,16 +415,34 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
             jnp.int32(cfg.d_lazy),
             (cfg.gossip_factor * n_el.astype(jnp.float32)).astype(
                 jnp.int32))
-        p_g = jnp.minimum(
-            1.0, n_go.astype(jnp.float32)
-            / jnp.maximum(n_el, 1).astype(jnp.float32))
         u_g = lane_u(gseed_ref[1])
-        tgt = elig & packb(u_g < p_g[None, :])
-        # IHAVE-spamming sybils advertise to every subscribed
-        # candidate (gossipsub_spam_test.go:135); syb_ref is zeros
-        # unless that attack is configured
-        syb = syb_ref[...]
-        return (tgt & ~syb) | (csub_ref[...] & syb)
+        if cfg.binomial_gossip_sampling:
+            p_g = jnp.minimum(
+                1.0, n_go.astype(jnp.float32)
+                / jnp.maximum(n_el, 1).astype(jnp.float32))
+            tgt = elig & packb(u_g < p_g[None, :])
+        else:
+            # exact-k: all-pairs rank compare, unrolled over the row
+            # axis so VMEM holds [C, B] intermediates (not [C, C, B])
+            elig_b = _expand(elig, C)
+            prio = jnp.where(elig_b, u_g, -1.0)
+            ranks = []
+            for i_ in range(C):
+                pi = prio[i_][None, :]
+                beats = (prio > pi) | ((prio == pi) & (cidx_i < i_))
+                ranks.append(beats.astype(jnp.int32).sum(
+                    axis=0, dtype=jnp.int32))
+            rank = jnp.stack(ranks)                   # [C, B]
+            tgt = elig & packb(elig_b & (rank < n_go[None, :]))
+        if has_sc and sc.sybil_ihave_spam:
+            # IHAVE-spamming sybils advertise to every subscribed
+            # candidate (gossipsub_spam_test.go:135).  Gated on the
+            # STATIC flag: syb_ref also carries the sybil mask for the
+            # IWANT-flood accrual, whose configs must not inherit the
+            # IHAVE override.
+            syb = syb_ref[...]
+            tgt = (tgt & ~syb) | (csub_ref[...] & syb)
+        return tgt
 
     if has_sc:
         cdt = counter_dtype
@@ -458,6 +487,19 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
         # kernel guard, so only the honest accrual is needed here.)
         pull = jnp.stack([fd_cnt[j] + inv_cnt[j] for j in range(C)])
         s32 = iws_in[...].astype(jnp.int32)
+        if iwant_spam:
+            # sybil receivers re-request their partner's FULL window
+            # every tick until the per-edge retransmission budget
+            # saturates (mcache.go:66-80 + gossipsub.go:690-693;
+            # attack gossipsub_spam_test.go:24) — mirrors the XLA
+            # epilogue bit-for-bit
+            padv = jnp.stack([jnp.zeros((B,), jnp.int32)
+                              if padv_cnt[j] is None else padv_cnt[j]
+                              for j in range(C)])
+            budget = cfg.gossip_retransmission * padv
+            flood = jnp.where((s32 < budget) & (padv > 0), padv, 0)
+            syb_on = (syb_ref[...] != 0)[None, :]
+            pull = jnp.where(syb_on, flood, pull)
         H = cfg.history_length
         dec = s32 - (s32 + (H - 1)) // H
         out_iws[...] = jnp.clip(dec + pull, 0, 30000).astype(jnp.int16)

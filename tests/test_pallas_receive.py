@@ -14,15 +14,18 @@ import go_libp2p_pubsub_tpu.models.gossipsub as gs
 
 
 def _build(n, n_topics, C, m, *, score, sybil_frac=0.0, spam=False,
-           graft_flood=False, invalid_frac=0.0, breaker_frac=0.0,
-           pad_block=None, seed=3):
+           iwant_spam=False, graft_flood=False, invalid_frac=0.0,
+           breaker_frac=0.0, pad_block=None, seed=3, exact_k=False,
+           direct=False):
     rng = np.random.default_rng(seed)
     offsets = gs.make_gossip_offsets(n_topics, C, n, seed=seed)
     cfg = gs.GossipSimConfig(offsets=offsets, n_topics=n_topics,
                              d=3, d_lo=2, d_hi=6, d_score=2, d_out=1,
                              d_lazy=2, gossip_factor=0.25,
-                             backoff_ticks=8)
+                             backoff_ticks=8,
+                             binomial_gossip_sampling=not exact_k)
     sc = (gs.ScoreSimConfig(sybil_ihave_spam=spam,
+                            sybil_iwant_spam=iwant_spam,
                             sybil_graft_flood=graft_flood)
           if score else None)
     idx = np.arange(n)
@@ -39,6 +42,13 @@ def _build(n, n_topics, C, m, *, score, sybil_frac=0.0, spam=False,
                   app_score=rng.normal(0, 0.1, n).astype(np.float32))
         if breaker_frac:
             kw["promise_break"] = rng.random(n) < breaker_frac
+    if direct:
+        # sparse symmetric direct overlay on candidate pair (0, cinv0)
+        f = (np.arange(n) % 29) == 0
+        de = np.zeros((n, C), dtype=bool)
+        for c_ in (0, cfg.cinv[0]):
+            de[:, c_] = f | np.roll(f, -int(offsets[c_]))
+        kw["direct_edges"] = de
     params, state = gs.make_gossip_sim(
         cfg, subs, topic, origin, ticks, score_cfg=sc,
         pad_to_block=pad_block, **kw)
@@ -120,6 +130,57 @@ def test_kernel_matches_xla_v11_adversarial():
         invalid_frac=0.3)
     _assert_state_equal(out_x, out_k, n, sc)
     assert np.asarray(out_x.scores.behaviour_penalty).max() > 0
+
+
+def test_kernel_matches_xla_v11_iwant_flood():
+    """BOTH gossip-repair attacks (IHAVE broken-promise spam + the
+    IWANT retransmission flood) on the kernel path: the in-kernel
+    flood accrual reads the partner's advertised window straight from
+    VMEM (the XLA twin rolls adv_count per edge) and must match bit
+    for bit, with the sybil rows' serve ledger live."""
+    n = 640
+    cfg, sc, out_x, out_k = _run_pair(
+        n, 2, 8, 10, 12, 128, score=True, sybil_frac=0.2, spam=True,
+        iwant_spam=True, invalid_frac=0.3)
+    _assert_state_equal(out_x, out_k, n, sc)
+    assert np.asarray(out_x.iwant_serves).max() > 0
+
+
+def test_kernel_matches_xla_direct_peers():
+    """Operator-pinned direct peers on the kernel path: the direct
+    accept/payload bypass and graft exclusions all happen on the gate
+    words and selections the kernel consumes (XLA prologue side), so
+    the trajectories must stay bit-identical — and direct edges never
+    enter a mesh."""
+    n = 928                     # multiple of 29: the overlay predicate
+    #                             tiles the ring without a seam
+    cfg, sc, out_x, out_k = _run_pair(n, 4, 8, 8, 30, 128, score=True,
+                                      direct=True)
+    _assert_state_equal(out_x, out_k, n, sc)
+    assert np.asarray(out_x.have).any()
+    # pinned invariant, not just parity: direct edges never meshed
+    f = (np.arange(n) % 29) == 0
+    cd = np.zeros(n, dtype=np.uint32)
+    for c_ in (0, cfg.cinv[0]):
+        cd |= (f | np.roll(f, -int(cfg.offsets[c_]))).astype(
+            np.uint32) << c_
+    assert cd.any()
+    assert (np.asarray(out_x.mesh) & cd).max() == 0
+    assert (np.asarray(out_k.mesh)[:n] & cd).max() == 0
+
+
+@pytest.mark.parametrize("score", [True, False])
+def test_kernel_matches_xla_exact_k_sampling(score):
+    """Exact uniform k-subset gossip targets (the reference's
+    emitGossip draw; binomial_gossip_sampling=False) on the kernel
+    path: the in-VMEM rank-compare must match ops.graph.select_k_bits
+    bit-for-bit."""
+    n = 900
+    cfg, sc, out_x, out_k = _run_pair(n, 4, 8, 8, 20, 128, score=score,
+                                      exact_k=True)
+    assert not cfg.binomial_gossip_sampling
+    _assert_state_equal(out_x, out_k, n, sc)
+    assert np.asarray(out_x.have).any()
 
 
 def test_kernel_matches_xla_v11_promise_breakers():

@@ -134,11 +134,9 @@ def _bench_gossip(metric, n, t, score_cfg, sybil_frac=None,
     rng = np.random.default_rng(0)
     block = 8192
     if kernel:
-        # kernel coverage now includes the sybil attack configs; still
-        # no paired/PX/shared-IP (see the step's guard)
-        assert not paired and px_candidates is None \
-            and not shared_sybil_ips, \
-            "kernel bench path: no paired/px/shared-IP configs"
+        # kernel coverage: everything except paired mode (attacks, PX,
+        # shared-IP gater, direct peers all parity-pinned)
+        assert not paired, "kernel bench path: no paired configs yet"
 
         # the pallas step wants n divisible by the u8 tile alignment
         # (4096) and the block (aligned-wrap plan) — round UP so the

@@ -1119,20 +1119,48 @@ def compute_gates(cfg: GossipSimConfig, sc: ScoreSimConfig | None,
     # threshold (emitGossip gossipsub.go:1656-1712).  st.fanout is
     # pre-tick state (fanout-only peers are unsubscribed, already
     # zeroed by the sub gate — the ~fanout term is belt-and-braces).
+    rows.append(gossip_targets_row(
+        cfg, sc, params, mesh=st.mesh, fanout=st.fanout,
+        mesh_b=st.mesh_b, active=st.active,
+        gossip_row=(rows[1] if sc is not None else None),
+        tick=tick, salt=salt, n_stream=n_stream, n=n))
+
+    rows.append(pack_rows(st.backoff > 0))
+    if cfg.paired_topics:
+        rows.append(pack_rows(st.backoff_b > 0))
+    # a TUPLE of [N] words — stacking into [G, N] would make every row
+    # read a sublane-sliced tile read (see GossipState.gates)
+    return tuple(rows)
+
+
+def gossip_targets_row(cfg: GossipSimConfig, sc: ScoreSimConfig | None,
+                       params: GossipParams, *, mesh, fanout, mesh_b,
+                       active, gossip_row, tick, salt, n_stream, n):
+    """The lazy-gossip targets gate row (compute_gates row 5 scored /
+    0 unscored): random non-mesh subscribed candidates, max(Dlazy,
+    factor * |elig|), both sides above the gossip threshold
+    (emitGossip gossipsub.go:1656-1712; the only always-on selection).
+
+    Shared by compute_gates and the kernel path's PX re-emission (the
+    kernel can't know the POST-rotation active set, so PX configs
+    recompute this row from the rotated state — see _finish_kernel)."""
+    C = cfg.n_candidates
+    ALL = jnp.uint32((1 << C) - 1)
+    Z = jnp.uint32(0)
     sub_all = jnp.where(params.subscribed, ALL, Z)
-    elig = params.cand_sub_bits & ~st.mesh & ~st.fanout & sub_all
-    if st.active is not None:
-        elig = elig & st.active
+    elig = params.cand_sub_bits & ~mesh & ~fanout & sub_all
+    if active is not None:
+        elig = elig & active
     if cfg.paired_topics:
         # shared gossip stream across the two topic slots (one Dlazy
         # selection covers both; documented deviation from per-topic
         # emission): exclude slot-B mesh members too
-        elig = elig & ~st.mesh_b
+        elig = elig & ~mesh_b
     if params.flood_proto is not None:
         # no IHAVE to floodsub-protocol peers (no control protocol)
         elig = elig & ~params.cand_flood_bits
-    if sc is not None:
-        elig = elig & rows[1]                               # gossip gate
+    if gossip_row is not None:
+        elig = elig & gossip_row                            # gossip gate
     n_elig = popcount32(elig)
     n_gossip = jnp.maximum(
         jnp.int32(cfg.d_lazy),
@@ -1157,14 +1185,39 @@ def compute_gates(cfg: GossipSimConfig, sc: ScoreSimConfig | None,
         # candidate ids they never deliver (gossipsub_spam_test.go:135)
         targets = jnp.where(params.sybil, params.cand_sub_bits,
                             targets)
-    rows.append(targets)
+    return targets
 
-    rows.append(pack_rows(st.backoff > 0))
-    if cfg.paired_topics:
-        rows.append(pack_rows(st.backoff_b > 0))
-    # a TUPLE of [N] words — stacking into [G, N] would make every row
-    # read a sublane-sliced tile read (see GossipState.gates)
-    return tuple(rows)
+
+def px_rotate(cfg: GossipSimConfig, params: GossipParams, *,
+              active, rot, keep, sel_k, tick, salt, n_stream):
+    """PX-driven candidate refresh (gossipsub.go:856-937), shared by
+    the XLA step's phase 4b and the kernel path's epilogue so the two
+    can never drift: received PRUNEs/PRUNE-responses (plus own
+    negative-score drops, folded into ``rot`` by the caller) rotate
+    the pruned address out of the active set and dial a fresh pool
+    candidate in; edges in ``keep`` (meshes, fanout, pinned direct
+    peers) are never deactivated."""
+    C = cfg.n_candidates
+    ALL = jnp.uint32((1 << C) - 1)
+    if params.cand_direct is not None:
+        # operator-pinned direct addresses are re-dialed
+        # unconditionally (gossipsub.go:1594-1616): PX churn never
+        # evicts them from the active set
+        keep = keep | params.cand_direct
+    deact = rot & active & ~keep
+    n_rot = popcount32(deact)
+    # exclude edges already folding in via keep, or a rotation slot
+    # would be wasted re-selecting one of them
+    pool_new = ~active & ~keep & params.cand_sub_bits & ALL
+    repl = jax.lax.cond(
+        jnp.any(n_rot > 0),
+        lambda: sel_k(pool_new, n_rot, (C, tick, 7, salt, n_stream)),
+        lambda: jnp.zeros_like(active))
+    # live connections are held addresses: an ACCEPTED inbound GRAFT
+    # teaches the grafter's address even if it wasn't in the active
+    # set (the dialer always knows the dialee), so mesh/fanout edges
+    # fold in and mesh ⊆ active is invariant
+    return (active & ~deact) | repl | keep
 
 
 def refresh_gates(cfg: GossipSimConfig, sc: ScoreSimConfig | None,
@@ -1257,12 +1310,13 @@ def make_gossip_step(cfg: GossipSimConfig,
                        fresh, adv, targets, withhold, out_bits, grafts,
                        dropped, mesh_sel, a_sent, would_accept,
                        backoff_bits2, sub_all, payload_bits,
-                       gossip_bits, accept_bits, valid_w, tick, salt):
+                       gossip_bits, accept_bits, valid_w, tick, salt,
+                       flood_bits=None, neg=None):
         """Pallas path: one mega-kernel does the payload receive,
         handshake resolution, and per-edge counter/backoff updates in
         a single HBM pass over the [C, N] state (ops/pallas/receive)."""
         from ..ops.pallas.receive import (
-            CTRL_A, CTRL_DROP, CTRL_GRAFT,
+            CTRL_A, CTRL_DROP, CTRL_FLOOD, CTRL_GRAFT,
             CTRL_OUT, CTRL_ADV, CTRL_TGT, extend_wrap,
             make_receive_update, plan, sharded_receive)
 
@@ -1292,6 +1346,9 @@ def make_gossip_step(cfg: GossipSimConfig,
                  | (bit_of(dropped, c) << jnp.uint32(CTRL_DROP))
                  | (bit_of(a_sent, c) << jnp.uint32(CTRL_A))
                  | (bit_of(targets, c) << jnp.uint32(CTRL_ADV)))
+            if flood_bits is not None:
+                b = b | (bit_of(flood_bits, c)
+                         << jnp.uint32(CTRL_FLOOD))
             ctrl_rows.append(b.astype(jnp.uint8))
         seen_st = jnp.stack([state.have[w] | injected[w]
                              for w in range(W)])
@@ -1321,6 +1378,8 @@ def make_gossip_step(cfg: GossipSimConfig,
                         s0.first_deliveries, s0.invalid_deliveries,
                         s0.behaviour_penalty, s0.time_in_mesh,
                         state.iwant_serves]
+            if params.cand_same_ip is not None:
+                blocked += [params.cand_same_ip]
         if shard_mesh is not None:
             # multi-chip: shard_map over the peer axis — per-shard
             # halo exchange (ICI collective-permutes) + the unmodified
@@ -1336,7 +1395,11 @@ def make_gossip_step(cfg: GossipSimConfig,
                 cfg, sc, n_true, receive_block, cdt, W,
                 track_promises, receive_interpret, shard_mesh,
                 shard_axis, head, jnp.stack(ctrl_rows),
-                jnp.stack(fresh), jnp.stack(adv), blocked)
+                jnp.stack(fresh), jnp.stack(adv), blocked,
+                inj_st=(jnp.stack(injected) if flood_bits is not None
+                        else None),
+                with_px=state.active is not None,
+                with_same_ip=params.cand_same_ip is not None)
         else:
             ctrl_flat = jnp.concatenate(
                 [extend_wrap(r, n_true, n_pad, pln["p8"], pln["e8"])
@@ -1349,17 +1412,51 @@ def make_gossip_step(cfg: GossipSimConfig,
                 [extend_wrap(adv[w], n_true, n_pad, pln["p32"],
                              pln["e32"])
                  for w in range(W)])
+            flats = [ctrl_flat, fresh_flat, adv_flat]
+            if flood_bits is not None:
+                # flood-publish payload: the sender's own due publishes
+                # ride a third per-edge view (CTRL_FLOOD targets)
+                flats.append(jnp.concatenate(
+                    [extend_wrap(injected[w], n_true, n_pad,
+                                 pln["p32"], pln["e32"])
+                     for w in range(W)]))
             krn = make_receive_update(
                 cfg, sc, n_true, receive_block, cdt, W,
                 track_promises=track_promises,
-                interpret=receive_interpret)
+                interpret=receive_interpret,
+                with_px=state.active is not None,
+                with_same_ip=params.cand_same_ip is not None)
             base0 = jnp.zeros((1,), dtype=jnp.uint32)
-            outs = krn(*head, base0, ctrl_flat, fresh_flat, adv_flat,
-                       *blocked)
+            outs = krn(*head, base0, *flats, *blocked)
+        px_word = None
+        if state.active is not None:
+            px_word, outs = outs[-1], outs[:-1]
         new_acq, mesh_new, backoff_new = outs[:3]
         n_gates = 7 if sc is not None else 2
         gates_new = tuple(outs[3:3 + n_gates])
         outs = outs[3 + n_gates:]
+        active_new = state.active
+        if state.active is not None:
+            # -- 4b mirror: PX-driven candidate refresh from the
+            # kernel's px_rot output (received PRUNEs/PRUNE-responses),
+            # then re-emit the targets gate row from the POST-rotation
+            # active set — the kernel emitted it before rotation was
+            # known (circular otherwise: rotation needs the kernel's
+            # handshake resolution)
+            if cfg.px_rotation:
+                rot = px_word if neg is None else px_word | neg
+                active_new = px_rotate(
+                    cfg, params, active=state.active, rot=rot,
+                    keep=mesh_new | fanout, sel_k=sel_k, tick=tick,
+                    salt=salt, n_stream=n_true)
+            tgt_idx = 5 if sc is not None else 0
+            tgt = gossip_targets_row(
+                cfg, sc, params, mesh=mesh_new, fanout=fanout,
+                mesh_b=None, active=active_new,
+                gossip_row=(gates_new[1] if sc is not None else None),
+                tick=tick + 1, salt=salt, n_stream=n_true, n=n_pad)
+            gates_new = (gates_new[:tgt_idx] + (tgt,)
+                         + gates_new[tgt_idx + 1:])
         have = state.have | new_acq
         recent = jax.lax.dynamic_update_slice_in_dim(
             state.recent, new_acq[None],
@@ -1385,7 +1482,7 @@ def make_gossip_step(cfg: GossipSimConfig,
             iwant_serves=(outs[4] if sc is not None
                           else state.iwant_serves),
             mesh_b=state.mesh_b, backoff_b=state.backoff_b,
-            active=state.active, gates=gates_new,
+            active=active_new, gates=gates_new,
             gates_fp=state.gates_fp)
         return new_state, delivered_now
 
@@ -1402,11 +1499,9 @@ def make_gossip_step(cfg: GossipSimConfig,
                 raise ValueError(
                     "pallas step needs make_gossip_sim(pad_to_block=...)")
             if (C > 16 or W == 0 or params.flood_proto is not None
-                    or paired or state.active is not None
-                    or params.cand_same_ip is not None
+                    or paired
                     or state.gates is None
                     or (sc is not None and (sc.track_p3
-                                            or sc.flood_publish
                                             # the kernel adds the baked
                                             # static P5+P6 term as-is;
                                             # a re-weighted config must
@@ -1417,8 +1512,7 @@ def make_gossip_step(cfg: GossipSimConfig,
                 raise ValueError(
                     "config not supported by the pallas step (needs "
                     "C<=16, W>=1, carried gates, matching static score "
-                    "weights, no flood_proto/track_p3/flood_publish/"
-                    "paired_topics/px_candidates/shared-IP gater)")
+                    "weights, no flood_proto/track_p3/paired_topics)")
         elif params.n_true is not None:
             raise ValueError(
                 "padded sim state requires the pallas step (XLA rolls "
@@ -1783,7 +1877,7 @@ def make_gossip_step(cfg: GossipSimConfig,
                 backoff_bits2=backoff_bits2, sub_all=sub_all,
                 payload_bits=payload_bits, gossip_bits=gossip_bits,
                 accept_bits=accept_bits, valid_w=valid_w, tick=tick,
-                salt=salt)
+                salt=salt, flood_bits=flood_bits, neg=sel_a["neg"])
 
         # behavioral broken-promise detection: a withholding peer's
         # IHAVE claims ids the receiver doesn't hold (the reference
@@ -2109,25 +2203,9 @@ def make_gossip_step(cfg: GossipSimConfig,
             keep = mesh | fanout
             if paired:
                 keep = keep | mesh_b_new
-            if params.cand_direct is not None:
-                # operator-pinned direct addresses are re-dialed
-                # unconditionally (gossipsub.go:1594-1616): PX churn
-                # never evicts them from the active set
-                keep = keep | params.cand_direct
-            deact = rot & state.active & ~keep
-            n_rot = popcount32(deact)
-            # exclude edges already folding in via keep, or a rotation
-            # slot would be wasted re-selecting one of them
-            pool_new = ~state.active & ~keep & params.cand_sub_bits & ALL
-            repl = jax.lax.cond(
-                jnp.any(n_rot > 0),
-                lambda: sel_k(pool_new, n_rot, u_spec(7)),
-                lambda: jnp.zeros_like(state.active))
-            # live connections are held addresses: an ACCEPTED inbound
-            # GRAFT teaches the grafter's address even if it wasn't in
-            # the active set (the dialer always knows the dialee), so
-            # mesh/fanout edges fold in and mesh ⊆ active is invariant
-            active_new = (state.active & ~deact) | repl | keep
+            active_new = px_rotate(
+                cfg, params, active=state.active, rot=rot, keep=keep,
+                sel_k=sel_k, tick=tick, salt=salt, n_stream=n_stream)
 
         # -- 5. score counter updates + decay ---------------------------
         # (array-level on purpose: a row-wise variant was measured 1.7x

@@ -76,6 +76,9 @@ CTRL_A = 4         # "no PRUNE would come back" (would-accept | silent)
 CTRL_ADV = 5       # raw IHAVE advert (incl. withheld promises);
 #                    CTRL_TGT is the DELIVERING advert, so
 #                    ADV & ~TGT marks a broken promise behaviorally
+CTRL_FLOOD = 6     # flood-publish target (own publishes to every
+#                    candidate above the publish threshold,
+#                    gossipsub.go:953-959; flood_publish configs)
 
 
 def _align_up(x: int, a: int) -> int:
@@ -163,7 +166,8 @@ def _expand(word: jnp.ndarray, c: int) -> jnp.ndarray:
 
 def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
                     counter_dtype, track_promises,
-                    force_extended=False, stream_n=None):
+                    force_extended=False, stream_n=None,
+                    with_px=False, with_same_ip=False):
     C = cfg.n_candidates
     B = block
     cinv = cfg.cinv
@@ -171,6 +175,8 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     pln = plan(n_true, offsets, block, force_extended=force_extended)
     p32, p8 = pln["p32"], pln["p8"]
     has_sc = sc is not None
+    flood_pub = has_sc and sc.flood_publish
+    n_pay = 3 if flood_pub else 2   # fresh, adv(, injected) views
     W = w_words
     Z = jnp.uint32(0)
     u1 = jnp.uint32(1)
@@ -188,6 +194,7 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     ctrl_hbm = nxt()
     fresh_hbm = nxt()
     adv_hbm = nxt()
+    inj_hbm = nxt() if flood_pub else None
     pay_ref = nxt() if has_sc else None
     gsp_ref = nxt() if has_sc else None
     acc_ref = nxt() if has_sc else None
@@ -208,6 +215,7 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
         static_ref = nxt()
         fd_in, inv_in, bp_in, tim_in = nxt(), nxt(), nxt(), nxt()
         iws_in = nxt()
+        sameip_ref = nxt() if with_same_ip else None
     out_acq = nxt()
     out_mesh = nxt()
     out_bo = nxt()
@@ -215,11 +223,13 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     if has_sc:
         out_fd, out_inv, out_bp, out_tim = nxt(), nxt(), nxt(), nxt()
         out_iws = nxt()
+    out_px = nxt() if with_px else None
     cbufs = [nxt() for _ in range(N_SLOTS)]
     # payload buffers: [slot][fresh w... adv w...], all separate 1-D
     # scratches (DMA into a row of a 2-D VMEM buffer hits sublane
     # alignment limits)
-    pbufs = [[nxt() for _ in range(2 * W)] for _ in range(N_SLOTS)]
+    pbufs = [[nxt() for _ in range(n_pay * W)]
+             for _ in range(N_SLOTS)]
     sems = nxt()
 
     i = pl.program_id(0)
@@ -244,24 +254,24 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
             sems.at[slot])
 
     def dma_pay(slot, j, k, w):
-        hbm = fresh_hbm if k == 0 else adv_hbm
+        hbm = (fresh_hbm, adv_hbm, inj_hbm)[k]
         start = w * lp + view_start(p_bases[j])
         return pltpu.make_async_copy(
             hbm.at[pl.ds(start, B + ALIGN32)],
             pbufs[slot][k * W + w],
-            sems.at[N_SLOTS + slot * 2 * W + k * W + w])
+            sems.at[N_SLOTS + slot * n_pay * W + k * W + w])
 
     def start_all(slot, j):
         dma_ctrl(slot, j).start()
         for w in range(W):
-            dma_pay(slot, j, 0, w).start()
-            dma_pay(slot, j, 1, w).start()
+            for k in range(n_pay):
+                dma_pay(slot, j, k, w).start()
 
     def wait_all(slot, j):
         dma_ctrl(slot, j).wait()
         for w in range(W):
-            dma_pay(slot, j, 0, w).wait()
-            dma_pay(slot, j, 1, w).wait()
+            for k in range(n_pay):
+                dma_pay(slot, j, k, w).wait()
 
     for j0 in range(min(N_SLOTS - 1, C)):
         start_all(j0 % N_SLOTS, j0)
@@ -305,6 +315,8 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
         d_r = (ctrl >> jnp.uint32(CTRL_DROP)) & u1
         a_r = (ctrl >> jnp.uint32(CTRL_A)) & u1
         adv_r = (ctrl >> jnp.uint32(CTRL_ADV)) & u1
+        if flood_pub:
+            fl_r = (ctrl >> jnp.uint32(CTRL_FLOOD)) & u1
         graft_recv = graft_recv | (g_r << jnp.uint32(j))
         prune_recv = prune_recv | (d_r << jnp.uint32(j))
         a_recv = a_recv | (a_r << jnp.uint32(j))
@@ -316,12 +328,21 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
             ok_g = ok_p & (((gsp_bits >> jnp.uint32(j)) & u1) != 0)
             fwd_on = fwd_on & ok_p
             gsp_on = gsp_on & ok_g
+        if flood_pub:
+            # flood-publish payload rides the same receiver payload
+            # gate as eager forwards (send_flood & gate_recv in the
+            # XLA combined path)
+            fl_on = (fl_r != 0) & ok_p
         fd_j = iv_j = pa_j = None
         for w in range(W):
             fresh_q = _flat_roll(pbufs[slot][w][...], p_deltas[j], B)
             adv_q = _flat_roll(pbufs[slot][W + w][...], p_deltas[j], B)
             got = (jnp.where(fwd_on, fresh_q, Z)
                    | jnp.where(gsp_on, adv_q, Z))
+            if flood_pub:
+                inj_q = _flat_roll(pbufs[slot][2 * W + w][...],
+                                   p_deltas[j], B)
+                got = got | jnp.where(fl_on, inj_q, Z)
             news = got & ~seen[w]
             heard[w] = heard[w] | news
             if has_sc:
@@ -365,6 +386,11 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     mesh = ((meshsel_ref[...] | accept) & ~prune_recv) & ~retract
     out_mesh[...] = mesh
     bo_trig = dropped | prune_recv | retract
+    if with_px:
+        # PX rotation triggers for the XLA epilogue: received
+        # PRUNEs / PRUNE-responses, the PX-record carriers
+        # (gossipsub.go:856-937)
+        out_px[...] = prune_recv | retract
 
     inj_a = inj_ref[...]
     # sub_all is the C-bit candidate gate (ALL or 0); for MESSAGE words
@@ -530,13 +556,27 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
         gossip_g = packb(score >= sc.gossip_threshold)
         pub_g = packb(score >= sc.publish_threshold)
         nonneg_g = packb(score >= 0)
-        # RED gater, per-edge stats (the shared-IP grouping is not
-        # supported by the kernel path — guarded at the step)
+        # RED gater (peer_gater.go:320-363); stats keyed by source
+        # IP when candidates share addresses (peer_gater.go:119-151
+        # — sibling sums over the cand_same_ip words), per-edge
+        # otherwise.  Pressure uses ungrouped totals, as in the
+        # XLA emission.
         inv_tot = inv_n.sum(axis=0)
         del_tot = fd_n.sum(axis=0)
         pressure = 16.0 * inv_tot / (1.0 + del_tot + 16.0 * inv_tot)
         gater_on = pressure > 0.33
-        goodput = (1.0 + fd_n) / (1.0 + fd_n + 16.0 * inv_n)
+        if with_same_ip:
+            inv_g = jnp.zeros_like(inv_n)
+            fd_g = jnp.zeros_like(fd_n)
+            for cc in range(C):
+                sib = _expand(sameip_ref[cc], C)
+                inv_g = inv_g + jnp.where(sib, inv_n[cc][None, :],
+                                          0.0)
+                fd_g = fd_g + jnp.where(sib, fd_n[cc][None, :],
+                                        0.0)
+        else:
+            inv_g, fd_g = inv_n, fd_n
+        goodput = (1.0 + fd_g) / (1.0 + fd_g + 16.0 * inv_g)
         u = lane_u(gseed_ref[0])
         ALLC = jnp.uint32((1 << C) - 1)
         gater_bits = packb(u < goodput) | jnp.where(gater_on, Z, ALLC)
@@ -594,7 +634,8 @@ def _ring_halo(x, p_l: int, p_r: int, axis_name: str, D: int):
 def sharded_receive(cfg, sc, n_true: int, block: int, counter_dtype,
                     w_words: int, track_promises: bool, interpret: bool,
                     mesh, axis_name: str,
-                    head, ctrl_rows, fresh_st, adv_st, blocked):
+                    head, ctrl_rows, fresh_st, adv_st, blocked,
+                    inj_st=None, with_px=False, with_same_ip=False):
     """Multi-chip kernel dispatch: shard_map over the peer axis, one
     pallas kernel invocation per shard with ring-halo exchange.
 
@@ -636,40 +677,44 @@ def sharded_receive(cfg, sc, n_true: int, block: int, counter_dtype,
     krn = make_receive_update(
         cfg, sc, S, block, counter_dtype, w_words,
         track_promises=track_promises, interpret=interpret,
-        force_extended=True, stream_n=n_true)
+        force_extended=True, stream_n=n_true, with_px=with_px,
+        with_same_ip=with_same_ip)
     n_head = len(head)
     n_gates = 7 if sc is not None else 2
+
+    n_flats = 3 if inj_st is None else 4
 
     def body(*ops):
         it = iter(ops)
         head_l = [next(it) for _ in range(n_head)]
-        ctrl = next(it)
-        fr = next(it)
-        ad = next(it)
+        flats = [next(it) for _ in range(n_flats)]
         blk = list(it)
         d = jax.lax.axis_index(axis_name)
         base = (jnp.uint32(S) * d.astype(jnp.uint32)).reshape(1)
-        ctrl_e = _ring_halo(ctrl, p8, p8 + e8, axis_name, D)
-        fr_e = _ring_halo(fr, p32, p32 + e32, axis_name, D)
-        ad_e = _ring_halo(ad, p32, p32 + e32, axis_name, D)
+        ctrl_e = _ring_halo(flats[0], p8, p8 + e8, axis_name, D)
+        pay_e = [_ring_halo(f, p32, p32 + e32, axis_name, D)
+                 for f in flats[1:]]
         return tuple(krn(*head_l, base, ctrl_e.reshape(-1),
-                         fr_e.reshape(-1), ad_e.reshape(-1), *blk))
+                         *[f.reshape(-1) for f in pay_e], *blk))
 
     shard_last = lambda x: P(*([None] * (x.ndim - 1)), axis_name)  # noqa: E731
     in_specs = tuple(
-        [P()] * n_head + [P(None, axis_name)] * 3
+        [P()] * n_head + [P(None, axis_name)] * n_flats
         + [shard_last(x) for x in blocked])
     out_specs = tuple(
         [P(None, axis_name), P(axis_name), P(None, axis_name)]
         + [P(axis_name)] * n_gates
-        + ([P(None, axis_name)] * 5 if sc is not None else []))
+        + ([P(None, axis_name)] * 5 if sc is not None else [])
+        + ([P(axis_name)] if with_px else []))
     try:
         fn = shard_map(body, mesh=mesh, in_specs=in_specs,
                        out_specs=out_specs, check_vma=False)
     except TypeError:          # older jax: check_rep instead
         fn = shard_map(body, mesh=mesh, in_specs=in_specs,
                        out_specs=out_specs, check_rep=False)
-    return fn(*head, ctrl_rows, fresh_st, adv_st, *blocked)
+    flats_in = [ctrl_rows, fresh_st, adv_st] + (
+        [] if inj_st is None else [inj_st])
+    return fn(*head, *flats_in, *blocked)
 
 
 def make_receive_update(cfg, sc, n_true: int, block: int,
@@ -677,24 +722,34 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
                         track_promises: bool = False,
                         interpret: bool = False,
                         force_extended: bool = False,
-                        stream_n: int | None = None):
+                        stream_n: int | None = None,
+                        with_px: bool = False,
+                        with_same_ip: bool = False):
     """Build the kernel caller.
 
     Operand order (args): [valid u32 [W] (sc only)], gseeds u32 [2]
     (tick+1 gater + targets lane seeds), base u32 [1] (global peer
     index of local position 0 — 0 off the sharded path), ctrl_flat u8
-    [C*L8], fresh_flat u32 [W*L32], adv_flat u32 [W*L32], [pay, gsp,
-    acc u32 [N_pad] (sc only)], sub, cand_sub, fanout, sybil-override,
+    [C*L8], fresh_flat u32 [W*L32], adv_flat u32 [W*L32],
+    [inj_flat u32 [W*L32] (flood_publish only)], [pay, gsp,
+    acc u32 [N_pad] (sc only)], sub, cand_sub, fanout, sybil-word,
     wa, bo2, grafts, dropped, meshsel u32 [N_pad], seen u32 [W, N_pad],
     injected
     [W, N_pad], backoff-remaining i16 [C, N_pad], [static f32
     [C, N_pad], fd, inv (counter_dtype), bp f32(/counter_dtype), tim
-    i16 [C, N_pad], iwant_serves i16 [C, N_pad] (sc only)].
+    i16 [C, N_pad], iwant_serves i16 [C, N_pad],
+    [cand_same_ip u32 [C, N_pad] (with_same_ip only)] (sc only)].
 
     Returns (new_acq [W, N_pad], mesh [N_pad], backoff [C, N_pad],
     *gates (G separate u32 [N_pad] words — compute_gates order),
-    [, fd, inv, bp, tim, iwant_serves]) where G = 7 scored / 2
-    unscored.
+    [, fd, inv, bp, tim, iwant_serves][, px_rot u32 [N_pad]
+    (with_px only — received PRUNEs/PRUNE-responses for the XLA
+    rotation epilogue)]) where G = 7 scored / 2 unscored.
+
+    NOTE the px caveat: with_px configs get their TARGETS gate row
+    re-emitted by the XLA epilogue from the post-rotation active set
+    (_finish_kernel); the row this kernel writes is pre-rotation and
+    is overwritten.
 
     Sharded use (models/gossipsub.py sharded kernel path): build with
     ``n_true`` = the LOCAL shard extent, ``force_extended=True`` (halo
@@ -704,6 +759,7 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
     """
     C = cfg.n_candidates
     has_sc = sc is not None
+    n_pay = 3 if (has_sc and sc.flood_publish) else 2
     pln = plan(n_true, cfg.offsets, block, force_extended=force_extended)
     n_pad, grid = pln["n_pad"], pln["grid"]
     B = block
@@ -713,7 +769,8 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
         _receive_kernel, cfg=cfg, sc=sc, block=block, n_true=n_true,
         w_words=w_words, counter_dtype=counter_dtype,
         track_promises=track_promises, force_extended=force_extended,
-        stream_n=stream_n)
+        stream_n=stream_n, with_px=with_px,
+        with_same_ip=with_same_ip)
 
     b1 = lambda: pl.BlockSpec((B,), lambda i: (i,))  # noqa: E731
     bw = lambda: pl.BlockSpec((W, B), lambda i: (0, i))  # noqa: E731
@@ -725,7 +782,8 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))  # valid
     in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))      # gseeds
     in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))      # base
-    in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * 3      # flats
+    # flats: ctrl, fresh, adv(, injected under flood_publish)
+    in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * (1 + n_pay)
     if has_sc:
         in_specs += [b1(), b1(), b1()]        # pay, gsp, acc
     # sub, cand_sub, fanout, sybil, wa, bo2, grafts, dropped, meshsel
@@ -734,6 +792,8 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
     in_specs += [bc()]                        # backoff in
     if has_sc:
         in_specs += [bc()] * 6    # static, fd, inv, bp, tim, iws
+        if with_same_ip:
+            in_specs += [bc()]    # cand_same_ip sibling words
 
     out_shape = ([
         jax.ShapeDtypeStruct((W, n_pad), jnp.uint32),       # new_acq
@@ -751,11 +811,15 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
             jax.ShapeDtypeStruct((C, n_pad), jnp.int16),      # iws
         ]
         out_specs += [bc()] * 5
+    if with_px:
+        out_shape += [jax.ShapeDtypeStruct((n_pad,), jnp.uint32)]
+        out_specs += [b1()]
 
     scratch = (
         [pltpu.VMEM((B + ALIGN8,), jnp.uint8)] * N_SLOTS
-        + [pltpu.VMEM((B + ALIGN32,), jnp.uint32)] * (N_SLOTS * 2 * W)
-        + [pltpu.SemaphoreType.DMA((N_SLOTS * (1 + 2 * W),))]
+        + [pltpu.VMEM((B + ALIGN32,), jnp.uint32)]
+        * (N_SLOTS * n_pay * W)
+        + [pltpu.SemaphoreType.DMA((N_SLOTS * (1 + n_pay * W),))]
     )
 
     return pl.pallas_call(

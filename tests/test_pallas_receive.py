@@ -16,7 +16,8 @@ import go_libp2p_pubsub_tpu.models.gossipsub as gs
 def _build(n, n_topics, C, m, *, score, sybil_frac=0.0, spam=False,
            iwant_spam=False, graft_flood=False, invalid_frac=0.0,
            breaker_frac=0.0, pad_block=None, seed=3, exact_k=False,
-           direct=False):
+           direct=False, flood_publish=False, px=None,
+           shared_ip=False):
     rng = np.random.default_rng(seed)
     offsets = gs.make_gossip_offsets(n_topics, C, n, seed=seed)
     cfg = gs.GossipSimConfig(offsets=offsets, n_topics=n_topics,
@@ -26,7 +27,8 @@ def _build(n, n_topics, C, m, *, score, sybil_frac=0.0, spam=False,
                              binomial_gossip_sampling=not exact_k)
     sc = (gs.ScoreSimConfig(sybil_ihave_spam=spam,
                             sybil_iwant_spam=iwant_spam,
-                            sybil_graft_flood=graft_flood)
+                            sybil_graft_flood=graft_flood,
+                            flood_publish=flood_publish)
           if score else None)
     idx = np.arange(n)
     subs = np.zeros((n, n_topics), dtype=bool)
@@ -49,6 +51,16 @@ def _build(n, n_topics, C, m, *, score, sybil_frac=0.0, spam=False,
         for c_ in (0, cfg.cinv[0]):
             de[:, c_] = f | np.roll(f, -int(offsets[c_]))
         kw["direct_edges"] = de
+    if px is not None:
+        kw["px_candidates"] = px
+    if shared_ip:
+        ip = np.arange(n)
+        ip[::7] = 0              # broad sharing: cand_same_ip built
+        kw["peer_ip"] = ip
+        kw.setdefault("app_score",
+                      rng.normal(0, 0.1, n).astype(np.float32))
+        kw.setdefault("sybil", np.zeros(n, dtype=bool))
+        kw.setdefault("msg_invalid", np.zeros(m, dtype=bool))
     params, state = gs.make_gossip_sim(
         cfg, subs, topic, origin, ticks, score_cfg=sc,
         pad_to_block=pad_block, **kw)
@@ -146,6 +158,36 @@ def test_kernel_matches_xla_v11_iwant_flood():
     assert np.asarray(out_x.iwant_serves).max() > 0
 
 
+@pytest.mark.parametrize("score", [True, False])
+def test_kernel_matches_xla_px_rotation(score):
+    """PX candidate rotation on the kernel path: the kernel emits the
+    px_rot word (received PRUNEs/PRUNE-responses), the XLA epilogue
+    rotates the active set and re-emits the targets row from the
+    POST-rotation actives — trajectories must stay bit-identical, and
+    rotation must actually happen."""
+    n = 900
+    cfg, sc, out_x, out_k = _run_pair(n, 4, 8, 8, 30, 128, score=score,
+                                      px=7)
+    _assert_state_equal(out_x, out_k, n, sc)
+    np.testing.assert_array_equal(np.asarray(out_x.active),
+                                  np.asarray(out_k.active)[:n])
+    # non-vacuous: the active set rotated somewhere along the run
+    cfg2, sc2, p2, s2 = _build(n, 4, 8, 8, score=score, px=7)
+    assert (np.asarray(out_x.active) != np.asarray(s2.active)).any()
+
+
+def test_kernel_matches_xla_flood_publish():
+    """WithFloodPublish on the kernel path: own publishes ride a third
+    per-edge payload view to every candidate above the publish
+    threshold (CTRL_FLOOD), gated by the receiver's payload gate like
+    eager forwards — bit-identical to the XLA combined path."""
+    n = 900
+    cfg, sc, out_x, out_k = _run_pair(n, 4, 8, 8, 30, 128, score=True,
+                                      flood_publish=True)
+    _assert_state_equal(out_x, out_k, n, sc)
+    assert np.asarray(out_x.have).any()
+
+
 def test_kernel_matches_xla_direct_peers():
     """Operator-pinned direct peers on the kernel path: the direct
     accept/payload bypass and graft exclusions all happen on the gate
@@ -209,19 +251,27 @@ def test_padded_state_requires_kernel():
         step(params, state)
 
 
-@pytest.mark.parametrize("score", [True, False])
-def test_sharded_kernel_matches_single_device(score):
+@pytest.mark.parametrize("score,loaded", [(True, False), (False, False),
+                                          (True, True)])
+def test_sharded_kernel_matches_single_device(score, loaded):
     """The shard_map multi-chip kernel dispatch (ring-halo exchange +
     per-shard kernel, ops/pallas/receive.sharded_receive) must produce
     the SAME trajectory as the single-device kernel, bit for bit — the
     in-kernel uniform streams draw by global peer index and the halos
-    reproduce extend_wrap's mod-n indexing."""
+    reproduce extend_wrap's mod-n indexing.  The ``loaded`` variant
+    additionally exercises the PX, flood-publish, and shared-IP
+    plumbing (extra flats / operands / outputs) under shard_map."""
     import jax
     from jax.sharding import Mesh
 
     n, D, block = 2048, 8, 128
     assert n % (D * block) == 0
-    cfg, sc, p_k, s_k = _build(n, 4, 8, 8, score=score, pad_block=block)
+    extra = (dict(px=7, flood_publish=True, shared_ip=True)
+             if loaded else {})
+    cfg, sc, p_k, s_k = _build(n, 4, 8, 8, score=score, pad_block=block,
+                               **extra)
+    if loaded:
+        assert p_k.cand_same_ip is not None and s_k.active is not None
     assert p_k.subscribed.shape[0] == n          # n_pad == n_true
     step_1 = gs.make_gossip_step(cfg, sc, receive_block=block,
                                  receive_interpret=True)
@@ -239,6 +289,55 @@ def test_sharded_kernel_matches_single_device(score):
     # non-vacuous: the run formed meshes and moved messages
     assert np.asarray(gs.mesh_degrees(out_1)).mean() > 0
     assert np.asarray(out_1.have).any()
+
+
+def test_kernel_matches_xla_shared_ip_gater():
+    """Shared-IP gater grouping on the kernel path (peer_gater.go:
+    119-151): the in-kernel gate emission sums gater stats over
+    same-IP siblings exactly as the XLA emission.  Topology mirrors
+    test_gater_shared_ip_fate: arithmetic offsets so IP siblings are
+    co-candidates of common victims, invalid spam creates real gater
+    pressure."""
+    n, t = 640, 2
+    offsets = tuple(2 * k for k in range(1, 9)) + tuple(
+        -2 * k for k in range(1, 9))
+    cfg = gs.GossipSimConfig(offsets=offsets, n_topics=t,
+                             d=3, d_lo=2, d_hi=6, d_score=2, d_out=1,
+                             d_lazy=2, gossip_factor=0.25,
+                             backoff_ticks=8)
+    rng = np.random.default_rng(3)
+    idx = np.arange(n)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[idx, idx % t] = True
+    spam = np.zeros(n, dtype=bool)
+    spam[0:120:12] = True
+    ip = np.arange(n)
+    ip[2:122:12] = ip[0:120:12]      # clean twins share spammer IPs
+    m = 12
+    sp_ids = np.flatnonzero(spam)
+    origin = np.concatenate([np.repeat(sp_ids, 1),
+                             rng.integers(0, n, m - len(sp_ids))])
+    topic = (origin % t).astype(np.int64)
+    invalid = np.array([True] * len(sp_ids)
+                       + [False] * (m - len(sp_ids)))
+    ticks = np.sort(rng.integers(0, 8, m)).astype(np.int32)
+    sc = gs.ScoreSimConfig(ip_colocation_factor_weight=0.0)
+
+    def build(pad):
+        return gs.make_gossip_sim(
+            cfg, subs, topic, origin, ticks, score_cfg=sc,
+            sybil=spam, msg_invalid=invalid, peer_ip=ip,
+            pad_to_block=pad)
+
+    p_x, s_x = build(None)
+    p_k, s_k = build(128)
+    assert p_x.cand_same_ip is not None
+    out_x = gs.gossip_run(p_x, s_x, 25, gs.make_gossip_step(cfg, sc))
+    out_k = gs.gossip_run(p_k, s_k, 25, gs.make_gossip_step(
+        cfg, sc, receive_block=128, receive_interpret=True))
+    _assert_state_equal(out_x, out_k, n, sc)
+    # non-vacuous: invalid traffic accrued somewhere
+    assert np.asarray(out_x.scores.invalid_deliveries).max() > 0
 
 
 def test_kernel_matches_xla_aligned_wrap():

@@ -134,9 +134,8 @@ def _bench_gossip(metric, n, t, score_cfg, sybil_frac=None,
     rng = np.random.default_rng(0)
     block = 8192
     if kernel:
-        # kernel coverage: everything except paired mode (attacks, PX,
-        # shared-IP gater, direct peers all parity-pinned)
-        assert not paired, "kernel bench path: no paired configs yet"
+        # kernel coverage: the full config matrix (paired, attacks,
+        # PX, shared-IP gater, direct peers — all parity-pinned)
 
         # the pallas step wants n divisible by the u8 tile alignment
         # (4096) and the block (aligned-wrap plan) — round UP so the
@@ -262,11 +261,13 @@ def bench_gossipsub_v11_multitopic():
     import go_libp2p_pubsub_tpu.models.gossipsub as gs
     on_accel = jax.devices()[0].platform != "cpu"
     n = 1_000_000 if on_accel else 100_000
+    kernel = (os.environ.get("GOSSIP_BENCH_KERNEL", "0") == "1"
+              and on_accel)
     _bench_gossip(
-        f"gossipsub_v11_multitopic_{n}peers_100topics_2per_peer"
-        "_heartbeats_per_sec",
+        "gossipsub_v11_multitopic_{n}peers_100topics_2per_peer"
+        + ("_kernel" if kernel else "") + "_heartbeats_per_sec",
         n, 100, gs.ScoreSimConfig(topic_score_cap=50.0), paired=True,
-        baseline=10_000.0)
+        baseline=10_000.0, kernel=kernel)
 
 
 def bench_gossipsub_v11_adversarial():
@@ -302,14 +303,17 @@ def bench_gossipsub_v11_everything():
     import go_libp2p_pubsub_tpu.models.gossipsub as gs
     on_accel = jax.devices()[0].platform != "cpu"
     n = 1_000_000 if on_accel else 100_000
+    kernel = (os.environ.get("GOSSIP_BENCH_KERNEL", "0") == "1"
+              and on_accel)
     _bench_gossip(
-        f"gossipsub_v11_everything_{n}peers_heartbeats_per_sec",
+        "gossipsub_v11_everything_{n}peers"
+        + ("_kernel" if kernel else "") + "_heartbeats_per_sec",
         n, 100, gs.ScoreSimConfig(topic_score_cap=50.0,
                                   sybil_ihave_spam=True,
                                   sybil_iwant_spam=True),
         sybil_frac=0.2, gate_honest=True, paired=True,
         px_candidates=14, with_direct=True, shared_sybil_ips=True,
-        baseline=10_000.0)
+        baseline=10_000.0, kernel=kernel)
 
 
 BENCHES = {

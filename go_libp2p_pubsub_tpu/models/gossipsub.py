@@ -1311,14 +1311,17 @@ def make_gossip_step(cfg: GossipSimConfig,
                        dropped, mesh_sel, a_sent, would_accept,
                        backoff_bits2, sub_all, payload_bits,
                        gossip_bits, accept_bits, valid_w, tick, salt,
-                       flood_bits=None, neg=None):
+                       flood_bits=None, neg=None, sel_b=None,
+                       fresh_b=None):
         """Pallas path: one mega-kernel does the payload receive,
         handshake resolution, and per-edge counter/backoff updates in
         a single HBM pass over the [C, N] state (ops/pallas/receive)."""
         from ..ops.pallas.receive import (
             CTRL_A, CTRL_DROP, CTRL_FLOOD, CTRL_GRAFT,
-            CTRL_OUT, CTRL_ADV, CTRL_TGT, extend_wrap,
-            make_receive_update, plan, sharded_receive)
+            CTRL_OUT, CTRL_ADV, CTRL_TGT,
+            CTRL2_A_B, CTRL2_DROP_B, CTRL2_GRAFT_B, CTRL2_OUT_B,
+            extend_wrap, make_receive_update, n_gate_rows, plan,
+            sharded_receive)
 
         n_true = params.n_true
         n_pad = params.subscribed.shape[0]
@@ -1350,6 +1353,26 @@ def make_gossip_step(cfg: GossipSimConfig,
                 b = b | (bit_of(flood_bits, c)
                          << jnp.uint32(CTRL_FLOOD))
             ctrl_rows.append(b.astype(jnp.uint8))
+        ctrl2_rows = None
+        if paired:
+            # second ctrl byte: the SLOT-B flags of the same edge
+            out_b_bits = state.mesh_b
+            if params.cand_direct is not None:
+                # direct peers are eager-forward targets on every
+                # topic (gossipsub.go:945-950)
+                out_b_bits = out_b_bits | (params.cand_direct
+                                           & params.cand_sub_bits)
+            ctrl2_rows = []
+            for c in range(C):
+                b2 = ((bit_of(out_b_bits, c)
+                       << jnp.uint32(CTRL2_OUT_B))
+                      | (bit_of(sel_b["grafts"], c)
+                         << jnp.uint32(CTRL2_GRAFT_B))
+                      | (bit_of(sel_b["dropped"], c)
+                         << jnp.uint32(CTRL2_DROP_B))
+                      | (bit_of(sel_b["a_sent"], c)
+                         << jnp.uint32(CTRL2_A_B)))
+                ctrl2_rows.append(b2.astype(jnp.uint8))
         seen_st = jnp.stack([state.have[w] | injected[w]
                              for w in range(W)])
         inj_st = jnp.stack(injected)
@@ -1371,13 +1394,22 @@ def make_gossip_step(cfg: GossipSimConfig,
             blocked += [payload_bits, gossip_bits, accept_bits]
         blocked += [sub_all, params.cand_sub_bits, fanout, syb_mask,
                     would_accept, backoff_bits2, grafts, dropped,
-                    mesh_sel, seen_st, inj_st, state.backoff]
+                    mesh_sel]
+        if paired:
+            blocked += [sel_b["would_accept"],
+                        sel_b["backoff_bits2"], sel_b["grafts"],
+                        sel_b["dropped"], sel_b["mesh_sel"]]
+        blocked += [seen_st, inj_st, state.backoff]
+        if paired:
+            blocked += [state.backoff_b]
         if sc is not None:
             s0 = state.scores
             blocked += [params.cand_static_score,
                         s0.first_deliveries, s0.invalid_deliveries,
-                        s0.behaviour_penalty, s0.time_in_mesh,
-                        state.iwant_serves]
+                        s0.behaviour_penalty, s0.time_in_mesh]
+            if paired:
+                blocked += [s0.time_in_mesh_b]
+            blocked += [state.iwant_serves]
             if params.cand_same_ip is not None:
                 blocked += [params.cand_same_ip]
         if shard_mesh is not None:
@@ -1399,27 +1431,34 @@ def make_gossip_step(cfg: GossipSimConfig,
                 inj_st=(jnp.stack(injected) if flood_bits is not None
                         else None),
                 with_px=state.active is not None,
-                with_same_ip=params.cand_same_ip is not None)
+                with_same_ip=params.cand_same_ip is not None,
+                ctrl2_rows=(jnp.stack(ctrl2_rows) if paired
+                            else None),
+                freshb_st=(jnp.stack(fresh_b) if paired else None))
         else:
-            ctrl_flat = jnp.concatenate(
-                [extend_wrap(r, n_true, n_pad, pln["p8"], pln["e8"])
-                 for r in ctrl_rows])
-            fresh_flat = jnp.concatenate(
-                [extend_wrap(fresh[w], n_true, n_pad, pln["p32"],
-                             pln["e32"])
-                 for w in range(W)])
-            adv_flat = jnp.concatenate(
-                [extend_wrap(adv[w], n_true, n_pad, pln["p32"],
-                             pln["e32"])
-                 for w in range(W)])
-            flats = [ctrl_flat, fresh_flat, adv_flat]
-            if flood_bits is not None:
-                # flood-publish payload: the sender's own due publishes
-                # ride a third per-edge view (CTRL_FLOOD targets)
-                flats.append(jnp.concatenate(
-                    [extend_wrap(injected[w], n_true, n_pad,
+            def flat8(rows):
+                return jnp.concatenate(
+                    [extend_wrap(r, n_true, n_pad, pln["p8"],
+                                 pln["e8"]) for r in rows])
+
+            def flat32(rows):
+                return jnp.concatenate(
+                    [extend_wrap(rows[w], n_true, n_pad,
                                  pln["p32"], pln["e32"])
-                     for w in range(W)]))
+                     for w in range(W)])
+
+            flats = [flat8(ctrl_rows)]
+            if paired:
+                flats.append(flat8(ctrl2_rows))
+            flats.append(flat32(fresh))
+            if paired:
+                flats.append(flat32(fresh_b))
+            flats.append(flat32(adv))
+            if flood_bits is not None:
+                # flood-publish payload: the sender's own due
+                # publishes ride their own per-edge view
+                # (CTRL_FLOOD targets)
+                flats.append(flat32(injected))
             krn = make_receive_update(
                 cfg, sc, n_true, receive_block, cdt, W,
                 track_promises=track_promises,
@@ -1431,10 +1470,20 @@ def make_gossip_step(cfg: GossipSimConfig,
         px_word = None
         if state.active is not None:
             px_word, outs = outs[-1], outs[:-1]
-        new_acq, mesh_new, backoff_new = outs[:3]
-        n_gates = 7 if sc is not None else 2
-        gates_new = tuple(outs[3:3 + n_gates])
-        outs = outs[3 + n_gates:]
+        it_o = iter(outs)
+        new_acq = next(it_o)
+        mesh_new = next(it_o)
+        mesh_b_new = next(it_o) if paired else None
+        backoff_new = next(it_o)
+        backoff_b_new = next(it_o) if paired else None
+        gates_new = tuple(
+            next(it_o) for _ in range(n_gate_rows(sc is not None,
+                                                  paired)))
+        if sc is not None:
+            fd_o, inv_o, bp_o = next(it_o), next(it_o), next(it_o)
+            tim_o = next(it_o)
+            tim_b_o = next(it_o) if paired else None
+            iws_o = next(it_o)
         active_new = state.active
         if state.active is not None:
             # -- 4b mirror: PX-driven candidate refresh from the
@@ -1445,14 +1494,17 @@ def make_gossip_step(cfg: GossipSimConfig,
             # handshake resolution)
             if cfg.px_rotation:
                 rot = px_word if neg is None else px_word | neg
+                keep = mesh_new | fanout
+                if paired:
+                    keep = keep | mesh_b_new
                 active_new = px_rotate(
                     cfg, params, active=state.active, rot=rot,
-                    keep=mesh_new | fanout, sel_k=sel_k, tick=tick,
+                    keep=keep, sel_k=sel_k, tick=tick,
                     salt=salt, n_stream=n_true)
             tgt_idx = 5 if sc is not None else 0
             tgt = gossip_targets_row(
                 cfg, sc, params, mesh=mesh_new, fanout=fanout,
-                mesh_b=None, active=active_new,
+                mesh_b=mesh_b_new, active=active_new,
                 gossip_row=(gates_new[1] if sc is not None else None),
                 tick=tick + 1, salt=salt, n_stream=n_true, n=n_pad)
             gates_new = (gates_new[:tgt_idx] + (tgt,)
@@ -1469,19 +1521,19 @@ def make_gossip_step(cfg: GossipSimConfig,
         scores = state.scores
         if sc is not None:
             scores = ScoreState(
-                time_in_mesh=outs[3], first_deliveries=outs[0],
+                time_in_mesh=tim_o, first_deliveries=fd_o,
                 mesh_deliveries=state.scores.mesh_deliveries,
                 mesh_failure_penalty=state.scores.mesh_failure_penalty,
-                invalid_deliveries=outs[1], behaviour_penalty=outs[2],
-                time_in_mesh_b=None)
+                invalid_deliveries=inv_o, behaviour_penalty=bp_o,
+                time_in_mesh_b=tim_b_o)
         new_state = GossipState(
             mesh=mesh_new, fanout=fanout, last_pub=last_pub,
             backoff=backoff_new, have=have, recent=recent,
             first_tick=first_tick, scores=scores, key=state.key,
             tick=tick + 1,
-            iwant_serves=(outs[4] if sc is not None
+            iwant_serves=(iws_o if sc is not None
                           else state.iwant_serves),
-            mesh_b=state.mesh_b, backoff_b=state.backoff_b,
+            mesh_b=mesh_b_new, backoff_b=backoff_b_new,
             active=active_new, gates=gates_new,
             gates_fp=state.gates_fp)
         return new_state, delivered_now
@@ -1499,7 +1551,6 @@ def make_gossip_step(cfg: GossipSimConfig,
                 raise ValueError(
                     "pallas step needs make_gossip_sim(pad_to_block=...)")
             if (C > 16 or W == 0 or params.flood_proto is not None
-                    or paired
                     or state.gates is None
                     or (sc is not None and (sc.track_p3
                                             # the kernel adds the baked
@@ -1512,7 +1563,7 @@ def make_gossip_step(cfg: GossipSimConfig,
                 raise ValueError(
                     "config not supported by the pallas step (needs "
                     "C<=16, W>=1, carried gates, matching static score "
-                    "weights, no flood_proto/track_p3/paired_topics)")
+                    "weights, no flood_proto/track_p3)")
         elif params.n_true is not None:
             raise ValueError(
                 "padded sim state requires the pallas step (XLA rolls "
@@ -1867,9 +1918,16 @@ def make_gossip_step(cfg: GossipSimConfig,
         would_accept, a_sent = sel_a["would_accept"], sel_a["a_sent"]
 
         if kernel_on:
+            # PX rotation folds in BOTH slots' negative-score drops
+            # (XLA 4b does the same)
+            neg_px = sel_a["neg"]
+            if paired and sel_b["neg"] is not None:
+                neg_px = (sel_b["neg"] if neg_px is None
+                          else neg_px | sel_b["neg"])
             return _finish_kernel(
                 params=params, state=state, fanout=fanout,
-                last_pub=last_pub, injected=injected, fresh=fresh,
+                last_pub=last_pub, injected=injected,
+                fresh=(fresh_a if paired else fresh),
                 adv=adv, targets=targets, withhold=withhold,
                 out_bits=out_bits,
                 grafts=grafts, dropped=dropped, mesh_sel=mesh_sel,
@@ -1877,7 +1935,9 @@ def make_gossip_step(cfg: GossipSimConfig,
                 backoff_bits2=backoff_bits2, sub_all=sub_all,
                 payload_bits=payload_bits, gossip_bits=gossip_bits,
                 accept_bits=accept_bits, valid_w=valid_w, tick=tick,
-                salt=salt, flood_bits=flood_bits, neg=sel_a["neg"])
+                salt=salt, flood_bits=flood_bits, neg=neg_px,
+                sel_b=sel_b,
+                fresh_b=(fresh_b if paired else None))
 
         # behavioral broken-promise detection: a withholding peer's
         # IHAVE claims ids the receiver doesn't hold (the reference
@@ -2164,12 +2224,18 @@ def make_gossip_step(cfg: GossipSimConfig,
             gb, pb, _ = raw_transfers(sel_b, skip_a=True)
             # both slots' A masks ride ONE pair-packed transfer
             # (paired mode enforces C <= 16); skipped when neither slot
-            # grafted (retract = grafts & ~a is zero regardless)
+            # grafted (retract = grafts & ~a is zero regardless).
+            # Each half is masked to the C candidate bits BEFORE
+            # packing: the scored a_sent carries ~accept_bits, whose
+            # bits >= 16 would otherwise pollute the slot-B half and
+            # silently disable every slot-B-informed retraction
+            # (caught by the kernel-parity suite, which transfers the
+            # per-slot A bits individually and retracts correctly)
             a_both = jax.lax.cond(
                 jnp.any((sel_a["grafts"] | sel_b["grafts"]) != 0),
                 lambda: transfer_bits(
-                    sel_a["a_sent"] | (sel_b["a_sent"]
-                                       << jnp.uint32(16)),
+                    (sel_a["a_sent"] & ALL)
+                    | ((sel_b["a_sent"] & ALL) << jnp.uint32(16)),
                     cfg, pair=True),
                 lambda: jnp.zeros_like(sel_a["grafts"]))
             aa = a_both & ALL

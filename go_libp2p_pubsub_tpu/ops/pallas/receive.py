@@ -40,7 +40,13 @@ splits into an aligned base plus a static in-VMEM lane-roll remainder
 
 The kernel is semantically identical to the XLA combined path (same op
 order, so counter bits match exactly); tests pin kernel==XLA
-trajectories on shared seeds.
+trajectories on shared seeds across the FULL config matrix — v1.0,
+v1.1, both gossip-repair attacks, graft flood, promise breakers,
+exact-k sampling, direct peers, PX rotation, shared-IP gater, flood
+publish, and paired-topic mode (second ctrl byte + slot-B payload view
++ static cross-slot routing + per-slot P1) — including the everything-
+on configuration.  Remaining refusals: C > 16, W == 0, mixed-protocol
+(flood_proto), track_p3, and re-weighted static score bakes.
 
 Multi-chip: ``sharded_receive`` runs the kernel under ``shard_map``
 over the peer axis — each shard halo-exchanges max|offset| of boundary
@@ -80,9 +86,26 @@ CTRL_FLOOD = 6     # flood-publish target (own publishes to every
 #                    candidate above the publish threshold,
 #                    gossipsub.go:953-959; flood_publish configs)
 
+# second ctrl byte (paired-topic mode): the SLOT-B flags of the same
+# edge — per-topic meshes keep their own handshake (gossipsub.go:135)
+CTRL2_OUT_B = 0    # slot-B eager-forward member (mesh_b | direct)
+CTRL2_GRAFT_B = 1  # slot-B GRAFT sent
+CTRL2_DROP_B = 2   # slot-B PRUNE sent
+CTRL2_A_B = 3      # slot-B "no PRUNE would come back"
+
 
 def _align_up(x: int, a: int) -> int:
     return ((x + a - 1) // a) * a
+
+
+def n_gate_rows(scored: bool, paired: bool) -> int:
+    """Canonical carried-gate-word count (compute_gates order):
+    scored (accept, gossip, publish, nonneg, payload, targets,
+    backoff(, backoff_b)); unscored (targets, backoff(, backoff_b)).
+    The kernel's emitted rows and every output-unpacking site must
+    use THIS count — a desynchronized copy mis-slices everything
+    downstream."""
+    return (7 if scored else 2) + (1 if paired else 0)
 
 
 def plan(n_true: int, offsets, block: int, force_extended: bool = False):
@@ -175,8 +198,14 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     pln = plan(n_true, offsets, block, force_extended=force_extended)
     p32, p8 = pln["p32"], pln["p8"]
     has_sc = sc is not None
+    paired = cfg.paired_topics
     flood_pub = has_sc and sc.flood_publish
-    n_pay = 3 if flood_pub else 2   # fresh, adv(, injected) views
+    # payload views per edge: fresh(, fresh_b), adv(, injected)
+    n_pay = 2 + (1 if paired else 0) + (1 if flood_pub else 0)
+    IDX_FB = 1                       # fresh_b view index (paired)
+    IDX_ADV = 2 if paired else 1
+    IDX_INJ = n_pay - 1              # injected view (flood_pub)
+    n_ctrl = 2 if paired else 1
     W = w_words
     Z = jnp.uint32(0)
     u1 = jnp.uint32(1)
@@ -192,7 +221,9 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     #                         path: each shard's kernel must draw
     #                         the GLOBAL peer's uniform stream)
     ctrl_hbm = nxt()
+    ctrl2_hbm = nxt() if paired else None
     fresh_hbm = nxt()
+    freshb_hbm = nxt() if paired else None
     adv_hbm = nxt()
     inj_hbm = nxt() if flood_pub else None
     pay_ref = nxt() if has_sc else None
@@ -208,23 +239,32 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     graft_ref = nxt()
     drop_ref = nxt()
     meshsel_ref = nxt()
+    if paired:
+        wab_ref, bo2b_ref = nxt(), nxt()
+        graftb_ref, dropb_ref, meshselb_ref = nxt(), nxt(), nxt()
     seen_ref = nxt()
     inj_ref = nxt()
     bo_in = nxt()
+    bob_in = nxt() if paired else None
     if has_sc:
         static_ref = nxt()
         fd_in, inv_in, bp_in, tim_in = nxt(), nxt(), nxt(), nxt()
+        timb_in = nxt() if paired else None
         iws_in = nxt()
         sameip_ref = nxt() if with_same_ip else None
     out_acq = nxt()
     out_mesh = nxt()
+    out_mesh_b = nxt() if paired else None
     out_bo = nxt()
-    out_gates = [nxt() for _ in range(7 if has_sc else 2)]
+    out_bo_b = nxt() if paired else None
+    out_gates = [nxt() for _ in range(n_gate_rows(has_sc, paired))]
     if has_sc:
         out_fd, out_inv, out_bp, out_tim = nxt(), nxt(), nxt(), nxt()
+        out_tim_b = nxt() if paired else None
         out_iws = nxt()
     out_px = nxt() if with_px else None
     cbufs = [nxt() for _ in range(N_SLOTS)]
+    c2bufs = [nxt() for _ in range(N_SLOTS)] if paired else None
     # payload buffers: [slot][fresh w... adv w...], all separate 1-D
     # scratches (DMA into a row of a 2-D VMEM buffer hits sublane
     # alignment limits)
@@ -247,28 +287,39 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
         # stays tile-aligned because n is a multiple of the alignment
         return (i * B + base) % n_true if aligned else i * B + base
 
-    def dma_ctrl(slot, j):
+    if paired:
+        pay_srcs = (fresh_hbm, freshb_hbm, adv_hbm, inj_hbm)
+    else:
+        pay_srcs = (fresh_hbm, adv_hbm, inj_hbm)
+
+    def dma_ctrl(slot, j, second=False):
         start = cinv[j] * lc + view_start(c_bases[j])
+        hbm = ctrl2_hbm if second else ctrl_hbm
+        buf = (c2bufs if second else cbufs)[slot]
         return pltpu.make_async_copy(
-            ctrl_hbm.at[pl.ds(start, B + ALIGN8)], cbufs[slot],
-            sems.at[slot])
+            hbm.at[pl.ds(start, B + ALIGN8)], buf,
+            sems.at[slot + (N_SLOTS if second else 0)])
 
     def dma_pay(slot, j, k, w):
-        hbm = (fresh_hbm, adv_hbm, inj_hbm)[k]
         start = w * lp + view_start(p_bases[j])
         return pltpu.make_async_copy(
-            hbm.at[pl.ds(start, B + ALIGN32)],
+            pay_srcs[k].at[pl.ds(start, B + ALIGN32)],
             pbufs[slot][k * W + w],
-            sems.at[N_SLOTS + slot * n_pay * W + k * W + w])
+            sems.at[N_SLOTS * n_ctrl
+                    + slot * n_pay * W + k * W + w])
 
     def start_all(slot, j):
         dma_ctrl(slot, j).start()
+        if paired:
+            dma_ctrl(slot, j, second=True).start()
         for w in range(W):
             for k in range(n_pay):
                 dma_pay(slot, j, k, w).start()
 
     def wait_all(slot, j):
         dma_ctrl(slot, j).wait()
+        if paired:
+            dma_ctrl(slot, j, second=True).wait()
         for w in range(W):
             for k in range(n_pay):
                 dma_pay(slot, j, k, w).wait()
@@ -300,6 +351,10 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     prune_recv = jnp.zeros((B,), jnp.uint32)
     a_recv = jnp.zeros((B,), jnp.uint32)
     broken_recv = jnp.zeros((B,), jnp.uint32)
+    if paired:
+        graft_recv_b = jnp.zeros((B,), jnp.uint32)
+        prune_recv_b = jnp.zeros((B,), jnp.uint32)
+        a_recv_b = jnp.zeros((B,), jnp.uint32)
 
     for j in range(C):
         if j + N_SLOTS - 1 < C:
@@ -317,9 +372,32 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
         adv_r = (ctrl >> jnp.uint32(CTRL_ADV)) & u1
         if flood_pub:
             fl_r = (ctrl >> jnp.uint32(CTRL_FLOOD)) & u1
-        graft_recv = graft_recv | (g_r << jnp.uint32(j))
-        prune_recv = prune_recv | (d_r << jnp.uint32(j))
-        a_recv = a_recv | (a_r << jnp.uint32(j))
+        if paired:
+            ctrl2 = _flat_roll(c2bufs[slot][...].astype(jnp.uint32),
+                               c_deltas[j], B)
+            m_fb = (ctrl2 >> jnp.uint32(CTRL2_OUT_B)) & u1
+            g2 = (ctrl2 >> jnp.uint32(CTRL2_GRAFT_B)) & u1
+            d2 = (ctrl2 >> jnp.uint32(CTRL2_DROP_B)) & u1
+            a2 = (ctrl2 >> jnp.uint32(CTRL2_A_B)) & u1
+            # cross-slot routing (STATIC per edge): on edges whose
+            # offset is an odd multiple of T/2, the topic p calls
+            # slot X lives in the partner's OTHER slot
+            # (class(p+o) = class(p) + T/2) — sender slot-A control
+            # pertains to MY slot B there (models/gossipsub.py
+            # cross-slot section)
+            odd = (offsets[j] % cfg.n_topics) != 0
+            ga, da, aa = ((g2, d2, a2) if odd else (g_r, d_r, a_r))
+            gb, db, ab = ((g_r, d_r, a_r) if odd else (g2, d2, a2))
+            graft_recv = graft_recv | (ga << jnp.uint32(j))
+            prune_recv = prune_recv | (da << jnp.uint32(j))
+            a_recv = a_recv | (aa << jnp.uint32(j))
+            graft_recv_b = graft_recv_b | (gb << jnp.uint32(j))
+            prune_recv_b = prune_recv_b | (db << jnp.uint32(j))
+            a_recv_b = a_recv_b | (ab << jnp.uint32(j))
+        else:
+            graft_recv = graft_recv | (g_r << jnp.uint32(j))
+            prune_recv = prune_recv | (d_r << jnp.uint32(j))
+            a_recv = a_recv | (a_r << jnp.uint32(j))
 
         fwd_on = m_f != 0
         gsp_on = m_g != 0
@@ -333,14 +411,23 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
             # gate as eager forwards (send_flood & gate_recv in the
             # XLA combined path)
             fl_on = (fl_r != 0) & ok_p
+        if paired:
+            fb_on = m_fb != 0
+            if has_sc:
+                fb_on = fb_on & ok_p
         fd_j = iv_j = pa_j = None
         for w in range(W):
             fresh_q = _flat_roll(pbufs[slot][w][...], p_deltas[j], B)
-            adv_q = _flat_roll(pbufs[slot][W + w][...], p_deltas[j], B)
+            adv_q = _flat_roll(pbufs[slot][IDX_ADV * W + w][...],
+                               p_deltas[j], B)
             got = (jnp.where(fwd_on, fresh_q, Z)
                    | jnp.where(gsp_on, adv_q, Z))
+            if paired:
+                fb_q = _flat_roll(pbufs[slot][IDX_FB * W + w][...],
+                                  p_deltas[j], B)
+                got = got | jnp.where(fb_on, fb_q, Z)
             if flood_pub:
-                inj_q = _flat_roll(pbufs[slot][2 * W + w][...],
+                inj_q = _flat_roll(pbufs[slot][IDX_INJ * W + w][...],
                                    p_deltas[j], B)
                 got = got | jnp.where(fl_on, inj_q, Z)
             news = got & ~seen[w]
@@ -376,6 +463,9 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
         accb = acc_ref[...]
         graft_recv = graft_recv & accb
         prune_recv = prune_recv & accb
+        if paired:
+            graft_recv_b = graft_recv_b & accb
+            prune_recv_b = prune_recv_b & accb
     wa = wa_ref[...]
     bo2 = bo2_ref[...]
     grafts = graft_ref[...]
@@ -386,11 +476,23 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     mesh = ((meshsel_ref[...] | accept) & ~prune_recv) & ~retract
     out_mesh[...] = mesh
     bo_trig = dropped | prune_recv | retract
+    px_val = prune_recv | retract
+    if paired:
+        viol_b = graft_recv_b & bo2b_ref[...]
+        accept_b = graft_recv_b & wab_ref[...]
+        grafts_b = graftb_ref[...]
+        retract_b = grafts_b & ~a_recv_b
+        mesh_b = ((meshselb_ref[...] | accept_b)
+                  & ~prune_recv_b) & ~retract_b
+        out_mesh_b[...] = mesh_b
+        bo_trig_b = dropb_ref[...] | prune_recv_b | retract_b
+        px_val = px_val | prune_recv_b | retract_b
     if with_px:
         # PX rotation triggers for the XLA epilogue: received
         # PRUNEs / PRUNE-responses, the PX-record carriers
-        # (gossipsub.go:856-937)
-        out_px[...] = prune_recv | retract
+        # (gossipsub.go:856-937; paired: either slot's, as in the
+        # XLA px_a | px_b union)
+        out_px[...] = px_val
 
     inj_a = inj_ref[...]
     # sub_all is the C-bit candidate gate (ALL or 0); for MESSAGE words
@@ -405,6 +507,12 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     bo_new = jnp.where(_expand(bo_trig, C), cfg.backoff_ticks - 1,
                        jnp.maximum(bo32 - 1, 0))
     out_bo[...] = bo_new.astype(jnp.int16)
+    if paired:
+        bob32 = bob_in[...].astype(jnp.int32)
+        bob_new = jnp.where(_expand(bo_trig_b, C),
+                            cfg.backoff_ticks - 1,
+                            jnp.maximum(bob32 - 1, 0))
+        out_bo_b[...] = bob_new.astype(jnp.int16)
 
     # packed-row helper matching ops.graph.pack_rows bit-for-bit
     # (mosaic can't reduce unsigned ints: sum i32, bit-cast after)
@@ -415,6 +523,7 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
             axis=0, dtype=jnp.int32).astype(jnp.uint32)
 
     bo_gate = packb(bo_new > 0)
+    bo_gate_b = packb(bob_new > 0) if paired else None
 
     def lane_u(seed):
         """Phase uniform for tick+1, matching ops.graph.lane_uniform
@@ -434,6 +543,10 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
         # (k/|elig|) fast path, or the exact uniform k-subset matching
         # ops.graph.select_k_bits bit-for-bit (rank-compare in VMEM)
         elig = csub_ref[...] & ~mesh & ~fan_ref[...] & sub_all
+        if paired:
+            # shared gossip stream across the two topic slots
+            # (compute_gates): exclude slot-B mesh members too
+            elig = elig & ~mesh_b
         if gossip_g is not None:
             elig = elig & gossip_g
         n_el = jax.lax.population_count(elig).astype(jnp.int32)
@@ -485,6 +598,12 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
             in_mesh, jnp.minimum(tim32 + 1, 32766),
             0).astype(jnp.int16)
         out_tim[...] = tim_new
+        if paired:
+            timb32 = timb_in[...].astype(jnp.int32)
+            timb_new = jnp.where(
+                _expand(mesh_b, C), jnp.minimum(timb32 + 1, 32766),
+                0).astype(jnp.int16)
+            out_tim_b[...] = timb_new
         zrow = jnp.zeros((B,), jnp.int32)
         fd_stack = jnp.stack(
             [zrow if r is None else r for r in fd_cnt]).astype(
@@ -500,6 +619,10 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
                      sc.invalid_message_deliveries_decay)
         out_inv[...] = inv_new
         bp = f32(bp_in[...]) + _expand(viol, C).astype(jnp.float32)
+        if paired:
+            # per-topic backoff violations each count
+            # (gossipsub.go:747-765)
+            bp = bp + _expand(viol_b, C).astype(jnp.float32)
         if track_promises:
             bp = bp + _expand(broken_recv, C).astype(jnp.float32)
         bp_new = dk(bp, sc.behaviour_penalty_decay,
@@ -546,6 +669,13 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
                       * fd_n
                       + (w_t * sc.invalid_message_deliveries_weight)
                       * inv_n * inv_n)
+        if paired:
+            # per-slot P1 for the SECOND topic (compute_scores)
+            timb_n = timb_new.astype(jnp.int32).astype(jnp.float32)
+            topic_part = topic_part + (
+                w_t * sc.time_in_mesh_weight
+                * jnp.minimum(timb_n / sc.time_in_mesh_quantum,
+                              sc.time_in_mesh_cap))
         if sc.topic_score_cap > 0:
             topic_part = jnp.minimum(topic_part, sc.topic_score_cap)
         bp_ex = jnp.maximum(0.0, bp_new.astype(jnp.float32)
@@ -580,14 +710,17 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
         u = lane_u(gseed_ref[0])
         ALLC = jnp.uint32((1 << C) - 1)
         gater_bits = packb(u < goodput) | jnp.where(gater_on, Z, ALLC)
-        for ref, val in zip(out_gates,
-                            [accept_g, gossip_g, pub_g, nonneg_g,
-                             accept_g & gater_bits,
-                             targets_gate(gossip_g), bo_gate]):
+        rows = [accept_g, gossip_g, pub_g, nonneg_g,
+                accept_g & gater_bits, targets_gate(gossip_g), bo_gate]
+        if paired:
+            rows.append(bo_gate_b)
+        for ref, val in zip(out_gates, rows):
             ref[...] = val
     else:
         out_gates[0][...] = targets_gate(None)
         out_gates[1][...] = bo_gate
+        if paired:
+            out_gates[2][...] = bo_gate_b
 
 
 def _ring_halo(x, p_l: int, p_r: int, axis_name: str, D: int):
@@ -635,7 +768,8 @@ def sharded_receive(cfg, sc, n_true: int, block: int, counter_dtype,
                     w_words: int, track_promises: bool, interpret: bool,
                     mesh, axis_name: str,
                     head, ctrl_rows, fresh_st, adv_st, blocked,
-                    inj_st=None, with_px=False, with_same_ip=False):
+                    inj_st=None, with_px=False, with_same_ip=False,
+                    ctrl2_rows=None, freshb_st=None):
     """Multi-chip kernel dispatch: shard_map over the peer axis, one
     pallas kernel invocation per shard with ring-halo exchange.
 
@@ -680,9 +814,22 @@ def sharded_receive(cfg, sc, n_true: int, block: int, counter_dtype,
         force_extended=True, stream_n=n_true, with_px=with_px,
         with_same_ip=with_same_ip)
     n_head = len(head)
-    n_gates = 7 if sc is not None else 2
+    paired = cfg.paired_topics
+    n_gates = n_gate_rows(sc is not None, paired)
+    n_ctrl = 2 if paired else 1
 
-    n_flats = 3 if inj_st is None else 4
+    # flats order mirrors the kernel: ctrl(, ctrl2), fresh(, fresh_b),
+    # adv(, injected) — first n_ctrl are u8 (p8 halos), rest u32 (p32)
+    flats_in = [ctrl_rows]
+    if paired:
+        flats_in.append(ctrl2_rows)
+    flats_in.append(fresh_st)
+    if paired:
+        flats_in.append(freshb_st)
+    flats_in.append(adv_st)
+    if inj_st is not None:
+        flats_in.append(inj_st)
+    n_flats = len(flats_in)
 
     def body(*ops):
         it = iter(ops)
@@ -691,10 +838,12 @@ def sharded_receive(cfg, sc, n_true: int, block: int, counter_dtype,
         blk = list(it)
         d = jax.lax.axis_index(axis_name)
         base = (jnp.uint32(S) * d.astype(jnp.uint32)).reshape(1)
-        ctrl_e = _ring_halo(flats[0], p8, p8 + e8, axis_name, D)
+        ctrl_e = [_ring_halo(f, p8, p8 + e8, axis_name, D)
+                  for f in flats[:n_ctrl]]
         pay_e = [_ring_halo(f, p32, p32 + e32, axis_name, D)
-                 for f in flats[1:]]
-        return tuple(krn(*head_l, base, ctrl_e.reshape(-1),
+                 for f in flats[n_ctrl:]]
+        return tuple(krn(*head_l, base,
+                         *[f.reshape(-1) for f in ctrl_e],
                          *[f.reshape(-1) for f in pay_e], *blk))
 
     shard_last = lambda x: P(*([None] * (x.ndim - 1)), axis_name)  # noqa: E731
@@ -702,9 +851,12 @@ def sharded_receive(cfg, sc, n_true: int, block: int, counter_dtype,
         [P()] * n_head + [P(None, axis_name)] * n_flats
         + [shard_last(x) for x in blocked])
     out_specs = tuple(
-        [P(None, axis_name), P(axis_name), P(None, axis_name)]
+        [P(None, axis_name), P(axis_name)]
+        + ([P(axis_name)] if paired else [])              # mesh_b
+        + [P(None, axis_name)] * (2 if paired else 1)     # backoff(,_b)
         + [P(axis_name)] * n_gates
-        + ([P(None, axis_name)] * 5 if sc is not None else [])
+        + ([P(None, axis_name)] * (6 if paired else 5)
+           if sc is not None else [])                     # counters
         + ([P(axis_name)] if with_px else []))
     try:
         fn = shard_map(body, mesh=mesh, in_specs=in_specs,
@@ -712,8 +864,6 @@ def sharded_receive(cfg, sc, n_true: int, block: int, counter_dtype,
     except TypeError:          # older jax: check_rep instead
         fn = shard_map(body, mesh=mesh, in_specs=in_specs,
                        out_specs=out_specs, check_rep=False)
-    flats_in = [ctrl_rows, fresh_st, adv_st] + (
-        [] if inj_st is None else [inj_st])
     return fn(*head, *flats_in, *blocked)
 
 
@@ -759,7 +909,10 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
     """
     C = cfg.n_candidates
     has_sc = sc is not None
-    n_pay = 3 if (has_sc and sc.flood_publish) else 2
+    paired = cfg.paired_topics
+    flood_pub = has_sc and sc.flood_publish
+    n_pay = 2 + (1 if paired else 0) + (1 if flood_pub else 0)
+    n_ctrl = 2 if paired else 1
     pln = plan(n_true, cfg.offsets, block, force_extended=force_extended)
     n_pad, grid = pln["n_pad"], pln["grid"]
     B = block
@@ -776,31 +929,43 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
     bw = lambda: pl.BlockSpec((W, B), lambda i: (0, i))  # noqa: E731
     bc = lambda: pl.BlockSpec((C, B), lambda i: (0, i))  # noqa: E731
 
-    n_gates = 7 if has_sc else 2
+    n_gates = n_gate_rows(has_sc, paired)
     in_specs = []
     if has_sc:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))  # valid
     in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))      # gseeds
     in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))      # base
-    # flats: ctrl, fresh, adv(, injected under flood_publish)
-    in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * (1 + n_pay)
+    # flats: ctrl(, ctrl2), fresh(, fresh_b), adv(, injected)
+    in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * (n_ctrl + n_pay)
     if has_sc:
         in_specs += [b1(), b1(), b1()]        # pay, gsp, acc
     # sub, cand_sub, fanout, sybil, wa, bo2, grafts, dropped, meshsel
-    in_specs += [b1()] * 9
+    # (+ the slot-B handshake words in paired mode)
+    in_specs += [b1()] * (14 if paired else 9)
     in_specs += [bw(), bw()]                  # seen, injected
-    in_specs += [bc()]                        # backoff in
+    in_specs += [bc()] * (2 if paired else 1)  # backoff(, backoff_b)
     if has_sc:
-        in_specs += [bc()] * 6    # static, fd, inv, bp, tim, iws
+        # static, fd, inv, bp, tim(, tim_b), iws
+        in_specs += [bc()] * (7 if paired else 6)
         if with_same_ip:
             in_specs += [bc()]    # cand_same_ip sibling words
 
-    out_shape = ([
+    out_shape = [
         jax.ShapeDtypeStruct((W, n_pad), jnp.uint32),       # new_acq
         jax.ShapeDtypeStruct((n_pad,), jnp.uint32),         # mesh
-        jax.ShapeDtypeStruct((C, n_pad), jnp.int16),        # backoff
-    ] + [jax.ShapeDtypeStruct((n_pad,), jnp.uint32)] * n_gates)
-    out_specs = [bw(), b1(), bc()] + [b1() for _ in range(n_gates)]
+    ]
+    out_specs = [bw(), b1()]
+    if paired:
+        out_shape += [jax.ShapeDtypeStruct((n_pad,), jnp.uint32)]
+        out_specs += [b1()]                                 # mesh_b
+    out_shape += [jax.ShapeDtypeStruct((C, n_pad), jnp.int16)]
+    out_specs += [bc()]                                     # backoff
+    if paired:
+        out_shape += [jax.ShapeDtypeStruct((C, n_pad), jnp.int16)]
+        out_specs += [bc()]                                 # backoff_b
+    out_shape += [jax.ShapeDtypeStruct((n_pad,), jnp.uint32)
+                  ] * n_gates
+    out_specs += [b1() for _ in range(n_gates)]
     if has_sc:
         out_shape += [
             jax.ShapeDtypeStruct((C, n_pad), counter_dtype),  # fd
@@ -808,18 +973,25 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
             jax.ShapeDtypeStruct((C, n_pad),
                                  jnp.dtype(sc.bp_dtype)),     # bp
             jax.ShapeDtypeStruct((C, n_pad), jnp.int16),      # tim
-            jax.ShapeDtypeStruct((C, n_pad), jnp.int16),      # iws
         ]
-        out_specs += [bc()] * 5
+        out_specs += [bc()] * 4
+        if paired:
+            out_shape += [jax.ShapeDtypeStruct((C, n_pad),
+                                               jnp.int16)]    # tim_b
+            out_specs += [bc()]
+        out_shape += [jax.ShapeDtypeStruct((C, n_pad), jnp.int16)]
+        out_specs += [bc()]                                   # iws
     if with_px:
         out_shape += [jax.ShapeDtypeStruct((n_pad,), jnp.uint32)]
         out_specs += [b1()]
 
     scratch = (
-        [pltpu.VMEM((B + ALIGN8,), jnp.uint8)] * N_SLOTS
+        [pltpu.VMEM((B + ALIGN8,), jnp.uint8)]
+        * (N_SLOTS * n_ctrl)
         + [pltpu.VMEM((B + ALIGN32,), jnp.uint32)]
         * (N_SLOTS * n_pay * W)
-        + [pltpu.SemaphoreType.DMA((N_SLOTS * (1 + n_pay * W),))]
+        + [pltpu.SemaphoreType.DMA((N_SLOTS
+                                    * (n_ctrl + n_pay * W),))]
     )
 
     return pl.pallas_call(

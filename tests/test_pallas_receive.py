@@ -243,6 +243,108 @@ def test_kernel_matches_xla_v11_graft_flood():
     _assert_state_equal(out_x, out_k, n, sc)
 
 
+def _build_paired(n, t, C, m, *, score, pad_block=None, seed=2,
+                  sybil_frac=0.0, spam=False, iwant_spam=False,
+                  invalid_frac=0.0, px=None, direct=False,
+                  shared_ip=False, flood_publish=False):
+    rng = np.random.default_rng(seed)
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, C, n, seed=seed, paired=True),
+        n_topics=t, paired_topics=True,
+        d=3, d_lo=2, d_hi=6, d_score=2, d_out=1, d_lazy=2,
+        gossip_factor=0.25, backoff_ticks=8)
+    own = np.arange(n) % t
+    second = (own + t // 2) % t
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), own] = True
+    subs[np.arange(n), second] = True
+    topic = rng.integers(0, t, m)
+    members = [np.flatnonzero((own == tau) | (second == tau))
+               for tau in range(t)]
+    origin = np.array([rng.choice(members[tau]) for tau in topic])
+    ticks = np.sort(rng.integers(0, 12, m)).astype(np.int32)
+    sc = (gs.ScoreSimConfig(topic_score_cap=25.0,
+                            sybil_ihave_spam=spam,
+                            sybil_iwant_spam=iwant_spam,
+                            flood_publish=flood_publish)
+          if score else None)
+    kw = {}
+    if score:
+        sybil = rng.random(n) < sybil_frac
+        kw = dict(sybil=sybil,
+                  msg_invalid=rng.random(m) < invalid_frac,
+                  app_score=rng.normal(0, 0.1, n).astype(np.float32))
+        if shared_ip:
+            ip = np.arange(n)
+            sid = np.flatnonzero(sybil)
+            ip[sid] = n + np.arange(len(sid)) // 2
+            kw["peer_ip"] = ip
+    if direct:
+        f = (np.arange(n) % 29) == 0
+        de = np.zeros((n, C), dtype=bool)
+        for c_ in (0, cfg.cinv[0]):
+            de[:, c_] = f | np.roll(f, -int(cfg.offsets[c_]))
+        kw["direct_edges"] = de
+    if px is not None:
+        kw["px_candidates"] = px
+    params, state = gs.make_gossip_sim(
+        cfg, subs, topic, origin, ticks, score_cfg=sc,
+        pad_to_block=pad_block, **kw)
+    return cfg, sc, params, state
+
+
+@pytest.mark.parametrize("score", [True, False])
+def test_kernel_matches_xla_paired(score):
+    """Paired-topic mode on the kernel path: two meshes/backoffs per
+    peer, slot-B payload view, second ctrl byte with STATIC cross-slot
+    routing, per-slot P1 and the 8-row gate emission — all bit-identical
+    to the XLA combined path."""
+    n = 928
+    cfg, sc, p_x, s_x = _build_paired(n, 4, 8, 10, score=score)
+    cfg2, sc2, p_k, s_k = _build_paired(n, 4, 8, 10, score=score,
+                                        pad_block=128)
+    out_x = gs.gossip_run(p_x, s_x, 30, gs.make_gossip_step(cfg, sc))
+    out_k = gs.gossip_run(p_k, s_k, 30, gs.make_gossip_step(
+        cfg2, sc2, receive_block=128, receive_interpret=True))
+    _assert_state_equal(out_x, out_k, n, sc)
+    np.testing.assert_array_equal(np.asarray(out_x.mesh_b),
+                                  np.asarray(out_k.mesh_b)[:n])
+    np.testing.assert_array_equal(np.asarray(out_x.backoff_b),
+                                  np.asarray(out_k.backoff_b)[:, :n])
+    if sc is not None:
+        np.testing.assert_array_equal(
+            np.asarray(out_x.scores.time_in_mesh_b),
+            np.asarray(out_k.scores.time_in_mesh_b)[:, :n])
+    # both slot meshes formed
+    assert np.asarray(out_x.mesh_b).any()
+    assert np.asarray(out_x.have).any()
+
+
+def test_kernel_matches_xla_everything_on():
+    """The EVERYTHING-ON configuration on the kernel path: paired
+    topics + PX rotation + direct peers + shared-IP sybils + both
+    gossip-repair attacks + flood publish, bit-identical to the XLA
+    path — the full feature matrix in one kernel invocation."""
+    n = 928
+    kw = dict(score=True, sybil_frac=0.15, spam=True, iwant_spam=True,
+              invalid_frac=0.25, px=7, direct=True, shared_ip=True,
+              flood_publish=True)
+    cfg, sc, p_x, s_x = _build_paired(n, 4, 8, 12, **kw)
+    cfg2, sc2, p_k, s_k = _build_paired(n, 4, 8, 12, pad_block=128,
+                                        **kw)
+    assert p_x.cand_same_ip is not None and p_x.cand_direct is not None
+    assert s_x.active is not None
+    out_x = gs.gossip_run(p_x, s_x, 16, gs.make_gossip_step(cfg, sc))
+    out_k = gs.gossip_run(p_k, s_k, 16, gs.make_gossip_step(
+        cfg2, sc2, receive_block=128, receive_interpret=True))
+    _assert_state_equal(out_x, out_k, n, sc)
+    np.testing.assert_array_equal(np.asarray(out_x.mesh_b),
+                                  np.asarray(out_k.mesh_b)[:n])
+    np.testing.assert_array_equal(np.asarray(out_x.active),
+                                  np.asarray(out_k.active)[:n])
+    assert np.asarray(out_x.iwant_serves).max() > 0
+
+
 def test_padded_state_requires_kernel():
     cfg, sc, params, state = _build(900, 4, 8, 8, score=True,
                                     pad_block=128)

@@ -21,5 +21,6 @@ run python bench.py
 run python bench_suite.py gossipsub_v10 gossipsub_v11_multitopic \
     gossipsub_v11_adversarial gossipsub_v11_everything
 run env GOSSIP_BENCH_KERNEL=1 python bench_suite.py gossipsub_v11 \
-    gossipsub_v11_adversarial
+    gossipsub_v11_adversarial gossipsub_v11_multitopic \
+    gossipsub_v11_everything
 echo DONE | tee -a "$log"

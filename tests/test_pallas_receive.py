@@ -345,6 +345,25 @@ def test_kernel_matches_xla_everything_on():
     assert np.asarray(out_x.iwant_serves).max() > 0
 
 
+def test_gate_row_count_single_source():
+    """compute_gates' emitted row count must equal the canonical
+    n_gate_rows() the kernel and every unpacking site use, for all four
+    (scored, paired) combinations — the counts live in two files and
+    this pins them in lockstep."""
+    from go_libp2p_pubsub_tpu.ops.pallas.receive import n_gate_rows
+
+    for paired in (False, True):
+        for score in (False, True):
+            if paired:
+                cfg, sc, params, state = _build_paired(
+                    256, 4, 8, 4, score=score)
+            else:
+                cfg, sc, params, state = _build(256, 4, 8, 4,
+                                                score=score)
+            assert len(state.gates) == n_gate_rows(score, paired), \
+                (score, paired, len(state.gates))
+
+
 def test_padded_state_requires_kernel():
     cfg, sc, params, state = _build(900, 4, 8, 8, score=True,
                                     pad_block=128)

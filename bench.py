@@ -13,6 +13,14 @@ holds the platform-specific sync idiom: only host transfers of dependent
 values are trustworthy sync points on this platform — device completion
 futures resolve early).  `python bench_suite.py` runs all five BASELINE
 configs.
+
+TPU-unavailable resilience: the axon tunnel has been observed wedged for
+16+ hours at a stretch, during which any backend init HANGS indefinitely
+(round 4's driver bench recorded rc != 0 and no number at all).  The
+backend is therefore probed in a bounded SUBPROCESS first; if it hangs
+or errors, the bench re-executes itself pinned to CPU and emits the
+clearly-distinguishable CPU-scale row (100k peers in the metric name)
+instead of dying — a labeled fallback number beats an empty artifact.
 """
 
 from __future__ import annotations
@@ -22,8 +30,29 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import bench_suite  # noqa: E402
+if os.environ.get("BENCH_FORCE_CPU") == "1":
+    # the environment's site hook pins JAX_PLATFORMS to the TPU tunnel;
+    # only a jax.config update before backend init overrides it
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 
 if __name__ == "__main__":
+    from go_libp2p_pubsub_tpu.utils.accel import tpu_reachable
+
+    # None = this process already holds a backend (never probe then);
+    # proceed with it as-is
+    if (os.environ.get("BENCH_FORCE_CPU") != "1"
+            and tpu_reachable(
+                float(os.environ.get("BENCH_PROBE_TIMEOUT", "360")))
+            is False):
+        print("TPU backend unreachable; re-running on CPU (fallback "
+              "row, reduced scale)", file=sys.stderr, flush=True)
+        env = dict(os.environ, BENCH_FORCE_CPU="1")
+        os.execve(sys.executable,
+                  [sys.executable, os.path.abspath(__file__)], env)
+
+    import bench_suite  # noqa: E402
+
     bench_suite.bench_gossipsub_v11()

@@ -1,0 +1,51 @@
+"""TPU-availability probe shared by the driver entry points
+(bench.py, __graft_entry__.entry).
+
+The axon tunnel, when wedged, HANGS backend init indefinitely (observed
+16+ hours at a stretch); probing in a bounded SUBPROCESS means a hung
+probe can be abandoned without hanging — or killing — the caller.
+
+Discipline (PERF_NOTES.md tunnel notes): NEVER probe while this process
+already holds an initialized backend — a second concurrent tunnel
+client is the documented wedge trigger.  ``tpu_reachable`` returns
+``None`` in that case; callers must use the live backend as-is.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+
+def backend_initialized() -> bool:
+    """True iff THIS process already initialized a jax backend."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return False
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False
+
+
+def tpu_reachable(timeout_s: float = 300.0) -> bool | None:
+    """Probe whether a non-CPU backend comes up within ``timeout_s``.
+
+    Returns True/False from a bounded subprocess probe, or ``None``
+    when this process already holds an initialized backend (probing
+    would make a second concurrent tunnel client — never do that)."""
+    if backend_initialized():
+        return None
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print('PLAT', jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s)
+    except Exception:
+        return False
+    for line in (r.stdout or "").splitlines():
+        if line.startswith("PLAT "):
+            return line.split(" ", 1)[1].strip() != "cpu"
+    return False

@@ -132,13 +132,24 @@ def main():
     pm_k = gs.gossip_run(pp_k, ps_k, 90, pstep_k)
     fields = []
     ok = _cmp(pm_x, pm_k, np_, fields)
-    for fname in ("mesh_b", "backoff_b"):
-        a = np.asarray(getattr(pm_x, fname))
-        b = np.asarray(getattr(pm_k, fname))[..., :np_]
+    for fname, arr in (("mesh_b", pm_x.mesh_b),
+                       ("backoff_b", pm_x.backoff_b),
+                       ("time_in_mesh_b", pm_x.scores.time_in_mesh_b)):
+        b_arr = (pm_k.scores.time_in_mesh_b
+                 if fname == "time_in_mesh_b"
+                 else getattr(pm_k, fname))
+        a = np.asarray(arr)
+        b = np.asarray(b_arr)[..., :np_]
         same = bool(np.array_equal(a, b))
         fields.append({"field": fname, "identical": same})
         ok &= same
+    # liveness: a dead paired sim (nothing delivered, no slot-B mesh)
+    # would compare identical vacuously
+    live = (bool(np.asarray(pm_x.have).any())
+            and bool(np.asarray(pm_x.mesh_b).any()))
+    ok &= live
     report["checks"].append({"config": "paired", "tick": 90, "ok": ok,
+                             "paired_sim_live": live,
                              "fields": fields})
     ok_all &= ok
 

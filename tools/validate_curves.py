@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""BASELINE.md metric 2: reachability-vs-hops curves, core vs sim,
+averaged over many independent runs.
+
+The CI gates (tests/test_interop_replay.py) compare SINGLE core runs
+against the deterministic sim under a wide envelope (0.075) because one
+60-host asyncio cluster carries ±0.02 of run-to-run timing noise.  The
+BASELINE claim ("curves matching within 1%") is a statement about MEAN
+curves, so this tool runs K independent (topology, publishers, mesh
+seed) samples on BOTH sides, averages, and records the achieved
+per-hop delta as a committed artifact.
+
+CPU-only (the core is asyncio; the sim runs fine on the CPU backend).
+
+Usage: python tools/validate_curves.py [K] [out.json]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    from go_libp2p_pubsub_tpu.interop import (
+        mean_reach_fraction, reach_by_hops_from_trace,
+        run_core_gossipsub)
+
+    K = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    out_path = sys.argv[2] if len(sys.argv) > 2 else "CURVES_r05.json"
+    n, C, M = 60, 8, 24
+    HOPS = 12
+
+    sim_curves, core_curves = [], []
+    degrees = []
+    incomplete = 0
+    for k in range(K):
+        offsets = gs.make_gossip_offsets(1, C, n, seed=3 + k)
+        rng = np.random.default_rng(100 + k)
+        publishers = list(rng.integers(0, n, M))
+
+        cfg = gs.GossipSimConfig(
+            offsets=offsets, n_topics=1, d=3, d_lo=2, d_hi=6,
+            d_score=2, d_out=1, d_lazy=0, gossip_factor=0.0)
+        subs = np.ones((n, 1), dtype=bool)
+        params, state = gs.make_gossip_sim(
+            cfg, subs, np.zeros(M, np.int64), np.array(publishers),
+            np.full(M, 90, np.int32), seed=k)
+        out = gs.gossip_run(params, state, 110,
+                            gs.make_gossip_step(cfg, None))
+        sim_mean = mean_reach_fraction(
+            np.asarray(gs.reach_by_hops(params, out, HOPS)), n)
+        assert sim_mean[-1] == 1.0, f"sim incomplete at k={k}"
+        sim_deg = float(np.asarray(gs.mesh_degrees(out)).mean())
+
+        # mean mesh degree DRIVES spread speed: curves are only
+        # comparable when the two meshes settled to the same degree
+        # (the CI gate requires |core_deg - sim_deg| < 0.6 for the
+        # same reason); under-warmed core clusters sit mid-GRAFT-burst
+        # with inflated degrees and systematically faster curves
+        core_mean = core_deg = None
+        for warm_s, settle_s in ((2.0, 1.2), (3.5, 2.0), (5.0, 2.5)):
+            run = run_core_gossipsub(offsets, n, publishers,
+                                     warm_s=warm_s, settle_s=settle_s)
+            cm = mean_reach_fraction(
+                reach_by_hops_from_trace(run, HOPS + 1), n)
+            cd = float(np.mean(run.extra["mesh_degrees"]))
+            if cm[-1] == 1.0 and abs(cd - sim_deg) < 0.6:
+                core_mean, core_deg = cm, cd
+                break
+        if core_mean is None:
+            incomplete += 1       # drop the PAIR, keep sides matched
+            print(f"run {k}: core incomplete/degree-mismatched "
+                  f"(core_deg {cd:.2f} vs sim {sim_deg:.2f}), dropped",
+                  file=sys.stderr)
+            continue
+        degrees.append((core_deg, sim_deg))
+        sim_curves.append(sim_mean)
+        # sim hop h aligns with core hop h+1 (the sim's publish tick
+        # includes the first forwarding hop)
+        core_curves.append(core_mean[1:HOPS + 1])
+        print(f"run {k}: ok (deg core {core_deg:.2f} sim {sim_deg:.2f})",
+              flush=True)
+
+    sim_avg = np.mean(sim_curves, axis=0)
+    core_avg = np.mean(core_curves, axis=0)
+    delta = np.abs(core_avg - sim_avg)
+    report = {
+        "config": {"n_hosts": n, "C": C, "msgs_per_run": M,
+                   "runs": len(sim_curves), "dropped": incomplete},
+        "mean_mesh_degree": {
+            "core": round(float(np.mean([d[0] for d in degrees])), 3),
+            "sim": round(float(np.mean([d[1] for d in degrees])), 3)},
+        "hops": HOPS,
+        "sim_mean_curve": [round(float(x), 4) for x in sim_avg],
+        "core_mean_curve": [round(float(x), 4) for x in core_avg],
+        "abs_delta_per_hop": [round(float(x), 4) for x in delta],
+        "max_abs_delta": round(float(delta.max()), 4),
+        "mean_abs_delta": round(float(delta.mean()), 4),
+    }
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps({"curves_max_abs_delta": report["max_abs_delta"],
+                      "curves_mean_abs_delta": report["mean_abs_delta"],
+                      "runs": len(sim_curves)}))
+
+
+if __name__ == "__main__":
+    main()

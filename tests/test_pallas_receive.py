@@ -372,26 +372,33 @@ def test_padded_state_requires_kernel():
         step(params, state)
 
 
-@pytest.mark.parametrize("score,loaded", [(True, False), (False, False),
-                                          (True, True)])
-def test_sharded_kernel_matches_single_device(score, loaded):
+@pytest.mark.parametrize("score,variant",
+                         [(True, "plain"), (False, "plain"),
+                          (True, "loaded"), (True, "paired")])
+def test_sharded_kernel_matches_single_device(score, variant):
     """The shard_map multi-chip kernel dispatch (ring-halo exchange +
     per-shard kernel, ops/pallas/receive.sharded_receive) must produce
     the SAME trajectory as the single-device kernel, bit for bit — the
     in-kernel uniform streams draw by global peer index and the halos
     reproduce extend_wrap's mod-n indexing.  The ``loaded`` variant
     additionally exercises the PX, flood-publish, and shared-IP
-    plumbing (extra flats / operands / outputs) under shard_map."""
+    plumbing (extra flats / operands / outputs) under shard_map; the
+    ``paired`` variant the second ctrl-byte halo and slot-B payload
+    view."""
     import jax
     from jax.sharding import Mesh
 
     n, D, block = 2048, 8, 128
     assert n % (D * block) == 0
-    extra = (dict(px=7, flood_publish=True, shared_ip=True)
-             if loaded else {})
-    cfg, sc, p_k, s_k = _build(n, 4, 8, 8, score=score, pad_block=block,
-                               **extra)
-    if loaded:
+    if variant == "paired":
+        cfg, sc, p_k, s_k = _build_paired(n, 4, 8, 8, score=score,
+                                          pad_block=block)
+    else:
+        extra = (dict(px=7, flood_publish=True, shared_ip=True)
+                 if variant == "loaded" else {})
+        cfg, sc, p_k, s_k = _build(n, 4, 8, 8, score=score,
+                                   pad_block=block, **extra)
+    if variant == "loaded":
         assert p_k.cand_same_ip is not None and s_k.active is not None
     assert p_k.subscribed.shape[0] == n          # n_pad == n_true
     step_1 = gs.make_gossip_step(cfg, sc, receive_block=block,

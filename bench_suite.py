@@ -132,7 +132,10 @@ def _bench_gossip(metric, n, t, score_cfg, sybil_frac=None,
     warmup, T, reps = 100, 100, 3
     horizon = warmup + T * reps
     rng = np.random.default_rng(0)
-    block = 8192
+    # GOSSIP_BENCH_BLOCK: kernel block size override — the paired
+    # kernel holds ~2x the per-block VMEM state of the clean one, so a
+    # VMEM-limited chip may need 4096 there
+    block = int(os.environ.get("GOSSIP_BENCH_BLOCK", "8192"))
     if kernel:
         # kernel coverage: the full config matrix (paired, attacks,
         # PX, shared-IP gater, direct peers — all parity-pinned)

@@ -108,7 +108,6 @@ from ..ops.graph import (
     lane_uniform,
     make_circulant_offsets,
     pack_bits,
-    pack_bits_pm,
     pack_rows,
     popcount32,
     ranks_desc,
@@ -239,6 +238,39 @@ class GossipSimConfig:
         offsets; the reference tracks dial direction per conn,
         gossipsub.go:1376-1435)."""
         return sum(1 << c for c, o in enumerate(self.offsets) if o > 0)
+
+
+def _pack_bits_pm_np(bits: np.ndarray) -> np.ndarray:
+    """Host-side twin of ops.graph.pack_bits_pm (bool [N, M] -> uint32
+    [W, N]): pack BEFORE the host->device transfer so a 1M-peer sim
+    ships W words per peer instead of M bools (32x less tunnel
+    traffic; same values)."""
+    n, m = bits.shape
+    w = (m + WORD_BITS - 1) // WORD_BITS
+    pad = w * WORD_BITS - m
+    if pad:
+        bits = np.concatenate(
+            [bits, np.zeros((n, pad), dtype=bits.dtype)], axis=-1)
+    # np.packbits -> little-endian u32 view: same words as pack_bits'
+    # bit-m-in-position-m layout, without a 32x u32 intermediate
+    words = np.packbits(bits.astype(np.uint8), axis=-1,
+                        bitorder="little").view(np.uint32)
+    return np.ascontiguousarray(words.T)
+
+
+def _to_device(a: np.ndarray) -> jnp.ndarray:
+    """Move a host-built array to device — but materialize all-zero
+    arrays directly on device instead of transferring them.
+
+    The no-attack configs (app_score=None, unique IPs, no sybils) make
+    every [C, N] static-score view identically zero; at 1M peers that
+    is ~200 MB of zeros per sim, and bulk host->device transfers are
+    exactly what stresses the axon tunnel's relayed transport
+    (PERF_NOTES operational notes).  Value-identical either way.
+    """
+    if not a.any():
+        return jnp.zeros(a.shape, dtype=a.dtype)
+    return jnp.asarray(a)
 
 
 def make_gossip_offsets(n_topics: int, n_candidates: int, n_peers: int,
@@ -688,15 +720,15 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
         kw = dict(
             cand_same_ip=same_ip,
             invalid_words=pack_bits(jnp.asarray(inv)),
-            cand_app_score=jnp.asarray(padl(app_v)),
-            cand_colo_excess=jnp.asarray(padl(colo_v)),
-            cand_static_score=jnp.asarray(padl(
+            cand_app_score=_to_device(padl(app_v)),
+            cand_colo_excess=_to_device(padl(colo_v)),
+            cand_static_score=_to_device(padl(
                 score_cfg.app_specific_weight * app_v
                 + score_cfg.ip_colocation_factor_weight * colo_v * colo_v)),
             static_score_weights=(score_cfg.app_specific_weight,
                                   score_cfg.ip_colocation_factor_weight),
-            cand_sybil=jnp.asarray(padl(cand_view(syb))),
-            sybil=jnp.asarray(padl(syb)),
+            cand_sybil=_to_device(padl(cand_view(syb))),
+            sybil=_to_device(padl(syb)),
         )
 
     if flood_proto is not None:
@@ -731,10 +763,10 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
     params = GossipParams(
         subscribed=jnp.asarray(padl(subscribed)),
         cand_sub_bits=jnp.asarray(padl(cand_bits(subscribed))),
-        origin_words=pack_bits_pm(jnp.asarray(pad0(origin_bits))),
-        deliver_words=pack_bits_pm(jnp.asarray(pad0(deliver_bits))),
+        origin_words=jnp.asarray(_pack_bits_pm_np(pad0(origin_bits))),
+        deliver_words=jnp.asarray(_pack_bits_pm_np(pad0(deliver_bits))),
         publish_tick=jnp.asarray(msg_publish_tick, dtype=jnp.int32),
-        slot_b_words=(pack_bits_pm(jnp.asarray(pad0(slot_b_bits)))
+        slot_b_words=(jnp.asarray(_pack_bits_pm_np(pad0(slot_b_bits)))
                       if slot_b_bits is not None else None),
         n_true=(n if pad_to_block is not None else None),
         **kw,

@@ -11,6 +11,7 @@ Usage: python tools/bench_kernel.py [n] [which ...]
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 
@@ -77,21 +78,26 @@ def main():
     if "xla" in which:
         cfg, sc, params, state = build(n)
         timed("xla", cfg, sc, params, state)
+    # GOSSIP_BENCH_BLOCK + GOSSIP_KERNEL_SLOTS make the kernel's two
+    # schedule knobs sweepable without code edits (measure_variants.sh)
+    block = int(os.environ.get("GOSSIP_BENCH_BLOCK", "8192"))
     if "kernel" in which:
-        cfg, sc, params, state = build(n, pad_block=8192)
-        timed("kernel-b8192", cfg, sc, params, state,
-              receive_block=8192)
+        cfg, sc, params, state = build(n, pad_block=block)
+        timed(f"kernel-b{block}", cfg, sc, params, state,
+              receive_block=block)
     if "kernela" in which:
         # aligned-wrap plan: n divisible by lcm(t=100, ALIGN8, block)
-        na = 1_024_000 if n == 1_000_000 else n
+        import math
+        q = math.lcm(100, 4096, block)
+        na = -(-n // q) * q
         from go_libp2p_pubsub_tpu.ops.pallas.receive import plan
-        cfg, sc, params, state = build(na, pad_block=8192)
-        if not plan(na, cfg.offsets, 8192)["aligned"]:
+        cfg, sc, params, state = build(na, pad_block=block)
+        if not plan(na, cfg.offsets, block)["aligned"]:
             raise SystemExit(
                 f"n={na} does not satisfy the aligned plan "
-                "(need n % 4096 == 0 and n % 8192 == 0)")
-        timed(f"kernel-aligned-n{na}", cfg, sc, params, state,
-              receive_block=8192)
+                f"(need n % 4096 == 0 and n % {block} == 0)")
+        timed(f"kernel-aligned-n{na}-b{block}", cfg, sc, params, state,
+              receive_block=block)
 
 
 if __name__ == "__main__":

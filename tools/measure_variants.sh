@@ -1,0 +1,25 @@
+#!/bin/bash
+# Kernel-schedule variant sweep — runs AFTER tools/measure_all.sh so the
+# baseline numbers land first.  Strictly sequential TPU processes; each
+# variant is one process (GOSSIP_KERNEL_SLOTS is read at import).
+# Identity at every swept depth/block is pinned by the interpret-mode
+# suite (tests/test_pallas_receive.py, run at slots 2/4/8).
+set -u
+cd /root/repo
+log=/tmp/measure_variants.log
+: > "$log"
+sync_log() { cp "$log" /root/repo/MEASURE_VARIANTS.log; }
+trap sync_log EXIT
+run() {
+  echo "=== $* ===" | tee -a "$log"
+  timeout -k 30 2700 "$@" 2>&1 | grep -v WARNING | tee -a "$log"
+  echo "--- rc=${PIPESTATUS[0]} ---" | tee -a "$log"
+  sync_log
+}
+# prefetch-depth sweep at the default block
+run env GOSSIP_KERNEL_SLOTS=8 python tools/bench_kernel.py 1000000 kernela
+run env GOSSIP_KERNEL_SLOTS=2 python tools/bench_kernel.py 1000000 kernela
+# block-size sweep at the default depth
+run env GOSSIP_BENCH_BLOCK=4096 python tools/bench_kernel.py 1000000 kernela
+run env GOSSIP_BENCH_BLOCK=16384 python tools/bench_kernel.py 1000000 kernela
+echo DONE | tee -a "$log"

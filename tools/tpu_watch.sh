@@ -30,10 +30,13 @@ while true; do
       touch /tmp/measure_pass_start
       bash tools/measure_all.sh >>"$log" 2>&1
       echo "[watch] measure_all finished $(date -u +%H:%M:%S)" | tee -a "$log"
+      bash tools/measure_variants.sh >>"$log" 2>&1
+      echo "[watch] variants finished $(date -u +%H:%M:%S)" | tee -a "$log"
       # commit only artifacts this pass actually (re)wrote — a stale
       # KERNEL_IDENTITY json from an aborted earlier pass must not be
       # relabeled as this capture
       fresh=$(find KERNEL_IDENTITY_r05.json MEASURE_RECOVERY.log \
+              MEASURE_VARIANTS.log \
               -newer /tmp/measure_pass_start 2>/dev/null)
       if [ -n "$fresh" ]; then
         git add $fresh

@@ -50,5 +50,5 @@ while true; do
   else
     echo "[watch] attempt $n: port closed $(date -u +%H:%M:%S)" >>"$log"
   fi
-  sleep 240
+  sleep 240 9>&-   # don't leak the lock fd into the sleep child
 done

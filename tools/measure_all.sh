@@ -13,6 +13,10 @@ log=/tmp/measure_all.log
 : > "$log"
 sync_log() { cp "$log" /root/repo/MEASURE_RECOVERY.log; }
 trap sync_log EXIT
+port_open() {
+  (exec 3<>/dev/tcp/127.0.0.1/"${AXON_PROBE_PORT:-8082}") 2>/dev/null \
+    && exec 3>&- 3<&-
+}
 run() {
   local t="$1"; shift
   echo "=== $* ===" | tee -a "$log"
@@ -20,6 +24,14 @@ run() {
   local rc=${PIPESTATUS[0]}
   echo "--- rc=$rc ---" | tee -a "$log"
   sync_log
+  # the relay has died mid-session twice; once it's gone every further
+  # step just burns its full timeout against a dead backend — abort,
+  # the watcher re-arms and reruns the pass from the top on recovery
+  if ! port_open; then
+    echo "!! relay port closed — aborting measurement pass" | tee -a "$log"
+    sync_log
+    exit 2
+  fi
 }
 # 1. hardware kernel-identity artifact (small run, judge deliverable)
 run 1800 python tools/kernel_identity.py 200000 KERNEL_IDENTITY_r05.json

@@ -29,9 +29,12 @@ while true; do
         | tee -a "$log"
       touch /tmp/measure_pass_start
       bash tools/measure_all.sh >>"$log" 2>&1
-      echo "[watch] measure_all finished $(date -u +%H:%M:%S)" | tee -a "$log"
-      bash tools/measure_variants.sh >>"$log" 2>&1
-      echo "[watch] variants finished $(date -u +%H:%M:%S)" | tee -a "$log"
+      mrc=$?
+      echo "[watch] measure_all rc=$mrc $(date -u +%H:%M:%S)" | tee -a "$log"
+      if [ "$mrc" -eq 0 ]; then
+        bash tools/measure_variants.sh >>"$log" 2>&1
+        echo "[watch] variants finished $(date -u +%H:%M:%S)" | tee -a "$log"
+      fi
       # commit only artifacts this pass actually (re)wrote — a stale
       # KERNEL_IDENTITY json from an aborted earlier pass must not be
       # relabeled as this capture
@@ -43,7 +46,10 @@ while true; do
         git commit -m "Hardware recovery capture: measure_all artifacts" \
           >>"$log" 2>&1 || true
       fi
-      exit 0
+      # pass aborted on a relay death: keep watching — a later
+      # recovery reruns the whole pass (artifact writes are idempotent)
+      [ "$mrc" -eq 0 ] && exit 0
+      echo "[watch] pass aborted — re-arming" | tee -a "$log"
     fi
     echo "[watch] attempt $n: port open but backend probe failed" \
       | tee -a "$log"

@@ -254,7 +254,8 @@ def bench_gossipsub_v11():
     # kernel path needs the TPU mosaic lowering — never on CPU hosts
     kernel = (os.environ.get("GOSSIP_BENCH_KERNEL", "0") == "1"
               and on_accel)
-    _bench_gossip("gossipsub_v11_{n}peers_100topics_heartbeats_per_sec",
+    _bench_gossip("gossipsub_v11_{n}peers_100topics"
+                  + ("_kernel" if kernel else "") + "_heartbeats_per_sec",
                   n, 100, gs.ScoreSimConfig(), baseline=10_000.0,
                   kernel=kernel)
 

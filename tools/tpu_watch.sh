@@ -38,8 +38,9 @@ while true; do
       # commit only artifacts this pass actually (re)wrote — a stale
       # KERNEL_IDENTITY json from an aborted earlier pass must not be
       # relabeled as this capture
+      python tools/pick_bench_path.py >>"$log" 2>&1
       fresh=$(find KERNEL_IDENTITY_r05.json MEASURE_RECOVERY.log \
-              MEASURE_VARIANTS.log \
+              MEASURE_VARIANTS.log BENCH_CONFIG.json \
               -newer /tmp/measure_pass_start 2>/dev/null)
       if [ -n "$fresh" ]; then
         git add $fresh

@@ -195,7 +195,8 @@ def _expand(word: jnp.ndarray, c: int) -> jnp.ndarray:
 def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
                     counter_dtype, track_promises,
                     force_extended=False, stream_n=None,
-                    with_px=False, with_same_ip=False):
+                    with_px=False, with_same_ip=False,
+                    with_static=True):
     C = cfg.n_candidates
     B = block
     cinv = cfg.cinv
@@ -252,7 +253,10 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     bo_in = nxt()
     bob_in = nxt() if paired else None
     if has_sc:
-        static_ref = nxt()
+        # all-zero static bakes are elided from the operand list (no
+        # [C, B] f32 stream per block — models/gossipsub.py
+        # static_score_zero)
+        static_ref = nxt() if with_static else None
         fd_in, inv_in, bp_in, tim_in = nxt(), nxt(), nxt(), nxt()
         timb_in = nxt() if paired else None
         iws_in = nxt()
@@ -685,8 +689,9 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
             topic_part = jnp.minimum(topic_part, sc.topic_score_cap)
         bp_ex = jnp.maximum(0.0, bp_new.astype(jnp.float32)
                             - sc.behaviour_penalty_threshold)
-        score = (topic_part + static_ref[...]
-                 + sc.behaviour_penalty_weight * bp_ex * bp_ex)
+        if with_static:
+            topic_part = topic_part + static_ref[...]
+        score = topic_part + sc.behaviour_penalty_weight * bp_ex * bp_ex
         accept_g = packb(score >= sc.graylist_threshold)
         gossip_g = packb(score >= sc.gossip_threshold)
         pub_g = packb(score >= sc.publish_threshold)
@@ -774,7 +779,7 @@ def sharded_receive(cfg, sc, n_true: int, block: int, counter_dtype,
                     mesh, axis_name: str,
                     head, ctrl_rows, fresh_st, adv_st, blocked,
                     inj_st=None, with_px=False, with_same_ip=False,
-                    ctrl2_rows=None, freshb_st=None):
+                    ctrl2_rows=None, freshb_st=None, with_static=True):
     """Multi-chip kernel dispatch: shard_map over the peer axis, one
     pallas kernel invocation per shard with ring-halo exchange.
 
@@ -817,7 +822,7 @@ def sharded_receive(cfg, sc, n_true: int, block: int, counter_dtype,
         cfg, sc, S, block, counter_dtype, w_words,
         track_promises=track_promises, interpret=interpret,
         force_extended=True, stream_n=n_true, with_px=with_px,
-        with_same_ip=with_same_ip)
+        with_same_ip=with_same_ip, with_static=with_static)
     n_head = len(head)
     paired = cfg.paired_topics
     n_gates = n_gate_rows(sc is not None, paired)
@@ -879,7 +884,8 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
                         force_extended: bool = False,
                         stream_n: int | None = None,
                         with_px: bool = False,
-                        with_same_ip: bool = False):
+                        with_same_ip: bool = False,
+                        with_static: bool = True):
     """Build the kernel caller.
 
     Operand order (args): [valid u32 [W] (sc only)], gseeds u32 [2]
@@ -928,7 +934,7 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
         w_words=w_words, counter_dtype=counter_dtype,
         track_promises=track_promises, force_extended=force_extended,
         stream_n=stream_n, with_px=with_px,
-        with_same_ip=with_same_ip)
+        with_same_ip=with_same_ip, with_static=with_static)
 
     b1 = lambda: pl.BlockSpec((B,), lambda i: (i,))  # noqa: E731
     bw = lambda: pl.BlockSpec((W, B), lambda i: (0, i))  # noqa: E731
@@ -950,8 +956,9 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
     in_specs += [bw(), bw()]                  # seen, injected
     in_specs += [bc()] * (2 if paired else 1)  # backoff(, backoff_b)
     if has_sc:
-        # static, fd, inv, bp, tim(, tim_b), iws
-        in_specs += [bc()] * (7 if paired else 6)
+        # [static], fd, inv, bp, tim(, tim_b), iws
+        in_specs += [bc()] * ((1 if with_static else 0)
+                              + (6 if paired else 5))
         if with_same_ip:
             in_specs += [bc()]    # cand_same_ip sibling words
 

@@ -35,8 +35,11 @@ run() {
 }
 # 1. hardware kernel-identity artifact (small run, judge deliverable)
 run 1800 python tools/kernel_identity.py 200000 KERNEL_IDENTITY_r05.json
-# 2. the flagship driver metric
-run 1800 python bench.py
+# 2. the flagship driver metric — forced-XLA so the pass ALWAYS
+# produces a plain flagship row for pick_bench_path to compare against
+# (a committed kernel pin would otherwise make bench.py emit only the
+# _kernel row and the picker would clear a still-valid pin)
+run 1800 env GOSSIP_BENCH_KERNEL=0 python bench.py
 # 3. XLA vs kernel timing at 1M (decides the default path)
 run 2700 python tools/bench_kernel.py 1000000 xla kernel kernela
 run 2700 python tools/bench_kernel.py 1000000 kernela --noroll

@@ -18,7 +18,10 @@ import os
 import re
 import sys
 
-ROW = re.compile(r'^\{.*"metric": "(gossipsub_v11_\d+peers_100topics'
+# 7+ digit peer counts only: the 1M-scale TPU rows (1000000 plain /
+# 1024000 kernel-padded).  The CPU-fallback row (100000 peers) is a
+# 10x-smaller problem and must not enter the comparison.
+ROW = re.compile(r'^\{.*"metric": "(gossipsub_v11_\d{7,}peers_100topics'
                  r'(_kernel)?_heartbeats_per_sec)"')
 
 
@@ -31,7 +34,10 @@ def main():
                 m = ROW.match(line.strip())
                 if not m:
                     continue
-                val = json.loads(line)["value"]
+                try:
+                    val = float(json.loads(line)["value"])
+                except (ValueError, KeyError, TypeError):
+                    continue   # truncated/garbled row (killed bench)
                 (kern if m.group(2) else xla).append(val)
     except OSError as e:
         print(f"pick_bench_path: no log ({e}); leaving config untouched")

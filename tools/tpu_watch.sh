@@ -40,10 +40,12 @@ while true; do
       # relabeled as this capture
       python tools/pick_bench_path.py >>"$log" 2>&1
       fresh=$(find KERNEL_IDENTITY_r05.json MEASURE_RECOVERY.log \
-              MEASURE_VARIANTS.log BENCH_CONFIG.json \
+              MEASURE_VARIANTS.log \
               -newer /tmp/measure_pass_start 2>/dev/null)
-      if [ -n "$fresh" ]; then
-        git add $fresh
+      [ -n "$fresh" ] && git add $fresh
+      # -A so a pin the picker just DELETED is staged too
+      git add -A -- BENCH_CONFIG.json 2>/dev/null
+      if ! git diff --cached --quiet; then
         git commit -m "Hardware recovery capture: measure_all artifacts" \
           >>"$log" 2>&1 || true
       fi

@@ -523,3 +523,23 @@ def test_direct_peers_always_forward_never_mesh():
                          gs.make_gossip_step(cfg, sc))
     have2 = np.asarray(out2.have)[0]
     assert (have2[isolated] & want_bits[isolated]).max() == 0
+
+
+def test_static_score_elision_trajectory_identical():
+    """The all-zero static-bake elision (GossipParams.static_score_zero)
+    must be a pure compiler-level optimization: running the SAME sim
+    with the flag forced off (streaming the zero [C, N] array every
+    tick) yields a bit-identical trajectory."""
+    import jax
+
+    cfg, sc, params, state = build(n=600, n_msgs=8)
+    assert params.static_score_zero  # no app scores / unique IPs
+    step = make_gossip_step(cfg, sc)
+    out_fast = gossip_run(params, state, 40, step)
+
+    forced = params.replace(static_score_zero=False)
+    out_ref = gossip_run(forced, state, 40, make_gossip_step(cfg, sc))
+
+    for a, b in zip(jax.tree_util.tree_leaves(out_fast),
+                    jax.tree_util.tree_leaves(out_ref)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

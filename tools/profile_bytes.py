@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Deterministic traffic profile: XLA cost-analysis "bytes accessed"
+per tick for the REAL v1.1 step, with components ablated to no-ops —
+the noise-free twin of tools/profile_ablate.py (wall-clock).  Each
+line's delta vs baseline is that component's share of the optimized
+HLO's memory traffic (post-fusion, CSE'd), which is what a
+traffic-bound step's runtime scales with.
+
+Runs on the CPU backend (no TPU needed — use `env -u
+PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu`); CPU fusion differs from TPU
+in detail but array-level traffic is backend-invariant enough to rank
+components and catch accidental re-materializations (e.g. the static
+score bake was read by SEVEN fusions before the zero-elision).
+
+Usage: python tools/profile_bytes.py [n_peers]
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    t, m, C = 100, 32, 16
+    rng = np.random.default_rng(0)
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, C, n, seed=0), n_topics=t)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    topic = rng.integers(0, t, m)
+    origin = rng.integers(0, n // t, m) * t + topic
+    tick0 = np.sort(rng.integers(0, 80, m)).astype(np.int32)
+    sc = gs.ScoreSimConfig()
+    params, state = gs.make_gossip_sim(cfg, subs, topic, origin, tick0,
+                                       score_cfg=sc,
+                                       track_first_tick=False)
+
+    def cost(step):
+        f = jax.jit(lambda pp, ss: step(pp, ss)[0])
+        ca = f.lower(params, state).compile().cost_analysis()
+        ca = ca[0] if isinstance(ca, list) else ca
+        return ca["bytes accessed"], ca.get("flops", 0.0)
+
+    saved = {}
+
+    def patch(**kw):
+        for name, fn in kw.items():
+            saved[name] = getattr(gs, name)
+            setattr(gs, name, fn)
+
+    def unpatch():
+        for name, fn in saved.items():
+            setattr(gs, name, fn)
+        saved.clear()
+
+    base_b, base_f = cost(gs.make_gossip_step(cfg, sc))
+    print(f"n={n} C={C}")
+    print(f"{'baseline full step':34s} {base_b / 1e6:9.1f} MB  "
+          f"{base_f / 1e6:9.1f} Mflop")
+
+    def report(name, **patches):
+        patch(**patches)
+        try:
+            b, fl = cost(gs.make_gossip_step(cfg, sc))
+        finally:
+            unpatch()
+        print(f"{'-' + name:34s} {b / 1e6:9.1f} MB  "
+              f"(delta {(base_b - b) / 1e6:+9.1f} MB, "
+              f"{(base_f - fl) / 1e6:+8.1f} Mflop)")
+
+    class FakeJnp:
+        def __getattr__(self, a):
+            return getattr(jnp, a)
+
+        @staticmethod
+        def roll(x, off, axis=0):
+            return x
+
+    report("all rolls", jnp=FakeJnp())
+    report("transfer_bits",
+           transfer_bits=lambda bits, cfg, pair=False: bits)
+    report("select_k_bits",
+           select_k_bits=lambda elig, k_, spec=None, **kw: elig)
+    report("lane_uniform",
+           lane_uniform=lambda shape, tick, phase, salt, **kw: jnp.full(
+               shape, 0.5, dtype=jnp.float32))
+    report("compute_scores (cond bodies)",
+           compute_scores=lambda sc_, p, s: jnp.zeros(
+               (C, n), dtype=jnp.float32))
+    zw = lambda s_: jnp.zeros_like(s_.mesh)  # noqa: E731
+
+    def fake_gates(cfg_, sc_, p, s, salt):
+        g = (5 if sc_ is not None else 0) + 2 \
+            + (1 if cfg_.paired_topics else 0)
+        return tuple(zw(s) for _ in range(g))
+
+    report("compute_gates (emission)", compute_gates=fake_gates)
+
+    class FakeLax:
+        def __getattr__(self, a):
+            return getattr(jax.lax, a)
+
+        @staticmethod
+        def optimization_barrier(x):
+            return x
+
+    class FakeJax:
+        lax = FakeLax()
+
+        def __getattr__(self, a):
+            return getattr(jax, a)
+
+    report("no optimization_barrier (news fused)", jax=FakeJax())
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,15 @@ port_open() {
 }
 run() {
   local t="$1"; shift
+  # MEASURE_DEADLINE (epoch secs): stop starting new TPU steps near the
+  # driver's own end-of-round bench window — two concurrent TPU clients
+  # wedge the tunnel (PERF_NOTES operational notes)
+  if [ "$(date +%s)" -gt "${MEASURE_DEADLINE:-9999999999}" ]; then
+    echo "!! measurement deadline passed — leaving the chip free" \
+      | tee -a "$log"
+    sync_log
+    exit 3
+  fi
   echo "=== $* ===" | tee -a "$log"
   timeout -k 30 "$t" "$@" 2>&1 | grep -v WARNING | tee -a "$log"
   local rc=${PIPESTATUS[0]}

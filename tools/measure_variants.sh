@@ -11,6 +11,12 @@ log=/tmp/measure_variants.log
 sync_log() { cp "$log" /root/repo/MEASURE_VARIANTS.log; }
 trap sync_log EXIT
 run() {
+  if [ "$(date +%s)" -gt "${MEASURE_DEADLINE:-9999999999}" ]; then
+    echo "!! measurement deadline passed — leaving the chip free" \
+      | tee -a "$log"
+    sync_log
+    exit 3
+  fi
   echo "=== $* ===" | tee -a "$log"
   timeout -k 30 2700 "$@" 2>&1 | grep -v WARNING | tee -a "$log"
   echo "--- rc=${PIPESTATUS[0]} ---" | tee -a "$log"

@@ -16,9 +16,17 @@ if ! flock -n 9; then
 fi
 log=/tmp/tpu_watch.log
 port="${AXON_PROBE_PORT:-8082}"
+# hard stop for ALL watcher TPU activity (probes included): leave the
+# chip free for the driver's own end-of-round bench run
+export MEASURE_DEADLINE="${MEASURE_DEADLINE:-$(date -d '2026-07-31 14:10 UTC' +%s)}"
 echo "[watch] start $(date -u +%H:%M:%S) probing 127.0.0.1:$port" | tee -a "$log"
 n=0
 while true; do
+  if [ "$(date +%s)" -gt "$MEASURE_DEADLINE" ]; then
+    echo "[watch] deadline passed — exiting (chip left to the driver)" \
+      | tee -a "$log"
+    exit 0
+  fi
   n=$((n + 1))
   if (exec 3<>/dev/tcp/127.0.0.1/"$port") 2>/dev/null; then
     exec 3>&- 3<&- 2>/dev/null

@@ -46,7 +46,9 @@ exact-k sampling, direct peers, PX rotation, shared-IP gater, flood
 publish, and paired-topic mode (second ctrl byte + slot-B payload view
 + static cross-slot routing + per-slot P1) — including the everything-
 on configuration.  Remaining refusals: C > 16, W == 0, mixed-protocol
-(flood_proto), track_p3, and re-weighted static score bakes.
+(flood_proto), track_p3, and re-weighted NONZERO static score bakes
+(an all-zero bake is weight-independent and is elided outright —
+``with_static=False`` drops the [C, B] f32 stream per block).
 
 Multi-chip: ``sharded_receive`` runs the kernel under ``shard_map``
 over the peer axis — each shard halo-exchanges max|offset| of boundary

@@ -1,0 +1,74 @@
+"""The bench-path picker is part of the unattended recovery chain
+(tools/tpu_watch.sh): it decides which execution path the driver's
+end-of-round bench runs.  Pin its decision logic."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PICK = REPO / "tools" / "pick_bench_path.py"
+
+XLA_ROW = ('{"metric": "gossipsub_v11_1000000peers_100topics_'
+           'heartbeats_per_sec", "value": %s, "unit": "heartbeats/s"}')
+KERN_ROW = ('{"metric": "gossipsub_v11_1024000peers_100topics_kernel_'
+            'heartbeats_per_sec", "value": %s, "unit": "heartbeats/s"}')
+CPU_ROW = ('{"metric": "gossipsub_v11_100000peers_100topics_'
+           'heartbeats_per_sec", "value": %s, "unit": "heartbeats/s"}')
+
+
+def run_pick(tmp_path, lines):
+    log = tmp_path / "m.log"
+    log.write_text("\n".join(lines) + "\n")
+    out = subprocess.run(
+        [sys.executable, str(PICK), str(log)], cwd=tmp_path,
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    cfg = tmp_path / "BENCH_CONFIG.json"
+    return json.loads(cfg.read_text()) if cfg.exists() else None
+
+
+def test_kernel_win_pins(tmp_path):
+    cfg = run_pick(tmp_path, [XLA_ROW % 160.0, KERN_ROW % 250.0])
+    assert cfg and cfg["kernel"] is True
+
+
+def test_kernel_loss_no_pin(tmp_path):
+    assert run_pick(tmp_path, [XLA_ROW % 160.0, KERN_ROW % 150.0]) is None
+
+
+def test_margin_under_2pct_no_pin(tmp_path):
+    assert run_pick(tmp_path, [XLA_ROW % 160.0, KERN_ROW % 162.0]) is None
+
+
+def test_stale_pin_cleared_on_loss(tmp_path):
+    (tmp_path / "BENCH_CONFIG.json").write_text('{"kernel": true}\n')
+    assert run_pick(tmp_path, [XLA_ROW % 160.0, KERN_ROW % 150.0]) is None
+
+
+def test_cpu_fallback_rows_ignored(tmp_path):
+    # a 100k CPU-fallback row must not stand in for the 1M XLA row
+    cfg = run_pick(tmp_path, [CPU_ROW % 15.9, KERN_ROW % 250.0])
+    assert cfg is None          # no comparable XLA row -> no decision
+
+
+def test_truncated_line_survived(tmp_path):
+    cfg = run_pick(tmp_path, [
+        XLA_ROW % 160.0,
+        KERN_ROW % 250.0,
+        # killed bench mid-write: cut AFTER the metric name so the
+        # regex matches and the json.loads guard is what's exercised
+        (KERN_ROW % 999.0)[:90],
+    ])
+    assert cfg and cfg["kernel"] is True
+
+
+def test_missing_log_untouched(tmp_path):
+    (tmp_path / "BENCH_CONFIG.json").write_text('{"kernel": true}\n')
+    out = subprocess.run(
+        [sys.executable, str(PICK), str(tmp_path / "absent.log")],
+        cwd=tmp_path, capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0
+    # a missing log is not evidence the pin is stale
+    assert (tmp_path / "BENCH_CONFIG.json").exists()

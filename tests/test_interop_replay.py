@@ -294,7 +294,7 @@ def test_randomsub_core_vs_sim_reach_curves():
     # the gossipsub curve gates; the third rung rides out heavy
     # co-located load, e.g. a parallel compile)
     last = None
-    for settle_s in (1.0, 2.0, 4.0):
+    for settle_s in (1.0, 2.0, 4.0, 8.0):
         run = run_core_randomsub(n, publishers, settle_s=settle_s)
         core_mean = mean_reach_fraction(
             reach_by_hops_from_trace(run, 10), n)

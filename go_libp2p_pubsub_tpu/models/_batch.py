@@ -1,0 +1,96 @@
+"""Replica batching for the sim runners: stack B independent
+(params, state) builds along a leading axis and advance them with ONE
+vmapped step inside ONE scan dispatch.
+
+Gossip-protocol evaluation is statistical — reachability curves and
+attack-resilience numbers are distributions over many independent
+(topology, publishers, mesh-seed) runs (arxiv 2007.02754 §5,
+OPTIMUMP2P arxiv 2508.04833) — so replica sweeps, not single runs, are
+the real workload.  Running K replicas as K separate ``*_run`` calls
+pays K dispatches and K resident carries; ``jax.vmap`` over a stacked
+leading replica axis turns that into one device program whose inner
+shapes are unchanged (the peer axis stays on the vector lanes, the
+replica axis becomes the outer grid), and ``donate_argnums`` on the
+carry keeps the whole batch at one carry's worth of live HBM per
+moment.  vmap adds no arithmetic: per replica the batched trajectory
+is bit-identical to the sequential one
+(tests/test_gossipsub_sim.py::test_batch_matches_sequential).
+
+The stacking contract: all replicas must share the SAME static
+configuration (cfg/score_cfg, and therefore pytree structure — aux
+fields like ``gates_fp``/``n_true`` included) because the step bakes
+the circulant offsets in as compile-time constants.  Replicas may vary
+anything carried as arrays: PRNG seed, publishers, message tables,
+subscriptions, sybil flags, ...
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _register_optimization_barrier_batcher() -> None:
+    """Give ``lax.optimization_barrier`` a vmap rule (identity on batch
+    dims) if this jax version lacks one.
+
+    The gossip step uses a barrier to pin the payload-acquisition
+    fusion boundary; the barrier is semantically the identity, so its
+    batching rule is a pure pass-through — the same rule later jax
+    versions ship built in.  Without it, vmapping the step raises
+    NotImplementedError.  Registered only when missing, so newer jax
+    keeps its own rule."""
+    from jax.interpreters import batching
+
+    try:
+        from jax._src.lax.lax import optimization_barrier_p
+    except ImportError:     # internal layout moved; assume rule exists
+        return
+    if optimization_barrier_p in batching.primitive_batchers:
+        return
+
+    def _batcher(batched_args, batch_dims):
+        outs = optimization_barrier_p.bind(*batched_args)
+        return outs, batch_dims
+
+    batching.primitive_batchers[optimization_barrier_p] = _batcher
+
+
+_register_optimization_barrier_batcher()
+
+
+def stack_trees(trees):
+    """Stack a list of structurally-identical pytrees leaf-wise along a
+    new leading replica axis.
+
+    Static (non-leaf) fields must match across replicas — they are part
+    of the tree structure, and a mismatch means the replicas were built
+    for different configs and cannot share one compiled step.
+    """
+    if not trees:
+        raise ValueError("stack_trees needs at least one tree")
+    ref = jax.tree_util.tree_structure(trees[0])
+    for i, t in enumerate(trees[1:], start=1):
+        td = jax.tree_util.tree_structure(t)
+        if td != ref:
+            raise ValueError(
+                f"replica {i} has a different pytree structure than "
+                f"replica 0 (static fields / None leaves must match "
+                f"across the batch):\n  {td}\nvs\n  {ref}")
+    return jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *trees)
+
+
+def index_trees(tree, i: int):
+    """Slice replica ``i`` out of a stacked pytree (leading axis)."""
+    return jax.tree_util.tree_map(lambda leaf: leaf[i], tree)
+
+
+def tree_copy(tree):
+    """Deep-copy every leaf of a pytree into fresh device buffers.
+
+    The single- and batched-trajectory runners donate their state carry
+    (the donated buffers are consumed by the call); callers that need
+    the SAME state again afterwards — A/B comparisons, re-running a
+    settled state under several step variants — pass a copy instead.
+    """
+    return jax.tree_util.tree_map(jnp.copy, tree)

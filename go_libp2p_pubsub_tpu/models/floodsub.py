@@ -24,6 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
+from ._batch import index_trees, stack_trees, tree_copy  # noqa: F401
+#   (re-exported: companions of the donated/batched runners)
 from ..ops.graph import (
     WORD_BITS,
     count_bits_per_position,
@@ -149,17 +151,21 @@ def _finish_step(params: FloodParams, state: FloodState,
     return new_state, delivered_now
 
 
-@partial(jax.jit, static_argnums=(2, 3))
+@partial(jax.jit, static_argnums=(2, 3), donate_argnums=(1,))
 def flood_run(params: FloodParams, state: FloodState, n_ticks: int,
               step_fn=flood_step) -> FloodState:
-    """Run n_ticks steps under one jit (lax.scan keeps the trace compact)."""
+    """Run n_ticks steps under one jit (lax.scan keeps the trace compact).
+
+    The state carry is DONATED — the scan reuses the input's buffers
+    instead of holding two full copies live; callers that need the
+    input state afterwards pass tree_copy(state) (models/_batch.py)."""
     def body(s, _):
         return step_fn(params, s), None
     state, _ = jax.lax.scan(body, state, None, length=n_ticks)
     return state
 
 
-@partial(jax.jit, static_argnums=(2, 3, 4))
+@partial(jax.jit, static_argnums=(2, 3, 4), donate_argnums=(1,))
 def flood_run_curve(params: FloodParams, state: FloodState, n_ticks: int,
                     step_core, n_msgs: int):
     """Run n_ticks steps collecting per-tick delivered counts.
@@ -167,7 +173,8 @@ def flood_run_curve(params: FloodParams, state: FloodState, n_ticks: int,
     step_core: (params, state) -> (state, delivered_now_words); use
     ``_core`` variants.  Returns (state, counts [n_ticks, M]).  Keeping the
     curve as per-tick count reductions (instead of a per-peer first_tick
-    array) removes the dominant memory traffic from the hot loop.
+    array) removes the dominant memory traffic from the hot loop.  The
+    state carry is donated (see flood_run).
     """
     def body(s, _):
         s2, delivered = step_core(params, s)
@@ -175,6 +182,20 @@ def flood_run_curve(params: FloodParams, state: FloodState, n_ticks: int,
         return s2, counts
     state, counts = jax.lax.scan(body, state, None, length=n_ticks)
     return state, counts
+
+
+@partial(jax.jit, static_argnums=(2, 3), donate_argnums=(1,))
+def flood_run_batch(params: FloodParams, state: FloodState, n_ticks: int,
+                    step_fn=flood_step) -> FloodState:
+    """flood_run over B replicas stacked on a leading axis
+    (models/_batch.py stack_trees): one scan of the vmapped step, one
+    donated resident carry."""
+    vstep = jax.vmap(step_fn)
+
+    def body(s, _):
+        return vstep(params, s), None
+    state, _ = jax.lax.scan(body, state, None, length=n_ticks)
+    return state
 
 
 def make_circulant_step_core(offsets):

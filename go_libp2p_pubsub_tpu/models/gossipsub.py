@@ -114,6 +114,9 @@ from ..ops.graph import (
     select_k_bits,
     select_k_by_priority_bits,
 )
+from ._batch import index_trees, stack_trees, tree_copy  # noqa: F401
+#   (re-exported: tree_copy is the companion of the donated runners —
+#    callers that reuse a state after a run pass a copy)
 from ._delivery import (
     reach_counts_from_first_tick,
     first_tick_to_matrix,
@@ -251,10 +254,17 @@ def _pack_bits_pm_np(bits: np.ndarray) -> np.ndarray:
     if pad:
         bits = np.concatenate(
             [bits, np.zeros((n, pad), dtype=bits.dtype)], axis=-1)
-    # np.packbits -> little-endian u32 view: same words as pack_bits'
-    # bit-m-in-position-m layout, without a 32x u32 intermediate
+    # np.packbits -> EXPLICITLY little-endian u32 view: the packed byte
+    # stream is little-endian by construction (bitorder="little"), so
+    # the word view must be '<u4' — a native-endian view would silently
+    # scramble bit positions on a big-endian host.  astype then converts
+    # values (not bytes) to the native uint32 jax expects; on
+    # little-endian hosts it is a no-op alias.
+    # tests/test_gossipsub_sim.py::test_pack_bits_pm_np_matches_device
+    # pins this against ops.graph.pack_bits_pm.
     words = np.packbits(bits.astype(np.uint8), axis=-1,
-                        bitorder="little").view(np.uint32)
+                        bitorder="little").view("<u4").astype(
+                            np.uint32, copy=False)
     return np.ascontiguousarray(words.T)
 
 
@@ -2484,25 +2494,84 @@ def make_gossip_step(cfg: GossipSimConfig,
 # --------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnums=(2, 3))
+@partial(jax.jit, static_argnums=(2, 3), donate_argnums=(1,))
 def gossip_run(params: GossipParams, state: GossipState, n_ticks: int,
                step) -> GossipState:
     # jit (with step static) is load-bearing: a bare lax.scan call misses
     # the C++ dispatch fast path and costs ~4 ms/call of host overhead at
-    # 1M peers — as much as the step itself
+    # 1M peers — as much as the step itself.  The state carry is DONATED:
+    # the scan writes the new carry into the input's buffers instead of
+    # holding two full copies of the (up to ~GB-scale) state live across
+    # the call.  Callers that still need the input state afterwards pass
+    # tree_copy(state) (models/_batch.py).
     def body(s, _):
         return step(params, s)[0], None
     state, _ = jax.lax.scan(body, state, None, length=n_ticks)
     return state
 
 
-@partial(jax.jit, static_argnums=(2, 3, 4))
+@partial(jax.jit, static_argnums=(2, 3, 4), donate_argnums=(1,))
 def gossip_run_curve(params: GossipParams, state: GossipState, n_ticks: int,
                      step, n_msgs: int):
-    """Run n_ticks collecting per-tick delivered counts [n_ticks, M]."""
+    """Run n_ticks collecting per-tick delivered counts [n_ticks, M].
+
+    The state carry is donated (see gossip_run)."""
     def body(s, _):
         s2, delivered = step(params, s)
         return s2, count_bits_per_position(delivered, n_msgs)
+    state, counts = jax.lax.scan(body, state, None, length=n_ticks)
+    return state, counts
+
+
+# --------------------------------------------------------------------------
+# Batched replica execution: B independent sims, one device program
+# --------------------------------------------------------------------------
+
+
+def stack_sims(cfg: GossipSimConfig, specs, **common):
+    """Build B replicas of ONE static config and stack them for the
+    batched runners: ``specs`` is a list of make_gossip_sim keyword
+    dicts (subs, msg_topic, msg_origin, msg_publish_tick, seed, ...);
+    ``common`` supplies kwargs shared by every replica.  Returns
+    (params_B, state_B) with a leading replica axis on every leaf.
+
+    All replicas share ``cfg`` (and any score_cfg) because the step
+    bakes the circulant offsets in as compile-time constants — replicas
+    may vary anything that lives in arrays: seed, publishers, message
+    tables, subscriptions, sybil flags, ...
+    """
+    builds = [make_gossip_sim(cfg, **{**common, **spec}) for spec in specs]
+    return (stack_trees([b[0] for b in builds]),
+            stack_trees([b[1] for b in builds]))
+
+
+@partial(jax.jit, static_argnums=(2, 3), donate_argnums=(1,))
+def gossip_run_batch(params: GossipParams, state: GossipState,
+                     n_ticks: int, step) -> GossipState:
+    """Advance B stacked replicas (stack_sims / stack_trees) n_ticks in
+    ONE scan of the vmapped step: one dispatch and one donated resident
+    carry instead of B.  Per replica the trajectory is bit-identical to
+    the sequential gossip_run (vmap adds no arithmetic; pinned by
+    tests/test_gossipsub_sim.py::test_batch_matches_sequential)."""
+    vstep = jax.vmap(step)
+
+    def body(s, _):
+        return vstep(params, s)[0], None
+    state, _ = jax.lax.scan(body, state, None, length=n_ticks)
+    return state
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4), donate_argnums=(1,))
+def gossip_run_curve_batch(params: GossipParams, state: GossipState,
+                           n_ticks: int, step, n_msgs: int):
+    """gossip_run_curve over B stacked replicas: returns
+    (state_B, counts [n_ticks, B, M])."""
+    vstep = jax.vmap(step)
+
+    def body(s, _):
+        s2, delivered = vstep(params, s)
+        return s2, jax.vmap(
+            lambda d: count_bits_per_position(d, n_msgs))(delivered)
     state, counts = jax.lax.scan(body, state, None, length=n_ticks)
     return state, counts
 

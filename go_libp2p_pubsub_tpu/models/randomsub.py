@@ -37,6 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
+from ._batch import index_trees, stack_trees, tree_copy  # noqa: F401
+#   (re-exported: companions of the donated/batched runners)
 from ..ops.graph import (
     WORD_BITS,
     count_bits_per_position,
@@ -291,16 +293,18 @@ def make_randomsub_dense_step(cfg: RandomSubSimConfig):
     return step
 
 
-@partial(jax.jit, static_argnums=(2, 3))
+@partial(jax.jit, static_argnums=(2, 3), donate_argnums=(1,))
 def randomsub_run(params: RandomSubParams, state: RandomSubState,
                   n_ticks: int, step) -> RandomSubState:
+    # the state carry is donated — callers that reuse the input state
+    # afterwards pass tree_copy(state) (models/_batch.py)
     def body(s, _):
         return step(params, s)[0], None
     state, _ = jax.lax.scan(body, state, None, length=n_ticks)
     return state
 
 
-@partial(jax.jit, static_argnums=(2, 3, 4))
+@partial(jax.jit, static_argnums=(2, 3, 4), donate_argnums=(1,))
 def randomsub_run_curve(params: RandomSubParams, state: RandomSubState,
                         n_ticks: int, step, n_msgs: int):
     def body(s, _):
@@ -308,6 +312,20 @@ def randomsub_run_curve(params: RandomSubParams, state: RandomSubState,
         return s2, count_bits_per_position(delivered, n_msgs)
     state, counts = jax.lax.scan(body, state, None, length=n_ticks)
     return state, counts
+
+
+@partial(jax.jit, static_argnums=(2, 3), donate_argnums=(1,))
+def randomsub_run_batch(params: RandomSubParams, state: RandomSubState,
+                        n_ticks: int, step) -> RandomSubState:
+    """randomsub_run over B replicas stacked on a leading axis
+    (models/_batch.py stack_trees): one scan of the vmapped step, one
+    donated resident carry."""
+    vstep = jax.vmap(step)
+
+    def body(s, _):
+        return vstep(params, s)[0], None
+    state, _ = jax.lax.scan(body, state, None, length=n_ticks)
+    return state
 
 
 def first_tick_matrix(state: RandomSubState, m: int) -> jnp.ndarray:

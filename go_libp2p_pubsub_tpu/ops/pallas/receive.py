@@ -75,7 +75,30 @@ from .select import _fmix32
 # round 4; GOSSIP_KERNEL_SLOTS overrides for hardware A/B sweeps (the
 # slot count only changes the copy schedule, never values — the
 # interpret-mode identity suite runs at several depths).
-N_SLOTS = int(os.environ.get("GOSSIP_KERNEL_SLOTS", "4"))
+
+
+def _parse_n_slots() -> int:
+    """Validate GOSSIP_KERNEL_SLOTS at import: a typo'd sweep value
+    must fail HERE with the env var named, not as an opaque Mosaic
+    scratch-shape error 40 minutes into a hardware pass."""
+    raw = os.environ.get("GOSSIP_KERNEL_SLOTS", "4")
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"GOSSIP_KERNEL_SLOTS={raw!r} must be an integer "
+            "(DMA prefetch depth, e.g. 2/4/8)") from None
+    if not 1 <= val <= 32:
+        # each slot holds a full edge block in VMEM scratch; C <= 16
+        # edges means depths beyond that only waste VMEM, and 32 is
+        # already far past any measurable prefetch benefit
+        raise ValueError(
+            f"GOSSIP_KERNEL_SLOTS={val} out of range [1, 32] "
+            "(DMA prefetch depth; sweeps use 2/4/8)")
+    return val
+
+
+N_SLOTS = _parse_n_slots()
 ALIGN32 = 1024     # u32 1-D DMA slice alignment (8 x 128 tile)
 ALIGN8 = 4096      # u8 alignment (32 x 128 tile)
 

@@ -50,9 +50,11 @@ def test_resume_is_bit_identical(tmp_path, score):
     path = str(tmp_path / "snap.npz")
     save_state(path, mid)
 
-    uninterrupted = gossip_run(params, mid, 25, step)
+    # restore + compare BEFORE resuming: the runner donates its state
+    # carry, so mid's buffers are consumed by the continuation run
     restored = load_state(path, mid)
     assert_tree_equal(mid, restored)
+    uninterrupted = gossip_run(params, mid, 25, step)
     resumed = gossip_run(params, restored, 25, step)
     assert_tree_equal(uninterrupted, resumed)
 

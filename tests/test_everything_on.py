@@ -104,6 +104,9 @@ def test_everything_on_defenses_live():
      second) = _build_everything()
     step = gs.make_gossip_step(cfg, sc)
     mid = gs.gossip_run(params, state, 18, step)
+    # pull the mid-run ledger to host BEFORE resuming — the runner
+    # donates its state carry, consuming mid's buffers
+    serves = np.asarray(mid.iwant_serves)
     out = gs.gossip_run(params, mid, 27, step)
 
     # direct edges: no HONEST peer ever meshes one (graft-flooding
@@ -119,7 +122,6 @@ def test_everything_on_defenses_live():
         "PX rotation must never evict pinned direct edges"
 
     # serve ledger: live mid-run, sybil rows above every honest row
-    serves = np.asarray(mid.iwant_serves)
     syb_max = serves[:, sybil].max()
     hon_max = serves[:, ~sybil].max()
     assert syb_max > hon_max, (syb_max, hon_max)
@@ -148,8 +150,9 @@ def test_everything_on_px_rotation_active():
     (cfg, sc, params, state, sybil, *_rest) = _build_everything()
     step = gs.make_gossip_step(cfg, sc)
     mid = gs.gossip_run(params, state, 18, step)
+    a0 = np.asarray(mid.active)   # before the donated resume eats mid
     out = gs.gossip_run(params, mid, 27, step)
-    a0, a1 = np.asarray(mid.active), np.asarray(out.active)
+    a1 = np.asarray(out.active)
     assert (a0 != a1).any(), "no PX rotation happened in 45 ticks"
     cd = np.asarray(params.cand_direct)
     assert ((a0 & cd) == cd).all() and ((a1 & cd) == cd).all()

@@ -139,12 +139,14 @@ def test_sharded_step_matches_single_device():
     ticks = np.array([0, 0, 1])
     params, state = make_flood_sim(nbrs, mask, subs, None, msg_topic,
                                    msg_origin, ticks)
-    ref = flood_run(params, state, 12)
-
+    # copy for the single-device run: the runner donates its state, and
+    # shard_peer_tree shares non-peer-axis buffers with the source tree
+    from go_libp2p_pubsub_tpu.models.floodsub import tree_copy
     mesh = make_mesh(8)
     assert mesh.size == 8
     params_s = shard_peer_tree(params, mesh, n)
     state_s = shard_peer_tree(state, mesh, n)
+    ref = flood_run(params, tree_copy(state), 12)
     out = flood_run(params_s, state_s, 12)
     np.testing.assert_array_equal(np.asarray(ref.first_tick),
                                   np.asarray(out.first_tick))

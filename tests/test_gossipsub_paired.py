@@ -231,6 +231,7 @@ def test_px_candidate_refresh_recovers_starved_peers():
             cfg, subs, topic, origin, ticks,
             score_cfg=gs.ScoreSimConfig(), sybil=sybil,
             msg_invalid=invalid, px_candidates=7)
+        active0 = np.asarray(state.active)   # before the donated run
         out = gs.gossip_run(params, state, 70,
                             gs.make_gossip_step(cfg, gs.ScoreSimConfig()))
         deg = np.asarray(gs.mesh_degrees(out))[~sybil]
@@ -240,7 +241,7 @@ def test_px_candidate_refresh_recovers_starved_peers():
         for c, o in enumerate(cfg.offsets):
             hon_cand |= np.roll(~sybil, -o).astype(np.uint32) << c
         useful = np.asarray(popcount32(act & hon_cand))[~sybil]
-        rotated = not np.array_equal(act, np.asarray(state.active))
+        rotated = not np.array_equal(act, active0)
         honest_mask = ~sybil
         reach = np.asarray(gs.reach_by_hops(
             params, out, 30, mask=honest_mask))[n_inv:, -1]
@@ -285,7 +286,8 @@ def test_paired_pipelined_gates_match_recompute():
     params, state = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
                                        score_cfg=sc)
     assert len(state.gates) == 8
-    out_p = gs.gossip_run(params, state, 25, gs.make_gossip_step(cfg, sc))
+    out_p = gs.gossip_run(params, gs.tree_copy(state), 25,
+                          gs.make_gossip_step(cfg, sc))
     out_r = gs.gossip_run(params, state, 25,
                           gs.make_gossip_step(cfg, sc,
                                               pipeline_gates=False))

@@ -14,16 +14,22 @@ import pytest
 from go_libp2p_pubsub_tpu.models.gossipsub import (
     GossipSimConfig,
     GossipState,
+    _pack_bits_pm_np,
+    index_trees,
     make_gossip_offsets,
     make_gossip_sim,
     make_gossip_step,
     mesh_degrees,
     mesh_symmetry_fraction,
     gossip_run,
+    gossip_run_batch,
     gossip_run_curve,
+    gossip_run_curve_batch,
     reach_counts,
     refresh_gates,
     first_tick_matrix,
+    stack_sims,
+    tree_copy,
 )
 
 
@@ -175,11 +181,13 @@ def test_sharded_step_matches_single_device():
     cfg, params, state, *_ = build(n=512, t=2, c=8, n_msgs=8, d=3, d_lo=2,
                                    d_hi=6, d_score=2, d_out=1, d_lazy=2)
     step = make_gossip_step(cfg)
-    out_single = gossip_run(params, state, 12, step)
-
+    # copy for the single-device run: the runner donates its state, and
+    # shard_peer_tree shares non-peer-axis buffers (the PRNG key) with
+    # the source tree
     mesh = make_mesh(8)
     params_s = shard_peer_tree(params, mesh, 512)
     state_s = shard_peer_tree(state, mesh, 512)
+    out_single = gossip_run(params, tree_copy(state), 12, step)
     out_shard = gossip_run(params_s, state_s, 12, step)
 
     np.testing.assert_array_equal(np.asarray(out_single.have),
@@ -260,7 +268,8 @@ def test_fused_equals_split_scored_no_gossip():
     sc = gs.ScoreSimConfig()
     params, state = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
                                        score_cfg=sc)
-    out_f = gs.gossip_run(params, state, 30, gs.make_gossip_step(cfg, sc))
+    out_f = gs.gossip_run(params, gs.tree_copy(state), 30,
+                          gs.make_gossip_step(cfg, sc))
     out_s = gs.gossip_run(params, state, 30,
                           gs.make_gossip_step(cfg, sc, force_split=True))
     for f in ("have", "mesh", "backoff", "fanout", "recent",
@@ -293,7 +302,8 @@ def test_fused_equals_split_v10_with_gossip():
     origin = rng.integers(0, n // t, m) * t + topic
     ticks = np.sort(rng.integers(0, 10, m)).astype(np.int32)
     params, state = gs.make_gossip_sim(cfg, subs, topic, origin, ticks)
-    out_f = gs.gossip_run(params, state, 30, gs.make_gossip_step(cfg))
+    out_f = gs.gossip_run(params, gs.tree_copy(state), 30,
+                          gs.make_gossip_step(cfg))
     out_s = gs.gossip_run(params, state, 30,
                           gs.make_gossip_step(cfg, force_split=True))
     for f in ("have", "mesh", "backoff", "fanout", "recent",
@@ -326,7 +336,8 @@ def test_pipelined_gates_match_recompute():
         cfg, subs, topic, origin, ticks, score_cfg=sc,
         sybil=rng.random(n) < 0.2, msg_invalid=rng.random(m) < 0.4,
         app_score=rng.normal(0, 0.1, n).astype(np.float32))
-    out_p = gs.gossip_run(params, state, 25, gs.make_gossip_step(cfg, sc))
+    out_p = gs.gossip_run(params, gs.tree_copy(state), 25,
+                          gs.make_gossip_step(cfg, sc))
     out_r = gs.gossip_run(params, state, 25,
                           gs.make_gossip_step(cfg, sc,
                                               pipeline_gates=False))
@@ -369,3 +380,125 @@ def test_gossip_repair_with_exact_sampling():
     assert (np.asarray(mesh_degrees(out))[isolated] == 0).all()
     np.testing.assert_array_equal(
         np.asarray(reach_counts(params, out)), 600 // 3)
+
+
+# --------------------------------------------------------------------------
+# Batched replica execution (gossip_run_batch / stack_sims) + the
+# donated state carry
+# --------------------------------------------------------------------------
+
+
+def _replica_specs(n=300, t=3, c=16, n_msgs=8, seeds=(1, 2, 3)):
+    cfg = GossipSimConfig(
+        offsets=make_gossip_offsets(t, c, n, seed=1), n_topics=t)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    rng = np.random.default_rng(1)
+    topic = rng.integers(0, t, n_msgs)
+    origin = rng.integers(0, n // t, n_msgs) * t + topic
+    ticks = np.zeros(n_msgs, dtype=np.int32)
+    specs = [dict(subs=subs, msg_topic=topic, msg_origin=origin,
+                  msg_publish_tick=ticks, seed=s) for s in seeds]
+    return cfg, specs
+
+
+def test_batch_matches_sequential():
+    """gossip_run_batch over B=3 stacked mesh seeds is bit-identical
+    per replica to three sequential gossip_run calls: vmap adds no
+    arithmetic, so batching replicas can never change a trajectory."""
+    from go_libp2p_pubsub_tpu.models.gossipsub import ScoreSimConfig
+
+    cfg, specs = _replica_specs()
+    sc = ScoreSimConfig()
+    step = make_gossip_step(cfg, sc)
+    params_b, state_b = stack_sims(cfg, specs, score_cfg=sc)
+    out_b = gossip_run_batch(params_b, state_b, 20, step)
+    for i, spec in enumerate(specs):
+        params, state = make_gossip_sim(cfg, **spec, score_cfg=sc)
+        out = gossip_run(params, state, 20, step)
+        ref = jax.tree_util.tree_leaves(out)
+        got = jax.tree_util.tree_leaves(index_trees(out_b, i))
+        assert len(ref) == len(got)
+        for a, b in zip(ref, got):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_batch_curve_matches_sequential_curve():
+    """gossip_run_curve_batch returns [n_ticks, B, M] per-tick counts,
+    each replica column equal to its sequential gossip_run_curve."""
+    cfg, specs = _replica_specs()
+    step = make_gossip_step(cfg)
+    params_b, state_b = stack_sims(cfg, specs)
+    _, counts_b = gossip_run_curve_batch(params_b, state_b, 25, step, 8)
+    counts_b = np.asarray(counts_b)
+    assert counts_b.shape == (25, len(specs), 8)
+    for i, spec in enumerate(specs):
+        params, state = make_gossip_sim(cfg, **spec)
+        _, counts = gossip_run_curve(params, state, 25, step, 8)
+        np.testing.assert_array_equal(counts_b[:, i, :],
+                                      np.asarray(counts))
+
+
+def test_batch_donated_carry_same_fingerprint():
+    """The donated-carry path is value-invisible: running a batch whose
+    input buffers are consumed (donated) yields the same final state
+    fingerprint as running from an undonated copy, and the donated
+    input is actually consumed where the backend supports donation."""
+    cfg, specs = _replica_specs()
+    step = make_gossip_step(cfg)
+    params_b, state_b = stack_sims(cfg, specs)
+    keep = tree_copy(state_b)
+    out_donated = gossip_run_batch(params_b, state_b, 15, step)
+    out_copy = gossip_run_batch(params_b, keep, 15, step)
+
+    def fingerprint(tree):
+        import hashlib
+        h = hashlib.sha256()
+        for leaf in jax.tree_util.tree_leaves(tree):
+            h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+        return h.hexdigest()
+
+    assert fingerprint(out_donated) == fingerprint(out_copy)
+
+
+def test_single_run_donates_its_carry():
+    """gossip_run consumes its state argument (donate_argnums): the
+    input buffers must be gone after the call on backends that honor
+    donation — the memory-amortization contract of the runners."""
+    cfg, specs = _replica_specs(seeds=(1,))
+    step = make_gossip_step(cfg)
+    params, state = make_gossip_sim(cfg, **specs[0])
+    _ = gossip_run(params, state, 5, step)
+    if jax.default_backend() in ("cpu", "tpu", "gpu"):
+        with pytest.raises(RuntimeError, match="deleted"):
+            np.asarray(state.mesh)
+
+
+def test_stack_sims_rejects_structure_mismatch():
+    """Replicas built for different configs (different pytree statics /
+    None leaves) must be refused, not silently mis-stacked."""
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        ScoreSimConfig, stack_trees)
+
+    cfg, specs = _replica_specs(seeds=(1, 2))
+    _, s_plain = make_gossip_sim(cfg, **specs[0])
+    _, s_scored = make_gossip_sim(cfg, **specs[1],
+                                  score_cfg=ScoreSimConfig())
+    with pytest.raises(ValueError, match="structure"):
+        stack_trees([s_plain, s_scored])
+
+
+def test_pack_bits_pm_np_matches_device():
+    """The host-side packer is bit-exact against ops.graph.pack_bits_pm
+    across padded and word-aligned widths (and the uint32 view is
+    explicitly little-endian — '<u4' — so the equality holds regardless
+    of host byte order)."""
+    from go_libp2p_pubsub_tpu.ops.graph import pack_bits_pm
+
+    rng = np.random.default_rng(0)
+    for n, m in ((7, 1), (5, 24), (3, 32), (4, 40), (2, 64), (6, 65)):
+        bits = rng.random((n, m)) < 0.5
+        host = _pack_bits_pm_np(bits)
+        dev = np.asarray(pack_bits_pm(jnp.asarray(bits)))
+        assert host.dtype == np.uint32
+        np.testing.assert_array_equal(host, dev, err_msg=f"n={n} m={m}")

@@ -20,6 +20,7 @@ from go_libp2p_pubsub_tpu.models.gossipsub import (
     mesh_degrees,
     gossip_run,
     reach_counts,
+    tree_copy,
 )
 
 import pytest
@@ -535,7 +536,7 @@ def test_static_score_elision_trajectory_identical():
     cfg, sc, params, state = build(n=600, n_msgs=8)
     assert params.static_score_zero  # no app scores / unique IPs
     step = make_gossip_step(cfg, sc)
-    out_fast = gossip_run(params, state, 40, step)
+    out_fast = gossip_run(params, tree_copy(state), 40, step)
 
     forced = params.replace(static_score_zero=False)
     out_ref = gossip_run(forced, state, 40, make_gossip_step(cfg, sc))

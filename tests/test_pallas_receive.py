@@ -407,7 +407,7 @@ def test_sharded_kernel_matches_single_device(score, variant):
     step_8 = gs.make_gossip_step(cfg, sc, receive_block=block,
                                  receive_interpret=True,
                                  shard_mesh=mesh)
-    out_1 = gs.gossip_run(p_k, s_k, 15, step_1)
+    out_1 = gs.gossip_run(p_k, gs.tree_copy(s_k), 15, step_1)
     out_8 = gs.gossip_run(p_k, s_k, 15, step_8)
     l1 = jax.tree_util.tree_leaves(out_1)
     l8 = jax.tree_util.tree_leaves(out_8)
@@ -480,3 +480,21 @@ def test_kernel_matches_xla_aligned_wrap():
     assert plan(n, cfg.offsets, 128)["aligned"]
     _assert_state_equal(out_x, out_k, n, sc)
     assert np.asarray(out_x.scores.first_deliveries).max() > 0
+
+
+def test_kernel_slots_env_validated_at_import():
+    """A typo'd GOSSIP_KERNEL_SLOTS must fail at import with the env
+    var named — not as an opaque Mosaic scratch error mid-sweep."""
+    import os
+    import subprocess
+    import sys
+
+    for bad in ("banana", "0", "33"):
+        env = dict(os.environ, GOSSIP_KERNEL_SLOTS=bad,
+                   JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import go_libp2p_pubsub_tpu.ops.pallas.receive"],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert r.returncode != 0, bad
+        assert "GOSSIP_KERNEL_SLOTS" in r.stderr, r.stderr[-500:]

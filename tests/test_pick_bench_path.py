@@ -72,3 +72,32 @@ def test_missing_log_untouched(tmp_path):
     assert out.returncode == 0
     # a missing log is not evidence the pin is stale
     assert (tmp_path / "BENCH_CONFIG.json").exists()
+
+
+def test_missing_kernel_row_preserves_pin(tmp_path):
+    # an aborted pass (or forced-XLA-only rerun) lacks the kernel row:
+    # that is NOT a completed comparison — the hardware-measured pin
+    # must survive
+    (tmp_path / "BENCH_CONFIG.json").write_text('{"kernel": true}\n')
+    assert run_pick(tmp_path, [XLA_ROW % 160.0]) == {"kernel": True}
+
+
+def test_missing_xla_row_preserves_pin(tmp_path):
+    # a CPU-fallback flagship run leaves only the kernel row behind
+    (tmp_path / "BENCH_CONFIG.json").write_text('{"kernel": true}\n')
+    assert run_pick(tmp_path,
+                    [CPU_ROW % 15.9, KERN_ROW % 250.0]) == {"kernel": True}
+
+
+def test_alias_rows_ignored(tmp_path):
+    # bench_suite re-emits a kernel measurement under the plain
+    # historical name (alias_of tag) for exact-name consumers; the
+    # picker must not read it as an XLA measurement (here it would
+    # otherwise see xla=250 vs kernel=250 and clear the pin)
+    (tmp_path / "BENCH_CONFIG.json").write_text('{"kernel": true}\n')
+    alias = ('{"metric": "gossipsub_v11_1024000peers_100topics_'
+             'heartbeats_per_sec", "value": 250.0, "unit": '
+             '"heartbeats/s", "alias_of": "gossipsub_v11_1024000peers_'
+             '100topics_kernel_heartbeats_per_sec"}')
+    cfg = run_pick(tmp_path, [KERN_ROW % 250.0, alias])
+    assert cfg == {"kernel": True}   # pin untouched (no true XLA row)

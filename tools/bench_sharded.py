@@ -53,7 +53,9 @@ def build(n, t=100, m=32, seed=0, pad_block=None):
 
 
 def time_run(gs, params, state, step, k=100, reps=3):
-    state = gs.gossip_run(params, state, 50, step)
+    # the runner donates its state carry; copy so the caller's settled
+    # state survives for the sharded/identity comparisons below
+    state = gs.gossip_run(params, gs.tree_copy(state), 50, step)
     _ = int(np.asarray(state.tick))
     best = 1e9
     for _ in range(reps):
@@ -140,7 +142,7 @@ def main():
     # covers interpret mode only; kernel_identity.py covers the
     # unsharded compiled kernel — this closes the sharded gap)
     import jax as _jax
-    o_a = gs.gossip_run(pk, stk, 10, step_k)
+    o_a = gs.gossip_run(pk, gs.tree_copy(stk), 10, step_k)
     o_b = gs.gossip_run(pk1, sk1, 10, step_ks)
     for a, b in zip(_jax.tree_util.tree_leaves(o_a),
                     _jax.tree_util.tree_leaves(o_b)):

@@ -10,6 +10,10 @@ log=/tmp/measure_variants.log
 : > "$log"
 sync_log() { cp "$log" /root/repo/MEASURE_VARIANTS.log; }
 trap sync_log EXIT
+port_open() {
+  (exec 3<>/dev/tcp/127.0.0.1/"${AXON_PROBE_PORT:-8082}") 2>/dev/null \
+    && exec 3>&- 3<&-
+}
 run() {
   if [ "$(date +%s)" -gt "${MEASURE_DEADLINE:-9999999999}" ]; then
     echo "!! measurement deadline passed — leaving the chip free" \
@@ -21,6 +25,15 @@ run() {
   timeout -k 30 2700 "$@" 2>&1 | grep -v WARNING | tee -a "$log"
   echo "--- rc=${PIPESTATUS[0]} ---" | tee -a "$log"
   sync_log
+  # same abort-on-relay-death logic as measure_all.sh: once the relay
+  # port is gone every further variant just burns its full 2700 s
+  # timeout against a dead backend (~3 h for the sweep) — abort; the
+  # watcher re-arms and a later recovery reruns the pass
+  if ! port_open; then
+    echo "!! relay port closed — aborting variant sweep" | tee -a "$log"
+    sync_log
+    exit 2
+  fi
 }
 # prefetch-depth sweep at the default block
 run env GOSSIP_KERNEL_SLOTS=8 python tools/bench_kernel.py 1000000 kernela

@@ -5,8 +5,15 @@ Scans MEASURE_RECOVERY.log for the flagship v1.1 rows (the metric
 carries a ``_kernel`` tag when the pallas path ran, bench_suite.py)
 and writes BENCH_CONFIG.json {"kernel": true} iff the kernel path
 measurably beat the XLA path on hardware — bench.py then defaults the
-driver's unattended end-of-round run to the winner.  No file is
-written (and any stale pin is cleared) otherwise.
+driver's unattended end-of-round run to the winner.
+
+A pin is only CLEARED on a COMPLETED losing comparison: both
+comparable 1M rows present and the kernel failing the margin.  A log
+missing either row (aborted pass, CPU-fallback flagship, relay death)
+is not evidence the pin is stale — the last hardware-measured decision
+stands (advisor r5).  Alias rows (bench_suite re-emitting a kernel
+measurement under the plain historical name, tagged "alias_of") are
+skipped: they are kernel numbers and must not impersonate XLA ones.
 
 Usage: python tools/pick_bench_path.py [log=MEASURE_RECOVERY.log]
 """
@@ -35,9 +42,13 @@ def main():
                 if not m:
                     continue
                 try:
-                    val = float(json.loads(line)["value"])
+                    row = json.loads(line)
+                    val = float(row["value"])
                 except (ValueError, KeyError, TypeError):
                     continue   # truncated/garbled row (killed bench)
+                if "alias_of" in row:
+                    continue   # kernel value re-emitted under the
+                    #            plain name for exact-name consumers
                 (kern if m.group(2) else xla).append(val)
     except OSError as e:
         print(f"pick_bench_path: no log ({e}); leaving config untouched")
@@ -46,8 +57,15 @@ def main():
     best_k = max(kern, default=None)
     print(f"pick_bench_path: xla={best_x} kernel={best_k} (hb/s)")
     cfg = "BENCH_CONFIG.json"
+    if best_x is None or best_k is None:
+        # an incomplete comparison (aborted pass / CPU-fallback
+        # flagship) is not evidence either way: keep whatever the last
+        # completed hardware comparison decided
+        print("pick_bench_path: missing a comparable 1M row — "
+              "leaving any existing pin untouched")
+        return
     # require a real margin: path choice should not flap on noise
-    if best_x is not None and best_k is not None and best_k > 1.02 * best_x:
+    if best_k > 1.02 * best_x:
         with open(cfg, "w") as f:
             json.dump({"kernel": True,
                        "measured_xla_hbs": best_x,
@@ -55,6 +73,8 @@ def main():
             f.write("\n")
         print("pick_bench_path: kernel path pinned")
     elif os.path.exists(cfg):
+        # a COMPLETED comparison the kernel lost: the pin is genuinely
+        # stale
         os.remove(cfg)
         print("pick_bench_path: stale kernel pin cleared")
 

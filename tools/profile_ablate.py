@@ -49,8 +49,10 @@ def main():
     def time_step(step):
         # state must be loop-CARRIED (gossip_run's scan), not closed
         # over: with invariant state XLA hoists the score/counter work
-        # out of the loop and the step looks ~2x faster than it is
-        st = gs.gossip_run(params, state, k, step)
+        # out of the loop and the step looks ~2x faster than it is.
+        # Copy: the runner donates its carry and every ablation variant
+        # re-times from the same settled state.
+        st = gs.gossip_run(params, gs.tree_copy(state), k, step)
         _ = int(np.asarray(st.tick))
         best = 1e9
         for _r in range(2):

@@ -45,8 +45,17 @@ while true; do
       fi
       # commit only artifacts this pass actually (re)wrote — a stale
       # KERNEL_IDENTITY json from an aborted earlier pass must not be
-      # relabeled as this capture
-      python tools/pick_bench_path.py >>"$log" 2>&1
+      # relabeled as this capture.
+      # Run the path picker ONLY after a COMPLETED pass: an aborted one
+      # (relay death mid-pass, deadline) lacks the forced-XLA flagship
+      # row, and the picker must not judge — let alone clear — a
+      # hardware-measured pin from half a log (advisor r5)
+      if [ "$mrc" -eq 0 ]; then
+        python tools/pick_bench_path.py >>"$log" 2>&1
+      else
+        echo "[watch] pass aborted (rc=$mrc) — skipping pick_bench_path" \
+          | tee -a "$log"
+      fi
       fresh=$(find KERNEL_IDENTITY_r05.json MEASURE_RECOVERY.log \
               MEASURE_VARIANTS.log \
               -newer /tmp/measure_pass_start 2>/dev/null)

@@ -10,19 +10,141 @@ curves, so this tool runs K independent (topology, publishers, mesh
 seed) samples on BOTH sides, averages, and records the achieved
 per-hop delta as a committed artifact.
 
+Replica execution is BATCHED (the round-5 n=120 sweep's binding cost
+was K separate Python-loop gossip_run calls, each recompiling the step
+for its own topology): replicas are grouped into chunks of B that
+share a topology — publishers and mesh seed stay per-replica — and
+each chunk advances as ONE gossip_run_batch dispatch of the vmapped
+step with a donated carry.  B is chosen from the peer count so the
+batched carry fits the memory budget (see _pick_chunk; override with
+--batch).  Per replica the batched trajectory is bit-identical to the
+sequential one, so --sequential (the automatic fallback when B=1)
+iterates the SAME spec list one run at a time and produces identical
+mean curves — it exists for A/B validation and as the escape hatch on
+memory-starved hosts.
+
 CPU-only (the core is asyncio; the sim runs fine on the CPU backend).
 
-Usage: python tools/validate_curves.py [K] [out.json]
+Usage: python tools/validate_curves.py [K] [out.json] [n]
+                                       [--batch B] [--sequential]
+                                       [--sim-only]
+
+--sim-only skips the asyncio core side entirely: it times and reports
+just the sim replica sweep (the perf-comparison mode recorded in
+PERF_NOTES.md).
 """
 
 from __future__ import annotations
 
 import json
 import sys
+import time
 
 import numpy as np
 
 sys.path.insert(0, ".")
+
+# cap on replicas per shared-topology chunk: keeps >= 2 distinct
+# topologies in a default K=10..12 sweep (topology is one of the three
+# randomness dimensions the mean averages over)
+MAX_CHUNK = 6
+
+
+def _pick_chunk(n_peers: int, k: int, budget_bytes: int) -> int:
+    """Chunk size B from the peer count: how many replica carries fit
+    the memory budget at once.
+
+    Per-replica carry estimate for the curve config (no scoring), from
+    the GossipState layout: mesh/fanout/last_pub/gates [N] words,
+    backoff i16 [C, N], have + recent u32 [(1 + Hg) * W, N], first_tick
+    i16 [W, 32, N] — first_tick dominates.  W = 1 (M = 24 ids), C = 8,
+    Hg = 3 here; the formula keeps the symbolic form so larger sweeps
+    scale it honestly.
+    """
+    C, W, HG = 8, 1, 3
+    per_replica = n_peers * (4 * 4          # mesh/fanout/last_pub/gate
+                             + 2 * C        # backoff i16
+                             + 4 * W * (1 + HG)   # have + recent
+                             + 2 * W * 32)  # first_tick i16
+    b = int(budget_bytes // max(per_replica, 1))
+    return max(1, min(k, b, MAX_CHUNK))
+
+
+def _make_specs(K: int, B: int, n: int, C: int, M: int):
+    """The K replica specs, chunked: chunk j (replicas j*B .. j*B+B-1)
+    shares topology seed 3+j; publishers (rng 100+k) and the sim's mesh
+    seed (k) stay per-replica.  The sequential fallback iterates the
+    same list, so both paths average the same trajectories."""
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+
+    chunks = []
+    for j in range(0, (K + B - 1) // B):
+        members = []
+        offsets = gs.make_gossip_offsets(1, C, n, seed=3 + j)
+        for k in range(j * B, min((j + 1) * B, K)):
+            rng = np.random.default_rng(100 + k)
+            members.append({
+                "k": k,
+                "publishers": list(rng.integers(0, n, M)),
+                "seed": k,
+            })
+        chunks.append({"topo_seed": 3 + j, "offsets": offsets,
+                       "members": members})
+    return chunks
+
+
+def _sim_sweep(chunks, n: int, M: int, HOPS: int, sequential: bool):
+    """Run every replica's sim trajectory; returns ({k: (mean_curve,
+    mesh_degree)}, fell_back).  Batched: one gossip_run_batch per
+    chunk.  Sequential: one gossip_run per replica, same specs.
+    ``fell_back`` is True when ANY chunk had to drop from the batched
+    path to the per-replica loop — the committed artifact's mode tag
+    must reflect that, or the recorded timing would impersonate the
+    batched path."""
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+
+    subs = np.ones((n, 1), dtype=bool)
+    out = {}
+    fell_back = False
+    for chunk in chunks:
+        cfg = gs.GossipSimConfig(
+            offsets=chunk["offsets"], n_topics=1, d=3, d_lo=2, d_hi=6,
+            d_score=2, d_out=1, d_lazy=0, gossip_factor=0.0)
+        step = gs.make_gossip_step(cfg, None)
+        specs = [dict(subs=subs, msg_topic=np.zeros(M, np.int64),
+                      msg_origin=np.array(m["publishers"]),
+                      msg_publish_tick=np.full(M, 90, np.int32),
+                      seed=m["seed"])
+                 for m in chunk["members"]]
+        if not (sequential or len(specs) == 1):
+            try:
+                params_b, state_b = gs.stack_sims(cfg, specs)
+                fin_b = gs.gossip_run_batch(params_b, state_b, 110, step)
+                for i, m in enumerate(chunk["members"]):
+                    out[m["k"]] = _replica_stats(
+                        gs, gs.index_trees(params_b, i),
+                        gs.index_trees(fin_b, i), HOPS, n)
+                continue
+            except Exception as e:    # OOM / backend refusal: the
+                # per-replica loop is always available and identical
+                fell_back = True
+                print(f"batched chunk failed ({type(e).__name__}: "
+                      f"{e}); falling back to the sequential loop",
+                      file=sys.stderr)
+        for m, spec in zip(chunk["members"], specs):
+            params, state = gs.make_gossip_sim(cfg, **spec)
+            fin = gs.gossip_run(params, state, 110, step)
+            out[m["k"]] = _replica_stats(gs, params, fin, HOPS, n)
+    return out, fell_back
+
+
+def _replica_stats(gs, params, fin, HOPS, n):
+    from go_libp2p_pubsub_tpu.interop import mean_reach_fraction
+
+    mean = mean_reach_fraction(
+        np.asarray(gs.reach_by_hops(params, fin, HOPS)), n)
+    deg = float(np.asarray(gs.mesh_degrees(fin)).mean())
+    return mean, deg
 
 
 def main():
@@ -30,96 +152,132 @@ def main():
 
     jax.config.update("jax_platforms", "cpu")
 
-    import go_libp2p_pubsub_tpu.models.gossipsub as gs
     from go_libp2p_pubsub_tpu.interop import (
         mean_reach_fraction, reach_by_hops_from_trace,
         run_core_gossipsub)
 
-    K = int(sys.argv[1]) if len(sys.argv) > 1 else 10
-    out_path = sys.argv[2] if len(sys.argv) > 2 else "CURVES_r05.json"
-    n = int(sys.argv[3]) if len(sys.argv) > 3 else 60
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("K", nargs="?", type=int, default=10)
+    ap.add_argument("out", nargs="?", default="CURVES_r05.json")
+    ap.add_argument("n", nargs="?", type=int, default=60)
+    ap.add_argument("--batch", type=int, default=None,
+                    help="override the chunk size heuristic")
+    ap.add_argument("--sequential", action="store_true",
+                    help="per-replica fallback over the same specs")
+    ap.add_argument("--sim-only", action="store_true",
+                    help="skip the asyncio core side; time the sim "
+                         "replica sweep only")
+    ns = ap.parse_args()
+    batch_override = ns.batch
+    sequential = ns.sequential
+    sim_only = ns.sim_only
+    K, out_path, n = ns.K, ns.out, ns.n
     C, M = 8, 24
     HOPS = 12 if n <= 60 else 16
+
+    import os
+    budget = int(os.environ.get("GOSSIP_CURVE_MEM_BUDGET",
+                                str(1 << 30)))
+    B = batch_override or _pick_chunk(n, K, budget)
+    chunks = _make_specs(K, B, n, C, M)
+    mode = "sequential" if (sequential or B == 1) else f"batched{B}"
+    print(f"sim sweep: K={K} chunk={B} mode={mode}", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    sim_stats, fell_back = _sim_sweep(chunks, n, M, HOPS, sequential)
+    sim_seconds = time.perf_counter() - t0
+    if fell_back:
+        # the timing below is (at least partly) the per-replica loop's
+        # — the artifact must not attribute it to the batched path
+        mode += "+seq-fallback"
+    print(f"sim sweep: {sim_seconds:.2f}s ({mode})", file=sys.stderr)
 
     sim_curves, core_curves = [], []
     degrees = []
     incomplete = 0
-    for k in range(K):
-        offsets = gs.make_gossip_offsets(1, C, n, seed=3 + k)
-        rng = np.random.default_rng(100 + k)
-        publishers = list(rng.integers(0, n, M))
+    for chunk in chunks:
+        for m in chunk["members"]:
+            k = m["k"]
+            sim_mean, sim_deg = sim_stats[k]
+            if sim_mean[-1] != 1.0:
+                # with gossip repair OFF (the curve-comparison setting)
+                # an unlucky settled mesh can disconnect a peer — the
+                # exact failure mode gossip exists to repair.  Drop the
+                # pair.
+                incomplete += 1
+                print(f"run {k}: sim mesh incomplete (no gossip "
+                      "repair), dropped", file=sys.stderr)
+                continue
+            if sim_only:
+                degrees.append((sim_deg, sim_deg))
+                sim_curves.append(sim_mean)
+                continue
 
-        cfg = gs.GossipSimConfig(
-            offsets=offsets, n_topics=1, d=3, d_lo=2, d_hi=6,
-            d_score=2, d_out=1, d_lazy=0, gossip_factor=0.0)
-        subs = np.ones((n, 1), dtype=bool)
-        params, state = gs.make_gossip_sim(
-            cfg, subs, np.zeros(M, np.int64), np.array(publishers),
-            np.full(M, 90, np.int32), seed=k)
-        out = gs.gossip_run(params, state, 110,
-                            gs.make_gossip_step(cfg, None))
-        sim_mean = mean_reach_fraction(
-            np.asarray(gs.reach_by_hops(params, out, HOPS)), n)
-        if sim_mean[-1] != 1.0:
-            # with gossip repair OFF (the curve-comparison setting) an
-            # unlucky settled mesh can disconnect a peer — the exact
-            # failure mode gossip exists to repair.  Drop the pair.
-            incomplete += 1
-            print(f"run {k}: sim mesh incomplete (no gossip repair), "
-                  "dropped", file=sys.stderr)
-            continue
-        sim_deg = float(np.asarray(gs.mesh_degrees(out)).mean())
-
-        # mean mesh degree DRIVES spread speed: curves are only
-        # comparable when the two meshes settled to the same degree
-        # (the CI gate requires |core_deg - sim_deg| < 0.6 for the
-        # same reason); under-warmed core clusters sit mid-GRAFT-burst
-        # with inflated degrees and systematically faster curves
-        core_mean = core_deg = None
-        for warm_s, settle_s in ((2.0, 1.2), (3.5, 2.0), (5.0, 2.5)):
-            run = run_core_gossipsub(offsets, n, publishers,
-                                     warm_s=warm_s, settle_s=settle_s)
-            cm = mean_reach_fraction(
-                reach_by_hops_from_trace(run, HOPS + 1), n)
-            cd = float(np.mean(run.extra["mesh_degrees"]))
-            if cm[-1] == 1.0 and abs(cd - sim_deg) < 0.6:
-                core_mean, core_deg = cm, cd
-                break
-        if core_mean is None:
-            incomplete += 1       # drop the PAIR, keep sides matched
-            print(f"run {k}: core incomplete/degree-mismatched "
-                  f"(core_deg {cd:.2f} vs sim {sim_deg:.2f}), dropped",
-                  file=sys.stderr)
-            continue
-        degrees.append((core_deg, sim_deg))
-        sim_curves.append(sim_mean)
-        # sim hop h aligns with core hop h+1 (the sim's publish tick
-        # includes the first forwarding hop)
-        core_curves.append(core_mean[1:HOPS + 1])
-        print(f"run {k}: ok (deg core {core_deg:.2f} sim {sim_deg:.2f})",
-              flush=True)
+            # mean mesh degree DRIVES spread speed: curves are only
+            # comparable when the two meshes settled to the same degree
+            # (the CI gate requires |core_deg - sim_deg| < 0.6 for the
+            # same reason); under-warmed core clusters sit mid-GRAFT-
+            # burst with inflated degrees and systematically faster
+            # curves
+            core_mean = core_deg = None
+            for warm_s, settle_s in ((2.0, 1.2), (3.5, 2.0), (5.0, 2.5)):
+                run = run_core_gossipsub(chunk["offsets"], n,
+                                         m["publishers"],
+                                         warm_s=warm_s,
+                                         settle_s=settle_s)
+                cm = mean_reach_fraction(
+                    reach_by_hops_from_trace(run, HOPS + 1), n)
+                cd = float(np.mean(run.extra["mesh_degrees"]))
+                if cm[-1] == 1.0 and abs(cd - sim_deg) < 0.6:
+                    core_mean, core_deg = cm, cd
+                    break
+            if core_mean is None:
+                incomplete += 1       # drop the PAIR, keep sides matched
+                print(f"run {k}: core incomplete/degree-mismatched "
+                      f"(core_deg {cd:.2f} vs sim {sim_deg:.2f}), "
+                      "dropped", file=sys.stderr)
+                continue
+            degrees.append((core_deg, sim_deg))
+            sim_curves.append(sim_mean)
+            # sim hop h aligns with core hop h+1 (the sim's publish tick
+            # includes the first forwarding hop)
+            core_curves.append(core_mean[1:HOPS + 1])
+            print(f"run {k}: ok (deg core {core_deg:.2f} "
+                  f"sim {sim_deg:.2f})", flush=True)
 
     sim_avg = np.mean(sim_curves, axis=0)
-    core_avg = np.mean(core_curves, axis=0)
-    delta = np.abs(core_avg - sim_avg)
     report = {
         "config": {"n_hosts": n, "C": C, "msgs_per_run": M,
-                   "runs": len(sim_curves), "dropped": incomplete},
-        "mean_mesh_degree": {
-            "core": round(float(np.mean([d[0] for d in degrees])), 3),
-            "sim": round(float(np.mean([d[1] for d in degrees])), 3)},
+                   "runs": len(sim_curves), "dropped": incomplete,
+                   "chunk": B, "mode": mode},
+        "sim_sweep_seconds": round(sim_seconds, 3),
         "hops": HOPS,
         "sim_mean_curve": [round(float(x), 4) for x in sim_avg],
-        "core_mean_curve": [round(float(x), 4) for x in core_avg],
-        "abs_delta_per_hop": [round(float(x), 4) for x in delta],
-        "max_abs_delta": round(float(delta.max()), 4),
-        "mean_abs_delta": round(float(delta.mean()), 4),
     }
+    if sim_only:
+        report["mean_mesh_degree"] = {
+            "sim": round(float(np.mean([d[1] for d in degrees])), 3)}
+        summary = {"runs": len(sim_curves), "mode": mode,
+                   "sim_sweep_seconds": report["sim_sweep_seconds"]}
+    else:
+        core_avg = np.mean(core_curves, axis=0)
+        delta = np.abs(core_avg - sim_avg)
+        report.update({
+            "mean_mesh_degree": {
+                "core": round(float(np.mean([d[0] for d in degrees])), 3),
+                "sim": round(float(np.mean([d[1] for d in degrees])), 3)},
+            "core_mean_curve": [round(float(x), 4) for x in core_avg],
+            "abs_delta_per_hop": [round(float(x), 4) for x in delta],
+            "max_abs_delta": round(float(delta.max()), 4),
+            "mean_abs_delta": round(float(delta.mean()), 4),
+        })
+        summary = {"curves_max_abs_delta": report["max_abs_delta"],
+                   "curves_mean_abs_delta": report["mean_abs_delta"],
+                   "runs": len(sim_curves)}
     with open(out_path, "w") as f:
         json.dump(report, f, indent=1)
-    print(json.dumps({"curves_max_abs_delta": report["max_abs_delta"],
-                      "curves_mean_abs_delta": report["mean_abs_delta"],
-                      "runs": len(sim_curves)}))
+    print(json.dumps(summary))
 
 
 if __name__ == "__main__":

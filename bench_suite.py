@@ -385,6 +385,94 @@ def bench_gossipsub_v11_everything():
         baseline=10_000.0, kernel=kernel)
 
 
+def bench_gossipsub_v11_churn():
+    """Degradation under faults (models/faults.py): 10% of peers cycle
+    down/up in staggered waves, every link drops 2% of ticks, and one
+    30-heartbeat partition splits the network in half mid-run.  XLA
+    path only (the pallas step refuses fault configs).  Emits THREE
+    rows: throughput under churn, the delivery-under-churn fraction,
+    and the partition-heal recovery time (ticks from heal to 99%
+    reachability for a publish still inside the IHAVE window at heal —
+    the OPTIMUMP2P-style headline metric)."""
+    import jax
+    import go_libp2p_pubsub_tpu.models.faults as fl
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    from go_libp2p_pubsub_tpu.models._delivery import recovery_ticks
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    n = 1_000_000 if on_accel else 100_000
+    t = 100
+    m, C = 32, 16
+    warmup, T = 100, 150
+    horizon = warmup + T
+    part_start, heal = warmup + 20, warmup + 50
+    rng = np.random.default_rng(0)
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, C, n, seed=0), n_topics=t)
+    score_cfg = gs.ScoreSimConfig()
+    # messages: most spread through the run; the last four published
+    # 2 ticks before heal from partition side 0 (the recovery probes)
+    topic, origin, tick = _msgs(rng, n, t, m, horizon - 40)
+    grp = (np.arange(n) < n // 2).astype(np.int64)
+    probe = np.arange(m - 4, m)
+    tick[probe] = heal - 2
+    origin[probe] = (origin[probe] % (n // 2 // t)) * t + topic[probe]
+    # churn: 10% of peers down for one of three staggered 20-tick waves
+    # — all rejoined by warmup+35, BEFORE the recovery probes publish
+    # (a peer down across a publish misses it forever once it ages out
+    # of the mcache window, so late churn would cap reachability below
+    # the 99% recovery threshold; that loss is the delivery-fraction
+    # row's business, the recovery row isolates the partition)
+    victims = np.flatnonzero(rng.random(n) < 0.10)
+    ivs = [(int(p), warmup + 5 + int(p % 3) * 5,
+            warmup + 25 + int(p % 3) * 5) for p in victims]
+    sched = fl.FaultSchedule(
+        n_peers=n, horizon=horizon, down_intervals=ivs, drop_prob=0.02,
+        partition_group=grp, partition_windows=[(part_start, heal)],
+        seed=1)
+    subs = _subs_matrix(n, t)
+    params, state = gs.make_gossip_sim(
+        cfg, subs, topic, origin, tick, score_cfg=score_cfg,
+        track_first_tick=False, fault_schedule=sched)
+    params = jax.device_put(params)
+    step = gs.make_gossip_step(cfg, score_cfg)
+    state = gs.gossip_run(params, jax.device_put(state), warmup, step)
+    _ = int(np.asarray(state.tick))
+    t0 = time.perf_counter()
+    state, counts = gs.gossip_run_curve(params, state, T, step, m)
+    counts = np.asarray(counts)
+    dt = time.perf_counter() - t0
+    want = np.full(m, n // t, dtype=np.float32)
+    # final delivered fraction from the possession words (the per-tick
+    # curve only covers the measured window; warmup-era publishes
+    # delivered most of their copies before it)
+    reach = np.asarray(gs.reach_counts_from_have(params, state))
+    # the recovery probes belong to the recovery row, not the churn
+    # delivery average (_msgs already bounds every tick < horizon - 40)
+    settled = np.ones(m, dtype=bool)
+    settled[probe] = False
+    churn_frac = float((reach[settled] / want[settled]).mean())
+    # per-tick counts start at warmup: index heal by (heal - warmup)
+    rec = np.asarray(recovery_ticks(counts, heal - warmup, want,
+                                    frac=0.99))[probe]
+    rec_ok = rec[rec >= 0]
+    emit(f"gossipsub_v11_churn_{n}peers_heartbeats_per_sec", T / dt,
+         "heartbeats/s",
+         extra={"faults": "10pct_churn+2pct_loss+partition"})
+    emit(f"gossipsub_v11_churn_{n}peers_delivery_fraction",
+         churn_frac, "fraction",
+         extra={"messages": int(settled.sum()),
+                "faults": "10pct_churn+2pct_loss+partition"})
+    assert churn_frac > 0.80, (
+        f"delivery collapsed under churn: {churn_frac}")
+    assert len(rec_ok), "no partition probe recovered"
+    emit(f"gossipsub_v11_partition_recovery_ticks_{n}peers",
+         float(np.median(rec_ok)), "ticks",
+         extra={"probes": int(len(rec)),
+                "recovered": int(len(rec_ok)),
+                "threshold": 0.99})
+
+
 BENCHES = {
     "floodsub_hosts": bench_floodsub_hosts,
     "randomsub_10k": bench_randomsub_10k,
@@ -394,6 +482,7 @@ BENCHES = {
     "gossipsub_v11_multitopic": bench_gossipsub_v11_multitopic,
     "gossipsub_v11_adversarial": bench_gossipsub_v11_adversarial,
     "gossipsub_v11_everything": bench_gossipsub_v11_everything,
+    "gossipsub_v11_churn": bench_gossipsub_v11_churn,
 }
 
 

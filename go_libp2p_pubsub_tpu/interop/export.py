@@ -35,19 +35,90 @@ def msg_id(m: int) -> bytes:
     return b"msg-%d" % m
 
 
+def _churn_items(fault_schedule, peer_topic: np.ndarray):
+    """FaultSchedule churn -> sorted (tick, kind, topic, peer) items,
+    kind -2 = LEAVE, -1 = JOIN — the single expansion both
+    churn_events and events_from_sim consume.
+
+    Adjacent intervals ([a, b) followed by [b, c) on one peer — legal
+    per the schedule validator, and one continuous outage to
+    alive_mask) are MERGED first, so the stream never shows a
+    same-tick JOIN+LEAVE pair that would leave a replay consumer
+    believing the peer came back up."""
+    per_peer: dict[int, list[list[int]]] = {}
+    for p, s, e in fault_schedule.down_intervals:
+        lst = per_peer.setdefault(int(p), [])
+        if lst and lst[-1][1] == s:      # validator guarantees sorted
+            lst[-1][1] = e
+        else:
+            lst.append([s, e])
+    items = []
+    for p, ivs in per_peer.items():
+        for s, e in ivs:
+            items.append((s, -2, int(peer_topic[p]), p))        # LEAVE
+            if e < fault_schedule.horizon:
+                items.append((e, -1, int(peer_topic[p]), p))    # JOIN
+    items.sort()
+    return items
+
+
+def churn_events(fault_schedule, peer_topic: np.ndarray,
+                 topic_name=lambda t: f"topic-{t}"):
+    """FaultSchedule churn -> JOIN/LEAVE TraceEvents (reference
+    trace.proto types 9/10 — the events the reference's own harness
+    emits when hosts come and go).
+
+    A peer LEAVEs its topic at each down interval's start and re-JOINs
+    at its end (no JOIN when the interval runs to the schedule horizon
+    — the peer never came back within the run).  ``peer_topic``: int
+    [N] residue-class topic per peer (the sim's membership model).
+    Returned sorted by (tick, LEAVE-before-JOIN-before-payload order),
+    mergeable into events_from_sim's stream via ``fault_schedule=``.
+    """
+    items = _churn_items(fault_schedule, peer_topic)
+    out = []
+    for t, kind, tpc, p in items:
+        if kind == -2:
+            out.append(tr.TraceEvent(
+                type=TraceType.LEAVE, peer_id=peer_id(p),
+                timestamp=t * NS_PER_TICK,
+                leave=tr.LeaveEv(topic=topic_name(tpc))))
+        else:
+            out.append(tr.TraceEvent(
+                type=TraceType.JOIN, peer_id=peer_id(p),
+                timestamp=t * NS_PER_TICK,
+                join=tr.JoinEv(topic=topic_name(tpc))))
+    return out
+
+
 def events_from_sim(first_tick_matrix: np.ndarray,
                     msg_topic: np.ndarray,
                     msg_origin: np.ndarray,
                     msg_publish_tick: np.ndarray,
-                    topic_name=lambda t: f"topic-{t}"):
+                    topic_name=lambda t: f"topic-{t}",
+                    fault_schedule=None,
+                    peer_topic: np.ndarray | None = None):
     """Yield TraceEvents (publish + every first delivery) in tick order.
 
     first_tick_matrix: int [N, M] (models *.first_tick_matrix output;
     -1 = not delivered).  Origins' own inject-tick deliveries are emitted
     as their PUBLISH_MESSAGE events.
+
+    With ``fault_schedule`` (+ ``peer_topic`` [N]), churn JOIN/LEAVE
+    events are merged into the stream in tick order (leave/join sort
+    before same-tick payload events), so churn runs validate against
+    reference traces that carry the same event types.
     """
     n, m = first_tick_matrix.shape
     items = []                              # (tick, kind, payload)
+    if fault_schedule is not None:
+        if peer_topic is None:
+            raise ValueError(
+                "fault_schedule needs peer_topic (int [N]): JOIN/LEAVE "
+                "events carry the churned peer's topic — a silent "
+                "topic-0 default would mislabel every multi-topic "
+                "churn trace")
+        items.extend(_churn_items(fault_schedule, peer_topic))
     for j in range(m):
         items.append((int(msg_publish_tick[j]), 0, j, int(msg_origin[j])))
     peers, msgs = np.nonzero(first_tick_matrix >= 0)
@@ -60,7 +131,17 @@ def events_from_sim(first_tick_matrix: np.ndarray,
     items.sort()                        # chronological stream, pubs first
     out = []
     for t, kind, j, p in items:
-        if kind == 0:
+        if kind == -2:
+            out.append(tr.TraceEvent(
+                type=TraceType.LEAVE, peer_id=peer_id(p),
+                timestamp=t * NS_PER_TICK,
+                leave=tr.LeaveEv(topic=topic_name(j))))
+        elif kind == -1:
+            out.append(tr.TraceEvent(
+                type=TraceType.JOIN, peer_id=peer_id(p),
+                timestamp=t * NS_PER_TICK,
+                join=tr.JoinEv(topic=topic_name(j))))
+        elif kind == 0:
             out.append(tr.TraceEvent(
                 type=TraceType.PUBLISH_MESSAGE,
                 peer_id=peer_id(p), timestamp=t * NS_PER_TICK,

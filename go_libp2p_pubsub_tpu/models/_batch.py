@@ -59,24 +59,66 @@ def _register_optimization_barrier_batcher() -> None:
 _register_optimization_barrier_batcher()
 
 
+def _describe_mismatch(i: int, ref_tree, tree) -> str | None:
+    """Human-readable field-level diff of two pytrees (replica i vs 0),
+    or None when they match.  Names the FIRST offending field by its
+    attribute path — the actionable error the stacking contract owes
+    callers, instead of the opaque treedef dump / downstream vmap
+    shape error."""
+    ks = jax.tree_util.keystr
+    ref_leaves = jax.tree_util.tree_flatten_with_path(ref_tree)[0]
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    ref_map = {ks(p): leaf for p, leaf in ref_leaves}
+    cur_map = {ks(p): leaf for p, leaf in leaves}
+    for path in sorted(set(ref_map) - set(cur_map)):
+        return (f"replica {i} is missing field {path!r} that replica 0 "
+                "has (None vs array: the replicas were built with "
+                "different options, so their pytree structure differs)")
+    for path in sorted(set(cur_map) - set(ref_map)):
+        return (f"replica {i} has field {path!r} that replica 0 lacks "
+                "(None vs array: the replicas were built with "
+                "different options, so their pytree structure differs)")
+    for path in sorted(ref_map):
+        a, b = ref_map[path], cur_map[path]
+        sa = getattr(a, "shape", None)
+        sb = getattr(b, "shape", None)
+        da = getattr(a, "dtype", None)
+        db = getattr(b, "dtype", None)
+        if sa != sb:
+            return (f"replica {i} field {path!r} has shape {sb} but "
+                    f"replica 0 has {sa} (peer/message/fault table "
+                    "sizes must match across the batch)")
+        if da != db:
+            return (f"replica {i} field {path!r} has dtype {db} but "
+                    f"replica 0 has {da}")
+    # leaves agree: any remaining difference is in static aux data
+    # (pytree_node=False fields — e.g. gates_fp, n_true,
+    # static_score_weights), which is part of the treedef
+    ref_def = jax.tree_util.tree_structure(ref_tree)
+    td = jax.tree_util.tree_structure(tree)
+    if td != ref_def:
+        return (f"replica {i} differs from replica 0 in static "
+                f"(non-array) config baked into the pytree structure:\n"
+                f"  {td}\nvs\n  {ref_def}")
+    return None
+
+
 def stack_trees(trees):
     """Stack a list of structurally-identical pytrees leaf-wise along a
     new leading replica axis.
 
     Static (non-leaf) fields must match across replicas — they are part
     of the tree structure, and a mismatch means the replicas were built
-    for different configs and cannot share one compiled step.
+    for different configs and cannot share one compiled step.  A
+    mismatch raises a ValueError naming the offending field (build
+    time), never an opaque vmap shape error later.
     """
     if not trees:
         raise ValueError("stack_trees needs at least one tree")
-    ref = jax.tree_util.tree_structure(trees[0])
     for i, t in enumerate(trees[1:], start=1):
-        td = jax.tree_util.tree_structure(t)
-        if td != ref:
-            raise ValueError(
-                f"replica {i} has a different pytree structure than "
-                f"replica 0 (static fields / None leaves must match "
-                f"across the batch):\n  {td}\nvs\n  {ref}")
+        msg = _describe_mismatch(i, trees[0], t)
+        if msg is not None:
+            raise ValueError(msg)
     return jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *trees)
 
 

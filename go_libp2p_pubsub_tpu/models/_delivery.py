@@ -59,3 +59,37 @@ def reach_by_hops_from_first_tick(first_tick: jnp.ndarray, m: int,
     per_hop = (ft[None, :, :] == hops[:, None, None]).sum(
         axis=1, dtype=jnp.int32)           # [max_hops, M]
     return jnp.cumsum(per_hop, axis=0).T   # [M, max_hops]
+
+
+# --------------------------------------------------------------------------
+# Degradation / recovery metrics (fault-injection runs, models/faults.py)
+# --------------------------------------------------------------------------
+
+
+def delivery_fraction_curve(counts: jnp.ndarray,
+                            want: jnp.ndarray) -> jnp.ndarray:
+    """f32 [T, M] cumulative delivered fraction per tick from the
+    ``*_run_curve`` per-tick counts [T, M].  ``want`` is the per-message
+    full-delivery peer count ([M] or scalar) — under churn the curve
+    plateaus below 1.0, and how far below IS the degradation metric."""
+    cum = jnp.cumsum(counts.astype(jnp.float32), axis=0)
+    return cum / jnp.maximum(jnp.asarray(want, dtype=jnp.float32), 1.0)
+
+
+def recovery_ticks(counts: jnp.ndarray, heal_tick: int,
+                   want: jnp.ndarray, frac: float = 0.99) -> jnp.ndarray:
+    """int32 [M]: ticks from ``heal_tick`` (e.g. a partition window's
+    end) until each message's cumulative delivery reaches ``frac`` of
+    ``want``; -1 = never within the run.  Messages already above the
+    threshold at heal report 0 — recovery was instant for them.
+
+    The headline resilience number (OPTIMUMP2P arxiv 2508.04833 frames
+    recovery-time-under-faults as the metric that matters): a finite
+    value certifies the mesh actually healed, its magnitude is the
+    repair latency in heartbeats."""
+    t = counts.shape[0]
+    reach = delivery_fraction_curve(counts, want) >= frac     # [T, M]
+    after = reach & (jnp.arange(t)[:, None] >= heal_tick)
+    ever = after.any(axis=0)
+    first = jnp.argmax(after, axis=0)                          # [M]
+    return jnp.where(ever, first - heal_tick, -1).astype(jnp.int32)

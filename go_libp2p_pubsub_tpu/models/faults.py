@@ -1,0 +1,413 @@
+"""Fault injection for the vectorized simulators: churn, link loss,
+and partition events.
+
+The reference's raison d'être is failure-resilient propagation (arxiv
+2007.02754); its own test harness churns peers (JOIN/LEAVE trace
+events) and drops RPCs (DROP_RPC) constantly.  This module gives the
+three TPU simulators the same adversities as data, not control flow:
+
+- ``FaultSchedule`` is the user-facing, host-side spec — validated
+  eagerly at construction (satellite contract: a bad schedule fails at
+  build time with a ValueError naming the field, never as a garbage
+  trajectory).
+- ``compile_faults`` lowers a schedule against a circulant offset set
+  into ``FaultParams``, a flax pytree of device arrays that rides the
+  simulator's params.  Every per-tick mask is then computed INSIDE the
+  scan with pure ``jnp`` ops — no host round-trips — and every leaf is
+  an array, so ``stack_trees``/``vmap`` batching works unchanged and
+  stacked replicas may carry distinct fault seeds, churn tables, and
+  partition maps (shapes must match across the batch, as for any
+  stacked leaf).
+
+Fault model (one tick = one heartbeat = one hop, as everywhere):
+
+- **Churn**: per-peer half-open down intervals ``[start, end)``.  A
+  peer that is down neither sends nor receives ANYTHING — payload,
+  gossip, or control — and does not inject its own publishes (a
+  publish due while down is lost, not deferred: the node was off).
+  ``alive_mask`` evaluates the interval table per tick: an [N, K]
+  compare, K = max intervals per peer.
+- **Link loss**: each UNDIRECTED candidate edge is down for a whole
+  tick with probability ``drop_prob`` (scalar, or per-edge [C, N] —
+  validated symmetric, since one edge has two views).  Symmetry comes
+  free from the draw itself: uniforms are drawn at the positive-offset
+  bits only and transferred to the partner's negative bits, so both
+  endpoints see the same coin.  A down link carries nothing either
+  way that tick — payload, IHAVE, and the GRAFT/PRUNE handshake alike
+  (the reference's DROP_RPC drops whole RPCs).
+- **Partitions**: a static group assignment [N] plus up-to-P tick
+  windows.  While any window is active, every candidate edge whose
+  endpoints sit in different groups is cut, splitting the peer set;
+  at heal the edges return and recovery proceeds through the normal
+  mesh-repair path (the recovery-time metric in models/_delivery.py
+  measures how fast).
+
+GossipSub semantics (threaded through models/gossipsub.py): edges to
+dead peers are dropped from the mesh with PRUNE/backoff semantics on
+the next heartbeat — BOTH sides start the same backoff clock at the
+death tick, so a rejoining peer and its old partners become mutually
+graftable again at the same time and the peer re-enters through the
+normal GRAFT path (deg < Dlo -> graft selection).  Handshake RPCs on a
+down link are lost atomically (graft and its A-response ride the same
+undirected edge-tick), so symmetric drops never leave a half-grafted
+mesh edge; a lost PRUNE can leave the pruned side unaware for a while,
+exactly as in the reference — gossip repair covers the gap.
+
+The pallas receive kernel does not honor fault masks; fault configs
+are REFUSED on that path (make_gossip_step raises, the same contract
+as its other refusals).  XLA path only.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..ops.graph import lane_uniform, pack_rows
+
+__all__ = [
+    "FaultSchedule",
+    "FaultParams",
+    "compile_faults",
+    "alive_mask",
+    "alive_word",
+    "cand_alive_bits",
+    "link_ok_bits",
+    "link_ok_rows",
+]
+
+
+# --------------------------------------------------------------------------
+# User-facing schedule (host side, validated at construction)
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class FaultSchedule:
+    """Validated fault spec for one simulation of ``n_peers`` peers over
+    ticks ``[0, horizon)``.
+
+    down_intervals: iterable of ``(peer, start, end)`` half-open down
+        windows (churn).  Per peer they must be sorted and
+        non-overlapping.
+    drop_prob: probability an undirected candidate edge is down for a
+        tick — a float, or a [C, N] per-edge array (symmetric across
+        the edge's two views; checked in compile_faults where the
+        offsets are known).
+    partition_group: optional int [N] group assignment; edges between
+        groups are cut during every partition window.
+    partition_windows: iterable of ``(start, end)`` half-open tick
+        windows, sorted and non-overlapping.
+    seed: the fault stream's own lane-hash salt — independent of the
+        simulator's PRNG key, so batched replicas can carry distinct
+        fault seeds (or share one) regardless of their mesh seeds.
+    """
+
+    n_peers: int
+    horizon: int
+    down_intervals: tuple = ()
+    drop_prob: object = 0.0
+    partition_group: object = None
+    partition_windows: tuple = ()
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.n_peers < 1:
+            raise ValueError("n_peers must be >= 1")
+        if self.horizon < 1:
+            raise ValueError("horizon must be >= 1 (ticks [0, horizon))")
+        ivs = tuple((int(p), int(s), int(e))
+                    for p, s, e in self.down_intervals)
+        object.__setattr__(self, "down_intervals", ivs)
+        per_peer: dict[int, list[tuple[int, int]]] = {}
+        for p, s, e in ivs:
+            if not (0 <= p < self.n_peers):
+                raise ValueError(
+                    f"down_intervals: peer {p} out of range "
+                    f"[0, {self.n_peers})")
+            if not (0 <= s < e <= self.horizon):
+                raise ValueError(
+                    f"down_intervals: interval [{s}, {e}) for peer {p} "
+                    f"must satisfy 0 <= start < end <= horizon="
+                    f"{self.horizon}")
+            per_peer.setdefault(p, []).append((s, e))
+        for p, lst in per_peer.items():
+            for (s0, e0), (s1, e1) in zip(lst, lst[1:]):
+                if s1 < e0:
+                    raise ValueError(
+                        f"down_intervals: peer {p} intervals "
+                        f"[{s0}, {e0}) and [{s1}, {e1}) overlap or are "
+                        "non-monotone (sort them, merge overlaps)")
+        dp = self.drop_prob
+        if np.isscalar(dp) or getattr(dp, "ndim", None) == 0:
+            if not (0.0 <= float(dp) <= 1.0):
+                raise ValueError(
+                    f"drop_prob: {float(dp)} outside [0, 1]")
+        else:
+            arr = np.asarray(dp, dtype=np.float32)
+            if arr.ndim != 2 or arr.shape[1] != self.n_peers:
+                raise ValueError(
+                    "drop_prob: per-edge form must be [C, n_peers] "
+                    f"(got shape {arr.shape})")
+            if ((arr < 0.0) | (arr > 1.0)).any():
+                raise ValueError(
+                    "drop_prob: per-edge values outside [0, 1]")
+            object.__setattr__(self, "drop_prob", arr)
+        wins = tuple((int(s), int(e)) for s, e in self.partition_windows)
+        object.__setattr__(self, "partition_windows", wins)
+        for s, e in wins:
+            if not (0 <= s < e <= self.horizon):
+                raise ValueError(
+                    f"partition_windows: window [{s}, {e}) must satisfy "
+                    f"0 <= start < end <= horizon={self.horizon}")
+        for (s0, e0), (s1, e1) in zip(wins, wins[1:]):
+            if s1 < e0:
+                raise ValueError(
+                    f"partition_windows: windows [{s0}, {e0}) and "
+                    f"[{s1}, {e1}) overlap or are non-monotone")
+        if wins and self.partition_group is None:
+            raise ValueError(
+                "partition_group: required when partition_windows are "
+                "given (who is on which side?)")
+        if self.partition_group is not None:
+            grp = np.asarray(self.partition_group)
+            if grp.shape != (self.n_peers,):
+                raise ValueError(
+                    f"partition_group: must be int [n_peers="
+                    f"{self.n_peers}] (got shape {grp.shape})")
+            if not np.issubdtype(grp.dtype, np.integer) or (grp < 0).any():
+                raise ValueError(
+                    "partition_group: must be non-negative integers")
+            object.__setattr__(self, "partition_group",
+                               grp.astype(np.int32))
+
+    @property
+    def max_down_intervals(self) -> int:
+        """K: the per-peer interval-table width (max intervals on any
+        one peer)."""
+        if not self.down_intervals:
+            return 0
+        counts = np.bincount(
+            np.asarray([p for p, _, _ in self.down_intervals]),
+            minlength=self.n_peers)
+        return int(counts.max())
+
+
+# --------------------------------------------------------------------------
+# Compiled device-side form (a pytree leaf set riding the sim params)
+# --------------------------------------------------------------------------
+
+
+@struct.dataclass
+class FaultParams:
+    """Device arrays compiled from a FaultSchedule against one circulant
+    offset set.  Every field is an array leaf, so stacked replica
+    batches (stack_trees / vmap) carry and vary faults like any other
+    per-replica data.  ``None`` link/partition fields mean that fault
+    class is inactive (host-decided at compile time, so clean runs pay
+    nothing for the absent class)."""
+
+    down_start: jnp.ndarray          # int32 [N, K] (K may be 0)
+    down_end: jnp.ndarray            # int32 [N, K]
+    seed: jnp.ndarray                # uint32 [] fault-stream salt
+    drop_prob: jnp.ndarray | None = None   # f32 [] or [C, N]
+    cross_bits: jnp.ndarray | None = None  # uint32 [N] partition-crossing
+    #   edges (C <= 32 packed form) — exactly one of cross_bits /
+    #   cross_rows is set when partitions are active
+    cross_rows: jnp.ndarray | None = None  # bool [C, N] unpacked form
+    part_start: jnp.ndarray | None = None  # int32 [P]
+    part_end: jnp.ndarray | None = None    # int32 [P]
+
+
+# lane_uniform phase for the per-tick link draws.  Must stay disjoint
+# from the simulator phases (gossipsub uses 1-7 and 12/13/15; randomsub
+# uses 1) — the fault stream additionally has its own salt, but keeping
+# the phase space disjoint makes the draws independent even under a
+# shared seed.
+LINK_PHASE = 9
+
+
+def compile_faults(schedule: FaultSchedule, offsets,
+                   pack_links: bool | None = None) -> FaultParams:
+    """Lower a FaultSchedule against a circulant ``offsets`` set.
+
+    pack_links=True stores partition-crossing edges as a packed uint32
+    [N] word (requires C <= 32 — the gossipsub form); False stores bool
+    [C, N] rows (floodsub/randomsub, where C may exceed 32).  Default:
+    packed iff C <= 32.
+    """
+    offs = tuple(int(o) for o in offsets)
+    C = len(offs)
+    n = schedule.n_peers
+    idx = {o: i for i, o in enumerate(offs)}
+    if any(-o not in idx for o in offs):
+        raise ValueError("offsets must be closed under negation "
+                         "(fault link masks pair each edge's two views)")
+    cinv = tuple(idx[-o] for o in offs)
+    if 0 in idx:
+        raise ValueError("offsets must not contain 0 (self-edges have "
+                         "no link to drop)")
+    if pack_links is None:
+        pack_links = C <= 32
+    if pack_links and C > 32:
+        raise ValueError("pack_links needs C <= 32")
+
+    k = schedule.max_down_intervals
+    down_start = np.zeros((n, k), dtype=np.int32)
+    down_end = np.zeros((n, k), dtype=np.int32)   # start==end: empty slot
+    fill = np.zeros(n, dtype=np.int64)
+    for p, s, e in schedule.down_intervals:
+        down_start[p, fill[p]] = s
+        down_end[p, fill[p]] = e
+        fill[p] += 1
+
+    kw = {}
+    dp = schedule.drop_prob
+    if isinstance(dp, np.ndarray):
+        if dp.shape[0] != C:
+            raise ValueError(
+                f"drop_prob: per-edge form is [C={dp.shape[0]}, N] but "
+                f"the offset set has C={C} candidates")
+        # one undirected edge, two views: p's bit c and (p+o_c)'s bit
+        # cinv[c] describe the same link and must carry the same
+        # probability (np.roll(x, -o)[p] = x[p+o])
+        for c, o in enumerate(offs):
+            if not np.allclose(dp[c], np.roll(dp[cinv[c]], -o)):
+                raise ValueError(
+                    "drop_prob: per-edge probabilities must be "
+                    "symmetric — peer p's bit c and peer p+o_c's bit "
+                    "cinv[c] describe one edge")
+        kw["drop_prob"] = jnp.asarray(dp)
+    elif float(dp) > 0.0:
+        kw["drop_prob"] = jnp.float32(float(dp))
+
+    if schedule.partition_windows:
+        grp = schedule.partition_group
+        cross = np.stack([grp != np.roll(grp, -o) for o in offs],
+                         axis=0)                       # bool [C, N]
+        if pack_links:
+            bits = np.zeros(n, dtype=np.uint32)
+            for c in range(C):
+                bits |= cross[c].astype(np.uint32) << c
+            kw["cross_bits"] = jnp.asarray(bits)
+        else:
+            kw["cross_rows"] = jnp.asarray(cross)
+        kw["part_start"] = jnp.asarray(
+            np.asarray([s for s, _ in schedule.partition_windows],
+                       dtype=np.int32))
+        kw["part_end"] = jnp.asarray(
+            np.asarray([e for _, e in schedule.partition_windows],
+                       dtype=np.int32))
+
+    return FaultParams(
+        down_start=jnp.asarray(down_start),
+        down_end=jnp.asarray(down_end),
+        seed=jnp.uint32(schedule.seed & 0xFFFFFFFF),
+        **kw)
+
+
+# --------------------------------------------------------------------------
+# Per-tick mask computation (pure jnp — runs inside the scan)
+# --------------------------------------------------------------------------
+
+
+def alive_mask(fp: FaultParams, tick) -> jnp.ndarray:
+    """bool [N]: peer up at ``tick`` (no down interval covers it)."""
+    if fp.down_start.shape[1] == 0:
+        return jnp.ones(fp.down_start.shape[0], dtype=bool)
+    down = jnp.any((tick >= fp.down_start) & (tick < fp.down_end),
+                   axis=1)
+    return ~down
+
+
+def alive_word(alive: jnp.ndarray) -> jnp.ndarray:
+    """bool [N] -> uint32 [N] all-ones/all-zeros word mask (gates packed
+    possession words)."""
+    return jnp.where(alive, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+
+
+def cand_alive_bits(alive: jnp.ndarray, offsets) -> jnp.ndarray:
+    """uint32 [N]: bit c set iff candidate p + offsets[c] is alive
+    (C <= 32 packed form; C rolls of a bool [N])."""
+    out = jnp.zeros(alive.shape, dtype=jnp.uint32)
+    for c, off in enumerate(offsets):
+        out = out | (jnp.roll(alive, -int(off), axis=0)
+                     .astype(jnp.uint32) << jnp.uint32(c))
+    return out
+
+
+def _partition_active(fp: FaultParams, tick):
+    return jnp.any((tick >= fp.part_start) & (tick < fp.part_end))
+
+
+def _link_drop_draw(fp: FaultParams, C: int, n: int, tick, stride: int):
+    """bool [C, N] directed draw field for this tick (fault-seeded
+    lane hash; the callers symmetrize by keeping positive-offset bits
+    and transferring)."""
+    u = lane_uniform((C, n), tick, LINK_PHASE, fp.seed, stride=stride)
+    return u < fp.drop_prob
+
+
+def link_ok_bits(fp: FaultParams, offsets, cinv, tick,
+                 n_stream: int | None = None) -> jnp.ndarray | None:
+    """Packed per-edge link mask: uint32 [N], bit c set iff the
+    undirected edge (p, p + offsets[c]) is UP this tick.  None when no
+    link faults are configured (pure churn).  Symmetric by
+    construction: drops are drawn at the positive-offset bits and
+    transferred to the partner's bits, so both views flip together.
+    """
+    if fp.drop_prob is None and fp.cross_bits is None:
+        return None
+    C = len(offsets)
+    n = fp.down_start.shape[0]
+    ALL = jnp.uint32((1 << C) - 1)
+    drop = jnp.zeros((n,), dtype=jnp.uint32)
+    if fp.drop_prob is not None:
+        pos = jnp.uint32(sum(1 << c for c, o in enumerate(offsets)
+                             if int(o) > 0))
+        draw = pack_rows(_link_drop_draw(
+            fp, C, n, tick, n_stream if n_stream is not None else n))
+        draw = draw & pos
+        # transfer the positive bits to the partner's negative bits
+        # (transfer_bits without the cfg dependency: bit c rolled by
+        # offsets[c] lands in the partner's bit cinv[c])
+        mirror = jnp.zeros_like(draw)
+        for c, off in enumerate(offsets):
+            if int(off) <= 0:
+                continue
+            b = (draw >> jnp.uint32(c)) & jnp.uint32(1)
+            mirror = mirror | (jnp.roll(b, int(off), axis=0)
+                               << jnp.uint32(cinv[c]))
+        drop = draw | mirror
+    if fp.cross_bits is not None:
+        drop = drop | jnp.where(_partition_active(fp, tick),
+                                fp.cross_bits, jnp.uint32(0))
+    return ~drop & ALL
+
+
+def link_ok_rows(fp: FaultParams, offsets, cinv, tick,
+                 n_stream: int | None = None) -> jnp.ndarray | None:
+    """Unpacked link mask: bool [C, N], True = edge up.  The C > 32
+    form (randomsub) and the floodsub circulant path.  None when no
+    link faults are configured."""
+    if fp.drop_prob is None and fp.cross_rows is None:
+        return None
+    C = len(offsets)
+    n = fp.down_start.shape[0]
+    up = jnp.ones((C, n), dtype=bool)
+    if fp.drop_prob is not None:
+        draw = _link_drop_draw(
+            fp, C, n, tick, n_stream if n_stream is not None else n)
+        rows = [None] * C
+        for c, off in enumerate(offsets):
+            if int(off) > 0:
+                rows[c] = draw[c]
+                rows[cinv[c]] = jnp.roll(draw[c], int(off), axis=0)
+        up = ~jnp.stack(rows, axis=0)
+    if fp.cross_rows is not None:
+        up = up & ~(fp.cross_rows
+                    & _partition_active(fp, tick))
+    return up

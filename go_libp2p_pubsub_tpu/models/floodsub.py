@@ -40,6 +40,7 @@ from ._delivery import (
     reach_counts_from_first_tick,
     update_first_tick,
 )
+from . import faults as _faults
 
 
 @struct.dataclass
@@ -53,6 +54,8 @@ class FloodParams:
     deliver_words: jnp.ndarray # uint32 [W, N]: counts as delivery for bit m
     origin_words: jnp.ndarray  # uint32 [W, N]: bit m set at origin[m]
     publish_tick: jnp.ndarray  # int32 [M]
+    # compiled fault schedule (models/faults.py) — circulant step only
+    faults: _faults.FaultParams | None = None
 
 
 @struct.dataclass
@@ -67,12 +70,18 @@ class FloodState:
 def make_flood_sim(nbrs: np.ndarray, nbr_mask: np.ndarray, subs: np.ndarray,
                    relays: np.ndarray | None, msg_topic: np.ndarray,
                    msg_origin: np.ndarray, msg_publish_tick: np.ndarray,
-                   track_first_tick: bool = True):
+                   track_first_tick: bool = True,
+                   fault_schedule: _faults.FaultSchedule | None = None,
+                   fault_offsets=None):
     """Build (params, state) for a flood simulation.
 
     subs/relays: bool [N, T]; msg_*: [M] arrays describing the message table.
     track_first_tick=False drops the per-(peer, message) delivery-tick array
     (use flood_run_curve's per-tick counts instead) — the fast path.
+
+    fault_schedule (models/faults.py) injects churn/link-loss/partition
+    events; honored by the CIRCULANT step only (pass the step's offsets
+    as ``fault_offsets``) — the gather-based nbrs path refuses faults.
     """
     n = subs.shape[0]
     m = len(msg_topic)
@@ -88,6 +97,23 @@ def make_flood_sim(nbrs: np.ndarray, nbr_mask: np.ndarray, subs: np.ndarray,
     origin_bits = np.zeros((n, m), dtype=bool)
     origin_bits[msg_origin, np.arange(m)] = True
 
+    fparams = None
+    if fault_schedule is not None:
+        if nbrs is not None:
+            raise ValueError(
+                "fault_schedule: circulant topologies only (nbrs=None); "
+                "the gather-based path has no per-edge link masks")
+        if fault_offsets is None:
+            raise ValueError(
+                "fault_schedule needs fault_offsets (the circulant "
+                "offsets the step was built with)")
+        if fault_schedule.n_peers != n:
+            raise ValueError(
+                f"fault_schedule.n_peers={fault_schedule.n_peers} != "
+                f"sim peer count {n}")
+        fparams = _faults.compile_faults(fault_schedule, fault_offsets,
+                                         pack_links=False)
+
     # a peer forwards what it is subscribed/relaying for, plus its own
     # publishes (publish-without-subscribe floods too, floodsub.go:76-100)
     fwd = sub_bits | relay_bits | origin_bits
@@ -98,6 +124,7 @@ def make_flood_sim(nbrs: np.ndarray, nbr_mask: np.ndarray, subs: np.ndarray,
         deliver_words=pack_bits_pm(jnp.asarray(sub_bits)),
         origin_words=pack_bits_pm(jnp.asarray(origin_bits)),
         publish_tick=jnp.asarray(msg_publish_tick, dtype=jnp.int32),
+        faults=fparams,
     )
     w = params.fwd_words.shape[0]
     state = FloodState(
@@ -112,6 +139,11 @@ def make_flood_sim(nbrs: np.ndarray, nbr_mask: np.ndarray, subs: np.ndarray,
 def flood_step(params: FloodParams, state: FloodState) -> FloodState:
     """One virtual tick: inject due publishes, propagate one hop, record
     first deliveries.  Pure function — jit/shard_map friendly."""
+    if params.faults is not None:
+        raise ValueError(
+            "fault injection needs the circulant step "
+            "(make_circulant_flood_step); the gather path has no "
+            "per-edge link masks")
     heard = propagate_pm(state.have & params.fwd_words, params.nbrs,
                          params.nbr_mask)
     return _finish_step(params, state, heard)[0]
@@ -129,7 +161,9 @@ def make_circulant_flood_step(offsets):
 
 
 def _finish_step(params: FloodParams, state: FloodState,
-                 heard: jnp.ndarray) -> tuple[FloodState, jnp.ndarray]:
+                 heard: jnp.ndarray,
+                 alive: jnp.ndarray | None = None
+                 ) -> tuple[FloodState, jnp.ndarray]:
     # the hop used what peers had at the END of the previous tick —
     # a publish at tick t reaches direct neighbors at t+1
     new_bits = heard & ~state.have
@@ -138,6 +172,10 @@ def _finish_step(params: FloodParams, state: FloodState,
     # then inject messages whose publish tick is now
     due = pack_bits(params.publish_tick == state.tick)          # [W]
     injected = params.origin_words & due[:, None] & ~state.have
+    if alive is not None:
+        # a down origin does not publish: the message is lost, not
+        # deferred (the node was off at its publish tick)
+        injected = injected & _faults.alive_word(alive)[None, :]
     have = state.have | accepted | injected
 
     # delivery accounting (origin's own publish counts at inject tick)
@@ -199,12 +237,39 @@ def flood_run_batch(params: FloodParams, state: FloodState, n_ticks: int,
 
 
 def make_circulant_step_core(offsets):
-    """(params, state) -> (state, delivered_words) over a circulant graph."""
+    """(params, state) -> (state, delivered_words) over a circulant
+    graph.  Honors ``params.faults`` (models/faults.py): a down peer
+    neither sends, receives, nor injects; a down link carries nothing
+    that tick; partition windows cut cross-group edges."""
     offsets = tuple(int(o) for o in offsets)
+    idx = {o: i for i, o in enumerate(offsets)}
+    cinv = (tuple(idx[-o] for o in offsets)
+            if all(-o in idx for o in offsets) else None)
 
     def core(params: FloodParams, state: FloodState):
-        heard = propagate_circulant(state.have & params.fwd_words, offsets)
-        return _finish_step(params, state, heard)
+        if params.faults is None:
+            heard = propagate_circulant(state.have & params.fwd_words,
+                                        offsets)
+            return _finish_step(params, state, heard)
+        fp = params.faults
+        alive = _faults.alive_mask(fp, state.tick)
+        aw = _faults.alive_word(alive)
+        link = _faults.link_ok_rows(fp, offsets, cinv, state.tick)
+        src = state.have & params.fwd_words & aw[None, :]  # sender up
+        if link is None:
+            # pure churn: every edge carries, so the hop IS the tuned
+            # propagation kernel — only the endpoints are masked
+            heard = propagate_circulant(src, offsets) & aw[None, :]
+            return _finish_step(params, state, heard, alive=alive)
+        w_rows = []
+        for w in range(src.shape[0]):
+            out = jnp.zeros_like(src[w])
+            for c, off in enumerate(offsets):
+                sent = jnp.where(link[c], src[w], jnp.uint32(0))
+                out = out | jnp.roll(sent, off, axis=0)
+            w_rows.append(out)
+        heard = jnp.stack(w_rows, axis=0) & aw[None, :]    # receiver up
+        return _finish_step(params, state, heard, alive=alive)
 
     return core
 

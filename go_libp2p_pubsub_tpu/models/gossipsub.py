@@ -122,6 +122,7 @@ from ._delivery import (
     first_tick_to_matrix,
     update_first_tick,
 )
+from . import faults as _faults
 
 
 # --------------------------------------------------------------------------
@@ -501,6 +502,10 @@ class GossipParams:
     # (gossipsub.go:737-745).  The sim's always-on edge is the analog
     # of the periodic directConnect reconnection (gossipsub.go:1594).
     cand_direct: jnp.ndarray | None = None       # uint32 [N]
+    # compiled fault schedule (models/faults.py): per-tick churn/link-
+    # loss/partition masks, computed inside the scan.  XLA path only —
+    # the pallas step refuses fault configs.
+    faults: _faults.FaultParams | None = None
 
 
 @struct.dataclass
@@ -598,7 +603,8 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
                     promise_break: np.ndarray | None = None,
                     px_candidates: int | None = None,
                     direct_edges: np.ndarray | None = None,
-                    pad_to_block: int | None = None):
+                    pad_to_block: int | None = None,
+                    fault_schedule: _faults.FaultSchedule | None = None):
     """Build (params, state).  subs: bool [N, T] — but each peer may only
     subscribe to its residue-class topic (circulant classes are closed, so
     cross-class subscriptions would never receive anything).
@@ -616,6 +622,10 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
     network: they flood everything they hold to all subscribed candidates
     and are flooded by gossipsub peers, but never join meshes or exchange
     gossip (gossipsub_feat.go:11-52, gossipsub.go:969-974).
+
+    fault_schedule (models/faults.py) injects churn/link-loss/partition
+    events into the step — XLA path only, so it is incompatible with
+    pad_to_block (the pallas step refuses fault configs).
     """
     n, t = subs.shape
     if t != cfg.n_topics:
@@ -778,6 +788,18 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
             raise ValueError("promise_break requires score_cfg (P7)")
         kw.update(promise_break=jnp.asarray(
             padl(np.asarray(promise_break, dtype=bool))))
+
+    if fault_schedule is not None:
+        if pad_to_block is not None:
+            raise ValueError(
+                "fault_schedule is XLA-path only: the pallas step "
+                "(pad_to_block) refuses fault configs")
+        if fault_schedule.n_peers != n:
+            raise ValueError(
+                f"fault_schedule.n_peers={fault_schedule.n_peers} != "
+                f"sim peer count {n}")
+        kw.update(faults=_faults.compile_faults(
+            fault_schedule, cfg.offsets, pack_links=True))
 
     params = GossipParams(
         subscribed=jnp.asarray(padl(subscribed)),
@@ -1613,6 +1635,11 @@ def make_gossip_step(cfg: GossipSimConfig,
                     "pallas step needs make_gossip_sim(pad_to_block=...)")
             if (C > 16 or W == 0 or params.flood_proto is not None
                     or state.gates is None
+                    # fault masks are not threaded through the mosaic
+                    # kernel: fault configs are refused outright, the
+                    # same contract as the other refusals (run faults
+                    # on the XLA path)
+                    or params.faults is not None
                     or (sc is not None and (sc.track_p3
                                             # the kernel adds the baked
                                             # static P5+P6 term as-is;
@@ -1627,7 +1654,7 @@ def make_gossip_step(cfg: GossipSimConfig,
                 raise ValueError(
                     "config not supported by the pallas step (needs "
                     "C<=16, W>=1, carried gates, matching static score "
-                    "weights, no flood_proto/track_p3)")
+                    "weights, no flood_proto/track_p3/faults)")
         elif params.n_true is not None:
             raise ValueError(
                 "padded sim state requires the pallas step (XLA rolls "
@@ -1640,6 +1667,28 @@ def make_gossip_step(cfg: GossipSimConfig,
         salt = jax.random.key_data(state.key)[-1]
         n_stream = params.n_true if params.n_true is not None else n
         u_spec = lambda phase: (C, tick, phase, salt, n_stream)  # noqa: E731
+
+        # -- fault masks (models/faults.py): computed once per tick from
+        # the compiled schedule, pure jnp.  f_alive_w gates packed
+        # possession words (receiver side), f_send_ok gates per-edge
+        # send masks (sender alive AND link up — symmetric drops, so
+        # an edge-tick loses its payload, gossip, AND handshake RPCs in
+        # both directions atomically), f_cand_alive marks candidates
+        # that are up (mesh maintenance: dead edges drop with PRUNE/
+        # backoff semantics, rejoin goes through the normal GRAFT path).
+        fp = params.faults
+        if fp is not None:
+            f_alive = _faults.alive_mask(fp, tick)              # bool [N]
+            f_alive_w = _faults.alive_word(f_alive)             # u32 [N]
+            f_alive_all = jnp.where(f_alive, ALL, Z)
+            f_cand_alive = _faults.cand_alive_bits(f_alive, offsets)
+            f_link = _faults.link_ok_bits(fp, offsets, cinv, tick,
+                                          n_stream)
+            f_send_ok = (f_alive_all if f_link is None
+                         else f_alive_all & f_link)
+        else:
+            f_alive = f_alive_w = f_alive_all = None
+            f_cand_alive = f_send_ok = None
 
         # -- 0. start-of-tick gate words --------------------------------
         # Normally READ from the state: the previous tick's epilogue (or
@@ -1703,6 +1752,10 @@ def make_gossip_step(cfg: GossipSimConfig,
         due = pack_bits(params.publish_tick == tick)            # [W]
         injected = [params.origin_words[w] & due[w] & ~state.have[w]
                     for w in range(W)]
+        if fp is not None:
+            # a down origin does not publish: the message is lost, not
+            # deferred (the node was off at its publish tick)
+            injected = [inj & f_alive_w for inj in injected]
         publishing = jnp.zeros((n,), dtype=bool)
         for w in range(W):
             publishing = publishing | (injected[w] != 0)        # [N]
@@ -1731,6 +1784,9 @@ def make_gossip_step(cfg: GossipSimConfig,
             f_elig = f_elig & ~params.cand_flood_bits
         if sc is not None:  # fanout requires score >= publish threshold
             f_elig = f_elig & pub_ok_bits
+        if fp is not None:
+            # dead candidates make useless fanout targets
+            f_elig = f_elig & f_cand_alive
         fanout = fanout | jax.lax.cond(
             jnp.any(f_need > 0),
             lambda: sel_k(f_elig, f_need, u_spec(4)),
@@ -1786,6 +1842,17 @@ def make_gossip_step(cfg: GossipSimConfig,
             flood_bits = params.cand_sub_bits & pub_ok_bits
         else:
             flood_bits = None
+
+        if fp is not None:
+            # faults cut SENDS at their source masks: a down peer (or a
+            # down link's endpoint) forwards nothing, gossips nothing,
+            # and flood-publishes nothing this tick.  Receivers are
+            # gated at the rolled words below; the handshake transfers
+            # carry the same mask inside raw_transfers.
+            out_bits = out_bits & f_send_ok
+            targets = targets & f_send_ok
+            if flood_bits is not None:
+                flood_bits = flood_bits & f_send_ok
 
         have_start = state.have
         seen = [have_start[w] | injected[w] for w in range(W)]
@@ -1846,6 +1913,17 @@ def make_gossip_step(cfg: GossipSimConfig,
         mesh_before = state.mesh
 
         def maintain(mesh0, bo_row0, ph_graft, ph_prune, ph_og):
+            dead = None
+            if fp is not None:
+                # churn: edges to dead candidates — and a dead peer's
+                # own whole mesh — drop with PRUNE/backoff semantics
+                # (folded into ``dropped`` below).  BOTH ends start the
+                # same backoff clock at the death tick, so a rejoining
+                # peer and its old partners become mutually graftable
+                # again at the same heartbeat and rejoin rides the
+                # normal deg < Dlo GRAFT path.
+                dead = mesh0 & ~(f_cand_alive & f_alive_all)
+                mesh0 = mesh0 & ~dead
             if sc is not None:
                 # drop negative-score mesh members first (:1332)
                 neg = mesh0 & ~nonneg_bits
@@ -1875,6 +1953,10 @@ def make_gossip_step(cfg: GossipSimConfig,
                 can_graft = jnp.where(params.flood_proto, Z, can_graft)
             if sc is not None:
                 can_graft = can_graft & nonneg_bits
+            if fp is not None:
+                # no grafting AT dead candidates, and no maintenance BY
+                # a dead peer
+                can_graft = can_graft & f_cand_alive & f_alive_all
             need = jnp.where(deg < cfg.d_lo, cfg.d - deg, 0)
             grafts = jax.lax.cond(
                 jnp.any(need > 0),
@@ -1950,9 +2032,15 @@ def make_gossip_step(cfg: GossipSimConfig,
                 grafts = jnp.where(params.sybil,
                                    params.cand_sub_bits & ~mesh_ng,
                                    grafts)
+            if fp is not None:
+                # safety net over the overrides above: not even a
+                # graft-flooding sybil grafts while dead or at the dead
+                grafts = grafts & f_cand_alive & f_alive_all
 
             mesh_sel = (mesh_ng | grafts) & ~prunes
             dropped = prunes if neg is None else prunes | neg
+            if dead is not None:
+                dropped = dropped | dead
             backoff_bits2 = backoff_bits | dropped  # post-write backoff
             # bits, derived algebraically (the only edges whose backoff
             # changed are prunes|neg, all set beyond tick)
@@ -2019,6 +2107,10 @@ def make_gossip_step(cfg: GossipSimConfig,
             lack_any = jnp.zeros((n,), dtype=bool)
             for w in range(W):
                 lack_any = lack_any | ((~seen[w]) != 0)
+            if fp is not None:
+                # a down receiver got no advert, so it records no
+                # broken promise this tick
+                lack_any = lack_any & f_alive
 
         # Columns are independent: every same-tick deliverer of a new
         # message gets delivery credit (the reference's near-first window
@@ -2057,6 +2149,10 @@ def make_gossip_step(cfg: GossipSimConfig,
                 # includes the direct word)
                 send_fwd_b = send_fwd_b | (params.cand_direct
                                            & params.cand_sub_bits)
+            if paired and fp is not None:
+                # slot-B forwards are sends too (out_bits carried the
+                # slot-A mask only)
+                send_fwd_b = send_fwd_b & f_send_ok
             if sc is not None:
                 # with every edge's payload AND gossip gate open (no
                 # attackers, no graylisting — the clean steady state)
@@ -2101,6 +2197,8 @@ def make_gossip_step(cfg: GossipSimConfig,
                         sent = sent | jnp.where(
                             bit_row(send_flood, c_send), injected[w], Z)
                     rolled = jnp.roll(sent, off, axis=0)
+                    if fp is not None:
+                        rolled = rolled & f_alive_w  # down peers hear 0
                     news = rolled & ~seen[w]
                     if sc is not None:
                         # barrier: force ONE materialization of this
@@ -2140,6 +2238,8 @@ def make_gossip_step(cfg: GossipSimConfig,
                     rolled = jnp.roll(sent, off, axis=0)
                     if ok_j is not None:
                         rolled = jnp.where(ok_j, rolled, Z)
+                    if fp is not None:
+                        rolled = rolled & f_alive_w  # down peers hear 0
                     news = rolled & ~seen[w]
                     mesh_heard[w] = mesh_heard[w] | news
                     if sc is not None:
@@ -2169,6 +2269,8 @@ def make_gossip_step(cfg: GossipSimConfig,
                     rolled = jnp.roll(sent, off, axis=0)
                     if ok_j is not None:
                         rolled = jnp.where(ok_j, rolled, Z)
+                    if fp is not None:
+                        rolled = rolled & f_alive_w  # down peers hear 0
                     news = rolled & ~seen_g[w]
                     gossip_heard[w] = gossip_heard[w] | news
                     if sc is not None:
@@ -2220,6 +2322,18 @@ def make_gossip_step(cfg: GossipSimConfig,
         # (C rolls) and one serial dependency shorter.
         def raw_transfers(sel, skip_a=False):
             grafts_s, dropped_s = sel["grafts"], sel["dropped"]
+            if fp is not None:
+                # handshake RPCs are sends like any other: a dead peer
+                # (or a down link) transmits no GRAFT/PRUNE/A this tick.
+                # The local effects of ``dropped`` (mesh removal, own
+                # backoff) still apply — only the notification is lost,
+                # as when the reference's PRUNE RPC is dropped.
+                grafts_tx = grafts_s & f_send_ok
+                dropped_tx = dropped_s & f_send_ok
+                a_tx = sel["a_sent"] & f_send_ok
+            else:
+                grafts_tx, dropped_tx = grafts_s, dropped_s
+                a_tx = sel["a_sent"]
 
             def live():
                 if C <= 16:
@@ -2227,15 +2341,15 @@ def make_gossip_step(cfg: GossipSimConfig,
                     # the A mask a second (2C rolls; was 3C with
                     # reject-back)
                     recv = transfer_bits(
-                        grafts_s | (dropped_s << jnp.uint32(16)), cfg,
+                        grafts_tx | (dropped_tx << jnp.uint32(16)), cfg,
                         pair=True)
                     graft_recv = recv & ALL
                     prune_recv = recv >> jnp.uint32(16)
                 else:
-                    graft_recv = transfer_bits(grafts_s, cfg)
-                    prune_recv = transfer_bits(dropped_s, cfg)
+                    graft_recv = transfer_bits(grafts_tx, cfg)
+                    prune_recv = transfer_bits(dropped_tx, cfg)
                 a_recv = (jnp.zeros_like(grafts_s) if skip_a
-                          else transfer_bits(sel["a_sent"], cfg))
+                          else transfer_bits(a_tx, cfg))
                 return graft_recv, prune_recv, a_recv
 
             def idle():
@@ -2251,6 +2365,11 @@ def make_gossip_step(cfg: GossipSimConfig,
             return graft_recv, prune_recv, (None if skip_a else a_recv)
 
         def resolve(sel, graft_recv, prune_recv, a_recv):
+            if fp is not None:
+                # a down peer processes no inbound control either
+                graft_recv = graft_recv & f_alive_all
+                prune_recv = prune_recv & f_alive_all
+                a_recv = a_recv & f_alive_all
             if sc is not None:
                 # graylisted peers' control traffic is dropped outright
                 graft_recv = graft_recv & accept_bits
@@ -2295,11 +2414,12 @@ def make_gossip_step(cfg: GossipSimConfig,
             # silently disable every slot-B-informed retraction
             # (caught by the kernel-parity suite, which transfers the
             # per-slot A bits individually and retracts correctly)
+            a_ok = ALL if fp is None else (ALL & f_send_ok)
             a_both = jax.lax.cond(
                 jnp.any((sel_a["grafts"] | sel_b["grafts"]) != 0),
                 lambda: transfer_bits(
-                    (sel_a["a_sent"] & ALL)
-                    | ((sel_b["a_sent"] & ALL) << jnp.uint32(16)),
+                    (sel_a["a_sent"] & a_ok)
+                    | ((sel_b["a_sent"] & a_ok) << jnp.uint32(16)),
                     cfg, pair=True),
                 lambda: jnp.zeros_like(sel_a["grafts"]))
             aa = a_both & ALL
@@ -2399,6 +2519,13 @@ def make_gossip_step(cfg: GossipSimConfig,
                 budget = cfg.gossip_retransmission * partner_adv
                 flood = jnp.where((s32 < budget) & (partner_adv > 0),
                                   partner_adv, 0)
+                if fp is not None:
+                    # no IWANT flood over a faulted edge: a dead sybil
+                    # requests nothing, a dead (or link-cut) partner
+                    # serves nothing
+                    flood = jnp.where(
+                        expand_bits(f_send_ok & f_cand_alive, C),
+                        flood, 0)
                 pulls = jnp.where(params.sybil[None, :], flood, pulls)
             decayed = s32 - (s32 + cfg.history_length - 1
                              ) // cfg.history_length
@@ -2538,9 +2665,25 @@ def stack_sims(cfg: GossipSimConfig, specs, **common):
     All replicas share ``cfg`` (and any score_cfg) because the step
     bakes the circulant offsets in as compile-time constants — replicas
     may vary anything that lives in arrays: seed, publishers, message
-    tables, subscriptions, sybil flags, ...
+    tables, subscriptions, sybil flags, fault schedules, ...  A spec
+    that disagrees on STATIC config (score_cfg, track_first_tick,
+    pad_to_block, px_candidates) raises here, naming the field, rather
+    than failing later with an opaque vmap shape error.
     """
-    builds = [make_gossip_sim(cfg, **{**common, **spec}) for spec in specs]
+    static_keys = ("score_cfg", "track_first_tick", "pad_to_block",
+                   "px_candidates")
+    merged = [{**common, **spec} for spec in specs]
+    for key in static_keys:
+        vals = [m.get(key) for m in merged]
+        for i, v in enumerate(vals[1:], start=1):
+            if v != vals[0]:
+                raise ValueError(
+                    f"stack_sims: replica {i} spec disagrees with "
+                    f"replica 0 on static config {key!r} "
+                    f"({v!r} vs {vals[0]!r}) — all replicas of a batch "
+                    "share one compiled step, so static config must "
+                    "match (vary arrays instead)")
+    builds = [make_gossip_sim(cfg, **m) for m in merged]
     return (stack_trees([b[0] for b in builds]),
             stack_trees([b[1] for b in builds]))
 

@@ -53,6 +53,7 @@ from ._delivery import (
     reach_counts_from_first_tick,
     update_first_tick,
 )
+from . import faults as _faults
 
 
 @dataclass(frozen=True)
@@ -92,6 +93,8 @@ class RandomSubParams:
     origin_words: jnp.ndarray    # uint32 [W, N]
     deliver_words: jnp.ndarray   # uint32 [W, N]
     publish_tick: jnp.ndarray    # int32 [M]
+    # compiled fault schedule (models/faults.py) — circulant step only
+    faults: _faults.FaultParams | None = None
 
 
 @struct.dataclass
@@ -107,13 +110,28 @@ def make_randomsub_sim(cfg: RandomSubSimConfig, subs: np.ndarray,
                        msg_topic: np.ndarray, msg_origin: np.ndarray,
                        msg_publish_tick: np.ndarray, seed: int = 0,
                        track_first_tick: bool = True,
-                       dense: bool = False):
+                       dense: bool = False,
+                       fault_schedule: _faults.FaultSchedule | None = None):
     """Build (params, state).  Same residue-class topic model as the
     GossipSub simulator: peer p may only subscribe to topic p mod T.
 
     dense=True sizes send_prob for the MXU step
     (make_randomsub_dense_step), whose sampling pool is all topic members
-    rather than the C circulant candidates."""
+    rather than the C circulant candidates.
+
+    fault_schedule (models/faults.py) injects churn/link-loss/partition
+    events — honored by the circulant step only (the dense MXU step's
+    all-member sampling pool has no per-candidate link axis; it refuses
+    fault configs)."""
+    if fault_schedule is not None:
+        if dense:
+            raise ValueError(
+                "fault_schedule: circulant step only (the dense MXU "
+                "step has no per-edge link masks)")
+        if fault_schedule.n_peers != subs.shape[0]:
+            raise ValueError(
+                f"fault_schedule.n_peers={fault_schedule.n_peers} != "
+                f"sim peer count {subs.shape[0]}")
     n, t = subs.shape
     if t != cfg.n_topics:
         raise ValueError("subs topic dim != cfg.n_topics")
@@ -154,6 +172,9 @@ def make_randomsub_sim(cfg: RandomSubSimConfig, subs: np.ndarray,
         origin_words=pack_bits_pm(jnp.asarray(origin_bits)),
         deliver_words=pack_bits_pm(jnp.asarray(deliver_bits)),
         publish_tick=jnp.asarray(msg_publish_tick, dtype=jnp.int32),
+        faults=(_faults.compile_faults(fault_schedule, cfg.offsets,
+                                       pack_links=False)
+                if fault_schedule is not None else None),
     )
     w = params.origin_words.shape[0]
     state = RandomSubState(
@@ -174,6 +195,9 @@ def make_randomsub_step(cfg: RandomSubSimConfig):
     offsets = tuple(int(o) for o in cfg.offsets)
     C = len(offsets)
     Z = jnp.uint32(0)
+    idx = {o: i for i, o in enumerate(offsets)}
+    cinv = (tuple(idx[-o] for o in offsets)
+            if all(-o in idx for o in offsets) else None)
 
     def step(params: RandomSubParams, state: RandomSubState):
         tick = state.tick
@@ -184,11 +208,24 @@ def make_randomsub_step(cfg: RandomSubSimConfig):
         due = pack_bits(params.publish_tick == tick)            # [W]
         injected = [params.origin_words[w] & due[w] & ~state.have[w]
                     for w in range(W)]
+        fp = params.faults
+        alive = aw = None
+        if fp is not None:
+            alive = _faults.alive_mask(fp, tick)
+            aw = _faults.alive_word(alive)
+            # a down origin does not publish (lost, not deferred)
+            injected = [inj & aw for inj in injected]
         frontier = [state.fresh[w] | injected[w] for w in range(W)]
 
         # per-edge Bernoulli sends of the frontier (fresh draw per tick)
         u = lane_uniform((C, n), tick, 1, salt)
         send = params.cand_subscribed & (u < params.send_prob[None, :])
+        if fp is not None:
+            # a down peer sends nothing; a down link carries nothing
+            send = send & alive[None, :]
+            link = _faults.link_ok_rows(fp, offsets, cinv, tick)
+            if link is not None:
+                send = send & link
         heard = [Z] * W
         for c, off in enumerate(offsets):
             mask_c = send[c]
@@ -196,6 +233,9 @@ def make_randomsub_step(cfg: RandomSubSimConfig):
                 sent = jnp.where(mask_c, frontier[w], Z)
                 heard[w] = heard[w] | jnp.roll(sent, off, axis=0)
 
+        if fp is not None:
+            # a down peer receives nothing
+            heard = [h & aw for h in heard]
         new = (jnp.stack([heard[w] & ~state.have[w] & ~injected[w]
                           for w in range(W)], axis=0) if W
                else jnp.zeros((0, n), dtype=jnp.uint32))
@@ -235,6 +275,11 @@ def make_randomsub_dense_step(cfg: RandomSubSimConfig):
     T = cfg.n_topics
 
     def step(params: RandomSubParams, state: RandomSubState):
+        if params.faults is not None:
+            raise ValueError(
+                "fault injection needs the circulant step "
+                "(make_randomsub_step); the dense MXU step has no "
+                "per-edge link masks")
         tick = state.tick
         n = params.subscribed.shape[0]
         W = state.have.shape[0]

@@ -330,6 +330,16 @@ def ranks_desc(prio: jnp.ndarray,
     return beats.sum(axis=1, dtype=jnp.int32)
 
 
+def _reduce_or(x: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """Bitwise-OR reduction over one axis.  ``jax.lax.reduce_or`` is not
+    present in every supported jax version (this tree's pin lacks it),
+    so spell it via the generic reducer."""
+    if hasattr(jax.lax, "reduce_or"):
+        return jax.lax.reduce_or(x, axes=(axis,))
+    return jax.lax.reduce(x, jnp.uint32(0), jax.lax.bitwise_or,
+                          dimensions=(axis,))
+
+
 def propagate(words: jnp.ndarray, nbrs: jnp.ndarray,
               nbr_mask: jnp.ndarray) -> jnp.ndarray:
     """One hop of message spread: OR of each peer's neighbors' words.
@@ -342,7 +352,7 @@ def propagate(words: jnp.ndarray, nbrs: jnp.ndarray,
     """
     gathered = words.at[nbrs].get(mode="fill", fill_value=0)  # [N, K, W]
     gathered = jnp.where(nbr_mask[..., None], gathered, jnp.uint32(0))
-    return jax.lax.reduce_or(gathered, axes=(1,))
+    return _reduce_or(gathered, axis=1)
 
 
 def propagate_pm(words: jnp.ndarray, nbrs: jnp.ndarray,
@@ -354,4 +364,4 @@ def propagate_pm(words: jnp.ndarray, nbrs: jnp.ndarray,
     """
     gathered = words.at[:, nbrs].get(mode="fill", fill_value=0)  # [W, N, K]
     gathered = jnp.where(nbr_mask[None, :, :], gathered, jnp.uint32(0))
-    return jax.lax.reduce_or(gathered, axes=(2,))
+    return _reduce_or(gathered, axis=2)

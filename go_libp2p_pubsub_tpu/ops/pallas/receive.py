@@ -77,6 +77,21 @@ from .select import _fmix32
 # interpret-mode identity suite runs at several depths).
 
 
+def _compiler_params_cls():
+    """The TPU compiler-params class was renamed across jax versions
+    (CompilerParams vs the older TPUCompilerParams); resolve by
+    presence and fail with the names spelled out rather than a
+    'NoneType is not callable' at the pallas_call site."""
+    cls = (getattr(pltpu, "CompilerParams", None)
+           or getattr(pltpu, "TPUCompilerParams", None))
+    if cls is None:
+        raise AttributeError(
+            "jax.experimental.pallas.tpu exposes neither "
+            "CompilerParams nor TPUCompilerParams — unsupported jax "
+            "version for the receive kernel")
+    return cls
+
+
 def _parse_n_slots() -> int:
     """Validate GOSSIP_KERNEL_SLOTS at import: a typo'd sweep value
     must fail HERE with the env var named, not as an opaque Mosaic
@@ -1039,7 +1054,7 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
         out_specs=tuple(out_specs),
         scratch_shapes=scratch,
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params_cls()(
             # the default 16 MiB scoped-vmem budget is just short of the
             # double-buffered [C, B] counter blocks at B=8192; v5e has
             # headroom above it

@@ -128,3 +128,72 @@ def test_tracestat_summarizes_both_formats(tmp_path):
     assert outs[0]["messages_published"] == m
     assert outs[0]["total_deliveries"] == m * (n // t)
     assert outs[0]["events"]["DELIVER_MESSAGE"] == m * (n // t)
+
+
+def test_churn_run_exports_join_leave_events(tmp_path):
+    """A churn run's trace carries the reference's JOIN/LEAVE event
+    types (trace.proto 9/10) at the down-interval boundaries, merged in
+    tick order with the payload events, and the pb file round-trips."""
+    import go_libp2p_pubsub_tpu.models.faults as fl
+
+    n, t, m = 600, 3, 8
+    cfg = GossipSimConfig(offsets=make_gossip_offsets(t, 16, n, seed=6),
+                          n_topics=t)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    rng = np.random.default_rng(6)
+    topic = rng.integers(0, t, m)
+    origin = rng.integers(0, n // t, m) * t + topic
+    ticks = rng.integers(0, 10, m).astype(np.int32)
+    sched = fl.FaultSchedule(
+        n_peers=n, horizon=30,
+        down_intervals=[(9, 2, 12), (12, 4, 30)], seed=3)
+    params, state = make_gossip_sim(cfg, subs, topic, origin, ticks,
+                                    fault_schedule=sched)
+    out = gossip_run(params, state, 30, make_gossip_step(cfg))
+    ft = np.asarray(first_tick_matrix(out, m))
+    events = events_from_sim(ft, topic, origin, ticks,
+                             fault_schedule=sched,
+                             peer_topic=np.arange(n) % t)
+    path = str(tmp_path / "churn.pb")
+    write_pb_trace(path, events)
+    buf = open(path, "rb").read()
+    pos, parsed = 0, []
+    while pos < len(buf):
+        evt, pos = read_delimited(tr.TraceEvent, buf, pos)
+        parsed.append(evt)
+    assert len(parsed) == len(events)
+    leaves = [e for e in parsed if e.type == TraceType.LEAVE]
+    joins = [e for e in parsed if e.type == TraceType.JOIN]
+    # peer 9 leaves at 2, rejoins at 12; peer 12 leaves at 4 and its
+    # interval runs to the horizon -> no JOIN
+    assert [(e.peer_id, e.timestamp) for e in leaves] == [
+        (b"sim-9", 2 * 10 ** 9), (b"sim-12", 4 * 10 ** 9)]
+    assert [(e.peer_id, e.timestamp) for e in joins] == [
+        (b"sim-9", 12 * 10 ** 9)]
+    assert leaves[0].leave.topic == f"topic-{9 % t}"
+    assert joins[0].join.topic == f"topic-{9 % t}"
+    # the merged stream stays timestamp-ordered
+    ts = [e.timestamp for e in parsed]
+    assert ts == sorted(ts)
+    # and the churned peers delivered nothing while down
+    assert (ft[12] < 0).all()
+
+
+def test_adjacent_churn_intervals_merge_in_trace():
+    """Adjacent down intervals ([a, b) + [b, c)) are ONE continuous
+    outage to alive_mask; the exported stream must not show a
+    same-tick JOIN+LEAVE flicker at the seam."""
+    import go_libp2p_pubsub_tpu.models.faults as fl
+    from go_libp2p_pubsub_tpu.interop.export import churn_events
+
+    sched = fl.FaultSchedule(
+        n_peers=8, horizon=40,
+        down_intervals=[(2, 3, 10), (2, 10, 20), (5, 30, 40)])
+    evs = churn_events(sched, np.zeros(8, dtype=np.int64))
+    kinds = [(e.type, e.peer_id, e.timestamp // 10 ** 9) for e in evs]
+    # peer 2: one LEAVE at 3, one JOIN at 20 (seam at 10 merged away);
+    # peer 5: LEAVE at 30, interval runs to horizon -> no JOIN
+    assert kinds == [(TraceType.LEAVE, b"sim-2", 3),
+                     (TraceType.JOIN, b"sim-2", 20),
+                     (TraceType.LEAVE, b"sim-5", 30)]

@@ -21,6 +21,12 @@ port="${AXON_PROBE_PORT:-8082}"
 export MEASURE_DEADLINE="${MEASURE_DEADLINE:-$(date -d '2026-07-31 14:10 UTC' +%s)}"
 echo "[watch] start $(date -u +%H:%M:%S) probing 127.0.0.1:$port" | tee -a "$log"
 n=0
+# after an aborted measurement pass (relay died mid-pass) the watcher
+# RE-ARMS with capped exponential backoff instead of giving up or
+# hammering: 60s doubling to a 1920s cap, reset on any completed pass.
+# The steady-state probe cadence stays 240s.
+retry_delay=60
+retry_count=0
 while true; do
   if [ "$(date +%s)" -gt "$MEASURE_DEADLINE" ]; then
     echo "[watch] deadline passed — exiting (chip left to the driver)" \
@@ -67,9 +73,17 @@ while true; do
           >>"$log" 2>&1 || true
       fi
       # pass aborted on a relay death: keep watching — a later
-      # recovery reruns the whole pass (artifact writes are idempotent)
+      # recovery reruns the whole pass (artifact writes are idempotent).
+      # Back off exponentially (capped) so a flapping relay is not
+      # hammered with full measurement passes; each retry is logged.
       [ "$mrc" -eq 0 ] && exit 0
-      echo "[watch] pass aborted — re-arming" | tee -a "$log"
+      retry_count=$((retry_count + 1))
+      echo "[watch] pass aborted — retry #$retry_count in ${retry_delay}s" \
+        | tee -a "$log"
+      sleep "$retry_delay" 9>&-
+      retry_delay=$((retry_delay * 2))
+      [ "$retry_delay" -gt 1920 ] && retry_delay=1920
+      continue
     fi
     echo "[watch] attempt $n: port open but backend probe failed" \
       | tee -a "$log"

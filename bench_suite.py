@@ -18,6 +18,12 @@ suite is the full matrix for tracking all baseline configs.)
                    spam AND the IWANT retransmission flood —
                    heartbeats/s, gated on honest-traffic delivery and
                    the retransmission-cutoff load bound
+  gossipsub_telemetry
+                   the flagship config run telemetry-off vs
+                   telemetry-on (models/telemetry.py) — a throughput
+                   row each (the observation cost, measured) plus the
+                   control-overhead row (control bytes / payload
+                   bytes, the GossipSub paper's headline number)
 
 Usage: python bench_suite.py [config ...]   (default: all)
 """
@@ -473,6 +479,81 @@ def bench_gossipsub_v11_churn():
                 "threshold": 0.99})
 
 
+def bench_gossipsub_telemetry():
+    """Observation cost + the GossipSub paper's headline overhead
+    number: the flagship v1.1 config run telemetry-OFF and
+    telemetry-ON (models/telemetry.py full frame, XLA path — the
+    kernel refuses telemetry), one throughput row each so the
+    observation cost is itself measured, plus the control-overhead row
+    (control bytes / payload bytes, estimated from the pb/rpc.py
+    framing constants) summed over the ON run's measured window."""
+    import jax
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    import go_libp2p_pubsub_tpu.models.telemetry as tl
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    n = 1_000_000 if on_accel else 100_000
+    t = 100
+    m, C = 32, 16
+    warmup, T, reps = 100, 100, 3
+    horizon = warmup + T * reps
+    rng = np.random.default_rng(0)
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, C, n, seed=0), n_topics=t)
+    score_cfg = gs.ScoreSimConfig()
+    topic, origin, tick = _msgs(rng, n, t, m, horizon)
+    subs = _subs_matrix(n, t)
+    tcfg = tl.TelemetryConfig()
+    rates = {}
+    tel_totals = None
+    for mode in ("off", "on"):
+        params, state = gs.make_gossip_sim(
+            cfg, subs, topic, origin, tick, score_cfg=score_cfg,
+            track_first_tick=False)
+        params = jax.device_put(params)
+        state = jax.device_put(state)
+        if mode == "off":
+            step = gs.make_gossip_step(cfg, score_cfg)
+            state = gs.gossip_run(params, state, warmup, step)
+            deg = np.asarray(gs.mesh_degrees(state))[
+                np.asarray(params.subscribed)]
+            assert deg.mean() >= cfg.d_lo, f"no mesh: {deg.mean()}"
+            _ = int(np.asarray(state.tick))
+            t0 = time.perf_counter()
+            for _r in range(reps):
+                state = gs.gossip_run(params, state, T, step)
+                _ = int(np.asarray(state.tick))
+            rates[mode] = T * reps / (time.perf_counter() - t0)
+        else:
+            step = gs.make_gossip_step(cfg, score_cfg, telemetry=tcfg)
+            state, _fr = tl.telemetry_run(params, state, warmup, step)
+            _ = int(np.asarray(state.tick))
+            t0 = time.perf_counter()
+            window_frames = []
+            for _r in range(reps):
+                state, fr = tl.telemetry_run(params, state, T, step)
+                _ = int(np.asarray(state.tick))
+                window_frames.append(tl.summarize_frames(fr))
+            rates[mode] = T * reps / (time.perf_counter() - t0)
+            tel_totals = {
+                k: sum(s[k] for s in window_frames)
+                for k in ("bytes_payload", "bytes_control",
+                          "payload_sent", "ihave_ids",
+                          "iwant_ids_served", "graft_sends",
+                          "prune_sends")}
+    emit(f"gossipsub_v11_telemetry_off_{n}peers_heartbeats_per_sec",
+         rates["off"], "heartbeats/s")
+    emit(f"gossipsub_v11_telemetry_on_{n}peers_heartbeats_per_sec",
+         rates["on"], "heartbeats/s",
+         extra={"telemetry_overhead_pct": round(
+             100.0 * (rates["off"] / rates["on"] - 1.0), 1)})
+    ratio = (tel_totals["bytes_control"] / tel_totals["bytes_payload"]
+             if tel_totals["bytes_payload"] > 0 else 0.0)
+    emit(f"gossipsub_v11_control_overhead_{n}peers_bytes_ratio",
+         ratio, "control_bytes/payload_bytes",
+         extra={k: round(v, 1) for k, v in tel_totals.items()})
+
+
 BENCHES = {
     "floodsub_hosts": bench_floodsub_hosts,
     "randomsub_10k": bench_randomsub_10k,
@@ -483,6 +564,7 @@ BENCHES = {
     "gossipsub_v11_adversarial": bench_gossipsub_v11_adversarial,
     "gossipsub_v11_everything": bench_gossipsub_v11_everything,
     "gossipsub_v11_churn": bench_gossipsub_v11_churn,
+    "gossipsub_telemetry": bench_gossipsub_telemetry,
 }
 
 

@@ -10,7 +10,7 @@ wrapper.  The filter is consulted for every subscription notification
 from __future__ import annotations
 
 import re
-from typing import Callable, Iterable, Optional
+from typing import Callable, Iterable
 
 from ..pb import rpc as pb
 from .types import PeerID

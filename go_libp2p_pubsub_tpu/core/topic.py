@@ -9,11 +9,10 @@ and pull-based subscriptions.
 from __future__ import annotations
 
 import asyncio
-from typing import Callable, Optional
+from typing import Callable
 
 from ..pb import rpc as pb
 from .types import Message, PeerEvent, PeerID
-from .validation import ValidationError
 
 
 class TopicClosedError(Exception):

@@ -7,7 +7,7 @@ Semantics mirror the reference runtime (see /root/reference/pubsub.go:27-30,
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Optional
 
 # -- protocol IDs ----------------------------------------------------------

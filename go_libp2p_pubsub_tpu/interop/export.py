@@ -158,6 +158,74 @@ def events_from_sim(first_tick_matrix: np.ndarray,
     return out
 
 
+def mesh_trace_events(mesh_snapshots: np.ndarray, offsets,
+                      peer_topic: np.ndarray,
+                      start_tick: int = 0,
+                      initial_mesh: np.ndarray | None = None,
+                      topic_name=lambda t: f"topic-{t}"):
+    """Host-side diff of per-tick mesh words -> GRAFT/PRUNE TraceEvents
+    (reference trace.proto types 11/12 — the mesh-maintenance events
+    the reference tracer emits from its heartbeat).
+
+    mesh_snapshots: uint32 [T, N], row k = the mesh bitmask AFTER tick
+    ``start_tick + k`` (models/gossipsub.py gossip_run_mesh_snapshots).
+    ``initial_mesh`` [N] is the baseline before the first diffed tick
+    (the pre-run ``state.mesh``; defaults to the empty mesh).  A bit
+    gained between consecutive snapshots is a GRAFT by that peer at
+    that tick, a bit lost is a PRUNE; the event's ``peer_id`` field
+    carries the mesh partner (the grafted/pruned edge's other end, as
+    in the reference's GraftEv/PruneEv), the topic is the grafting
+    peer's residue-class topic (``peer_topic`` int [N]).
+
+    Returned in tick order (GRAFTs before PRUNEs within a tick);
+    merge with an events_from_sim stream via merge_event_streams, and
+    in paired mode call once per mesh slot with that slot's topics.
+    """
+    snaps = np.asarray(mesh_snapshots, dtype=np.uint64)
+    t_ticks, n = snaps.shape
+    offs = tuple(int(o) for o in offsets)
+    prev = (np.zeros(n, dtype=np.uint64) if initial_mesh is None
+            else np.asarray(initial_mesh, dtype=np.uint64))
+    out = []
+    for k in range(t_ticks):
+        cur = snaps[k]
+        diff = cur ^ prev
+        if diff.any():
+            ts = (start_tick + k) * NS_PER_TICK
+            for kind in (0, 1):                     # grafts, then prunes
+                for c, off in enumerate(offs):
+                    cur_c = (cur >> np.uint64(c)) & np.uint64(1)
+                    prev_c = (prev >> np.uint64(c)) & np.uint64(1)
+                    flip = (cur_c & ~prev_c if kind == 0
+                            else prev_c & ~cur_c)
+                    for p in np.flatnonzero(flip):
+                        partner = peer_id(int((p + off) % n))
+                        tpc = topic_name(int(peer_topic[p]))
+                        if kind == 0:
+                            out.append(tr.TraceEvent(
+                                type=TraceType.GRAFT,
+                                peer_id=peer_id(int(p)), timestamp=ts,
+                                graft=tr.GraftEv(peer_id=partner,
+                                                 topic=tpc)))
+                        else:
+                            out.append(tr.TraceEvent(
+                                type=TraceType.PRUNE,
+                                peer_id=peer_id(int(p)), timestamp=ts,
+                                prune=tr.PruneEv(peer_id=partner,
+                                                 topic=tpc)))
+        prev = cur
+    return out
+
+
+def merge_event_streams(*streams):
+    """Merge TraceEvent streams into one timestamp-ordered stream
+    (stable sort: within a tick, each stream's internal order is kept
+    and earlier streams sort first)."""
+    out = [e for stream in streams for e in stream]
+    out.sort(key=lambda e: e.timestamp)
+    return out
+
+
 def write_pb_trace(path: str, events) -> None:
     """Varint-delimited pb file — the PBTracer/reference format."""
     with open(path, "wb") as f:

@@ -41,6 +41,7 @@ from ._delivery import (
     update_first_tick,
 )
 from . import faults as _faults
+from . import telemetry as _telemetry
 
 
 @struct.dataclass
@@ -236,17 +237,87 @@ def flood_run_batch(params: FloodParams, state: FloodState, n_ticks: int,
     return state
 
 
-def make_circulant_step_core(offsets):
+def make_circulant_step_core(offsets,
+                             telemetry: "_telemetry.TelemetryConfig | None"
+                             = None):
     """(params, state) -> (state, delivered_words) over a circulant
     graph.  Honors ``params.faults`` (models/faults.py): a down peer
     neither sends, receives, nor injects; a down link carries nothing
-    that tick; partition windows cut cross-group edges."""
+    that tick; partition windows cut cross-group edges.
+
+    With ``telemetry`` (models/telemetry.py) the core returns
+    ``(state, delivered_words, TelemetryFrame)`` carrying floodsub's
+    applicable frame subset — payload copies sent, duplicates
+    suppressed, estimated payload bytes, and the fault counters (the
+    gossip/mesh/score fields stay zero).  The hop then runs as explicit
+    per-edge rolls (instead of the fused propagation kernel) so per-edge
+    copies are countable — the state trajectory stays bit-identical,
+    and ``telemetry=None`` compiles the exact pre-telemetry core.
+    The gather-based flood_step refuses telemetry like it refuses
+    faults (no per-edge loop to count over)."""
     offsets = tuple(int(o) for o in offsets)
     idx = {o: i for i, o in enumerate(offsets)}
     cinv = (tuple(idx[-o] for o in offsets)
             if all(-o in idx for o in offsets) else None)
+    tel = telemetry
+    ws = _telemetry.wire_sizes(tel) if tel is not None else None
+    pc = jax.lax.population_count
+
+    def telemetry_core(params: FloodParams, state: FloodState):
+        fp = params.faults
+        alive = aw = link = None
+        src = state.have & params.fwd_words
+        if fp is not None:
+            alive = _faults.alive_mask(fp, state.tick)
+            aw = _faults.alive_word(alive)
+            link = _faults.link_ok_rows(fp, offsets, cinv, state.tick)
+            src = src & aw[None, :]                        # sender up
+        W = src.shape[0]
+        sent_cnt = jnp.int32(0)
+        recv_cnt = jnp.int32(0)
+        w_rows = []
+        for w in range(W):
+            out = jnp.zeros_like(src[w])
+            for c, off in enumerate(offsets):
+                sent = (src[w] if link is None
+                        else jnp.where(link[c], src[w], jnp.uint32(0)))
+                rolled = jnp.roll(sent, off, axis=0)
+                if aw is not None:
+                    rolled = rolled & aw                   # receiver up
+                out = out | rolled
+                if tel.counters:
+                    sent_cnt += pc(sent).sum(dtype=jnp.int32)
+                    recv_cnt += pc(rolled).sum(dtype=jnp.int32)
+            w_rows.append(out)
+        heard = jnp.stack(w_rows, axis=0)
+        new_state, delivered = _finish_step(params, state, heard,
+                                            alive=alive)
+        kw_f = {}
+        if tel.counters:
+            # accepted = what actually entered a peer's possession set;
+            # the rest of the received copies were seen-cache (or
+            # non-subscriber) drops
+            accepted = (heard & ~state.have
+                        & (params.fwd_words | params.deliver_words))
+            kw_f.update(
+                payload_sent=sent_cnt,
+                dup_suppressed=recv_cnt - pc(accepted).sum(
+                    dtype=jnp.int32))
+            if tel.wire:
+                kw_f["bytes_payload"] = (
+                    sent_cnt.astype(jnp.float32)
+                    * float(ws.payload_frame))
+        if tel.faults and fp is not None:
+            kw_f["down_peers"] = (~alive).sum(dtype=jnp.int32)
+            if link is not None:
+                # two [C, N] views per undirected edge; halve
+                kw_f["dropped_edge_ticks"] = (
+                    (~link).sum(dtype=jnp.int32) // 2)
+        return new_state, delivered, _telemetry.make_frame(**kw_f)
 
     def core(params: FloodParams, state: FloodState):
+        if tel is not None:
+            return telemetry_core(params, state)
         if params.faults is None:
             heard = propagate_circulant(state.have & params.fwd_words,
                                         offsets)

@@ -123,6 +123,7 @@ from ._delivery import (
     update_first_tick,
 )
 from . import faults as _faults
+from . import telemetry as _telemetry
 
 
 # --------------------------------------------------------------------------
@@ -1325,8 +1326,18 @@ def make_gossip_step(cfg: GossipSimConfig,
                      force_split: bool = False,
                      pipeline_gates: bool = True,
                      shard_mesh=None,
-                     shard_axis: str = "peers"):
+                     shard_axis: str = "peers",
+                     telemetry: _telemetry.TelemetryConfig | None = None):
     """Build the jittable (params, state) -> (state, delivered_words) core.
+
+    With ``telemetry`` (models/telemetry.py) the step instead returns
+    ``(state, delivered_words, TelemetryFrame)`` — per-tick protocol
+    counters computed in-scan; run it through the telemetry runners
+    (telemetry_run / telemetry_run_curve / telemetry_run_batch).  The
+    state trajectory is bit-identical to the telemetry-free step
+    (telemetry only READS), and ``telemetry=None`` (the default)
+    compiles the exact pre-telemetry step.  XLA path only — the pallas
+    kernel refuses telemetry configs like it refuses fault configs.
 
     Per tick:
       1. inject due publishes (Topic.Publish -> rt.Publish, topic.go:207)
@@ -1352,6 +1363,10 @@ def make_gossip_step(cfg: GossipSimConfig,
     C = cfg.n_candidates
     sc = score_cfg
     paired = cfg.paired_topics
+    tel = telemetry
+    # wire-framing constants measured from the pb/rpc.py encodings at
+    # build time (host side), baked into the step as scalars
+    ws = _telemetry.wire_sizes(tel) if tel is not None else None
     step_gates_fp = gates_fingerprint(cfg, sc)
     offsets = tuple(int(o) for o in cfg.offsets)
     cinv = cfg.cinv
@@ -1633,6 +1648,13 @@ def make_gossip_step(cfg: GossipSimConfig,
             if params.n_true is None:
                 raise ValueError(
                     "pallas step needs make_gossip_sim(pad_to_block=...)")
+            if tel is not None:
+                # telemetry counters are not threaded through the mosaic
+                # kernel — refused outright, the same contract as the
+                # fault-config refusal (run telemetry on the XLA path)
+                raise ValueError(
+                    "telemetry is XLA-path only: the pallas step "
+                    "refuses telemetry configs")
             if (C > 16 or W == 0 or params.flood_proto is not None
                     or state.gates is None
                     # fault masks are not threaded through the mosaic
@@ -2112,6 +2134,26 @@ def make_gossip_step(cfg: GossipSimConfig,
                 # broken promise this tick
                 lack_any = lack_any & f_alive
 
+        # -- telemetry counter accumulators (models/telemetry.py).
+        # Sender-side counts (payload copies, IHAVE ids) are popcounts
+        # of the very send words the loops below already build;
+        # receiver-side counts (IWANT requested/served, duplicates)
+        # need a gossip-only re-roll per edge-word — the main
+        # observation cost, measured as the on-vs-off bench delta.
+        # Advert counting uses ``targets`` PRE-withhold: a withholding
+        # spammer does advertise (that is the attack), so its ids land
+        # in ihave_ids/iwant_ids_requested but never in
+        # iwant_ids_served — the gap is the broken-promise traffic.
+        tel_acc = None
+        if tel is not None and tel.counters:
+            z32 = jnp.int32(0)
+            tel_acc = dict(payload=z32, recv=z32, ihave_rpcs=z32,
+                           ihave_ids=z32, iwant_rpcs=z32, req=z32,
+                           srv=z32)
+            tel_adv_any = jnp.zeros((n,), dtype=bool)
+            for w in range(W):
+                tel_adv_any = tel_adv_any | (adv[w] != 0)
+
         # Columns are independent: every same-tick deliverer of a new
         # message gets delivery credit (the reference's near-first window
         # covers simultaneous copies, score.go:684-818; with one tick =
@@ -2185,21 +2227,47 @@ def make_gossip_step(cfg: GossipSimConfig,
                 m_f = bit_row(send_fwd, c_send)                 # [N]
                 m_g = bit_row(send_gsp, c_send)
                 m_fb = (bit_row(send_fwd_b, c_send) if paired else None)
+                m_fl = (bit_row(send_flood, c_send)
+                        if send_flood is not None else None)
+                m_adv = (bit_row(targets, c_send)
+                         if tel_acc is not None else None)
                 fd_j = iv_j = None
+                req_c = None
                 for w in range(W):
-                    sent = (jnp.where(m_f,
+                    fwd_w = jnp.where(m_f,
                                       fresh_a[w] if paired else fresh[w],
                                       Z)
-                            | jnp.where(m_g, adv[w], Z))
                     if paired:
-                        sent = sent | jnp.where(m_fb, fresh_b[w], Z)
-                    if send_flood is not None:
-                        sent = sent | jnp.where(
-                            bit_row(send_flood, c_send), injected[w], Z)
+                        fwd_w = fwd_w | jnp.where(m_fb, fresh_b[w], Z)
+                    if m_fl is not None:
+                        fwd_w = fwd_w | jnp.where(m_fl, injected[w], Z)
+                    gsp_w = jnp.where(m_g, adv[w], Z)
+                    # same value as the old fused (fwd | gossip) word —
+                    # uint32 OR is associative, so splitting it for the
+                    # telemetry tallies changes nothing downstream
+                    sent = fwd_w | gsp_w
                     rolled = jnp.roll(sent, off, axis=0)
                     if fp is not None:
                         rolled = rolled & f_alive_w  # down peers hear 0
                     news = rolled & ~seen[w]
+                    if tel_acc is not None:
+                        adv_w = jnp.where(m_adv, adv[w], Z)
+                        r_gsp = jnp.roll(gsp_w, off, axis=0)
+                        r_adv = jnp.roll(adv_w, off, axis=0)
+                        if fp is not None:
+                            r_gsp = r_gsp & f_alive_w
+                            r_adv = r_adv & f_alive_w
+                        tel_acc["payload"] += pc(fwd_w).sum(
+                            dtype=jnp.int32)
+                        tel_acc["ihave_ids"] += pc(adv_w).sum(
+                            dtype=jnp.int32)
+                        tel_acc["srv"] += pc(r_gsp & ~seen[w]).sum(
+                            dtype=jnp.int32)
+                        tel_acc["recv"] += pc(rolled).sum(
+                            dtype=jnp.int32)
+                        req_c = acc(req_c,
+                                    pc(r_adv & ~seen[w]).astype(
+                                        jnp.int32))
                     if sc is not None:
                         # barrier: force ONE materialization of this
                         # edge's news word.  Without it XLA fuses the
@@ -2219,6 +2287,13 @@ def make_gossip_step(cfg: GossipSimConfig,
                     got_cheat = jnp.roll(bit_row(send_cheat, c_send),
                                          off, axis=0)
                     broken_add[j] = got_cheat & lack_any
+                if tel_acc is not None:
+                    tel_acc["ihave_rpcs"] += (m_adv & tel_adv_any).sum(
+                        dtype=jnp.int32)
+                    if req_c is not None:    # stays None when W == 0
+                        tel_acc["req"] += req_c.sum(dtype=jnp.int32)
+                        tel_acc["iwant_rpcs"] += (req_c > 0).sum(
+                            dtype=jnp.int32)
                 fd_add[j], inv_add[j] = fd_j, iv_j
             new_heard_bits = [jnp.where(sub, hw, Z) for hw in heard]
         else:
@@ -2242,6 +2317,11 @@ def make_gossip_step(cfg: GossipSimConfig,
                         rolled = rolled & f_alive_w  # down peers hear 0
                     news = rolled & ~seen[w]
                     mesh_heard[w] = mesh_heard[w] | news
+                    if tel_acc is not None:
+                        tel_acc["payload"] += pc(sent).sum(
+                            dtype=jnp.int32)
+                        tel_acc["recv"] += pc(rolled).sum(
+                            dtype=jnp.int32)
                     if sc is not None:
                         # P3 counts duplicate copies from mesh members in
                         # the window — the provenance that forces the
@@ -2258,12 +2338,14 @@ def make_gossip_step(cfg: GossipSimConfig,
             gossip_heard = [Z] * W
             for c_send, off in enumerate(offsets):
                 j = cinv[c_send]
-                send_mask = bit_row(targets, c_send)
+                adv_mask = bit_row(targets, c_send)
+                send_mask = adv_mask
                 if withhold is not None:
                     send_mask = send_mask & ~withhold
                 ok_j = None
                 if sc is not None:
                     ok_j = bit_row(payload_bits & gossip_bits, j)
+                req_c = None
                 for w in range(W):
                     sent = jnp.where(send_mask, adv[w], Z)
                     rolled = jnp.roll(sent, off, axis=0)
@@ -2273,6 +2355,27 @@ def make_gossip_step(cfg: GossipSimConfig,
                         rolled = rolled & f_alive_w  # down peers hear 0
                     news = rolled & ~seen_g[w]
                     gossip_heard[w] = gossip_heard[w] | news
+                    if tel_acc is not None:
+                        # requested/served count against START-of-tick
+                        # possession (~seen, not ~seen_g): the same
+                        # estimator the combined path uses, so the
+                        # byte/ratio outputs are formulation-invariant
+                        # (pinned by test_telemetry.py)
+                        adv_w = jnp.where(adv_mask, adv[w], Z)
+                        r_adv = jnp.roll(adv_w, off, axis=0)
+                        if ok_j is not None:
+                            r_adv = jnp.where(ok_j, r_adv, Z)
+                        if fp is not None:
+                            r_adv = r_adv & f_alive_w
+                        tel_acc["ihave_ids"] += pc(adv_w).sum(
+                            dtype=jnp.int32)
+                        tel_acc["srv"] += pc(rolled & ~seen[w]).sum(
+                            dtype=jnp.int32)
+                        tel_acc["recv"] += pc(rolled).sum(
+                            dtype=jnp.int32)
+                        req_c = acc(req_c,
+                                    pc(r_adv & ~seen[w]).astype(
+                                        jnp.int32))
                     if sc is not None:
                         # IWANT-pulled messages go through validation
                         # like any other delivery: P2 valid, P4 invalid
@@ -2284,6 +2387,14 @@ def make_gossip_step(cfg: GossipSimConfig,
                     if ok_j is not None:
                         got_cheat = got_cheat & ok_j
                     broken_add[j] = got_cheat & lack_any
+                if tel_acc is not None:
+                    tel_acc["ihave_rpcs"] += (adv_mask
+                                              & tel_adv_any).sum(
+                        dtype=jnp.int32)
+                    if req_c is not None:    # stays None when W == 0
+                        tel_acc["req"] += req_c.sum(dtype=jnp.int32)
+                        tel_acc["iwant_rpcs"] += (req_c > 0).sum(
+                            dtype=jnp.int32)
             new_heard_bits = [
                 jnp.where(sub, mesh_heard[w] | gossip_heard[w], Z)
                 for w in range(W)]
@@ -2604,14 +2715,94 @@ def make_gossip_step(cfg: GossipSimConfig,
             # emit the NEXT tick's gate words now, while the updated
             # counters are live in registers (XLA fuses the score math
             # and packs into the decay pass) — the next prologue then
-            # reads G words/peer instead of the [C, N] counter state.
+            # reads G words/peer instead of the [C, N] numeric state.
             # Emitted even with pipeline_gates=False (whose prologue
             # recomputes rather than trusting the carry): the returned
             # state must never hold STALE gates that a later pipelined
             # step would silently act on.
             new_state = new_state.replace(gates=compute_gates(
                 cfg, sc, params, new_state, salt))
-        return new_state, delivered_now
+        if tel is None:
+            return new_state, delivered_now
+
+        # -- telemetry frame assembly (models/telemetry.py): a pure
+        # READOUT of values the tick already computed, so the state
+        # trajectory is bit-identical to the telemetry-free step.
+        kw_f = {}
+        if tel_acc is not None:
+            def tx(bits):
+                # handshake RPCs actually transmitted: a dead peer or a
+                # cut link sends nothing (the masking raw_transfers
+                # applies), and nothing goes on the wire TOWARD a dead
+                # partner either — the reference drops the connection,
+                # it does not send a PRUNE RPC at a dead peer.  The
+                # partner-alive mask matters for prunes only (sel
+                # 'dropped' includes the fault-injected dead edges;
+                # graft selection already excludes dead candidates) —
+                # without it churn ticks would tally one phantom PRUNE
+                # per dead mesh edge into the control-byte estimate.
+                if fp is None:
+                    return bits
+                return bits & f_send_ok & f_cand_alive
+
+            graft_cnt = popcount32(tx(sel_a["grafts"])).sum(
+                dtype=jnp.int32)
+            prune_cnt = popcount32(tx(sel_a["dropped"])).sum(
+                dtype=jnp.int32)
+            if paired:
+                graft_cnt = graft_cnt + popcount32(
+                    tx(sel_b["grafts"])).sum(dtype=jnp.int32)
+                prune_cnt = prune_cnt + popcount32(
+                    tx(sel_b["dropped"])).sum(dtype=jnp.int32)
+            new_ids = jnp.int32(0)
+            for w in range(W):
+                new_ids = new_ids + pc(new_heard_bits[w]).sum(
+                    dtype=jnp.int32)
+            kw_f.update(
+                payload_sent=tel_acc["payload"],
+                ihave_rpcs=tel_acc["ihave_rpcs"],
+                ihave_ids=tel_acc["ihave_ids"],
+                iwant_rpcs=tel_acc["iwant_rpcs"],
+                iwant_ids_requested=tel_acc["req"],
+                iwant_ids_served=tel_acc["srv"],
+                graft_sends=graft_cnt, prune_sends=prune_cnt,
+                dup_suppressed=tel_acc["recv"] - new_ids)
+            if tel.wire:
+                f32c = lambda x: x.astype(jnp.float32)  # noqa: E731
+                kw_f["bytes_payload"] = (
+                    f32c(tel_acc["payload"] + tel_acc["srv"])
+                    * float(ws.payload_frame))
+                kw_f["bytes_control"] = (
+                    f32c(tel_acc["ihave_rpcs"]) * float(ws.ihave_base)
+                    + f32c(tel_acc["ihave_ids"])
+                    * float(ws.ihave_per_id)
+                    + f32c(tel_acc["iwant_rpcs"]) * float(ws.iwant_base)
+                    + f32c(tel_acc["req"]) * float(ws.iwant_per_id)
+                    + f32c(graft_cnt) * float(ws.graft_frame)
+                    + f32c(prune_cnt) * float(ws.prune_frame))
+        if tel.mesh:
+            deg_t = popcount32(mesh)
+            if paired:
+                deg_t = deg_t + popcount32(mesh_b_new)
+            mn_d, mean_d, mx_d = _telemetry.degree_stats(deg_t, sub)
+            kw_f.update(mesh_deg_min=mn_d, mesh_deg_mean=mean_d,
+                        mesh_deg_max=mx_d)
+        if tel.scores and sc is not None:
+            # start-of-tick scores — the same view the gates acted on
+            score_t = score_fn()
+            mask_t = expand_bits(params.cand_sub_bits & sub_all, C)
+            sm, smn, fneg, fg = _telemetry.score_stats(
+                score_t, mask_t, sc.gossip_threshold)
+            kw_f.update(score_mean=sm, score_min=smn,
+                        score_frac_neg=fneg,
+                        score_frac_below_gossip=fg)
+        if tel.faults and fp is not None:
+            kw_f["down_peers"] = (~f_alive).sum(dtype=jnp.int32)
+            if f_link is not None:
+                # one undirected edge has two packed views; halve
+                kw_f["dropped_edge_ticks"] = (
+                    popcount32(~f_link & ALL).sum(dtype=jnp.int32) // 2)
+        return new_state, delivered_now, _telemetry.make_frame(**kw_f)
 
     return step
 
@@ -2717,6 +2908,25 @@ def gossip_run_curve_batch(params: GossipParams, state: GossipState,
             lambda d: count_bits_per_position(d, n_msgs))(delivered)
     state, counts = jax.lax.scan(body, state, None, length=n_ticks)
     return state, counts
+
+
+@partial(jax.jit, static_argnums=(2, 3), donate_argnums=(1,))
+def gossip_run_mesh_snapshots(params: GossipParams, state: GossipState,
+                              n_ticks: int, step):
+    """Advance n_ticks collecting the END-of-tick mesh word per tick:
+    returns ``(state, snaps)`` where ``snaps["mesh"]`` is uint32
+    [n_ticks, N] (plus ``"mesh_b"`` in paired mode).  Row k is the mesh
+    AFTER tick ``start_tick + k`` — feed it (with the pre-run mesh as
+    the baseline) to interop.export.mesh_trace_events, whose host-side
+    diff emits the reference's GRAFT/PRUNE TraceEvents (trace.proto
+    types 11/12).  Works with any step, telemetry-enabled or not."""
+    def body(s, _):
+        s2 = step(params, s)[0]
+        snap = {"mesh": s2.mesh}
+        if s2.mesh_b is not None:
+            snap["mesh_b"] = s2.mesh_b
+        return s2, snap
+    return jax.lax.scan(body, state, None, length=n_ticks)
 
 
 def first_tick_matrix(state: GossipState, m: int) -> jnp.ndarray:

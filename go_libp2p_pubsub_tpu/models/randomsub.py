@@ -54,6 +54,7 @@ from ._delivery import (
     update_first_tick,
 )
 from . import faults as _faults
+from . import telemetry as _telemetry
 
 
 @dataclass(frozen=True)
@@ -188,16 +189,30 @@ def make_randomsub_sim(cfg: RandomSubSimConfig, subs: np.ndarray,
     return params, state
 
 
-def make_randomsub_step(cfg: RandomSubSimConfig):
+def make_randomsub_step(cfg: RandomSubSimConfig,
+                        telemetry: "_telemetry.TelemetryConfig | None"
+                        = None):
     """(params, state) -> (state, delivered_words): one tick = inject due
     publishes, forward the frontier to a Bernoulli(k/pool) subset of
-    subscribed candidates, record deliveries."""
+    subscribed candidates, record deliveries.
+
+    With ``telemetry`` (models/telemetry.py) the step returns
+    ``(state, delivered_words, TelemetryFrame)`` carrying randomsub's
+    applicable frame subset — payload copies sent, duplicates
+    suppressed, estimated payload bytes, fault counters (gossip/mesh/
+    score fields stay zero).  Telemetry only READS, so the state
+    trajectory is bit-identical; ``None`` (default) compiles the exact
+    pre-telemetry step.  The dense MXU step refuses telemetry like it
+    refuses faults."""
     offsets = tuple(int(o) for o in cfg.offsets)
     C = len(offsets)
     Z = jnp.uint32(0)
     idx = {o: i for i, o in enumerate(offsets)}
     cinv = (tuple(idx[-o] for o in offsets)
             if all(-o in idx for o in offsets) else None)
+    tel = telemetry
+    ws = _telemetry.wire_sizes(tel) if tel is not None else None
+    pc = jax.lax.population_count
 
     def step(params: RandomSubParams, state: RandomSubState):
         tick = state.tick
@@ -209,7 +224,7 @@ def make_randomsub_step(cfg: RandomSubSimConfig):
         injected = [params.origin_words[w] & due[w] & ~state.have[w]
                     for w in range(W)]
         fp = params.faults
-        alive = aw = None
+        alive = aw = link = None
         if fp is not None:
             alive = _faults.alive_mask(fp, tick)
             aw = _faults.alive_word(alive)
@@ -226,12 +241,22 @@ def make_randomsub_step(cfg: RandomSubSimConfig):
             link = _faults.link_ok_rows(fp, offsets, cinv, tick)
             if link is not None:
                 send = send & link
+        tel_sent = tel_recv = None
+        if tel is not None and tel.counters:
+            tel_sent = jnp.int32(0)
+            tel_recv = jnp.int32(0)
         heard = [Z] * W
         for c, off in enumerate(offsets):
             mask_c = send[c]
             for w in range(W):
                 sent = jnp.where(mask_c, frontier[w], Z)
-                heard[w] = heard[w] | jnp.roll(sent, off, axis=0)
+                rolled = jnp.roll(sent, off, axis=0)
+                heard[w] = heard[w] | rolled
+                if tel_sent is not None:
+                    tel_sent += pc(sent).sum(dtype=jnp.int32)
+                    tel_recv += pc(rolled if aw is None
+                                   else rolled & aw).sum(
+                        dtype=jnp.int32)
 
         if fp is not None:
             # a down peer receives nothing
@@ -254,7 +279,22 @@ def make_randomsub_step(cfg: RandomSubSimConfig):
         new_state = RandomSubState(
             have=have, fresh=new, first_tick=first_tick,
             key=state.key, tick=tick + 1)
-        return new_state, delivered_now
+        if tel is None:
+            return new_state, delivered_now
+        kw_f = {}
+        if tel.counters:
+            kw_f.update(payload_sent=tel_sent,
+                        dup_suppressed=tel_recv - pc(new).sum(
+                            dtype=jnp.int32))
+            if tel.wire:
+                kw_f["bytes_payload"] = (tel_sent.astype(jnp.float32)
+                                         * float(ws.payload_frame))
+        if tel.faults and fp is not None:
+            kw_f["down_peers"] = (~alive).sum(dtype=jnp.int32)
+            if link is not None:
+                kw_f["dropped_edge_ticks"] = (
+                    (~link).sum(dtype=jnp.int32) // 2)
+        return new_state, delivered_now, _telemetry.make_frame(**kw_f)
 
     return step
 

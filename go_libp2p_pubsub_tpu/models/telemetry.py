@@ -1,0 +1,351 @@
+"""Device-side telemetry: in-scan protocol counters for the simulators.
+
+The reference dedicates a whole layer to observability (trace.go /
+tracer.go, 13 TraceEvent types), and the GossipSub paper's evaluation is
+built on exactly those measurements: control-message overhead, mesh
+degree health, and score distributions under attack.  The vectorized
+simulators previously returned only delivery counts; this module gives
+them the same quantities as DATA riding the ``lax.scan``:
+
+- ``TelemetryConfig`` is the static knob (baked into the compiled step,
+  like the simulator configs).  ``None`` — the default everywhere —
+  compiles the exact pre-telemetry step: every telemetry branch is
+  trace-time dead and the runners are bit-identical to a build without
+  this module (pinned by tests/test_telemetry.py).
+- ``TelemetryFrame`` is a pytree of per-tick SCALAR aggregates computed
+  with pure jnp ops inside the step (popcounts of the very masks the
+  step already holds, plus a few extra rolls for receiver-side counts —
+  the measured observation cost, see PERF_NOTES round 8).  A
+  telemetry-enabled step returns ``(state, delivered, frame)``; the
+  runners below collect the frames as scan ys, so a whole run's
+  timeline comes back in ONE dispatch, and ``vmap`` batches frames
+  across replicas like any other leaf (batched == sequential
+  bit-identical, pinned).
+- Bytes-on-wire estimates use the REFERENCE's protobuf framing: the
+  per-frame constants are measured from pb/rpc.py encodings at step
+  build time (``wire_sizes``), not guessed.
+
+Coverage by simulator: gossipsub emits the full frame; floodsub and
+randomsub emit the applicable subset (payload / duplicate / fault
+counters) with the gossip-only fields zero.  XLA path only — the pallas
+receive kernel, the floodsub gather step, and the randomsub dense MXU
+step refuse telemetry configs the way they refuse fault configs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..ops.graph import count_bits_per_position
+
+
+# --------------------------------------------------------------------------
+# Static configuration
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TelemetryConfig:
+    """Static telemetry knob (baked into the compiled step).
+
+    Group toggles (a disabled group's frame fields are zero and its
+    device work is trace-time dead):
+
+    - ``counters``: RPC sends by type (payload, IHAVE ids advertised,
+      IWANT ids requested/served, GRAFT, PRUNE) and duplicates
+      suppressed by the seen-cache.
+    - ``wire``: estimated bytes-on-wire from the pb/rpc.py framing
+      constants (requires ``counters``).
+    - ``mesh``: mesh-degree min/mean/max over subscribed peers.
+    - ``scores``: score-distribution summary over live candidate edges
+      (zero when the sim runs unscored).
+    - ``faults``: down-peer and dropped-edge-tick counts (zero when no
+      fault schedule rides the params).
+
+    Framing assumptions for the wire estimates (the sim's bit-position
+    message ids have no on-wire size, so representative lengths are
+    config):
+    ``payload_data_bytes`` per message body, ``msg_id_bytes`` per
+    message id, ``peer_id_bytes`` per peer id, ``topic_bytes`` per
+    topic string.
+    """
+
+    counters: bool = True
+    wire: bool = True
+    mesh: bool = True
+    scores: bool = True
+    faults: bool = True
+    payload_data_bytes: int = 64
+    msg_id_bytes: int = 8
+    peer_id_bytes: int = 8
+    topic_bytes: int = 8
+
+    def __post_init__(self):
+        if self.wire and not self.counters:
+            raise ValueError(
+                "TelemetryConfig: wire=True needs counters=True (byte "
+                "estimates are derived from the RPC counters)")
+        for name in ("payload_data_bytes", "msg_id_bytes",
+                     "peer_id_bytes", "topic_bytes"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"TelemetryConfig: {name} must be >= 1")
+
+
+@dataclass(frozen=True)
+class WireSizes:
+    """Per-frame byte constants measured from the pb/rpc.py encodings
+    (see ``wire_sizes``).  All include the varint length prefix of the
+    delimited stream framing (comm.go's protoio writer)."""
+
+    payload_frame: int   # one published message in its own RPC frame
+    ihave_base: int      # an RPC carrying one merged IHAVE, zero ids
+    ihave_per_id: int    # marginal bytes per advertised id
+    iwant_base: int      # an RPC carrying one IWANT, zero ids
+    iwant_per_id: int    # marginal bytes per requested id
+    graft_frame: int     # an RPC carrying one GRAFT
+    prune_frame: int     # an RPC carrying one PRUNE (no PX records)
+
+
+def wire_sizes(tcfg: TelemetryConfig) -> WireSizes:
+    """Measure the framing constants from actual pb/rpc.py encodings.
+
+    The per-id marginals are taken between the 2-id and 1-id encodings
+    (away from varint length-prefix boundaries), so ``base + k * per_id``
+    is an estimate for large k — within a few bytes of exact, which is
+    the right fidelity for an aggregate overhead ratio.
+    """
+    from ..pb import rpc as rpcpb
+    from ..pb.proto import write_delimited
+
+    mid = b"\x00" * tcfg.msg_id_bytes
+    pid = b"\x00" * tcfg.peer_id_bytes
+    topic = "t" * tcfg.topic_bytes
+
+    def fsz(msg):
+        return len(write_delimited(msg))
+
+    payload = fsz(rpcpb.RPC(publish=[rpcpb.PubMessage(
+        from_peer=pid, data=b"\x00" * tcfg.payload_data_bytes,
+        seqno=b"\x00" * 8, topic=topic)]))
+
+    def ih(k):
+        return fsz(rpcpb.RPC(control=rpcpb.ControlMessage(
+            ihave=[rpcpb.ControlIHave(topic_id=topic,
+                                      message_ids=[mid] * k)])))
+
+    def iw(k):
+        return fsz(rpcpb.RPC(control=rpcpb.ControlMessage(
+            iwant=[rpcpb.ControlIWant(message_ids=[mid] * k)])))
+
+    ihave_per = ih(2) - ih(1)
+    iwant_per = iw(2) - iw(1)
+    graft = fsz(rpcpb.RPC(control=rpcpb.ControlMessage(
+        graft=[rpcpb.ControlGraft(topic_id=topic)])))
+    prune = fsz(rpcpb.RPC(control=rpcpb.ControlMessage(
+        prune=[rpcpb.ControlPrune(topic_id=topic)])))
+    return WireSizes(
+        payload_frame=payload,
+        ihave_base=ih(1) - ihave_per, ihave_per_id=ihave_per,
+        iwant_base=iw(1) - iwant_per, iwant_per_id=iwant_per,
+        graft_frame=graft, prune_frame=prune)
+
+
+# --------------------------------------------------------------------------
+# The per-tick frame
+# --------------------------------------------------------------------------
+
+
+@struct.dataclass
+class TelemetryFrame:
+    """Per-tick scalar aggregates.  Every field is a 0-d jnp array so
+    scan ys stay tiny; a run's frames come back with a leading [T]
+    axis (and [T, B] when the step is vmapped over replicas).
+
+    Counter semantics (all network-wide totals for the tick):
+
+    - ``payload_sent``: payload message copies transmitted by eager
+      forwarding (mesh/fanout/direct/flood-publish).  Gossip-served
+      copies are counted separately in ``iwant_ids_served``.
+    - ``ihave_rpcs`` / ``ihave_ids``: edges carrying a (merged) IHAVE,
+      and total ids advertised — sender side, withholding spammers
+      included (they do advertise; that is the attack).
+    - ``iwant_ids_requested``: advertised ids the receiver lacked (it
+      would IWANT exactly these).  ``iwant_ids_served``: ids actually
+      delivered through the gossip pull — the requested-minus-served
+      gap is the broken-promise traffic P7 penalizes.
+    - ``graft_sends`` / ``prune_sends``: GRAFT / PRUNE control messages
+      transmitted (explicit prunes only; PRUNE responses to rejected
+      GRAFTs ride the step's A-mask abstraction and are not counted).
+    - ``dup_suppressed``: received copies that did not result in a new
+      acquisition (seen-cache duplicate or non-subscriber drop) — the
+      reference's DUPLICATE_MESSAGE analog.
+
+    Counts are relative to START-of-tick possession in both gossipsub
+    formulations, so the requested/served/byte outputs (and the
+    control-overhead ratio built from them) are identical between the
+    combined and force_split paths (pinned).  The one formulation-
+    dependent field is ``dup_suppressed``: the combined path's merged
+    eager+gossip word is ONE received copy where the split path (like
+    the reference's separate forward and gossip RPCs) counts two.
+    """
+
+    payload_sent: jnp.ndarray         # int32
+    ihave_rpcs: jnp.ndarray           # int32
+    ihave_ids: jnp.ndarray            # int32
+    iwant_rpcs: jnp.ndarray           # int32
+    iwant_ids_requested: jnp.ndarray  # int32
+    iwant_ids_served: jnp.ndarray     # int32
+    graft_sends: jnp.ndarray          # int32
+    prune_sends: jnp.ndarray          # int32
+    dup_suppressed: jnp.ndarray       # int32
+    bytes_payload: jnp.ndarray        # float32 (estimated wire bytes)
+    bytes_control: jnp.ndarray        # float32
+    mesh_deg_min: jnp.ndarray         # int32 (subscribed peers)
+    mesh_deg_mean: jnp.ndarray        # float32
+    mesh_deg_max: jnp.ndarray         # int32
+    score_mean: jnp.ndarray           # float32 (live candidate edges)
+    score_min: jnp.ndarray            # float32
+    score_frac_neg: jnp.ndarray       # float32 (fraction < 0)
+    score_frac_below_gossip: jnp.ndarray  # float32 (< gossip threshold)
+    down_peers: jnp.ndarray           # int32
+    dropped_edge_ticks: jnp.ndarray   # int32 (link loss + partition)
+
+
+_I32_FIELDS = ("payload_sent", "ihave_rpcs", "ihave_ids", "iwant_rpcs",
+               "iwant_ids_requested", "iwant_ids_served", "graft_sends",
+               "prune_sends", "dup_suppressed", "mesh_deg_min",
+               "mesh_deg_max", "down_peers", "dropped_edge_ticks")
+_F32_FIELDS = ("bytes_payload", "bytes_control", "mesh_deg_mean",
+               "score_mean", "score_min", "score_frac_neg",
+               "score_frac_below_gossip")
+
+
+def make_frame(**kw) -> TelemetryFrame:
+    """A TelemetryFrame with the given fields set and the rest zero —
+    how the floodsub/randomsub subsets (and disabled groups) fill in.
+    Values are cast to the field's canonical dtype."""
+    vals = {}
+    for name in _I32_FIELDS:
+        vals[name] = jnp.asarray(kw.pop(name, 0), dtype=jnp.int32)
+    for name in _F32_FIELDS:
+        vals[name] = jnp.asarray(kw.pop(name, 0.0), dtype=jnp.float32)
+    if kw:
+        raise TypeError(f"unknown TelemetryFrame fields: {sorted(kw)}")
+    return TelemetryFrame(**vals)
+
+
+def degree_stats(deg: jnp.ndarray, subscribed: jnp.ndarray):
+    """(min_i32, mean_f32, max_i32) of ``deg`` over subscribed peers
+    (all-zero when nobody subscribes)."""
+    sub = subscribed
+    n_sub = jnp.maximum(sub.sum(dtype=jnp.int32), 1)
+    any_sub = jnp.any(sub)
+    big = jnp.int32(jnp.iinfo(jnp.int32).max)
+    mn = jnp.min(jnp.where(sub, deg, big))
+    mx = jnp.max(jnp.where(sub, deg, jnp.int32(-1)))
+    mean = jnp.where(sub, deg, 0).sum(dtype=jnp.float32) / n_sub
+    zero = jnp.int32(0)
+    return (jnp.where(any_sub, mn, zero),
+            jnp.where(any_sub, mean, jnp.float32(0.0)),
+            jnp.where(any_sub, mx, zero))
+
+
+def score_stats(score: jnp.ndarray, mask: jnp.ndarray,
+                gossip_threshold: float):
+    """(mean, min, frac_below_zero, frac_below_gossip) of the [C, N]
+    per-edge score over edges where ``mask`` is True."""
+    n_live = jnp.maximum(mask.sum(dtype=jnp.int32), 1)
+    any_live = jnp.any(mask)
+    mean = jnp.where(mask, score, 0.0).sum(dtype=jnp.float32) / n_live
+    mn = jnp.min(jnp.where(mask, score, jnp.inf))
+    frac_neg = (mask & (score < 0.0)).sum(dtype=jnp.float32) / n_live
+    frac_gsp = (mask & (score < gossip_threshold)).sum(
+        dtype=jnp.float32) / n_live
+    zf = jnp.float32(0.0)
+    return (jnp.where(any_live, mean, zf),
+            jnp.where(any_live, mn, zf),
+            jnp.where(any_live, frac_neg, zf),
+            jnp.where(any_live, frac_gsp, zf))
+
+
+# --------------------------------------------------------------------------
+# Runners — model-agnostic: any step returning (state, delivered, frame)
+# --------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnums=(2, 3), donate_argnums=(1,))
+def telemetry_run(params, state, n_ticks: int, step):
+    """Advance ``n_ticks`` collecting the per-tick TelemetryFrame:
+    returns ``(state, frames)`` with a leading [n_ticks] axis on every
+    frame leaf.  ``step`` must be telemetry-enabled (returns a 3-tuple).
+    The state carry is donated, like every other runner — callers that
+    reuse the input state pass tree_copy (models/_batch.py)."""
+    def body(s, _):
+        out = step(params, s)
+        return out[0], out[2]
+    return jax.lax.scan(body, state, None, length=n_ticks)
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4), donate_argnums=(1,))
+def telemetry_run_curve(params, state, n_ticks: int, step, n_msgs: int):
+    """telemetry_run + per-tick delivered counts: returns
+    ``(state, counts [n_ticks, M], frames)``."""
+    def body(s, _):
+        s2, delivered, frame = step(params, s)
+        return s2, (count_bits_per_position(delivered, n_msgs), frame)
+    state, (counts, frames) = jax.lax.scan(body, state, None,
+                                           length=n_ticks)
+    return state, counts, frames
+
+
+@partial(jax.jit, static_argnums=(2, 3), donate_argnums=(1,))
+def telemetry_run_batch(params, state, n_ticks: int, step):
+    """telemetry_run over B stacked replicas (models/_batch.py
+    stack_trees): one scan of the vmapped step; frame leaves come back
+    [n_ticks, B].  Per replica the frames are bit-identical to the
+    sequential telemetry_run (pinned by tests/test_telemetry.py)."""
+    vstep = jax.vmap(step)
+
+    def body(s, _):
+        out = vstep(params, s)
+        return out[0], out[2]
+    return jax.lax.scan(body, state, None, length=n_ticks)
+
+
+# --------------------------------------------------------------------------
+# Host-side aggregation (tools / benches)
+# --------------------------------------------------------------------------
+
+
+def frames_to_arrays(frames: TelemetryFrame) -> dict:
+    """Frame pytree -> {field: np.ndarray} (whatever leading axes the
+    runner produced)."""
+    import numpy as np
+    return {name: np.asarray(getattr(frames, name))
+            for name in _I32_FIELDS + _F32_FIELDS}
+
+
+def summarize_frames(frames: TelemetryFrame) -> dict:
+    """Whole-run totals + the paper's control-overhead headline number
+    (control bytes / payload bytes).  Count fields are summed over every
+    axis; gauge fields (mesh/score) report their final-tick value."""
+    import numpy as np
+    arrs = frames_to_arrays(frames)
+    totals = {name: int(arrs[name].sum()) for name in _I32_FIELDS
+              if name not in ("mesh_deg_min", "mesh_deg_max",
+                              "down_peers")}
+    bytes_payload = float(arrs["bytes_payload"].sum())
+    bytes_control = float(arrs["bytes_control"].sum())
+    out = dict(totals)
+    out["bytes_payload"] = bytes_payload
+    out["bytes_control"] = bytes_control
+    out["control_overhead_ratio"] = (
+        bytes_control / bytes_payload if bytes_payload > 0 else 0.0)
+    out["final_mesh_deg_mean"] = float(
+        np.asarray(arrs["mesh_deg_mean"]).reshape(-1)[-1])
+    return out

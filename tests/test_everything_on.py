@@ -16,8 +16,6 @@ r4 weak-3).  This config combines:
 
 import numpy as np
 
-import jax.numpy as jnp
-
 import go_libp2p_pubsub_tpu.models.gossipsub as gs
 
 

@@ -3,14 +3,11 @@ cross-validation against the asyncio protocol core; sharded execution on a
 virtual 8-device mesh."""
 
 import numpy as np
-import jax
 import jax.numpy as jnp
 
 from go_libp2p_pubsub_tpu.models.floodsub import (
     first_tick_matrix,
-    FloodState,
     flood_run,
-    flood_step,
     make_flood_sim,
     reach_by_hops,
     reach_counts,

@@ -17,7 +17,6 @@ from go_libp2p_pubsub_tpu.core import (
     GOSSIPSUB_ID_V11,
     GossipSubParams,
     InProcNetwork,
-    MessageSignaturePolicy,
     create_floodsub,
     create_gossipsub,
     fragment_rpc,

@@ -13,7 +13,6 @@ import pytest
 
 from go_libp2p_pubsub_tpu.models.gossipsub import (
     GossipSimConfig,
-    GossipState,
     _pack_bits_pm_np,
     index_trees,
     make_gossip_offsets,
@@ -27,7 +26,6 @@ from go_libp2p_pubsub_tpu.models.gossipsub import (
     gossip_run_curve_batch,
     reach_counts,
     refresh_gates,
-    first_tick_matrix,
     stack_sims,
     tree_copy,
 )

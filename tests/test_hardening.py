@@ -6,8 +6,6 @@ from __future__ import annotations
 
 import random
 
-import pytest
-
 from go_libp2p_pubsub_tpu.core import (
     AcceptStatus,
     GossipTracer,

@@ -19,7 +19,6 @@ from go_libp2p_pubsub_tpu.interop import (
     run_core_floodsub,
 )
 from go_libp2p_pubsub_tpu.models.floodsub import (
-    first_tick_matrix,
     flood_run,
     flood_step,
     make_flood_sim,

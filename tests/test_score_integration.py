@@ -11,7 +11,6 @@ import random
 
 from go_libp2p_pubsub_tpu.core import (
     AcceptStatus,
-    GossipSubParams,
     InProcNetwork,
     MessageSignaturePolicy,
     PeerScoreParams,

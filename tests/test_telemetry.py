@@ -1,0 +1,353 @@
+"""Device-side telemetry (models/telemetry.py): telemetry-off runs are
+bit-identical to pre-telemetry behavior, telemetry-on runs leave the
+state trajectory untouched, batched frames match sequential exactly,
+and the counters/byte estimates are sane against hand-checkable
+quantities."""
+
+import numpy as np
+import jax
+import pytest
+
+import go_libp2p_pubsub_tpu.models.faults as fl
+import go_libp2p_pubsub_tpu.models.floodsub as fs
+import go_libp2p_pubsub_tpu.models.gossipsub as gs
+import go_libp2p_pubsub_tpu.models.randomsub as rs
+import go_libp2p_pubsub_tpu.models.telemetry as tl
+from go_libp2p_pubsub_tpu.ops.graph import make_circulant_offsets
+
+
+def tree_equal(a, b):
+    """Exact (bitwise) equality over two pytrees."""
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+def gossip_inputs(n=600, t=3, m=8, seed=6):
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, 16, n, seed=seed), n_topics=t)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    rng = np.random.default_rng(seed)
+    topic = rng.integers(0, t, m)
+    origin = rng.integers(0, n // t, m) * t + topic
+    ticks = rng.integers(0, 10, m).astype(np.int32)
+    return cfg, subs, topic, origin, ticks
+
+
+# --------------------------------------------------------------------------
+# Config validation + wire sizes
+# --------------------------------------------------------------------------
+
+
+def test_config_validates():
+    with pytest.raises(ValueError, match="wire"):
+        tl.TelemetryConfig(counters=False, wire=True)
+    with pytest.raises(ValueError, match="msg_id_bytes"):
+        tl.TelemetryConfig(msg_id_bytes=0)
+
+
+def test_wire_sizes_match_pb_encodings():
+    """The framing constants come from ACTUAL pb/rpc.py encodings, and
+    base + k * per_id tracks the exact k-id encoding."""
+    from go_libp2p_pubsub_tpu.pb import rpc as rpcpb
+    from go_libp2p_pubsub_tpu.pb.proto import write_delimited
+
+    tcfg = tl.TelemetryConfig()
+    ws = tl.wire_sizes(tcfg)
+    msg = rpcpb.PubMessage(
+        from_peer=b"\x00" * tcfg.peer_id_bytes,
+        data=b"\x00" * tcfg.payload_data_bytes,
+        seqno=b"\x00" * 8, topic="t" * tcfg.topic_bytes)
+    assert ws.payload_frame == len(write_delimited(
+        rpcpb.RPC(publish=[msg])))
+
+    def ih(k):
+        return len(write_delimited(rpcpb.RPC(
+            control=rpcpb.ControlMessage(ihave=[rpcpb.ControlIHave(
+                topic_id="t" * tcfg.topic_bytes,
+                message_ids=[b"\x00" * tcfg.msg_id_bytes] * k)]))))
+
+    assert ws.ihave_base + 3 * ws.ihave_per_id == ih(3)
+    assert ws.graft_frame > 0 and ws.prune_frame > 0
+    assert ws.iwant_per_id > tcfg.msg_id_bytes  # id + tag/len overhead
+
+
+# --------------------------------------------------------------------------
+# Bit-identity: telemetry only READS
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("scored", [False, True])
+def test_gossip_state_identical_with_telemetry(scored):
+    cfg, subs, topic, origin, ticks = gossip_inputs()
+    sc = gs.ScoreSimConfig() if scored else None
+    p1, s1 = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                                score_cfg=sc)
+    p2, s2 = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                                score_cfg=sc)
+    fin_off = gs.gossip_run(p1, s1, 25, gs.make_gossip_step(cfg, sc))
+    fin_on, frames = tl.telemetry_run(
+        p2, s2, 25, gs.make_gossip_step(cfg, sc,
+                                        telemetry=tl.TelemetryConfig()))
+    assert tree_equal(fin_off, fin_on)
+    arr = tl.frames_to_arrays(frames)
+    assert arr["payload_sent"].shape == (25,)
+    assert arr["payload_sent"].sum() > 0
+    assert arr["graft_sends"].sum() > 0
+
+
+def test_gossip_split_path_state_identical_with_telemetry():
+    """The force_split (separate mesh/gossip loop) formulation carries
+    its own telemetry tallies — state must stay untouched there too."""
+    cfg, subs, topic, origin, ticks = gossip_inputs()
+    p1, s1 = gs.make_gossip_sim(cfg, subs, topic, origin, ticks)
+    p2, s2 = gs.make_gossip_sim(cfg, subs, topic, origin, ticks)
+    fin_off = gs.gossip_run(
+        p1, s1, 20, gs.make_gossip_step(cfg, force_split=True))
+    fin_on, frames = tl.telemetry_run(
+        p2, s2, 20, gs.make_gossip_step(
+            cfg, force_split=True, telemetry=tl.TelemetryConfig()))
+    assert tree_equal(fin_off, fin_on)
+    assert tl.frames_to_arrays(frames)["payload_sent"].sum() > 0
+
+
+def test_flood_state_identical_with_telemetry():
+    n, t, m = 300, 3, 6
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    rng = np.random.default_rng(2)
+    topic = rng.integers(0, t, m)
+    origin = rng.integers(0, n // t, m) * t + topic
+    ticks = np.zeros(m, dtype=np.int32)
+    offs = tuple(int(o) for o in make_circulant_offsets(t, 12, n, seed=1))
+    p1, s1 = fs.make_flood_sim(None, None, subs, None, topic, origin,
+                               ticks)
+    p2, s2 = fs.make_flood_sim(None, None, subs, None, topic, origin,
+                               ticks)
+    core_off = fs.make_circulant_step_core(offs)
+    core_on = fs.make_circulant_step_core(
+        offs, telemetry=tl.TelemetryConfig())
+    fin1, counts1 = fs.flood_run_curve(p1, s1, 15, core_off, m)
+    fin2, counts2, frames = tl.telemetry_run_curve(p2, s2, 15, core_on,
+                                                   m)
+    assert tree_equal(fin1, fin2)
+    assert np.array_equal(np.asarray(counts1), np.asarray(counts2))
+    arr = tl.frames_to_arrays(frames)
+    assert arr["payload_sent"].sum() > 0
+    assert arr["dup_suppressed"].sum() > 0      # floods re-hear a lot
+    # gossip-only fields are zero in the floodsub subset
+    assert arr["ihave_ids"].sum() == 0
+    assert arr["graft_sends"].sum() == 0
+
+
+def test_randomsub_state_identical_with_telemetry():
+    n, t, m = 400, 2, 6
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    rng = np.random.default_rng(3)
+    topic = rng.integers(0, t, m)
+    origin = rng.integers(0, n // t, m) * t + topic
+    ticks = np.zeros(m, dtype=np.int32)
+    cfg = rs.RandomSubSimConfig(
+        offsets=rs.make_randomsub_offsets(t, 24, n, seed=2), n_topics=t)
+    p1, s1 = rs.make_randomsub_sim(cfg, subs, topic, origin, ticks)
+    p2, s2 = rs.make_randomsub_sim(cfg, subs, topic, origin, ticks)
+    fin1 = rs.randomsub_run(p1, s1, 15, rs.make_randomsub_step(cfg))
+    fin2, frames = tl.telemetry_run(
+        p2, s2, 15,
+        rs.make_randomsub_step(cfg, telemetry=tl.TelemetryConfig()))
+    assert tree_equal(fin1, fin2)
+    arr = tl.frames_to_arrays(frames)
+    assert arr["payload_sent"].sum() > 0
+    assert arr["ihave_ids"].sum() == 0
+
+
+def test_pallas_step_refuses_telemetry():
+    """Kernel path: telemetry configs are refused outright (the same
+    contract as the fault-config refusal)."""
+    cfg, subs, topic, origin, ticks = gossip_inputs()
+    params, state = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                                       pad_to_block=1024)
+    step = gs.make_gossip_step(cfg, receive_block=1024,
+                               telemetry=tl.TelemetryConfig())
+    with pytest.raises(ValueError, match="telemetry is XLA-path only"):
+        step(params, state)
+
+
+# --------------------------------------------------------------------------
+# Batched == sequential, per replica, bit-for-bit
+# --------------------------------------------------------------------------
+
+
+def test_batched_frames_match_sequential():
+    cfg, subs, topic, origin, ticks = gossip_inputs(n=300, t=3, m=6)
+    sc = gs.ScoreSimConfig()
+    tcfg = tl.TelemetryConfig()
+    step = gs.make_gossip_step(cfg, sc, telemetry=tcfg)
+    specs = [dict(subs=subs, msg_topic=topic, msg_origin=origin,
+                  msg_publish_tick=ticks, seed=r, score_cfg=sc)
+             for r in range(3)]
+    params_b, state_b = gs.stack_sims(cfg, specs)
+    fin_b, frames_b = tl.telemetry_run_batch(params_b, state_b, 20,
+                                             step)
+    arr_b = tl.frames_to_arrays(frames_b)          # each [T, B]
+    for i, spec in enumerate(specs):
+        p_i, s_i = gs.make_gossip_sim(cfg, **spec)
+        fin_i, frames_i = tl.telemetry_run(p_i, s_i, 20, step)
+        arr_i = tl.frames_to_arrays(frames_i)      # each [T]
+        assert tree_equal(gs.index_trees(fin_b, i), fin_i)
+        for name, col in arr_b.items():
+            assert np.array_equal(col[:, i], arr_i[name]), name
+
+
+# --------------------------------------------------------------------------
+# Counter semantics against hand-checkable quantities
+# --------------------------------------------------------------------------
+
+
+def test_gossip_counters_and_bytes_consistent():
+    cfg, subs, topic, origin, ticks = gossip_inputs()
+    tcfg = tl.TelemetryConfig()
+    ws = tl.wire_sizes(tcfg)
+    params, state = gs.make_gossip_sim(cfg, subs, topic, origin, ticks)
+    _, frames = tl.telemetry_run(
+        params, state, 25, gs.make_gossip_step(cfg, telemetry=tcfg))
+    a = tl.frames_to_arrays(frames)
+    # no withholding: every requested id is served
+    assert (a["iwant_ids_requested"] == a["iwant_ids_served"]).all()
+    # degree ordering holds tick-wise once meshes exist
+    assert (a["mesh_deg_min"] <= a["mesh_deg_max"]).all()
+    live = a["mesh_deg_max"] > 0
+    assert (a["mesh_deg_mean"][live]
+            <= a["mesh_deg_max"][live] + 1e-6).all()
+    # byte estimates are exact functions of the counters
+    np.testing.assert_allclose(
+        a["bytes_payload"],
+        (a["payload_sent"] + a["iwant_ids_served"]).astype(np.float64)
+        * ws.payload_frame, rtol=1e-6)
+    expect_ctl = (a["ihave_rpcs"] * ws.ihave_base
+                  + a["ihave_ids"] * ws.ihave_per_id
+                  + a["iwant_rpcs"] * ws.iwant_base
+                  + a["iwant_ids_requested"] * ws.iwant_per_id
+                  + a["graft_sends"] * ws.graft_frame
+                  + a["prune_sends"] * ws.prune_frame)
+    np.testing.assert_allclose(a["bytes_control"],
+                               expect_ctl.astype(np.float64), rtol=1e-6)
+    # unscored run: score summary group stays zero
+    assert (a["score_mean"] == 0).all() and (a["score_min"] == 0).all()
+
+
+def test_gossip_score_summary_live_when_scored():
+    cfg, subs, topic, origin, ticks = gossip_inputs()
+    sc = gs.ScoreSimConfig()
+    params, state = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                                       score_cfg=sc)
+    _, frames = tl.telemetry_run(
+        params, state, 25,
+        gs.make_gossip_step(cfg, sc, telemetry=tl.TelemetryConfig()))
+    a = tl.frames_to_arrays(frames)
+    # honest steady traffic: P1/P2 accrue, so the mean goes positive
+    # and nobody sinks below the gossip threshold
+    assert a["score_mean"][-1] > 0
+    assert (a["score_min"] <= a["score_mean"] + 1e-6).all()
+    assert (a["score_frac_below_gossip"] == 0).all()
+
+
+def test_fault_counters_exact():
+    """down_peers tracks the churn table exactly; with partitions only
+    (drop_prob=0) dropped_edge_ticks equals the cross-edge count during
+    the window and 0 outside."""
+    cfg, subs, topic, origin, ticks = gossip_inputs()
+    n = subs.shape[0]
+    grp = (np.arange(n) < n // 2).astype(np.int64)
+    sched = fl.FaultSchedule(
+        n_peers=n, horizon=30,
+        down_intervals=[(7, 3, 9), (11, 5, 30)],
+        partition_group=grp, partition_windows=[(10, 14)], seed=4)
+    params, state = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                                       fault_schedule=sched)
+    _, frames = tl.telemetry_run(
+        params, state, 30,
+        gs.make_gossip_step(cfg, telemetry=tl.TelemetryConfig()))
+    a = tl.frames_to_arrays(frames)
+    expect_down = np.zeros(30, dtype=np.int64)
+    expect_down[3:9] += 1
+    expect_down[5:30] += 1
+    assert np.array_equal(a["down_peers"], expect_down)
+    # cross-edge count from the offsets (both views / 2)
+    cross = sum(int((grp != np.roll(grp, -o)).sum())
+                for o in cfg.offsets) // 2
+    in_window = np.zeros(30, dtype=bool)
+    in_window[10:14] = True
+    assert (a["dropped_edge_ticks"][in_window] == cross).all()
+    assert (a["dropped_edge_ticks"][~in_window] == 0).all()
+
+
+def test_frame_subset_groups_disable():
+    """Disabled groups zero their fields and still compile."""
+    cfg, subs, topic, origin, ticks = gossip_inputs(n=300)
+    tcfg = tl.TelemetryConfig(counters=False, wire=False, scores=False,
+                              faults=False)
+    params, state = gs.make_gossip_sim(cfg, subs, topic, origin, ticks)
+    _, frames = tl.telemetry_run(
+        params, state, 10, gs.make_gossip_step(cfg, telemetry=tcfg))
+    a = tl.frames_to_arrays(frames)
+    assert (a["payload_sent"] == 0).all()
+    assert (a["bytes_control"] == 0).all()
+    assert a["mesh_deg_max"][-1] > 0           # mesh group still on
+
+
+def test_telemetry_works_with_zero_messages():
+    """A mesh-formation-only sim (empty message table, W == 0) runs
+    under telemetry wherever the plain step runs — the counters just
+    stay zero while the mesh/graft groups stay live."""
+    cfg, subs, _, _, _ = gossip_inputs(n=300)
+    empty = np.zeros(0, dtype=np.int64)
+    params, state = gs.make_gossip_sim(
+        cfg, subs, empty, empty, empty.astype(np.int32))
+    _, frames = tl.telemetry_run(
+        params, state, 10,
+        gs.make_gossip_step(cfg, telemetry=tl.TelemetryConfig()))
+    a = tl.frames_to_arrays(frames)
+    assert (a["payload_sent"] == 0).all()
+    assert (a["iwant_ids_requested"] == 0).all()
+    assert a["graft_sends"].sum() > 0
+    assert a["mesh_deg_max"][-1] > 0
+
+
+def test_combined_and_split_paths_agree_on_frames():
+    """The control-overhead outputs are formulation-invariant: the
+    combined (fused-roll) and force_split step emit identical frames
+    for every field except dup_suppressed (documented: a merged
+    eager+gossip word is one received copy vs the split path's two)."""
+    cfg, subs, topic, origin, ticks = gossip_inputs()
+    tcfg = tl.TelemetryConfig()
+    p1, s1 = gs.make_gossip_sim(cfg, subs, topic, origin, ticks)
+    p2, s2 = gs.make_gossip_sim(cfg, subs, topic, origin, ticks)
+    _, fr_c = tl.telemetry_run(
+        p1, s1, 25, gs.make_gossip_step(cfg, telemetry=tcfg))
+    _, fr_s = tl.telemetry_run(
+        p2, s2, 25,
+        gs.make_gossip_step(cfg, force_split=True, telemetry=tcfg))
+    a_c, a_s = tl.frames_to_arrays(fr_c), tl.frames_to_arrays(fr_s)
+    for name in a_c:
+        if name == "dup_suppressed":
+            assert (a_s[name] >= a_c[name]).all()
+            continue
+        assert np.array_equal(a_c[name], a_s[name]), name
+
+
+def test_summarize_frames():
+    cfg, subs, topic, origin, ticks = gossip_inputs(n=300)
+    params, state = gs.make_gossip_sim(cfg, subs, topic, origin, ticks)
+    _, frames = tl.telemetry_run(
+        params, state, 15,
+        gs.make_gossip_step(cfg, telemetry=tl.TelemetryConfig()))
+    s = tl.summarize_frames(frames)
+    assert s["payload_sent"] > 0
+    assert s["bytes_payload"] > 0
+    assert 0 < s["control_overhead_ratio"] < 10
+    assert s["final_mesh_deg_mean"] > 0

@@ -1,0 +1,17 @@
+#!/bin/bash
+# Lint preflight: ruff with the pinned repo config (ruff.toml) when
+# ruff is installed; otherwise the stdlib-only fallback subset checker
+# (tools/lint_fallback.py — same enforced rule families), so hermetic
+# containers without ruff still gate on a clean pass.  Wired into
+# tools/measure_all.sh as step 0: a measurement pass from a dirty tree
+# wastes chip hours.
+set -u
+cd "$(dirname "$0")/.."
+if command -v ruff >/dev/null 2>&1; then
+  exec ruff check --config ruff.toml .
+fi
+if python -c "import ruff" >/dev/null 2>&1; then
+  exec python -m ruff check --config ruff.toml .
+fi
+echo "lint.sh: ruff not installed — running the stdlib fallback" >&2
+exec python tools/lint_fallback.py

@@ -87,6 +87,18 @@ run() {
     fi
   fi
 }
+# 0. lint preflight (CPU-only, seconds): a measurement pass burning
+# chip-hours from a tree that doesn't even lint is a wasted window —
+# fail fast before the first TPU step (tools/lint.sh: pinned ruff
+# config, stdlib fallback where ruff isn't installed)
+echo "=== lint preflight ===" | tee -a "$log"
+bash tools/lint.sh 2>&1 | tee -a "$log"
+if [ "${PIPESTATUS[0]}" -ne 0 ]; then
+  echo "!! lint preflight failed — fix findings before measuring" \
+    | tee -a "$log"
+  sync_log
+  exit 4
+fi
 # 1. hardware kernel-identity artifact (small run, judge deliverable)
 run 1800 python tools/kernel_identity.py 200000 KERNEL_IDENTITY_r05.json
 # 2. the flagship driver metric — forced-XLA so the pass ALWAYS

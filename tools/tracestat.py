@@ -7,8 +7,13 @@ here it ships with the framework).
 Reads either sink format (core/tracer_sinks.py and interop/export.py
 write both): ndjson (NewJSONTracer, tracer.go:85) or varint-delimited
 protobuf (NewPBTracer, tracer.go:137).  Prints per-event-type counts,
-per-message delivery coverage, and the publish->deliver latency
-distribution.
+per-message delivery coverage, the publish->deliver latency
+distribution (global and per topic, p50/p90/p99), and control-plane
+event rates (GRAFT/PRUNE/JOIN/LEAVE/... per second over the trace
+span).
+
+An empty or unparseable trace file is an ERROR (nonzero exit with the
+offending path named), never a silent zero-count report.
 
 Usage: python tools/tracestat.py trace.json [trace2.pb ...] [--json]
 """
@@ -28,6 +33,17 @@ from go_libp2p_pubsub_tpu.pb.trace import TraceType  # noqa: E402
 _SUB_KEYS = ("publish_message", "deliver_message", "reject_message",
              "duplicate_message")
 
+# everything that is not payload-path (publish/deliver/reject/dup) is
+# control-plane bookkeeping: peer/RPC/membership/mesh events
+_CONTROL_TYPES = (TraceType.ADD_PEER, TraceType.REMOVE_PEER,
+                  TraceType.RECV_RPC, TraceType.SEND_RPC,
+                  TraceType.DROP_RPC, TraceType.JOIN, TraceType.LEAVE,
+                  TraceType.GRAFT, TraceType.PRUNE)
+
+
+class TraceParseError(Exception):
+    """A trace file that cannot be summarized (empty / unparseable)."""
+
 
 def _is_json(data: bytes) -> bool:
     """Sniff the sink format: a delimited-pb stream could by chance
@@ -43,52 +59,104 @@ def _is_json(data: bytes) -> bool:
         return False
 
 
-def iter_events(path: str):
-    """Yield (type:int, msg_id:bytes|None, ts:int|None) from either
-    sink format."""
-    with open(path, "rb") as f:
-        data = f.read()
+def load_events(path: str):
+    """Read a trace file into a list of ``(type, msg_id, ts, topic)``
+    tuples (either sink format).  Raises TraceParseError — with the
+    path and reason — on an empty, event-free, or unparseable file
+    instead of yielding a silent zero-count summary."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        raise TraceParseError(f"{path}: cannot read trace file ({e})")
+    if not data:
+        raise TraceParseError(f"{path}: empty trace file")
+    events = []
     if _is_json(data):
-        for line in data.decode("utf-8", "surrogateescape").splitlines():
+        lines = data.decode("utf-8", "surrogateescape").splitlines()
+        for lineno, line in enumerate(lines, start=1):
             line = line.strip()
             if not line:
                 continue
-            ev = json.loads(line)
-            mid = None
+            try:
+                ev = json.loads(line)
+            except ValueError as e:
+                raise TraceParseError(
+                    f"{path}:{lineno}: unparseable ndjson line ({e})")
+            if not isinstance(ev, dict):
+                raise TraceParseError(
+                    f"{path}:{lineno}: ndjson line is not an object")
+            mid = topic = None
             for k in _SUB_KEYS:
                 sub = ev.get(k)
                 if sub and "message_id" in sub:
                     mid = base64.b64decode(sub["message_id"])
+                    topic = sub.get("topic")
                     break
-            yield ev.get("type"), mid, ev.get("timestamp")
+            events.append((ev.get("type"), mid, ev.get("timestamp"),
+                           topic))
     else:
-        for ev in iter_delimited(tr.TraceEvent, data):
-            sub = (ev.publish_message or ev.deliver_message
-                   or ev.reject_message or ev.duplicate_message)
-            mid = sub.message_id if sub else None
-            yield ev.type, mid, ev.timestamp
+        try:
+            for ev in iter_delimited(tr.TraceEvent, data):
+                sub = (ev.publish_message or ev.deliver_message
+                       or ev.reject_message or ev.duplicate_message)
+                mid = sub.message_id if sub else None
+                topic = sub.topic if sub else None
+                events.append((ev.type, mid, ev.timestamp, topic))
+        except ValueError as e:
+            raise TraceParseError(
+                f"{path}: unparseable delimited-pb stream ({e})")
+    if not events:
+        raise TraceParseError(f"{path}: no trace events in file")
+    return events
+
+
+def _percentiles(latencies):
+    """{p50, p90, p99, count} of a latency list (ns)."""
+    lat = sorted(latencies)
+    k = len(lat)
+
+    def q(p):
+        return lat[min(k - 1, (k * p) // 100)]
+
+    return {"p50": q(50), "p90": q(90), "p99": q(99), "count": k}
 
 
 def stats(paths):
+    by_file = [load_events(p) for p in paths]
     counts = {}
     publish_ts = {}
+    publish_topic = {}
     deliveries = {}
     latencies = []
+    lat_by_topic = {}
+    ts_min = ts_max = None
     # first pass: publish timestamps across ALL files — per-node traces
     # put publishes and deliveries in different files, and argument
     # order must not change the latency pairing
-    for path in paths:
-        for typ, mid, ts in iter_events(path):
+    for events in by_file:
+        for typ, mid, ts, topic in events:
             if typ == TraceType.PUBLISH_MESSAGE and mid is not None:
                 publish_ts.setdefault(mid, ts)
-    for path in paths:
-        for typ, mid, ts in iter_events(path):
+                if topic is not None:
+                    publish_topic.setdefault(mid, topic)
+    for events in by_file:
+        for typ, mid, ts, topic in events:
             name = TraceType.NAMES.get(typ, str(typ))
             counts[name] = counts.get(name, 0) + 1
+            if ts is not None:
+                ts_min = ts if ts_min is None else min(ts_min, ts)
+                ts_max = ts if ts_max is None else max(ts_max, ts)
             if typ == TraceType.DELIVER_MESSAGE and mid is not None:
                 deliveries[mid] = deliveries.get(mid, 0) + 1
                 if ts is not None and publish_ts.get(mid) is not None:
-                    latencies.append(ts - publish_ts[mid])
+                    lat = ts - publish_ts[mid]
+                    latencies.append(lat)
+                    # topic from the delivery itself, else the publish
+                    tpc = (topic if topic is not None
+                           else publish_topic.get(mid))
+                    if tpc is not None:
+                        lat_by_topic.setdefault(tpc, []).append(lat)
     # coverage is per PUBLISHED message: a lost message counts as 0,
     # not as absent
     per_pub = ({mid: deliveries.get(mid, 0) for mid in publish_ts}
@@ -104,14 +172,31 @@ def stats(paths):
                                    if per_pub else 0),
     }
     if latencies:
-        latencies.sort()
-        k = len(latencies)
+        pct = _percentiles(latencies)
         out["latency_ns"] = {
-            "min": latencies[0],
-            "p50": latencies[k // 2],
-            "p99": latencies[min(k - 1, (k * 99) // 100)],
-            "max": latencies[-1],
-            "mean": sum(latencies) / k,
+            "min": min(latencies),
+            "p50": pct["p50"], "p90": pct["p90"], "p99": pct["p99"],
+            "max": max(latencies),
+            "mean": sum(latencies) / len(latencies),
+        }
+    if lat_by_topic:
+        out["latency_by_topic_ns"] = {
+            tpc: _percentiles(lat)
+            for tpc, lat in sorted(lat_by_topic.items())}
+    # control-plane event rates over the trace's timestamp span (the
+    # GossipSub paper's control-overhead measurements are rates, not
+    # totals)
+    ctl = {TraceType.NAMES[t]: counts.get(TraceType.NAMES[t], 0)
+           for t in _CONTROL_TYPES
+           if counts.get(TraceType.NAMES[t], 0)}
+    if ctl and ts_min is not None:
+        span_s = (ts_max - ts_min) / 1e9
+        out["control"] = {
+            "span_seconds": span_s,
+            "total_events": sum(ctl.values()),
+            "events_per_sec": (
+                {name: cnt / span_s for name, cnt in sorted(ctl.items())}
+                if span_s > 0 else None),
         }
     return out
 
@@ -121,7 +206,11 @@ def main():
     as_json = "--json" in sys.argv[1:]
     if not args:
         raise SystemExit(__doc__)
-    out = stats(args)
+    try:
+        out = stats(args)
+    except TraceParseError as e:
+        print(f"tracestat: error: {e}", file=sys.stderr)
+        raise SystemExit(2)
     if as_json:
         print(json.dumps(out, indent=2))
         return
@@ -136,8 +225,17 @@ def main():
     if "latency_ns" in out:
         la = out["latency_ns"]
         print("publish->deliver latency (ns): "
-              f"min {la['min']}  p50 {la['p50']}  p99 {la['p99']}  "
-              f"max {la['max']}  mean {la['mean']:.0f}")
+              f"min {la['min']}  p50 {la['p50']}  p90 {la['p90']}  "
+              f"p99 {la['p99']}  max {la['max']}  mean {la['mean']:.0f}")
+    for tpc, pct in out.get("latency_by_topic_ns", {}).items():
+        print(f"  topic {tpc:16s} p50 {pct['p50']}  p90 {pct['p90']}  "
+              f"p99 {pct['p99']}  ({pct['count']} deliveries)")
+    if "control" in out:
+        ctl = out["control"]
+        print(f"control events     : {ctl['total_events']} over "
+              f"{ctl['span_seconds']:.1f}s")
+        for name, rate in (ctl["events_per_sec"] or {}).items():
+            print(f"  {name:24s} {rate:.2f}/s")
 
 
 if __name__ == "__main__":

@@ -28,6 +28,7 @@ CPU-only (the core is asyncio; the sim runs fine on the CPU backend).
 Usage: python tools/validate_curves.py [K] [out.json] [n]
                                        [--batch B] [--sequential]
                                        [--sim-only] [--degradation]
+                                       [--telemetry]
 
 --sim-only skips the asyncio core side entirely: it times and reports
 just the sim replica sweep (the perf-comparison mode recorded in
@@ -38,6 +39,14 @@ repair enabled): the same K-replica batch at several link-drop levels
 with 10% churn overlapping the publish tick (models/faults.py),
 recording the mean reachability curve and final delivered fraction per
 level — the graceful-degradation artifact.
+
+--telemetry runs the TELEMETRY timeline sweep instead (sim only,
+gossip repair enabled, models/telemetry.py full frame): the same
+K-replica batch through telemetry_run_batch, dumping the per-tick
+replica-mean timeline of the protocol counters (payload copies, IHAVE
+ids, gossip pulls, GRAFT/PRUNE, duplicates, mesh degree, estimated
+wire bytes) plus the whole-run control-overhead ratio — the
+observability artifact.
 """
 
 from __future__ import annotations
@@ -250,6 +259,93 @@ def _degradation_sweep(chunks, n, M, HOPS, sequential, out_path,
         "sweep_seconds": report["sweep_seconds"]}))
 
 
+TELEMETRY_FIELDS = ("payload_sent", "ihave_ids", "iwant_ids_served",
+                    "graft_sends", "prune_sends", "dup_suppressed",
+                    "mesh_deg_mean", "bytes_payload", "bytes_control")
+
+
+def _telemetry_sweep(chunks, n, M, sequential, out_path, mode="?"):
+    """Per-tick telemetry timeline over the SAME replica specs as the
+    curve sweep (gossip repair ON, full TelemetryFrame): one
+    telemetry_run_batch per chunk — frames come back [T, B] and are
+    averaged across replicas per tick.  Writes the timeline artifact
+    with the whole-run control/payload byte totals and prints a
+    one-line summary."""
+    import time as _time
+
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    import go_libp2p_pubsub_tpu.models.telemetry as tl
+
+    subs = np.ones((n, 1), dtype=bool)
+    TICKS = 110
+    tcfg = tl.TelemetryConfig()
+    t0 = _time.perf_counter()
+    per_field = {f: [] for f in TELEMETRY_FIELDS}   # replica [T] rows
+    fell_back = False
+    for chunk in chunks:
+        cfg = gs.GossipSimConfig(
+            offsets=chunk["offsets"], n_topics=1, d=3, d_lo=2,
+            d_hi=6, d_score=2, d_out=1)
+        step = gs.make_gossip_step(cfg, None, telemetry=tcfg)
+        specs = [dict(subs=subs, msg_topic=np.zeros(M, np.int64),
+                      msg_origin=np.array(m["publishers"]),
+                      msg_publish_tick=np.full(M, 90, np.int32),
+                      seed=m["seed"])
+                 for m in chunk["members"]]
+        arrs = None
+        if not (sequential or len(specs) == 1):
+            try:
+                params_b, state_b = gs.stack_sims(cfg, specs)
+                _, fr_b = tl.telemetry_run_batch(params_b, state_b,
+                                                 TICKS, step)
+                arrs = tl.frames_to_arrays(fr_b)      # each [T, B]
+                for i in range(len(specs)):
+                    for f in TELEMETRY_FIELDS:
+                        per_field[f].append(
+                            np.asarray(arrs[f][:, i], dtype=np.float64))
+            except Exception as e:  # OOM / backend refusal: the
+                # per-replica loop is identical (see _sim_sweep)
+                fell_back = True
+                print(f"batched telemetry chunk failed "
+                      f"({type(e).__name__}: {e}); falling back to "
+                      "the sequential loop", file=sys.stderr)
+                arrs = None
+        if arrs is None:
+            for spec in specs:
+                p_, s_ = gs.make_gossip_sim(cfg, **spec)
+                _, fr = tl.telemetry_run(p_, s_, TICKS, step)
+                fa = tl.frames_to_arrays(fr)          # each [T]
+                for f in TELEMETRY_FIELDS:
+                    per_field[f].append(
+                        np.asarray(fa[f], dtype=np.float64))
+    dt = _time.perf_counter() - t0
+    if fell_back:
+        mode += "+seq-fallback"
+    timeline = {f: [round(float(x), 3)
+                    for x in np.mean(per_field[f], axis=0)]
+                for f in TELEMETRY_FIELDS}
+    bp = float(np.sum(per_field["bytes_payload"]))
+    bc = float(np.sum(per_field["bytes_control"]))
+    report = {
+        "config": {"n_hosts": n, "msgs_per_run": M,
+                   "runs": len(per_field["payload_sent"]),
+                   "publish_tick": 90, "mode": mode},
+        "ticks": TICKS,
+        "mean_timeline": timeline,
+        "bytes_payload_total": round(bp, 1),
+        "bytes_control_total": round(bc, 1),
+        "control_overhead_ratio": round(bc / bp, 4) if bp else 0.0,
+        "sweep_seconds": round(dt, 3),
+    }
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps({
+        "telemetry_runs": report["config"]["runs"],
+        "control_overhead_ratio": report["control_overhead_ratio"],
+        "mode": mode,
+        "sweep_seconds": report["sweep_seconds"]}))
+
+
 def _replica_stats(gs, params, fin, HOPS, n):
     from go_libp2p_pubsub_tpu.interop import mean_reach_fraction
 
@@ -283,6 +379,10 @@ def main():
     ap.add_argument("--degradation", action="store_true",
                     help="fault-injection sweep (churn + link-drop "
                          "levels) instead of the core comparison")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="telemetry timeline sweep (per-tick protocol "
+                         "counters + control-overhead artifact) "
+                         "instead of the core comparison")
     ns = ap.parse_args()
     batch_override = ns.batch
     sequential = ns.sequential
@@ -304,6 +404,13 @@ def main():
               f"levels={DEGRADATION_LEVELS}", file=sys.stderr)
         _degradation_sweep(chunks, n, M, HOPS, sequential, out_path,
                            mode=mode)
+        return
+    if ns.telemetry:
+        if out_path == "CURVES_r05.json":    # the core-mode default
+            out_path = "TELEMETRY_r08.json"
+        print(f"telemetry sweep: K={K} chunk={B} mode={mode}",
+              file=sys.stderr)
+        _telemetry_sweep(chunks, n, M, sequential, out_path, mode=mode)
         return
     print(f"sim sweep: K={K} chunk={B} mode={mode}", file=sys.stderr)
 

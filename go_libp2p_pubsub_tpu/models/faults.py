@@ -61,6 +61,7 @@ as its other refusals).  XLA path only.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import ClassVar
 
 import jax.numpy as jnp
 import numpy as np
@@ -113,6 +114,31 @@ class FaultSchedule:
     partition_group: object = None
     partition_windows: tuple = ()
     seed: int = 0
+
+    # Machine-readable thread-or-refuse contract (verified by
+    # tools/graftlint/contracts.py).  Fault data is "threaded" on the
+    # three circulant XLA paths (compiled into FaultParams device
+    # arrays, proven by build/jaxpr diff under a probe schedule) and
+    # "refused" on the pallas kernel / gather / dense paths (the
+    # builders raise, proven by reject probes).  n_peers/horizon are
+    # host-side validation bounds ("build-time", proven by reject
+    # probes naming the bad field).
+    PATHS: ClassVar[tuple[str, ...]] = (
+        "gossip-xla", "gossip-kernel", "flood-circulant",
+        "flood-gather", "randomsub-circulant", "randomsub-dense")
+    _THREADED: ClassVar[dict[str, str]] = {
+        "gossip-xla": "threaded", "flood-circulant": "threaded",
+        "randomsub-circulant": "threaded", "gossip-kernel": "refused",
+        "flood-gather": "refused", "randomsub-dense": "refused"}
+    CONTRACT: ClassVar[dict[str, object]] = {
+        "n_peers": "build-time",
+        "horizon": "build-time",
+        "down_intervals": _THREADED,
+        "drop_prob": _THREADED,
+        "partition_group": _THREADED,
+        "partition_windows": _THREADED,
+        "seed": _THREADED,
+    }
 
     def __post_init__(self):
         if self.n_peers < 1:

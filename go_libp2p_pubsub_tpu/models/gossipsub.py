@@ -93,6 +93,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
+from typing import ClassVar
 
 import jax
 import jax.numpy as jnp
@@ -188,6 +189,39 @@ class GossipSimConfig:
     # step, the single largest always-on cost after the payload rolls.
     # False restores exact-k (validation/equivalence studies).
     binomial_gossip_sampling: bool = True
+
+    # Machine-readable thread-or-refuse contract, verified by
+    # tools/graftlint/contracts.py: every field must be provably
+    # "threaded" (reaches the compiled step — as a baked constant or
+    # through built device arrays — on EVERY path in PATHS, proven by
+    # jaxpr/build diff under a probe value) or "build-time" (host-side
+    # validation only, proven by a reject probe that raises).  A new
+    # config field without a contract entry (or an entry without a
+    # probe) fails `python -m tools.graftlint`.
+    PATHS: ClassVar[tuple[str, ...]] = ("xla", "kernel")
+    CONTRACT: ClassVar[dict[str, object]] = {
+        "offsets": "threaded",
+        "n_topics": "threaded",
+        "px_rotation": "threaded",
+        "paired_topics": "threaded",
+        "d": "threaded",
+        "d_lo": "threaded",
+        "d_hi": "threaded",
+        "d_score": "threaded",
+        "d_out": "threaded",
+        "d_lazy": "threaded",
+        "gossip_factor": "threaded",
+        "history_gossip": "threaded",
+        "history_length": "threaded",
+        "backoff_ticks": "threaded",
+        "fanout_ttl_ticks": "threaded",
+        "gossip_retransmission": "threaded",
+        # statically-enforced IHAVE invariants: build-time rejection in
+        # make_gossip_sim / __post_init__, never run-time truncation
+        "max_ihave_length": "build-time",
+        "max_ihave_messages": "build-time",
+        "binomial_gossip_sampling": "threaded",
+    }
 
     def __post_init__(self):
         offs = np.asarray(self.offsets, dtype=np.int64)

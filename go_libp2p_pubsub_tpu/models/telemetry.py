@@ -36,6 +36,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
+from typing import ClassVar
 
 import jax
 import jax.numpy as jnp
@@ -84,6 +85,55 @@ class TelemetryConfig:
     msg_id_bytes: int = 8
     peer_id_bytes: int = 8
     topic_bytes: int = 8
+
+    # Machine-readable thread-or-refuse contract (verified by
+    # tools/graftlint/contracts.py).  Per execution path each field is
+    # "threaded" (changes the compiled step, proven by jaxpr diff),
+    # "inert" (documented no-op on that path's frame subset, proven by
+    # jaxpr EQUALITY), or "refused" (the path rejects telemetry
+    # configs outright — by raising, or by not exposing a telemetry
+    # parameter at all).  The refuse-telemetry contract of the pallas
+    # kernel / gather / dense paths is thereby machine-checked.
+    PATHS: ClassVar[tuple[str, ...]] = (
+        "gossip-xla", "gossip-kernel", "flood-circulant",
+        "flood-gather", "randomsub-circulant", "randomsub-dense")
+    _REFUSING: ClassVar[dict[str, str]] = {
+        "gossip-kernel": "refused", "flood-gather": "refused",
+        "randomsub-dense": "refused"}
+    CONTRACT: ClassVar[dict[str, object]] = {
+        "counters": {"gossip-xla": "threaded",
+                     "flood-circulant": "threaded",
+                     "randomsub-circulant": "threaded", **_REFUSING},
+        "wire": {"gossip-xla": "threaded",
+                 "flood-circulant": "threaded",
+                 "randomsub-circulant": "threaded", **_REFUSING},
+        "mesh": {"gossip-xla": "threaded",
+                 "flood-circulant": "inert",
+                 "randomsub-circulant": "inert", **_REFUSING},
+        "scores": {"gossip-xla": "threaded",
+                   "flood-circulant": "inert",
+                   "randomsub-circulant": "inert", **_REFUSING},
+        "faults": {"gossip-xla": "threaded",
+                   "flood-circulant": "threaded",
+                   "randomsub-circulant": "threaded", **_REFUSING},
+        "payload_data_bytes": {"gossip-xla": "threaded",
+                               "flood-circulant": "threaded",
+                               "randomsub-circulant": "threaded",
+                               **_REFUSING},
+        # ihave/iwant per-id framing: gossip-only; the flood/randomsub
+        # frame subsets bake only the payload frame size
+        "msg_id_bytes": {"gossip-xla": "threaded",
+                         "flood-circulant": "inert",
+                         "randomsub-circulant": "inert", **_REFUSING},
+        "peer_id_bytes": {"gossip-xla": "threaded",
+                          "flood-circulant": "threaded",
+                          "randomsub-circulant": "threaded",
+                          **_REFUSING},
+        "topic_bytes": {"gossip-xla": "threaded",
+                        "flood-circulant": "threaded",
+                        "randomsub-circulant": "threaded",
+                        **_REFUSING},
+    }
 
     def __post_init__(self):
         if self.wire and not self.counters:

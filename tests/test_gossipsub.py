@@ -147,8 +147,11 @@ async def test_mesh_degree_bounds():
         return True
 
     # Heartbeats fire late under suite load; poll for convergence instead
-    # of a fixed sleep.
-    await settle_until(converged, timeout=8.0)
+    # of a fixed sleep.  20-host meshes have been observed to need >8s
+    # of wall clock on a loaded machine (the poll returns as soon as
+    # the meshes settle, so the generous ceiling costs nothing when
+    # the box is idle).
+    await settle_until(converged, timeout=30.0)
     for ps in psubs:
         mesh = ps.router.mesh.get("mesh-topic", set())
         assert len(mesh) >= ps.router.params.d_lo

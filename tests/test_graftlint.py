@@ -1,0 +1,341 @@
+"""graftlint (tools/graftlint): the AST pass runs clean on the tree
+and flags every seeded fixture violation; pragmas suppress per line;
+the abstract-eval audit covers the full declared config matrix without
+compiling (= without executing) a single sim program; the config
+contracts' refusal and build-time claims hold.
+
+The full threaded-probe contract sweep (~40 s of step traces) runs in
+``python -m tools.graftlint`` (measure_all step 0.5) and in the @slow
+test here; tier-1 keeps the fast invariants.
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tools.graftlint import RULES, check_file, run_paths
+from tools.graftlint import jaxpr_audit as ja
+from tools.graftlint.pragmas import pragma_lines, scope_override
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = REPO / "tools" / "graftlint" / "fixtures"
+
+
+# --------------------------------------------------------------------------
+# AST pass
+# --------------------------------------------------------------------------
+
+
+def test_tree_is_clean():
+    """The whole repo (fixtures excluded) has zero findings — the
+    tier-1 smoke that runs the AST pass on every file."""
+    findings = run_paths([REPO], root=REPO)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_fixture_corpus_seeds_every_rule():
+    """>= 1 seeded violation per rule, each named with file:line."""
+    findings = run_paths([FIXTURES], root=REPO, include_fixtures=True)
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+        assert f.line > 0 and f.path.endswith(".py")
+    missing = set(RULES) - set(by_rule)
+    assert not missing, f"rules with no seeded fixture: {missing}"
+
+
+def test_cli_nonzero_on_fixtures_naming_rule_and_line():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint",
+         str(FIXTURES / "bare_except.py")],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 1
+    assert "bare_except.py:9: graftlint[bare-except]" in out.stdout
+
+
+def test_pragmas_suppress_per_line():
+    """pragma_ok.py seeds the same violations as its twins but every
+    line carries a pragma — zero findings."""
+    assert check_file(FIXTURES / "pragma_ok.py", root=REPO) == []
+    # and the pragma really is per-LINE: the same violation without a
+    # pragma in the same file still fires
+    src = ('# graftlint: scope=tools\n'
+           'import sys\n'
+           'sys.path.insert(0, ".")  # graftlint: ignore[sys-path-insert]\n'
+           'sys.path.insert(0, "x")\n')
+    findings = check_file(Path("inline.py"), root=REPO, src=src)
+    assert [f.line for f in findings] == [4]
+    assert findings[0].rule == "sys-path-insert"
+
+
+def test_pragma_parsing_forms():
+    src = ("a()  # graftlint: ignore[rule-a]\n"
+           "b()  # graftlint: ignore[rule-a, rule-b]\n"
+           "c()  # graftlint: ignore\n")
+    p = pragma_lines(src)
+    assert p[1] == frozenset({"rule-a"})
+    assert p[2] == frozenset({"rule-a", "rule-b"})
+    assert p[3] is None
+
+
+def test_scope_directive_overrides_path():
+    assert scope_override("# graftlint: scope=model\nx = 1\n") == "model"
+    with pytest.raises(ValueError, match="unknown graftlint scope"):
+        scope_override("# graftlint: scope=bogus\n")
+    # a typo'd directive in a scanned file is a LOCATED finding, not a
+    # crash of the whole lint run
+    findings = check_file(Path("tools/x.py"), root=REPO,
+                          src="x = 1\n# graftlint: scope=modle\n")
+    assert [(f.rule, f.line) for f in findings] == [
+        ("scope-directive", 2)]
+    # nondeterminism is model-scoped: the same source flags under the
+    # directive and stays silent without it (tools scope)
+    bad = "import time\n\n\ndef f():\n    return time.time()\n"
+    silent = check_file(Path("tools/x.py"), root=REPO, src=bad)
+    assert silent == []
+    loud = check_file(Path("tools/x.py"), root=REPO,
+                      src="# graftlint: scope=model\n" + bad)
+    assert {f.rule for f in loud} == {"nondeterminism"}
+
+
+def test_except_rule_covers_evasive_forms():
+    """BaseException and tuple-hidden Exception are the same hazards
+    as their plain spellings — the rules must see through them."""
+    base = ("def f():\n    try:\n        pass\n"
+            "    except BaseException:\n        pass\n")
+    findings = check_file(Path("m.py"), root=REPO,
+                          src="# graftlint: scope=model\n" + base)
+    assert {f.rule for f in findings} == {"bare-except"}
+    tup = ("def f():\n    try:\n        pass\n"
+           "    except (Exception, ValueError):\n        pass\n")
+    findings = check_file(Path("tools/x.py"), root=REPO, src=tup)
+    assert {f.rule for f in findings} == {"broad-except"}
+
+
+def test_missing_donate_positions():
+    findings = check_file(FIXTURES / "missing_donate.py", root=REPO)
+    flagged = {f.line for f in findings
+               if f.rule == "missing-donate"}
+    assert flagged == {9, 14, 19}     # run_ok (donated) not flagged
+    # donate_argnames string form is verifiable too: naming 'state'
+    # passes, naming another arg is flagged
+    good = ("from functools import partial\nimport jax\n\n\n"
+            "@partial(jax.jit, donate_argnames=('state',))\n"
+            "def run(params, state):\n    return state\n")
+    assert check_file(Path("m.py"), root=REPO, src=good) == []
+    bad = good.replace("('state',)", "('params',)")
+    findings = check_file(Path("m.py"), root=REPO, src=bad)
+    assert {f.rule for f in findings} == {"missing-donate"}
+
+
+def test_pragma_in_docstring_not_honored():
+    """Only real comment tokens carry pragmas/directives — a file that
+    QUOTES one in a docstring keeps its path-derived scope and its
+    findings."""
+    src = ('"""Docs showing the syntax:\n\n'
+           '    # graftlint: scope=model\n'
+           '    x()  # graftlint: ignore[broad-except]\n'
+           '"""\n\n\n'
+           'def f():\n'
+           '    try:\n'
+           '        pass\n'
+           '    except Exception:\n'
+           '        pass\n')
+    assert scope_override(src) is None
+    findings = check_file(Path("tools/x.py"), root=REPO, src=src)
+    assert {f.rule for f in findings} == {"broad-except"}
+
+
+def test_only_graftlint_fixture_dir_is_exempt(tmp_path):
+    """A directory merely NAMED fixtures elsewhere stays under the
+    tree-clean gate."""
+    from tools.graftlint.astpass import iter_target_files
+
+    other = tmp_path / "tests" / "fixtures"
+    other.mkdir(parents=True)
+    (other / "f.py").write_text("x = 1\n")
+    corpus = tmp_path / "tools" / "graftlint" / "fixtures"
+    corpus.mkdir(parents=True)
+    (corpus / "seeded.py").write_text("x = 1\n")
+    scanned = {p.relative_to(tmp_path).as_posix()
+               for p in iter_target_files(tmp_path)}
+    assert "tests/fixtures/f.py" in scanned
+    assert "tools/graftlint/fixtures/seeded.py" not in scanned
+
+
+# --------------------------------------------------------------------------
+# Abstract-eval audit: full declared matrix, zero execution
+# --------------------------------------------------------------------------
+
+
+def test_declared_matrix_shape():
+    combos = ja.declared_matrix()
+    assert len(combos) == 32
+    # all three sims x telemetry x faults x batched; split axis only
+    # on gossipsub
+    key = lambda c: (c["sim"], c["split"], c["telemetry"],  # noqa: E731
+                     c["faults"], c["batched"])
+    assert len({key(c) for c in combos}) == 32
+    for sim, n in (("gossipsub", 16), ("floodsub", 8),
+                   ("randomsub", 8)):
+        assert sum(c["sim"] == sim for c in combos) == n
+    axes = {ax: {c[ax] for c in combos}
+            for ax in ("telemetry", "faults", "batched")}
+    assert all(v == {False, True} for v in axes.values())
+
+
+def test_audit_covers_matrix_without_compiling_a_sim():
+    """The audit traces/lowers every declared combo and passes — under
+    a backend-compile guard (the dispatch-count trace guard): building
+    the tiny sims may compile trivial array ops, but the audit phase
+    itself must never reach the compiler, which is what 'asserted
+    without executing a sim tick' means mechanically."""
+    import jax._src.compiler as _compiler
+
+    cases = ja.build_cases()           # builds arrays; may compile
+    declared = {(c["sim"], c["split"], c["telemetry"], c["faults"],
+                 c["batched"]) for c in ja.declared_matrix()}
+    built = {(c.sim, c.split, c.telemetry, c.faults, c.batched)
+             for c in cases}
+    assert built == declared
+
+    compiled = []
+    orig = _compiler.backend_compile
+
+    def guard(*args, **kw):
+        compiled.append(args)
+        return orig(*args, **kw)
+
+    _compiler.backend_compile = guard
+    try:
+        problems = ja.run_audit(cases)
+    finally:
+        _compiler.backend_compile = orig
+    assert problems == [], "\n".join(problems)
+    assert compiled == [], (
+        f"audit phase reached the compiler {len(compiled)} time(s) — "
+        "it must trace/lower only")
+
+
+def test_audit_catches_a_seeded_64bit_widening():
+    """The checks are live, not vacuous: a case whose trace contains a
+    float64 convert / aval must fail the audit."""
+    import jax
+    import jax.numpy as jnp
+
+    def bad_runner(params, state, n_ticks, step):
+        return state.astype(jnp.float64)
+
+    case = ja.AuditCase(
+        sim="gossipsub", split=False, telemetry=False, faults=False,
+        batched=False)
+    with jax.experimental.enable_x64():
+        closed = jax.make_jaxpr(bad_runner, static_argnums=(2, 3))(
+            jnp.zeros(4, jnp.float32), jnp.zeros(4, jnp.float32), 1,
+            None)
+    case.trace = lambda: closed
+    case.lower = lambda: ""
+    case.n_carry_leaves = 0
+    problems = ja.audit_case(case)
+    assert any("no-64bit" in p for p in problems)
+    assert any("no-widening-convert" in p for p in problems)
+
+
+def test_audit_catches_a_seeded_callback_and_missing_donation():
+    import jax
+    import jax.numpy as jnp
+
+    def cb_runner(params, state, n_ticks, step):
+        jax.debug.callback(lambda: None)
+        return state
+
+    case = ja.AuditCase(
+        sim="floodsub", split=False, telemetry=False, faults=False,
+        batched=False)
+    case.trace = lambda: jax.make_jaxpr(
+        cb_runner, static_argnums=(2, 3))(
+            jnp.zeros(4), jnp.zeros(4), 1, None)
+    case.lower = lambda: "module { }"      # zero aliased buffers
+    case.n_carry_leaves = 3
+    problems = ja.audit_case(case)
+    assert any("no-host-callback" in p for p in problems)
+    assert any("donation" in p for p in problems)
+
+
+# --------------------------------------------------------------------------
+# Config contracts
+# --------------------------------------------------------------------------
+
+
+def test_contract_declarations_complete():
+    """Every field of the three contracted configs is declared, for
+    every declared path — no probes run (fast completeness gate)."""
+    import dataclasses
+    from go_libp2p_pubsub_tpu.models.faults import FaultSchedule
+    from go_libp2p_pubsub_tpu.models.gossipsub import GossipSimConfig
+    from go_libp2p_pubsub_tpu.models.telemetry import TelemetryConfig
+
+    for cls in (GossipSimConfig, TelemetryConfig, FaultSchedule):
+        fields = {f.name for f in dataclasses.fields(cls)}
+        assert set(cls.CONTRACT) == fields, cls.__name__
+        for fld, spec in cls.CONTRACT.items():
+            per_path = (dict.fromkeys(cls.PATHS, spec)
+                        if isinstance(spec, str) else spec)
+            assert set(per_path) == set(cls.PATHS), (cls.__name__, fld)
+
+
+def test_contract_refusals_and_build_time_hold():
+    """The refuse-telemetry / refuse-faults contracts of the pallas
+    kernel, gather, and dense paths — and the build-time reject
+    claims — verified directly (the fast, no-trace subset)."""
+    from tools.graftlint import contracts as ct
+
+    for key, (probe, match) in ct._REFUSALS.items():
+        assert ct._expect_raise(probe, match, label=str(key)) == [], key
+    for key, (probe, match) in ct._BUILD_TIME.items():
+        assert ct._expect_raise(probe, match, label=str(key)) == [], key
+    # and the match is load-bearing: the right exception with the
+    # WRONG message does not vacuously prove a refusal
+    def wrong_reason():
+        raise ValueError("some incidental validation error")
+    assert ct._expect_raise(wrong_reason, r"refuses fault configs",
+                            label="x") != []
+
+
+def test_contract_fault_threading_fast():
+    """FaultSchedule data fields provably reach the device params on
+    all three circulant paths (value-diff probes, no tracing)."""
+    from tools.graftlint import contracts as ct
+
+    for field in ("down_intervals", "drop_prob", "partition_group",
+                  "partition_windows", "seed"):
+        for path in ("gossip-xla", "flood-circulant",
+                     "randomsub-circulant"):
+            assert ct._fault_threaded(field, path), (field, path)
+
+
+def test_contract_detects_an_undeclared_field(monkeypatch):
+    """Adding a config field without a contract entry is a finding —
+    the ratchet the checker exists for."""
+    from go_libp2p_pubsub_tpu.models.faults import FaultSchedule
+    from tools.graftlint import contracts as ct
+
+    pruned = {k: v for k, v in FaultSchedule.CONTRACT.items()
+              if k != "seed"}
+    monkeypatch.setattr(FaultSchedule, "CONTRACT", pruned)
+    monkeypatch.setattr(
+        ct, "_contracted_classes", lambda: (FaultSchedule,))
+    problems = ct.check_contracts()
+    assert any("FaultSchedule.seed has no thread-or-refuse" in p
+               for p in problems)
+
+
+@pytest.mark.slow
+def test_full_contract_sweep():
+    """The complete threaded/inert probe matrix (what the CLI runs)."""
+    from tools.graftlint.contracts import check_contracts
+
+    problems = check_contracts()
+    assert problems == [], "\n".join(problems)

@@ -1,0 +1,6 @@
+"""Measurement / profiling / validation tools.
+
+Most entries are standalone scripts (see README.md in this directory);
+``tools.graftlint`` is the importable static-analysis package
+(``python -m tools.graftlint``).
+"""

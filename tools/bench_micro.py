@@ -15,7 +15,7 @@ import time
 
 import numpy as np
 
-sys.path.insert(0, ".")
+sys.path.insert(0, ".")  # graftlint: ignore[sys-path-insert]
 
 
 def main():

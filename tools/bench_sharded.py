@@ -31,7 +31,7 @@ import time
 
 import numpy as np
 
-sys.path.insert(0, ".")
+sys.path.insert(0, ".")  # graftlint: ignore[sys-path-insert]
 
 
 def build(n, t=100, m=32, seed=0, pad_block=None):

@@ -13,8 +13,8 @@ import re
 import sys
 from collections import Counter
 
-sys.path.insert(0, ".")
-sys.path.insert(0, "tools")
+sys.path.insert(0, ".")  # graftlint: ignore[sys-path-insert]
+sys.path.insert(0, "tools")  # graftlint: ignore[sys-path-insert]
 
 from bench_kernel import build  # noqa: E402
 

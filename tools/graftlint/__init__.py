@@ -1,0 +1,44 @@
+"""graftlint: repo-specific static analysis for the TPU pubsub codebase.
+
+Three passes, runnable standalone (``python -m tools.graftlint``) and
+wired into the measurement preflight (tools/measure_all.sh step 0.5):
+
+- **AST pass** (``astpass``, stdlib-only): JAX-shaped defect patterns
+  that generic linters miss — Python branching on traced values inside
+  step/scan bodies, ``np.*`` calls in traced code, jit-wrapped runners
+  whose ``state`` carry is not donated, banned nondeterminism in model
+  code, bare/broad excepts and ``sys.path`` mutation in tools.
+- **Abstract-eval audit** (``jaxpr_audit``): traces every simulator
+  runner over a declared config matrix (3 simulators x telemetry x
+  faults x batched x XLA combined/split) with ``jax.make_jaxpr`` /
+  ``.lower`` — never executing a sim tick — and asserts no 64-bit
+  widening, no host callbacks, donation actually applied to the carry,
+  and captured-constant size under budget.
+- **Config-contract checker** (``contracts``): every field of
+  GossipSimConfig / FaultSchedule / TelemetryConfig must be provably
+  threaded into each execution path, explicitly refused there, or
+  build-time-validated — driven by the machine-readable ``CONTRACT``
+  declarations on the config dataclasses themselves.
+
+Per-line suppressions: ``# graftlint: ignore[rule]`` (see ``pragmas``).
+Rule catalog and how to extend it: tools/README.md.
+"""
+
+from .astpass import (  # noqa: F401
+    Finding,
+    RULES,
+    check_file,
+    iter_target_files,
+    run_paths,
+)
+from .pragmas import pragma_lines, scope_override  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "check_file",
+    "iter_target_files",
+    "run_paths",
+    "pragma_lines",
+    "scope_override",
+]

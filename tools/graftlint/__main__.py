@@ -1,0 +1,89 @@
+"""CLI: ``python -m tools.graftlint [paths...] [options]``.
+
+Default (no paths): the full suite over the repo — AST pass on every
+.py file (fixtures excluded), then the abstract-eval audit over the
+declared config matrix, then the config-contract checker.  Exit 0 =
+clean; exit 1 = findings, each printed as ``path:line: graftlint[rule]
+message`` (AST) or a named audit/contract problem.
+
+With explicit paths, only the AST pass runs, on those paths (fixtures
+included — that is how the seeded-violation corpus self-tests).
+
+Options: ``--ast-only`` (skip the jax-importing passes — the fast
+preflight subset), ``--no-audit``, ``--no-contracts``,
+``--list-rules``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from .astpass import RULES, run_paths
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint", description=__doc__)
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs for the AST pass (default: repo "
+                         "root; explicit paths skip the jaxpr passes)")
+    ap.add_argument("--ast-only", action="store_true",
+                    help="AST pass only (no jax import)")
+    ap.add_argument("--no-audit", action="store_true")
+    ap.add_argument("--no-contracts", action="store_true")
+    ap.add_argument("--list-rules", action="store_true")
+    ns = ap.parse_args(argv)
+
+    if ns.list_rules:
+        for name, (scopes, desc) in RULES.items():
+            where = ", ".join(scopes) if scopes else "any"
+            print(f"{name:18s} [{where}] {desc}")
+        return 0
+
+    # the repo root is the directory that contains this package's
+    # parent (tools/) — robust to being run from anywhere
+    root = Path(__file__).resolve().parents[2]
+    explicit = bool(ns.paths)
+    paths = ns.paths or [root]
+    findings = run_paths(paths, root=root, include_fixtures=explicit)
+    for f in findings:
+        print(f)
+    n_problems = len(findings)
+
+    if not explicit and not ns.ast_only:
+        # running as `python -m tools.graftlint` implies the repo root
+        # is already importable, so go_libp2p_pubsub_tpu resolves too.
+        # Force the CPU backend (as tools/validate_curves.py does): the
+        # trace/lower passes must run even when the TPU relay is down —
+        # a static preflight must never be a second TPU client.
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        if not ns.no_audit:
+            from .jaxpr_audit import run_audit
+            print("graftlint: abstract-eval audit over the declared "
+                  "config matrix ...", file=sys.stderr)
+            audit = run_audit(log=lambda s: print(s, file=sys.stderr))
+            for p in audit:
+                print(p)
+            n_problems += len(audit)
+        if not ns.no_contracts:
+            from .contracts import check_contracts
+            print("graftlint: config-contract checks ...",
+                  file=sys.stderr)
+            contracts = check_contracts(
+                log=lambda s: print(s, file=sys.stderr))
+            for p in contracts:
+                print(p)
+            n_problems += len(contracts)
+
+    if n_problems:
+        print(f"graftlint: {n_problems} finding(s)", file=sys.stderr)
+        return 1
+    print("graftlint: clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

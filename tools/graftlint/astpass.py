@@ -1,0 +1,445 @@
+"""The stdlib-``ast`` pass: JAX-shaped defect patterns by rule.
+
+Rule catalog (scopes: model = go_libp2p_pubsub_tpu/{models,ops},
+tools = tools/, any = every scanned file; see tools/README.md for the
+full rationale and how to add a rule):
+
+- ``traced-branch`` (any): a Python ``if``/``while``/``assert``/
+  conditional expression whose test contains a ``jnp.``/``jax.``/
+  ``lax.`` expression, inside a traced function.  Python control flow
+  on traced values either fails at trace time (ConcretizationTypeError,
+  the lucky case) or silently bakes one branch into the compiled step.
+  Use ``jnp.where``/``lax.cond``.
+- ``np-in-traced`` (any): a ``np.*``/``numpy.*`` call inside a traced
+  function.  NumPy ops concretize tracers or run host-side at trace
+  time; inside a scanned step that is either a trace error or a silent
+  constant.  Use ``jnp``, or hoist the host computation to build time.
+  (``np.float32``-style attribute *references* — dtypes — are fine.)
+- ``missing-donate`` (any): a jit-decorated function with a parameter
+  named ``state`` (the scan carry convention of every runner in this
+  repo) whose ``donate_argnums`` does not cover it.  At 1M peers an
+  undonated carry holds two GB-scale copies live (see gossip_run).
+- ``nondeterminism`` (model): ``time``/``random`` imported or called in
+  model code.  Sim trajectories must be a function of explicit seeds;
+  wall-clock or global-RNG state in models silently breaks replica
+  batching and bit-identity pins.
+- ``bare-except`` (model, tools): ``except:`` swallows KeyboardInterrupt
+  / SystemExit and hides the relay-death failure modes the tools are
+  built to surface.  Name the exception class.
+- ``broad-except`` (tools): ``except Exception`` in tools — legitimate
+  only for the documented batched->sequential fallbacks; every use
+  carries a per-line pragma so suppressions stay auditable.
+- ``sys-path-insert`` (tools): module-level ``sys.path`` mutation.
+  Grandfathered in the script-style tools (pragma'd); new tools should
+  run as modules (``python -m tools.x``) instead.
+
+A function is *traced* when (a) it is decorated with ``jax.jit`` /
+``partial(jax.jit, ...)``, (b) its name is passed to ``lax.scan`` /
+``while_loop`` / ``fori_loop`` / ``cond`` / ``switch`` / ``vmap`` /
+``pallas_call`` in the same module, (c) it is a conventional step body
+(``step``/``body``/``core``/``kernel``-named) nested inside a
+``make_*`` factory, or (d) it is nested inside a traced function.
+Static detection under-approximates real tracing (a function passed
+through a variable is invisible); the fixture corpus pins exactly what
+the pass promises to catch.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from pathlib import Path
+
+from .pragmas import pragma_lines, scope_override, suppressed
+
+#: rule name -> (scopes it applies in, or None = any scope; summary)
+RULES: dict[str, tuple[tuple[str, ...] | None, str]] = {
+    "traced-branch": (
+        None, "Python branch on a traced (jnp/jax) expression inside a "
+              "traced function"),
+    "np-in-traced": (
+        None, "np.* call inside a traced function"),
+    "missing-donate": (
+        None, "jit-wrapped runner's 'state' carry not in donate_argnums"),
+    "nondeterminism": (
+        ("model",), "time/random (wall clock, global RNG) in model code"),
+    "bare-except": (
+        ("model", "tools"), "bare 'except:'"),
+    "broad-except": (
+        ("tools",), "'except Exception' in tools"),
+    "sys-path-insert": (
+        ("tools",), "module-level sys.path mutation in tools"),
+}
+
+EXCLUDE_DIRS = {"__pycache__", ".git"}
+
+#: nested-function names conventionally traced inside make_* factories
+_STEP_NAMES = {"step", "body", "core", "telemetry_core", "kernel",
+               "vstep"}
+#: call targets whose function-valued arguments are traced
+_TRACING_CALLS = {"scan", "while_loop", "fori_loop", "cond", "switch",
+                  "vmap", "pmap", "pallas_call", "checkpoint", "remat"}
+_JAX_ROOTS = {"jnp", "jax", "lax", "pl", "pltpu"}
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: graftlint[{self.rule}] " \
+               f"{self.message}"
+
+
+def classify_scope(path: Path, root: Path) -> str:
+    """Scope from on-disk location (fixtures override via directive)."""
+    try:
+        parts = path.resolve().relative_to(root.resolve()).parts
+    except ValueError:
+        parts = path.parts
+    if "models" in parts or "ops" in parts:
+        return "model"
+    if "core" in parts:
+        return "core"
+    if parts and parts[0] == "tools":
+        return "tools"
+    if "tests" in parts:
+        return "tests"
+    return "other"
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """Does this decorator expression wrap jax.jit?"""
+    d = _dotted(node)
+    if d in ("jax.jit", "jit"):
+        return True
+    if isinstance(node, ast.Call):
+        f = _dotted(node.func)
+        if f in ("jax.jit", "jit"):
+            return True
+        if f in ("partial", "functools.partial"):
+            return any(_dotted(a) in ("jax.jit", "jit")
+                       for a in node.args)
+    return False
+
+
+def _jit_decorator(fn: ast.FunctionDef) -> ast.expr | None:
+    for dec in fn.decorator_list:
+        if _is_jit_expr(dec):
+            return dec
+    return None
+
+
+def _donated_argnums(dec: ast.expr) -> tuple | None:
+    """Literal donate_argnums/donate_argnames of a jit decorator, as a
+    mixed tuple of ints (argnums) and strs (argnames); () when absent,
+    None when present but not a literal (unverifiable -> skip)."""
+    if not isinstance(dec, ast.Call):
+        return ()
+    out = []
+    found = False
+    for kw in dec.keywords:
+        if kw.arg not in ("donate_argnums", "donate_argnames"):
+            continue
+        found = True
+        v = kw.value
+        elts = (v.elts if isinstance(v, (ast.Tuple, ast.List))
+                else [v])
+        for elt in elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, (int, str))):
+                return None
+            out.append(elt.value)
+    return tuple(out) if found else ()
+
+
+def _contains_jax_expr(node: ast.AST) -> ast.AST | None:
+    """A jnp./jax./lax.-rooted subexpression inside ``node`` (the
+    traced-value heuristic for branch tests), or None."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute):
+            d = _dotted(sub)
+            if d is not None and d.split(".")[0] in _JAX_ROOTS:
+                return sub
+    return None
+
+
+class _FileChecker:
+    def __init__(self, path: Path, src: str, tree: ast.Module,
+                 scope: str):
+        self.path = path
+        self.src = src
+        self.tree = tree
+        self.scope = scope
+        self.pragmas = pragma_lines(src)
+        self.findings: list[Finding] = []
+        self.traced: set[ast.AST] = set()
+        self._parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+
+    # -- plumbing ---------------------------------------------------------
+
+    def _emit(self, rule: str, node: ast.AST, message: str):
+        scopes = RULES[rule][0]
+        if scopes is not None and self.scope not in scopes:
+            return
+        line = getattr(node, "lineno", 0)
+        if suppressed(self.pragmas, line, rule):
+            return
+        self.findings.append(
+            Finding(str(self.path), line, rule, message))
+
+    def _enclosing_functions(self, node: ast.AST):
+        cur = self._parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield cur
+            cur = self._parents.get(cur)
+
+    # -- traced-function discovery ---------------------------------------
+
+    def _collect_traced(self):
+        by_name: dict[str, list[ast.AST]] = {}
+        funcs = [n for n in ast.walk(self.tree)
+                 if isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))]
+        for fn in funcs:
+            by_name.setdefault(fn.name, []).append(fn)
+            # (a) jit-decorated
+            if _jit_decorator(fn) is not None:
+                self.traced.add(fn)
+            # (c) conventional step body inside a make_* factory
+            elif fn.name in _STEP_NAMES and any(
+                    f.name.startswith("make_")
+                    for f in self._enclosing_functions(fn)):
+                self.traced.add(fn)
+        # (b) passed by name to a tracing call
+        for call in ast.walk(self.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            d = _dotted(call.func)
+            if d is None or d.split(".")[-1] not in _TRACING_CALLS:
+                continue
+            for arg in list(call.args) + [k.value for k in call.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in by_name:
+                    self.traced.update(by_name[arg.id])
+        # (d) functions nested inside traced functions
+        changed = True
+        while changed:
+            changed = False
+            for fn in funcs:
+                if fn in self.traced:
+                    continue
+                if any(enc in self.traced
+                       for enc in self._enclosing_functions(fn)):
+                    self.traced.add(fn)
+                    changed = True
+
+    def _in_traced(self, node: ast.AST) -> ast.AST | None:
+        for enc in self._enclosing_functions(node):
+            if enc in self.traced:
+                return enc
+        return None
+
+    # -- the rules --------------------------------------------------------
+
+    def run(self) -> list[Finding]:
+        self._collect_traced()
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.If, ast.While, ast.Assert,
+                                 ast.IfExp)):
+                self._check_traced_branch(node)
+            elif isinstance(node, ast.Call):
+                self._check_np_call(node)
+                self._check_sys_path(node)
+                self._check_nondet_call(node)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                self._check_donation(node)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._check_nondet_import(node)
+            elif isinstance(node, ast.ExceptHandler):
+                self._check_except(node)
+        self.findings.sort(key=lambda f: (f.line, f.rule))
+        return self.findings
+
+    def _check_traced_branch(self, node):
+        fn = self._in_traced(node)
+        if fn is None:
+            return
+        test = node.test
+        hit = _contains_jax_expr(test)
+        if hit is None:
+            return
+        kind = {ast.If: "if", ast.While: "while", ast.Assert: "assert",
+                ast.IfExp: "conditional expression"}[type(node)]
+        self._emit(
+            "traced-branch", node,
+            f"Python {kind} on traced expression "
+            f"'{_dotted(hit) or 'jnp/jax value'}' inside traced "
+            f"function '{fn.name}' — use jnp.where / lax.cond")
+
+    def _check_np_call(self, node):
+        fn = self._in_traced(node)
+        if fn is None:
+            return
+        d = _dotted(node.func)
+        if d is None or d.split(".")[0] not in ("np", "numpy"):
+            return
+        self._emit(
+            "np-in-traced", node,
+            f"'{d}(...)' inside traced function '{fn.name}' — numpy "
+            "concretizes tracers / runs at trace time; use jnp or "
+            "hoist to build time")
+
+    def _check_donation(self, fn):
+        dec = _jit_decorator(fn)
+        if dec is None:
+            return
+        argnames = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        if "state" not in argnames:
+            return
+        idx = argnames.index("state")
+        donated = _donated_argnums(dec)
+        if donated is None:       # non-literal donate spec: unverifiable
+            return
+        if idx not in donated and "state" not in donated:
+            self._emit(
+                "missing-donate", fn,
+                f"jit-wrapped '{fn.name}' carries 'state' at arg {idx} "
+                f"but donate_argnums={donated or '()'} does not donate "
+                "it — an undonated carry keeps two full copies live")
+
+    def _check_nondet_import(self, node):
+        names = ([a.name for a in node.names]
+                 if isinstance(node, ast.Import)
+                 else [node.module or ""])
+        for name in names:
+            root = name.split(".")[0]
+            if root in ("time", "random"):
+                self._emit(
+                    "nondeterminism", node,
+                    f"import of '{root}' in model code — trajectories "
+                    "must be functions of explicit seeds")
+
+    def _check_nondet_call(self, node):
+        d = _dotted(node.func)
+        if d is None:
+            return
+        root = d.split(".")[0]
+        if root in ("time", "random") and "." in d:
+            self._emit(
+                "nondeterminism", node,
+                f"'{d}(...)' in model code — wall clock / global RNG "
+                "is banned in models")
+
+    def _check_except(self, node):
+        if node.type is None:
+            self._emit("bare-except", node,
+                       "bare 'except:' — name the exception class "
+                       "(swallows KeyboardInterrupt/SystemExit)")
+            return
+        # tuple handlers hide the same classes: except (Exception, X)
+        elts = (node.type.elts if isinstance(node.type, ast.Tuple)
+                else [node.type])
+        names = {_dotted(e) for e in elts}
+        if "BaseException" in names:
+            # semantically a bare except (same swallowed interrupts) —
+            # same rule, same scopes
+            self._emit("bare-except", node,
+                       "'except BaseException' — equivalent to a bare "
+                       "'except:' (swallows KeyboardInterrupt/"
+                       "SystemExit); name the failure class")
+        elif "Exception" in names:
+            self._emit(
+                "broad-except", node,
+                "'except Exception' in tools — catch the specific "
+                "failure, or pragma the documented fallback")
+
+    def _check_sys_path(self, node):
+        d = _dotted(node.func)
+        if d in ("sys.path.insert", "sys.path.append"):
+            self._emit(
+                "sys-path-insert", node,
+                "sys.path mutation — run new tools as modules "
+                "(python -m tools.x); existing script-style tools are "
+                "pragma-grandfathered")
+
+
+def check_file(path: Path, root: Path | None = None,
+               src: str | None = None) -> list[Finding]:
+    """All findings for one file (scope from path, or the file's
+    ``# graftlint: scope=...`` directive)."""
+    path = Path(path)
+    root = Path(root) if root is not None else Path(".")
+    if src is None:
+        src = path.read_text(encoding="utf-8",
+                             errors="surrogateescape")
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [Finding(str(path), e.lineno or 0, "syntax",
+                        f"unparseable file: {e.msg}")]
+    try:
+        scope = scope_override(src) or classify_scope(path, root)
+    except ValueError as e:
+        # a typo'd directive must be a located finding, not a crash
+        return [Finding(str(path), getattr(e, "lineno", 0),
+                        "scope-directive", str(e))]
+    return _FileChecker(path, src, tree, scope).run()
+
+
+def _is_seeded_fixture(path: Path) -> bool:
+    """ONLY graftlint's own corpus is exempt — a directory merely
+    NAMED fixtures elsewhere in the repo is ordinary code and stays
+    under the tree-clean gate."""
+    parts = path.parts
+    return ("fixtures" in parts
+            and parts[max(0, parts.index("fixtures") - 1)]
+            == "graftlint")
+
+
+def iter_target_files(root: Path, include_fixtures: bool = False):
+    """The .py files a default run scans (the seeded-violation corpus
+    excluded unless asked for — it exists to be dirty)."""
+    for path in sorted(Path(root).rglob("*.py")):
+        if any(part in EXCLUDE_DIRS for part in path.parts):
+            continue
+        if not include_fixtures and _is_seeded_fixture(path):
+            continue
+        yield path
+
+
+def run_paths(paths, root: Path | None = None,
+              include_fixtures: bool = False) -> list[Finding]:
+    """AST pass over files and/or directories.  ``include_fixtures``
+    scans the seeded-violation corpus too (self-test mode; default
+    runs exclude it — fixtures exist to be dirty)."""
+    root = Path(root) if root is not None else Path(".")
+    findings: list[Finding] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            for f in iter_target_files(p,
+                                       include_fixtures=include_fixtures):
+                findings.extend(check_file(f, root))
+        else:
+            findings.extend(check_file(p, root))
+    return findings

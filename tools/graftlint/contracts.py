@@ -1,0 +1,584 @@
+"""Config-contract checker: thread-or-refuse, machine-verified.
+
+The config dataclasses declare their own contracts (``CONTRACT`` /
+``PATHS`` class attributes on GossipSimConfig, TelemetryConfig,
+FaultSchedule); this module proves each claim:
+
+- ``threaded``  — the field reaches the compiled computation on that
+  path: under a registered probe value, the traced step's jaxpr text
+  OR the built (params, state) leaves must differ from the base build.
+- ``inert``     — documented no-op on that path (e.g. the mesh-degree
+  telemetry group on floodsub's frame subset): the jaxpr must be
+  IDENTICAL under the probe — an inert field that starts changing the
+  computation is a contract drift in the other direction.
+- ``refused``   — the path rejects the config outright: a registered
+  probe must raise ValueError (build- or trace-time, via
+  ``jax.eval_shape`` — never executing), or the path's entry point
+  must not expose the config parameter at all (API-absence refusal,
+  checked against ``inspect.signature``).
+- ``build-time`` — host-side validation only: a registered reject
+  probe (an invalid value) must raise ValueError at build.
+
+Every claim needs a registered probe; a contract entry without one —
+e.g. a freshly added config field — fails the check, which is the
+ratchet: you cannot add a knob that silently does nothing.
+
+All probes are build + trace only (``jax.make_jaxpr`` on single
+steps); no sim tick ever executes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+
+#: tiny probe-sim dimensions (distinct from jaxpr_audit's so the two
+#: passes never share a compiled-constant cache entry by accident)
+N, T, M, C = 80, 2, 6, 8
+KERNEL_BLOCK = 1024
+
+_VALID = ("threaded", "inert", "refused", "build-time")
+
+
+# --------------------------------------------------------------------------
+# Build helpers (lazy jax imports keep the AST-only path import-free)
+# --------------------------------------------------------------------------
+
+
+def _inputs(n_topics, paired=False):
+    import numpy as np
+    subs = np.zeros((N, n_topics), dtype=bool)
+    own = np.arange(N) % n_topics
+    subs[np.arange(N), own] = True
+    if paired:
+        subs[np.arange(N), (own + n_topics // 2) % n_topics] = True
+    rng = np.random.default_rng(0)
+    topic = rng.integers(0, n_topics, M)
+    origin = rng.integers(0, N // n_topics, M) * n_topics + topic
+    ticks = np.zeros(M, dtype=np.int32)
+    return subs, topic, origin, ticks
+
+
+def _fault_schedule(**kw):
+    import numpy as np
+    from go_libp2p_pubsub_tpu.models.faults import FaultSchedule
+    base = dict(n_peers=N, horizon=4,
+                down_intervals=((0, 0, 2), (3, 1, 3)),
+                drop_prob=0.1,
+                partition_group=(np.arange(N) % 2).astype(np.int32),
+                partition_windows=((1, 3),),
+                seed=0)
+    base.update(kw)
+    return FaultSchedule(**base)
+
+
+_ARTIFACT_CACHE: dict[tuple, tuple] = {}
+
+
+def _gossip_artifact(path, cfg_kw=None, *, n_topics=T, paired=False,
+                     px=7, attack=False):
+    """(jaxpr_text, build_leaves) of a scored gossip step on ``path``
+    ("xla" | "kernel") under config overrides.  ``attack`` switches to
+    the IWANT-spam adversarial config (some knobs — the
+    gossip-repair abuse bounds — only compile in under attack).
+    Memoized: every probe shares its base artifact."""
+    import jax
+    import numpy as np
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+
+    key = (path, n_topics, paired, px, attack,
+           tuple(sorted((cfg_kw or {}).items())))
+    if key in _ARTIFACT_CACHE:
+        return _ARTIFACT_CACHE[key]
+
+    kw = dict(n_topics=n_topics, d=3, d_lo=2, d_hi=6, d_score=2,
+              d_out=1, d_lazy=2, backoff_ticks=8, paired_topics=paired)
+    kw.update(cfg_kw or {})
+    offsets = kw.pop("offsets", None)
+    if offsets is None:
+        offsets = gs.make_gossip_offsets(
+            n_topics, C, N, seed=kw.pop("offsets_seed", 1),
+            paired=paired)
+    else:
+        kw.pop("offsets_seed", None)
+    cfg = gs.GossipSimConfig(offsets=offsets, **kw)
+    sc = gs.ScoreSimConfig(sybil_iwant_spam=attack)
+    subs, topic, origin, ticks = _inputs(n_topics, paired=paired)
+    sim_kw = dict(score_cfg=sc)
+    step_kw = {}
+    if attack:
+        sim_kw["sybil"] = (np.arange(N) % 5) == 0
+    if px is not None:
+        sim_kw["px_candidates"] = px
+    if path == "kernel":
+        sim_kw["pad_to_block"] = KERNEL_BLOCK
+        step_kw["receive_block"] = KERNEL_BLOCK
+    params, state = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                                       **sim_kw)
+    step = gs.make_gossip_step(cfg, sc, **step_kw)
+    out = (str(jax.make_jaxpr(step)(params, state)),
+           jax.tree_util.tree_leaves((params, state)))
+    _ARTIFACT_CACHE[key] = out
+    return out
+
+
+def _telemetry_artifact(path, tel_kw=None):
+    """jaxpr text of a telemetry-enabled step on one circulant path,
+    over a scored+faulted base sim (so every frame group is live)."""
+    import jax
+    import go_libp2p_pubsub_tpu.models.floodsub as fs
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    import go_libp2p_pubsub_tpu.models.randomsub as rs
+    import go_libp2p_pubsub_tpu.models.telemetry as tl
+    from go_libp2p_pubsub_tpu.ops.graph import make_circulant_offsets
+
+    key = ("tel", path, tuple(sorted((tel_kw or {}).items())))
+    if key in _ARTIFACT_CACHE:
+        return _ARTIFACT_CACHE[key]
+    tcfg = tl.TelemetryConfig(**(tel_kw or {}))
+    subs, topic, origin, ticks = _inputs(T)
+    sched = _fault_schedule()
+    if path == "gossip-xla":
+        cfg = gs.GossipSimConfig(
+            offsets=gs.make_gossip_offsets(T, C, N, seed=1),
+            n_topics=T, d=3, d_lo=2, d_hi=6, d_score=2, d_out=1,
+            d_lazy=2, backoff_ticks=8)
+        sc = gs.ScoreSimConfig()
+        params, state = gs.make_gossip_sim(
+            cfg, subs, topic, origin, ticks, score_cfg=sc,
+            fault_schedule=sched)
+        step = gs.make_gossip_step(cfg, sc, telemetry=tcfg)
+    elif path == "flood-circulant":
+        offs = tuple(int(o) for o in
+                     make_circulant_offsets(T, C, N, seed=1))
+        params, state = fs.make_flood_sim(
+            None, None, subs, None, topic, origin, ticks,
+            fault_schedule=sched, fault_offsets=offs)
+        step = fs.make_circulant_step_core(offs, telemetry=tcfg)
+    elif path == "randomsub-circulant":
+        rcfg = rs.RandomSubSimConfig(
+            offsets=rs.make_randomsub_offsets(T, C, N, seed=1),
+            n_topics=T, d=3)
+        params, state = rs.make_randomsub_sim(
+            rcfg, subs, topic, origin, ticks, fault_schedule=sched)
+        step = rs.make_randomsub_step(rcfg, telemetry=tcfg)
+    else:
+        raise ValueError(f"no telemetry probe path {path!r}")
+    out = str(jax.make_jaxpr(step)(params, state))
+    _ARTIFACT_CACHE[key] = out
+    return out
+
+
+def _faults_artifact(path, sched_kw=None):
+    """Build leaves of a faulted sim's params on one circulant path
+    (FaultParams ride the params, so value diffs prove threading
+    without a trace)."""
+    import jax
+    import numpy as np
+    import go_libp2p_pubsub_tpu.models.floodsub as fs
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    import go_libp2p_pubsub_tpu.models.randomsub as rs
+    from go_libp2p_pubsub_tpu.ops.graph import make_circulant_offsets
+
+    sched_kw = dict(sched_kw or {})
+    if sched_kw.get("partition_group") == "mod4":
+        sched_kw["partition_group"] = (np.arange(N) % 4).astype(np.int32)
+    sched = _fault_schedule(**sched_kw)
+    subs, topic, origin, ticks = _inputs(T)
+    if path == "gossip-xla":
+        cfg = gs.GossipSimConfig(
+            offsets=gs.make_gossip_offsets(T, C, N, seed=1),
+            n_topics=T, d=3, d_lo=2, d_hi=6, d_score=2, d_out=1)
+        params, _ = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                                       fault_schedule=sched)
+    elif path == "flood-circulant":
+        offs = tuple(int(o) for o in
+                     make_circulant_offsets(T, C, N, seed=1))
+        params, _ = fs.make_flood_sim(
+            None, None, subs, None, topic, origin, ticks,
+            fault_schedule=sched, fault_offsets=offs)
+    elif path == "randomsub-circulant":
+        rcfg = rs.RandomSubSimConfig(
+            offsets=rs.make_randomsub_offsets(T, C, N, seed=1),
+            n_topics=T, d=3)
+        params, _ = rs.make_randomsub_sim(rcfg, subs, topic, origin,
+                                          ticks, fault_schedule=sched)
+    else:
+        raise ValueError(f"no faults probe path {path!r}")
+    return jax.tree_util.tree_leaves(params)
+
+
+def _leaves_differ(a, b) -> bool:
+    import numpy as np
+    if len(a) != len(b):
+        return True
+    for x, y in zip(a, b):
+        x, y = np.asarray(x), np.asarray(y)
+        if x.shape != y.shape or x.dtype != y.dtype:
+            return True
+        if not np.array_equal(x, y):
+            return True
+    return False
+
+
+# --------------------------------------------------------------------------
+# The probe registry.  Keys: (class name, field) for threaded/inert and
+# build-time probes; (class name, path) for refusals.
+# --------------------------------------------------------------------------
+
+#: GossipSimConfig threaded probes: cfg overrides (plus specials) that
+#: must change the jaxpr or the build on BOTH declared paths
+_GOSSIP_PROBES = {
+    "offsets": dict(cfg_kw={"offsets_seed": 2}),
+    "n_topics": dict(n_topics=1),
+    "px_rotation": dict(cfg_kw={"px_rotation": False}),
+    "paired_topics": dict(paired=True, px=None),
+    "d": dict(cfg_kw={"d": 4}),
+    "d_lo": dict(cfg_kw={"d_lo": 3}),
+    "d_hi": dict(cfg_kw={"d_hi": 5}),
+    "d_score": dict(cfg_kw={"d_score": 3}),
+    "d_out": dict(cfg_kw={"d_out": 0}),
+    "d_lazy": dict(cfg_kw={"d_lazy": 3}),
+    "gossip_factor": dict(cfg_kw={"gossip_factor": 0.5}),
+    "history_gossip": dict(cfg_kw={"history_gossip": 2}),
+    "history_length": dict(cfg_kw={"history_length": 4}),
+    "backoff_ticks": dict(cfg_kw={"backoff_ticks": 9}),
+    "fanout_ttl_ticks": dict(cfg_kw={"fanout_ttl_ticks": 7}),
+    # the serve-budget cutoff only compiles in under the IWANT-spam
+    # attack config (honest edges provably stay under budget) — the
+    # probe must run the adversarial step
+    "gossip_retransmission": dict(attack=True,
+                                  cfg_kw={"gossip_retransmission": 4}),
+    "binomial_gossip_sampling": dict(
+        cfg_kw={"binomial_gossip_sampling": False}),
+}
+
+#: TelemetryConfig probes: (base TelemetryConfig kwargs, probe kwargs)
+_TEL_PROBES = {
+    "counters": (dict(counters=True, wire=False),
+                 dict(counters=False, wire=False)),
+    "wire": (dict(wire=True), dict(wire=False)),
+    "mesh": (dict(mesh=True), dict(mesh=False)),
+    "scores": (dict(scores=True), dict(scores=False)),
+    "faults": (dict(faults=True), dict(faults=False)),
+    "payload_data_bytes": (dict(), dict(payload_data_bytes=65)),
+    "msg_id_bytes": (dict(), dict(msg_id_bytes=9)),
+    "peer_id_bytes": (dict(), dict(peer_id_bytes=9)),
+    "topic_bytes": (dict(), dict(topic_bytes=9)),
+}
+
+#: FaultSchedule threaded probes: schedule overrides whose compiled
+#: FaultParams must differ in the built params
+_FAULT_PROBES = {
+    "down_intervals": dict(down_intervals=((0, 0, 3), (3, 1, 3))),
+    "drop_prob": dict(drop_prob=0.2),
+    "partition_group": dict(partition_group="mod4"),
+    "partition_windows": dict(partition_windows=((0, 2),)),
+    "seed": dict(seed=1),
+}
+
+
+def _gossip_threaded(field, path):
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    spec = dict(_GOSSIP_PROBES[field])
+    # base/probe must differ in ONLY the probed field: px/attack are
+    # shared overrides (both sides), and the n_topics / paired probes
+    # pin the base's offsets explicitly so the offset regeneration
+    # their new modulus would trigger cannot impersonate the probed
+    # field
+    base_kw = {k: spec[k] for k in ("px", "attack") if k in spec}
+    if field in ("n_topics", "paired_topics"):
+        shared = gs.make_gossip_offsets(T, C, N, seed=1)
+        base_kw["cfg_kw"] = {"offsets": shared}
+        spec["cfg_kw"] = {"offsets": shared, **spec.get("cfg_kw", {})}
+    base = _gossip_artifact(path, **base_kw)
+    probe = _gossip_artifact(path, **{**base_kw, **spec})
+    return base[0] != probe[0] or _leaves_differ(base[1], probe[1])
+
+
+def _tel_probe(field, path, want_inert):
+    base_kw, probe_kw = _TEL_PROBES[field]
+    base = _telemetry_artifact(path, base_kw)
+    probe = _telemetry_artifact(path, {**base_kw, **probe_kw})
+    differs = base != probe
+    return (not differs) if want_inert else differs
+
+
+def _fault_threaded(field, path):
+    base = _faults_artifact(path)
+    probe = _faults_artifact(path, _FAULT_PROBES[field])
+    return _leaves_differ(base, probe)
+
+
+# -- refusal probes (one per (class, path)) --------------------------------
+
+
+def _refuse_gossip_kernel_telemetry():
+    import jax
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    import go_libp2p_pubsub_tpu.models.telemetry as tl
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(T, C, N, seed=1), n_topics=T,
+        d=3, d_lo=2, d_hi=6, d_score=2, d_out=1)
+    subs, topic, origin, ticks = _inputs(T)
+    params, state = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                                       pad_to_block=KERNEL_BLOCK)
+    step = gs.make_gossip_step(cfg, receive_block=KERNEL_BLOCK,
+                               telemetry=tl.TelemetryConfig())
+    jax.eval_shape(step, params, state)    # must raise ValueError
+
+
+def _refuse_gossip_kernel_faults():
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(T, C, N, seed=1), n_topics=T,
+        d=3, d_lo=2, d_hi=6, d_score=2, d_out=1)
+    subs, topic, origin, ticks = _inputs(T)
+    gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                       fault_schedule=_fault_schedule(),
+                       pad_to_block=KERNEL_BLOCK)   # must raise
+
+
+def _refuse_flood_gather_faults():
+    import numpy as np
+    import go_libp2p_pubsub_tpu.models.floodsub as fs
+    subs, topic, origin, ticks = _inputs(T)
+    nbrs = np.stack([(np.arange(N) + 1) % N,
+                     (np.arange(N) - 1) % N], axis=1)
+    fs.make_flood_sim(nbrs, np.ones_like(nbrs, dtype=bool), subs, None,
+                      topic, origin, ticks,
+                      fault_schedule=_fault_schedule())   # must raise
+
+
+def _refuse_randomsub_dense_faults():
+    import go_libp2p_pubsub_tpu.models.randomsub as rs
+    rcfg = rs.RandomSubSimConfig(
+        offsets=rs.make_randomsub_offsets(T, C, N, seed=1),
+        n_topics=T, d=3)
+    subs, topic, origin, ticks = _inputs(T)
+    rs.make_randomsub_sim(rcfg, subs, topic, origin, ticks, dense=True,
+                          fault_schedule=_fault_schedule())  # must raise
+
+
+def _refuse_by_api(entry_point_name):
+    """API-absence refusal: the path's entry point must not expose a
+    ``telemetry`` parameter at all."""
+    def probe():
+        import go_libp2p_pubsub_tpu.models.floodsub as fs
+        import go_libp2p_pubsub_tpu.models.randomsub as rs
+        fn = {"flood_step": fs.flood_step,
+              "make_randomsub_dense_step":
+                  rs.make_randomsub_dense_step}[entry_point_name]
+        if "telemetry" in inspect.signature(fn).parameters:
+            return   # parameter exists -> NOT refused -> probe fails
+        raise ValueError(f"{entry_point_name} exposes no telemetry "
+                         "parameter (refused by API)")
+    return probe
+
+
+#: (probe, required-message regex): a refusal only counts when the
+#: raised ValueError is THE refusal, not an incidental one — an
+#: unrelated validation error must not vacuously satisfy the contract
+_REFUSALS = {
+    ("TelemetryConfig", "gossip-kernel"):
+        (_refuse_gossip_kernel_telemetry, r"telemetry is XLA-path only"),
+    ("TelemetryConfig", "flood-gather"):
+        (_refuse_by_api("flood_step"), r"refused by API"),
+    ("TelemetryConfig", "randomsub-dense"):
+        (_refuse_by_api("make_randomsub_dense_step"), r"refused by API"),
+    ("FaultSchedule", "gossip-kernel"):
+        (_refuse_gossip_kernel_faults, r"refuses fault configs"),
+    ("FaultSchedule", "flood-gather"):
+        (_refuse_flood_gather_faults, r"circulant topologies only"),
+    ("FaultSchedule", "randomsub-dense"):
+        (_refuse_randomsub_dense_faults, r"circulant step only"),
+}
+
+
+# -- build-time reject probes ----------------------------------------------
+
+
+def _reject_max_ihave_length():
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(T, C, N, seed=1), n_topics=T,
+        d=3, d_lo=2, d_hi=6, d_score=2, d_out=1, max_ihave_length=3)
+    subs, topic, origin, ticks = _inputs(T)   # M=6 ids > cap of 3
+    gs.make_gossip_sim(cfg, subs, topic, origin, ticks)   # must raise
+
+
+def _reject_max_ihave_messages():
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(T, C, N, seed=1), n_topics=T,
+        d=3, d_lo=2, d_hi=6, d_score=2, d_out=1,
+        max_ihave_messages=0)   # must raise
+
+
+def _reject_fault_n_peers():
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(T, C, N, seed=1), n_topics=T,
+        d=3, d_lo=2, d_hi=6, d_score=2, d_out=1)
+    subs, topic, origin, ticks = _inputs(T)
+    gs.make_gossip_sim(
+        cfg, subs, topic, origin, ticks,
+        fault_schedule=_fault_schedule(n_peers=N + 1,
+                                       partition_group=None,
+                                       partition_windows=(),
+                                       down_intervals=()))  # must raise
+
+
+def _reject_fault_horizon():
+    _fault_schedule(horizon=0)   # must raise
+
+
+_BUILD_TIME = {
+    ("GossipSimConfig", "max_ihave_length"):
+        (_reject_max_ihave_length, r"exceeds max_ihave_length"),
+    ("GossipSimConfig", "max_ihave_messages"):
+        (_reject_max_ihave_messages, r"IHAVE caps"),
+    ("FaultSchedule", "n_peers"):
+        (_reject_fault_n_peers, r"n_peers"),
+    ("FaultSchedule", "horizon"):
+        (_reject_fault_horizon, r"horizon must be >= 1"),
+}
+
+
+# --------------------------------------------------------------------------
+# The checker
+# --------------------------------------------------------------------------
+
+
+def _contracted_classes():
+    from go_libp2p_pubsub_tpu.models.faults import FaultSchedule
+    from go_libp2p_pubsub_tpu.models.gossipsub import GossipSimConfig
+    from go_libp2p_pubsub_tpu.models.telemetry import TelemetryConfig
+    return (GossipSimConfig, TelemetryConfig, FaultSchedule)
+
+
+def _threaded_prover(cls_name, field, path, status):
+    """The registered prover for one (class, field, path) claim, or
+    None when unregistered."""
+    if cls_name == "GossipSimConfig" and field in _GOSSIP_PROBES:
+        return lambda: _gossip_threaded(field, path)
+    if cls_name == "TelemetryConfig" and field in _TEL_PROBES:
+        return lambda: _tel_probe(field, path, status == "inert")
+    if cls_name == "FaultSchedule" and field in _FAULT_PROBES:
+        return lambda: _fault_threaded(field, path)
+    return None
+
+
+def check_contracts(log=None) -> list[str]:
+    """Verify every declared contract claim; returns problem strings
+    (empty = all contracts hold)."""
+    problems = []
+    for cls in _contracted_classes():
+        name = cls.__name__
+        fields = {f.name for f in dataclasses.fields(cls)}
+        contract = dict(cls.CONTRACT)
+        paths = tuple(cls.PATHS)
+
+        for miss in sorted(fields - set(contract)):
+            problems.append(
+                f"contract: {name}.{miss} has no thread-or-refuse "
+                "declaration (add it to CONTRACT)")
+        for extra in sorted(set(contract) - fields):
+            problems.append(
+                f"contract: {name}.{extra} declared but is not a "
+                "dataclass field")
+
+        refusal_checked: set[str] = set()
+        for fld in sorted(set(contract) & fields):
+            spec = contract[fld]
+            per_path = (dict.fromkeys(paths, spec)
+                        if isinstance(spec, str) else dict(spec))
+            for p in per_path:
+                if p not in paths and per_path[p] != "build-time":
+                    problems.append(
+                        f"contract: {name}.{fld} names unknown "
+                        f"path {p!r}")
+            for p in paths:
+                status = per_path.get(p)
+                if status is None:
+                    problems.append(
+                        f"contract: {name}.{fld} is silent about "
+                        f"path {p!r}")
+                    continue
+                if status not in _VALID:
+                    problems.append(
+                        f"contract: {name}.{fld} has unknown status "
+                        f"{status!r} on {p!r}")
+                    continue
+                label = f"{name}.{fld}[{p}]"
+                if status == "build-time":
+                    spec = _BUILD_TIME.get((name, fld))
+                    if spec is None:
+                        problems.append(
+                            f"contract: {label} claims build-time "
+                            "but no reject probe is registered")
+                        continue
+                    if (name, fld) in refusal_checked:
+                        continue
+                    refusal_checked.add((name, fld))
+                    problems.extend(_expect_raise(
+                        *spec, label=f"{label} build-time reject"))
+                elif status == "refused":
+                    if p in refusal_checked:
+                        continue
+                    refusal_checked.add(p)
+                    spec = _REFUSALS.get((name, p))
+                    if spec is None:
+                        problems.append(
+                            f"contract: {label} claims refused but "
+                            "no refusal probe is registered")
+                        continue
+                    problems.extend(_expect_raise(
+                        *spec, label=f"{name}[{p}] refusal"))
+                else:   # threaded / inert
+                    prover = _threaded_prover(name, fld, p, status)
+                    if prover is None:
+                        problems.append(
+                            f"contract: {label} claims {status} but "
+                            "no probe is registered")
+                        continue
+                    try:
+                        ok = prover()
+                    except Exception as e:  # graftlint: ignore[broad-except]
+                        # a broken probe of ANY kind is itself a finding
+                        problems.append(
+                            f"contract: {label} probe errored: "
+                            f"{type(e).__name__}: {e}")
+                        continue
+                    if not ok:
+                        problems.append(
+                            f"contract: {label} claims {status} but "
+                            "the probe " + (
+                                "changed the jaxpr (inert violated)"
+                                if status == "inert" else
+                                "changed neither jaxpr nor build "
+                                "(not threaded)"))
+        if log is not None:
+            log(f"  contract {name}: "
+                f"{len(fields)} fields x {len(paths)} paths checked")
+    return problems
+
+
+def _expect_raise(probe, match, label) -> list[str]:
+    import re
+    try:
+        probe()
+    except ValueError as e:
+        if re.search(match, str(e)):
+            return []
+        # a ValueError that is NOT the declared refusal message would
+        # let an unrelated validation error vacuously 'prove' the
+        # contract — require the message, pytest.raises(match=) style
+        return [f"contract: {label} raised ValueError({e!s}) which "
+                f"does not match the declared refusal {match!r}"]
+    except Exception as e:  # graftlint: ignore[broad-except]
+        # wrong exception class = the refusal is an accident, not a
+        # contract — report it rather than crash the checker
+        return [f"contract: {label} raised {type(e).__name__} "
+                f"instead of ValueError: {e}"]
+    return [f"contract: {label} did NOT raise (claim is false)"]

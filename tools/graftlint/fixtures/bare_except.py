@@ -1,0 +1,18 @@
+# graftlint: scope=tools
+"""graftlint fixture: seeded ``bare-except`` violation."""
+
+
+def load(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except:  # noqa: E722 — seeded bare except
+        return None
+
+
+def load_base(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except BaseException:                # seeded: bare-except-equivalent
+        return None

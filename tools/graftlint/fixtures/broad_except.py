@@ -1,0 +1,18 @@
+# graftlint: scope=tools
+"""graftlint fixture: seeded ``broad-except`` violation."""
+
+
+def load(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except Exception:                   # seeded: broad except in tools
+        return None
+
+
+def load_tuple(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except (Exception, ValueError):     # seeded: tuple-hidden broad
+        return None

@@ -1,0 +1,25 @@
+"""graftlint fixture: seeded ``missing-donate`` violations."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnums=(2,))
+def run(params, state, n_ticks):        # seeded: state at 1, no donate
+    return state
+
+
+@jax.jit
+def run_bare(state):                    # seeded: bare jit, no donate
+    return state
+
+
+@partial(jax.jit, static_argnums=(2,), donate_argnums=(0,))
+def run_wrong_arg(params, state, n_ticks):   # seeded: donates 0, not 1
+    return state
+
+
+@partial(jax.jit, static_argnums=(2,), donate_argnums=(1,))
+def run_ok(params, state, n_ticks):     # correctly donated: NOT flagged
+    return state

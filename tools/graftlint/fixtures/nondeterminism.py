@@ -1,0 +1,11 @@
+# graftlint: scope=model
+"""graftlint fixture: seeded ``nondeterminism`` violations (the scope
+directive above makes this file check as model code)."""
+
+import random                           # seeded: global RNG in a model
+import time                             # seeded: wall clock in a model
+
+
+def jitter_tick():
+    # seeded: two nondeterministic calls
+    return time.time() + random.random()

@@ -1,0 +1,20 @@
+"""graftlint fixture: seeded ``np-in-traced`` violations."""
+
+import numpy as np
+import jax
+
+
+@jax.jit
+def step(state):
+    noise = np.square(state)            # seeded: np call under trace
+    return state + noise
+
+
+def make_flood_step():
+    def core(params, state):
+        # seeded: np.roll concretizes the tracer (or silently runs at
+        # trace time on a constant) — the jnp.roll twin is the fix
+        heard = np.roll(state, 1)
+        # np.float32 as a dtype REFERENCE is fine (attribute, no call):
+        return heard.astype(np.float32)
+    return core

@@ -1,0 +1,24 @@
+# graftlint: scope=tools
+"""graftlint fixture: every violation here carries a per-line pragma —
+the corpus test asserts this file produces ZERO findings (pragma
+support), while its unpragma'd twins above each produce >= 1."""
+
+import sys
+
+sys.path.insert(0, ".")  # graftlint: ignore[sys-path-insert]
+
+
+def load(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except Exception:  # graftlint: ignore[broad-except]
+        return None
+
+
+def load_any(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except:  # noqa: E722  # graftlint: ignore
+        return None

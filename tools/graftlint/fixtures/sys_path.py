@@ -1,0 +1,6 @@
+# graftlint: scope=tools
+"""graftlint fixture: seeded ``sys-path-insert`` violation."""
+
+import sys
+
+sys.path.insert(0, ".")                 # seeded: sys.path mutation

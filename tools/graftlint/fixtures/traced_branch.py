@@ -1,0 +1,34 @@
+"""graftlint fixture: seeded ``traced-branch`` violations.
+
+Every pattern here must be FLAGGED by the AST pass (the corpus test
+asserts >= 1 finding per rule, naming file:line); none may appear in
+the real tree unpragma'd.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def make_step():
+    def step(params, state):
+        if jnp.any(state > 0):          # seeded: if on traced value
+            state = state + 1
+        return state, None
+    return step
+
+
+@jax.jit
+def run(x):
+    while jnp.all(x < 3):               # seeded: while on traced value
+        x = x + 1
+    assert jnp.isfinite(x).all()        # seeded: assert on traced value
+    return x
+
+
+def body(carry, _):
+    y = carry * 2 if jnp.max(carry) > 0 else carry   # seeded: ternary
+    return y, None
+
+
+def drive(x0):
+    return jax.lax.scan(body, x0, None, length=4)

@@ -1,0 +1,341 @@
+"""Abstract-eval audit: trace every simulator runner over the declared
+config matrix and check compile-time invariants — without executing a
+single sim tick.
+
+The two seed-breaking jax-pin drifts fixed in PR 2 (``jax.lax.
+reduce_or`` removed, ``pltpu.CompilerParams`` renamed) and the silent
+regressions the 1M-peer hardware benches cannot afford (f64 promotion,
+a dropped donation doubling the resident carry, a host callback
+sneaking into the scan) are all visible in the jaxpr / lowered HLO.
+This pass builds tiny sims for every combination of the DECLARED
+matrix —
+
+    3 simulators x telemetry {off,on} x faults {off,on}
+                 x {sequential,batched}            (all three)
+    gossipsub additionally x XLA {combined,split}  (force_split)
+
+— and for each case runs ``jax.make_jaxpr`` over the real runner
+(scan included) plus ``.lower`` on the jitted entry point.  Checks:
+
+- **no-64bit**: no float64/int64/uint64/complex128 aval anywhere in
+  the jaxpr (recursively through pjit/scan/vmap sub-jaxprs).
+- **no-widening-convert**: no ``convert_element_type`` whose target is
+  a 64-bit dtype (the specific drift mode of a silent f64 promotion).
+- **no-host-callback**: no callback/infeed/outfeed primitive — the
+  scan must stay device-resident.
+- **donation**: the lowered module aliases EVERY state-carry leaf to
+  an output (``tf.aliasing_output`` per donated buffer) — donation
+  declared in Python but dropped in lowering would silently double
+  resident memory.
+- **const-budget**: captured (closure) constants across all
+  sub-jaxprs stay under ``CONST_BUDGET_BYTES`` — a step closure that
+  captures a peer-sized array ships it once per compilation and hides
+  it from the donation accounting.
+
+Everything here is trace/lower only: building the tiny sims executes
+ordinary array constructors, but auditing never runs a step
+(tests/test_graftlint.py pins that with a backend-compile guard).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+#: peer count / topics / messages / candidates for the audit sims —
+#: big enough to be structurally honest (W=1 word, C=8 ring), small
+#: enough that a full-matrix trace stays in seconds
+N, T, M, C = 80, 2, 6, 8
+TICKS = 3
+BATCH = 2
+CONST_BUDGET_BYTES = 1 << 20
+
+_64BIT = ("float64", "int64", "uint64", "complex128")
+
+
+@dataclass
+class AuditCase:
+    sim: str                 # gossipsub | floodsub | randomsub
+    split: bool              # gossipsub XLA formulation axis
+    telemetry: bool
+    faults: bool
+    batched: bool
+    trace: object = field(repr=False, default=None)   # () -> ClosedJaxpr
+    lower: object = field(repr=False, default=None)   # () -> lowered text
+    n_carry_leaves: int = 0
+
+    @property
+    def name(self) -> str:
+        return (f"{self.sim}"
+                f"{'-split' if self.split else ''}"
+                f"{'-tel' if self.telemetry else ''}"
+                f"{'-faults' if self.faults else ''}"
+                f"{'-batched' if self.batched else '-seq'}")
+
+
+def declared_matrix() -> list[dict]:
+    """The full audited combination set, as data (tests assert
+    build_cases covers exactly this)."""
+    out = []
+    for sim in ("gossipsub", "floodsub", "randomsub"):
+        splits = (False, True) if sim == "gossipsub" else (False,)
+        for split in splits:
+            for tel in (False, True):
+                for faults in (False, True):
+                    for batched in (False, True):
+                        out.append(dict(sim=sim, split=split,
+                                        telemetry=tel, faults=faults,
+                                        batched=batched))
+    return out
+
+
+def _sim_inputs(n_topics: int, seed: int = 0):
+    import numpy as np
+    subs = np.zeros((N, n_topics), dtype=bool)
+    subs[np.arange(N), np.arange(N) % n_topics] = True
+    rng = np.random.default_rng(seed)
+    topic = rng.integers(0, n_topics, M)
+    origin = rng.integers(0, N // n_topics, M) * n_topics + topic
+    ticks = np.zeros(M, dtype=np.int32)
+    return subs, topic, origin, ticks
+
+
+def audit_fault_schedule(seed: int = 0):
+    """A schedule exercising all three fault classes within TICKS."""
+    import numpy as np
+    from go_libp2p_pubsub_tpu.models.faults import FaultSchedule
+    return FaultSchedule(
+        n_peers=N, horizon=max(TICKS, 4),
+        down_intervals=((0, 0, 2), (3, 1, 3)),
+        drop_prob=0.1,
+        partition_group=(np.arange(N) % 2).astype(np.int32),
+        partition_windows=((1, 3),),
+        seed=seed)
+
+
+def build_cases() -> list[AuditCase]:
+    """Build (params, state, step, runner) for every declared combo.
+    This phase executes ordinary array builders; the returned cases'
+    ``trace``/``lower`` thunks never execute anything."""
+    import jax
+    import go_libp2p_pubsub_tpu.models.floodsub as fs
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    import go_libp2p_pubsub_tpu.models.randomsub as rs
+    import go_libp2p_pubsub_tpu.models.telemetry as tl
+    from go_libp2p_pubsub_tpu.ops.graph import make_circulant_offsets
+
+    tcfg = tl.TelemetryConfig()
+    cases = []
+    for combo in declared_matrix():
+        sim = combo["sim"]
+        tel = tcfg if combo["telemetry"] else None
+        fsched = (audit_fault_schedule() if combo["faults"] else None)
+        b = combo["batched"]
+
+        if sim == "gossipsub":
+            cfg = gs.GossipSimConfig(
+                offsets=gs.make_gossip_offsets(T, C, N, seed=1),
+                n_topics=T, d=3, d_lo=2, d_hi=6, d_score=2, d_out=1,
+                d_lazy=2, backoff_ticks=8)
+            step = gs.make_gossip_step(cfg, force_split=combo["split"],
+                                       telemetry=tel)
+            subs, topic, origin, ticks = _sim_inputs(T)
+            spec = dict(subs=subs, msg_topic=topic, msg_origin=origin,
+                        msg_publish_tick=ticks)
+            if b:
+                specs = [dict(spec, seed=r,
+                              fault_schedule=(audit_fault_schedule(r)
+                                              if fsched else None))
+                         for r in range(BATCH)]
+                params, state = gs.stack_sims(cfg, specs)
+                runner = (tl.telemetry_run_batch if tel
+                          else gs.gossip_run_batch)
+            else:
+                params, state = gs.make_gossip_sim(
+                    cfg, seed=0, fault_schedule=fsched, **spec)
+                runner = tl.telemetry_run if tel else gs.gossip_run
+            args, statics = (params, state, TICKS, step), (2, 3)
+
+        elif sim == "floodsub":
+            offs = tuple(int(o) for o in
+                         make_circulant_offsets(T, C, N, seed=1))
+            subs, topic, origin, ticks = _sim_inputs(T)
+
+            def build_flood(sched):
+                return fs.make_flood_sim(
+                    None, None, subs, None, topic, origin, ticks,
+                    fault_schedule=sched, fault_offsets=offs)
+
+            if b:
+                builds = [build_flood(audit_fault_schedule(r)
+                                      if fsched else None)
+                          for r in range(BATCH)]
+                params = fs.stack_trees([p for p, _ in builds])
+                state = fs.stack_trees([s for _, s in builds])
+                if tel:
+                    core = fs.make_circulant_step_core(offs,
+                                                       telemetry=tel)
+                    runner, args, statics = (
+                        tl.telemetry_run_batch,
+                        (params, state, TICKS, core), (2, 3))
+                else:
+                    step_fn = fs.make_circulant_flood_step(offs)
+                    runner, args, statics = (
+                        fs.flood_run_batch,
+                        (params, state, TICKS, step_fn), (2, 3))
+            else:
+                params, state = build_flood(fsched)
+                core = fs.make_circulant_step_core(offs, telemetry=tel)
+                runner = (tl.telemetry_run_curve if tel
+                          else fs.flood_run_curve)
+                args, statics = (params, state, TICKS, core, M), (2, 3, 4)
+
+        else:   # randomsub
+            rcfg = rs.RandomSubSimConfig(
+                offsets=rs.make_randomsub_offsets(T, C, N, seed=1),
+                n_topics=T, d=3)
+            step = rs.make_randomsub_step(rcfg, telemetry=tel)
+            subs, topic, origin, ticks = _sim_inputs(T)
+
+            def build_rsub(sched):
+                return rs.make_randomsub_sim(
+                    rcfg, subs, topic, origin, ticks,
+                    fault_schedule=sched)
+
+            if b:
+                builds = [build_rsub(audit_fault_schedule(r)
+                                     if fsched else None)
+                          for r in range(BATCH)]
+                params = rs.stack_trees([p for p, _ in builds])
+                state = rs.stack_trees([s for _, s in builds])
+                runner = (tl.telemetry_run_batch if tel
+                          else rs.randomsub_run_batch)
+            else:
+                params, state = build_rsub(fsched)
+                runner = tl.telemetry_run if tel else rs.randomsub_run
+            args, statics = (params, state, TICKS, step), (2, 3)
+
+        case = AuditCase(**combo)
+        case.n_carry_leaves = len(jax.tree_util.tree_leaves(state))
+        # late-binding via default args: the thunks must be pure
+        # trace/lower closures over THIS combo's objects
+        case.trace = (lambda r=runner, a=args, s=statics:
+                      jax.make_jaxpr(r, static_argnums=s)(*a))
+        case.lower = (lambda r=runner, a=args:
+                      r.lower(*a).as_text())
+        cases.append(case)
+    return cases
+
+
+# --------------------------------------------------------------------------
+# Jaxpr walking + the checks
+# --------------------------------------------------------------------------
+
+
+def _iter_eqns(jaxpr):
+    """Every eqn in a (Closed)Jaxpr, recursively through sub-jaxprs."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in inner.eqns:
+        yield eqn
+        for val in eqn.params.values():
+            subs = val if isinstance(val, (tuple, list)) else (val,)
+            for sub in subs:
+                if hasattr(sub, "eqns") or hasattr(sub, "jaxpr"):
+                    yield from _iter_eqns(sub)
+
+
+def _iter_consts(jaxpr):
+    """Captured constants, recursively (ClosedJaxpr.consts at every
+    nesting level)."""
+    consts = getattr(jaxpr, "consts", None)
+    if consts:
+        yield from consts
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in inner.eqns:
+        for val in eqn.params.values():
+            subs = val if isinstance(val, (tuple, list)) else (val,)
+            for sub in subs:
+                if hasattr(sub, "eqns") or hasattr(sub, "jaxpr"):
+                    yield from _iter_consts(sub)
+
+
+def audit_case(case: AuditCase) -> list[str]:
+    """Problem strings for one case (empty = clean)."""
+    problems = []
+    closed = case.trace()
+
+    dtypes = set()
+    for eqn in _iter_eqns(closed):
+        prim = eqn.primitive.name
+        if "callback" in prim or prim in ("infeed", "outfeed"):
+            problems.append(
+                f"{case.name}: no-host-callback: primitive '{prim}' "
+                "in the traced runner")
+        if prim == "convert_element_type":
+            dst = str(eqn.params.get("new_dtype"))
+            if dst in _64BIT:
+                problems.append(
+                    f"{case.name}: no-widening-convert: "
+                    f"convert_element_type -> {dst}")
+        for v in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(v, "aval", None)
+            if aval is not None and hasattr(aval, "dtype"):
+                dtypes.add(str(aval.dtype))
+    bad = sorted(d for d in dtypes if d in _64BIT)
+    if bad:
+        problems.append(
+            f"{case.name}: no-64bit: {', '.join(bad)} aval(s) in the "
+            "traced runner")
+
+    const_bytes = sum(getattr(c, "nbytes", 0)
+                      for c in _iter_consts(closed))
+    if const_bytes > CONST_BUDGET_BYTES:
+        problems.append(
+            f"{case.name}: const-budget: {const_bytes} bytes of "
+            f"captured constants > {CONST_BUDGET_BYTES}")
+
+    lowered = case.lower()
+    aliased, nargs = _aliased_args(lowered)
+    # every runner donates exactly its state carry, which flattens to
+    # the LAST n_carry_leaves entry-function arguments (params leaves
+    # first) — so the aliased set must be exactly that trailing range.
+    # A bare occurrence count would let aliasing on OTHER buffers mask
+    # a dropped state donation.
+    expect = set(range(nargs - case.n_carry_leaves, nargs))
+    if aliased != expect:
+        problems.append(
+            f"{case.name}: donation: aliased args {sorted(aliased)} "
+            f"!= the state-carry args {sorted(expect)} — the donated "
+            "carry is not (exactly) the aliased buffer set")
+    return problems
+
+
+_ARG_RE = re.compile(r"%arg(\d+): [^,)]*?\{([^{}]*)\}")
+
+
+def _aliased_args(lowered: str) -> tuple[set, int]:
+    """(indices of @main arguments carrying tf.aliasing_output, total
+    argument count) from the lowered StableHLO text."""
+    m = re.search(r"func\.func public @main\((.*?)\)\s*->", lowered,
+                  re.S)
+    if m is None:
+        return set(), 0
+    sig = m.group(1)
+    nargs = len(set(re.findall(r"%arg(\d+):", sig)))
+    aliased = {int(a) for a, attrs in _ARG_RE.findall(sig)
+               if "tf.aliasing_output" in attrs}
+    return aliased, nargs
+
+
+def run_audit(cases=None, log=None) -> list[str]:
+    """The whole matrix; returns all problems (empty = clean)."""
+    if cases is None:
+        cases = build_cases()
+    problems = []
+    for case in cases:
+        probs = audit_case(case)
+        if log is not None:
+            log(f"  audit {case.name}: "
+                f"{'OK' if not probs else f'{len(probs)} problem(s)'}")
+        problems.extend(probs)
+    return problems

@@ -1,0 +1,90 @@
+"""Per-line graftlint suppressions and per-file scope directives.
+
+Suppressions are deliberately NOT ``noqa``: a graftlint finding is a
+repo-specific contract violation, and silencing one must be a
+separate, auditable decision from silencing a generic style rule.
+The syntax (in a real comment — string literals and docstrings that
+merely QUOTE a pragma are ignored, the file is tokenized)::
+
+    some_code()  # graftlint: ignore[rule-name]
+    other_code()  # graftlint: ignore[rule-a,rule-b]
+    anything()   # graftlint: ignore
+
+A bare ``ignore`` silences every rule on that line; the bracketed form
+silences only the named rules (the audit-friendly form — prefer it).
+``grep -rn "graftlint: ignore"`` lists every suppression.
+
+Fixture files (and any file whose on-disk location does not reflect
+the scope its rules should be checked under) may pin their scope with
+a file-level directive: a comment that starts its own line, anywhere
+in the file::
+
+    # graftlint: scope=model
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+
+_IGNORE_RE = re.compile(
+    r"#\s*graftlint:\s*ignore(?:\[([A-Za-z0-9_\-, ]+)\])?")
+_SCOPE_RE = re.compile(r"^#\s*graftlint:\s*scope=([a-z]+)\s*$")
+
+#: scopes a file may claim / be classified into
+SCOPES = ("model", "core", "tools", "tests", "other")
+
+
+def _comments(src: str):
+    """(line, column, text) of every real COMMENT token — tokenizing
+    (rather than regexing raw lines) is what keeps directives quoted
+    inside string literals or docstrings from being honored."""
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(src).readline)
+        return [(t.start[0], t.start[1], t.string) for t in toks
+                if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # unparseable source: the AST pass reports it separately
+        return []
+
+
+def pragma_lines(src: str) -> dict[int, frozenset[str] | None]:
+    """Map line number -> suppressed rule names (None = all rules)."""
+    out: dict[int, frozenset[str] | None] = {}
+    for line, _col, text in _comments(src):
+        m = _IGNORE_RE.search(text)
+        if m:
+            names = m.group(1)
+            out[line] = (None if names is None else frozenset(
+                n.strip() for n in names.split(",") if n.strip()))
+    return out
+
+
+def suppressed(pragmas: dict, line: int, rule: str) -> bool:
+    if line not in pragmas:
+        return False
+    names = pragmas[line]
+    return names is None or rule in names
+
+
+def scope_override(src: str) -> str | None:
+    """The file's ``# graftlint: scope=...`` directive, if any (a
+    comment that starts its own line).  A directive naming an unknown
+    scope raises ValueError carrying a ``lineno`` attribute
+    (check_file converts it into a located finding rather than
+    crashing the run)."""
+    for line, col, text in _comments(src):
+        if col != 0:
+            continue          # trailing comments are not directives
+        m = _SCOPE_RE.match(text.strip())
+        if m:
+            scope = m.group(1)
+            if scope not in SCOPES:
+                err = ValueError(
+                    f"unknown graftlint scope directive {scope!r} "
+                    f"(one of {SCOPES})")
+                err.lineno = line
+                raise err
+            return scope
+    return None

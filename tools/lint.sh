@@ -2,16 +2,22 @@
 # Lint preflight: ruff with the pinned repo config (ruff.toml) when
 # ruff is installed; otherwise the stdlib-only fallback subset checker
 # (tools/lint_fallback.py — same enforced rule families), so hermetic
-# containers without ruff still gate on a clean pass.  Wired into
-# tools/measure_all.sh as step 0: a measurement pass from a dirty tree
-# wastes chip hours.
+# containers without ruff still gate on a clean pass.  Either way the
+# graftlint AST pass (tools/graftlint, --ast-only: the seconds-fast,
+# jax-free subset of the repo-specific rules) runs on top — the full
+# graftlint suite (abstract-eval audit + config contracts) is its own
+# measure_all.sh step 0.5.  Wired into tools/measure_all.sh as step 0:
+# a measurement pass from a dirty tree wastes chip hours.
 set -u
 cd "$(dirname "$0")/.."
+rc=0
 if command -v ruff >/dev/null 2>&1; then
-  exec ruff check --config ruff.toml .
+  ruff check --config ruff.toml . || rc=1
+elif python -c "import ruff" >/dev/null 2>&1; then
+  python -m ruff check --config ruff.toml . || rc=1
+else
+  echo "lint.sh: ruff not installed — running the stdlib fallback" >&2
+  python tools/lint_fallback.py || rc=1
 fi
-if python -c "import ruff" >/dev/null 2>&1; then
-  exec python -m ruff check --config ruff.toml .
-fi
-echo "lint.sh: ruff not installed — running the stdlib fallback" >&2
-exec python tools/lint_fallback.py
+python -m tools.graftlint --ast-only || rc=1
+exit $rc

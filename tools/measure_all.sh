@@ -99,6 +99,19 @@ if [ "${PIPESTATUS[0]}" -ne 0 ]; then
   sync_log
   exit 4
 fi
+# 0.5. graftlint preflight (CPU-only, ~1 min): the JAX-specific static
+# suite — AST rules, the abstract-eval audit over the full simulator
+# config matrix (no sim executed), and the config thread-or-refuse
+# contracts.  Exactly the silent regressions (f64 promotion, dropped
+# donation, kernel-contract drift) that would waste the chip window.
+echo "=== graftlint preflight ===" | tee -a "$log"
+env JAX_PLATFORMS=cpu python -m tools.graftlint 2>&1 | tee -a "$log"
+if [ "${PIPESTATUS[0]}" -ne 0 ]; then
+  echo "!! graftlint preflight failed — fix findings before measuring" \
+    | tee -a "$log"
+  sync_log
+  exit 4
+fi
 # 1. hardware kernel-identity artifact (small run, judge deliverable)
 run 1800 python tools/kernel_identity.py 200000 KERNEL_IDENTITY_r05.json
 # 2. the flagship driver metric — forced-XLA so the pass ALWAYS

@@ -21,7 +21,7 @@ import sys
 
 import numpy as np
 
-sys.path.insert(0, ".")
+sys.path.insert(0, ".")  # graftlint: ignore[sys-path-insert]
 
 
 def main():

@@ -15,8 +15,8 @@ from collections import defaultdict
 
 import numpy as np
 
-sys.path.insert(0, ".")
-sys.path.insert(0, "tools")
+sys.path.insert(0, ".")  # graftlint: ignore[sys-path-insert]
+sys.path.insert(0, "tools")  # graftlint: ignore[sys-path-insert]
 
 from bench_kernel import build  # noqa: E402
 

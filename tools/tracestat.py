@@ -24,7 +24,8 @@ import base64
 import json
 import sys
 
-sys.path.insert(0, ".")
+sys.path.insert(0, ".")  # graftlint: ignore[sys-path-insert]
+#   (script-style tool, documented to run from the repo root)
 
 from go_libp2p_pubsub_tpu.pb import trace as tr  # noqa: E402
 from go_libp2p_pubsub_tpu.pb.proto import iter_delimited  # noqa: E402

@@ -24,6 +24,12 @@ suite is the full matrix for tracking all baseline configs.)
                    row each (the observation cost, measured) plus the
                    control-overhead row (control bytes / payload
                    bytes, the GossipSub paper's headline number)
+  gossipsub_v11_churn_kernel / gossipsub_telemetry_kernel
+                   the same faulted / observed workloads through the
+                   pallas receive kernel (round 9: in-kernel fault
+                   masks + telemetry tallies), each also measuring
+                   the KERNEL-path mask/observation overhead and
+                   alias-paired to its XLA row for pick_bench_path
 
 Usage: python bench_suite.py [config ...]   (default: all)
 """
@@ -395,11 +401,11 @@ def bench_gossipsub_v11_churn():
     """Degradation under faults (models/faults.py): 10% of peers cycle
     down/up in staggered waves, every link drops 2% of ticks, and one
     30-heartbeat partition splits the network in half mid-run.  XLA
-    path only (the pallas step refuses fault configs).  Emits THREE
-    rows: throughput under churn, the delivery-under-churn fraction,
-    and the partition-heal recovery time (ticks from heal to 99%
-    reachability for a publish still inside the IHAVE window at heal —
-    the OPTIMUMP2P-style headline metric)."""
+    path (the kernel twin is gossipsub_v11_churn_kernel).  Emits
+    THREE rows: throughput under churn, the delivery-under-churn
+    fraction, and the partition-heal recovery time (ticks from heal
+    to 99% reachability for a publish still inside the IHAVE window
+    at heal — the OPTIMUMP2P-style headline metric)."""
     import jax
     import go_libp2p_pubsub_tpu.models.faults as fl
     import go_libp2p_pubsub_tpu.models.gossipsub as gs
@@ -479,14 +485,178 @@ def bench_gossipsub_v11_churn():
                 "threshold": 0.99})
 
 
+def bench_gossipsub_v11_churn_kernel():
+    """gossipsub_v11_churn through the pallas receive kernel (round 9:
+    fault masks thread through the kernel's VMEM pass).  Mosaic on
+    TPU; CPU hosts run the kernel in interpret mode — the on/off
+    RATIO is the measurement there, not absolute speed.  Emits the
+    faulted kernel throughput row plus a fault-free kernel run of the
+    same shape, so the KERNEL-path fault-mask overhead is itself
+    measured (the XLA path's was ~15% at 100k CPU, PERF_NOTES r7/r9),
+    and an alias row pairing the kernel measurement to the plain
+    churn metric name (tagged alias_of — pick_bench_path skips it)."""
+    import math
+    import jax
+    import go_libp2p_pubsub_tpu.models.faults as fl
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    n_named = 1_000_000 if on_accel else 100_000
+    t = 100
+    m, C = 32, 16
+    block = int(os.environ.get("GOSSIP_BENCH_BLOCK", "8192"))
+    quantum = math.lcm(t, 4096, block)
+    n = -(-n_named // quantum) * quantum
+    warmup, T = 100, 150
+    horizon = warmup + T
+    part_start, heal = warmup + 20, warmup + 50
+    rng = np.random.default_rng(0)
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, C, n, seed=0), n_topics=t)
+    score_cfg = gs.ScoreSimConfig()
+    topic, origin, tick = _msgs(rng, n, t, m, horizon - 40)
+    grp = (np.arange(n) < n // 2).astype(np.int64)
+    victims = np.flatnonzero(rng.random(n) < 0.10)
+    ivs = [(int(p), warmup + 5 + int(p % 3) * 5,
+            warmup + 25 + int(p % 3) * 5) for p in victims]
+    sched = fl.FaultSchedule(
+        n_peers=n, horizon=horizon, down_intervals=ivs, drop_prob=0.02,
+        partition_group=grp, partition_windows=[(part_start, heal)],
+        seed=1)
+    subs = _subs_matrix(n, t)
+    rates = {}
+    frac = None
+    for mode in ("faulted", "clean"):
+        params, state = gs.make_gossip_sim(
+            cfg, subs, topic, origin, tick, score_cfg=score_cfg,
+            track_first_tick=False, pad_to_block=block,
+            fault_schedule=(sched if mode == "faulted" else None))
+        params = jax.device_put(params)
+        step = gs.make_gossip_step(cfg, score_cfg, receive_block=block,
+                                   receive_interpret=not on_accel)
+        state = gs.gossip_run(params, jax.device_put(state), warmup,
+                              step)
+        _ = int(np.asarray(state.tick))
+        t0 = time.perf_counter()
+        state = gs.gossip_run(params, state, T, step)
+        _ = int(np.asarray(state.tick))
+        rates[mode] = T / (time.perf_counter() - t0)
+        if mode == "faulted":
+            reach = np.asarray(gs.reach_counts_from_have(params, state))
+            frac = float((reach / float(n // t)).mean())
+            assert frac > 0.80, (
+                f"delivery collapsed under churn (kernel): {frac}")
+    overhead = 100.0 * (rates["clean"] / rates["faulted"] - 1.0)
+    name = f"gossipsub_v11_churn_kernel_{n}peers_heartbeats_per_sec"
+    emit(name, rates["faulted"], "heartbeats/s",
+         extra={"faults": "10pct_churn+2pct_loss+partition",
+                "fault_mask_overhead_pct": round(overhead, 1),
+                "kernel_fault_free_hbps": round(rates["clean"], 2),
+                "delivery_fraction": round(frac, 3),
+                "interpret": not on_accel})
+    emit(f"gossipsub_v11_churn_{n_named}peers_heartbeats_per_sec",
+         rates["faulted"], "heartbeats/s", extra={"alias_of": name})
+
+
+def bench_gossipsub_telemetry_kernel():
+    """Kernel twin of gossipsub_telemetry: the flagship v1.1 config
+    through the pallas kernel telemetry-OFF vs telemetry-ON (the
+    round-9 in-kernel counter tallies), a throughput row each so the
+    KERNEL-path observation cost is measured (the XLA path's was ~51%
+    at 100k CPU, PERF_NOTES r8), plus the control-overhead row —
+    each alias-paired to its XLA metric name for pick_bench_path
+    (alias rows are tagged and skipped by the picker)."""
+    import math
+    import jax
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    import go_libp2p_pubsub_tpu.models.telemetry as tl
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    n_named = 1_000_000 if on_accel else 100_000
+    t = 100
+    m, C = 32, 16
+    block = int(os.environ.get("GOSSIP_BENCH_BLOCK", "8192"))
+    quantum = math.lcm(t, 4096, block)
+    n = -(-n_named // quantum) * quantum
+    # interpret-mode CPU fallback is ~2 orders slower than XLA: one
+    # timed window there, the usual three on hardware
+    warmup, T = 100, 100
+    reps = 3 if on_accel else 1
+    horizon = warmup + T * reps
+    rng = np.random.default_rng(0)
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, C, n, seed=0), n_topics=t)
+    score_cfg = gs.ScoreSimConfig()
+    topic, origin, tick = _msgs(rng, n, t, m, horizon)
+    subs = _subs_matrix(n, t)
+    tcfg = tl.TelemetryConfig()
+    rates = {}
+    tel_totals = None
+    for mode in ("off", "on"):
+        params, state = gs.make_gossip_sim(
+            cfg, subs, topic, origin, tick, score_cfg=score_cfg,
+            track_first_tick=False, pad_to_block=block)
+        params = jax.device_put(params)
+        state = jax.device_put(state)
+        step = gs.make_gossip_step(
+            cfg, score_cfg, receive_block=block,
+            receive_interpret=not on_accel,
+            telemetry=(tcfg if mode == "on" else None))
+        if mode == "off":
+            state = gs.gossip_run(params, state, warmup, step)
+            _ = int(np.asarray(state.tick))
+            t0 = time.perf_counter()
+            for _r in range(reps):
+                state = gs.gossip_run(params, state, T, step)
+                _ = int(np.asarray(state.tick))
+            rates[mode] = T * reps / (time.perf_counter() - t0)
+        else:
+            state, _fr = tl.telemetry_run(params, state, warmup, step)
+            _ = int(np.asarray(state.tick))
+            t0 = time.perf_counter()
+            window_frames = []
+            for _r in range(reps):
+                state, fr = tl.telemetry_run(params, state, T, step)
+                _ = int(np.asarray(state.tick))
+                window_frames.append(tl.summarize_frames(fr))
+            rates[mode] = T * reps / (time.perf_counter() - t0)
+            tel_totals = {
+                k: sum(s[k] for s in window_frames)
+                for k in ("bytes_payload", "bytes_control",
+                          "payload_sent", "ihave_ids",
+                          "iwant_ids_served", "graft_sends",
+                          "prune_sends")}
+    overhead = 100.0 * (rates["off"] / rates["on"] - 1.0)
+    for mode in ("off", "on"):
+        extra = {"interpret": not on_accel}
+        if mode == "on":
+            extra["telemetry_overhead_pct"] = round(overhead, 1)
+        name = (f"gossipsub_v11_telemetry_{mode}_kernel_{n}peers"
+                "_heartbeats_per_sec")
+        emit(name, rates[mode], "heartbeats/s", extra=extra)
+        emit(f"gossipsub_v11_telemetry_{mode}_{n_named}peers"
+             "_heartbeats_per_sec", rates[mode], "heartbeats/s",
+             extra={"alias_of": name})
+    ratio = (tel_totals["bytes_control"] / tel_totals["bytes_payload"]
+             if tel_totals["bytes_payload"] > 0 else 0.0)
+    name = (f"gossipsub_v11_control_overhead_kernel_{n}peers"
+            "_bytes_ratio")
+    emit(name, ratio, "control_bytes/payload_bytes",
+         extra={k: round(v, 1) for k, v in tel_totals.items()})
+    emit(f"gossipsub_v11_control_overhead_{n_named}peers_bytes_ratio",
+         ratio, "control_bytes/payload_bytes",
+         extra={"alias_of": name})
+
+
 def bench_gossipsub_telemetry():
     """Observation cost + the GossipSub paper's headline overhead
     number: the flagship v1.1 config run telemetry-OFF and
     telemetry-ON (models/telemetry.py full frame, XLA path — the
-    kernel refuses telemetry), one throughput row each so the
-    observation cost is itself measured, plus the control-overhead row
-    (control bytes / payload bytes, estimated from the pb/rpc.py
-    framing constants) summed over the ON run's measured window."""
+    kernel twin is gossipsub_telemetry_kernel), one throughput row
+    each so the observation cost is itself measured, plus the
+    control-overhead row (control bytes / payload bytes, estimated
+    from the pb/rpc.py framing constants) summed over the ON run's
+    measured window."""
     import jax
     import go_libp2p_pubsub_tpu.models.gossipsub as gs
     import go_libp2p_pubsub_tpu.models.telemetry as tl
@@ -564,7 +734,9 @@ BENCHES = {
     "gossipsub_v11_adversarial": bench_gossipsub_v11_adversarial,
     "gossipsub_v11_everything": bench_gossipsub_v11_everything,
     "gossipsub_v11_churn": bench_gossipsub_v11_churn,
+    "gossipsub_v11_churn_kernel": bench_gossipsub_v11_churn_kernel,
     "gossipsub_telemetry": bench_gossipsub_telemetry,
+    "gossipsub_telemetry_kernel": bench_gossipsub_telemetry_kernel,
 }
 
 

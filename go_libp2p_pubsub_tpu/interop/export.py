@@ -7,6 +7,10 @@ is the first_tick array; this module turns it (plus the publish table)
 into PUBLISH_MESSAGE / DELIVER_MESSAGE TraceEvents and writes them in the
 exact format of the core's sinks: ndjson (NewJSONTracer) or
 varint-delimited protobuf (NewPBTracer, reference tracer.go:85,137).
+Churn schedules add JOIN/LEAVE, mesh-snapshot diffs add GRAFT/PRUNE
+(mesh_trace_events), and possession-snapshot diffs/replays add
+REJECT_MESSAGE / DUPLICATE_MESSAGE (reject_events /
+duplicate_events) — 8 of the 13 reference event types.
 
 Synthetic identities: sim peer i gets peer id ``b"sim-%d" % i``; message
 m gets id ``b"msg-%d" % m``; tick t maps to timestamp t * 1e9 ns (one
@@ -214,6 +218,179 @@ def mesh_trace_events(mesh_snapshots: np.ndarray, offsets,
                                 prune=tr.PruneEv(peer_id=partner,
                                                  topic=tpc)))
         prev = cur
+    return out
+
+
+def reject_events(have_snapshots: np.ndarray, msg_invalid: np.ndarray,
+                  msg_topic: np.ndarray, start_tick: int = 0,
+                  initial_have: np.ndarray | None = None,
+                  n_true: int | None = None,
+                  topic_name=lambda t: f"topic-{t}",
+                  reason: str = "validation failed"):
+    """Host-side diff of per-tick possession words -> REJECT_MESSAGE
+    TraceEvents (reference trace.proto type 1).
+
+    A peer's FIRST acquisition of a validation-failing message is the
+    tick its router rejects it (validation.go:274-351 — the same
+    copies P4 counts in aggregate; the telemetry seen-cache counters
+    measure them network-wide, this emits the per-event stream).
+
+    have_snapshots: uint32 [T, W, N], row k = possession AFTER tick
+    ``start_tick + k`` (models/gossipsub.py gossip_run_acq_snapshots).
+    ``initial_have`` [W, N] is the pre-run baseline (defaults to
+    empty).  ``n_true`` slices kernel-padded snapshots to the true
+    ring.  ``received_from`` is left unset: the sim's one-tick window
+    makes the rejecting peer and tick exact but the sending edge of
+    the FIRST copy unobservable from possession diffs (use
+    duplicate_events' replay for per-edge attribution of repeats).
+
+    Exact — acquisition is a pure function of the possession words,
+    independent of path, gates, or faults."""
+    snaps = np.asarray(have_snapshots, dtype=np.uint32)
+    if n_true is not None:
+        snaps = snaps[:, :, :n_true]
+    t_ticks = snaps.shape[0]
+    inv_ids = np.flatnonzero(np.asarray(msg_invalid, dtype=bool))
+    prev = (np.zeros_like(snaps[0]) if initial_have is None
+            else np.asarray(initial_have,
+                            dtype=np.uint32)[:, :snaps.shape[2]])
+    out = []
+    for k in range(t_ticks):
+        cur = snaps[k]
+        new = cur & ~prev
+        ts = (start_tick + k) * NS_PER_TICK
+        for m in inv_ids:
+            w, b = divmod(int(m), 32)
+            for p in np.flatnonzero((new[w] >> np.uint32(b))
+                                    & np.uint32(1)):
+                out.append(tr.TraceEvent(
+                    type=TraceType.REJECT_MESSAGE,
+                    peer_id=peer_id(int(p)), timestamp=ts,
+                    reject_message=tr.RejectMessageEv(
+                        message_id=msg_id(int(m)), reason=reason,
+                        topic=topic_name(int(msg_topic[m])))))
+        prev = cur
+    return out
+
+
+def duplicate_events(have_snapshots: np.ndarray,
+                     mesh_snapshots: np.ndarray, offsets,
+                     msg_topic: np.ndarray, start_tick: int = 0,
+                     initial_have: np.ndarray | None = None,
+                     initial_mesh: np.ndarray | None = None,
+                     n_true: int | None = None,
+                     mesh_b_snapshots: np.ndarray | None = None,
+                     initial_mesh_b: np.ndarray | None = None,
+                     slot_b_words: np.ndarray | None = None,
+                     topic_name=lambda t: f"topic-{t}"):
+    """Host-side eager-forward replay -> DUPLICATE_MESSAGE TraceEvents
+    (reference trace.proto type 2, the seen-cache hit pubsub.go:
+    851-868), with per-copy sender attribution (``received_from``).
+
+    Replay model: at tick t every peer forwards its tick t-1
+    acquisitions along its mesh edges (out_bits = start-of-tick mesh,
+    forwardMessage gossipsub.go:989-999); a copy landing on a peer
+    that already holds the id is a duplicate.  Same-tick multi-source
+    copies count as duplicates for every sender after the first in
+    candidate-bit order (arrival order inside the one-tick window is
+    unobservable; the count matches the reference's serial seen-cache
+    exactly).  Under this model the per-tick event count EQUALS the
+    telemetry ``dup_suppressed`` counter for gossip-free,
+    fully-subscribed, fault-free runs (pinned by
+    tests/test_trace_export.py); gossip pulls are lack-gated in the
+    sim and contribute no duplicates, so in general the stream covers
+    the eager-mesh duplicate class (fanout/flood-publish copies and
+    gater-closed edges fall outside the replay).
+
+    have_snapshots [T, W, N] / mesh_snapshots [T, N]: END-of-tick
+    rows from gossip_run_acq_snapshots; ``initial_*`` are the pre-run
+    baselines.  Events start at the SECOND snapshot tick (the first
+    needs pre-run acquisition history).  ``n_true`` slices
+    kernel-padded snapshots (the replay's rolls must wrap at the true
+    ring).
+
+    Paired-topic runs: pass ``mesh_b_snapshots`` (and
+    ``slot_b_words`` — GossipParams.slot_b_words, uint32 [W, N]: bit
+    m set iff message m rides peer p's SECOND topic slot) so the
+    replay splits each sender's fresh set by topic slot and walks
+    BOTH meshes, as the sim's forwarding does."""
+    snaps = np.asarray(have_snapshots, dtype=np.uint32)
+    meshes = np.asarray(mesh_snapshots, dtype=np.uint32)
+    meshes_b = (None if mesh_b_snapshots is None
+                else np.asarray(mesh_b_snapshots, dtype=np.uint32))
+    if meshes_b is not None and slot_b_words is None:
+        raise ValueError(
+            "duplicate_events: mesh_b_snapshots needs slot_b_words "
+            "(which messages ride the second topic slot) — without "
+            "the split the replay would forward every id on both "
+            "meshes and overcount")
+    if slot_b_words is not None and meshes_b is None:
+        raise ValueError(
+            "duplicate_events: slot_b_words needs mesh_b_snapshots "
+            "(the second slot's mesh to forward along) — without it "
+            "every slot-B id would drop out of the replay and "
+            "undercount")
+    if n_true is not None:
+        snaps = snaps[:, :, :n_true]
+        meshes = meshes[:, :n_true]
+        if meshes_b is not None:
+            meshes_b = meshes_b[:, :n_true]
+    t_ticks, w_words, n = snaps.shape
+    offs = tuple(int(o) for o in offsets)
+    slot_b = (None if slot_b_words is None
+              else np.asarray(slot_b_words, dtype=np.uint32)[:, :n])
+    h0 = (np.zeros_like(snaps[0]) if initial_have is None
+          else np.asarray(initial_have, dtype=np.uint32)[:, :n])
+    m0 = (np.zeros_like(meshes[0]) if initial_mesh is None
+          else np.asarray(initial_mesh, dtype=np.uint32)[:n])
+    hav = np.concatenate([h0[None], snaps])      # hav[i] = end of tick
+    msh = np.concatenate([m0[None], meshes])     #   start_tick + i - 1
+    msh_b = None
+    if meshes_b is not None:
+        m0b = (np.zeros_like(meshes_b[0]) if initial_mesh_b is None
+               else np.asarray(initial_mesh_b, dtype=np.uint32)[:n])
+        msh_b = np.concatenate([m0b[None], meshes_b])
+    out = []
+    for k in range(2, t_ticks + 1):
+        tick = start_tick + k - 1
+        ts = tick * NS_PER_TICK
+        acq_prev = hav[k - 1] & ~hav[k - 2]      # [W, N] sender fresh
+        have_prev = hav[k - 1]
+        mesh_out = msh[k - 1]                    # start-of-tick mesh
+        mesh_b_out = None if msh_b is None else msh_b[k - 1]
+        already = have_prev.copy()               # per-receiver cache
+        for c, off in enumerate(offs):
+            senders = ((mesh_out >> np.uint32(c)) & np.uint32(1)
+                       ).astype(bool)
+            senders_b = (None if mesh_b_out is None else
+                         ((mesh_b_out >> np.uint32(c)) & np.uint32(1)
+                          ).astype(bool))
+            for w in range(w_words):
+                if slot_b is None:
+                    sent = np.where(senders, acq_prev[w], 0)
+                else:
+                    # the sim forwards slot-A content on mesh and
+                    # slot-B content on mesh_b, merged per edge
+                    sent = (np.where(senders,
+                                     acq_prev[w] & ~slot_b[w], 0)
+                            | np.where(senders_b,
+                                       acq_prev[w] & slot_b[w], 0))
+                copy_w = np.roll(sent, off)
+                dup = copy_w & already[w]
+                for r in np.flatnonzero(dup):
+                    src = peer_id(int((r - off) % n))
+                    for b in range(32):
+                        if (dup[r] >> np.uint32(b)) & np.uint32(1):
+                            m = w * 32 + b
+                            out.append(tr.TraceEvent(
+                                type=TraceType.DUPLICATE_MESSAGE,
+                                peer_id=peer_id(int(r)), timestamp=ts,
+                                duplicate_message=tr.DuplicateMessageEv(
+                                    message_id=msg_id(m),
+                                    received_from=src,
+                                    topic=topic_name(
+                                        int(msg_topic[m])))))
+                already[w] = already[w] | copy_w
     return out
 
 

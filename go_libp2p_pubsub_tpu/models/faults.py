@@ -53,9 +53,12 @@ undirected edge-tick), so symmetric drops never leave a half-grafted
 mesh edge; a lost PRUNE can leave the pruned side unaware for a while,
 exactly as in the reference — gossip repair covers the gap.
 
-The pallas receive kernel does not honor fault masks; fault configs
-are REFUSED on that path (make_gossip_step raises, the same contract
-as its other refusals).  XLA path only.
+The pallas receive kernel honors fault masks too (round 9): the
+per-tick alive/link words thread through its VMEM pass — sender-side
+masking rides the ctrl bytes, the receiver-alive word is one extra
+[N] operand (ops/pallas/receive.py) — so faulted runs take the fast
+path at hardware scale.  The floodsub gather and randomsub dense
+paths still refuse fault configs (their builders raise).
 """
 
 from __future__ import annotations
@@ -117,18 +120,18 @@ class FaultSchedule:
 
     # Machine-readable thread-or-refuse contract (verified by
     # tools/graftlint/contracts.py).  Fault data is "threaded" on the
-    # three circulant XLA paths (compiled into FaultParams device
-    # arrays, proven by build/jaxpr diff under a probe schedule) and
-    # "refused" on the pallas kernel / gather / dense paths (the
-    # builders raise, proven by reject probes).  n_peers/horizon are
-    # host-side validation bounds ("build-time", proven by reject
-    # probes naming the bad field).
+    # three circulant XLA paths AND the pallas kernel path (compiled
+    # into FaultParams device arrays riding the padded build, proven
+    # by build/jaxpr diff under a probe schedule) and "refused" on the
+    # gather / dense paths (the builders raise, proven by reject
+    # probes).  n_peers/horizon are host-side validation bounds
+    # ("build-time", proven by reject probes naming the bad field).
     PATHS: ClassVar[tuple[str, ...]] = (
         "gossip-xla", "gossip-kernel", "flood-circulant",
         "flood-gather", "randomsub-circulant", "randomsub-dense")
     _THREADED: ClassVar[dict[str, str]] = {
         "gossip-xla": "threaded", "flood-circulant": "threaded",
-        "randomsub-circulant": "threaded", "gossip-kernel": "refused",
+        "randomsub-circulant": "threaded", "gossip-kernel": "threaded",
         "flood-gather": "refused", "randomsub-dense": "refused"}
     CONTRACT: ClassVar[dict[str, object]] = {
         "n_peers": "build-time",

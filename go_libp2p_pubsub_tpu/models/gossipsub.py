@@ -538,8 +538,9 @@ class GossipParams:
     # of the periodic directConnect reconnection (gossipsub.go:1594).
     cand_direct: jnp.ndarray | None = None       # uint32 [N]
     # compiled fault schedule (models/faults.py): per-tick churn/link-
-    # loss/partition masks, computed inside the scan.  XLA path only —
-    # the pallas step refuses fault configs.
+    # loss/partition masks, computed inside the scan.  Honored by both
+    # execution paths: the XLA rolls mask directly, the pallas kernel
+    # threads the alive/link words through its VMEM pass.
     faults: _faults.FaultParams | None = None
 
 
@@ -659,8 +660,10 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
     gossip (gossipsub_feat.go:11-52, gossipsub.go:969-974).
 
     fault_schedule (models/faults.py) injects churn/link-loss/partition
-    events into the step — XLA path only, so it is incompatible with
-    pad_to_block (the pallas step refuses fault configs).
+    events into the step, on either execution path (the pallas kernel
+    threads the per-tick alive/link mask words through its VMEM pass).
+    The schedule is sized to the TRUE peer count; with pad_to_block
+    the pad lanes ride as alive-with-links-up.
     """
     n, t = subs.shape
     if t != cfg.n_topics:
@@ -825,10 +828,10 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
             padl(np.asarray(promise_break, dtype=bool))))
 
     if fault_schedule is not None:
-        if pad_to_block is not None:
-            raise ValueError(
-                "fault_schedule is XLA-path only: the pallas step "
-                "(pad_to_block) refuses fault configs")
+        # both paths honor fault masks (the pallas kernel threads the
+        # per-tick alive/link words through its VMEM pass); the
+        # schedule is always sized to the TRUE peer count — pad lanes
+        # are appended as alive-with-links-up inside the step
         if fault_schedule.n_peers != n:
             raise ValueError(
                 f"fault_schedule.n_peers={fault_schedule.n_peers} != "
@@ -1351,6 +1354,39 @@ def refresh_gates(cfg: GossipSimConfig, sc: ScoreSimConfig | None,
         gates_fp=gates_fingerprint(cfg, sc))
 
 
+def kernel_capability(cfg: GossipSimConfig, sc: ScoreSimConfig | None,
+                      params: GossipParams,
+                      state: GossipState) -> str | None:
+    """Capability dispatch for the pallas receive path: ``None`` when
+    the mosaic kernel supports this configuration, else the refusal
+    message the step raises (message-matched by tests — keep stable).
+
+    Fault schedules and telemetry configs are CAPABILITIES, not
+    refusals: the kernel threads the per-tick alive/link mask words
+    through its VMEM pass and accumulates the TelemetryFrame counter
+    tallies as in-kernel reductions (ops/pallas/receive.py).  What
+    remains refused is genuinely unsupported: C > 16 (the u16
+    pair-packing and ctrl-byte layout), W == 0 (no payload stream to
+    schedule), mixed-protocol overlays (flood_proto), P3 bookkeeping
+    (needs the split-loop provenance the fused kernel elides), a
+    state without carried gates, and a re-weighted NONZERO static
+    score bake (the kernel adds the baked P5+P6 term as-is; an
+    all-zero bake is weight-independent)."""
+    if (cfg.n_candidates > 16 or params.origin_words.shape[0] == 0
+            or params.flood_proto is not None
+            or state.gates is None
+            or (sc is not None
+                and (sc.track_p3
+                     or (not params.static_score_zero
+                         and params.static_score_weights
+                         != (sc.app_specific_weight,
+                             sc.ip_colocation_factor_weight))))):
+        return ("config not supported by the pallas step (needs C<=16, "
+                "W>=1, carried gates, matching static score weights, "
+                "no flood_proto/track_p3)")
+    return None
+
+
 def make_gossip_step(cfg: GossipSimConfig,
                      score_cfg: ScoreSimConfig | None = None,
                      use_pallas_select: bool | None = None,
@@ -1370,8 +1406,11 @@ def make_gossip_step(cfg: GossipSimConfig,
     (telemetry_run / telemetry_run_curve / telemetry_run_batch).  The
     state trajectory is bit-identical to the telemetry-free step
     (telemetry only READS), and ``telemetry=None`` (the default)
-    compiles the exact pre-telemetry step.  XLA path only — the pallas
-    kernel refuses telemetry configs like it refuses fault configs.
+    compiles the exact pre-telemetry step.  Both execution paths
+    support it: on the pallas kernel the RPC/duplicate counters
+    accumulate as in-kernel reductions over views already in VMEM
+    (frames match the XLA path bit-for-bit; the scores group costs
+    one extra [C, N] pass on the kernel path — see kernel_capability).
 
     Per tick:
       1. inject due publishes (Topic.Publish -> rt.Publish, topic.go:207)
@@ -1440,14 +1479,24 @@ def make_gossip_step(cfg: GossipSimConfig,
                        backoff_bits2, sub_all, payload_bits,
                        gossip_bits, accept_bits, valid_w, tick, salt,
                        flood_bits=None, neg=None, sel_b=None,
-                       fresh_b=None):
+                       fresh_b=None, fmasks=None):
         """Pallas path: one mega-kernel does the payload receive,
         handshake resolution, and per-edge counter/backoff updates in
-        a single HBM pass over the [C, N] state (ops/pallas/receive)."""
+        a single HBM pass over the [C, N] state (ops/pallas/receive).
+
+        ``fmasks`` (fault configs): the per-tick mask words — sender
+        sides are masked HERE on the [N] ctrl words before byte
+        packing (they ride the existing DMA slots), receiver sides go
+        in as the kernel's alive-word operand.  With telemetry, the
+        in-kernel counter tallies come back as one [TEL_ROWS, 128]
+        reduction output and the frame is assembled in the epilogue,
+        bit-identical to the XLA path's."""
         from ..ops.pallas.receive import (
             CTRL_A, CTRL_DROP, CTRL_FLOOD, CTRL_GRAFT,
             CTRL_OUT, CTRL_ADV, CTRL_TGT,
             CTRL2_A_B, CTRL2_DROP_B, CTRL2_GRAFT_B, CTRL2_OUT_B,
+            TEL_PAYLOAD, TEL_IHAVE_IDS, TEL_IWANT_SERVED, TEL_RECV,
+            TEL_IWANT_REQ, TEL_IHAVE_RPCS, TEL_IWANT_RPCS, TEL_NEW_IDS,
             extend_wrap, make_receive_update, n_gate_rows, plan,
             sharded_receive)
 
@@ -1469,13 +1518,23 @@ def make_gossip_step(cfg: GossipSimConfig,
         def bit_of(word, c):
             return (word >> jnp.uint32(c)) & jnp.uint32(1)
 
+        g_tx, d_tx, a_tx = grafts, dropped, a_sent
+        if fmasks is not None:
+            # handshake RPCs are sends like any other: a dead peer (or
+            # a down link) transmits no GRAFT/PRUNE/A this tick.  The
+            # LOCAL effects of ``dropped`` (mesh removal, own backoff)
+            # still apply via the drop_ref operand below — only the
+            # notification is lost, exactly the XLA raw_transfers
+            # contract.  out_bits/targets arrive pre-masked.
+            so = fmasks["send_ok"]
+            g_tx, d_tx, a_tx = grafts & so, dropped & so, a_sent & so
         ctrl_rows = []              # u8 [n_pad] per sender edge
         for c in range(C):
             b = ((bit_of(out_bits, c) << jnp.uint32(CTRL_OUT))
                  | (bit_of(tgt_deliver, c) << jnp.uint32(CTRL_TGT))
-                 | (bit_of(grafts, c) << jnp.uint32(CTRL_GRAFT))
-                 | (bit_of(dropped, c) << jnp.uint32(CTRL_DROP))
-                 | (bit_of(a_sent, c) << jnp.uint32(CTRL_A))
+                 | (bit_of(g_tx, c) << jnp.uint32(CTRL_GRAFT))
+                 | (bit_of(d_tx, c) << jnp.uint32(CTRL_DROP))
+                 | (bit_of(a_tx, c) << jnp.uint32(CTRL_A))
                  | (bit_of(targets, c) << jnp.uint32(CTRL_ADV)))
             if flood_bits is not None:
                 b = b | (bit_of(flood_bits, c)
@@ -1490,15 +1549,23 @@ def make_gossip_step(cfg: GossipSimConfig,
                 # topic (gossipsub.go:945-950)
                 out_b_bits = out_b_bits | (params.cand_direct
                                            & params.cand_sub_bits)
+            gb_tx, db_tx, ab_tx = (sel_b["grafts"], sel_b["dropped"],
+                                   sel_b["a_sent"])
+            if fmasks is not None:
+                # slot-B forwards and handshake are sends too
+                so = fmasks["send_ok"]
+                out_b_bits = out_b_bits & so
+                gb_tx, db_tx, ab_tx = (gb_tx & so, db_tx & so,
+                                       ab_tx & so)
             ctrl2_rows = []
             for c in range(C):
                 b2 = ((bit_of(out_b_bits, c)
                        << jnp.uint32(CTRL2_OUT_B))
-                      | (bit_of(sel_b["grafts"], c)
+                      | (bit_of(gb_tx, c)
                          << jnp.uint32(CTRL2_GRAFT_B))
-                      | (bit_of(sel_b["dropped"], c)
+                      | (bit_of(db_tx, c)
                          << jnp.uint32(CTRL2_DROP_B))
-                      | (bit_of(sel_b["a_sent"], c)
+                      | (bit_of(ab_tx, c)
                          << jnp.uint32(CTRL2_A_B)))
                 ctrl2_rows.append(b2.astype(jnp.uint8))
         seen_st = jnp.stack([state.have[w] | injected[w]
@@ -1542,6 +1609,12 @@ def make_gossip_step(cfg: GossipSimConfig,
             blocked += [state.iwant_serves]
             if params.cand_same_ip is not None:
                 blocked += [params.cand_same_ip]
+        if fmasks is not None:
+            blocked += [fmasks["alive_w"]]
+            if sc is not None and sc.sybil_iwant_spam:
+                blocked += [fmasks["flood_ok"]]
+        with_f = fmasks is not None
+        with_t = tel is not None and tel.counters
         if shard_mesh is not None:
             # multi-chip: shard_map over the peer axis — per-shard
             # halo exchange (ICI collective-permutes) + the unmodified
@@ -1565,7 +1638,8 @@ def make_gossip_step(cfg: GossipSimConfig,
                 with_static=with_static,
                 ctrl2_rows=(jnp.stack(ctrl2_rows) if paired
                             else None),
-                freshb_st=(jnp.stack(fresh_b) if paired else None))
+                freshb_st=(jnp.stack(fresh_b) if paired else None),
+                with_faults=with_f, with_telemetry=with_t)
         else:
             def flat8(rows):
                 return jnp.concatenate(
@@ -1596,9 +1670,13 @@ def make_gossip_step(cfg: GossipSimConfig,
                 interpret=receive_interpret,
                 with_px=state.active is not None,
                 with_same_ip=params.cand_same_ip is not None,
-                with_static=with_static)
+                with_static=with_static,
+                with_faults=with_f, with_telemetry=with_t)
             base0 = jnp.zeros((1,), dtype=jnp.uint32)
             outs = krn(*head, base0, *flats, *blocked)
+        tel_row = None
+        if with_t:
+            tel_row, outs = outs[-1], outs[:-1]
         px_word = None
         if state.active is not None:
             px_word, outs = outs[-1], outs[:-1]
@@ -1668,7 +1746,89 @@ def make_gossip_step(cfg: GossipSimConfig,
             mesh_b=mesh_b_new, backoff_b=backoff_b_new,
             active=active_new, gates=gates_new,
             gates_fp=state.gates_fp)
-        return new_state, delivered_now
+        if tel is None:
+            return new_state, delivered_now
+
+        # -- telemetry frame assembly (kernel path).  The counter
+        # tallies come back from the in-kernel reductions (i32, exact,
+        # order-free — they equal the XLA accumulators' totals); the
+        # gauge groups (mesh/scores) reduce over [:n_true] slices so
+        # every float reduction sees exactly the XLA path's shapes and
+        # values — the whole frame is bit-identical to the XLA step's
+        # (pinned by tests/test_pallas_receive.py).
+        kw_f = {}
+        if tel.counters:
+            sums = tel_row.sum(axis=1)          # [TEL_ROWS] i32
+
+            def tx(bits):
+                # handshake RPCs actually transmitted (the XLA
+                # epilogue's tx(): nothing goes on the wire over a
+                # faulted edge or toward a dead partner)
+                if fmasks is None:
+                    return bits
+                return bits & fmasks["send_ok"] & fmasks["cand_alive"]
+
+            graft_cnt = popcount32(tx(grafts)).sum(dtype=jnp.int32)
+            prune_cnt = popcount32(tx(dropped)).sum(dtype=jnp.int32)
+            if paired:
+                graft_cnt = graft_cnt + popcount32(
+                    tx(sel_b["grafts"])).sum(dtype=jnp.int32)
+                prune_cnt = prune_cnt + popcount32(
+                    tx(sel_b["dropped"])).sum(dtype=jnp.int32)
+            kw_f.update(
+                payload_sent=sums[TEL_PAYLOAD],
+                ihave_rpcs=sums[TEL_IHAVE_RPCS],
+                ihave_ids=sums[TEL_IHAVE_IDS],
+                iwant_rpcs=sums[TEL_IWANT_RPCS],
+                iwant_ids_requested=sums[TEL_IWANT_REQ],
+                iwant_ids_served=sums[TEL_IWANT_SERVED],
+                graft_sends=graft_cnt, prune_sends=prune_cnt,
+                dup_suppressed=sums[TEL_RECV] - sums[TEL_NEW_IDS])
+            if tel.wire:
+                f32c = lambda x: x.astype(jnp.float32)  # noqa: E731
+                kw_f["bytes_payload"] = (
+                    f32c(sums[TEL_PAYLOAD] + sums[TEL_IWANT_SERVED])
+                    * float(ws.payload_frame))
+                kw_f["bytes_control"] = (
+                    f32c(sums[TEL_IHAVE_RPCS]) * float(ws.ihave_base)
+                    + f32c(sums[TEL_IHAVE_IDS])
+                    * float(ws.ihave_per_id)
+                    + f32c(sums[TEL_IWANT_RPCS]) * float(ws.iwant_base)
+                    + f32c(sums[TEL_IWANT_REQ])
+                    * float(ws.iwant_per_id)
+                    + f32c(graft_cnt) * float(ws.graft_frame)
+                    + f32c(prune_cnt) * float(ws.prune_frame))
+        if tel.mesh:
+            deg_t = popcount32(mesh_new[:n_true])
+            if paired:
+                deg_t = deg_t + popcount32(mesh_b_new[:n_true])
+            mn_d, mean_d, mx_d = _telemetry.degree_stats(
+                deg_t, params.subscribed[:n_true])
+            kw_f.update(mesh_deg_min=mn_d, mesh_deg_mean=mean_d,
+                        mesh_deg_max=mx_d)
+        if tel.scores and sc is not None:
+            # start-of-tick scores — the view the gates acted on, and
+            # the one telemetry group that re-reads the [C, N]
+            # counters on the kernel path (the kernel's own score
+            # pass runs on the UPDATED counters for next tick's gates)
+            score_t = compute_scores(sc, params, state)
+            mask_t = expand_bits(params.cand_sub_bits & sub_all, C)
+            sm, smn, fneg, fg = _telemetry.score_stats(
+                score_t[:, :n_true], mask_t[:, :n_true],
+                sc.gossip_threshold)
+            kw_f.update(score_mean=sm, score_min=smn,
+                        score_frac_neg=fneg,
+                        score_frac_below_gossip=fg)
+        if tel.faults and fmasks is not None:
+            # unpadded masks: pad lanes are alive-with-links-up by
+            # construction and must not enter the counts
+            kw_f["down_peers"] = (~fmasks["alive_u"]).sum(
+                dtype=jnp.int32)
+            if fmasks["link_u"] is not None:
+                kw_f["dropped_edge_ticks"] = (
+                    popcount32(~fmasks["link_u"] & ALL).sum(
+                        dtype=jnp.int32) // 2)
+        return new_state, delivered_now, _telemetry.make_frame(**kw_f)
 
     def step(params: GossipParams, state: GossipState):
         tick = state.tick
@@ -1682,35 +1842,12 @@ def make_gossip_step(cfg: GossipSimConfig,
             if params.n_true is None:
                 raise ValueError(
                     "pallas step needs make_gossip_sim(pad_to_block=...)")
-            if tel is not None:
-                # telemetry counters are not threaded through the mosaic
-                # kernel — refused outright, the same contract as the
-                # fault-config refusal (run telemetry on the XLA path)
-                raise ValueError(
-                    "telemetry is XLA-path only: the pallas step "
-                    "refuses telemetry configs")
-            if (C > 16 or W == 0 or params.flood_proto is not None
-                    or state.gates is None
-                    # fault masks are not threaded through the mosaic
-                    # kernel: fault configs are refused outright, the
-                    # same contract as the other refusals (run faults
-                    # on the XLA path)
-                    or params.faults is not None
-                    or (sc is not None and (sc.track_p3
-                                            # the kernel adds the baked
-                                            # static P5+P6 term as-is;
-                                            # a re-weighted config must
-                                            # not read a stale bake
-                                            # (an all-zero bake is
-                                            # weight-independent)
-                                            or (not params.static_score_zero
-                                                and params.static_score_weights
-                                                != (sc.app_specific_weight,
-                                                    sc.ip_colocation_factor_weight))))):
-                raise ValueError(
-                    "config not supported by the pallas step (needs "
-                    "C<=16, W>=1, carried gates, matching static score "
-                    "weights, no flood_proto/track_p3/faults)")
+            # capability dispatch: faults and telemetry run IN the
+            # kernel now; anything genuinely unsupported raises the
+            # same message-matched refusal as before
+            reason = kernel_capability(cfg, sc, params, state)
+            if reason is not None:
+                raise ValueError(reason)
         elif params.n_true is not None:
             raise ValueError(
                 "padded sim state requires the pallas step (XLA rolls "
@@ -1734,17 +1871,38 @@ def make_gossip_step(cfg: GossipSimConfig,
         # backoff semantics, rejoin goes through the normal GRAFT path).
         fp = params.faults
         if fp is not None:
-            f_alive = _faults.alive_mask(fp, tick)              # bool [N]
+            # masks are computed on the TRUE ring (the schedule's
+            # n_peers; every roll/draw wraps there) and padded
+            # afterwards for the kernel path — pad peers ride as
+            # alive-with-links-up, so the masks never perturb the
+            # (garbage-tolerated) pad lanes and the fault stream is
+            # identical between the padded and unpadded formulations
+            n_tr = fp.down_start.shape[0]
+            f_alive_u = _faults.alive_mask(fp, tick)        # bool [n_tr]
+            f_link_u = _faults.link_ok_bits(fp, offsets, cinv, tick,
+                                            n_stream)
+            f_cand_alive_u = _faults.cand_alive_bits(f_alive_u, offsets)
+
+            def fpad(a, fill):
+                if a is None or n_tr == n:
+                    return a
+                return jnp.concatenate(
+                    [a, jnp.full((n - n_tr,), fill, dtype=a.dtype)])
+
+            f_alive = fpad(f_alive_u, True)
             f_alive_w = _faults.alive_word(f_alive)             # u32 [N]
             f_alive_all = jnp.where(f_alive, ALL, Z)
-            f_cand_alive = _faults.cand_alive_bits(f_alive, offsets)
-            f_link = _faults.link_ok_bits(fp, offsets, cinv, tick,
-                                          n_stream)
+            f_cand_alive = fpad(f_cand_alive_u, jnp.uint32((1 << C) - 1))
+            f_link = fpad(f_link_u, jnp.uint32((1 << C) - 1))
             f_send_ok = (f_alive_all if f_link is None
                          else f_alive_all & f_link)
+            fmasks = dict(alive_w=f_alive_w, send_ok=f_send_ok,
+                          cand_alive=f_cand_alive,
+                          flood_ok=(f_send_ok & f_cand_alive),
+                          alive_u=f_alive_u, link_u=f_link_u)
         else:
             f_alive = f_alive_w = f_alive_all = None
-            f_cand_alive = f_send_ok = None
+            f_cand_alive = f_send_ok = fmasks = None
 
         # -- 0. start-of-tick gate words --------------------------------
         # Normally READ from the state: the previous tick's epilogue (or
@@ -2145,7 +2303,8 @@ def make_gossip_step(cfg: GossipSimConfig,
                 accept_bits=accept_bits, valid_w=valid_w, tick=tick,
                 salt=salt, flood_bits=flood_bits, neg=neg_px,
                 sel_b=sel_b,
-                fresh_b=(fresh_b if paired else None))
+                fresh_b=(fresh_b if paired else None),
+                fmasks=fmasks)
 
         # behavioral broken-promise detection: a withholding peer's
         # IHAVE claims ids the receiver doesn't hold (the reference
@@ -2957,6 +3116,28 @@ def gossip_run_mesh_snapshots(params: GossipParams, state: GossipState,
     def body(s, _):
         s2 = step(params, s)[0]
         snap = {"mesh": s2.mesh}
+        if s2.mesh_b is not None:
+            snap["mesh_b"] = s2.mesh_b
+        return s2, snap
+    return jax.lax.scan(body, state, None, length=n_ticks)
+
+
+@partial(jax.jit, static_argnums=(2, 3), donate_argnums=(1,))
+def gossip_run_acq_snapshots(params: GossipParams, state: GossipState,
+                             n_ticks: int, step):
+    """Advance n_ticks collecting END-of-tick possession AND mesh
+    words per tick: returns ``(state, snaps)`` where ``snaps["have"]``
+    is uint32 [n_ticks, W, N] and ``snaps["mesh"]`` uint32
+    [n_ticks, N] (plus ``"mesh_b"`` in paired mode).  The host-side
+    event exporters diff these into reference-format TraceEvents:
+    interop.export.reject_events (REJECT_MESSAGE from invalid-id
+    acquisitions) and interop.export.duplicate_events (seen-cache
+    DUPLICATE_MESSAGE from an eager-forward replay over the recorded
+    meshes).  Collection cost is W+1 [N] words per tick — export
+    runs, not benches."""
+    def body(s, _):
+        s2 = step(params, s)[0]
+        snap = {"have": s2.have, "mesh": s2.mesh}
         if s2.mesh_b is not None:
             snap["mesh_b"] = s2.mesh_b
         return s2, snap

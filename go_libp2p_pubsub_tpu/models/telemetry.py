@@ -25,11 +25,14 @@ them the same quantities as DATA riding the ``lax.scan``:
   per-frame constants are measured from pb/rpc.py encodings at step
   build time (``wire_sizes``), not guessed.
 
-Coverage by simulator: gossipsub emits the full frame; floodsub and
-randomsub emit the applicable subset (payload / duplicate / fault
-counters) with the gossip-only fields zero.  XLA path only — the pallas
-receive kernel, the floodsub gather step, and the randomsub dense MXU
-step refuse telemetry configs the way they refuse fault configs.
+Coverage by simulator: gossipsub emits the full frame on BOTH
+execution paths — the pallas receive kernel (round 9) accumulates the
+RPC/duplicate counter tallies as in-kernel reductions and the step
+epilogue assembles the frame bit-identically to the XLA path's;
+floodsub and randomsub emit the applicable subset (payload /
+duplicate / fault counters) with the gossip-only fields zero.  The
+floodsub gather step and the randomsub dense MXU step refuse
+telemetry configs the way they refuse fault configs.
 """
 
 from __future__ import annotations
@@ -92,44 +95,55 @@ class TelemetryConfig:
     # "inert" (documented no-op on that path's frame subset, proven by
     # jaxpr EQUALITY), or "refused" (the path rejects telemetry
     # configs outright — by raising, or by not exposing a telemetry
-    # parameter at all).  The refuse-telemetry contract of the pallas
-    # kernel / gather / dense paths is thereby machine-checked.
+    # parameter at all).  The gossip KERNEL path is threaded since
+    # round 9 (in-kernel counter tallies + epilogue frame assembly —
+    # every field changes the kernel-path jaxpr like the XLA one);
+    # the refuse-telemetry contract of the gather / dense paths
+    # remains machine-checked.
     PATHS: ClassVar[tuple[str, ...]] = (
         "gossip-xla", "gossip-kernel", "flood-circulant",
         "flood-gather", "randomsub-circulant", "randomsub-dense")
     _REFUSING: ClassVar[dict[str, str]] = {
-        "gossip-kernel": "refused", "flood-gather": "refused",
-        "randomsub-dense": "refused"}
+        "flood-gather": "refused", "randomsub-dense": "refused"}
     CONTRACT: ClassVar[dict[str, object]] = {
         "counters": {"gossip-xla": "threaded",
+                     "gossip-kernel": "threaded",
                      "flood-circulant": "threaded",
                      "randomsub-circulant": "threaded", **_REFUSING},
         "wire": {"gossip-xla": "threaded",
+                 "gossip-kernel": "threaded",
                  "flood-circulant": "threaded",
                  "randomsub-circulant": "threaded", **_REFUSING},
         "mesh": {"gossip-xla": "threaded",
+                 "gossip-kernel": "threaded",
                  "flood-circulant": "inert",
                  "randomsub-circulant": "inert", **_REFUSING},
         "scores": {"gossip-xla": "threaded",
+                   "gossip-kernel": "threaded",
                    "flood-circulant": "inert",
                    "randomsub-circulant": "inert", **_REFUSING},
         "faults": {"gossip-xla": "threaded",
+                   "gossip-kernel": "threaded",
                    "flood-circulant": "threaded",
                    "randomsub-circulant": "threaded", **_REFUSING},
         "payload_data_bytes": {"gossip-xla": "threaded",
+                               "gossip-kernel": "threaded",
                                "flood-circulant": "threaded",
                                "randomsub-circulant": "threaded",
                                **_REFUSING},
         # ihave/iwant per-id framing: gossip-only; the flood/randomsub
         # frame subsets bake only the payload frame size
         "msg_id_bytes": {"gossip-xla": "threaded",
+                         "gossip-kernel": "threaded",
                          "flood-circulant": "inert",
                          "randomsub-circulant": "inert", **_REFUSING},
         "peer_id_bytes": {"gossip-xla": "threaded",
+                          "gossip-kernel": "threaded",
                           "flood-circulant": "threaded",
                           "randomsub-circulant": "threaded",
                           **_REFUSING},
         "topic_bytes": {"gossip-xla": "threaded",
+                        "gossip-kernel": "threaded",
                         "flood-circulant": "threaded",
                         "randomsub-circulant": "threaded",
                         **_REFUSING},

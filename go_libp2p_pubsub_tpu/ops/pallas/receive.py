@@ -50,6 +50,31 @@ on configuration.  Remaining refusals: C > 16, W == 0, mixed-protocol
 (an all-zero bake is weight-independent and is elided outright —
 ``with_static=False`` drops the [C, B] f32 stream per block).
 
+Faults (``with_faults``, models/faults.py): the per-tick alive/link
+masks ride the EXISTING data slots instead of forcing a full-array
+XLA pass — sender-side masking (out/target/handshake bits & send-ok)
+happens on the [N] ctrl words BEFORE they are packed into the u8 ctrl
+bytes the DMA already ships, and the only new operand is the
+receiver's alive word (all-ones/all-zeros u32 [N], one b1 stream):
+in-block it gates the merged payload word (a down peer hears nothing)
+and the accumulated GRAFT/PRUNE/A/broken control words (a down peer
+processes no inbound control), exactly mirroring the XLA path's
+``rolled & f_alive_w`` / ``resolve(... & f_alive_all)``.  IWANT-spam
+configs add one more [N] word (send-ok ∧ cand-alive) gating the
+in-kernel flood accrual.
+
+Telemetry (``with_telemetry``, models/telemetry.py): the
+TelemetryFrame RPC/duplicate counters accumulate as in-kernel i32
+reductions over the very views the kernel already holds (the XLA
+path's main observation cost is a gossip-only re-roll per edge-word;
+here the rolled word is in VMEM anyway) and are emitted once per tick
+as a [TEL_ROWS, 128] lane-partial output revisited across the grid —
+counting is receiver-side, but each directed send is viewed by
+exactly one receiver, so the i32 network totals match the XLA path's
+sender-side counts exactly (integer sums are order-free).  Pad lanes
+are excluded by an in-kernel lane mask (they read wrapped — real —
+sender data and would otherwise tally phantoms).
+
 Multi-chip: ``sharded_receive`` runs the kernel under ``shard_map``
 over the peer axis — each shard halo-exchanges max|offset| of boundary
 data with its ring neighbors (``ppermute`` → ICI collective-permute,
@@ -137,6 +162,20 @@ CTRL2_OUT_B = 0    # slot-B eager-forward member (mesh_b | direct)
 CTRL2_GRAFT_B = 1  # slot-B GRAFT sent
 CTRL2_DROP_B = 2   # slot-B PRUNE sent
 CTRL2_A_B = 3      # slot-B "no PRUNE would come back"
+
+# in-kernel telemetry tally rows (out_tel i32 [TEL_ROWS, 128] — 128
+# lane-partial sums per row, consumers sum axis 1).  Combined-path
+# counter semantics (models/telemetry.py TelemetryFrame):
+(TEL_PAYLOAD,       # payload copies sent (eager + slot-B + flood)
+ TEL_IHAVE_IDS,     # ids advertised (pre-withhold, sender-targeted)
+ TEL_IWANT_SERVED,  # gossip-pulled ids actually delivered
+ TEL_RECV,          # received copies (merged word, post alive mask)
+ TEL_IWANT_REQ,     # advertised ids the receiver lacked
+ TEL_IHAVE_RPCS,    # edges carrying a nonempty IHAVE
+ TEL_IWANT_RPCS,    # (edge, receiver) pairs with >= 1 requested id
+ TEL_NEW_IDS,       # new acquisitions (recv - new = dup_suppressed)
+ ) = range(8)
+TEL_ROWS = 8
 
 
 def _align_up(x: int, a: int) -> int:
@@ -236,7 +275,8 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
                     counter_dtype, track_promises,
                     force_extended=False, stream_n=None,
                     with_px=False, with_same_ip=False,
-                    with_static=True):
+                    with_static=True, with_faults=False,
+                    with_telemetry=False):
     C = cfg.n_candidates
     B = block
     cinv = cfg.cinv
@@ -246,6 +286,7 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     has_sc = sc is not None
     paired = cfg.paired_topics
     flood_pub = has_sc and sc.flood_publish
+    iwant_spam = has_sc and sc.sybil_iwant_spam
     # payload views per edge: fresh(, fresh_b), adv(, injected)
     n_pay = 2 + (1 if paired else 0) + (1 if flood_pub else 0)
     IDX_FB = 1                       # fresh_b view index (paired)
@@ -301,6 +342,11 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
         timb_in = nxt() if paired else None
         iws_in = nxt()
         sameip_ref = nxt() if with_same_ip else None
+    # fault masks (models/faults.py), per-peer [B] u32 words: the
+    # receiver's alive word (all-ones/all-zeros) and — IWANT-spam
+    # configs only — the send-ok ∧ cand-alive bits gating the flood
+    alive_ref = nxt() if with_faults else None
+    fok_ref = nxt() if (with_faults and iwant_spam) else None
     out_acq = nxt()
     out_mesh = nxt()
     out_mesh_b = nxt() if paired else None
@@ -312,6 +358,7 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
         out_tim_b = nxt() if paired else None
         out_iws = nxt()
     out_px = nxt() if with_px else None
+    out_tel = nxt() if with_telemetry else None
     cbufs = [nxt() for _ in range(N_SLOTS)]
     c2bufs = [nxt() for _ in range(N_SLOTS)] if paired else None
     # payload buffers: [slot][fresh w... adv w...], all separate 1-D
@@ -395,7 +442,16 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     inv_cnt = [None] * C
     padv_cnt = [None] * C       # partner's advertised-window size per
     #                             edge (IWANT-flood accrual input)
-    iwant_spam = has_sc and sc.sybil_iwant_spam
+    if with_faults:
+        alive_w_blk = alive_ref[...]     # u32 all-ones/all-zeros [B]
+    if with_telemetry:
+        pcount = lambda x: jax.lax.population_count(x).astype(  # noqa: E731
+            jnp.int32)
+        zi = jnp.zeros((B,), jnp.int32)
+        t_pay = t_ihv = t_srv = t_recv = zi
+        t_req = t_ihr = t_iwr = t_new = zi
+        i1 = jnp.int32(1)
+        i0 = jnp.int32(0)
     graft_recv = jnp.zeros((B,), jnp.uint32)
     prune_recv = jnp.zeros((B,), jnp.uint32)
     a_recv = jnp.zeros((B,), jnp.uint32)
@@ -465,22 +521,48 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
             if has_sc:
                 fb_on = fb_on & ok_p
         fd_j = iv_j = pa_j = None
+        if with_telemetry:
+            adv_on = adv_r != 0      # sender targeted this edge
+            req_c = zi
+            adv_nz = jnp.zeros((B,), jnp.bool_)
         for w in range(W):
             fresh_q = _flat_roll(pbufs[slot][w][...], p_deltas[j], B)
             adv_q = _flat_roll(pbufs[slot][IDX_ADV * W + w][...],
                                p_deltas[j], B)
-            got = (jnp.where(fwd_on, fresh_q, Z)
-                   | jnp.where(gsp_on, adv_q, Z))
+            # fwd (eager + slot-B + flood-publish) and gossip halves
+            # kept apart for the telemetry tallies; their OR is the
+            # same merged word as before (u32 OR is associative)
+            fwd_q = jnp.where(fwd_on, fresh_q, Z)
             if paired:
                 fb_q = _flat_roll(pbufs[slot][IDX_FB * W + w][...],
                                   p_deltas[j], B)
-                got = got | jnp.where(fb_on, fb_q, Z)
+                fwd_q = fwd_q | jnp.where(fb_on, fb_q, Z)
             if flood_pub:
                 inj_q = _flat_roll(pbufs[slot][IDX_INJ * W + w][...],
                                    p_deltas[j], B)
-                got = got | jnp.where(fl_on, inj_q, Z)
+                fwd_q = fwd_q | jnp.where(fl_on, inj_q, Z)
+            gsp_q = jnp.where(gsp_on, adv_q, Z)
+            got = fwd_q | gsp_q
+            if with_faults:
+                # a down receiver hears nothing (XLA: rolled &
+                # f_alive_w); senders were masked at the ctrl bytes
+                got = got & alive_w_blk
             news = got & ~seen[w]
             heard[w] = heard[w] | news
+            if with_telemetry:
+                # combined-path tallies: sent words pre-recv-alive,
+                # received/served/requested post (models/gossipsub.py
+                # telemetry accumulators, bit-for-bit)
+                adv_w_q = jnp.where(adv_on, adv_q, Z)
+                gsp_m = (gsp_q & alive_w_blk if with_faults else gsp_q)
+                r_adv = (adv_w_q & alive_w_blk if with_faults
+                         else adv_w_q)
+                t_pay = t_pay + pcount(fwd_q)
+                t_ihv = t_ihv + pcount(adv_w_q)
+                t_srv = t_srv + pcount(gsp_m & ~seen[w])
+                t_recv = t_recv + pcount(got)
+                req_c = req_c + pcount(r_adv & ~seen[w])
+                adv_nz = adv_nz | (adv_q != 0)
             if has_sc:
                 # popcount yields u32; mosaic can't cast u32->f32, so
                 # counts go to i32 immediately
@@ -498,6 +580,12 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
                 pa_j = np_ if pa_j is None else pa_j + np_
         fd_cnt[j], inv_cnt[j] = fd_j, iv_j
         padv_cnt[j] = pa_j
+        if with_telemetry:
+            # one IHAVE RPC per targeted edge with a nonempty advert;
+            # one IWANT RPC per (edge, receiver) with >= 1 lacked id
+            t_ihr = t_ihr + jnp.where(adv_on & adv_nz, i1, i0)
+            t_req = t_req + req_c
+            t_iwr = t_iwr + jnp.where(req_c > 0, i1, i0)
         if track_promises:
             # behavioral broken promise: advertised (ADV), not
             # delivering (~TGT), receiver accepts the IHAVE (gossip
@@ -508,6 +596,20 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
             broken_recv = broken_recv | (
                 (adv_r & (u1 ^ m_g) & okg_u & lacked) << jnp.uint32(j))
 
+    if with_faults:
+        # a down receiver processes no inbound control and records no
+        # broken promise this tick (XLA resolve: & f_alive_all / the
+        # lack_any & f_alive gate); the alive word is all-ones or
+        # all-zeros, so it masks packed C-bit words directly
+        graft_recv = graft_recv & alive_w_blk
+        prune_recv = prune_recv & alive_w_blk
+        a_recv = a_recv & alive_w_blk
+        if track_promises:
+            broken_recv = broken_recv & alive_w_blk
+        if paired:
+            graft_recv_b = graft_recv_b & alive_w_blk
+            prune_recv_b = prune_recv_b & alive_w_blk
+            a_recv_b = a_recv_b & alive_w_blk
     if has_sc:
         accb = acc_ref[...]
         graft_recv = graft_recv & accb
@@ -550,6 +652,11 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     out_acq[...] = jnp.stack(
         [jnp.where(subbed, heard[w], jnp.uint32(0)) | inj_a[w]
          for w in range(W)])
+    if with_telemetry:
+        # dup_suppressed = recv - new (injected publishes are not
+        # received copies, so they stay out of both sides)
+        for w in range(W):
+            t_new = t_new + pcount(jnp.where(subbed, heard[w], Z))
     # backoff = remaining ticks: triggers restart at B-1, else
     # decrement toward 0 (i32 detour: mosaic lacks 16-bit min/max)
     bo32 = bo_in[...].astype(jnp.int32)
@@ -696,6 +803,11 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
                               for j in range(C)])
             budget = cfg.gossip_retransmission * padv
             flood = jnp.where((s32 < budget) & (padv > 0), padv, 0)
+            if with_faults:
+                # no IWANT flood over a faulted edge: a dead sybil
+                # requests nothing, a dead (or link-cut) partner
+                # serves nothing (XLA epilogue's expand_bits mask)
+                flood = jnp.where(_expand(fok_ref[...], C), flood, 0)
             syb_on = (syb_ref[...] != 0)[None, :]
             pull = jnp.where(syb_on, flood, pull)
         H = cfg.history_length
@@ -772,6 +884,28 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
         if paired:
             out_gates[2][...] = bo_gate_b
 
+    if with_telemetry:
+        # once-per-tick reduction emission: mask pad lanes (they read
+        # wrapped — real — sender data and would tally phantoms),
+        # fold [B] lanes to 128 partials, and accumulate across the
+        # grid into the single revisited [TEL_ROWS, 128] block
+        rows8 = jnp.stack([t_pay, t_ihv, t_srv, t_recv,
+                           t_req, t_ihr, t_iwr, t_new])
+        lane_i = (jax.lax.broadcasted_iota(jnp.int32, (TEL_ROWS, B), 1)
+                  + i * B)
+        tele = jnp.where(lane_i < n_true, rows8, i0)
+        blk = tele[:, :128]
+        for k in range(1, B // 128):
+            blk = blk + tele[:, k * 128:(k + 1) * 128]
+
+        @pl.when(i == 0)
+        def _tel_init():
+            out_tel[...] = blk
+
+        @pl.when(i != 0)
+        def _tel_accumulate():
+            out_tel[...] = out_tel[...] + blk
+
 
 def _ring_halo(x, p_l: int, p_r: int, axis_name: str, D: int):
     """Per-shard halo extension along the last axis of a D-shard ring.
@@ -819,7 +953,8 @@ def sharded_receive(cfg, sc, n_true: int, block: int, counter_dtype,
                     mesh, axis_name: str,
                     head, ctrl_rows, fresh_st, adv_st, blocked,
                     inj_st=None, with_px=False, with_same_ip=False,
-                    ctrl2_rows=None, freshb_st=None, with_static=True):
+                    ctrl2_rows=None, freshb_st=None, with_static=True,
+                    with_faults=False, with_telemetry=False):
     """Multi-chip kernel dispatch: shard_map over the peer axis, one
     pallas kernel invocation per shard with ring-halo exchange.
 
@@ -862,7 +997,8 @@ def sharded_receive(cfg, sc, n_true: int, block: int, counter_dtype,
         cfg, sc, S, block, counter_dtype, w_words,
         track_promises=track_promises, interpret=interpret,
         force_extended=True, stream_n=n_true, with_px=with_px,
-        with_same_ip=with_same_ip, with_static=with_static)
+        with_same_ip=with_same_ip, with_static=with_static,
+        with_faults=with_faults, with_telemetry=with_telemetry)
     n_head = len(head)
     paired = cfg.paired_topics
     n_gates = n_gate_rows(sc is not None, paired)
@@ -892,9 +1028,14 @@ def sharded_receive(cfg, sc, n_true: int, block: int, counter_dtype,
                   for f in flats[:n_ctrl]]
         pay_e = [_ring_halo(f, p32, p32 + e32, axis_name, D)
                  for f in flats[n_ctrl:]]
-        return tuple(krn(*head_l, base,
+        outs = tuple(krn(*head_l, base,
                          *[f.reshape(-1) for f in ctrl_e],
                          *[f.reshape(-1) for f in pay_e], *blk))
+        if with_telemetry:
+            # per-shard lane-partials -> replicated global tallies
+            # (i32 psum — exact, order-free)
+            outs = outs[:-1] + (jax.lax.psum(outs[-1], axis_name),)
+        return outs
 
     shard_last = lambda x: P(*([None] * (x.ndim - 1)), axis_name)  # noqa: E731
     in_specs = tuple(
@@ -907,7 +1048,8 @@ def sharded_receive(cfg, sc, n_true: int, block: int, counter_dtype,
         + [P(axis_name)] * n_gates
         + ([P(None, axis_name)] * (6 if paired else 5)
            if sc is not None else [])                     # counters
-        + ([P(axis_name)] if with_px else []))
+        + ([P(axis_name)] if with_px else [])
+        + ([P(None, None)] if with_telemetry else []))    # tel (repl.)
     try:
         fn = shard_map(body, mesh=mesh, in_specs=in_specs,
                        out_specs=out_specs, check_vma=False)
@@ -925,7 +1067,9 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
                         stream_n: int | None = None,
                         with_px: bool = False,
                         with_same_ip: bool = False,
-                        with_static: bool = True):
+                        with_static: bool = True,
+                        with_faults: bool = False,
+                        with_telemetry: bool = False):
     """Build the kernel caller.
 
     Operand order (args): [valid u32 [W] (sc only)], gseeds u32 [2]
@@ -939,13 +1083,18 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
     [W, N_pad], backoff-remaining i16 [C, N_pad], [static f32
     [C, N_pad], fd, inv (counter_dtype), bp f32(/counter_dtype), tim
     i16 [C, N_pad], iwant_serves i16 [C, N_pad],
-    [cand_same_ip u32 [C, N_pad] (with_same_ip only)] (sc only)].
+    [cand_same_ip u32 [C, N_pad] (with_same_ip only)] (sc only)],
+    [alive_w u32 [N_pad] (with_faults only: the receiver-alive
+    all-ones/all-zeros word), [flood_ok u32 [N_pad] (with_faults AND
+    sybil_iwant_spam: send-ok ∧ cand-alive bits)]].
 
     Returns (new_acq [W, N_pad], mesh [N_pad], backoff [C, N_pad],
     *gates (G separate u32 [N_pad] words — compute_gates order),
     [, fd, inv, bp, tim, iwant_serves][, px_rot u32 [N_pad]
     (with_px only — received PRUNEs/PRUNE-responses for the XLA
-    rotation epilogue)]) where G = 7 scored / 2 unscored.
+    rotation epilogue)][, tel i32 [TEL_ROWS, 128] (with_telemetry
+    only — lane-partial counter tallies, sum axis 1 for the network
+    totals)]) where G = 7 scored / 2 unscored.
 
     NOTE the px caveat: with_px configs get their TARGETS gate row
     re-emitted by the XLA epilogue from the post-rotation active set
@@ -974,7 +1123,8 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
         w_words=w_words, counter_dtype=counter_dtype,
         track_promises=track_promises, force_extended=force_extended,
         stream_n=stream_n, with_px=with_px,
-        with_same_ip=with_same_ip, with_static=with_static)
+        with_same_ip=with_same_ip, with_static=with_static,
+        with_faults=with_faults, with_telemetry=with_telemetry)
 
     b1 = lambda: pl.BlockSpec((B,), lambda i: (i,))  # noqa: E731
     bw = lambda: pl.BlockSpec((W, B), lambda i: (0, i))  # noqa: E731
@@ -1001,6 +1151,10 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
                               + (6 if paired else 5))
         if with_same_ip:
             in_specs += [bc()]    # cand_same_ip sibling words
+    if with_faults:
+        in_specs += [b1()]        # receiver-alive word
+        if has_sc and sc.sybil_iwant_spam:
+            in_specs += [b1()]    # send-ok ∧ cand-alive (flood gate)
 
     out_shape = [
         jax.ShapeDtypeStruct((W, n_pad), jnp.uint32),       # new_acq
@@ -1036,6 +1190,11 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
     if with_px:
         out_shape += [jax.ShapeDtypeStruct((n_pad,), jnp.uint32)]
         out_specs += [b1()]
+    if with_telemetry:
+        # single block revisited across the grid (constant index map):
+        # the kernel initializes it on block 0 and accumulates after
+        out_shape += [jax.ShapeDtypeStruct((TEL_ROWS, 128), jnp.int32)]
+        out_specs += [pl.BlockSpec((TEL_ROWS, 128), lambda i: (0, 0))]
 
     scratch = (
         [pltpu.VMEM((B + ALIGN8,), jnp.uint8)]

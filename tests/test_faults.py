@@ -338,25 +338,28 @@ def test_partition_heal_reports_finite_recovery():
 # --------------------------------------------------------------------------
 
 
-def test_pallas_step_refuses_fault_configs():
-    sched = fl.FaultSchedule(n_peers=240, horizon=10)
+def test_pallas_step_accepts_fault_configs():
+    """Round 9: fault masks thread through the pallas kernel — a
+    faulted config on the kernel path is a CAPABILITY now (the full
+    parity matrix is pinned in tests/test_pallas_receive.py; this
+    pins acceptance where the refusal used to be).  An UNPADDED state
+    with the kernel forced still raises the pad requirement."""
+    sched = fl.FaultSchedule(n_peers=240, horizon=10,
+                             down_intervals=((0, 0, 5),))
     cfg, sc, params, state, *_ = gossip_build(sched=sched)
     step = gs.make_gossip_step(cfg, sc, use_pallas_receive=True)
-    with pytest.raises(ValueError, match="pallas"):
-        step(params, state)
-
-
-def test_padded_sim_rejects_fault_schedule():
+    with pytest.raises(ValueError, match="pad_to_block"):
+        step(params, state)     # the PAD refusal, not a fault refusal
     n, t = 240, 2
-    cfg = gs.GossipSimConfig(
-        offsets=gs.make_gossip_offsets(t, 16, n, seed=1), n_topics=t)
     subs = np.zeros((n, t), dtype=bool)
     subs[np.arange(n), np.arange(n) % t] = True
-    with pytest.raises(ValueError, match="pallas"):
-        gs.make_gossip_sim(
-            cfg, subs, np.zeros(2, np.int64), np.zeros(2, np.int64),
-            np.zeros(2, np.int32), pad_to_block=256,
-            fault_schedule=fl.FaultSchedule(n_peers=n, horizon=10))
+    p_k, s_k = gs.make_gossip_sim(
+        cfg, subs, np.zeros(2, np.int64), np.zeros(2, np.int64),
+        np.zeros(2, np.int32), pad_to_block=256, fault_schedule=sched)
+    step_k = gs.make_gossip_step(cfg, sc, receive_block=256,
+                                 receive_interpret=True)
+    out = gs.gossip_run(p_k, s_k, 3, step_k)
+    assert int(np.asarray(out.tick)) == 3
 
 
 def test_dense_randomsub_refuses_faults():

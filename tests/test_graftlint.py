@@ -287,9 +287,12 @@ def test_contract_declarations_complete():
 
 
 def test_contract_refusals_and_build_time_hold():
-    """The refuse-telemetry / refuse-faults contracts of the pallas
-    kernel, gather, and dense paths — and the build-time reject
-    claims — verified directly (the fast, no-trace subset)."""
+    """The refuse-telemetry / refuse-faults contracts of the gather
+    and dense paths — and the build-time reject claims — verified
+    directly (the fast, no-trace subset).  The pallas kernel's
+    entries left _REFUSALS in round 9: it THREADS faults and
+    telemetry now (see test_contract_fault_threading_fast and
+    test_contract_telemetry_kernel_threaded_fast)."""
     from tools.graftlint import contracts as ct
 
     for key, (probe, match) in ct._REFUSALS.items():
@@ -306,14 +309,26 @@ def test_contract_refusals_and_build_time_hold():
 
 def test_contract_fault_threading_fast():
     """FaultSchedule data fields provably reach the device params on
-    all three circulant paths (value-diff probes, no tracing)."""
+    all three circulant paths AND the round-9 pallas kernel path
+    (value-diff probes on the padded build, no tracing)."""
     from tools.graftlint import contracts as ct
 
     for field in ("down_intervals", "drop_prob", "partition_group",
                   "partition_windows", "seed"):
-        for path in ("gossip-xla", "flood-circulant",
+        for path in ("gossip-xla", "gossip-kernel", "flood-circulant",
                      "randomsub-circulant"):
             assert ct._fault_threaded(field, path), (field, path)
+
+
+def test_contract_telemetry_kernel_threaded_fast():
+    """One kernel-path telemetry threading probe in the fast subset:
+    the ``counters`` group must change the KERNEL step's jaxpr (the
+    in-kernel tally output appearing/disappearing) — the round-9
+    flip from refused to threaded, proven.  The full field sweep runs
+    in the @slow check_contracts pass."""
+    from tools.graftlint import contracts as ct
+
+    assert ct._tel_probe("counters", "gossip-kernel", False)
 
 
 def test_contract_detects_an_undeclared_field(monkeypatch):

@@ -13,11 +13,31 @@ import pytest
 import go_libp2p_pubsub_tpu.models.gossipsub as gs
 
 
+def _sched(n, seed=5, horizon=40, drop=0.05, partition=True,
+           churn_frac=0.1):
+    """A FaultSchedule exercising all three fault classes inside the
+    test runs' tick windows (staggered churn waves, symmetric link
+    loss, one mid-run half/half partition)."""
+    import go_libp2p_pubsub_tpu.models.faults as fl
+
+    rng = np.random.default_rng(seed)
+    victims = np.flatnonzero(rng.random(n) < churn_frac)
+    ivs = tuple((int(p), 3 + int(p % 4), 10 + int(p % 4))
+                for p in victims)
+    kw = {}
+    if partition:
+        kw = dict(partition_group=(np.arange(n) % 2).astype(np.int32),
+                  partition_windows=((12, 18),))
+    return fl.FaultSchedule(n_peers=n, horizon=horizon,
+                            down_intervals=ivs, drop_prob=drop,
+                            seed=seed ^ 0x9E37, **kw)
+
+
 def _build(n, n_topics, C, m, *, score, sybil_frac=0.0, spam=False,
            iwant_spam=False, graft_flood=False, invalid_frac=0.0,
            breaker_frac=0.0, pad_block=None, seed=3, exact_k=False,
            direct=False, flood_publish=False, px=None,
-           shared_ip=False):
+           shared_ip=False, faults=None):
     rng = np.random.default_rng(seed)
     offsets = gs.make_gossip_offsets(n_topics, C, n, seed=seed)
     cfg = gs.GossipSimConfig(offsets=offsets, n_topics=n_topics,
@@ -63,20 +83,42 @@ def _build(n, n_topics, C, m, *, score, sybil_frac=0.0, spam=False,
         kw.setdefault("msg_invalid", np.zeros(m, dtype=bool))
     params, state = gs.make_gossip_sim(
         cfg, subs, topic, origin, ticks, score_cfg=sc,
-        pad_to_block=pad_block, **kw)
+        pad_to_block=pad_block, fault_schedule=faults, **kw)
     return cfg, sc, params, state
 
 
-def _run_pair(n, n_topics, C, m, n_ticks, block, **kw):
+def _run_pair(n, n_topics, C, m, n_ticks, block, telemetry=None, **kw):
+    """XLA (unpadded) and kernel (padded, interpret) trajectories of
+    one config.  With ``telemetry`` returns (..., frames_x, frames_k)
+    too, run through the telemetry runners."""
+    import go_libp2p_pubsub_tpu.models.telemetry as tl
+
     cfg, sc, p_x, s_x = _build(n, n_topics, C, m, **kw)
     cfg2, sc2, p_k, s_k = _build(n, n_topics, C, m, pad_block=block,
                                  **kw)
-    step_x = gs.make_gossip_step(cfg, sc)
+    step_x = gs.make_gossip_step(cfg, sc, telemetry=telemetry)
     step_k = gs.make_gossip_step(cfg2, sc2, receive_block=block,
-                                 receive_interpret=True)
+                                 receive_interpret=True,
+                                 telemetry=telemetry)
+    if telemetry is not None:
+        out_x, fr_x = tl.telemetry_run(p_x, s_x, n_ticks, step_x)
+        out_k, fr_k = tl.telemetry_run(p_k, s_k, n_ticks, step_k)
+        return cfg, sc, out_x, out_k, fr_x, fr_k
     out_x = gs.gossip_run(p_x, s_x, n_ticks, step_x)
     out_k = gs.gossip_run(p_k, s_k, n_ticks, step_k)
     return cfg, sc, out_x, out_k
+
+
+def _assert_frames_equal(fr_x, fr_k):
+    """Kernel-path frames == XLA-path frames, bit for bit (the int
+    counter tallies are exact by construction; the float gauges reduce
+    over identical [:n_true] shapes)."""
+    import go_libp2p_pubsub_tpu.models.telemetry as tl
+
+    ax, ak = tl.frames_to_arrays(fr_x), tl.frames_to_arrays(fr_k)
+    for name in ax:
+        np.testing.assert_array_equal(ax[name], ak[name], err_msg=name)
+    return ax
 
 
 def _assert_state_equal(out_x, out_k, n, sc):
@@ -246,7 +288,7 @@ def test_kernel_matches_xla_v11_graft_flood():
 def _build_paired(n, t, C, m, *, score, pad_block=None, seed=2,
                   sybil_frac=0.0, spam=False, iwant_spam=False,
                   invalid_frac=0.0, px=None, direct=False,
-                  shared_ip=False, flood_publish=False):
+                  shared_ip=False, flood_publish=False, faults=None):
     rng = np.random.default_rng(seed)
     cfg = gs.GossipSimConfig(
         offsets=gs.make_gossip_offsets(t, C, n, seed=seed, paired=True),
@@ -289,7 +331,7 @@ def _build_paired(n, t, C, m, *, score, pad_block=None, seed=2,
         kw["px_candidates"] = px
     params, state = gs.make_gossip_sim(
         cfg, subs, topic, origin, ticks, score_cfg=sc,
-        pad_to_block=pad_block, **kw)
+        pad_to_block=pad_block, fault_schedule=faults, **kw)
     return cfg, sc, params, state
 
 
@@ -498,3 +540,244 @@ def test_kernel_slots_env_validated_at_import():
             capture_output=True, text=True, timeout=120, env=env)
         assert r.returncode != 0, bad
         assert "GOSSIP_KERNEL_SLOTS" in r.stderr, r.stderr[-500:]
+
+
+# --------------------------------------------------------------------------
+# Faulted + observed runs on the fast path: the kernel accepts
+# FaultSchedule and TelemetryConfig (round 9) — kernel vs XLA state
+# trajectories (and telemetry frames) must stay bit-identical across
+# the new config matrix.  Fast subset here; the full sweep is @slow.
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("score", [True, False])
+def test_kernel_matches_xla_faults(score):
+    """Churn + link loss + a mid-run partition on the kernel path:
+    the per-tick alive/link mask words ride the ctrl bytes (sender
+    side) and the alive-word operand (receiver side) — bit-identical
+    to the XLA fault masking."""
+    n = 900
+    cfg, sc, out_x, out_k = _run_pair(n, 4, 8, 8, 30, 128, score=score,
+                                      faults=_sched(n))
+    _assert_state_equal(out_x, out_k, n, sc)
+    # non-vacuous: the faults actually bit — the faulted trajectory
+    # differs from a fault-free run of the same seed
+    _, _, out_clean, _ = _run_pair(n, 4, 8, 8, 30, 128, score=score)
+    assert (np.asarray(out_clean.have) != np.asarray(out_x.have)).any()
+    assert np.asarray(out_x.have).any()
+
+
+def test_kernel_matches_xla_telemetry_frames():
+    """Telemetry through the kernel: the in-kernel counter tallies
+    (RPC sends by type, duplicates, bytes-on-wire) and the epilogue
+    gauge groups must reproduce the XLA path's TelemetryFrame stream
+    bit for bit, while the state trajectory stays bit-identical to
+    the telemetry-free kernel run."""
+    import go_libp2p_pubsub_tpu.models.telemetry as tl
+
+    n = 900
+    cfg, sc, out_x, out_k, fr_x, fr_k = _run_pair(
+        n, 4, 8, 8, 25, 128, score=True, telemetry=tl.TelemetryConfig())
+    _assert_state_equal(out_x, out_k, n, sc)
+    ax = _assert_frames_equal(fr_x, fr_k)
+    assert ax["payload_sent"].sum() > 0
+    assert ax["ihave_ids"].sum() > 0
+    assert ax["iwant_ids_served"].sum() > 0
+    assert ax["dup_suppressed"].sum() > 0
+    assert ax["bytes_control"].sum() > 0
+    # telemetry only READS: the kernel state trajectory is identical
+    # to the telemetry-free kernel run
+    _, _, _, out_k_plain = _run_pair(n, 4, 8, 8, 25, 128, score=True)
+    np.testing.assert_array_equal(np.asarray(out_k.have),
+                                  np.asarray(out_k_plain.have))
+    np.testing.assert_array_equal(np.asarray(out_k.mesh),
+                                  np.asarray(out_k_plain.mesh))
+
+
+def test_kernel_matches_xla_faults_plus_telemetry():
+    """Faults AND telemetry at once on the kernel path — the two
+    ROADMAP workloads together: fault counters land in the frames,
+    masked tallies match the XLA accumulators exactly."""
+    import go_libp2p_pubsub_tpu.models.telemetry as tl
+
+    n = 900
+    cfg, sc, out_x, out_k, fr_x, fr_k = _run_pair(
+        n, 4, 8, 8, 25, 128, score=True, faults=_sched(n),
+        telemetry=tl.TelemetryConfig())
+    _assert_state_equal(out_x, out_k, n, sc)
+    ax = _assert_frames_equal(fr_x, fr_k)
+    assert ax["down_peers"].max() > 0
+    assert ax["dropped_edge_ticks"].max() > 0
+    assert ax["payload_sent"].sum() > 0
+
+
+def test_kernel_matches_xla_faults_iwant_flood():
+    """IWANT-retransmission-flood sybils UNDER faults: the in-kernel
+    flood accrual is gated by the send-ok ∧ cand-alive operand (a
+    dead sybil requests nothing, a cut link serves nothing) — serve
+    ledger bit-identical to the XLA epilogue."""
+    import go_libp2p_pubsub_tpu.models.telemetry as tl
+
+    n = 640
+    cfg, sc, out_x, out_k, fr_x, fr_k = _run_pair(
+        n, 2, 8, 10, 12, 128, score=True, sybil_frac=0.2, spam=True,
+        iwant_spam=True, invalid_frac=0.3,
+        faults=_sched(n, partition=False),
+        telemetry=tl.TelemetryConfig())
+    _assert_state_equal(out_x, out_k, n, sc)
+    _assert_frames_equal(fr_x, fr_k)
+    assert np.asarray(out_x.iwant_serves).max() > 0
+
+
+def test_kernel_matches_xla_batched_fault_seeds():
+    """Batched-over-seeds faulted replicas: the XLA batched runner
+    (vmapped step, per-replica fault seeds) against the kernel run
+    sequentially per replica — every replica's trajectory must agree
+    with its kernel twin."""
+    n, B = 640, 3
+    kw = dict(n_topics=2, d=3, d_lo=2, d_hi=6, d_score=2, d_out=1,
+              d_lazy=2, gossip_factor=0.25, backoff_ticks=8)
+    offsets = gs.make_gossip_offsets(2, 8, n, seed=3)
+    cfg = gs.GossipSimConfig(offsets=offsets, **kw)
+    sc = gs.ScoreSimConfig()
+    rng = np.random.default_rng(3)
+    idx = np.arange(n)
+    subs = np.zeros((n, 2), dtype=bool)
+    subs[idx, idx % 2] = True
+    topic = rng.integers(0, 2, 8)
+    origin = rng.integers(0, n // 2, 8) * 2 + topic
+    ticks = np.sort(rng.integers(0, 8, 8)).astype(np.int32)
+    specs = [dict(subs=subs, msg_topic=topic, msg_origin=origin,
+                  msg_publish_tick=ticks, seed=0,
+                  fault_schedule=_sched(n, seed=100 + r))
+             for r in range(B)]
+    params_b, state_b = gs.stack_sims(cfg, specs, score_cfg=sc)
+    out_b = gs.gossip_run_batch(params_b, state_b, 20,
+                                gs.make_gossip_step(cfg, sc))
+    step_k = gs.make_gossip_step(cfg, sc, receive_block=128,
+                                 receive_interpret=True)
+    for r in range(B):
+        p_k, s_k = gs.make_gossip_sim(cfg, pad_to_block=128,
+                                      score_cfg=sc, **specs[r])
+        out_k = gs.gossip_run(p_k, s_k, 20, step_k)
+        out_r = gs.index_trees(out_b, r)
+        np.testing.assert_array_equal(np.asarray(out_r.have),
+                                      np.asarray(out_k.have)[:, :n])
+        np.testing.assert_array_equal(np.asarray(out_r.mesh),
+                                      np.asarray(out_k.mesh)[:n])
+        np.testing.assert_array_equal(
+            np.asarray(out_r.scores.first_deliveries),
+            np.asarray(out_k.scores.first_deliveries)[:, :n])
+    # distinct fault seeds actually diverged the replicas
+    h = np.asarray(out_b.have)
+    assert (h[0] != h[1]).any() or (h[0] != h[2]).any()
+
+
+def test_kernel_zero_fault_schedule_bit_identical():
+    """A zero-fault schedule through the kernel == no schedule at all
+    (the masks are all-ones; masking with them is the identity) — the
+    kernel twin of the XLA pin in test_faults.py."""
+    import go_libp2p_pubsub_tpu.models.faults as fl
+
+    n = 900
+    empty = fl.FaultSchedule(n_peers=n, horizon=40)
+    cfg, sc, p_a, s_a = _build(n, 4, 8, 8, score=True, pad_block=128,
+                               faults=empty)
+    cfg2, sc2, p_b, s_b = _build(n, 4, 8, 8, score=True, pad_block=128)
+    step_f = gs.make_gossip_step(cfg, sc, receive_block=128,
+                                 receive_interpret=True)
+    step_0 = gs.make_gossip_step(cfg2, sc2, receive_block=128,
+                                 receive_interpret=True)
+    out_f = gs.gossip_run(p_a, s_a, 20, step_f)
+    out_0 = gs.gossip_run(p_b, s_b, 20, step_0)
+    for a, b in zip(__import__("jax").tree_util.tree_leaves(out_f),
+                    __import__("jax").tree_util.tree_leaves(out_0)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sharded_kernel_faults_telemetry():
+    """Faults + telemetry through the SHARDED kernel dispatch: the
+    per-peer mask operands shard like any blocked operand, the tel
+    tallies psum across the ring — state bit-identical to the
+    single-device kernel, int counters exact, float gauges within one
+    GSPMD-reduction ulp."""
+    import jax
+    from jax.sharding import Mesh
+    import go_libp2p_pubsub_tpu.models.telemetry as tl
+
+    n, D, block = 2048, 8, 128
+    sched = _sched(n, seed=7)
+    cfg, sc, p_k, s_k = _build(n, 4, 8, 8, score=True, pad_block=block,
+                               faults=sched)
+    assert p_k.subscribed.shape[0] == n          # n_pad == n_true
+    tcfg = tl.TelemetryConfig()
+    step_1 = gs.make_gossip_step(cfg, sc, receive_block=block,
+                                 receive_interpret=True, telemetry=tcfg)
+    mesh = Mesh(np.array(jax.devices("cpu")[:D]), ("peers",))
+    step_8 = gs.make_gossip_step(cfg, sc, receive_block=block,
+                                 receive_interpret=True,
+                                 shard_mesh=mesh, telemetry=tcfg)
+    out_1, fr_1 = tl.telemetry_run(p_k, gs.tree_copy(s_k), 12, step_1)
+    out_8, fr_8 = tl.telemetry_run(p_k, s_k, 12, step_8)
+    for a, b in zip(jax.tree_util.tree_leaves(out_1),
+                    jax.tree_util.tree_leaves(out_8)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    a1, a8 = tl.frames_to_arrays(fr_1), tl.frames_to_arrays(fr_8)
+    for name in a1:
+        if a1[name].dtype.kind == "i":
+            np.testing.assert_array_equal(a1[name], a8[name],
+                                          err_msg=name)
+        else:
+            # sharded float reductions use a different tree (per-shard
+            # partials + cross-device sum) — value-equal to ~1 ulp
+            np.testing.assert_allclose(a1[name], a8[name], rtol=1e-6,
+                                       err_msg=name)
+    assert a1["payload_sent"].sum() > 0
+    assert a1["down_peers"].max() > 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("variant", [
+    "paired", "paired_attacks", "px", "flood_publish", "direct",
+    "exact_k", "shared_ip"])
+def test_kernel_faults_telemetry_full_matrix(variant):
+    """@slow full sweep: every kernel feature variant under faults +
+    telemetry at once — states AND frames bit-identical to XLA."""
+    import go_libp2p_pubsub_tpu.models.telemetry as tl
+
+    tcfg = tl.TelemetryConfig()
+    if variant.startswith("paired"):
+        n = 928
+        kw = dict(score=True, faults=_sched(n, seed=11))
+        if variant == "paired_attacks":
+            kw.update(sybil_frac=0.15, spam=True, iwant_spam=True,
+                      invalid_frac=0.25)
+        cfg, sc, p_x, s_x = _build_paired(n, 4, 8, 10, **kw)
+        cfg2, sc2, p_k, s_k = _build_paired(n, 4, 8, 10, pad_block=128,
+                                            **kw)
+        out_x, fr_x = tl.telemetry_run(
+            p_x, s_x, 20, gs.make_gossip_step(cfg, sc, telemetry=tcfg))
+        out_k, fr_k = tl.telemetry_run(
+            p_k, s_k, 20, gs.make_gossip_step(
+                cfg2, sc2, receive_block=128, receive_interpret=True,
+                telemetry=tcfg))
+        _assert_state_equal(out_x, out_k, n, sc)
+        np.testing.assert_array_equal(np.asarray(out_x.mesh_b),
+                                      np.asarray(out_k.mesh_b)[:n])
+        _assert_frames_equal(fr_x, fr_k)
+        return
+    n = 900
+    kw = dict(score=True, faults=_sched(n, seed=13))
+    kw.update({"px": dict(px=7), "flood_publish": dict(flood_publish=True),
+               "direct": dict(direct=True), "exact_k": dict(exact_k=True),
+               "shared_ip": dict(shared_ip=True)}[variant])
+    if variant == "direct":
+        n = 928
+        kw["faults"] = _sched(n, seed=13)
+    cfg, sc, out_x, out_k, fr_x, fr_k = _run_pair(
+        n, 4, 8, 8, 25, 128, telemetry=tcfg, **kw)
+    _assert_state_equal(out_x, out_k, n, sc)
+    _assert_frames_equal(fr_x, fr_k)
+    if variant == "px":
+        np.testing.assert_array_equal(np.asarray(out_x.active),
+                                      np.asarray(out_k.active)[:n])

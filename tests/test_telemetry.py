@@ -165,16 +165,21 @@ def test_randomsub_state_identical_with_telemetry():
     assert arr["ihave_ids"].sum() == 0
 
 
-def test_pallas_step_refuses_telemetry():
-    """Kernel path: telemetry configs are refused outright (the same
-    contract as the fault-config refusal)."""
+def test_pallas_step_accepts_telemetry():
+    """Round 9: the kernel path accepts telemetry configs (in-kernel
+    counter tallies; frame parity is pinned in
+    tests/test_pallas_receive.py) — this pins acceptance where the
+    refusal used to be, and that the frames carry live counters."""
     cfg, subs, topic, origin, ticks = gossip_inputs()
     params, state = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
                                        pad_to_block=1024)
     step = gs.make_gossip_step(cfg, receive_block=1024,
+                               receive_interpret=True,
                                telemetry=tl.TelemetryConfig())
-    with pytest.raises(ValueError, match="telemetry is XLA-path only"):
-        step(params, state)
+    _, frames = tl.telemetry_run(params, state, 12, step)
+    arr = tl.frames_to_arrays(frames)
+    assert arr["payload_sent"].sum() > 0
+    assert arr["bytes_payload"].sum() > 0
 
 
 # --------------------------------------------------------------------------

@@ -342,3 +342,166 @@ def test_adjacent_churn_intervals_merge_in_trace():
     assert kinds == [(TraceType.LEAVE, b"sim-2", 3),
                      (TraceType.JOIN, b"sim-2", 20),
                      (TraceType.LEAVE, b"sim-5", 30)]
+
+
+# --------------------------------------------------------------------------
+# REJECT_MESSAGE / DUPLICATE_MESSAGE export (round 9: 2 more of the
+# reference's 13 event types; the telemetry counters measure them in
+# aggregate, these are the per-event streams)
+# --------------------------------------------------------------------------
+
+
+def test_reject_events_match_invalid_acquisitions():
+    """Every first acquisition of a validation-failing message is one
+    REJECT_MESSAGE event at the exact (peer, tick) — no more, no
+    less, and never for valid messages."""
+    from go_libp2p_pubsub_tpu.interop.export import reject_events
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        ScoreSimConfig, gossip_run_acq_snapshots)
+
+    n, t, m = 400, 2, 8
+    cfg = GossipSimConfig(offsets=make_gossip_offsets(t, 16, n, seed=4),
+                          n_topics=t)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    rng = np.random.default_rng(4)
+    topic = rng.integers(0, t, m)
+    origin = rng.integers(0, n // t, m) * t + topic
+    ticks = rng.integers(0, 8, m).astype(np.int32)
+    invalid = np.zeros(m, dtype=bool)
+    invalid[:3] = True
+    # sybil origins forward their own invalid publishes (honest peers
+    # drop invalid traffic before forwarding, so it would never move)
+    sybil = np.zeros(n, dtype=bool)
+    sybil[origin[:3]] = True
+    sc = ScoreSimConfig()
+    params, state = make_gossip_sim(
+        cfg, subs, topic, origin, ticks, score_cfg=sc, sybil=sybil,
+        msg_invalid=invalid)
+    out, snaps = gossip_run_acq_snapshots(
+        params, state, 20, make_gossip_step(cfg, sc))
+    have = np.asarray(snaps["have"])
+    events = reject_events(have, invalid, topic)
+    # ground truth straight from the possession words
+    got = {(e.peer_id, e.reject_message.message_id,
+            e.timestamp // 10**9) for e in events}
+    want = set()
+    prev = np.zeros_like(have[0])
+    for k in range(have.shape[0]):
+        new = have[k] & ~prev
+        for mm in np.flatnonzero(invalid):
+            w, b = divmod(int(mm), 32)
+            for p in np.flatnonzero((new[w] >> np.uint32(b)) & 1):
+                want.add((b"sim-%d" % p, b"msg-%d" % mm, k))
+        prev = have[k]
+    assert got == want and len(events) == len(got)   # no dup events
+    assert len(events) > 0                            # non-vacuous
+    assert all(e.type == TraceType.REJECT_MESSAGE for e in events)
+    valid_ids = {msg_id(int(j)) for j in range(m) if not invalid[j]}
+    assert not any(e.reject_message.message_id in valid_ids
+                   for e in events)
+
+
+def test_duplicate_events_match_telemetry_dup_counter():
+    """The eager-forward replay's per-tick DUPLICATE_MESSAGE count
+    EQUALS the telemetry seen-cache counter on a gossip-free,
+    fully-subscribed run — the per-event stream and the aggregate
+    counter are two views of the same quantity."""
+    import go_libp2p_pubsub_tpu.models.telemetry as tl
+    from go_libp2p_pubsub_tpu.interop.export import duplicate_events
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        gossip_run_acq_snapshots, tree_copy)
+
+    n, t, m = 400, 2, 8
+    # gossip disabled (d_lazy=0, factor=0): every received copy is an
+    # eager mesh forward, exactly the replay's model
+    cfg = GossipSimConfig(offsets=make_gossip_offsets(t, 16, n, seed=4),
+                          n_topics=t, d_lazy=0, gossip_factor=0.0)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    rng = np.random.default_rng(4)
+    topic = rng.integers(0, t, m)
+    origin = rng.integers(0, n // t, m) * t + topic
+    ticks = rng.integers(0, 8, m).astype(np.int32)
+    params, state = make_gossip_sim(cfg, subs, topic, origin, ticks)
+    n_ticks = 20
+    step_tel = make_gossip_step(cfg, telemetry=tl.TelemetryConfig(
+        wire=False, scores=False))
+    _, frames = tl.telemetry_run(params, tree_copy(state), n_ticks,
+                                 step_tel)
+    dup = np.asarray(tl.frames_to_arrays(frames)["dup_suppressed"])
+    out, snaps = gossip_run_acq_snapshots(params, state, n_ticks,
+                                          make_gossip_step(cfg))
+    events = duplicate_events(np.asarray(snaps["have"]),
+                              np.asarray(snaps["mesh"]),
+                              cfg.offsets, topic)
+    per_tick = np.zeros(n_ticks, dtype=np.int64)
+    for e in events:
+        assert e.type == TraceType.DUPLICATE_MESSAGE
+        assert e.duplicate_message.received_from.startswith(b"sim-")
+        per_tick[e.timestamp // 10**9] += 1
+    # tick 0 needs pre-run history the snapshots don't carry; every
+    # later tick's event count must equal the aggregate counter
+    np.testing.assert_array_equal(per_tick[1:], dup[1:])
+    assert per_tick.sum() > 0                         # non-vacuous
+
+
+def test_duplicate_events_paired_mode_matches_telemetry():
+    """Paired-topic runs: with mesh_b_snapshots + slot_b_words the
+    replay splits each sender's fresh set by topic slot and walks
+    BOTH meshes — per-tick event counts again equal the telemetry
+    seen-cache counter on a gossip-free run."""
+    import go_libp2p_pubsub_tpu.models.telemetry as tl
+    from go_libp2p_pubsub_tpu.interop.export import duplicate_events
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        gossip_run_acq_snapshots, tree_copy)
+
+    n, t, m = 400, 4, 8
+    cfg = GossipSimConfig(
+        offsets=make_gossip_offsets(t, 8, n, seed=4, paired=True),
+        n_topics=t, paired_topics=True, d=3, d_lo=2, d_hi=6,
+        d_score=2, d_out=1, d_lazy=0, gossip_factor=0.0)
+    own = np.arange(n) % t
+    second = (own + t // 2) % t
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), own] = True
+    subs[np.arange(n), second] = True
+    rng = np.random.default_rng(4)
+    topic = rng.integers(0, t, m)
+    members = [np.flatnonzero((own == tau) | (second == tau))
+               for tau in range(t)]
+    origin = np.array([rng.choice(members[tau]) for tau in topic])
+    ticks = rng.integers(0, 8, m).astype(np.int32)
+    params, state = make_gossip_sim(cfg, subs, topic, origin, ticks)
+    n_ticks = 20
+    step_tel = make_gossip_step(cfg, telemetry=tl.TelemetryConfig(
+        wire=False, scores=False))
+    _, frames = tl.telemetry_run(params, tree_copy(state), n_ticks,
+                                 step_tel)
+    dup = np.asarray(tl.frames_to_arrays(frames)["dup_suppressed"])
+    out, snaps = gossip_run_acq_snapshots(params, state, n_ticks,
+                                          make_gossip_step(cfg))
+    events = duplicate_events(
+        np.asarray(snaps["have"]), np.asarray(snaps["mesh"]),
+        cfg.offsets, topic,
+        mesh_b_snapshots=np.asarray(snaps["mesh_b"]),
+        slot_b_words=np.asarray(params.slot_b_words))
+    per_tick = np.zeros(n_ticks, dtype=np.int64)
+    for e in events:
+        per_tick[e.timestamp // 10**9] += 1
+    np.testing.assert_array_equal(per_tick[1:], dup[1:])
+    assert per_tick.sum() > 0
+    # omitting slot_b_words with mesh_b snapshots must refuse loudly
+    import pytest
+    with pytest.raises(ValueError, match="slot_b_words"):
+        duplicate_events(
+            np.asarray(snaps["have"]), np.asarray(snaps["mesh"]),
+            cfg.offsets, topic,
+            mesh_b_snapshots=np.asarray(snaps["mesh_b"]))
+    # ...and the mirror: slot_b_words without its mesh would silently
+    # drop every slot-B forward from the replay (undercount)
+    with pytest.raises(ValueError, match="mesh_b_snapshots"):
+        duplicate_events(
+            np.asarray(snaps["have"]), np.asarray(snaps["mesh"]),
+            cfg.offsets, topic,
+            slot_b_words=np.asarray(params.slot_b_words))

@@ -124,7 +124,10 @@ def _gossip_artifact(path, cfg_kw=None, *, n_topics=T, paired=False,
 
 def _telemetry_artifact(path, tel_kw=None):
     """jaxpr text of a telemetry-enabled step on one circulant path,
-    over a scored+faulted base sim (so every frame group is live)."""
+    over a scored+faulted base sim (so every frame group is live).
+    ``gossip-kernel`` traces the pallas path (padded build + mosaic
+    kernel in the jaxpr) — threading proof for the round-9 in-kernel
+    tallies."""
     import jax
     import go_libp2p_pubsub_tpu.models.floodsub as fs
     import go_libp2p_pubsub_tpu.models.gossipsub as gs
@@ -138,16 +141,20 @@ def _telemetry_artifact(path, tel_kw=None):
     tcfg = tl.TelemetryConfig(**(tel_kw or {}))
     subs, topic, origin, ticks = _inputs(T)
     sched = _fault_schedule()
-    if path == "gossip-xla":
+    if path in ("gossip-xla", "gossip-kernel"):
         cfg = gs.GossipSimConfig(
             offsets=gs.make_gossip_offsets(T, C, N, seed=1),
             n_topics=T, d=3, d_lo=2, d_hi=6, d_score=2, d_out=1,
             d_lazy=2, backoff_ticks=8)
         sc = gs.ScoreSimConfig()
+        sim_kw, step_kw = {}, {}
+        if path == "gossip-kernel":
+            sim_kw["pad_to_block"] = KERNEL_BLOCK
+            step_kw["receive_block"] = KERNEL_BLOCK
         params, state = gs.make_gossip_sim(
             cfg, subs, topic, origin, ticks, score_cfg=sc,
-            fault_schedule=sched)
-        step = gs.make_gossip_step(cfg, sc, telemetry=tcfg)
+            fault_schedule=sched, **sim_kw)
+        step = gs.make_gossip_step(cfg, sc, telemetry=tcfg, **step_kw)
     elif path == "flood-circulant":
         offs = tuple(int(o) for o in
                      make_circulant_offsets(T, C, N, seed=1))
@@ -172,7 +179,8 @@ def _telemetry_artifact(path, tel_kw=None):
 def _faults_artifact(path, sched_kw=None):
     """Build leaves of a faulted sim's params on one circulant path
     (FaultParams ride the params, so value diffs prove threading
-    without a trace)."""
+    without a trace).  ``gossip-kernel`` builds the PADDED sim — the
+    round-9 kernel path carries the same FaultParams leaves."""
     import jax
     import numpy as np
     import go_libp2p_pubsub_tpu.models.floodsub as fs
@@ -185,12 +193,14 @@ def _faults_artifact(path, sched_kw=None):
         sched_kw["partition_group"] = (np.arange(N) % 4).astype(np.int32)
     sched = _fault_schedule(**sched_kw)
     subs, topic, origin, ticks = _inputs(T)
-    if path == "gossip-xla":
+    if path in ("gossip-xla", "gossip-kernel"):
         cfg = gs.GossipSimConfig(
             offsets=gs.make_gossip_offsets(T, C, N, seed=1),
             n_topics=T, d=3, d_lo=2, d_hi=6, d_score=2, d_out=1)
-        params, _ = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
-                                       fault_schedule=sched)
+        params, _ = gs.make_gossip_sim(
+            cfg, subs, topic, origin, ticks, fault_schedule=sched,
+            pad_to_block=(KERNEL_BLOCK if path == "gossip-kernel"
+                          else None))
     elif path == "flood-circulant":
         offs = tuple(int(o) for o in
                      make_circulant_offsets(T, C, N, seed=1))
@@ -313,32 +323,6 @@ def _fault_threaded(field, path):
 # -- refusal probes (one per (class, path)) --------------------------------
 
 
-def _refuse_gossip_kernel_telemetry():
-    import jax
-    import go_libp2p_pubsub_tpu.models.gossipsub as gs
-    import go_libp2p_pubsub_tpu.models.telemetry as tl
-    cfg = gs.GossipSimConfig(
-        offsets=gs.make_gossip_offsets(T, C, N, seed=1), n_topics=T,
-        d=3, d_lo=2, d_hi=6, d_score=2, d_out=1)
-    subs, topic, origin, ticks = _inputs(T)
-    params, state = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
-                                       pad_to_block=KERNEL_BLOCK)
-    step = gs.make_gossip_step(cfg, receive_block=KERNEL_BLOCK,
-                               telemetry=tl.TelemetryConfig())
-    jax.eval_shape(step, params, state)    # must raise ValueError
-
-
-def _refuse_gossip_kernel_faults():
-    import go_libp2p_pubsub_tpu.models.gossipsub as gs
-    cfg = gs.GossipSimConfig(
-        offsets=gs.make_gossip_offsets(T, C, N, seed=1), n_topics=T,
-        d=3, d_lo=2, d_hi=6, d_score=2, d_out=1)
-    subs, topic, origin, ticks = _inputs(T)
-    gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
-                       fault_schedule=_fault_schedule(),
-                       pad_to_block=KERNEL_BLOCK)   # must raise
-
-
 def _refuse_flood_gather_faults():
     import numpy as np
     import go_libp2p_pubsub_tpu.models.floodsub as fs
@@ -380,14 +364,13 @@ def _refuse_by_api(entry_point_name):
 #: raised ValueError is THE refusal, not an incidental one — an
 #: unrelated validation error must not vacuously satisfy the contract
 _REFUSALS = {
-    ("TelemetryConfig", "gossip-kernel"):
-        (_refuse_gossip_kernel_telemetry, r"telemetry is XLA-path only"),
+    # gossip-kernel entries removed in round 9: the kernel path now
+    # THREADS faults and telemetry (see the *_artifact kernel paths);
+    # a still-refused-but-now-accepted declaration would be a finding
     ("TelemetryConfig", "flood-gather"):
         (_refuse_by_api("flood_step"), r"refused by API"),
     ("TelemetryConfig", "randomsub-dense"):
         (_refuse_by_api("make_randomsub_dense_step"), r"refused by API"),
-    ("FaultSchedule", "gossip-kernel"):
-        (_refuse_gossip_kernel_faults, r"refuses fault configs"),
     ("FaultSchedule", "flood-gather"):
         (_refuse_flood_gather_faults, r"circulant topologies only"),
     ("FaultSchedule", "randomsub-dense"):

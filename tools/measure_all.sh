@@ -128,6 +128,10 @@ run 2700 python bench_suite.py gossipsub_v10 gossipsub_v11_multitopic \
 run 2700 env GOSSIP_BENCH_KERNEL=1 python bench_suite.py gossipsub_v11 \
     gossipsub_v11_adversarial gossipsub_v11_multitopic \
     gossipsub_v11_everything
+# 4b. faulted + observed runs on the kernel path (round 9): the
+# kernel-path fault-mask and telemetry overheads, measured on mosaic
+run 2700 python bench_suite.py gossipsub_v11_churn_kernel \
+    gossipsub_telemetry_kernel
 # 5. GSPMD overhead + diagnostics
 run 1800 python tools/bench_sharded.py
 run 1800 python tools/bench_micro.py 1000000 100

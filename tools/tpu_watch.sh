@@ -16,6 +16,30 @@ if ! flock -n 9; then
 fi
 log=/tmp/tpu_watch.log
 port="${AXON_PROBE_PORT:-8082}"
+
+# PERF_NOTES operational note #2: background probe loops OUTLIVE their
+# shell wrappers — a stale `jax.devices()` probe left over from a dead
+# watcher is a live TPU client, and two concurrent clients wedge the
+# axon tunnel.  Hunt them down (ps match on the probe command) before
+# starting ANY new TPU client of our own.  Only the known probe
+# command is targeted — never arbitrary python/jax processes (a
+# measurement pass mid-flight must not be SIGTERM'd, note #2's other
+# lesson).
+hunt_stale_probes() {
+  local pids pid
+  pids=$(ps -eo pid=,args= \
+         | grep -F 'import jax; print(jax.devices())' \
+         | grep -v grep | awk '{print $1}')
+  for pid in $pids; do
+    [ "$pid" = "$$" ] && continue
+    echo "[watch] killing stale TPU probe pid $pid (pre-client hunt," \
+         "op-note #2)" | tee -a "$log"
+    kill "$pid" 2>/dev/null
+  done
+  if [ -n "$pids" ]; then
+    sleep 2   # give the dying client a beat to release its grant
+  fi
+}
 # hard stop for ALL watcher TPU activity (probes included): leave the
 # chip free for the driver's own end-of-round bench run
 export MEASURE_DEADLINE="${MEASURE_DEADLINE:-$(date -d '2026-07-31 14:10 UTC' +%s)}"
@@ -37,10 +61,12 @@ while true; do
   if (exec 3<>/dev/tcp/127.0.0.1/"$port") 2>/dev/null; then
     exec 3>&- 3<&- 2>/dev/null
     echo "[watch] attempt $n: port open $(date -u +%H:%M:%S)" | tee -a "$log"
+    hunt_stale_probes
     if timeout -k 10 300 python -c "import jax; print(jax.devices())" \
         >>"$log" 2>&1; then
       echo "[watch] backend up — running measure_all $(date -u +%H:%M:%S)" \
         | tee -a "$log"
+      hunt_stale_probes   # measure_all is a new TPU client too
       touch /tmp/measure_pass_start
       bash tools/measure_all.sh >>"$log" 2>&1
       mrc=$?

@@ -724,6 +724,130 @@ def bench_gossipsub_telemetry():
          extra={k: round(v, 1) for k, v in tel_totals.items()})
 
 
+def _trace_export_run(kernel: bool):
+    """Shared body of the trace-export benches: one faulted 100k-peer
+    gossipsub run (publish burst + mesh formation inside the probe
+    window), all six exporter streams -> the 13-type merged trace,
+    written in the reference pb format.  Returns row extras.
+
+    Artifacts land at /tmp/gossipsub_trace_export.pb and
+    /tmp/gossipsub_trace_export_frames.json — measure_all.sh runs
+    ``tracestat --check OBS_r10.json`` over them right after this
+    bench (the committed baseline; both execution paths produce the
+    SAME trace bit-for-bit, so the gate is path-independent)."""
+    import jax
+    import go_libp2p_pubsub_tpu.models.faults as fl
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    import go_libp2p_pubsub_tpu.models.telemetry as tl
+    from go_libp2p_pubsub_tpu.interop import export as ex
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    n, t, m, C = 100_000, 100, 16, 16
+    T, T_rpc = 6, 2
+    rng = np.random.default_rng(0)
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, C, n, seed=0), n_topics=t)
+    sc = gs.ScoreSimConfig()
+    topic, origin, tick = _msgs(rng, n, t, m, 4)
+    subs = _subs_matrix(n, t)
+    # two sybil origins publish validation-failing traffic so the
+    # exported stream carries REJECT_MESSAGE — full 13/13 coverage in
+    # the committed OBS_r10.json ratchet
+    invalid = np.zeros(m, dtype=bool)
+    invalid[:2] = True
+    sybil = np.zeros(n, dtype=bool)
+    sybil[origin[:2]] = True
+    victims = np.flatnonzero(rng.random(n) < 0.002)
+    sched = fl.FaultSchedule(
+        n_peers=n, horizon=T,
+        down_intervals=[(int(p), 1 + int(p % 2), 4 + int(p % 2))
+                        for p in victims],
+        drop_prob=0.02, seed=1)
+    peer_topic = (np.arange(n) % t).astype(np.int64)
+    kw = dict(pad_to_block=128) if kernel else {}
+    step_kw = (dict(receive_block=128,
+                    receive_interpret=not on_accel) if kernel
+               else dict(use_pallas_receive=False))
+    params, state = gs.make_gossip_sim(
+        cfg, subs, topic, origin, tick, score_cfg=sc, sybil=sybil,
+        msg_invalid=invalid, fault_schedule=sched, **kw)
+    params = jax.device_put(params)
+    state = jax.device_put(state)
+    tcfg = tl.TelemetryConfig(latency_hist=True, latency_buckets=16)
+    t0 = time.perf_counter()
+    out, counts, frames = tl.telemetry_run_curve(
+        params, gs.tree_copy(state), T,
+        gs.make_gossip_step(cfg, sc, telemetry=tcfg, **step_kw), m)
+    _, snaps = gs.gossip_run_acq_snapshots(
+        params, gs.tree_copy(state), T,
+        gs.make_gossip_step(cfg, sc, **step_kw))
+    _, rsnaps = gs.gossip_run_rpc_snapshots(
+        params, state, T_rpc,
+        gs.make_gossip_step(cfg, sc, rpc_probe=True, **step_kw))
+    have_s = np.asarray(snaps["have"])[:, :, :n]
+    mesh_s = np.asarray(snaps["mesh"])[:, :n]
+    rsnaps = {k: np.asarray(v) for k, v in rsnaps.items()}
+    collect_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    ftm = np.asarray(gs.first_tick_matrix(out, m))[:n]
+    merged = ex.merge_event_streams(
+        ex.events_from_sim(ftm, topic, origin, tick,
+                           fault_schedule=sched,
+                           peer_topic=peer_topic),
+        ex.mesh_trace_events(mesh_s, cfg.offsets, peer_topic),
+        ex.reject_events(have_s, invalid, topic),
+        ex.duplicate_events(have_s, mesh_s, cfg.offsets, topic),
+        ex.peer_events(cfg.offsets, n, fault_schedule=sched),
+        ex.rpc_events(rsnaps, cfg.offsets, topic, peer_topic,
+                      n_true=n))
+    export_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    path = "/tmp/gossipsub_trace_export.pb"
+    ex.write_pb_trace(path, merged)
+    ex.write_telemetry_frames(
+        "/tmp/gossipsub_trace_export_frames.json", frames, tcfg,
+        counts=np.asarray(counts), publish_tick=tick, msg_topic=topic)
+    write_s = time.perf_counter() - t0
+    n_events = len(merged)
+    n_bytes = os.path.getsize(path)
+    types = {e.type for e in merged}
+    assert len(types) == 13, f"only {len(types)} event types"
+    return dict(n_events=n_events, bytes_total=n_bytes,
+                collect_s=round(collect_s, 2),
+                export_s=round(export_s, 2),
+                write_s=round(write_s, 2))
+
+
+def bench_gossipsub_trace_export():
+    """Full-fidelity trace pipeline cost at 100k peers (round 10):
+    device collection (telemetry frames + acq/mesh snapshots + the
+    per-edge RPC probe) then the host-side 13-type export, measured
+    as events/sec and bytes/event in the reference pb format."""
+    x = _trace_export_run(kernel=False)
+    name = "gossipsub_trace_export_100000peers"
+    dt = x["export_s"] + x["write_s"]
+    emit(f"{name}_events_per_sec", x["n_events"] / dt, "events/s",
+         extra=x)
+    emit(f"{name}_bytes_per_event",
+         x["bytes_total"] / x["n_events"], "bytes/event")
+
+
+def bench_gossipsub_trace_export_kernel():
+    """Kernel twin of gossipsub_trace_export (alias_of-paired like the
+    round-9 rows): the same collectors and host export with the sim
+    advanced by the pallas receive path — proving the fast path feeds
+    the full trace pipeline, and costing its collection side."""
+    x = _trace_export_run(kernel=True)
+    name = "gossipsub_trace_export_100000peers"
+    dt = x["export_s"] + x["write_s"]
+    emit(f"{name}_events_per_sec_kernel", x["n_events"] / dt,
+         "events/s", extra={**x, "alias_of": f"{name}_events_per_sec"})
+    emit(f"{name}_bytes_per_event_kernel",
+         x["bytes_total"] / x["n_events"], "bytes/event",
+         extra={"alias_of": f"{name}_bytes_per_event"})
+
+
 BENCHES = {
     "floodsub_hosts": bench_floodsub_hosts,
     "randomsub_10k": bench_randomsub_10k,
@@ -737,6 +861,8 @@ BENCHES = {
     "gossipsub_v11_churn_kernel": bench_gossipsub_v11_churn_kernel,
     "gossipsub_telemetry": bench_gossipsub_telemetry,
     "gossipsub_telemetry_kernel": bench_gossipsub_telemetry_kernel,
+    "gossipsub_trace_export": bench_gossipsub_trace_export,
+    "gossipsub_trace_export_kernel": bench_gossipsub_trace_export_kernel,
 }
 
 

@@ -8,9 +8,12 @@ into PUBLISH_MESSAGE / DELIVER_MESSAGE TraceEvents and writes them in the
 exact format of the core's sinks: ndjson (NewJSONTracer) or
 varint-delimited protobuf (NewPBTracer, reference tracer.go:85,137).
 Churn schedules add JOIN/LEAVE, mesh-snapshot diffs add GRAFT/PRUNE
-(mesh_trace_events), and possession-snapshot diffs/replays add
+(mesh_trace_events), possession-snapshot diffs/replays add
 REJECT_MESSAGE / DUPLICATE_MESSAGE (reject_events /
-duplicate_events) — 8 of the 13 reference event types.
+duplicate_events), topology + churn add ADD_PEER / REMOVE_PEER
+(peer_events), and the round-10 per-edge RPC probe snapshots
+reconstruct the SEND_RPC / RECV_RPC / DROP_RPC metadata streams
+(rpc_events) — ALL 13 reference event types.
 
 Synthetic identities: sim peer i gets peer id ``b"sim-%d" % i``; message
 m gets id ``b"msg-%d" % m``; tick t maps to timestamp t * 1e9 ns (one
@@ -392,6 +395,288 @@ def duplicate_events(have_snapshots: np.ndarray,
                                         int(msg_topic[m])))))
                 already[w] = already[w] | copy_w
     return out
+
+
+def peer_events(offsets, n: int, fault_schedule=None,
+                proto: str = "/meshsub/1.1.0"):
+    """Topology + churn -> ADD_PEER / REMOVE_PEER TraceEvents
+    (reference trace.proto types 4/5 — the host's connection events,
+    pubsub.go:268-320).
+
+    The sim's circulant candidate graph IS its connection set: at tick
+    0 every live peer ADD_PEERs each live candidate partner.  Churn
+    (``fault_schedule`` down intervals, adjacent intervals merged like
+    churn_events) maps to connection loss: when p goes down, every
+    live partner emits REMOVE_PEER for p (p itself is off and traces
+    nothing); when p comes back, both directions re-ADD.  Two peers
+    rejoining the same tick dedupe to one event per (observer,
+    subject).  Returned in tick order."""
+    offs = tuple(int(o) for o in offsets)
+
+    merged: dict[int, list[list[int]]] = {}
+    if fault_schedule is not None:
+        for p, s, e in fault_schedule.down_intervals:
+            lst = merged.setdefault(int(p), [])
+            if lst and lst[-1][1] == s:
+                lst[-1][1] = e
+            else:
+                lst.append([int(s), int(e)])
+
+    def alive_at(p: int, t: int) -> bool:
+        return not any(s <= t < e for s, e in merged.get(p, ()))
+
+    items = []         # (tick, kind 0=add 1=remove, observer, subject)
+    seen = set()
+
+    def emit(t, kind, obs, subj):
+        key = (t, kind, obs, subj)
+        if key not in seen:
+            seen.add(key)
+            items.append(key)
+
+    for p in range(n):
+        if not alive_at(p, 0):
+            continue
+        for o in offs:
+            q = (p + o) % n
+            if q != p and alive_at(q, 0):
+                emit(0, 0, p, q)
+    for p, ivs in merged.items():
+        for s, e in ivs:
+            if s > 0:          # down from tick 0 = never connected
+                for o in offs:
+                    q = (p + o) % n
+                    if q != p and alive_at(q, s):
+                        emit(s, 1, q, p)
+            if fault_schedule is not None and e < fault_schedule.horizon:
+                for o in offs:
+                    q = (p + o) % n
+                    if q != p and alive_at(q, e):
+                        emit(e, 0, p, q)
+                        emit(e, 0, q, p)
+    items.sort()
+    out = []
+    for t, kind, obs, subj in items:
+        if kind == 0:
+            out.append(tr.TraceEvent(
+                type=TraceType.ADD_PEER, peer_id=peer_id(obs),
+                timestamp=t * NS_PER_TICK,
+                add_peer=tr.AddPeerEv(peer_id=peer_id(subj),
+                                      proto=proto)))
+        else:
+            out.append(tr.TraceEvent(
+                type=TraceType.REMOVE_PEER, peer_id=peer_id(obs),
+                timestamp=t * NS_PER_TICK,
+                remove_peer=tr.RemovePeerEv(peer_id=peer_id(subj))))
+    return out
+
+
+def _ids_of(words_col: np.ndarray, n_msgs: int) -> list[int]:
+    """Set bit positions of one peer's [W] possession column."""
+    out = []
+    for w, word in enumerate(words_col):
+        word = int(word)
+        while word:
+            b = (word & -word).bit_length() - 1
+            m = w * 32 + b
+            if m < n_msgs:
+                out.append(m)
+            word &= word - 1
+    return out
+
+
+def rpc_events(rpc_snaps: dict, offsets, msg_topic: np.ndarray,
+               peer_topic: np.ndarray, start_tick: int = 0,
+               n_true: int | None = None,
+               topic_name=lambda t: f"topic-{t}"):
+    """Per-edge RPC probe snapshots -> SEND_RPC / RECV_RPC / DROP_RPC
+    TraceEvents with full RPCMeta (reference trace.proto types 6/7/8).
+
+    ``rpc_snaps``: the dict gossip_run_rpc_snapshots collected (step
+    built with rpc_probe=True) — per-tick ATTEMPT masks (eager
+    forward, IHAVE, GRAFT, PRUNE), content words, and fault masks.
+
+    RPC model (mirrors the sim's one-tick window and the reference's
+    per-peer RPC coalescing, gossipsub.go sendRPC/flush):
+
+    - Each attempted directed edge-tick (p -> q) with any payload or
+      control carries ONE RPC: meta.messages = p's fresh forwards (on
+      mesh/fanout edges), meta.control.ihave = the merged advert (on
+      gossip-target edges), meta.control.graft/prune = the handshake.
+    - A dead sender attempts nothing (the node is off — no events,
+      like the reference's stopped host).
+    - An alive sender on a fault-masked edge (link down, or the
+      partner dead) emits DROP_RPC with the same meta — the RPC that
+      left the router and died on the wire (the reference's DropRPC,
+      tracer.go:Drop on a full/closed outbound queue).
+    - A healthy edge emits SEND_RPC at p and RECV_RPC at q.  If the
+      RPC carried an IHAVE advertising ids q lacks, q responds with an
+      IWANT RPC (reverse SEND/RECV, same tick — the link is up and
+      symmetric), and p serves the requested ids as a payload RPC
+      unless it is a withholding spammer (the broken-promise gap).
+
+    On a fault-free unscored run the stream's aggregate counts equal
+    the telemetry counters exactly (messages == payload_sent +
+    iwant_ids_served, ihave/iwant ids and RPC counts, graft/prune
+    sends; pinned by tests/test_trace_export.py)."""
+    offs = tuple(int(o) for o in offsets)
+    fwd = np.asarray(rpc_snaps["fwd"])
+    ihave = np.asarray(rpc_snaps["ihave"])
+    graft = np.asarray(rpc_snaps["graft"])
+    prune = np.asarray(rpc_snaps["prune"])
+    withhold = np.asarray(rpc_snaps["withhold"])
+    send_ok = np.asarray(rpc_snaps["send_ok"])
+    alive = np.asarray(rpc_snaps["alive"])
+    fresh = np.asarray(rpc_snaps["fresh"])
+    adv = np.asarray(rpc_snaps["adv"])
+    seen = np.asarray(rpc_snaps["seen"])
+    t_ticks = fwd.shape[0]
+    n = fwd.shape[1] if n_true is None else n_true
+    n_msgs = len(msg_topic)
+
+    def msg_metas(ids):
+        return [tr.MessageMeta(message_id=msg_id(m),
+                               topic=topic_name(int(msg_topic[m])))
+                for m in ids]
+
+    out = []
+    for k in range(t_ticks):
+        ts = (start_tick + k) * NS_PER_TICK
+        fresh_any = np.zeros(n, dtype=bool)
+        adv_any = np.zeros(n, dtype=bool)
+        for w in range(fresh.shape[1]):
+            fresh_any |= fresh[k, w, :n] != 0
+            adv_any |= adv[k, w, :n] != 0
+        for c, off in enumerate(offs):
+            bit = np.uint32(1) << np.uint32(c)
+            f_e = ((fwd[k, :n] & bit) != 0) & fresh_any
+            ih_e = ((ihave[k, :n] & bit) != 0) & adv_any
+            g_e = (graft[k, :n] & bit) != 0
+            p_e = (prune[k, :n] & bit) != 0
+            attempted = (f_e | ih_e | g_e | p_e) & alive[k, :n]
+            for p in np.flatnonzero(attempted):
+                p = int(p)
+                q = (p + off) % n
+                msgs = (_ids_of(fresh[k, :, p], n_msgs)
+                        if f_e[p] else [])
+                ctl_kw = {}
+                if ih_e[p]:
+                    ctl_kw["ihave"] = [tr.ControlIHaveMeta(
+                        topic=topic_name(int(peer_topic[p])),
+                        message_ids=[msg_id(m) for m in _ids_of(
+                            adv[k, :, p], n_msgs)])]
+                if g_e[p]:
+                    ctl_kw["graft"] = [tr.ControlGraftMeta(
+                        topic=topic_name(int(peer_topic[p])))]
+                if p_e[p]:
+                    ctl_kw["prune"] = [tr.ControlPruneMeta(
+                        topic=topic_name(int(peer_topic[p])))]
+                meta = tr.RPCMeta(
+                    messages=msg_metas(msgs),
+                    control=(tr.ControlMeta(**ctl_kw) if ctl_kw
+                             else None))
+                ok = bool(((send_ok[k, p] & bit) != 0)
+                          and alive[k, q])
+                if not ok:
+                    out.append(tr.TraceEvent(
+                        type=TraceType.DROP_RPC, peer_id=peer_id(p),
+                        timestamp=ts,
+                        drop_rpc=tr.DropRPCEv(send_to=peer_id(q),
+                                              meta=meta)))
+                    continue
+                out.append(tr.TraceEvent(
+                    type=TraceType.SEND_RPC, peer_id=peer_id(p),
+                    timestamp=ts,
+                    send_rpc=tr.SendRPCEv(send_to=peer_id(q),
+                                          meta=meta)))
+                out.append(tr.TraceEvent(
+                    type=TraceType.RECV_RPC, peer_id=peer_id(q),
+                    timestamp=ts,
+                    recv_rpc=tr.RecvRPCEv(received_from=peer_id(p),
+                                          meta=meta)))
+                if ih_e[p]:
+                    lack = _lack_ids(adv[k, :, p], seen[k, :, q],
+                                     n_msgs)
+                    if lack:
+                        iw_meta = tr.RPCMeta(control=tr.ControlMeta(
+                            iwant=[tr.ControlIWantMeta(
+                                message_ids=[msg_id(m)
+                                             for m in lack])]))
+                        out.append(tr.TraceEvent(
+                            type=TraceType.SEND_RPC,
+                            peer_id=peer_id(q), timestamp=ts,
+                            send_rpc=tr.SendRPCEv(
+                                send_to=peer_id(p), meta=iw_meta)))
+                        out.append(tr.TraceEvent(
+                            type=TraceType.RECV_RPC,
+                            peer_id=peer_id(p), timestamp=ts,
+                            recv_rpc=tr.RecvRPCEv(
+                                received_from=peer_id(q),
+                                meta=iw_meta)))
+                        if not withhold[k, p]:
+                            sv_meta = tr.RPCMeta(
+                                messages=msg_metas(lack))
+                            out.append(tr.TraceEvent(
+                                type=TraceType.SEND_RPC,
+                                peer_id=peer_id(p), timestamp=ts,
+                                send_rpc=tr.SendRPCEv(
+                                    send_to=peer_id(q),
+                                    meta=sv_meta)))
+                            out.append(tr.TraceEvent(
+                                type=TraceType.RECV_RPC,
+                                peer_id=peer_id(q), timestamp=ts,
+                                recv_rpc=tr.RecvRPCEv(
+                                    received_from=peer_id(p),
+                                    meta=sv_meta)))
+    return out
+
+
+def _lack_ids(adv_col: np.ndarray, seen_col: np.ndarray,
+              n_msgs: int) -> list[int]:
+    """Ids advertised in ``adv_col`` [W] that ``seen_col`` [W] lacks."""
+    return _ids_of(np.asarray(
+        [np.uint32(a) & ~np.uint32(s)
+         for a, s in zip(adv_col, seen_col)]), n_msgs)
+
+
+def write_telemetry_frames(path: str, frames, tcfg,
+                           counts=None, publish_tick=None,
+                           msg_topic=None, start_tick: int = 0) -> None:
+    """JSON histogram-frames sidecar for ``tools/tracestat.py
+    --frames`` — the device-side latency distribution a trace file
+    cannot carry (the trace has per-event latencies, but at scale only
+    the histogram ships).
+
+    ``frames`` must come from a latency_hist-enabled telemetry run.
+    With ``counts`` (per-tick delivered counts, [T, M]) plus the
+    publish table, the exact per-topic split is added host-side
+    (models/telemetry.latency_hists_by_topic)."""
+    from ..models import telemetry as _tl
+
+    arrs = _tl.frames_to_arrays(frames)
+    if "latency_hist" not in arrs:
+        raise ValueError(
+            "write_telemetry_frames: frames carry no latency_hist — "
+            "run with TelemetryConfig(latency_hist=True)")
+    per_tick = arrs["latency_hist"].reshape(
+        -1, arrs["latency_hist"].shape[-1])
+    obj = {
+        "ns_per_tick": NS_PER_TICK,
+        "latency_buckets": int(tcfg.latency_buckets),
+        "latency_hist": [int(c) for c in per_tick.sum(axis=0)],
+        "latency_hist_per_tick": [[int(c) for c in row]
+                                  for row in per_tick],
+    }
+    if counts is not None:
+        if publish_tick is None or msg_topic is None:
+            raise ValueError(
+                "write_telemetry_frames: counts needs publish_tick "
+                "and msg_topic for the per-topic split")
+        obj["latency_hist_by_topic"] = _tl.latency_hists_by_topic(
+            counts, publish_tick, msg_topic, tcfg.latency_buckets,
+            start_tick=start_tick)
+    with open(path, "w") as f:
+        json.dump(obj, f)
 
 
 def merge_event_streams(*streams):

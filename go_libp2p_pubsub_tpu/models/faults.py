@@ -57,8 +57,12 @@ The pallas receive kernel honors fault masks too (round 9): the
 per-tick alive/link words thread through its VMEM pass — sender-side
 masking rides the ctrl bytes, the receiver-alive word is one extra
 [N] operand (ops/pallas/receive.py) — so faulted runs take the fast
-path at hardware scale.  The floodsub gather and randomsub dense
-paths still refuse fault configs (their builders raise).
+path at hardware scale.  Round 10 closes the last two gaps: the
+floodsub GATHER table path (compile_faults_gather) and the randomsub
+DENSE all-pairs path (compile_faults_dense) thread schedules too,
+with per-undirected-pair canonical-hash link coins replacing the
+circulant positive-bit-transfer symmetrization (scalar drop_prob
+only — the per-edge [C, N] form is keyed to circulant offsets).
 """
 
 from __future__ import annotations
@@ -66,21 +70,26 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import ClassVar
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
-from ..ops.graph import lane_uniform, pack_rows
+from ..ops.graph import _fmix32, lane_seed, lane_uniform, pack_rows
 
 __all__ = [
     "FaultSchedule",
     "FaultParams",
     "compile_faults",
+    "compile_faults_gather",
+    "compile_faults_dense",
     "alive_mask",
     "alive_word",
     "cand_alive_bits",
     "link_ok_bits",
     "link_ok_rows",
+    "link_ok_gather",
+    "link_ok_dense",
 ]
 
 
@@ -119,20 +128,22 @@ class FaultSchedule:
     seed: int = 0
 
     # Machine-readable thread-or-refuse contract (verified by
-    # tools/graftlint/contracts.py).  Fault data is "threaded" on the
-    # three circulant XLA paths AND the pallas kernel path (compiled
-    # into FaultParams device arrays riding the padded build, proven
-    # by build/jaxpr diff under a probe schedule) and "refused" on the
-    # gather / dense paths (the builders raise, proven by reject
-    # probes).  n_peers/horizon are host-side validation bounds
-    # ("build-time", proven by reject probes naming the bad field).
+    # tools/graftlint/contracts.py).  Fault data is "threaded" on
+    # EVERY execution path since round 10: the three circulant XLA
+    # paths and the pallas kernel (compile_faults), the flood GATHER
+    # table path (compile_faults_gather — canonical-pair link coins +
+    # baked [N, K] crossing slots), and the randomsub DENSE all-pairs
+    # path (compile_faults_dense — same coins over (p, q), raw group
+    # assignment).  Proven by build/jaxpr diff under a probe schedule.
+    # n_peers/horizon are host-side validation bounds ("build-time",
+    # proven by reject probes naming the bad field).
     PATHS: ClassVar[tuple[str, ...]] = (
         "gossip-xla", "gossip-kernel", "flood-circulant",
         "flood-gather", "randomsub-circulant", "randomsub-dense")
     _THREADED: ClassVar[dict[str, str]] = {
         "gossip-xla": "threaded", "flood-circulant": "threaded",
         "randomsub-circulant": "threaded", "gossip-kernel": "threaded",
-        "flood-gather": "refused", "randomsub-dense": "refused"}
+        "flood-gather": "threaded", "randomsub-dense": "threaded"}
     CONTRACT: ClassVar[dict[str, object]] = {
         "n_peers": "build-time",
         "horizon": "build-time",
@@ -245,10 +256,17 @@ class FaultParams:
     drop_prob: jnp.ndarray | None = None   # f32 [] or [C, N]
     cross_bits: jnp.ndarray | None = None  # uint32 [N] partition-crossing
     #   edges (C <= 32 packed form) — exactly one of cross_bits /
-    #   cross_rows is set when partitions are active
+    #   cross_rows / cross_nk / group is set when partitions are active
     cross_rows: jnp.ndarray | None = None  # bool [C, N] unpacked form
     part_start: jnp.ndarray | None = None  # int32 [P]
     part_end: jnp.ndarray | None = None    # int32 [P]
+    # round 10: the non-circulant paths' forms.  cross_nk marks
+    # partition-crossing slots of a gather table (flood_step's nbrs),
+    # group carries the raw assignment for the dense all-pairs path
+    # (randomsub MXU), whose crossing mask is an [N, N] compare
+    # generated on the fly.
+    cross_nk: jnp.ndarray | None = None    # bool [N, K] (gather tables)
+    group: jnp.ndarray | None = None       # int32 [N] (dense all-pairs)
 
 
 # lane_uniform phase for the per-tick link draws.  Must stay disjoint
@@ -284,14 +302,7 @@ def compile_faults(schedule: FaultSchedule, offsets,
     if pack_links and C > 32:
         raise ValueError("pack_links needs C <= 32")
 
-    k = schedule.max_down_intervals
-    down_start = np.zeros((n, k), dtype=np.int32)
-    down_end = np.zeros((n, k), dtype=np.int32)   # start==end: empty slot
-    fill = np.zeros(n, dtype=np.int64)
-    for p, s, e in schedule.down_intervals:
-        down_start[p, fill[p]] = s
-        down_end[p, fill[p]] = e
-        fill[p] += 1
+    down_start, down_end = _down_tables(schedule)
 
     kw = {}
     dp = schedule.drop_prob
@@ -415,6 +426,146 @@ def link_ok_bits(fp: FaultParams, offsets, cinv, tick,
         drop = drop | jnp.where(_partition_active(fp, tick),
                                 fp.cross_bits, jnp.uint32(0))
     return ~drop & ALL
+
+
+def _down_tables(schedule: FaultSchedule):
+    import numpy as np
+    k = schedule.max_down_intervals
+    n = schedule.n_peers
+    down_start = np.zeros((n, k), dtype=np.int32)
+    down_end = np.zeros((n, k), dtype=np.int32)
+    fill = np.zeros(n, dtype=np.int64)
+    for p, s, e in schedule.down_intervals:
+        down_start[p, fill[p]] = s
+        down_end[p, fill[p]] = e
+        fill[p] += 1
+    return down_start, down_end
+
+
+def _scalar_drop(schedule: FaultSchedule, path: str):
+    dp = schedule.drop_prob
+    if isinstance(dp, np.ndarray):
+        raise ValueError(
+            f"drop_prob: the per-edge [C, N] form needs circulant "
+            f"offsets; the {path} path draws per-undirected-edge "
+            "coins from a canonical pair hash and takes a SCALAR "
+            "probability only")
+    return jnp.float32(float(dp)) if float(dp) > 0.0 else None
+
+
+def compile_faults_gather(schedule: FaultSchedule, nbrs,
+                          nbr_mask) -> FaultParams:
+    """Lower a FaultSchedule against a GATHER neighbor table
+    (flood_step's nbrs int [N, K] / nbr_mask bool [N, K]) — round 10.
+
+    Churn rides the same interval tables as the circulant form.  Link
+    drops take a scalar probability; each undirected pair (i, j) gets
+    ONE per-tick coin keyed on the canonical (min, max) hash
+    (link_ok_gather), so both directed table entries of a symmetric
+    edge flip together.  Partition crossing is baked as a bool [N, K]
+    slot mask."""
+    nbrs = np.asarray(nbrs)
+    if nbrs.shape[0] != schedule.n_peers:
+        raise ValueError(
+            f"nbrs table has {nbrs.shape[0]} rows but the schedule "
+            f"covers n_peers={schedule.n_peers}")
+    down_start, down_end = _down_tables(schedule)
+    kw = {}
+    dp = _scalar_drop(schedule, "gather")
+    if dp is not None:
+        kw["drop_prob"] = dp
+    if schedule.partition_windows:
+        grp = schedule.partition_group
+        kw["cross_nk"] = jnp.asarray(
+            (grp[:, None] != grp[nbrs]) & np.asarray(nbr_mask))
+        kw["part_start"] = jnp.asarray(np.asarray(
+            [s for s, _ in schedule.partition_windows], dtype=np.int32))
+        kw["part_end"] = jnp.asarray(np.asarray(
+            [e for _, e in schedule.partition_windows], dtype=np.int32))
+    return FaultParams(
+        down_start=jnp.asarray(down_start),
+        down_end=jnp.asarray(down_end),
+        seed=jnp.uint32(schedule.seed & 0xFFFFFFFF), **kw)
+
+
+def compile_faults_dense(schedule: FaultSchedule) -> FaultParams:
+    """Lower a FaultSchedule for the DENSE all-pairs path (randomsub's
+    MXU step) — round 10.  No per-candidate axis exists: link drops
+    take a scalar probability with per-undirected-pair canonical-hash
+    coins generated on the fly (link_ok_dense), and partitions carry
+    the raw group assignment (the [N, N] crossing compare is
+    trace-time cheap at dense-path scales)."""
+    down_start, down_end = _down_tables(schedule)
+    kw = {}
+    dp = _scalar_drop(schedule, "dense")
+    if dp is not None:
+        kw["drop_prob"] = dp
+    if schedule.partition_windows:
+        kw["group"] = jnp.asarray(schedule.partition_group)
+        kw["part_start"] = jnp.asarray(np.asarray(
+            [s for s, _ in schedule.partition_windows], dtype=np.int32))
+        kw["part_end"] = jnp.asarray(np.asarray(
+            [e for _, e in schedule.partition_windows], dtype=np.int32))
+    return FaultParams(
+        down_start=jnp.asarray(down_start),
+        down_end=jnp.asarray(down_end),
+        seed=jnp.uint32(schedule.seed & 0xFFFFFFFF), **kw)
+
+
+def _pair_uniform(lo, hi, span, tick, seed) -> jnp.ndarray:
+    """f32 uniforms keyed on the canonical undirected pair
+    (lo, hi) — identical for both directed views by construction.
+    ``span`` scales the lane so distinct pairs get distinct lanes
+    (exact below 2**32 lanes; beyond, wrapping only aliases coins)."""
+    lane = (lo.astype(jnp.uint32) * jnp.uint32(span)
+            + hi.astype(jnp.uint32))
+    h = _fmix32(lane ^ lane_seed(jnp.asarray(tick), LINK_PHASE,
+                                 jnp.asarray(seed)))
+    return (h >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(
+        1 / (1 << 24))
+
+
+def link_ok_gather(fp: FaultParams, nbrs: jnp.ndarray,
+                   tick) -> jnp.ndarray | None:
+    """bool [N, K]: table slot (i, k) carries this tick (undirected
+    link up).  None when no link faults are configured.  Symmetric for
+    symmetric tables: both views of edge {i, j} share the canonical
+    (min, max) coin."""
+    if fp.drop_prob is None and fp.cross_nk is None:
+        return None
+    n = nbrs.shape[0]
+    i = jnp.arange(n, dtype=jnp.uint32)[:, None]
+    j = nbrs.astype(jnp.uint32)
+    up = jnp.ones(nbrs.shape, dtype=bool)
+    if fp.drop_prob is not None:
+        u = _pair_uniform(jnp.minimum(i, j), jnp.maximum(i, j), n,
+                          tick, fp.seed)
+        up = u >= fp.drop_prob
+    if fp.cross_nk is not None:
+        up = up & ~(fp.cross_nk & _partition_active(fp, tick))
+    return up
+
+
+def link_ok_dense(fp: FaultParams, n: int, tick) -> jnp.ndarray | None:
+    """bool [N, N]: adj entry (receiver p, sender q) carries this tick.
+    None when no link faults are configured.  Symmetric by the same
+    canonical-pair construction; the partition crossing compare comes
+    from the raw group assignment."""
+    if fp.drop_prob is None and fp.group is None:
+        return None
+    up = jnp.ones((n, n), dtype=bool)
+    if fp.drop_prob is not None:
+        p = jax.lax.broadcasted_iota(jnp.uint32, (n, n), 0)
+        q = jax.lax.broadcasted_iota(jnp.uint32, (n, n), 1)
+        u = _pair_uniform(jnp.minimum(p, q), jnp.maximum(p, q), n,
+                          tick, fp.seed)
+        # the diagonal stays up: a self-pair has no link to drop (and
+        # the dropped-edge telemetry halves the off-diagonal count)
+        up = (u >= fp.drop_prob) | (p == q)
+    if fp.group is not None:
+        cross = fp.group[:, None] != fp.group[None, :]
+        up = up & ~(cross & _partition_active(fp, tick))
+    return up
 
 
 def link_ok_rows(fp: FaultParams, offsets, cinv, tick,

@@ -81,8 +81,11 @@ def make_flood_sim(nbrs: np.ndarray, nbr_mask: np.ndarray, subs: np.ndarray,
     (use flood_run_curve's per-tick counts instead) — the fast path.
 
     fault_schedule (models/faults.py) injects churn/link-loss/partition
-    events; honored by the CIRCULANT step only (pass the step's offsets
-    as ``fault_offsets``) — the gather-based nbrs path refuses faults.
+    events.  On circulant topologies (nbrs=None) pass the step's
+    offsets as ``fault_offsets``; on GATHER topologies (round 10) the
+    schedule compiles against the nbrs table itself
+    (compile_faults_gather — per-undirected-pair link coins, baked
+    partition-crossing slots) and flood_step honors it.
     """
     n = subs.shape[0]
     m = len(msg_topic)
@@ -100,20 +103,21 @@ def make_flood_sim(nbrs: np.ndarray, nbr_mask: np.ndarray, subs: np.ndarray,
 
     fparams = None
     if fault_schedule is not None:
-        if nbrs is not None:
-            raise ValueError(
-                "fault_schedule: circulant topologies only (nbrs=None); "
-                "the gather-based path has no per-edge link masks")
-        if fault_offsets is None:
-            raise ValueError(
-                "fault_schedule needs fault_offsets (the circulant "
-                "offsets the step was built with)")
         if fault_schedule.n_peers != n:
             raise ValueError(
                 f"fault_schedule.n_peers={fault_schedule.n_peers} != "
                 f"sim peer count {n}")
-        fparams = _faults.compile_faults(fault_schedule, fault_offsets,
-                                         pack_links=False)
+        if nbrs is not None:
+            fparams = _faults.compile_faults_gather(fault_schedule,
+                                                    nbrs, nbr_mask)
+        else:
+            if fault_offsets is None:
+                raise ValueError(
+                    "fault_schedule needs fault_offsets (the circulant "
+                    "offsets the step was built with)")
+            fparams = _faults.compile_faults(fault_schedule,
+                                             fault_offsets,
+                                             pack_links=False)
 
     # a peer forwards what it is subscribed/relaying for, plus its own
     # publishes (publish-without-subscribe floods too, floodsub.go:76-100)
@@ -138,16 +142,98 @@ def make_flood_sim(nbrs: np.ndarray, nbr_mask: np.ndarray, subs: np.ndarray,
 
 
 def flood_step(params: FloodParams, state: FloodState) -> FloodState:
-    """One virtual tick: inject due publishes, propagate one hop, record
-    first deliveries.  Pure function — jit/shard_map friendly."""
-    if params.faults is not None:
-        raise ValueError(
-            "fault injection needs the circulant step "
-            "(make_circulant_flood_step); the gather path has no "
-            "per-edge link masks")
-    heard = propagate_pm(state.have & params.fwd_words, params.nbrs,
-                         params.nbr_mask)
-    return _finish_step(params, state, heard)[0]
+    """One virtual tick over a GATHER topology: inject due publishes,
+    propagate one hop, record first deliveries.  Pure function —
+    jit/shard_map friendly.  Honors ``params.faults`` since round 10
+    (compile_faults_gather: a down peer neither sends, receives, nor
+    injects; undirected links drop on canonical-pair coins; partition
+    windows cut the baked crossing slots)."""
+    return make_gather_step_core()(params, state)[0]
+
+
+def make_gather_step_core(telemetry:
+                          "_telemetry.TelemetryConfig | None" = None):
+    """(params, state) -> (state, delivered_words) over a gather
+    (nbrs-table) topology — round 10 twin of make_circulant_step_core.
+
+    Honors ``params.faults`` (gather-compiled, see flood_step).  With
+    ``telemetry`` the core returns ``(state, delivered_words,
+    TelemetryFrame)`` carrying floodsub's frame subset: payload copies
+    sent (sender-side, per live table slot), duplicates suppressed,
+    estimated payload bytes, the delivery-latency histogram, and the
+    fault counters — gossip/mesh/score fields stay zero.  The
+    fault-free telemetry-off build compiles the exact fused
+    propagate_pm hop; counting runs the same gather with the masks
+    visible (state trajectory bit-identical either way)."""
+    tel = telemetry
+    ws = _telemetry.wire_sizes(tel) if tel is not None else None
+    pc = jax.lax.population_count
+
+    def core(params: FloodParams, state: FloodState):
+        fp = params.faults
+        src = state.have & params.fwd_words                # [W, N]
+        alive = aw = link_up = None
+        if fp is not None:
+            alive = _faults.alive_mask(fp, state.tick)
+            aw = _faults.alive_word(alive)
+            src = src & aw[None, :]                        # sender up
+            link_up = _faults.link_ok_gather(fp, params.nbrs,
+                                             state.tick)
+        if fp is None and tel is None:
+            heard = propagate_pm(src, params.nbrs, params.nbr_mask)
+            return _finish_step(params, state, heard)
+        if fp is not None and link_up is None and tel is None:
+            # pure churn: every table slot carries, so the hop IS the
+            # fused propagation kernel — only the endpoints are masked
+            # (twin of the circulant core's pure-churn case)
+            heard = propagate_pm(src, params.nbrs,
+                                 params.nbr_mask) & aw[None, :]
+            return _finish_step(params, state, heard, alive=alive)
+        ok = params.nbr_mask if link_up is None \
+            else params.nbr_mask & link_up                 # [N, K]
+        gathered = src.at[:, params.nbrs].get(
+            mode="fill", fill_value=0)                     # [W, N, K]
+        gathered = jnp.where(ok[None, :, :], gathered, jnp.uint32(0))
+        heard = jnp.zeros_like(src)
+        for k in range(params.nbrs.shape[1]):
+            heard = heard | gathered[:, :, k]
+        if aw is not None:
+            heard = heard & aw[None, :]                    # receiver up
+        new_state, delivered = _finish_step(params, state, heard,
+                                            alive=alive)
+        if tel is None:
+            return new_state, delivered
+        kw_f = {}
+        if tel.counters:
+            sent_cnt = pc(gathered).sum(dtype=jnp.int32)
+            recv = (gathered if aw is None
+                    else gathered & aw[None, :, None])
+            recv_cnt = pc(recv).sum(dtype=jnp.int32)
+            accepted = (heard & ~state.have
+                        & (params.fwd_words | params.deliver_words))
+            kw_f.update(
+                payload_sent=sent_cnt,
+                dup_suppressed=recv_cnt - pc(accepted).sum(
+                    dtype=jnp.int32))
+            if tel.wire:
+                kw_f["bytes_payload"] = (
+                    sent_cnt.astype(jnp.float32)
+                    * float(ws.payload_frame))
+        if tel.latency_hist:
+            kw_f["latency_hist"] = _telemetry.latency_histogram(
+                delivered, params.publish_tick, state.tick,
+                tel.latency_buckets)
+        if tel.faults and fp is not None:
+            kw_f["down_peers"] = (~alive).sum(dtype=jnp.int32)
+            if link_up is not None:
+                # two table slots per undirected edge on a symmetric
+                # table; halve like the circulant paths
+                kw_f["dropped_edge_ticks"] = (
+                    (~link_up & params.nbr_mask).sum(
+                        dtype=jnp.int32) // 2)
+        return new_state, delivered, _telemetry.make_frame(**kw_f)
+
+    return core
 
 
 def make_circulant_flood_step(offsets):
@@ -253,8 +339,8 @@ def make_circulant_step_core(offsets,
     per-edge rolls (instead of the fused propagation kernel) so per-edge
     copies are countable — the state trajectory stays bit-identical,
     and ``telemetry=None`` compiles the exact pre-telemetry core.
-    The gather-based flood_step refuses telemetry like it refuses
-    faults (no per-edge loop to count over)."""
+    The gather-based path threads telemetry too since round 10
+    (make_gather_step_core)."""
     offsets = tuple(int(o) for o in offsets)
     idx = {o: i for i, o in enumerate(offsets)}
     cinv = (tuple(idx[-o] for o in offsets)
@@ -307,6 +393,10 @@ def make_circulant_step_core(offsets,
                 kw_f["bytes_payload"] = (
                     sent_cnt.astype(jnp.float32)
                     * float(ws.payload_frame))
+        if tel.latency_hist:
+            kw_f["latency_hist"] = _telemetry.latency_histogram(
+                delivered, params.publish_tick, state.tick,
+                tel.latency_buckets)
         if tel.faults and fp is not None:
             kw_f["down_peers"] = (~alive).sum(dtype=jnp.int32)
             if link is not None:

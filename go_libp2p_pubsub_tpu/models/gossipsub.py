@@ -1397,8 +1397,21 @@ def make_gossip_step(cfg: GossipSimConfig,
                      pipeline_gates: bool = True,
                      shard_mesh=None,
                      shard_axis: str = "peers",
-                     telemetry: _telemetry.TelemetryConfig | None = None):
+                     telemetry: _telemetry.TelemetryConfig | None = None,
+                     rpc_probe: bool = False):
     """Build the jittable (params, state) -> (state, delivered_words) core.
+
+    With ``rpc_probe=True`` (round 10) the step additionally returns a
+    per-tick dict of per-edge RPC words as its LAST element — the
+    ATTEMPT masks (eager-forward / IHAVE / GRAFT / PRUNE edges before
+    fault masking), the content words they would carry, and the
+    per-tick fault masks — which
+    ``gossip_run_rpc_snapshots`` collects and
+    ``interop.export.rpc_events`` reconstructs into the reference's
+    per-RPC SEND_RPC / RECV_RPC / DROP_RPC metadata streams.  Probe
+    data is a pure READOUT (the state trajectory is bit-identical) and
+    works on both execution paths; paired-topic and mixed-protocol
+    overlays are not probe-supported (they raise).
 
     With ``telemetry`` (models/telemetry.py) the step instead returns
     ``(state, delivered_words, TelemetryFrame)`` — per-tick protocol
@@ -1451,6 +1464,16 @@ def make_gossip_step(cfg: GossipSimConfig,
                    or (sc is not None and sc.track_p3)):
         raise ValueError("paired_topics needs the combined path "
                         "(C<=16, no track_p3/force_split)")
+    if rpc_probe and paired:
+        raise ValueError(
+            "rpc_probe: paired-topic mode is not probe-supported (the "
+            "per-slot RPC split is not captured); run the probe on a "
+            "single-topic-per-peer config")
+    if rpc_probe and sc is not None and sc.flood_publish:
+        raise ValueError(
+            "rpc_probe: flood_publish is not probe-supported (flood "
+            "copies ride a separate per-edge view the probe does not "
+            "capture)")
 
     # random-k selection backend.  The mosaic kernel (bit-identical
     # output) is kept as an option, but measured inside the real scanned
@@ -1488,9 +1511,10 @@ def make_gossip_step(cfg: GossipSimConfig,
         sides are masked HERE on the [N] ctrl words before byte
         packing (they ride the existing DMA slots), receiver sides go
         in as the kernel's alive-word operand.  With telemetry, the
-        in-kernel counter tallies come back as one [TEL_ROWS, 128]
-        reduction output and the frame is assembled in the epilogue,
-        bit-identical to the XLA path's."""
+        in-kernel counter tallies — plus the round-10 latency bucket
+        rows when latency_hist is on — come back as one
+        [TEL_ROWS + L, 128] reduction output and the frame is
+        assembled in the epilogue, bit-identical to the XLA path's."""
         from ..ops.pallas.receive import (
             CTRL_A, CTRL_DROP, CTRL_FLOOD, CTRL_GRAFT,
             CTRL_OUT, CTRL_ADV, CTRL_TGT,
@@ -1614,7 +1638,19 @@ def make_gossip_step(cfg: GossipSimConfig,
             if sc is not None and sc.sybil_iwant_spam:
                 blocked += [fmasks["flood_ok"]]
         with_f = fmasks is not None
-        with_t = tel is not None and tel.counters
+        lat_b = (tel.latency_buckets
+                 if tel is not None and tel.latency_hist else 0)
+        with_t = tel is not None and (tel.counters or lat_b > 0)
+        if lat_b:
+            # latency-bucket operands: the tick's message masks (SMEM,
+            # replicated on the sharded path) and the effective
+            # deliver words the tallies count against
+            head = head + [_telemetry.latency_bucket_masks(
+                params.publish_tick, tick, lat_b, W)]
+            dlv_eff = params.deliver_words
+            if sc is not None:
+                dlv_eff = dlv_eff & ~params.invalid_words[:, None]
+            blocked += [dlv_eff]
         if shard_mesh is not None:
             # multi-chip: shard_map over the peer axis — per-shard
             # halo exchange (ICI collective-permutes) + the unmodified
@@ -1639,7 +1675,8 @@ def make_gossip_step(cfg: GossipSimConfig,
                 ctrl2_rows=(jnp.stack(ctrl2_rows) if paired
                             else None),
                 freshb_st=(jnp.stack(fresh_b) if paired else None),
-                with_faults=with_f, with_telemetry=with_t)
+                with_faults=with_f, with_telemetry=with_t,
+                tel_lat_buckets=lat_b)
         else:
             def flat8(rows):
                 return jnp.concatenate(
@@ -1671,7 +1708,8 @@ def make_gossip_step(cfg: GossipSimConfig,
                 with_px=state.active is not None,
                 with_same_ip=params.cand_same_ip is not None,
                 with_static=with_static,
-                with_faults=with_f, with_telemetry=with_t)
+                with_faults=with_f, with_telemetry=with_t,
+                tel_lat_buckets=lat_b)
             base0 = jnp.zeros((1,), dtype=jnp.uint32)
             outs = krn(*head, base0, *flats, *blocked)
         tel_row = None
@@ -1798,27 +1836,45 @@ def make_gossip_step(cfg: GossipSimConfig,
                     * float(ws.iwant_per_id)
                     + f32c(graft_cnt) * float(ws.graft_frame)
                     + f32c(prune_cnt) * float(ws.prune_frame))
-        if tel.mesh:
+        if tel.mesh or tel.degree_hist:
             deg_t = popcount32(mesh_new[:n_true])
             if paired:
                 deg_t = deg_t + popcount32(mesh_b_new[:n_true])
-            mn_d, mean_d, mx_d = _telemetry.degree_stats(
-                deg_t, params.subscribed[:n_true])
-            kw_f.update(mesh_deg_min=mn_d, mesh_deg_mean=mean_d,
-                        mesh_deg_max=mx_d)
-        if tel.scores and sc is not None:
+            if tel.mesh:
+                mn_d, mean_d, mx_d = _telemetry.degree_stats(
+                    deg_t, params.subscribed[:n_true])
+                kw_f.update(mesh_deg_min=mn_d, mesh_deg_mean=mean_d,
+                            mesh_deg_max=mx_d)
+            if tel.degree_hist:
+                kw_f["mesh_deg_hist"] = _telemetry.degree_histogram(
+                    deg_t, params.subscribed[:n_true],
+                    tel.degree_buckets)
+        if (tel.scores or tel.score_hist) and sc is not None:
             # start-of-tick scores — the view the gates acted on, and
             # the one telemetry group that re-reads the [C, N]
             # counters on the kernel path (the kernel's own score
             # pass runs on the UPDATED counters for next tick's gates)
             score_t = compute_scores(sc, params, state)
             mask_t = expand_bits(params.cand_sub_bits & sub_all, C)
-            sm, smn, fneg, fg = _telemetry.score_stats(
-                score_t[:, :n_true], mask_t[:, :n_true],
-                sc.gossip_threshold)
-            kw_f.update(score_mean=sm, score_min=smn,
-                        score_frac_neg=fneg,
-                        score_frac_below_gossip=fg)
+            if tel.scores:
+                sm, smn, fneg, fg = _telemetry.score_stats(
+                    score_t[:, :n_true], mask_t[:, :n_true],
+                    sc.gossip_threshold)
+                kw_f.update(score_mean=sm, score_min=smn,
+                            score_frac_neg=fneg,
+                            score_frac_below_gossip=fg)
+            if tel.score_hist:
+                kw_f["score_hist"] = _telemetry.score_histogram(
+                    score_t[:, :n_true], mask_t[:, :n_true],
+                    tel.score_bucket_edges)
+        if tel.latency_hist:
+            # in-kernel bucket tallies (rows TEL_ROWS..): exact i32
+            # counts of the same delivered-copy sets the XLA path
+            # scatters in latency_histogram — equal bit for bit (the
+            # sharded path psums the rows with the counters)
+            from ..ops.pallas.receive import TEL_ROWS
+            kw_f["latency_hist"] = tel_row[TEL_ROWS:].sum(
+                axis=1, dtype=jnp.int32)
         if tel.faults and fmasks is not None:
             # unpadded masks: pad lanes are alive-with-links-up by
             # construction and must not enter the counts
@@ -2057,6 +2113,13 @@ def make_gossip_step(cfg: GossipSimConfig,
         else:
             flood_bits = None
 
+        # rpc probe: the ATTEMPT masks are the pre-fault edge words —
+        # the host exporter splits each attempted edge-tick into
+        # SEND+RECV (healthy), DROP (fault-masked), or nothing (dead
+        # sender) using the fault words captured alongside
+        rpc_fwd_raw = out_bits if rpc_probe else None
+        rpc_adv_raw = targets if rpc_probe else None
+
         if fp is not None:
             # faults cut SENDS at their source masks: a down peer (or a
             # down link's endpoint) forwards nothing, gossips nothing,
@@ -2283,6 +2346,33 @@ def make_gossip_step(cfg: GossipSimConfig,
         mesh_sel, backoff_bits2 = sel_a["mesh_sel"], sel_a["backoff_bits2"]
         would_accept, a_sent = sel_a["would_accept"], sel_a["a_sent"]
 
+        rpc_snap = None
+        if rpc_probe:
+            if params.flood_proto is not None:
+                raise ValueError(
+                    "rpc_probe: mixed-protocol overlays are not "
+                    "probe-supported (floodsub-proto flooding rides "
+                    "outside the captured edge masks)")
+
+            def stk(rows):
+                return (jnp.stack(rows) if W
+                        else jnp.zeros((0, n), dtype=jnp.uint32))
+
+            # everything the host exporter needs to reconstruct the
+            # per-RPC streams: attempt masks + content words + fault
+            # words (all-healthy constants when no schedule rides).
+            # Pure readout — nothing below consumes it.
+            rpc_snap = dict(
+                fwd=rpc_fwd_raw, ihave=rpc_adv_raw,
+                graft=grafts, prune=dropped,
+                withhold=(withhold if withhold is not None
+                          else jnp.zeros((n,), dtype=bool)),
+                send_ok=(f_send_ok if fp is not None
+                         else jnp.full((n,), ALL)),
+                alive=(f_alive if fp is not None
+                       else jnp.ones((n,), dtype=bool)),
+                fresh=stk(fresh), adv=stk(adv), seen=stk(seen))
+
         if kernel_on:
             # PX rotation folds in BOTH slots' negative-score drops
             # (XLA 4b does the same)
@@ -2290,7 +2380,7 @@ def make_gossip_step(cfg: GossipSimConfig,
             if paired and sel_b["neg"] is not None:
                 neg_px = (sel_b["neg"] if neg_px is None
                           else neg_px | sel_b["neg"])
-            return _finish_kernel(
+            outk = _finish_kernel(
                 params=params, state=state, fanout=fanout,
                 last_pub=last_pub, injected=injected,
                 fresh=(fresh_a if paired else fresh),
@@ -2305,6 +2395,9 @@ def make_gossip_step(cfg: GossipSimConfig,
                 sel_b=sel_b,
                 fresh_b=(fresh_b if paired else None),
                 fmasks=fmasks)
+            if rpc_probe:
+                outk = (*outk, rpc_snap)
+            return outk
 
         # behavioral broken-promise detection: a withholding peer's
         # IHAVE claims ids the receiver doesn't hold (the reference
@@ -2916,6 +3009,8 @@ def make_gossip_step(cfg: GossipSimConfig,
             new_state = new_state.replace(gates=compute_gates(
                 cfg, sc, params, new_state, salt))
         if tel is None:
+            if rpc_probe:
+                return new_state, delivered_now, rpc_snap
             return new_state, delivered_now
 
         # -- telemetry frame assembly (models/telemetry.py): a pure
@@ -2973,29 +3068,44 @@ def make_gossip_step(cfg: GossipSimConfig,
                     + f32c(tel_acc["req"]) * float(ws.iwant_per_id)
                     + f32c(graft_cnt) * float(ws.graft_frame)
                     + f32c(prune_cnt) * float(ws.prune_frame))
-        if tel.mesh:
+        if tel.mesh or tel.degree_hist:
             deg_t = popcount32(mesh)
             if paired:
                 deg_t = deg_t + popcount32(mesh_b_new)
-            mn_d, mean_d, mx_d = _telemetry.degree_stats(deg_t, sub)
-            kw_f.update(mesh_deg_min=mn_d, mesh_deg_mean=mean_d,
-                        mesh_deg_max=mx_d)
-        if tel.scores and sc is not None:
+            if tel.mesh:
+                mn_d, mean_d, mx_d = _telemetry.degree_stats(deg_t, sub)
+                kw_f.update(mesh_deg_min=mn_d, mesh_deg_mean=mean_d,
+                            mesh_deg_max=mx_d)
+            if tel.degree_hist:
+                kw_f["mesh_deg_hist"] = _telemetry.degree_histogram(
+                    deg_t, sub, tel.degree_buckets)
+        if (tel.scores or tel.score_hist) and sc is not None:
             # start-of-tick scores — the same view the gates acted on
             score_t = score_fn()
             mask_t = expand_bits(params.cand_sub_bits & sub_all, C)
-            sm, smn, fneg, fg = _telemetry.score_stats(
-                score_t, mask_t, sc.gossip_threshold)
-            kw_f.update(score_mean=sm, score_min=smn,
-                        score_frac_neg=fneg,
-                        score_frac_below_gossip=fg)
+            if tel.scores:
+                sm, smn, fneg, fg = _telemetry.score_stats(
+                    score_t, mask_t, sc.gossip_threshold)
+                kw_f.update(score_mean=sm, score_min=smn,
+                            score_frac_neg=fneg,
+                            score_frac_below_gossip=fg)
+            if tel.score_hist:
+                kw_f["score_hist"] = _telemetry.score_histogram(
+                    score_t, mask_t, tel.score_bucket_edges)
+        if tel.latency_hist:
+            kw_f["latency_hist"] = _telemetry.latency_histogram(
+                delivered_now, params.publish_tick, tick,
+                tel.latency_buckets)
         if tel.faults and fp is not None:
             kw_f["down_peers"] = (~f_alive).sum(dtype=jnp.int32)
             if f_link is not None:
                 # one undirected edge has two packed views; halve
                 kw_f["dropped_edge_ticks"] = (
                     popcount32(~f_link & ALL).sum(dtype=jnp.int32) // 2)
-        return new_state, delivered_now, _telemetry.make_frame(**kw_f)
+        frame = _telemetry.make_frame(**kw_f)
+        if rpc_probe:
+            return new_state, delivered_now, frame, rpc_snap
+        return new_state, delivered_now, frame
 
     return step
 
@@ -3141,6 +3251,24 @@ def gossip_run_acq_snapshots(params: GossipParams, state: GossipState,
         if s2.mesh_b is not None:
             snap["mesh_b"] = s2.mesh_b
         return s2, snap
+    return jax.lax.scan(body, state, None, length=n_ticks)
+
+
+@partial(jax.jit, static_argnums=(2, 3), donate_argnums=(1,))
+def gossip_run_rpc_snapshots(params: GossipParams, state: GossipState,
+                             n_ticks: int, step):
+    """Advance n_ticks collecting the per-tick per-edge RPC probe dict
+    (round 10): returns ``(state, snaps)`` where every probe leaf
+    gains a leading [n_ticks] axis.  ``step`` must be built with
+    ``make_gossip_step(..., rpc_probe=True)`` (either execution path;
+    the probe dict is the step's LAST output either way) — feed the
+    snaps to interop.export.rpc_events, which reconstructs the
+    reference's SEND_RPC / RECV_RPC / DROP_RPC metadata streams
+    host-side (fault-masked edges emitting DROP_RPC).  Collection cost
+    is ~3W+6 [N] words per tick — export runs, not benches."""
+    def body(s, _):
+        out = step(params, s)
+        return out[0], out[-1]
     return jax.lax.scan(body, state, None, length=n_ticks)
 
 

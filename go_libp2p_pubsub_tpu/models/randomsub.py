@@ -121,14 +121,11 @@ def make_randomsub_sim(cfg: RandomSubSimConfig, subs: np.ndarray,
     rather than the C circulant candidates.
 
     fault_schedule (models/faults.py) injects churn/link-loss/partition
-    events — honored by the circulant step only (the dense MXU step's
-    all-member sampling pool has no per-candidate link axis; it refuses
-    fault configs)."""
+    events — honored by the circulant step AND (round 10) the dense
+    MXU step, which compiles the schedule to per-undirected-pair
+    canonical-hash link coins over the all-pairs adjacency
+    (compile_faults_dense; scalar drop_prob only)."""
     if fault_schedule is not None:
-        if dense:
-            raise ValueError(
-                "fault_schedule: circulant step only (the dense MXU "
-                "step has no per-edge link masks)")
         if fault_schedule.n_peers != subs.shape[0]:
             raise ValueError(
                 f"fault_schedule.n_peers={fault_schedule.n_peers} != "
@@ -173,9 +170,11 @@ def make_randomsub_sim(cfg: RandomSubSimConfig, subs: np.ndarray,
         origin_words=pack_bits_pm(jnp.asarray(origin_bits)),
         deliver_words=pack_bits_pm(jnp.asarray(deliver_bits)),
         publish_tick=jnp.asarray(msg_publish_tick, dtype=jnp.int32),
-        faults=(_faults.compile_faults(fault_schedule, cfg.offsets,
-                                       pack_links=False)
-                if fault_schedule is not None else None),
+        faults=(None if fault_schedule is None
+                else _faults.compile_faults_dense(fault_schedule)
+                if dense
+                else _faults.compile_faults(fault_schedule, cfg.offsets,
+                                            pack_links=False)),
     )
     w = params.origin_words.shape[0]
     state = RandomSubState(
@@ -289,6 +288,10 @@ def make_randomsub_step(cfg: RandomSubSimConfig,
             if tel.wire:
                 kw_f["bytes_payload"] = (tel_sent.astype(jnp.float32)
                                          * float(ws.payload_frame))
+        if tel.latency_hist:
+            kw_f["latency_hist"] = _telemetry.latency_histogram(
+                delivered_now, params.publish_tick, tick,
+                tel.latency_buckets)
         if tel.faults and fp is not None:
             kw_f["down_peers"] = (~alive).sum(dtype=jnp.int32)
             if link is not None:
@@ -299,7 +302,10 @@ def make_randomsub_step(cfg: RandomSubSimConfig,
     return step
 
 
-def make_randomsub_dense_step(cfg: RandomSubSimConfig):
+def make_randomsub_dense_step(cfg: RandomSubSimConfig,
+                              telemetry:
+                              "_telemetry.TelemetryConfig | None"
+                              = None):
     """MXU formulation for small N (<= ~32k peers): one hop = a bf16
     matmul ``adjacency [N, N] @ frontier [N, M]``.
 
@@ -311,15 +317,22 @@ def make_randomsub_dense_step(cfg: RandomSubSimConfig):
     reference's known-peer list (randomsub.go:124-138), not a circulant
     approximation.  ~N²·2 bytes of adjacency traffic per tick, so keep N
     small; the circulant step remains the path for large N.
+
+    Round 10: honors ``params.faults`` (compile_faults_dense — churn
+    masks the adjacency's sender columns and receiver rows, scalar
+    link loss draws one canonical-pair coin per undirected (p, q)
+    pair, partitions cut the group-crossing entries) and ``telemetry``
+    (the randomsub frame subset: payload copies sent counted
+    sender-side over the integer adjacency — self-copies included,
+    they are seen-cache hits like any duplicate — duplicates
+    suppressed, bytes, latency histogram, fault counters).
     """
     T = cfg.n_topics
+    tel = telemetry
+    ws = _telemetry.wire_sizes(tel) if tel is not None else None
+    pc = jax.lax.population_count
 
     def step(params: RandomSubParams, state: RandomSubState):
-        if params.faults is not None:
-            raise ValueError(
-                "fault injection needs the circulant step "
-                "(make_randomsub_step); the dense MXU step has no "
-                "per-edge link masks")
         tick = state.tick
         n = params.subscribed.shape[0]
         W = state.have.shape[0]
@@ -328,6 +341,13 @@ def make_randomsub_dense_step(cfg: RandomSubSimConfig):
         due = pack_bits(params.publish_tick == tick)            # [W]
         injected = [params.origin_words[w] & due[w] & ~state.have[w]
                     for w in range(W)]
+        fp = params.faults
+        alive = aw = None
+        if fp is not None:
+            alive = _faults.alive_mask(fp, tick)
+            aw = _faults.alive_word(alive)
+            # a down origin does not publish (lost, not deferred)
+            injected = [inj & aw for inj in injected]
         frontier = [state.fresh[w] | injected[w] for w in range(W)]
 
         # unpack frontier to bf16 [N, M] (tiny at dense-path scales)
@@ -347,6 +367,16 @@ def make_randomsub_dense_step(cfg: RandomSubSimConfig):
             pq = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0) \
                 - jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
             adj = adj & ((pq % T) == 0)
+        link = None
+        if fp is not None:
+            # a down peer sends nothing; a cut pair carries nothing
+            adj = adj & alive[None, :]
+            link = _faults.link_ok_dense(fp, n, tick)
+            if link is not None:
+                adj = adj & link
+        adj_send = adj          # sender-side view (sent = left the peer)
+        if fp is not None:
+            adj = adj & alive[:, None]          # receiver up
 
         cnt = jnp.dot(adj.astype(jnp.bfloat16), fmat,
                       preferred_element_type=jnp.float32)       # [N, M]
@@ -373,7 +403,44 @@ def make_randomsub_dense_step(cfg: RandomSubSimConfig):
         new_state = RandomSubState(
             have=have, fresh=new, first_tick=first_tick,
             key=state.key, tick=tick + 1)
-        return new_state, delivered_now
+        if tel is None:
+            return new_state, delivered_now
+        kw_f = {}
+        if tel.counters:
+            # exact integer copy counts: each adjacency entry carries
+            # the sender's whole frontier, so copies = frontier
+            # popcount weighted by the (masked) adjacency — summed in
+            # i32, not read off the bf16 matmul
+            frontier_cnt = None
+            for w in range(W):
+                pcw = pc(frontier[w]).astype(jnp.int32)
+                frontier_cnt = (pcw if frontier_cnt is None
+                                else frontier_cnt + pcw)
+            if frontier_cnt is None:
+                frontier_cnt = jnp.zeros((n,), dtype=jnp.int32)
+            sent_cnt = jnp.where(adj_send, frontier_cnt[None, :],
+                                 0).sum(dtype=jnp.int32)
+            recv_cnt = jnp.where(adj, frontier_cnt[None, :],
+                                 0).sum(dtype=jnp.int32)
+            kw_f.update(payload_sent=sent_cnt,
+                        dup_suppressed=recv_cnt - pc(new).sum(
+                            dtype=jnp.int32))
+            if tel.wire:
+                kw_f["bytes_payload"] = (sent_cnt.astype(jnp.float32)
+                                         * float(ws.payload_frame))
+        if tel.latency_hist:
+            kw_f["latency_hist"] = _telemetry.latency_histogram(
+                delivered_now, params.publish_tick, tick,
+                tel.latency_buckets)
+        if tel.faults and fp is not None:
+            kw_f["down_peers"] = (~alive).sum(dtype=jnp.int32)
+            if link is not None:
+                # each undirected pair has two adjacency entries; the
+                # diagonal (self-pairs) never drops, so halving the
+                # off-diagonal count is exact
+                kw_f["dropped_edge_ticks"] = (
+                    (~link).sum(dtype=jnp.int32) // 2)
+        return new_state, delivered_now, _telemetry.make_frame(**kw_f)
 
     return step
 

@@ -30,9 +30,16 @@ execution paths — the pallas receive kernel (round 9) accumulates the
 RPC/duplicate counter tallies as in-kernel reductions and the step
 epilogue assembles the frame bit-identically to the XLA path's;
 floodsub and randomsub emit the applicable subset (payload /
-duplicate / fault counters) with the gossip-only fields zero.  The
-floodsub gather step and the randomsub dense MXU step refuse
-telemetry configs the way they refuse fault configs.
+duplicate / fault / latency-histogram counters) with the gossip-only
+fields zero.  Since round 10 the floodsub GATHER step and the
+randomsub DENSE MXU step thread telemetry (and fault schedules) too —
+no execution path refuses observability configs any more.
+
+Round 10 adds fixed-bucket in-scan HISTOGRAM groups (delivery latency
+in ticks since publish, mesh degree, score) behind TelemetryConfig
+knobs: integer bucket tallies computed from values the step already
+holds, bit-identical between the XLA and pallas-kernel paths and
+exactly summing to the scalar population counters (pinned).
 """
 
 from __future__ import annotations
@@ -84,6 +91,32 @@ class TelemetryConfig:
     mesh: bool = True
     scores: bool = True
     faults: bool = True
+    # Fixed-bucket in-scan HISTOGRAM groups (round 10) — the frame
+    # gains small int32 bucket-count vectors instead of scalar
+    # summaries, turning min/mean/max telemetry into real
+    # distributions (delivery-latency percentiles, mesh-degree and
+    # score shape).  Off by default: the scalar groups above stay the
+    # cheap always-on observables.
+    #
+    # - ``latency_hist``: deliveries this tick bucketed by ticks since
+    #   publish (bucket b = latency b; the last bucket absorbs
+    #   >= latency_buckets - 1).  Sums exactly to the per-tick
+    #   delivered counts (pinned).
+    # - ``degree_hist``: subscribed peers bucketed by end-of-tick mesh
+    #   degree (last bucket absorbs the overflow).  Sums exactly to
+    #   the subscribed-peer count and is exactly consistent with the
+    #   ``mesh`` group's min/mean/max (pinned).
+    # - ``score_hist``: live candidate edges bucketed by start-of-tick
+    #   score against the static ``score_bucket_edges`` (bucket 0 =
+    #   below the first edge, bucket i = [edge[i-1], edge[i]), last =
+    #   >= the final edge).  Sums exactly to the live-edge count.
+    latency_hist: bool = False
+    degree_hist: bool = False
+    score_hist: bool = False
+    latency_buckets: int = 16
+    degree_buckets: int = 16
+    score_bucket_edges: tuple = (-50.0, -10.0, -1.0, 0.0, 1.0, 10.0,
+                                 50.0)
     payload_data_bytes: int = 64
     msg_id_bytes: int = 8
     peer_id_bytes: int = 8
@@ -91,62 +124,46 @@ class TelemetryConfig:
 
     # Machine-readable thread-or-refuse contract (verified by
     # tools/graftlint/contracts.py).  Per execution path each field is
-    # "threaded" (changes the compiled step, proven by jaxpr diff),
+    # "threaded" (changes the compiled step, proven by jaxpr diff) or
     # "inert" (documented no-op on that path's frame subset, proven by
-    # jaxpr EQUALITY), or "refused" (the path rejects telemetry
-    # configs outright — by raising, or by not exposing a telemetry
-    # parameter at all).  The gossip KERNEL path is threaded since
-    # round 9 (in-kernel counter tallies + epilogue frame assembly —
-    # every field changes the kernel-path jaxpr like the XLA one);
-    # the refuse-telemetry contract of the gather / dense paths
-    # remains machine-checked.
+    # jaxpr EQUALITY).  The gossip KERNEL path is threaded since
+    # round 9 (in-kernel counter tallies + epilogue frame assembly);
+    # the flood-GATHER and randomsub-DENSE paths are threaded since
+    # round 10 — no path refuses telemetry configs any more.
     PATHS: ClassVar[tuple[str, ...]] = (
         "gossip-xla", "gossip-kernel", "flood-circulant",
         "flood-gather", "randomsub-circulant", "randomsub-dense")
-    _REFUSING: ClassVar[dict[str, str]] = {
-        "flood-gather": "refused", "randomsub-dense": "refused"}
+    _ALL_THREADED: ClassVar[dict[str, str]] = {
+        "gossip-xla": "threaded", "gossip-kernel": "threaded",
+        "flood-circulant": "threaded", "flood-gather": "threaded",
+        "randomsub-circulant": "threaded",
+        "randomsub-dense": "threaded"}
+    # gossip-only machinery: inert on the payload-subset paths
+    _GOSSIP_ONLY: ClassVar[dict[str, str]] = {
+        "gossip-xla": "threaded", "gossip-kernel": "threaded",
+        "flood-circulant": "inert", "flood-gather": "inert",
+        "randomsub-circulant": "inert", "randomsub-dense": "inert"}
     CONTRACT: ClassVar[dict[str, object]] = {
-        "counters": {"gossip-xla": "threaded",
-                     "gossip-kernel": "threaded",
-                     "flood-circulant": "threaded",
-                     "randomsub-circulant": "threaded", **_REFUSING},
-        "wire": {"gossip-xla": "threaded",
-                 "gossip-kernel": "threaded",
-                 "flood-circulant": "threaded",
-                 "randomsub-circulant": "threaded", **_REFUSING},
-        "mesh": {"gossip-xla": "threaded",
-                 "gossip-kernel": "threaded",
-                 "flood-circulant": "inert",
-                 "randomsub-circulant": "inert", **_REFUSING},
-        "scores": {"gossip-xla": "threaded",
-                   "gossip-kernel": "threaded",
-                   "flood-circulant": "inert",
-                   "randomsub-circulant": "inert", **_REFUSING},
-        "faults": {"gossip-xla": "threaded",
-                   "gossip-kernel": "threaded",
-                   "flood-circulant": "threaded",
-                   "randomsub-circulant": "threaded", **_REFUSING},
-        "payload_data_bytes": {"gossip-xla": "threaded",
-                               "gossip-kernel": "threaded",
-                               "flood-circulant": "threaded",
-                               "randomsub-circulant": "threaded",
-                               **_REFUSING},
+        "counters": _ALL_THREADED,
+        "wire": _ALL_THREADED,
+        "mesh": _GOSSIP_ONLY,
+        "scores": _GOSSIP_ONLY,
+        "faults": _ALL_THREADED,
+        # every path computes delivered words, so the latency
+        # histogram threads everywhere; degree/score histograms are
+        # gossip-only gauges like the scalar mesh/scores groups
+        "latency_hist": _ALL_THREADED,
+        "latency_buckets": _ALL_THREADED,
+        "degree_hist": _GOSSIP_ONLY,
+        "degree_buckets": _GOSSIP_ONLY,
+        "score_hist": _GOSSIP_ONLY,
+        "score_bucket_edges": _GOSSIP_ONLY,
+        "payload_data_bytes": _ALL_THREADED,
         # ihave/iwant per-id framing: gossip-only; the flood/randomsub
         # frame subsets bake only the payload frame size
-        "msg_id_bytes": {"gossip-xla": "threaded",
-                         "gossip-kernel": "threaded",
-                         "flood-circulant": "inert",
-                         "randomsub-circulant": "inert", **_REFUSING},
-        "peer_id_bytes": {"gossip-xla": "threaded",
-                          "gossip-kernel": "threaded",
-                          "flood-circulant": "threaded",
-                          "randomsub-circulant": "threaded",
-                          **_REFUSING},
-        "topic_bytes": {"gossip-xla": "threaded",
-                        "gossip-kernel": "threaded",
-                        "flood-circulant": "threaded",
-                        "randomsub-circulant": "threaded",
-                        **_REFUSING},
+        "msg_id_bytes": _GOSSIP_ONLY,
+        "peer_id_bytes": _ALL_THREADED,
+        "topic_bytes": _ALL_THREADED,
     }
 
     def __post_init__(self):
@@ -158,6 +175,20 @@ class TelemetryConfig:
                      "peer_id_bytes", "topic_bytes"):
             if getattr(self, name) < 1:
                 raise ValueError(f"TelemetryConfig: {name} must be >= 1")
+        for name in ("latency_buckets", "degree_buckets"):
+            if getattr(self, name) < 2:
+                raise ValueError(
+                    f"TelemetryConfig: {name} must be >= 2 (one real "
+                    "bucket plus the overflow bucket)")
+        edges = tuple(float(e) for e in self.score_bucket_edges)
+        object.__setattr__(self, "score_bucket_edges", edges)
+        if len(edges) < 1:
+            raise ValueError(
+                "TelemetryConfig: score_bucket_edges needs >= 1 edge")
+        if any(b <= a for a, b in zip(edges, edges[1:])):
+            raise ValueError(
+                "TelemetryConfig: score_bucket_edges must be strictly "
+                f"increasing (got {edges})")
 
 
 @dataclass(frozen=True)
@@ -278,6 +309,14 @@ class TelemetryFrame:
     score_frac_below_gossip: jnp.ndarray  # float32 (< gossip threshold)
     down_peers: jnp.ndarray           # int32
     dropped_edge_ticks: jnp.ndarray   # int32 (link loss + partition)
+    # histogram groups (round 10): small int32 bucket-count vectors,
+    # None when the group is off (the frame pytree then matches the
+    # pre-histogram shape exactly).  Bucket semantics are documented
+    # on TelemetryConfig; every histogram sums exactly to its scalar
+    # population counter (pinned by tests/test_telemetry.py).
+    latency_hist: jnp.ndarray | None = None   # i32 [latency_buckets]
+    mesh_deg_hist: jnp.ndarray | None = None  # i32 [degree_buckets]
+    score_hist: jnp.ndarray | None = None     # i32 [n_edges + 1]
 
 
 _I32_FIELDS = ("payload_sent", "ihave_rpcs", "ihave_ids", "iwant_rpcs",
@@ -289,15 +328,21 @@ _F32_FIELDS = ("bytes_payload", "bytes_control", "mesh_deg_mean",
                "score_frac_below_gossip")
 
 
+_HIST_FIELDS = ("latency_hist", "mesh_deg_hist", "score_hist")
+
+
 def make_frame(**kw) -> TelemetryFrame:
     """A TelemetryFrame with the given fields set and the rest zero —
     how the floodsub/randomsub subsets (and disabled groups) fill in.
-    Values are cast to the field's canonical dtype."""
+    Values are cast to the field's canonical dtype.  Histogram fields
+    default to None (group off) rather than zero."""
     vals = {}
     for name in _I32_FIELDS:
         vals[name] = jnp.asarray(kw.pop(name, 0), dtype=jnp.int32)
     for name in _F32_FIELDS:
         vals[name] = jnp.asarray(kw.pop(name, 0.0), dtype=jnp.float32)
+    for name in _HIST_FIELDS:
+        vals[name] = kw.pop(name, None)
     if kw:
         raise TypeError(f"unknown TelemetryFrame fields: {sorted(kw)}")
     return TelemetryFrame(**vals)
@@ -335,6 +380,89 @@ def score_stats(score: jnp.ndarray, mask: jnp.ndarray,
             jnp.where(any_live, mn, zf),
             jnp.where(any_live, frac_neg, zf),
             jnp.where(any_live, frac_gsp, zf))
+
+
+# --------------------------------------------------------------------------
+# In-scan fixed-bucket histograms (round 10).  Pure integer bucket
+# tallies over values the step already holds, so they are bit-identical
+# across execution paths by construction: the degree/score gauges are
+# recomputed by the kernel epilogue via the same helpers on [:n_true]
+# views, while the latency buckets ride IN the pallas kernel as extra
+# tel-reduction rows (ops/pallas/receive.py tel_lat_buckets, fed by
+# latency_bucket_masks below) and are psum'd with the counters on the
+# sharded path.
+# --------------------------------------------------------------------------
+
+
+def latency_histogram(delivered_now: jnp.ndarray,
+                      publish_tick: jnp.ndarray, tick,
+                      n_buckets: int) -> jnp.ndarray:
+    """i32 [n_buckets]: THIS tick's deliveries bucketed by delivery
+    latency in ticks since publish (bucket b = latency exactly b; the
+    last bucket absorbs >= n_buckets - 1).  Sums exactly to the tick's
+    delivered count — the same per-message popcounts the curve runners
+    collect (count_bits_per_position), scattered by each message's
+    publish-relative age."""
+    m = publish_tick.shape[0]
+    per_msg = count_bits_per_position(delivered_now, m)      # i32 [M]
+    lat = jnp.clip(tick - publish_tick, 0, n_buckets - 1)    # i32 [M]
+    onehot = (lat[None, :]
+              == jnp.arange(n_buckets, dtype=lat.dtype)[:, None])
+    return jnp.where(onehot, per_msg[None, :], 0).sum(
+        axis=1, dtype=jnp.int32)
+
+
+def latency_bucket_masks(publish_tick: jnp.ndarray, tick,
+                         n_buckets: int, w_words: int) -> jnp.ndarray:
+    """u32 [n_buckets, w_words]: per-tick message-bit masks — message
+    m's bit (word m // 32, bit m % 32) is set in row b iff its
+    delivery latency THIS tick would land in bucket b (the same
+    clip(tick - publish_tick) bucketing as latency_histogram).  The
+    pallas receive kernel takes these as its SMEM bucket operand and
+    popcounts ``delivered & mask[b]`` per word — the in-kernel twin of
+    latency_histogram's scatter, exactly equal by construction."""
+    m = publish_tick.shape[0]
+    lat = jnp.clip(tick - publish_tick, 0, n_buckets - 1)    # i32 [M]
+    sel = (lat[None, :]
+           == jnp.arange(n_buckets, dtype=lat.dtype)[:, None])
+    bit = jnp.uint32(1) << (
+        jnp.arange(m, dtype=jnp.uint32) % jnp.uint32(32))
+    bits = jnp.where(sel, bit[None, :], jnp.uint32(0))
+    pad = w_words * 32 - m
+    if pad:
+        bits = jnp.pad(bits, ((0, 0), (0, pad)))
+    # disjoint bits per word: the sum IS the OR
+    return bits.reshape(n_buckets, w_words, 32).sum(
+        axis=2, dtype=jnp.uint32)
+
+
+def degree_histogram(deg: jnp.ndarray, subscribed: jnp.ndarray,
+                     n_buckets: int) -> jnp.ndarray:
+    """i32 [n_buckets]: subscribed peers bucketed by mesh degree
+    (bucket b = degree exactly b; last bucket absorbs the overflow).
+    Sums exactly to the subscribed-peer count."""
+    b = jnp.clip(deg, 0, n_buckets - 1)
+    onehot = ((b[None, :]
+               == jnp.arange(n_buckets, dtype=b.dtype)[:, None])
+              & subscribed[None, :])
+    return onehot.sum(axis=1, dtype=jnp.int32)
+
+
+def score_histogram(score: jnp.ndarray, mask: jnp.ndarray,
+                    edges: tuple) -> jnp.ndarray:
+    """i32 [len(edges) + 1]: masked elements of ``score`` bucketed
+    against the static ascending ``edges`` — bucket 0 is below the
+    first edge, bucket i is [edges[i-1], edges[i]), the last bucket is
+    >= the final edge.  Sums exactly to the masked-element count."""
+    idx = jnp.zeros(score.shape, dtype=jnp.int32)
+    for e in edges:
+        idx = idx + (score >= jnp.float32(e)).astype(jnp.int32)
+    n_b = len(edges) + 1
+    lanes = jnp.arange(n_b, dtype=jnp.int32).reshape(
+        (n_b,) + (1,) * score.ndim)
+    onehot = (idx[None] == lanes) & mask[None]
+    return onehot.sum(axis=tuple(range(1, onehot.ndim)),
+                      dtype=jnp.int32)
 
 
 # --------------------------------------------------------------------------
@@ -388,10 +516,16 @@ def telemetry_run_batch(params, state, n_ticks: int, step):
 
 def frames_to_arrays(frames: TelemetryFrame) -> dict:
     """Frame pytree -> {field: np.ndarray} (whatever leading axes the
-    runner produced)."""
+    runner produced).  Histogram fields appear only when their group
+    was enabled (None otherwise)."""
     import numpy as np
-    return {name: np.asarray(getattr(frames, name))
-            for name in _I32_FIELDS + _F32_FIELDS}
+    out = {name: np.asarray(getattr(frames, name))
+           for name in _I32_FIELDS + _F32_FIELDS}
+    for name in _HIST_FIELDS:
+        val = getattr(frames, name)
+        if val is not None:
+            out[name] = np.asarray(val)
+    return out
 
 
 def summarize_frames(frames: TelemetryFrame) -> dict:
@@ -412,4 +546,50 @@ def summarize_frames(frames: TelemetryFrame) -> dict:
         bytes_control / bytes_payload if bytes_payload > 0 else 0.0)
     out["final_mesh_deg_mean"] = float(
         np.asarray(arrs["mesh_deg_mean"]).reshape(-1)[-1])
+    if "latency_hist" in arrs:
+        hist = arrs["latency_hist"].reshape(
+            -1, arrs["latency_hist"].shape[-1]).sum(axis=0)
+        out["latency_hist"] = [int(c) for c in hist]
+        out["latency_ticks"] = hist_percentiles(hist)
+    return out
+
+
+def hist_percentiles(hist, pcts=(50, 90, 99)) -> dict:
+    """Percentile BUCKET values from a summed histogram (host side).
+
+    Delegates to the shared ``histutil.hist_percentiles`` — the ONE
+    home of the rank convention (rank = k * p // 100, matching
+    tools/tracestat.py's ``_percentiles`` over a sorted list), so the
+    device-side summaries and the tracestat --check gate can never
+    desynchronize.  Returns {"p50": ..., ..., "count": k}; all-zero
+    histograms report count 0 and percentiles None."""
+    from ..histutil import hist_percentiles as _hp
+    return _hp(hist, pcts)
+
+
+def latency_hists_by_topic(counts, publish_tick, msg_topic,
+                           n_buckets: int, start_tick: int = 0,
+                           topic_name=lambda t: f"topic-{t}") -> dict:
+    """Host-side per-topic latency histograms from the per-tick
+    delivered counts a curve runner collected (counts int [T, M] —
+    telemetry_run_curve / gossip_run_curve ys).
+
+    Exact by construction: delivery latency of every copy of message j
+    delivered at tick t is t - publish_tick[j].  The summed per-topic
+    histograms add up to the device-side ``latency_hist`` frames
+    (pinned by tests/test_telemetry.py) — this is the topic split the
+    scalar device histogram cannot carry."""
+    import numpy as np
+    counts = np.asarray(counts)
+    pub = np.asarray(publish_tick)
+    tpc = np.asarray(msg_topic)
+    out: dict = {}
+    t_ticks, m = counts.shape
+    for tau in sorted(set(int(x) for x in tpc)):
+        hist = np.zeros(n_buckets, dtype=np.int64)
+        for j in np.flatnonzero(tpc == tau):
+            lat = np.clip(start_tick + np.arange(t_ticks) - int(pub[j]),
+                          0, n_buckets - 1)
+            np.add.at(hist, lat, counts[:, j])
+        out[topic_name(tau)] = [int(c) for c in hist]
     return out

@@ -176,6 +176,11 @@ CTRL2_A_B = 3      # slot-B "no PRUNE would come back"
  TEL_NEW_IDS,       # new acquisitions (recv - new = dup_suppressed)
  ) = range(8)
 TEL_ROWS = 8
+# with tel_lat_buckets = L > 0 (round 10), rows TEL_ROWS..TEL_ROWS+L-1
+# append the delivery-latency bucket tallies: row TEL_ROWS + b counts
+# this tick's delivered message copies whose latency lands in bucket b
+# (the per-tick bucket masks arrive as one u32 SMEM word per (b, w) —
+# models/telemetry.py latency_bucket_masks)
 
 
 def _align_up(x: int, a: int) -> int:
@@ -276,7 +281,7 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
                     force_extended=False, stream_n=None,
                     with_px=False, with_same_ip=False,
                     with_static=True, with_faults=False,
-                    with_telemetry=False):
+                    with_telemetry=False, tel_lat_buckets=0):
     C = cfg.n_candidates
     B = block
     cinv = cfg.cinv
@@ -303,6 +308,8 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     gseed_ref = nxt()       # u32 [2]: mixed lane seeds for tick + 1
     #                         [0] gater draw (phase 6), [1] gossip
     #                         targets (phase 1)
+    latmask_ref = (nxt() if with_telemetry and tel_lat_buckets
+                   else None)  # u32 [L, W] per-tick bucket masks
     base_ref = nxt()        # u32 [1]: global peer index of local
     #                         position 0 (nonzero on the sharded
     #                         path: each shard's kernel must draw
@@ -347,6 +354,10 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     # configs only — the send-ok ∧ cand-alive bits gating the flood
     alive_ref = nxt() if with_faults else None
     fok_ref = nxt() if (with_faults and iwant_spam) else None
+    # effective deliver words (deliver & ~invalid, premasked by the
+    # caller): the latency tallies count delivered copies only
+    dlv_ref = (nxt() if with_telemetry and tel_lat_buckets
+               else None)
     out_acq = nxt()
     out_mesh = nxt()
     out_mesh_b = nxt() if paired else None
@@ -657,6 +668,19 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
         # received copies, so they stay out of both sides)
         for w in range(W):
             t_new = t_new + pcount(jnp.where(subbed, heard[w], Z))
+    if with_telemetry and tel_lat_buckets:
+        # delivery-latency bucket tallies (round 10): the emitted
+        # acquisitions (heard + injected, exactly the out_acq words)
+        # masked to delivered copies, popcounted against each bucket's
+        # per-tick message mask — the in-kernel twin of
+        # models/telemetry.latency_histogram's scatter
+        dlv_eff = dlv_ref[...]
+        t_lat = [zi for _ in range(tel_lat_buckets)]
+        for w in range(W):
+            dw = ((jnp.where(subbed, heard[w], Z) | inj_a[w])
+                  & dlv_eff[w])
+            for b in range(tel_lat_buckets):
+                t_lat[b] = t_lat[b] + pcount(dw & latmask_ref[b, w])
     # backoff = remaining ticks: triggers restart at B-1, else
     # decrement toward 0 (i32 detour: mosaic lacks 16-bit min/max)
     bo32 = bo_in[...].astype(jnp.int32)
@@ -888,10 +912,14 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
         # once-per-tick reduction emission: mask pad lanes (they read
         # wrapped — real — sender data and would tally phantoms),
         # fold [B] lanes to 128 partials, and accumulate across the
-        # grid into the single revisited [TEL_ROWS, 128] block
-        rows8 = jnp.stack([t_pay, t_ihv, t_srv, t_recv,
-                           t_req, t_ihr, t_iwr, t_new])
-        lane_i = (jax.lax.broadcasted_iota(jnp.int32, (TEL_ROWS, B), 1)
+        # grid into the single revisited [TEL_ROWS + L, 128] block
+        rows_l = [t_pay, t_ihv, t_srv, t_recv,
+                  t_req, t_ihr, t_iwr, t_new]
+        if tel_lat_buckets:
+            rows_l += t_lat
+        n_rows = len(rows_l)
+        rows8 = jnp.stack(rows_l)
+        lane_i = (jax.lax.broadcasted_iota(jnp.int32, (n_rows, B), 1)
                   + i * B)
         tele = jnp.where(lane_i < n_true, rows8, i0)
         blk = tele[:, :128]
@@ -954,7 +982,8 @@ def sharded_receive(cfg, sc, n_true: int, block: int, counter_dtype,
                     head, ctrl_rows, fresh_st, adv_st, blocked,
                     inj_st=None, with_px=False, with_same_ip=False,
                     ctrl2_rows=None, freshb_st=None, with_static=True,
-                    with_faults=False, with_telemetry=False):
+                    with_faults=False, with_telemetry=False,
+                    tel_lat_buckets=0):
     """Multi-chip kernel dispatch: shard_map over the peer axis, one
     pallas kernel invocation per shard with ring-halo exchange.
 
@@ -971,7 +1000,8 @@ def sharded_receive(cfg, sc, n_true: int, block: int, counter_dtype,
     halo ring must be the true ring) and n_true must divide evenly
     into D shards of whole blocks (n_true % (D * block) == 0).
 
-    ``head`` = [valid (sc only), gseeds]; ``ctrl_rows`` u8 [C, N];
+    ``head`` = [valid (sc only), gseeds(, latmask — tel_lat_buckets
+    only, replicated)]; ``ctrl_rows`` u8 [C, N];
     ``fresh_st``/``adv_st`` u32 [W, N]; ``blocked`` = the per-peer
     operands in make_receive_update order.  Returns the kernel's
     outputs with global [*, N] shapes.
@@ -998,7 +1028,8 @@ def sharded_receive(cfg, sc, n_true: int, block: int, counter_dtype,
         track_promises=track_promises, interpret=interpret,
         force_extended=True, stream_n=n_true, with_px=with_px,
         with_same_ip=with_same_ip, with_static=with_static,
-        with_faults=with_faults, with_telemetry=with_telemetry)
+        with_faults=with_faults, with_telemetry=with_telemetry,
+        tel_lat_buckets=tel_lat_buckets)
     n_head = len(head)
     paired = cfg.paired_topics
     n_gates = n_gate_rows(sc is not None, paired)
@@ -1069,11 +1100,15 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
                         with_same_ip: bool = False,
                         with_static: bool = True,
                         with_faults: bool = False,
-                        with_telemetry: bool = False):
+                        with_telemetry: bool = False,
+                        tel_lat_buckets: int = 0):
     """Build the kernel caller.
 
     Operand order (args): [valid u32 [W] (sc only)], gseeds u32 [2]
-    (tick+1 gater + targets lane seeds), base u32 [1] (global peer
+    (tick+1 gater + targets lane seeds), [latmask u32 [L, W]
+    (tel_lat_buckets = L > 0 only: the tick's delivery-latency bucket
+    masks, models/telemetry.py latency_bucket_masks)], base u32 [1]
+    (global peer
     index of local position 0 — 0 off the sharded path), ctrl_flat u8
     [C*L8], fresh_flat u32 [W*L32], adv_flat u32 [W*L32],
     [inj_flat u32 [W*L32] (flood_publish only)], [pay, gsp,
@@ -1086,15 +1121,18 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
     [cand_same_ip u32 [C, N_pad] (with_same_ip only)] (sc only)],
     [alive_w u32 [N_pad] (with_faults only: the receiver-alive
     all-ones/all-zeros word), [flood_ok u32 [N_pad] (with_faults AND
-    sybil_iwant_spam: send-ok ∧ cand-alive bits)]].
+    sybil_iwant_spam: send-ok ∧ cand-alive bits)]], [deliver_eff u32
+    [W, N_pad] (tel_lat_buckets only: deliver & ~invalid words — the
+    latency tallies count delivered copies)].
 
     Returns (new_acq [W, N_pad], mesh [N_pad], backoff [C, N_pad],
     *gates (G separate u32 [N_pad] words — compute_gates order),
     [, fd, inv, bp, tim, iwant_serves][, px_rot u32 [N_pad]
     (with_px only — received PRUNEs/PRUNE-responses for the XLA
-    rotation epilogue)][, tel i32 [TEL_ROWS, 128] (with_telemetry
-    only — lane-partial counter tallies, sum axis 1 for the network
-    totals)]) where G = 7 scored / 2 unscored.
+    rotation epilogue)][, tel i32 [TEL_ROWS + L, 128] (with_telemetry
+    only — lane-partial counter tallies, rows TEL_ROWS.. the latency
+    buckets; sum axis 1 for the network totals)]) where G = 7 scored
+    / 2 unscored.
 
     NOTE the px caveat: with_px configs get their TARGETS gate row
     re-emitted by the XLA epilogue from the post-rotation active set
@@ -1124,7 +1162,8 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
         track_promises=track_promises, force_extended=force_extended,
         stream_n=stream_n, with_px=with_px,
         with_same_ip=with_same_ip, with_static=with_static,
-        with_faults=with_faults, with_telemetry=with_telemetry)
+        with_faults=with_faults, with_telemetry=with_telemetry,
+        tel_lat_buckets=tel_lat_buckets)
 
     b1 = lambda: pl.BlockSpec((B,), lambda i: (i,))  # noqa: E731
     bw = lambda: pl.BlockSpec((W, B), lambda i: (0, i))  # noqa: E731
@@ -1135,6 +1174,8 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
     if has_sc:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))  # valid
     in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))      # gseeds
+    if with_telemetry and tel_lat_buckets:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))  # latmask
     in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))      # base
     # flats: ctrl(, ctrl2), fresh(, fresh_b), adv(, injected)
     in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * (n_ctrl + n_pay)
@@ -1155,6 +1196,8 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
         in_specs += [b1()]        # receiver-alive word
         if has_sc and sc.sybil_iwant_spam:
             in_specs += [b1()]    # send-ok ∧ cand-alive (flood gate)
+    if with_telemetry and tel_lat_buckets:
+        in_specs += [bw()]        # effective deliver words
 
     out_shape = [
         jax.ShapeDtypeStruct((W, n_pad), jnp.uint32),       # new_acq
@@ -1193,8 +1236,9 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
     if with_telemetry:
         # single block revisited across the grid (constant index map):
         # the kernel initializes it on block 0 and accumulates after
-        out_shape += [jax.ShapeDtypeStruct((TEL_ROWS, 128), jnp.int32)]
-        out_specs += [pl.BlockSpec((TEL_ROWS, 128), lambda i: (0, 0))]
+        n_tel = TEL_ROWS + tel_lat_buckets
+        out_shape += [jax.ShapeDtypeStruct((n_tel, 128), jnp.int32)]
+        out_specs += [pl.BlockSpec((n_tel, 128), lambda i: (0, 0))]
 
     scratch = (
         [pltpu.VMEM((B + ALIGN8,), jnp.uint8)]

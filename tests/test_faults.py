@@ -362,30 +362,88 @@ def test_pallas_step_accepts_fault_configs():
     assert int(np.asarray(out.tick)) == 3
 
 
-def test_dense_randomsub_refuses_faults():
-    n = 60
+def test_dense_randomsub_threads_faults_offline_invariant():
+    """Round 10: the dense MXU path THREADS fault schedules
+    (compile_faults_dense) — the offline-peer invariant holds on it,
+    and the per-edge drop_prob form (circulant-keyed) still rejects
+    with a message naming the constraint."""
+    n, m = 60, 4
     cfg = rs.RandomSubSimConfig(
         offsets=tuple(int(o)
                       for o in make_circulant_offsets(1, 6, n, seed=0)))
     subs = np.ones((n, 1), dtype=bool)
-    with pytest.raises(ValueError, match="dense"):
-        rs.make_randomsub_sim(
-            cfg, subs, np.zeros(1, np.int64), np.zeros(1, np.int64),
-            np.zeros(1, np.int32), dense=True,
-            fault_schedule=fl.FaultSchedule(n_peers=n, horizon=5))
-
-
-def test_flood_gather_path_refuses_faults():
-    n = 40
-    offs = tuple(int(o) for o in make_circulant_offsets(1, 4, n, seed=0))
-    subs = np.ones((n, 1), dtype=bool)
-    params, state = fs.make_flood_sim(
-        None, None, subs, None, np.zeros(1, np.int64),
-        np.zeros(1, np.int64), np.zeros(1, np.int32),
-        fault_schedule=fl.FaultSchedule(n_peers=n, horizon=5),
-        fault_offsets=offs)
+    origin = np.array([3, 10, 20, 30])
+    sched = fl.FaultSchedule(n_peers=n, horizon=40,
+                             down_intervals=[(3, 0, 40)], seed=1)
+    params, state = rs.make_randomsub_sim(
+        cfg, subs, np.zeros(m, np.int64), origin, np.zeros(m, np.int32),
+        dense=True, fault_schedule=sched)
+    out = rs.randomsub_run(params, state, 40,
+                           rs.make_randomsub_dense_step(cfg))
+    ft = np.asarray(rs.first_tick_matrix(out, m))
+    assert (ft[3] < 0).all()
+    assert np.asarray(rs.reach_counts(params, out))[0] == 0
+    per_edge = np.full((len(cfg.offsets), n), 0.5, dtype=np.float32)
     with pytest.raises(ValueError, match="circulant"):
-        fs.flood_step(params, state)
+        rs.make_randomsub_sim(
+            cfg, subs, np.zeros(m, np.int64), origin,
+            np.zeros(m, np.int32), dense=True,
+            fault_schedule=fl.FaultSchedule(
+                n_peers=n, horizon=5, drop_prob=per_edge))
+
+
+def test_flood_gather_path_threads_faults_offline_invariant():
+    """Round 10: the gather table path THREADS fault schedules
+    (compile_faults_gather) — flood_step honors churn on a symmetric
+    nbrs table, with the same offline-peer invariant as the circulant
+    core."""
+    n, m = 120, 4
+    offs = tuple(int(o) for o in make_circulant_offsets(1, 6, n, seed=2))
+    nbrs = np.stack([(np.arange(n) + o) % n for o in offs], axis=1)
+    mask = np.ones_like(nbrs, dtype=bool)
+    subs = np.ones((n, 1), dtype=bool)
+    origin = np.array([3, 10, 20, 30])
+    sched = fl.FaultSchedule(n_peers=n, horizon=30,
+                             down_intervals=[(3, 0, 30)], seed=1)
+    params, state = fs.make_flood_sim(
+        nbrs, mask, subs, None, np.zeros(m, np.int64), origin,
+        np.zeros(m, np.int32), fault_schedule=sched)
+    out = fs.flood_run(params, state, 30)
+    ft = np.asarray(fs.first_tick_matrix(out, m))
+    assert (ft[3] < 0).all()
+    reach = np.asarray(fs.reach_counts(params, out))
+    assert reach[0] == 0 and (reach[1:] == n - 1).all()
+
+
+def test_flood_gather_faulted_matches_circulant_core():
+    """The SAME schedule on the same ring must produce the same
+    delivery outcome whether the topology is expressed as circulant
+    offsets or as an equivalent gather table — churn masks are
+    topology-independent (link coins differ by construction, so this
+    pins churn + partitions only)."""
+    n, m = 120, 4
+    offs = tuple(int(o) for o in make_circulant_offsets(1, 6, n, seed=2))
+    nbrs = np.stack([(np.arange(n) + o) % n for o in offs], axis=1)
+    subs = np.ones((n, 1), dtype=bool)
+    origin = np.array([3, 10, 20, 30])
+    sched = fl.FaultSchedule(
+        n_peers=n, horizon=30, down_intervals=[(3, 2, 9), (50, 0, 30)],
+        partition_group=(np.arange(n) % 2).astype(np.int32),
+        partition_windows=((4, 8),), seed=1)
+    p_g, s_g = fs.make_flood_sim(
+        nbrs, np.ones_like(nbrs, dtype=bool), subs, None,
+        np.zeros(m, np.int64), origin, np.zeros(m, np.int32),
+        fault_schedule=sched)
+    out_g = fs.flood_run(p_g, s_g, 30)
+    p_c, s_c = fs.make_flood_sim(
+        None, None, subs, None, np.zeros(m, np.int64), origin,
+        np.zeros(m, np.int32), fault_schedule=sched,
+        fault_offsets=offs)
+    core = fs.make_circulant_step_core(offs)
+    out_c = fs.flood_run(p_c, s_c, 30, lambda p, s: core(p, s)[0])
+    np.testing.assert_array_equal(
+        np.asarray(fs.first_tick_matrix(out_g, m)),
+        np.asarray(fs.first_tick_matrix(out_c, m)))
 
 
 # --------------------------------------------------------------------------

@@ -172,15 +172,20 @@ def test_only_graftlint_fixture_dir_is_exempt(tmp_path):
 
 def test_declared_matrix_shape():
     combos = ja.declared_matrix()
-    assert len(combos) == 32
-    # all three sims x telemetry x faults x batched; split axis only
-    # on gossipsub
+    assert len(combos) == 44
+    # base 32: all three sims x telemetry x faults x batched; split
+    # axis only on gossipsub.  Round-10 variants: gather/dense
+    # (tel x faults), rpc (tel, faulted), hist (faults, scored).
     key = lambda c: (c["sim"], c["split"], c["telemetry"],  # noqa: E731
-                     c["faults"], c["batched"])
-    assert len({key(c) for c in combos}) == 32
-    for sim, n in (("gossipsub", 16), ("floodsub", 8),
-                   ("randomsub", 8)):
+                     c["faults"], c["batched"], c["variant"])
+    assert len({key(c) for c in combos}) == 44
+    assert sum(not c["variant"] for c in combos) == 32
+    for sim, n in (("gossipsub", 20), ("floodsub", 12),
+                   ("randomsub", 12)):
         assert sum(c["sim"] == sim for c in combos) == n
+    for var, n in (("gather", 4), ("dense", 4), ("rpc", 2),
+                   ("hist", 2)):
+        assert sum(c["variant"] == var for c in combos) == n
     axes = {ax: {c[ax] for c in combos}
             for ax in ("telemetry", "faults", "batched")}
     assert all(v == {False, True} for v in axes.values())
@@ -196,9 +201,10 @@ def test_audit_covers_matrix_without_compiling_a_sim():
 
     cases = ja.build_cases()           # builds arrays; may compile
     declared = {(c["sim"], c["split"], c["telemetry"], c["faults"],
-                 c["batched"]) for c in ja.declared_matrix()}
-    built = {(c.sim, c.split, c.telemetry, c.faults, c.batched)
-             for c in cases}
+                 c["batched"], c["variant"])
+                for c in ja.declared_matrix()}
+    built = {(c.sim, c.split, c.telemetry, c.faults, c.batched,
+              c.variant) for c in cases}
     assert built == declared
 
     compiled = []
@@ -287,16 +293,17 @@ def test_contract_declarations_complete():
 
 
 def test_contract_refusals_and_build_time_hold():
-    """The refuse-telemetry / refuse-faults contracts of the gather
-    and dense paths — and the build-time reject claims — verified
-    directly (the fast, no-trace subset).  The pallas kernel's
-    entries left _REFUSALS in round 9: it THREADS faults and
-    telemetry now (see test_contract_fault_threading_fast and
-    test_contract_telemetry_kernel_threaded_fast)."""
+    """The build-time reject claims verified directly (the fast,
+    no-trace subset).  _REFUSALS is EMPTY since round 10 — the pallas
+    kernel flipped to threaded in round 9 and the flood-gather /
+    randomsub-dense paths in round 10 (see
+    test_contract_fault_threading_fast and
+    test_contract_telemetry_kernel_threaded_fast) — and must stay
+    empty unless a future path genuinely refuses observability
+    configs."""
     from tools.graftlint import contracts as ct
 
-    for key, (probe, match) in ct._REFUSALS.items():
-        assert ct._expect_raise(probe, match, label=str(key)) == [], key
+    assert ct._REFUSALS == {}
     for key, (probe, match) in ct._BUILD_TIME.items():
         assert ct._expect_raise(probe, match, label=str(key)) == [], key
     # and the match is load-bearing: the right exception with the
@@ -309,14 +316,17 @@ def test_contract_refusals_and_build_time_hold():
 
 def test_contract_fault_threading_fast():
     """FaultSchedule data fields provably reach the device params on
-    all three circulant paths AND the round-9 pallas kernel path
-    (value-diff probes on the padded build, no tracing)."""
+    all three circulant paths, the round-9 pallas kernel path, AND
+    the round-10 gather/dense paths (value-diff probes on the build,
+    no tracing).  drop_prob on gather/dense is scalar-only, so the
+    per-edge form is exercised on the circulant paths only."""
     from tools.graftlint import contracts as ct
 
     for field in ("down_intervals", "drop_prob", "partition_group",
                   "partition_windows", "seed"):
         for path in ("gossip-xla", "gossip-kernel", "flood-circulant",
-                     "randomsub-circulant"):
+                     "randomsub-circulant", "flood-gather",
+                     "randomsub-dense"):
             assert ct._fault_threaded(field, path), (field, path)
 
 

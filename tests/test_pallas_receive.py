@@ -781,3 +781,87 @@ def test_kernel_faults_telemetry_full_matrix(variant):
     if variant == "px":
         np.testing.assert_array_equal(np.asarray(out_x.active),
                                       np.asarray(out_k.active)[:n])
+
+
+def test_kernel_histogram_frames_bit_identical_to_xla():
+    """Round 10: the in-kernel latency-bucket tallies (TEL_ROWS..
+    rows of the tel output) and the epilogue degree/score histograms
+    equal the XLA path's frames bit for bit on a scored + faulted
+    run, and the latency histogram sums to the per-tick delivered
+    counts."""
+    import go_libp2p_pubsub_tpu.models.telemetry as tl
+
+    n = 900
+    sched = _sched(n, seed=7)
+    tcfg = tl.TelemetryConfig(latency_hist=True, degree_hist=True,
+                              score_hist=True, latency_buckets=12,
+                              degree_buckets=10)
+    m = 8
+    cfg, sc, p_x, s_x = _build(n, 4, 8, m, score=True, faults=sched)
+    cfg2, sc2, p_k, s_k = _build(n, 4, 8, m, score=True,
+                                 pad_block=128, faults=sched)
+    out_x, counts_x, fr_x = tl.telemetry_run_curve(
+        p_x, s_x, 20, gs.make_gossip_step(cfg, sc, telemetry=tcfg), m)
+    out_k, counts_k, fr_k = tl.telemetry_run_curve(
+        p_k, s_k, 20, gs.make_gossip_step(
+            cfg2, sc2, receive_block=128, receive_interpret=True,
+            telemetry=tcfg), m)
+    np.testing.assert_array_equal(np.asarray(counts_x),
+                                  np.asarray(counts_k))
+    for name in ("latency_hist", "mesh_deg_hist", "score_hist"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fr_x, name)),
+            np.asarray(getattr(fr_k, name)), err_msg=name)
+    lat = np.asarray(fr_k.latency_hist)
+    np.testing.assert_array_equal(lat.sum(axis=1),
+                                  np.asarray(counts_k).sum(axis=1))
+    assert lat.sum() > 0
+
+
+def test_kernel_latency_hist_without_counters():
+    """latency_hist alone (counters off) still routes the kernel's
+    tel output: the bucket rows ride without the counter groups and
+    match the XLA path bit for bit."""
+    import go_libp2p_pubsub_tpu.models.telemetry as tl
+
+    n = 640
+    tcfg = tl.TelemetryConfig(counters=False, wire=False, mesh=False,
+                              scores=False, faults=False,
+                              latency_hist=True, latency_buckets=8)
+    m = 6
+    cfg, sc, p_x, s_x = _build(n, 4, 8, m, score=True)
+    cfg2, sc2, p_k, s_k = _build(n, 4, 8, m, score=True, pad_block=128)
+    out_x, fr_x = tl.telemetry_run(
+        p_x, s_x, 15, gs.make_gossip_step(cfg, sc, telemetry=tcfg))
+    out_k, fr_k = tl.telemetry_run(
+        p_k, s_k, 15, gs.make_gossip_step(
+            cfg2, sc2, receive_block=128, receive_interpret=True,
+            telemetry=tcfg))
+    np.testing.assert_array_equal(np.asarray(fr_x.latency_hist),
+                                  np.asarray(fr_k.latency_hist))
+    for a, b in zip(__import__("jax").tree_util.tree_leaves(out_x),
+                    __import__("jax").tree_util.tree_leaves(out_k)):
+        if np.asarray(a).shape == np.asarray(b).shape:
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_kernel_rpc_probe_matches_xla_trajectory():
+    """rpc_probe on the kernel path: pure readout (trajectory equals
+    the probe-free kernel run), and the probe's [:n] leaves equal the
+    XLA probe's — one exporter serves both paths."""
+    n, m = 640, 6
+    cfg, sc, p_x, s_x = _build(n, 4, 8, m, score=True)
+    cfg2, sc2, p_k, s_k = _build(n, 4, 8, m, score=True, pad_block=128)
+    out_x, snap_x = gs.gossip_run_rpc_snapshots(
+        p_x, s_x, 12, gs.make_gossip_step(cfg, sc, rpc_probe=True))
+    out_k, snap_k = gs.gossip_run_rpc_snapshots(
+        p_k, s_k, 12, gs.make_gossip_step(
+            cfg2, sc2, receive_block=128, receive_interpret=True,
+            rpc_probe=True))
+    for key in snap_x:
+        a = np.asarray(snap_x[key])
+        b = np.asarray(snap_k[key])
+        np.testing.assert_array_equal(a, b[..., :a.shape[-1]],
+                                      err_msg=key)
+    np.testing.assert_array_equal(np.asarray(out_x.have),
+                                  np.asarray(out_k.have)[:, :n])

@@ -356,3 +356,220 @@ def test_summarize_frames():
     assert s["bytes_payload"] > 0
     assert 0 < s["control_overhead_ratio"] < 10
     assert s["final_mesh_deg_mean"] > 0
+
+
+# --------------------------------------------------------------------------
+# Round-10 histogram groups: sums pinned to the scalar counters,
+# hist-off runs bit-identical, every execution path threads them
+# --------------------------------------------------------------------------
+
+
+def hist_tcfg(**kw):
+    base = dict(latency_hist=True, degree_hist=True, score_hist=True,
+                latency_buckets=12, degree_buckets=12)
+    base.update(kw)
+    return tl.TelemetryConfig(**base)
+
+
+def test_histogram_sums_match_scalar_counters():
+    """Every histogram sums exactly to its population: latency to the
+    tick's delivered-copy count, degree to the subscribed-peer count,
+    score to the live candidate-edge count — per tick, every tick."""
+    from go_libp2p_pubsub_tpu.ops.graph import expand_bits
+
+    cfg, subs, topic, origin, ticks = gossip_inputs(n=400)
+    sc = gs.ScoreSimConfig()
+    params, state = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                                       score_cfg=sc)
+    m = len(topic)
+    _, counts, frames = tl.telemetry_run_curve(
+        params, state, 15, gs.make_gossip_step(
+            cfg, sc, telemetry=hist_tcfg()), m)
+    counts = np.asarray(counts)                       # [T, M]
+    lat = np.asarray(frames.latency_hist)             # [T, L]
+    np.testing.assert_array_equal(lat.sum(axis=1), counts.sum(axis=1))
+    assert lat.sum() > 0
+    deg = np.asarray(frames.mesh_deg_hist)            # [T, B]
+    n_sub = int(np.asarray(params.subscribed).sum())
+    np.testing.assert_array_equal(deg.sum(axis=1),
+                                  np.full(deg.shape[0], n_sub))
+    sco = np.asarray(frames.score_hist)               # [T, E+1]
+    # live candidate edges: subscribed candidates of subscribed peers
+    sub_all = np.where(np.asarray(params.subscribed), 0xFFFFFFFF, 0)
+    mask = np.asarray(expand_bits(
+        params.cand_sub_bits & sub_all.astype(np.uint32),
+        len(cfg.offsets)))
+    np.testing.assert_array_equal(
+        sco.sum(axis=1), np.full(sco.shape[0], mask.sum()))
+
+
+def test_histogram_off_trajectory_identical_and_consistent_stats():
+    """Enabling histogram groups must not perturb the run: the state
+    trajectory AND the scalar frame groups are bit-identical with and
+    without the histograms (the buckets are pure readouts)."""
+    cfg, subs, topic, origin, ticks = gossip_inputs(n=300)
+    sc = gs.ScoreSimConfig()
+    p1, s1 = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                                score_cfg=sc)
+    p2, s2 = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                                score_cfg=sc)
+    fin_off, fr_off = tl.telemetry_run(
+        p1, s1, 15, gs.make_gossip_step(
+            cfg, sc, telemetry=tl.TelemetryConfig()))
+    fin_on, fr_on = tl.telemetry_run(
+        p2, s2, 15, gs.make_gossip_step(cfg, sc,
+                                        telemetry=hist_tcfg()))
+    assert tree_equal(fin_off, fin_on)
+    a_off, a_on = (tl.frames_to_arrays(fr_off),
+                   tl.frames_to_arrays(fr_on))
+    for name in a_off:                    # scalar groups unchanged
+        np.testing.assert_array_equal(a_off[name], a_on[name], err_msg=name)
+    for name in ("latency_hist", "mesh_deg_hist", "score_hist"):
+        assert name in a_on and name not in a_off
+    # degree histogram consistent with the scalar min/max gauges
+    deg = np.asarray(fr_on.mesh_deg_hist)
+    nz = [np.flatnonzero(row) for row in deg]
+    mins = np.array([int(ix[0]) for ix in nz])
+    maxs = np.array([int(ix[-1]) for ix in nz])
+    np.testing.assert_array_equal(
+        mins, np.asarray(fr_on.mesh_deg_min).astype(np.int64))
+    # max clips into the overflow bucket; below it the match is exact
+    cap = deg.shape[1] - 1
+    np.testing.assert_array_equal(
+        maxs, np.minimum(np.asarray(fr_on.mesh_deg_max), cap))
+
+
+def test_latency_histogram_batched_matches_sequential():
+    cfg, subs, topic, origin, ticks = gossip_inputs(n=300)
+    spec = dict(subs=subs, msg_topic=topic, msg_origin=origin,
+                msg_publish_tick=ticks)
+    step = gs.make_gossip_step(cfg, telemetry=hist_tcfg(
+        score_hist=False))
+    seq_frames = []
+    for r in range(2):
+        p, s = gs.make_gossip_sim(cfg, seed=r, **spec)
+        _, fr = tl.telemetry_run(p, s, 10, step)
+        seq_frames.append(np.asarray(fr.latency_hist))
+    pb, sb = gs.stack_sims(cfg, [dict(spec, seed=r) for r in range(2)])
+    _, frb = tl.telemetry_run_batch(pb, sb, 10, step)
+    hist_b = np.asarray(frb.latency_hist)          # [T, B, L]
+    for r in range(2):
+        np.testing.assert_array_equal(hist_b[:, r], seq_frames[r])
+
+
+def test_flood_gather_telemetry_subset_with_faults():
+    """Round 10: the gather table path emits the floodsub frame subset
+    (payload/dup/latency/fault counters; gossip fields zero) and its
+    latency histogram sums to the delivered counts."""
+    import go_libp2p_pubsub_tpu.models.faults as fl
+
+    n, t, m = 300, 3, 6
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    rng = np.random.default_rng(2)
+    topic = rng.integers(0, t, m)
+    origin = rng.integers(0, n // t, m) * t + topic
+    ticks = np.zeros(m, dtype=np.int32)
+    offs = tuple(int(o) for o in make_circulant_offsets(t, 12, n, seed=1))
+    nbrs = np.stack([(np.arange(n) + o) % n for o in offs], axis=1)
+    sched = fl.FaultSchedule(n_peers=n, horizon=15,
+                             down_intervals=((5, 2, 6),),
+                             drop_prob=0.05, seed=3)
+    params, state = fs.make_flood_sim(
+        nbrs, np.ones_like(nbrs, dtype=bool), subs, None, topic,
+        origin, ticks, fault_schedule=sched)
+    core = fs.make_gather_step_core(telemetry=tl.TelemetryConfig(
+        latency_hist=True, latency_buckets=10))
+    fin, counts, frames = tl.telemetry_run_curve(params, state, 15,
+                                                 core, m)
+    arr = tl.frames_to_arrays(frames)
+    assert arr["payload_sent"].sum() > 0
+    assert arr["dup_suppressed"].sum() > 0
+    assert arr["bytes_payload"].sum() > 0
+    assert arr["down_peers"].max() == 1
+    assert arr["dropped_edge_ticks"].sum() > 0
+    assert arr["ihave_ids"].sum() == 0
+    np.testing.assert_array_equal(
+        np.asarray(frames.latency_hist).sum(axis=1),
+        np.asarray(counts).sum(axis=1))
+    # telemetry-off gather trajectory identical (pure readout)
+    p2, s2 = fs.make_flood_sim(
+        nbrs, np.ones_like(nbrs, dtype=bool), subs, None, topic,
+        origin, ticks, fault_schedule=sched)
+    fin2 = fs.flood_run(p2, s2, 15)
+    assert tree_equal(fin, fin2)
+
+
+def test_randomsub_dense_telemetry_subset_with_faults():
+    """Round 10: the dense MXU path emits the randomsub frame subset
+    and stays trajectory-identical with telemetry off."""
+    import go_libp2p_pubsub_tpu.models.faults as fl
+
+    n, t, m = 120, 2, 6
+    cfg = rs.RandomSubSimConfig(
+        offsets=tuple(int(o)
+                      for o in make_circulant_offsets(t, 8, n, seed=3)),
+        n_topics=t, d=3)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    rng = np.random.default_rng(3)
+    topic = rng.integers(0, t, m)
+    origin = rng.integers(0, n // t, m) * t + topic
+    ticks = np.zeros(m, dtype=np.int32)
+    sched = fl.FaultSchedule(n_peers=n, horizon=15,
+                             down_intervals=((5, 2, 6),),
+                             drop_prob=0.05, seed=3)
+    params, state = rs.make_randomsub_sim(
+        cfg, subs, topic, origin, ticks, dense=True,
+        fault_schedule=sched)
+    step = rs.make_randomsub_dense_step(cfg, telemetry=tl.TelemetryConfig(
+        latency_hist=True, latency_buckets=10))
+    fin, counts, frames = tl.telemetry_run_curve(params, state, 15,
+                                                 step, m)
+    arr = tl.frames_to_arrays(frames)
+    assert arr["payload_sent"].sum() > 0
+    assert arr["down_peers"].max() == 1
+    assert arr["ihave_ids"].sum() == 0
+    np.testing.assert_array_equal(
+        np.asarray(frames.latency_hist).sum(axis=1),
+        np.asarray(counts).sum(axis=1))
+    p2, s2 = rs.make_randomsub_sim(
+        cfg, subs, topic, origin, ticks, dense=True,
+        fault_schedule=sched)
+    fin2 = rs.randomsub_run(p2, s2, 15,
+                            rs.make_randomsub_dense_step(cfg))
+    assert tree_equal(fin, fin2)
+
+
+def test_latency_hists_by_topic_sum_to_device_hist():
+    """The host-side per-topic split adds up to the device-side
+    latency_hist frames exactly — two views of the same deliveries."""
+    cfg, subs, topic, origin, ticks = gossip_inputs(n=300)
+    m = len(topic)
+    params, state = gs.make_gossip_sim(cfg, subs, topic, origin, ticks)
+    tcfg = hist_tcfg(degree_hist=False, score_hist=False)
+    _, counts, frames = tl.telemetry_run_curve(
+        params, state, 15, gs.make_gossip_step(cfg, telemetry=tcfg), m)
+    by_topic = tl.latency_hists_by_topic(
+        np.asarray(counts), np.asarray(params.publish_tick), topic,
+        tcfg.latency_buckets)
+    total = np.sum([h for h in by_topic.values()], axis=0)
+    np.testing.assert_array_equal(
+        total, np.asarray(frames.latency_hist).sum(axis=0))
+    assert len(by_topic) == len(set(int(x) for x in topic))
+
+
+def test_hist_percentiles_match_sorted_sample():
+    """hist_percentiles over a unit-bucket histogram equals the sorted
+    -sample rank convention of tools/tracestat.py."""
+    rng = np.random.default_rng(0)
+    sample = rng.integers(0, 12, 500)
+    hist = np.bincount(sample, minlength=16)
+    out = tl.hist_percentiles(hist)
+    srt = np.sort(sample)
+    for p in (50, 90, 99):
+        k = len(srt)
+        assert out[f"p{p}"] == int(srt[min(k - 1, (k * p) // 100)])
+    assert out["count"] == 500
+    empty = tl.hist_percentiles(np.zeros(8, dtype=np.int64))
+    assert empty["count"] == 0 and empty["p99"] is None

@@ -505,3 +505,283 @@ def test_duplicate_events_paired_mode_matches_telemetry():
             np.asarray(snaps["have"]), np.asarray(snaps["mesh"]),
             cfg.offsets, topic,
             slot_b_words=np.asarray(params.slot_b_words))
+
+
+# --------------------------------------------------------------------------
+# Round 10: 13/13 event-type coverage, per-RPC streams, peer events,
+# replay oracle, and the tracestat frames/--check gate
+# --------------------------------------------------------------------------
+
+
+def faulted_run(T=16, n=200, t=2, m=10):
+    """One faulted, scored, sybil-invalid gossipsub run plus every
+    snapshot collector the 13-type export needs."""
+    import go_libp2p_pubsub_tpu.models.faults as fl
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        ScoreSimConfig, gossip_run_acq_snapshots,
+        gossip_run_rpc_snapshots, tree_copy)
+
+    cfg = GossipSimConfig(offsets=make_gossip_offsets(t, 16, n, seed=4),
+                          n_topics=t)
+    rng = np.random.default_rng(4)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    topic = rng.integers(0, t, m)
+    origin = rng.integers(0, n // t, m) * t + topic
+    ticks = rng.integers(0, 6, m).astype(np.int32)
+    invalid = np.zeros(m, dtype=bool)
+    invalid[:2] = True
+    sybil = np.zeros(n, dtype=bool)
+    sybil[origin[:2]] = True
+    sc = ScoreSimConfig()
+    sched = fl.FaultSchedule(
+        n_peers=n, horizon=T, down_intervals=((5, 3, 8), (11, 2, 12)),
+        drop_prob=0.05, seed=9)
+    params, state = make_gossip_sim(
+        cfg, subs, topic, origin, ticks, score_cfg=sc, sybil=sybil,
+        msg_invalid=invalid, fault_schedule=sched)
+    peer_topic = (np.arange(n) % t).astype(np.int64)
+    step = make_gossip_step(cfg, sc)
+    out, snaps = gossip_run_acq_snapshots(params, tree_copy(state), T,
+                                          step)
+    step_rpc = make_gossip_step(cfg, sc, rpc_probe=True)
+    out2, rsnaps = gossip_run_rpc_snapshots(params, tree_copy(state),
+                                            T, step_rpc)
+    # the probe is a pure readout: same trajectory
+    assert np.array_equal(np.asarray(out.have), np.asarray(out2.have))
+    rsnaps = {k: np.asarray(v) for k, v in rsnaps.items()}
+    return (cfg, sched, params, out, snaps, rsnaps, topic, origin,
+            ticks, invalid, peer_topic, n, m, T)
+
+
+def all_13_events(run):
+    from go_libp2p_pubsub_tpu.interop import export as ex
+
+    (cfg, sched, params, out, snaps, rsnaps, topic, origin, ticks,
+     invalid, peer_topic, n, m, T) = run
+    ftm = np.asarray(first_tick_matrix(out, m))
+    base = ex.events_from_sim(ftm, topic, origin, ticks,
+                              fault_schedule=sched,
+                              peer_topic=peer_topic)
+    meshes = ex.mesh_trace_events(np.asarray(snaps["mesh"]),
+                                  cfg.offsets, peer_topic)
+    rejects = ex.reject_events(np.asarray(snaps["have"]), invalid,
+                               topic)
+    dups = ex.duplicate_events(np.asarray(snaps["have"]),
+                               np.asarray(snaps["mesh"]),
+                               cfg.offsets, topic)
+    peers = ex.peer_events(cfg.offsets, n, fault_schedule=sched)
+    rpcs = ex.rpc_events(rsnaps, cfg.offsets, topic, peer_topic)
+    return ex.merge_event_streams(base, meshes, rejects, dups, peers,
+                                  rpcs)
+
+
+def test_full_faulted_run_exports_all_13_types_and_replays(tmp_path):
+    """THE acceptance pin: one faulted gossipsub run exports every one
+    of the reference's 13 TraceEvent types; written with
+    write_pb_trace, read back via interop.replay, the event stream
+    alone reconstructs the simulator's final possession AND mesh."""
+    from go_libp2p_pubsub_tpu.interop import replay as rp
+
+    run = faulted_run()
+    (cfg, sched, params, out, snaps, rsnaps, topic, origin, ticks,
+     invalid, peer_topic, n, m, T) = run
+    merged = all_13_events(run)
+    got = {TraceType.NAMES[e.type] for e in merged}
+    assert got == set(TraceType.NAMES.values())        # 13/13
+    path = tmp_path / "full13.pb"
+    write_pb_trace(str(path), merged)
+    evs = rp.load_pb_trace(str(path))
+    assert len(evs) == len(merged)
+    have_rt = rp.possession_from_trace(evs, n, m)
+    hw = np.asarray(out.have)
+    have_sim = np.zeros((n, m), dtype=bool)
+    for j in range(m):
+        w, b = divmod(j, 32)
+        have_sim[:, j] = (hw[w] >> np.uint32(b)) & 1
+    np.testing.assert_array_equal(have_rt, have_sim)
+    mesh_rt = rp.mesh_from_trace(evs, cfg.offsets, n)
+    np.testing.assert_array_equal(mesh_rt, np.asarray(out.mesh))
+
+
+def test_rpc_stream_aggregates_equal_telemetry_counters():
+    """On a fault-free unscored run, the per-RPC stream's per-tick
+    aggregates equal the telemetry counters EXACTLY: two independent
+    observers (host-side RPC reconstruction vs in-scan reductions) of
+    the same protocol."""
+    import go_libp2p_pubsub_tpu.models.telemetry as tl
+    from go_libp2p_pubsub_tpu.interop import export as ex
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        gossip_run_rpc_snapshots, tree_copy)
+
+    n, t, m, T = 200, 2, 8, 14
+    cfg = GossipSimConfig(offsets=make_gossip_offsets(t, 16, n, seed=4),
+                          n_topics=t)
+    rng = np.random.default_rng(4)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    topic = rng.integers(0, t, m)
+    origin = rng.integers(0, n // t, m) * t + topic
+    ticks = rng.integers(0, 6, m).astype(np.int32)
+    params, state = make_gossip_sim(cfg, subs, topic, origin, ticks)
+    peer_topic = (np.arange(n) % t).astype(np.int64)
+    _, frames = tl.telemetry_run(
+        params, tree_copy(state), T,
+        make_gossip_step(cfg, telemetry=tl.TelemetryConfig(
+            wire=False, scores=False, mesh=False)))
+    arrs = tl.frames_to_arrays(frames)
+    _, rsnaps = gossip_run_rpc_snapshots(
+        params, tree_copy(state), T,
+        make_gossip_step(cfg, rpc_probe=True))
+    rsnaps = {k: np.asarray(v) for k, v in rsnaps.items()}
+    events = ex.rpc_events(rsnaps, cfg.offsets, topic, peer_topic)
+    agg = {k: np.zeros(T, dtype=np.int64) for k in
+           ("msgs", "ihave_rpcs", "ihave_ids", "iwant_rpcs",
+            "iwant_ids", "graft", "prune")}
+    n_send = n_recv = 0
+    for e in events:
+        if e.type == TraceType.RECV_RPC:
+            n_recv += 1
+            continue
+        if e.type != TraceType.SEND_RPC:
+            continue
+        n_send += 1
+        k = e.timestamp // 10**9
+        meta = e.send_rpc.meta
+        agg["msgs"][k] += len(meta.messages or ())
+        c = meta.control
+        if c is not None:
+            for ih in (c.ihave or ()):
+                agg["ihave_rpcs"][k] += 1
+                agg["ihave_ids"][k] += len(ih.message_ids)
+            for iw in (c.iwant or ()):
+                agg["iwant_rpcs"][k] += 1
+                agg["iwant_ids"][k] += len(iw.message_ids)
+            agg["graft"][k] += len(c.graft or ())
+            agg["prune"][k] += len(c.prune or ())
+    assert n_send == n_recv > 0       # healthy edges pair up exactly
+    np.testing.assert_array_equal(
+        agg["msgs"], arrs["payload_sent"] + arrs["iwant_ids_served"])
+    np.testing.assert_array_equal(agg["ihave_rpcs"], arrs["ihave_rpcs"])
+    np.testing.assert_array_equal(agg["ihave_ids"], arrs["ihave_ids"])
+    np.testing.assert_array_equal(agg["iwant_rpcs"], arrs["iwant_rpcs"])
+    np.testing.assert_array_equal(agg["iwant_ids"],
+                                  arrs["iwant_ids_requested"])
+    np.testing.assert_array_equal(agg["graft"], arrs["graft_sends"])
+    np.testing.assert_array_equal(agg["prune"], arrs["prune_sends"])
+
+
+def test_rpc_stream_drop_rpc_under_faults():
+    """Fault-masked edges emit DROP_RPC: with link loss and churn the
+    stream carries drops; dead senders attempt nothing (no event with
+    a down peer_id while down)."""
+    from go_libp2p_pubsub_tpu.interop import export as ex
+
+    run = faulted_run()
+    (cfg, sched, params, out, snaps, rsnaps, topic, origin, ticks,
+     invalid, peer_topic, n, m, T) = run
+    events = ex.rpc_events(rsnaps, cfg.offsets, topic, peer_topic)
+    drops = [e for e in events if e.type == TraceType.DROP_RPC]
+    assert drops
+    down = {(5, k) for k in range(3, 8)} | {(11, k) for k in range(2, 12)}
+    for e in events:
+        p = int(e.peer_id[4:])
+        k = e.timestamp // 10**9
+        assert (p, k) not in down, (p, k, e.type)
+
+
+def test_peer_events_churn_semantics():
+    """ADD_PEER at tick 0 for live circulant partners; REMOVE_PEER by
+    live observers when a peer goes down; symmetric re-ADD on rejoin."""
+    import go_libp2p_pubsub_tpu.models.faults as fl
+    from go_libp2p_pubsub_tpu.interop import export as ex
+
+    n, offs = 12, (1, -1)
+    sched = fl.FaultSchedule(n_peers=n, horizon=10,
+                             down_intervals=((3, 2, 5),), seed=0)
+    events = ex.peer_events(offs, n, fault_schedule=sched)
+    adds0 = [(int(e.peer_id[4:]), int(e.add_peer.peer_id[4:]))
+             for e in events
+             if e.type == TraceType.ADD_PEER and e.timestamp == 0]
+    assert len(adds0) == n * 2                    # full live ring
+    removes = [(e.timestamp // 10**9, int(e.peer_id[4:]),
+                int(e.remove_peer.peer_id[4:])) for e in events
+               if e.type == TraceType.REMOVE_PEER]
+    assert sorted(removes) == [(2, 2, 3), (2, 4, 3)]
+    readds = [(e.timestamp // 10**9, int(e.peer_id[4:]),
+               int(e.add_peer.peer_id[4:])) for e in events
+              if e.type == TraceType.ADD_PEER and e.timestamp > 0]
+    assert sorted(readds) == [(5, 2, 3), (5, 3, 2), (5, 3, 4),
+                              (5, 4, 3)]
+
+
+def test_tracestat_frames_percentiles_and_check_gate(tmp_path):
+    """tracestat prefers histogram frames for latency percentiles,
+    reports 13/13 coverage, and the --check gate passes against its
+    own report, fails on a doctored regression baseline, and exits 2
+    on an empty frames sidecar."""
+    import go_libp2p_pubsub_tpu.models.telemetry as tl
+    from go_libp2p_pubsub_tpu.interop import export as ex
+
+    run = faulted_run()
+    (cfg, sched, params, out, snaps, rsnaps, topic, origin, ticks,
+     invalid, peer_topic, n, m, T) = run
+    merged = all_13_events(run)
+    trace = tmp_path / "full13.pb"
+    write_pb_trace(str(trace), merged)
+    # frames sidecar from the same sim config (telemetry run)
+    tcfg = tl.TelemetryConfig(latency_hist=True, latency_buckets=16)
+    subs = np.zeros((n, cfg.n_topics), dtype=bool)
+    subs[np.arange(n), np.arange(n) % cfg.n_topics] = True
+    p3, s3 = make_gossip_sim(cfg, subs, topic, origin, ticks,
+                             fault_schedule=sched)
+    _, counts, frames = tl.telemetry_run_curve(
+        p3, s3, T, make_gossip_step(cfg, telemetry=tcfg), m)
+    fr_path = tmp_path / "frames.json"
+    ex.write_telemetry_frames(str(fr_path), frames, tcfg,
+                              counts=np.asarray(counts),
+                              publish_tick=ticks, msg_topic=topic)
+    r = _run_tracestat([trace], extra=("--frames", str(fr_path),
+                                       "--json"))
+    assert r.returncode == 0, r.stderr
+    rep = json.loads(r.stdout)
+    assert rep["coverage"]["covered"] == 13
+    assert rep["latency_ticks"]["source"] == "frames"
+    assert rep["latency_ticks"]["p99"] is not None
+    assert "latency_by_topic_ticks" in rep
+    base = tmp_path / "OBS_base.json"
+    base.write_text(json.dumps(rep))
+    r2 = _run_tracestat([trace], extra=("--frames", str(fr_path),
+                                        "--check", str(base)))
+    assert r2.returncode == 0, r2.stderr
+    # doctored regression baseline: tighter p99 -> gate trips
+    doctored = dict(rep)
+    doctored["latency_ticks"] = dict(rep["latency_ticks"])
+    doctored["latency_ticks"]["p99"] = -5
+    bad = tmp_path / "OBS_bad.json"
+    bad.write_text(json.dumps(doctored))
+    r3 = _run_tracestat([trace], extra=("--frames", str(fr_path),
+                                        "--check", str(bad)))
+    assert r3.returncode == 1
+    assert "latency regression" in r3.stderr
+    # coverage regression: drop an event type from the trace
+    few = [e for e in merged if e.type != TraceType.DROP_RPC]
+    part = tmp_path / "partial.pb"
+    write_pb_trace(str(part), few)
+    r4 = _run_tracestat([part], extra=("--frames", str(fr_path),
+                                       "--check", str(base)))
+    assert r4.returncode == 1
+    assert "coverage regression" in r4.stderr
+    assert "DROP_RPC" in r4.stderr
+    # empty frames sidecar: documented exit 2
+    empty = tmp_path / "empty.json"
+    empty.write_text("")
+    r5 = _run_tracestat([trace], extra=("--frames", str(empty)))
+    assert r5.returncode == 2
+    assert "empty frames file" in r5.stderr
+    # histogram-free frames: also exit 2
+    nohist = tmp_path / "nohist.json"
+    nohist.write_text(json.dumps({"ns_per_tick": 10**9}))
+    r6 = _run_tracestat([trace], extra=("--frames", str(nohist)))
+    assert r6.returncode == 2
+    assert "latency_hist" in r6.stderr

@@ -30,7 +30,6 @@ steps); no sim tick ever executes.
 from __future__ import annotations
 
 import dataclasses
-import inspect
 
 #: tiny probe-sim dimensions (distinct from jaxpr_audit's so the two
 #: passes never share a compiled-constant cache entry by accident)
@@ -123,12 +122,14 @@ def _gossip_artifact(path, cfg_kw=None, *, n_topics=T, paired=False,
 
 
 def _telemetry_artifact(path, tel_kw=None):
-    """jaxpr text of a telemetry-enabled step on one circulant path,
+    """jaxpr text of a telemetry-enabled step on one execution path,
     over a scored+faulted base sim (so every frame group is live).
     ``gossip-kernel`` traces the pallas path (padded build + mosaic
     kernel in the jaxpr) — threading proof for the round-9 in-kernel
-    tallies."""
+    tallies; ``flood-gather`` / ``randomsub-dense`` trace the round-10
+    threaded table/MXU paths."""
     import jax
+    import numpy as np
     import go_libp2p_pubsub_tpu.models.floodsub as fs
     import go_libp2p_pubsub_tpu.models.gossipsub as gs
     import go_libp2p_pubsub_tpu.models.randomsub as rs
@@ -169,11 +170,35 @@ def _telemetry_artifact(path, tel_kw=None):
         params, state = rs.make_randomsub_sim(
             rcfg, subs, topic, origin, ticks, fault_schedule=sched)
         step = rs.make_randomsub_step(rcfg, telemetry=tcfg)
+    elif path == "flood-gather":
+        nbrs, mask = _gather_table()
+        params, state = fs.make_flood_sim(
+            nbrs, mask, subs, None, topic, origin, ticks,
+            fault_schedule=sched)
+        step = fs.make_gather_step_core(telemetry=tcfg)
+    elif path == "randomsub-dense":
+        rcfg = rs.RandomSubSimConfig(
+            offsets=rs.make_randomsub_offsets(T, C, N, seed=1),
+            n_topics=T, d=3)
+        params, state = rs.make_randomsub_sim(
+            rcfg, subs, topic, origin, ticks, dense=True,
+            fault_schedule=sched)
+        step = rs.make_randomsub_dense_step(rcfg, telemetry=tcfg)
     else:
         raise ValueError(f"no telemetry probe path {path!r}")
     out = str(jax.make_jaxpr(step)(params, state))
     _ARTIFACT_CACHE[key] = out
     return out
+
+
+def _gather_table():
+    """A small symmetric nbrs table (ring ± 1, 2) for the gather-path
+    probes."""
+    import numpy as np
+    nbrs = np.stack([(np.arange(N) + 1) % N, (np.arange(N) - 1) % N,
+                     (np.arange(N) + 2) % N, (np.arange(N) - 2) % N],
+                    axis=1)
+    return nbrs, np.ones_like(nbrs, dtype=bool)
 
 
 def _faults_artifact(path, sched_kw=None):
@@ -213,6 +238,18 @@ def _faults_artifact(path, sched_kw=None):
             n_topics=T, d=3)
         params, _ = rs.make_randomsub_sim(rcfg, subs, topic, origin,
                                           ticks, fault_schedule=sched)
+    elif path == "flood-gather":
+        nbrs, mask = _gather_table()
+        params, _ = fs.make_flood_sim(
+            nbrs, mask, subs, None, topic, origin, ticks,
+            fault_schedule=sched)
+    elif path == "randomsub-dense":
+        rcfg = rs.RandomSubSimConfig(
+            offsets=rs.make_randomsub_offsets(T, C, N, seed=1),
+            n_topics=T, d=3)
+        params, _ = rs.make_randomsub_sim(rcfg, subs, topic, origin,
+                                          ticks, dense=True,
+                                          fault_schedule=sched)
     else:
         raise ValueError(f"no faults probe path {path!r}")
     return jax.tree_util.tree_leaves(params)
@@ -271,6 +308,18 @@ _TEL_PROBES = {
     "mesh": (dict(mesh=True), dict(mesh=False)),
     "scores": (dict(scores=True), dict(scores=False)),
     "faults": (dict(faults=True), dict(faults=False)),
+    # round-10 histogram knobs: the bucket-shape knobs are live only
+    # with their group on, so their base configs enable the group
+    "latency_hist": (dict(), dict(latency_hist=True)),
+    "latency_buckets": (dict(latency_hist=True),
+                        dict(latency_hist=True, latency_buckets=24)),
+    "degree_hist": (dict(), dict(degree_hist=True)),
+    "degree_buckets": (dict(degree_hist=True),
+                       dict(degree_hist=True, degree_buckets=24)),
+    "score_hist": (dict(), dict(score_hist=True)),
+    "score_bucket_edges": (dict(score_hist=True),
+                           dict(score_hist=True,
+                                score_bucket_edges=(-1.0, 1.0))),
     "payload_data_bytes": (dict(), dict(payload_data_bytes=65)),
     "msg_id_bytes": (dict(), dict(msg_id_bytes=9)),
     "peer_id_bytes": (dict(), dict(peer_id_bytes=9)),
@@ -322,60 +371,16 @@ def _fault_threaded(field, path):
 
 # -- refusal probes (one per (class, path)) --------------------------------
 
-
-def _refuse_flood_gather_faults():
-    import numpy as np
-    import go_libp2p_pubsub_tpu.models.floodsub as fs
-    subs, topic, origin, ticks = _inputs(T)
-    nbrs = np.stack([(np.arange(N) + 1) % N,
-                     (np.arange(N) - 1) % N], axis=1)
-    fs.make_flood_sim(nbrs, np.ones_like(nbrs, dtype=bool), subs, None,
-                      topic, origin, ticks,
-                      fault_schedule=_fault_schedule())   # must raise
-
-
-def _refuse_randomsub_dense_faults():
-    import go_libp2p_pubsub_tpu.models.randomsub as rs
-    rcfg = rs.RandomSubSimConfig(
-        offsets=rs.make_randomsub_offsets(T, C, N, seed=1),
-        n_topics=T, d=3)
-    subs, topic, origin, ticks = _inputs(T)
-    rs.make_randomsub_sim(rcfg, subs, topic, origin, ticks, dense=True,
-                          fault_schedule=_fault_schedule())  # must raise
-
-
-def _refuse_by_api(entry_point_name):
-    """API-absence refusal: the path's entry point must not expose a
-    ``telemetry`` parameter at all."""
-    def probe():
-        import go_libp2p_pubsub_tpu.models.floodsub as fs
-        import go_libp2p_pubsub_tpu.models.randomsub as rs
-        fn = {"flood_step": fs.flood_step,
-              "make_randomsub_dense_step":
-                  rs.make_randomsub_dense_step}[entry_point_name]
-        if "telemetry" in inspect.signature(fn).parameters:
-            return   # parameter exists -> NOT refused -> probe fails
-        raise ValueError(f"{entry_point_name} exposes no telemetry "
-                         "parameter (refused by API)")
-    return probe
-
-
 #: (probe, required-message regex): a refusal only counts when the
 #: raised ValueError is THE refusal, not an incidental one — an
-#: unrelated validation error must not vacuously satisfy the contract
-_REFUSALS = {
-    # gossip-kernel entries removed in round 9: the kernel path now
-    # THREADS faults and telemetry (see the *_artifact kernel paths);
-    # a still-refused-but-now-accepted declaration would be a finding
-    ("TelemetryConfig", "flood-gather"):
-        (_refuse_by_api("flood_step"), r"refused by API"),
-    ("TelemetryConfig", "randomsub-dense"):
-        (_refuse_by_api("make_randomsub_dense_step"), r"refused by API"),
-    ("FaultSchedule", "flood-gather"):
-        (_refuse_flood_gather_faults, r"circulant topologies only"),
-    ("FaultSchedule", "randomsub-dense"):
-        (_refuse_randomsub_dense_faults, r"circulant step only"),
-}
+#: unrelated validation error must not vacuously satisfy the contract.
+#: Empty since round 10: the gossip-kernel entries went in round 9
+#: (in-kernel fault masks + telemetry tallies) and the flood-gather /
+#: randomsub-dense entries in round 10 (gather/dense fault compilers +
+#: telemetry subsets) — no path refuses observability configs any
+#: more; a still-refused-but-now-accepted declaration would be a
+#: finding.
+_REFUSALS: dict = {}
 
 
 # -- build-time reject probes ----------------------------------------------

@@ -14,6 +14,15 @@ matrix —
                  x {sequential,batched}            (all three)
     gossipsub additionally x XLA {combined,split}  (force_split)
 
+plus the round-10 VARIANT cases (sequential):
+
+    floodsub  variant=gather  x telemetry x faults   (table path)
+    randomsub variant=dense   x telemetry x faults   (MXU path)
+    gossipsub variant=rpc     x telemetry, faults on (rpc_probe
+              step + gossip_run_rpc_snapshots)
+    gossipsub variant=hist    x faults, scored, all three histogram
+              groups on (latency/degree/score bucket tallies)
+
 — and for each case runs ``jax.make_jaxpr`` over the real runner
 (scan included) plus ``.lower`` on the jitted entry point.  Checks:
 
@@ -60,6 +69,7 @@ class AuditCase:
     telemetry: bool
     faults: bool
     batched: bool
+    variant: str = ""        # "" | gather | dense | rpc | hist
     trace: object = field(repr=False, default=None)   # () -> ClosedJaxpr
     lower: object = field(repr=False, default=None)   # () -> lowered text
     n_carry_leaves: int = 0
@@ -67,6 +77,7 @@ class AuditCase:
     @property
     def name(self) -> str:
         return (f"{self.sim}"
+                f"{'-' + self.variant if self.variant else ''}"
                 f"{'-split' if self.split else ''}"
                 f"{'-tel' if self.telemetry else ''}"
                 f"{'-faults' if self.faults else ''}"
@@ -85,7 +96,24 @@ def declared_matrix() -> list[dict]:
                     for batched in (False, True):
                         out.append(dict(sim=sim, split=split,
                                         telemetry=tel, faults=faults,
-                                        batched=batched))
+                                        batched=batched, variant=""))
+    # round-10 variant cases: the newly-threaded table/MXU paths, the
+    # rpc_probe snapshot runner, and the histogram frame groups — all
+    # sequential (the base matrix already proves the batched axis)
+    for tel in (False, True):
+        for faults in (False, True):
+            out.append(dict(sim="floodsub", split=False, telemetry=tel,
+                            faults=faults, batched=False,
+                            variant="gather"))
+            out.append(dict(sim="randomsub", split=False, telemetry=tel,
+                            faults=faults, batched=False,
+                            variant="dense"))
+    for tel in (False, True):
+        out.append(dict(sim="gossipsub", split=False, telemetry=tel,
+                        faults=True, batched=False, variant="rpc"))
+    for faults in (False, True):
+        out.append(dict(sim="gossipsub", split=False, telemetry=True,
+                        faults=faults, batched=False, variant="hist"))
     return out
 
 
@@ -128,11 +156,80 @@ def build_cases() -> list[AuditCase]:
     cases = []
     for combo in declared_matrix():
         sim = combo["sim"]
+        variant = combo.get("variant", "")
         tel = tcfg if combo["telemetry"] else None
         fsched = (audit_fault_schedule() if combo["faults"] else None)
         b = combo["batched"]
 
-        if sim == "gossipsub":
+        if variant == "gather":
+            # flood GATHER table path (round 10): symmetric nbrs table
+            # equivalent to the circulant ring, faults compiled against
+            # the table (compile_faults_gather)
+            import numpy as np
+            offs = tuple(int(o) for o in
+                         make_circulant_offsets(T, C, N, seed=1))
+            nbrs = np.stack([(np.arange(N) + o) % N for o in offs],
+                            axis=1)
+            mask = np.ones_like(nbrs, dtype=bool)
+            subs, topic, origin, ticks = _sim_inputs(T)
+            params, state = fs.make_flood_sim(
+                nbrs, mask, subs, None, topic, origin, ticks,
+                fault_schedule=fsched)
+            core = fs.make_gather_step_core(telemetry=tel)
+            runner = (tl.telemetry_run_curve if tel
+                      else fs.flood_run_curve)
+            args, statics = (params, state, TICKS, core, M), (2, 3, 4)
+
+        elif variant == "dense":
+            # randomsub DENSE MXU path (round 10): all-pairs adjacency,
+            # faults via compile_faults_dense (canonical-pair coins)
+            rcfg = rs.RandomSubSimConfig(
+                offsets=rs.make_randomsub_offsets(T, C, N, seed=1),
+                n_topics=T, d=3)
+            subs, topic, origin, ticks = _sim_inputs(T)
+            params, state = rs.make_randomsub_sim(
+                rcfg, subs, topic, origin, ticks, dense=True,
+                fault_schedule=fsched)
+            step = rs.make_randomsub_dense_step(rcfg, telemetry=tel)
+            runner = tl.telemetry_run if tel else rs.randomsub_run
+            args, statics = (params, state, TICKS, step), (2, 3)
+
+        elif variant == "rpc":
+            # per-edge RPC probe runner (round 10): the snapshot scan
+            # that feeds interop.export.rpc_events
+            cfg = gs.GossipSimConfig(
+                offsets=gs.make_gossip_offsets(T, C, N, seed=1),
+                n_topics=T, d=3, d_lo=2, d_hi=6, d_score=2, d_out=1,
+                d_lazy=2, backoff_ticks=8)
+            subs, topic, origin, ticks = _sim_inputs(T)
+            params, state = gs.make_gossip_sim(
+                cfg, subs, topic, origin, ticks, seed=0,
+                fault_schedule=fsched)
+            step = gs.make_gossip_step(cfg, telemetry=tel,
+                                       rpc_probe=True)
+            runner = gs.gossip_run_rpc_snapshots
+            args, statics = (params, state, TICKS, step), (2, 3)
+
+        elif variant == "hist":
+            # all three histogram groups live (score_hist needs a
+            # scored sim)
+            cfg = gs.GossipSimConfig(
+                offsets=gs.make_gossip_offsets(T, C, N, seed=1),
+                n_topics=T, d=3, d_lo=2, d_hi=6, d_score=2, d_out=1,
+                d_lazy=2, backoff_ticks=8)
+            sc = gs.ScoreSimConfig()
+            tel_h = tl.TelemetryConfig(latency_hist=True,
+                                       degree_hist=True,
+                                       score_hist=True)
+            subs, topic, origin, ticks = _sim_inputs(T)
+            params, state = gs.make_gossip_sim(
+                cfg, subs, topic, origin, ticks, seed=0, score_cfg=sc,
+                fault_schedule=fsched)
+            step = gs.make_gossip_step(cfg, sc, telemetry=tel_h)
+            runner = tl.telemetry_run
+            args, statics = (params, state, TICKS, step), (2, 3)
+
+        elif sim == "gossipsub":
             cfg = gs.GossipSimConfig(
                 offsets=gs.make_gossip_offsets(T, C, N, seed=1),
                 n_topics=T, d=3, d_lo=2, d_hi=6, d_score=2, d_out=1,

@@ -132,6 +132,23 @@ run 2700 env GOSSIP_BENCH_KERNEL=1 python bench_suite.py gossipsub_v11 \
 # kernel-path fault-mask and telemetry overheads, measured on mosaic
 run 2700 python bench_suite.py gossipsub_v11_churn_kernel \
     gossipsub_telemetry_kernel
+# 4c. trace pipeline (round 10): 13-type export throughput on both
+# paths, then the tracestat regression gate over the artifacts the
+# bench just wrote (coverage must stay 13/13 and device-histogram p99
+# within 1 tick of the committed OBS_r10.json baseline)
+run 2700 python bench_suite.py gossipsub_trace_export \
+    gossipsub_trace_export_kernel
+echo "=== tracestat --check gate ===" | tee -a "$log"
+env JAX_PLATFORMS=cpu python tools/tracestat.py \
+    /tmp/gossipsub_trace_export.pb \
+    --frames /tmp/gossipsub_trace_export_frames.json \
+    --check OBS_r10.json 2>&1 | tee -a "$log"
+if [ "${PIPESTATUS[0]}" -ne 0 ]; then
+  echo "!! tracestat gate failed — trace coverage or p99 regressed" \
+    | tee -a "$log"
+  sync_log
+  exit 5
+fi
 # 5. GSPMD overhead + diagnostics
 run 1800 python tools/bench_sharded.py
 run 1800 python tools/bench_micro.py 1000000 100

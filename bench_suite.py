@@ -30,6 +30,16 @@ suite is the full matrix for tracking all baseline configs.)
                    masks + telemetry tallies), each also measuring
                    the KERNEL-path mask/observation overhead and
                    alias-paired to its XLA row for pick_bench_path
+  gossipsub_tournament
+                   round 11: the attack x defense product ({clean,
+                   spam, eclipse, byzantine, cold_restart} x
+                   {reference, weak, hardened} score knobs) as ONE
+                   batched dispatch, worst-case honest delivery per
+                   defense + /tmp artifact for the tourneystat gate
+  gossipsub_invariants / gossipsub_invariants_kernel
+                   round 11: the in-scan runtime invariant checker's
+                   measured overhead, checker-off vs checker-on, on
+                   both execution paths
 
 Usage: python bench_suite.py [config ...]   (default: all)
 """
@@ -724,6 +734,132 @@ def bench_gossipsub_telemetry():
          extra={k: round(v, 1) for k, v in tel_totals.items()})
 
 
+def bench_gossipsub_tournament():
+    """Attack × defense tournament (round 11): the full {clean, spam,
+    eclipse, byzantine, cold_restart} x {reference, weak, hardened}
+    product as ONE batched dispatch (models/tournament.py on
+    stack_trees + vmap; defense knobs are traced ScoreKnobs operands,
+    so the grid shares one compiled step).  Every cell is
+    invariant-armed — the bench asserts zero runtime violations.
+
+    The shape is FIXED (20k peers, 20 topics, 150 ticks) on every
+    platform so the committed TOURNEY_r11.json baseline gates CPU and
+    TPU passes alike; tools/tourneystat.py --check compares the
+    reference-defense worst-case delivery fraction written to
+    /tmp/gossipsub_tournament.json."""
+    from go_libp2p_pubsub_tpu.models.tournament import run_tournament
+
+    n, t, m, T = 20_000, 20, 24, 150
+    t0 = time.perf_counter()
+    rep = run_tournament(n, t, m, T, seed=0)
+    dt = time.perf_counter() - t0
+    rep["round"] = 11
+    with open("/tmp/gossipsub_tournament.json", "w") as f:
+        json.dump(rep, f, indent=1)
+    emit(f"gossipsub_tournament_{n}peers_replica_heartbeats_per_sec",
+         rep["replicas"] * T / dt, "heartbeats/s",
+         extra={"cells": rep["replicas"], "ticks": T,
+                "wall_s": round(dt, 1)})
+    for dname, w in rep["worst_case"].items():
+        emit(f"gossipsub_tournament_worst_case_delivery_{dname}",
+             w["delivery_fraction"], "fraction",
+             extra={"attack": w["attack"]})
+    ecl = {r["defense"]: r.get("eclipse_takeover")
+           for r in rep["rows"] if r["attack"] == "eclipse"}
+    emit("gossipsub_tournament_eclipse_takeover_reference",
+         ecl.get("reference", 0.0), "fraction",
+         extra={"weak": ecl.get("weak"),
+                "hardened": ecl.get("hardened")})
+    assert rep["invariant_violations"] == 0, rep["rows"]
+
+
+def _bench_invariants(kernel: bool):
+    """Shared body of the invariant-overhead benches: the flagship
+    v1.1 config run checker-OFF vs checker-ON (all three groups), one
+    throughput row each — the round-11 observation-cost measurement
+    (PERF_NOTES).  The state trajectory is bit-identical either way
+    (pinned by tests/test_invariants.py); only the cost is at stake
+    here."""
+    import math
+    import jax
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    import go_libp2p_pubsub_tpu.models.invariants as iv
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    n_named = 1_000_000 if on_accel else 100_000
+    t = 100
+    m, C = 32, 16
+    n = n_named
+    block = int(os.environ.get("GOSSIP_BENCH_BLOCK", "8192"))
+    if kernel:
+        quantum = math.lcm(t, 4096, block)
+        n = -(-n_named // quantum) * quantum
+    # interpret-mode CPU fallback is ~2 orders slower than XLA: a
+    # short window there (the overhead RATIO is the measurement)
+    warmup, T = (100, 100) if (on_accel or not kernel) else (30, 50)
+    horizon = warmup + T
+    rng = np.random.default_rng(0)
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, C, n, seed=0), n_topics=t)
+    score_cfg = gs.ScoreSimConfig()
+    topic, origin, tick = _msgs(rng, n, t, m, horizon)
+    subs = _subs_matrix(n, t)
+    rates = {}
+    report = None
+    for mode in ("off", "on"):
+        params, state = gs.make_gossip_sim(
+            cfg, subs, topic, origin, tick, score_cfg=score_cfg,
+            track_first_tick=False,
+            pad_to_block=(block if kernel else None))
+        if mode == "on":
+            state = iv.attach(state)
+        params = jax.device_put(params)
+        step = gs.make_gossip_step(
+            cfg, score_cfg,
+            invariants=(iv.InvariantConfig() if mode == "on"
+                        else None),
+            **(dict(receive_block=block,
+                    receive_interpret=not on_accel) if kernel
+               else {}))
+        state = gs.gossip_run(params, jax.device_put(state), warmup,
+                              step)
+        _ = int(np.asarray(state.tick))
+        t0 = time.perf_counter()
+        state = gs.gossip_run(params, state, T, step)
+        _ = int(np.asarray(state.tick))
+        rates[mode] = T / (time.perf_counter() - t0)
+        if mode == "on":
+            report = iv.report(state)
+            assert report["bits"] == 0, report
+    overhead = 100.0 * (rates["off"] / rates["on"] - 1.0)
+    suffix = "_kernel" if kernel else ""
+    for mode in ("off", "on"):
+        extra = {"interpret": kernel and not on_accel}
+        if mode == "on":
+            extra.update(invariant_overhead_pct=round(overhead, 1),
+                         violations=report["bits"])
+        name = (f"gossipsub_v11_invariants_{mode}{suffix}_{n}peers"
+                "_heartbeats_per_sec")
+        emit(name, rates[mode], "heartbeats/s", extra=extra)
+        if kernel:
+            emit(f"gossipsub_v11_invariants_{mode}_{n_named}peers"
+                 "_heartbeats_per_sec", rates[mode], "heartbeats/s",
+                 extra={"alias_of": name})
+
+
+def bench_gossipsub_invariants():
+    """Invariant-check overhead on the XLA path (round 11)."""
+    _bench_invariants(kernel=False)
+
+
+def bench_gossipsub_invariants_kernel():
+    """Invariant-check overhead on the pallas-kernel path: the checker
+    is a pure epilogue readout of the kernel's outputs, so the fast
+    path needs no in-kernel changes (mosaic on TPU; interpret on CPU
+    where the on/off RATIO is the measurement)."""
+    _bench_invariants(kernel=True)
+
+
 def _trace_export_run(kernel: bool):
     """Shared body of the trace-export benches: one faulted 100k-peer
     gossipsub run (publish burst + mesh formation inside the probe
@@ -863,6 +999,9 @@ BENCHES = {
     "gossipsub_telemetry_kernel": bench_gossipsub_telemetry_kernel,
     "gossipsub_trace_export": bench_gossipsub_trace_export,
     "gossipsub_trace_export_kernel": bench_gossipsub_trace_export_kernel,
+    "gossipsub_tournament": bench_gossipsub_tournament,
+    "gossipsub_invariants": bench_gossipsub_invariants,
+    "gossipsub_invariants_kernel": bench_gossipsub_invariants_kernel,
 }
 
 

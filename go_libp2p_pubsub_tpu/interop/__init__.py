@@ -9,6 +9,7 @@ BASELINE.md requires (curves matching within 1%).
 
 from .replay import (
     TraceRun,
+    churn_from_schedule,
     circulant_edges,
     hops_from_trace,
     mean_reach_fraction,

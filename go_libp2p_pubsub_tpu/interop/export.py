@@ -518,7 +518,13 @@ def rpc_events(rpc_snaps: dict, offsets, msg_topic: np.ndarray,
     On a fault-free unscored run the stream's aggregate counts equal
     the telemetry counters exactly (messages == payload_sent +
     iwant_ids_served, ihave/iwant ids and RPC counts, graft/prune
-    sends; pinned by tests/test_trace_export.py)."""
+    sends; pinned by tests/test_trace_export.py).
+
+    Since round 11 flood-publish sends are captured too (the fixed
+    round-10 refusal): a ``flood``-targeted edge carries the sender's
+    own due publishes (``inj``) in its RPC — on flood-only edges those
+    are the whole payload, on mesh edges they were already inside the
+    fresh set."""
     offs = tuple(int(o) for o in offsets)
     fwd = np.asarray(rpc_snaps["fwd"])
     ihave = np.asarray(rpc_snaps["ihave"])
@@ -530,6 +536,10 @@ def rpc_events(rpc_snaps: dict, offsets, msg_topic: np.ndarray,
     fresh = np.asarray(rpc_snaps["fresh"])
     adv = np.asarray(rpc_snaps["adv"])
     seen = np.asarray(rpc_snaps["seen"])
+    # round-11 snapshot fields; tolerate round-10 recordings
+    flood = (np.asarray(rpc_snaps["flood"])
+             if "flood" in rpc_snaps else None)
+    inj = np.asarray(rpc_snaps["inj"]) if "inj" in rpc_snaps else None
     t_ticks = fwd.shape[0]
     n = fwd.shape[1] if n_true is None else n_true
     n_msgs = len(msg_topic)
@@ -544,21 +554,30 @@ def rpc_events(rpc_snaps: dict, offsets, msg_topic: np.ndarray,
         ts = (start_tick + k) * NS_PER_TICK
         fresh_any = np.zeros(n, dtype=bool)
         adv_any = np.zeros(n, dtype=bool)
+        inj_any = np.zeros(n, dtype=bool)
         for w in range(fresh.shape[1]):
             fresh_any |= fresh[k, w, :n] != 0
             adv_any |= adv[k, w, :n] != 0
+            if inj is not None:
+                inj_any |= inj[k, w, :n] != 0
         for c, off in enumerate(offs):
             bit = np.uint32(1) << np.uint32(c)
             f_e = ((fwd[k, :n] & bit) != 0) & fresh_any
             ih_e = ((ihave[k, :n] & bit) != 0) & adv_any
             g_e = (graft[k, :n] & bit) != 0
             p_e = (prune[k, :n] & bit) != 0
-            attempted = (f_e | ih_e | g_e | p_e) & alive[k, :n]
+            fl_e = (((flood[k, :n] & bit) != 0) & inj_any
+                    if flood is not None else np.zeros(n, dtype=bool))
+            attempted = (f_e | ih_e | g_e | p_e | fl_e) & alive[k, :n]
             for p in np.flatnonzero(attempted):
                 p = int(p)
                 q = (p + off) % n
-                msgs = (_ids_of(fresh[k, :, p], n_msgs)
-                        if f_e[p] else [])
+                # fresh ⊇ inj, so a mesh edge that also floods needs
+                # no merge; a flood-ONLY edge carries just the due
+                # publishes
+                msgs = (_ids_of(fresh[k, :, p], n_msgs) if f_e[p]
+                        else _ids_of(inj[k, :, p], n_msgs) if fl_e[p]
+                        else [])
                 ctl_kw = {}
                 if ih_e[p]:
                     ctl_kw["ihave"] = [tr.ControlIHaveMeta(

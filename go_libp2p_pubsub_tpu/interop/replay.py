@@ -261,7 +261,7 @@ def circulant_edges(offsets, n: int) -> list[tuple[int, int]]:
 async def _run_cluster(n: int, edges, publishers, make_psub,
                        warm_s: float, settle_s: float,
                        spam=None, collect=None,
-                       topics_for=None) -> TraceRun:
+                       topics_for=None, churn=None) -> TraceRun:
     """Shared cluster driver: build n hosts + pubsubs (make_psub(host,
     tracer, i)), join/subscribe all, wire ``edges``, wait ``warm_s`` for
     the overlay to settle (gossipsub mesh formation), publish, drain.
@@ -270,7 +270,18 @@ async def _run_cluster(n: int, edges, publishers, make_psub,
     inject adversarial wire traffic (scripted mock peers).
     ``topics_for(i)``: topic names host i joins (default: ["interop"]).
     ``publishers`` entries are peer indices (topic "interop") or
-    (peer index, topic name) pairs."""
+    (peer index, topic name) pairs.
+
+    ``churn`` (round 11): ``(peer, down_s, up_s)`` triples, seconds
+    relative to the START OF THE PUBLISH PHASE (after warm-up) — the
+    core-side twin of FaultSchedule.down_intervals
+    (churn_from_schedule converts).  At ``down_s`` the peer's host
+    drops every connection (the routers' disconnected notifiees fire,
+    exactly as for a crashed node); at ``up_s`` it re-dials its
+    original candidate neighbors and rejoins WARM (router state kept —
+    matching the vectorized simulator's default rejoin semantics).
+    All windows must close before ``settle_s`` ends; the run awaits
+    them before draining."""
     import random as _random
 
     from ..core import InProcNetwork
@@ -305,6 +316,37 @@ async def _run_cluster(n: int, edges, publishers, make_psub,
     if spam is not None:
         await spam(hosts, net)
 
+    churn_tasks: list[asyncio.Task] = []
+    churn_events: list[tuple] = []
+    if churn:
+        nbrs_of: dict[int, set[int]] = {}
+        for i, j in seen:
+            nbrs_of.setdefault(i, set()).add(j)
+            nbrs_of.setdefault(j, set()).add(i)
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        down_now: set[int] = set()
+
+        async def cycle(p: int, down_s: float, up_s: float):
+            await asyncio.sleep(down_s)
+            down_now.add(p)
+            churn_events.append((p, "leave", loop.time() - t0))
+            for pid in list(hosts[p].peers()):
+                await hosts[p].disconnect(pid)
+            await asyncio.sleep(max(0.0, up_s - down_s))
+            down_now.discard(p)
+            # re-dial only neighbors that are themselves UP: an edge to
+            # a still-down neighbor comes back when THAT neighbor's own
+            # rejoin re-dials us (symmetric windows, matching the
+            # simulator where a down peer stays fully isolated)
+            for j in sorted(nbrs_of.get(p, ())):
+                if j not in down_now:
+                    await connect(hosts[p], hosts[j])
+            churn_events.append((p, "join", loop.time() - t0))
+
+        churn_tasks = [asyncio.create_task(cycle(int(p), ds, us))
+                       for p, ds, us in churn]
+
     origins = []
     for entry in publishers:
         o, tname = (entry if isinstance(entry, tuple)
@@ -315,6 +357,9 @@ async def _run_cluster(n: int, edges, publishers, make_psub,
         origins.append(o)
         await asyncio.sleep(0.01)   # let eager forwarding interleave
     await asyncio.sleep(settle_s)
+    if churn_tasks:
+        await asyncio.gather(*churn_tasks)
+        await asyncio.sleep(0.1)    # let rejoin traffic settle
     for sub in subs:
         while True:
             try:
@@ -335,12 +380,34 @@ async def _run_cluster(n: int, edges, publishers, make_psub,
     peer_index = {bytes(h.id): i for i, h in enumerate(hosts)}
     events = [ev for t in tracers for ev in t.events]
     extra = collect(psubs) if collect is not None else {}
+    if churn:
+        extra = dict(extra, churn_events=churn_events)
     for ps in psubs:
         await ps.close()
     await net.close()
     _ = _random
     return TraceRun(events=events, msg_ids=msg_ids, origins=origins,
                     peer_index=peer_index, n_peers=n, extra=extra)
+
+
+def churn_from_schedule(schedule, heartbeat_s: float,
+                        start_tick: int = 0) -> list[tuple]:
+    """FaultSchedule.down_intervals (ticks) -> core-cluster ``churn``
+    triples (peer, down_s, up_s) under one-tick-one-heartbeat, with
+    tick ``start_tick`` mapped to the start of the publish phase —
+    run the SAME JOIN/LEAVE windows on both sides of the BASELINE
+    cross-validation.  No-op (s == e) intervals are dropped; so are
+    intervals wholly BEFORE start_tick (the core cluster's warm-up
+    has no downtime analog — replaying them would keep a peer down
+    across publishes the simulator saw it receive); straddling
+    intervals clamp their start to the publish phase's t=0."""
+    out = []
+    for p, s, e in schedule.down_intervals:
+        if s >= e or e <= start_tick:
+            continue
+        out.append((int(p), max(s - start_tick, 0) * heartbeat_s,
+                    (e - start_tick) * heartbeat_s))
+    return out
 
 
 def run_core_gossipsub(offsets, n: int, publishers, *,
@@ -351,7 +418,7 @@ def run_core_gossipsub(offsets, n: int, publishers, *,
                        settle_s: float = 1.0, seed: int = 42,
                        spam=None, topics_for=None,
                        direct_index=None,
-                       collect=None) -> TraceRun:
+                       collect=None, churn=None) -> TraceRun:
     """Real gossipsub cluster over the SAME circulant candidate graph the
     simulator uses: hosts connect only along candidate edges, the mesh
     forms as a random D-degree subgraph of them via GRAFT/PRUNE — the
@@ -394,7 +461,8 @@ def run_core_gossipsub(offsets, n: int, publishers, *,
     return asyncio.run(_run_cluster(n, edges, publishers, make_psub,
                                     warm_s, settle_s, spam=spam,
                                     collect=collect,
-                                    topics_for=topics_for))
+                                    topics_for=topics_for,
+                                    churn=churn))
 
 
 def run_core_randomsub(n: int, publishers: list[int], *,

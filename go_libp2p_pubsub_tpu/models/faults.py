@@ -41,6 +41,16 @@ Fault model (one tick = one heartbeat = one hop, as everywhere):
   at heal the edges return and recovery proceeds through the normal
   mesh-repair path (the recovery-time metric in models/_delivery.py
   measures how fast).
+- **Cold restart** (round 11): with ``cold_restart=True`` a churned
+  peer rejoins COLD — its possession words, mcache ring, and seen
+  cache are cleared at the rejoin tick instead of resuming warm, so
+  it must re-request everything still in its partners' IHAVE windows
+  via IWANT (and permanently loses what already aged out).  Honored
+  by the gossipsub simulator on BOTH execution paths (the state clear
+  happens in the shared prologue, before the XLA/pallas split); the
+  floodsub and randomsub builders refuse it (no gossip repair — a
+  cold peer there could never recover, so the mode would only
+  measure the clear itself).
 
 GossipSub semantics (threaded through models/gossipsub.py): edges to
 dead peers are dropped from the mesh with PRUNE/backoff semantics on
@@ -105,7 +115,14 @@ class FaultSchedule:
 
     down_intervals: iterable of ``(peer, start, end)`` half-open down
         windows (churn).  Per peer they must be sorted and
-        non-overlapping.
+        non-overlapping.  ``start == end`` is an explicit NO-OP
+        interval (never down) — batched replica sweeps use it to pad
+        every replica's interval table to one shape (stack_trees
+        needs matching [N, K] leaves across the batch).
+    cold_restart: churned peers rejoin COLD — possession/mcache/seen
+        cleared at the rejoin tick (gossipsub only; see module
+        docstring).  Static (baked into the compiled step), so every
+        replica of a stacked batch must agree on it.
     drop_prob: probability an undirected candidate edge is down for a
         tick — a float, or a [C, N] per-edge array (symmetric across
         the edge's two views; checked in compile_faults where the
@@ -126,6 +143,7 @@ class FaultSchedule:
     partition_group: object = None
     partition_windows: tuple = ()
     seed: int = 0
+    cold_restart: bool = False
 
     # Machine-readable thread-or-refuse contract (verified by
     # tools/graftlint/contracts.py).  Fault data is "threaded" on
@@ -152,6 +170,15 @@ class FaultSchedule:
         "partition_group": _THREADED,
         "partition_windows": _THREADED,
         "seed": _THREADED,
+        # round 11: cold-restart rejoin — possession/mcache cleared at
+        # the rejoin tick inside the gossipsub scan (both execution
+        # paths, jaxpr-diff proven); the floodsub/randomsub builders
+        # refuse it outright (no gossip repair to recover through)
+        "cold_restart": {
+            "gossip-xla": "threaded", "gossip-kernel": "threaded",
+            "flood-circulant": "refused", "flood-gather": "refused",
+            "randomsub-circulant": "refused",
+            "randomsub-dense": "refused"},
     }
 
     def __post_init__(self):
@@ -168,12 +195,16 @@ class FaultSchedule:
                 raise ValueError(
                     f"down_intervals: peer {p} out of range "
                     f"[0, {self.n_peers})")
-            if not (0 <= s < e <= self.horizon):
+            # start == end is an explicit no-op (empty window): the
+            # batched sweeps pad replica tables with it so every
+            # replica's [N, K] interval leaves share one shape
+            if not (0 <= s <= e <= self.horizon):
                 raise ValueError(
                     f"down_intervals: interval [{s}, {e}) for peer {p} "
-                    f"must satisfy 0 <= start < end <= horizon="
+                    f"must satisfy 0 <= start <= end <= horizon="
                     f"{self.horizon}")
-            per_peer.setdefault(p, []).append((s, e))
+            if s < e:
+                per_peer.setdefault(p, []).append((s, e))
         for p, lst in per_peer.items():
             for (s0, e0), (s1, e1) in zip(lst, lst[1:]):
                 if s1 < e0:
@@ -267,6 +298,10 @@ class FaultParams:
     # generated on the fly.
     cross_nk: jnp.ndarray | None = None    # bool [N, K] (gather tables)
     group: jnp.ndarray | None = None       # int32 [N] (dense all-pairs)
+    # round 11: cold-restart rejoin (STATIC — selects the compiled
+    # state-clear branch, so stacked replicas must agree; per-replica
+    # churn still varies through the interval tables)
+    cold_restart: bool = struct.field(pytree_node=False, default=False)
 
 
 # lane_uniform phase for the per-tick link draws.  Must stay disjoint
@@ -346,6 +381,7 @@ def compile_faults(schedule: FaultSchedule, offsets,
         down_start=jnp.asarray(down_start),
         down_end=jnp.asarray(down_end),
         seed=jnp.uint32(schedule.seed & 0xFFFFFFFF),
+        cold_restart=schedule.cold_restart,
         **kw)
 
 
@@ -361,6 +397,14 @@ def alive_mask(fp: FaultParams, tick) -> jnp.ndarray:
     down = jnp.any((tick >= fp.down_start) & (tick < fp.down_end),
                    axis=1)
     return ~down
+
+
+def rejoined_mask(fp: FaultParams, tick) -> jnp.ndarray:
+    """bool [N]: peer came back up exactly AT ``tick`` (down at tick-1,
+    up now) — the cold-restart clear set.  At tick 0 nothing rejoins
+    (intervals start >= 0, so every peer was 'up' at the virtual
+    tick -1)."""
+    return alive_mask(fp, tick) & ~alive_mask(fp, tick - 1)
 
 
 def alive_word(alive: jnp.ndarray) -> jnp.ndarray:

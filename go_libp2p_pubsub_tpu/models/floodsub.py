@@ -41,6 +41,7 @@ from ._delivery import (
     update_first_tick,
 )
 from . import faults as _faults
+from . import invariants as _invariants
 from . import telemetry as _telemetry
 
 
@@ -66,6 +67,11 @@ class FloodState:
     # (word-aligned layout: bit j of word w is message w*32+j; stored
     # unreshaped so the hot-loop update never materializes a relayout)
     tick: jnp.ndarray        # int32 scalar
+    # in-scan invariant-checker carry (models/invariants.py, round 11)
+    # — None (default) keeps the pytree identical to the pre-invariant
+    # state; invariants.attach(state) arms them
+    inv_viol: jnp.ndarray | None = None      # uint32 []
+    inv_first: jnp.ndarray | None = None     # int32 []
 
 
 def make_flood_sim(nbrs: np.ndarray, nbr_mask: np.ndarray, subs: np.ndarray,
@@ -107,6 +113,12 @@ def make_flood_sim(nbrs: np.ndarray, nbr_mask: np.ndarray, subs: np.ndarray,
             raise ValueError(
                 f"fault_schedule.n_peers={fault_schedule.n_peers} != "
                 f"sim peer count {n}")
+        if fault_schedule.cold_restart:
+            raise ValueError(
+                "cold_restart: the floodsub simulator refuses "
+                "cold-restart schedules (a cold rejoiner has no "
+                "IHAVE/IWANT repair path to recover through) — "
+                "run it on the gossipsub simulator")
         if nbrs is not None:
             fparams = _faults.compile_faults_gather(fault_schedule,
                                                     nbrs, nbr_mask)
@@ -152,7 +164,9 @@ def flood_step(params: FloodParams, state: FloodState) -> FloodState:
 
 
 def make_gather_step_core(telemetry:
-                          "_telemetry.TelemetryConfig | None" = None):
+                          "_telemetry.TelemetryConfig | None" = None,
+                          invariants:
+                          "_invariants.InvariantConfig | None" = None):
     """(params, state) -> (state, delivered_words) over a gather
     (nbrs-table) topology — round 10 twin of make_circulant_step_core.
 
@@ -164,7 +178,12 @@ def make_gather_step_core(telemetry:
     fault counters — gossip/mesh/score fields stay zero.  The
     fault-free telemetry-off build compiles the exact fused
     propagate_pm hop; counting runs the same gather with the masks
-    visible (state trajectory bit-identical either way)."""
+    visible (state trajectory bit-identical either way).
+
+    With ``invariants`` (models/invariants.py, round 11) the core
+    folds floodsub's applicable check subset — the ``delivery`` group
+    — into the armed state's inv carry (pure readout, trajectory
+    bit-identical; ``None`` compiles the exact pre-invariant core)."""
     tel = telemetry
     ws = _telemetry.wire_sizes(tel) if tel is not None else None
     pc = jax.lax.population_count
@@ -233,6 +252,9 @@ def make_gather_step_core(telemetry:
                         dtype=jnp.int32) // 2)
         return new_state, delivered, _telemetry.make_frame(**kw_f)
 
+    if invariants is not None:
+        return _invariants.wrap_step_delivery(core, invariants,
+                                              "floodsub (gather)")
     return core
 
 
@@ -272,7 +294,9 @@ def _finish_step(params: FloodParams, state: FloodState,
                                    state.tick)
 
     new_state = FloodState(have=have, first_tick=first_tick,
-                           tick=state.tick + 1)
+                           tick=state.tick + 1,
+                           inv_viol=state.inv_viol,
+                           inv_first=state.inv_first)
     return new_state, delivered_now
 
 
@@ -325,6 +349,9 @@ def flood_run_batch(params: FloodParams, state: FloodState, n_ticks: int,
 
 def make_circulant_step_core(offsets,
                              telemetry: "_telemetry.TelemetryConfig | None"
+                             = None,
+                             invariants:
+                             "_invariants.InvariantConfig | None"
                              = None):
     """(params, state) -> (state, delivered_words) over a circulant
     graph.  Honors ``params.faults`` (models/faults.py): a down peer
@@ -340,7 +367,11 @@ def make_circulant_step_core(offsets,
     copies are countable — the state trajectory stays bit-identical,
     and ``telemetry=None`` compiles the exact pre-telemetry core.
     The gather-based path threads telemetry too since round 10
-    (make_gather_step_core)."""
+    (make_gather_step_core).
+
+    ``invariants`` (round 11): floodsub's delivery-group invariant
+    subset folded into the armed state's carry — see
+    make_gather_step_core."""
     offsets = tuple(int(o) for o in offsets)
     idx = {o: i for i, o in enumerate(offsets)}
     cinv = (tuple(idx[-o] for o in offsets)
@@ -432,6 +463,9 @@ def make_circulant_step_core(offsets,
         heard = jnp.stack(w_rows, axis=0) & aw[None, :]    # receiver up
         return _finish_step(params, state, heard, alive=alive)
 
+    if invariants is not None:
+        return _invariants.wrap_step_delivery(core, invariants,
+                                              "floodsub (circulant)")
     return core
 
 

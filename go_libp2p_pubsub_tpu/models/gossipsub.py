@@ -124,6 +124,7 @@ from ._delivery import (
     update_first_tick,
 )
 from . import faults as _faults
+from . import invariants as _invariants
 from . import telemetry as _telemetry
 
 
@@ -404,11 +405,80 @@ class ScoreSimConfig:
     # serve until the per-edge retransmission budget saturates
     # (mcache.go:66-80 + gossipsub.go:690-693)
     sybil_iwant_spam: bool = False
+    # Eclipse formation (round 11; "GossipSub: Attack-Resilient
+    # Message Propagation" §eclipse): peers flagged in the sim's
+    # ``eclipse_sybil`` array coordinate GRAFT pressure on a VICTIM
+    # set (``eclipse_victim``) — every tick they GRAFT at every
+    # subscribed victim candidate, ignoring their own backoff, and
+    # forward NOTHING once inside (silent mesh occupation starves the
+    # victim).  Defense path: re-grafting during backoff accrues P7
+    # at the victim, the penalty squares into a negative score, and
+    # the victim's maintenance drops + graylists the attacker — the
+    # takeover bound tests/test_attacks.py pins.
+    sybil_eclipse: bool = False
+    # Byzantine id-preserving payload mutation (round 11): peers
+    # flagged in ``byzantine`` corrupt the CONTENT of every copy they
+    # relay or serve (the id is preserved — the copy reaches the
+    # receiver's validator and fails).  A mutated copy is rejected:
+    # it accrues the per-edge P4 invalid-delivery penalty and NEVER
+    # enters possession, so the receiver can still acquire the honest
+    # bytes from another edge (validation.go:274-351 semantics).
+    byzantine_mutation: bool = False
     # counter storage dtype: bfloat16 halves the dominant HBM traffic of
     # the v1.1 step (6 [C, N] counters r+w per tick); the counters are
     # small decaying sums where ~3 significant digits is ample.  All
     # arithmetic still runs in f32 (cast on read, cast on write).
     counter_dtype: str = "bfloat16"
+
+    # Machine-readable thread-or-refuse contract (round 11 — verified
+    # by tools/graftlint/contracts.py like GossipSimConfig's): every
+    # score knob must provably reach the compiled step on both
+    # execution paths, or be provably refused.  The P3/P3b family is
+    # kernel-refused (the fused kernel elides the split-loop
+    # provenance P3 needs), as is byzantine mutation (per-edge content
+    # corruption needs the per-edge receive loops).
+    PATHS: ClassVar[tuple[str, ...]] = ("xla", "kernel")
+    _KERNEL_REFUSED: ClassVar[dict[str, str]] = {
+        "xla": "threaded", "kernel": "refused"}
+    CONTRACT: ClassVar[dict[str, object]] = {
+        "topic_weight": "threaded",
+        "topic_score_cap": "threaded",
+        "time_in_mesh_weight": "threaded",
+        "time_in_mesh_quantum": "threaded",
+        "time_in_mesh_cap": "threaded",
+        "first_message_deliveries_weight": "threaded",
+        "first_message_deliveries_decay": "threaded",
+        "first_message_deliveries_cap": "threaded",
+        "mesh_message_deliveries_weight": _KERNEL_REFUSED,
+        "mesh_message_deliveries_decay": _KERNEL_REFUSED,
+        "mesh_message_deliveries_cap": _KERNEL_REFUSED,
+        "mesh_message_deliveries_threshold": _KERNEL_REFUSED,
+        "mesh_message_deliveries_activation": _KERNEL_REFUSED,
+        "mesh_failure_penalty_weight": _KERNEL_REFUSED,
+        "mesh_failure_penalty_decay": _KERNEL_REFUSED,
+        "invalid_message_deliveries_weight": "threaded",
+        "invalid_message_deliveries_decay": "threaded",
+        "app_specific_weight": "threaded",
+        "ip_colocation_factor_weight": "threaded",
+        "ip_colocation_factor_threshold": "threaded",
+        "behaviour_penalty_weight": "threaded",
+        "behaviour_penalty_decay": "threaded",
+        "behaviour_penalty_threshold": "threaded",
+        "decay_to_zero": "threaded",
+        "gossip_threshold": "threaded",
+        "publish_threshold": "threaded",
+        "graylist_threshold": "threaded",
+        "opportunistic_graft_threshold": "threaded",
+        "opportunistic_graft_ticks": "threaded",
+        "opportunistic_graft_peers": "threaded",
+        "flood_publish": "threaded",
+        "sybil_ihave_spam": "threaded",
+        "sybil_graft_flood": "threaded",
+        "sybil_iwant_spam": "threaded",
+        "sybil_eclipse": "threaded",
+        "byzantine_mutation": _KERNEL_REFUSED,
+        "counter_dtype": "threaded",
+    }
 
     @property
     def bp_dtype(self) -> str:
@@ -468,6 +538,31 @@ class ScoreSimConfig:
 # Pytrees.  Candidate masks are packed uint32 [N]; dense per-edge numeric
 # state (score counters, backoff ticks) is [C, N] peer-minor.
 # --------------------------------------------------------------------------
+
+
+#: the defense parameters the attack×defense tournament sweeps as DATA
+#: (traced operands instead of baked constants), in ScoreKnobs field
+#: order.  Everything else in ScoreSimConfig stays compile-time.
+SCORE_KNOB_FIELDS = ("invalid_message_deliveries_weight",
+                     "behaviour_penalty_weight",
+                     "graylist_threshold", "gossip_threshold")
+
+
+@struct.dataclass
+class ScoreKnobs:
+    """Traced score-parameter overrides (round 11): the four defense
+    knobs the attack tournament sweeps ride the params as f32 SCALAR
+    LEAVES, so ``vmap``/``stack_trees`` batches advance replicas with
+    HETEROGENEOUS defense settings in one dispatch — the mini config-
+    as-data step toward ROADMAP direction 2.  ``None`` (the default)
+    bakes the ScoreSimConfig values as before, bit-identically.  XLA
+    path only: the pallas kernel emits next-tick gates in-kernel from
+    baked thresholds (kernel_capability refuses knobbed params)."""
+
+    invalid_message_deliveries_weight: jnp.ndarray  # f32 [] (<= 0)
+    behaviour_penalty_weight: jnp.ndarray           # f32 [] (<= 0)
+    graylist_threshold: jnp.ndarray                 # f32 []
+    gossip_threshold: jnp.ndarray                   # f32 []
 
 
 @struct.dataclass
@@ -542,6 +637,20 @@ class GossipParams:
     # execution paths: the XLA rolls mask directly, the pallas kernel
     # threads the alive/link words through its VMEM pass.
     faults: _faults.FaultParams | None = None
+    # -- round-11 attack surface (arrays, so stacked replicas vary the
+    # formation per replica under ONE compiled step) ---------------------
+    # eclipse formation (ScoreSimConfig.sybil_eclipse): the attackers
+    # and their victim set.  cand_victim_bits[p] bit c = candidate
+    # p+o_c is a victim.
+    eclipse_sybil: jnp.ndarray | None = None      # bool [N]
+    eclipse_victim: jnp.ndarray | None = None     # bool [N]
+    cand_victim_bits: jnp.ndarray | None = None   # uint32 [N]
+    # Byzantine payload mutators (ScoreSimConfig.byzantine_mutation):
+    # cand_byz[p] bit c = candidate p+o_c corrupts what it relays.
+    byzantine: jnp.ndarray | None = None          # bool [N]
+    cand_byz: jnp.ndarray | None = None           # uint32 [N]
+    # traced defense-knob overrides (attack tournament); None = baked
+    score_knobs: ScoreKnobs | None = None
 
 
 @struct.dataclass
@@ -624,6 +733,12 @@ class GossipState:
     # Static aux data (not a leaf): never checkpointed, restored from
     # the template.
     gates_fp: int | None = struct.field(pytree_node=False, default=None)
+    # in-scan invariant-checker carry (models/invariants.py, round 11):
+    # cumulative violation bitmask + first violating tick.  None (the
+    # default) keeps the pytree identical to the pre-invariant state;
+    # invariants.attach(state) arms them.
+    inv_viol: jnp.ndarray | None = None      # uint32 []
+    inv_first: jnp.ndarray | None = None     # int32 []
 
 
 def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
@@ -640,7 +755,11 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
                     px_candidates: int | None = None,
                     direct_edges: np.ndarray | None = None,
                     pad_to_block: int | None = None,
-                    fault_schedule: _faults.FaultSchedule | None = None):
+                    fault_schedule: _faults.FaultSchedule | None = None,
+                    eclipse_sybil: np.ndarray | None = None,
+                    eclipse_victim: np.ndarray | None = None,
+                    byzantine: np.ndarray | None = None,
+                    score_knobs: dict | None = None):
     """Build (params, state).  subs: bool [N, T] — but each peer may only
     subscribe to its residue-class topic (circulant classes are closed, so
     cross-class subscriptions would never receive anything).
@@ -663,7 +782,21 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
     events into the step, on either execution path (the pallas kernel
     threads the per-tick alive/link mask words through its VMEM pass).
     The schedule is sized to the TRUE peer count; with pad_to_block
-    the pad lanes ride as alive-with-links-up.
+    the pad lanes ride as alive-with-links-up.  ``cold_restart``
+    schedules additionally clear a rejoining peer's possession +
+    mcache at the rejoin tick (both paths — the clear is in the
+    shared prologue).
+
+    Round-11 attack arrays (all require score_cfg):
+    - eclipse_sybil [N] bool + eclipse_victim [N] bool: the eclipse
+      formation's attackers and targets (live when
+      score_cfg.sybil_eclipse).
+    - byzantine [N] bool: id-preserving payload mutators (live when
+      score_cfg.byzantine_mutation).
+    - score_knobs: dict over SCORE_KNOB_FIELDS — traced defense-knob
+      overrides for the attack×defense tournament (missing keys fall
+      back to the score_cfg value; sign/order validated here).  XLA
+      path only.
     """
     n, t = subs.shape
     if t != cfg.n_topics:
@@ -826,6 +959,55 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
             raise ValueError("promise_break requires score_cfg (P7)")
         kw.update(promise_break=jnp.asarray(
             padl(np.asarray(promise_break, dtype=bool))))
+
+    if eclipse_sybil is not None or eclipse_victim is not None:
+        if score_cfg is None:
+            raise ValueError("eclipse_sybil/eclipse_victim require "
+                             "score_cfg (the defense under test)")
+        if eclipse_sybil is None or eclipse_victim is None:
+            raise ValueError("eclipse formations need BOTH "
+                             "eclipse_sybil and eclipse_victim")
+        es = np.asarray(eclipse_sybil, dtype=bool)
+        ev = np.asarray(eclipse_victim, dtype=bool)
+        if (es & ev).any():
+            raise ValueError(
+                "eclipse_sybil and eclipse_victim must be disjoint "
+                "(an attacker cannot eclipse itself)")
+        kw.update(eclipse_sybil=jnp.asarray(padl(es)),
+                  eclipse_victim=jnp.asarray(padl(ev)),
+                  cand_victim_bits=jnp.asarray(padl(cand_bits(ev))))
+
+    if byzantine is not None:
+        if score_cfg is None:
+            raise ValueError(
+                "byzantine requires score_cfg (mutated copies feed "
+                "the validation-reject P4 path)")
+        bz = np.asarray(byzantine, dtype=bool)
+        kw.update(byzantine=jnp.asarray(padl(bz)),
+                  cand_byz=jnp.asarray(padl(cand_bits(bz))))
+
+    if score_knobs is not None:
+        if score_cfg is None:
+            raise ValueError("score_knobs require score_cfg")
+        unknown = set(score_knobs) - set(SCORE_KNOB_FIELDS)
+        if unknown:
+            raise ValueError(
+                f"score_knobs: unknown knob(s) {sorted(unknown)} — "
+                f"sweepable knobs are {SCORE_KNOB_FIELDS}")
+        kv = {f: float(score_knobs.get(f, getattr(score_cfg, f)))
+              for f in SCORE_KNOB_FIELDS}
+        for f in ("invalid_message_deliveries_weight",
+                  "behaviour_penalty_weight"):
+            if kv[f] > 0:
+                raise ValueError(f"score_knobs: {f} must be <= 0")
+        if not (kv["graylist_threshold"]
+                <= score_cfg.publish_threshold
+                <= kv["gossip_threshold"] <= 0):
+            raise ValueError(
+                "score_knobs: need graylist <= publish (static) <= "
+                "gossip threshold <= 0")
+        kw.update(score_knobs=ScoreKnobs(
+            **{f: jnp.float32(kv[f]) for f in SCORE_KNOB_FIELDS}))
 
     if fault_schedule is not None:
         # both paths honor fault masks (the pallas kernel threads the
@@ -1002,6 +1184,14 @@ def compute_scores(sc: ScoreSimConfig, params: GossipParams,
     tim = f32(s.time_in_mesh)
     invd = f32(s.invalid_deliveries)
     w = sc.topic_weight
+    # tournament defense knobs (ScoreKnobs): traced overrides of the
+    # baked weights — absent (the default) this is the exact pre-knob
+    # arithmetic with python-float constants
+    kn = params.score_knobs
+    w_inv = (kn.invalid_message_deliveries_weight if kn is not None
+             else sc.invalid_message_deliveries_weight)
+    w_bp = (kn.behaviour_penalty_weight if kn is not None
+            else sc.behaviour_penalty_weight)
     # summed per-topic contribution (P1..P4).  With equal topic weights
     # the LINEAR terms' per-topic sums collapse into the aggregate
     # counters exactly (P1 stays per-slot because the meshes differ).
@@ -1016,7 +1206,7 @@ def compute_scores(sc: ScoreSimConfig, params: GossipParams,
                                 sc.time_in_mesh_cap)
                   + (w * sc.first_message_deliveries_weight)
                   * f32(s.first_deliveries)
-                  + (w * sc.invalid_message_deliveries_weight)
+                  + (w * w_inv)
                   * invd * invd)
     if s.time_in_mesh_b is not None:
         tim_b = f32(s.time_in_mesh_b)
@@ -1045,7 +1235,7 @@ def compute_scores(sc: ScoreSimConfig, params: GossipParams,
         0.0, f32(s.behaviour_penalty) - sc.behaviour_penalty_threshold)
     if static is not None:
         topic_part = topic_part + static
-    return topic_part + sc.behaviour_penalty_weight * bp_excess * bp_excess
+    return topic_part + w_bp * bp_excess * bp_excess
 
 
 def score_snapshot(sc: ScoreSimConfig, params: GossipParams,
@@ -1065,12 +1255,17 @@ def score_snapshot(sc: ScoreSimConfig, params: GossipParams,
     tim = f32(s.time_in_mesh)
     invd = f32(s.invalid_deliveries)
     w = sc.topic_weight
+    kn = params.score_knobs
+    w_inv = (kn.invalid_message_deliveries_weight if kn is not None
+             else sc.invalid_message_deliveries_weight)
+    w_bp = (kn.behaviour_penalty_weight if kn is not None
+            else sc.behaviour_penalty_weight)
     out = {
         "p1_time_in_mesh": w * sc.time_in_mesh_weight * jnp.minimum(
             tim / sc.time_in_mesh_quantum, sc.time_in_mesh_cap),
         "p2_first_deliveries": (w * sc.first_message_deliveries_weight
                                 * f32(s.first_deliveries)),
-        "p4_invalid_deliveries": (w * sc.invalid_message_deliveries_weight
+        "p4_invalid_deliveries": (w * w_inv
                                   * invd * invd),
         "p5_app_specific": (sc.app_specific_weight
                             * params.cand_app_score),
@@ -1101,7 +1296,7 @@ def score_snapshot(sc: ScoreSimConfig, params: GossipParams,
                 sc.time_in_mesh_cap))
     bp_excess = jnp.maximum(
         0.0, f32(s.behaviour_penalty) - sc.behaviour_penalty_threshold)
-    out["p7_behaviour_penalty"] = (sc.behaviour_penalty_weight
+    out["p7_behaviour_penalty"] = (w_bp
                                    * bp_excess * bp_excess)
     topic_part = (out["p1_time_in_mesh"] + out["p2_first_deliveries"]
                   + out["p3_mesh_delivery_deficit"]
@@ -1181,9 +1376,14 @@ def compute_gates(cfg: GossipSimConfig, sc: ScoreSimConfig | None,
     rows = []
     if sc is not None:
         score = compute_scores(sc, params, st)              # [C, N]
-        accept_bits = pack_rows(score >= sc.graylist_threshold)
+        kn = params.score_knobs
+        gray_thr = (kn.graylist_threshold if kn is not None
+                    else sc.graylist_threshold)
+        gsp_thr = (kn.gossip_threshold if kn is not None
+                   else sc.gossip_threshold)
+        accept_bits = pack_rows(score >= gray_thr)
         rows = [accept_bits,
-                pack_rows(score >= sc.gossip_threshold),
+                pack_rows(score >= gsp_thr),
                 pack_rows(score >= sc.publish_threshold),
                 pack_rows(score >= 0)]
         # RED gater: under invalid-traffic pressure, payload from an
@@ -1369,21 +1569,27 @@ def kernel_capability(cfg: GossipSimConfig, sc: ScoreSimConfig | None,
     pair-packing and ctrl-byte layout), W == 0 (no payload stream to
     schedule), mixed-protocol overlays (flood_proto), P3 bookkeeping
     (needs the split-loop provenance the fused kernel elides), a
-    state without carried gates, and a re-weighted NONZERO static
+    state without carried gates, a re-weighted NONZERO static
     score bake (the kernel adds the baked P5+P6 term as-is; an
-    all-zero bake is weight-independent)."""
+    all-zero bake is weight-independent), Byzantine payload mutation
+    (per-edge content corruption needs the per-edge receive loops the
+    fused kernel elides), and traced score knobs (the kernel emits
+    next-tick gates in-kernel from BAKED thresholds)."""
     if (cfg.n_candidates > 16 or params.origin_words.shape[0] == 0
             or params.flood_proto is not None
             or state.gates is None
             or (sc is not None
-                and (sc.track_p3
+                and ((sc.byzantine_mutation
+                      and params.cand_byz is not None)
+                     or params.score_knobs is not None
+                     or sc.track_p3
                      or (not params.static_score_zero
                          and params.static_score_weights
                          != (sc.app_specific_weight,
                              sc.ip_colocation_factor_weight))))):
         return ("config not supported by the pallas step (needs C<=16, "
                 "W>=1, carried gates, matching static score weights, "
-                "no flood_proto/track_p3)")
+                "no flood_proto/track_p3/byzantine/score_knobs)")
     return None
 
 
@@ -1398,7 +1604,9 @@ def make_gossip_step(cfg: GossipSimConfig,
                      shard_mesh=None,
                      shard_axis: str = "peers",
                      telemetry: _telemetry.TelemetryConfig | None = None,
-                     rpc_probe: bool = False):
+                     rpc_probe: bool = False,
+                     invariants: _invariants.InvariantConfig | None
+                     = None):
     """Build the jittable (params, state) -> (state, delivered_words) core.
 
     With ``rpc_probe=True`` (round 10) the step additionally returns a
@@ -1436,6 +1644,17 @@ def make_gossip_step(cfg: GossipSimConfig,
          deg>Dhi, GRAFT/PRUNE handshake with backoff, fanout TTL
          (heartbeat gossipsub.go:1299-1552)
 
+    With ``invariants`` (models/invariants.py, round 11) the step
+    additionally evaluates the ACL2s-style safety properties as cheap
+    boolean reductions over values the tick already computed — a pure
+    READOUT folded into the state's ``inv_viol``/``inv_first`` carry
+    (arm the state with invariants.attach first).  The trajectory of
+    every other state field is bit-identical with the checker on, and
+    ``invariants=None`` (the default) compiles the exact pre-invariant
+    step (both pinned by tests/test_invariants.py).  Works on BOTH
+    execution paths: the kernel epilogue hands the checker the same
+    outputs the XLA epilogue does.
+
     With score_cfg, the v1.1 hardening layer is woven through every phase:
     start-of-tick scores gate inbound RPCs (graylist), gossip exchange
     (gossip threshold), and publish flooding (publish threshold); delivery
@@ -1450,6 +1669,7 @@ def make_gossip_step(cfg: GossipSimConfig,
     sc = score_cfg
     paired = cfg.paired_topics
     tel = telemetry
+    icfg = invariants
     # wire-framing constants measured from the pb/rpc.py encodings at
     # build time (host side), baked into the step as scalars
     ws = _telemetry.wire_sizes(tel) if tel is not None else None
@@ -1465,15 +1685,16 @@ def make_gossip_step(cfg: GossipSimConfig,
         raise ValueError("paired_topics needs the combined path "
                         "(C<=16, no track_p3/force_split)")
     if rpc_probe and paired:
-        raise ValueError(
+        # the remaining probe refusals, by name: PAIRED-TOPIC overlays
+        # (here) and MIXED-PROTOCOL overlays (flood_proto, raised at
+        # trace time in the step where the params are visible).  The
+        # round-10 flood_publish refusal is FIXED: flood sends ride
+        # the probe's ``flood``/``inj`` words since round 11.
+        raise NotImplementedError(
             "rpc_probe: paired-topic mode is not probe-supported (the "
             "per-slot RPC split is not captured); run the probe on a "
-            "single-topic-per-peer config")
-    if rpc_probe and sc is not None and sc.flood_publish:
-        raise ValueError(
-            "rpc_probe: flood_publish is not probe-supported (flood "
-            "copies ride a separate per-edge view the probe does not "
-            "capture)")
+            "single-topic-per-peer config.  Remaining probe refusals: "
+            "paired_topics, mixed-protocol (flood_proto) overlays")
 
     # random-k selection backend.  The mosaic kernel (bit-identical
     # output) is kept as an option, but measured inside the real scanned
@@ -1496,13 +1717,65 @@ def make_gossip_step(cfg: GossipSimConfig,
     else:
         sel_k = select_k_bits
 
+    def apply_invariants(params, old_state, new_state, have_pre,
+                         rejoin_w, delivered_now, f_alive_w):
+        """Fold one tick's invariant checks (models/invariants.py)
+        into the state carry — a pure readout of the step's outputs,
+        shared verbatim by the XLA and kernel epilogues (which is why
+        the checker needs no in-kernel work).  On padded states every
+        operand is sliced to the TRUE peers: kernel pad lanes may
+        carry wrapped-view garbage (see iwant_serve_level) and must
+        not trip a check."""
+        n_true = params.n_true
+
+        def tr(a):
+            return a if (a is None or n_true is None) \
+                else a[..., :n_true]
+
+        sub_all_t = jnp.where(tr(params.subscribed), ALL, Z)
+        bits = _invariants.delivery_violations(
+            icfg, tr(have_pre), tr(new_state.have), tr(delivered_now),
+            alive_w=tr(f_alive_w),
+            invalid_words=(params.invalid_words if sc is not None
+                           else None),
+            allowed_clear_w=tr(rejoin_w))
+        honest_all = None
+        if sc is not None and (sc.sybil_graft_flood
+                               or sc.sybil_eclipse):
+            # attackers that bypass their own backoff legitimately
+            # hold mesh edges inside it (the partner accepted)
+            bypass = jnp.zeros(params.subscribed.shape, dtype=bool)
+            if sc.sybil_graft_flood and params.sybil is not None:
+                bypass = bypass | params.sybil
+            if sc.sybil_eclipse and params.eclipse_sybil is not None:
+                bypass = bypass | params.eclipse_sybil
+            honest_all = jnp.where(bypass, Z, ALL)
+        bits = bits | _invariants.gossip_mesh_violations(
+            icfg, C, mesh_new=tr(new_state.mesh),
+            backoff_new=tr(new_state.backoff),
+            cand_sub_bits=tr(params.cand_sub_bits),
+            sub_all=sub_all_t, honest_all=tr(honest_all),
+            mesh_b_new=tr(new_state.mesh_b),
+            backoff_b_new=tr(new_state.backoff_b))
+        if sc is not None and new_state.scores is not None:
+            bits = bits | _invariants.gossip_score_violations(
+                icfg, sc,
+                jax.tree_util.tree_map(tr, new_state.scores),
+                mesh_new=tr(new_state.mesh),
+                mesh_b_new=tr(new_state.mesh_b))
+        viol, first = _invariants.fold(
+            old_state.inv_viol, old_state.inv_first, bits,
+            old_state.tick)
+        return new_state.replace(inv_viol=viol, inv_first=first)
+
     def _finish_kernel(*, params, state, fanout, last_pub, injected,
                        fresh, adv, targets, withhold, out_bits, grafts,
                        dropped, mesh_sel, a_sent, would_accept,
                        backoff_bits2, sub_all, payload_bits,
                        gossip_bits, accept_bits, valid_w, tick, salt,
                        flood_bits=None, neg=None, sel_b=None,
-                       fresh_b=None, fmasks=None):
+                       fresh_b=None, fmasks=None, have_pre=None,
+                       rejoin_w=None):
         """Pallas path: one mega-kernel does the payload receive,
         handshake resolution, and per-edge counter/backoff updates in
         a single HBM pass over the [C, N] state (ops/pallas/receive).
@@ -1573,6 +1846,11 @@ def make_gossip_step(cfg: GossipSimConfig,
                 # topic (gossipsub.go:945-950)
                 out_b_bits = out_b_bits | (params.cand_direct
                                            & params.cand_sub_bits)
+            if (sc is not None and sc.sybil_eclipse
+                    and params.eclipse_sybil is not None):
+                # eclipse attackers are silent on the slot-B mesh too
+                out_b_bits = jnp.where(params.eclipse_sybil, Z,
+                                       out_b_bits)
             gb_tx, db_tx, ab_tx = (sel_b["grafts"], sel_b["dropped"],
                                    sel_b["a_sent"])
             if fmasks is not None:
@@ -1783,7 +2061,13 @@ def make_gossip_step(cfg: GossipSimConfig,
                           else state.iwant_serves),
             mesh_b=mesh_b_new, backoff_b=backoff_b_new,
             active=active_new, gates=gates_new,
-            gates_fp=state.gates_fp)
+            gates_fp=state.gates_fp,
+            inv_viol=state.inv_viol, inv_first=state.inv_first)
+        if icfg is not None:
+            new_state = apply_invariants(
+                params, state, new_state, have_pre, rejoin_w,
+                delivered_now,
+                fmasks["alive_w"] if fmasks is not None else None)
         if tel is None:
             return new_state, delivered_now
 
@@ -1894,6 +2178,10 @@ def make_gossip_step(cfg: GossipSimConfig,
         W = state.have.shape[0]
         kernel_on = (params.n_true is not None
                      if use_pallas_receive is None else use_pallas_receive)
+        # Byzantine id-preserving payload mutation (round 11): live
+        # when the config toggle AND the mutator arrays are both there
+        byz_mut = (sc is not None and sc.byzantine_mutation
+                   and params.cand_byz is not None)
         if kernel_on:
             if params.n_true is None:
                 raise ValueError(
@@ -1959,6 +2247,26 @@ def make_gossip_step(cfg: GossipSimConfig,
         else:
             f_alive = f_alive_w = f_alive_all = None
             f_cand_alive = f_send_ok = fmasks = None
+
+        if icfg is not None:
+            _invariants.require_armed(state, "gossipsub")
+
+        # -- cold-restart clear (FaultSchedule.cold_restart, round 11):
+        # a peer rejoining THIS tick comes back COLD — its possession
+        # words and mcache ring are zeroed before anything reads them,
+        # so everything it re-learns goes through the normal news path
+        # (mesh forwards for fresh traffic, IHAVE->IWANT pulls for
+        # anything still inside its partners' advert windows).  Shared
+        # prologue: both execution paths see the cleared state.
+        # ``have_pre``/``rejoin_w`` feed the invariant checker's
+        # possession-monotonicity exemption.
+        have_pre = state.have
+        rejoin_w = None
+        if fp is not None and fp.cold_restart:
+            rej = fpad(_faults.rejoined_mask(fp, tick), False)
+            rejoin_w = _faults.alive_word(rej)  # all-ones at rejoiners
+            state = state.replace(have=state.have & ~rejoin_w,
+                                  recent=state.recent & ~rejoin_w)
 
         # -- 0. start-of-tick gate words --------------------------------
         # Normally READ from the state: the previous tick's epilogue (or
@@ -2113,12 +2421,26 @@ def make_gossip_step(cfg: GossipSimConfig,
         else:
             flood_bits = None
 
+        if (sc is not None and sc.sybil_eclipse
+                and params.eclipse_sybil is not None):
+            # eclipse attackers are SILENT occupiers: once inside a
+            # victim's mesh they forward nothing, advertise nothing,
+            # and flood nothing — the occupied slot starves the victim
+            out_bits = jnp.where(params.eclipse_sybil, Z, out_bits)
+            targets = jnp.where(params.eclipse_sybil, Z, targets)
+            if flood_bits is not None:
+                flood_bits = jnp.where(params.eclipse_sybil, Z,
+                                       flood_bits)
+
         # rpc probe: the ATTEMPT masks are the pre-fault edge words —
         # the host exporter splits each attempted edge-tick into
         # SEND+RECV (healthy), DROP (fault-masked), or nothing (dead
         # sender) using the fault words captured alongside
         rpc_fwd_raw = out_bits if rpc_probe else None
         rpc_adv_raw = targets if rpc_probe else None
+        # flood-publish sends (round 11, the fixed round-10 refusal):
+        # the sender's own due publishes ride their own per-edge view
+        rpc_flood_raw = flood_bits if rpc_probe else None
 
         if fp is not None:
             # faults cut SENDS at their source masks: a down peer (or a
@@ -2309,6 +2631,18 @@ def make_gossip_step(cfg: GossipSimConfig,
                 grafts = jnp.where(params.sybil,
                                    params.cand_sub_bits & ~mesh_ng,
                                    grafts)
+            if (sc is not None and sc.sybil_eclipse
+                    and params.eclipse_sybil is not None):
+                # eclipse formation (round 11): attackers coordinate
+                # GRAFT pressure on the VICTIM set — every tick, at
+                # every subscribed victim candidate, ignoring their
+                # own backoff.  Re-grafting during backoff accrues P7
+                # at the victim (the defense this attack tests).
+                grafts = jnp.where(
+                    params.eclipse_sybil,
+                    params.cand_victim_bits & params.cand_sub_bits
+                    & ~mesh_ng,
+                    grafts)
             if fp is not None:
                 # safety net over the overrides above: not even a
                 # graft-flooding sybil grafts while dead or at the dead
@@ -2349,10 +2683,12 @@ def make_gossip_step(cfg: GossipSimConfig,
         rpc_snap = None
         if rpc_probe:
             if params.flood_proto is not None:
-                raise ValueError(
+                raise NotImplementedError(
                     "rpc_probe: mixed-protocol overlays are not "
                     "probe-supported (floodsub-proto flooding rides "
-                    "outside the captured edge masks)")
+                    "outside the captured edge masks).  Remaining "
+                    "probe refusals: paired_topics, mixed-protocol "
+                    "(flood_proto) overlays")
 
             def stk(rows):
                 return (jnp.stack(rows) if W
@@ -2365,6 +2701,9 @@ def make_gossip_step(cfg: GossipSimConfig,
             rpc_snap = dict(
                 fwd=rpc_fwd_raw, ihave=rpc_adv_raw,
                 graft=grafts, prune=dropped,
+                flood=(rpc_flood_raw if rpc_flood_raw is not None
+                       else jnp.zeros((n,), dtype=jnp.uint32)),
+                inj=stk(injected),
                 withhold=(withhold if withhold is not None
                           else jnp.zeros((n,), dtype=bool)),
                 send_ok=(f_send_ok if fp is not None
@@ -2394,7 +2733,7 @@ def make_gossip_step(cfg: GossipSimConfig,
                 salt=salt, flood_bits=flood_bits, neg=neg_px,
                 sel_b=sel_b,
                 fresh_b=(fresh_b if paired else None),
-                fmasks=fmasks)
+                fmasks=fmasks, have_pre=have_pre, rejoin_w=rejoin_w)
             if rpc_probe:
                 outk = (*outk, rpc_snap)
             return outk
@@ -2481,6 +2820,11 @@ def make_gossip_step(cfg: GossipSimConfig,
                 # slot-B forwards are sends too (out_bits carried the
                 # slot-A mask only)
                 send_fwd_b = send_fwd_b & f_send_ok
+            if (paired and sc is not None and sc.sybil_eclipse
+                    and params.eclipse_sybil is not None):
+                # eclipse attackers are silent on the slot-B mesh too
+                send_fwd_b = jnp.where(params.eclipse_sybil, Z,
+                                       send_fwd_b)
             if sc is not None:
                 # with every edge's payload AND gossip gate open (no
                 # attackers, no graylisting — the clean steady state)
@@ -2517,6 +2861,9 @@ def make_gossip_step(cfg: GossipSimConfig,
                         if send_flood is not None else None)
                 m_adv = (bit_row(targets, c_send)
                          if tel_acc is not None else None)
+                # receiver-side view: is MY candidate j (this edge's
+                # sender) a Byzantine mutator?
+                byz_j = bit_row(params.cand_byz, j) if byz_mut else None
                 fd_j = iv_j = None
                 req_c = None
                 for w in range(W):
@@ -2562,6 +2909,16 @@ def make_gossip_step(cfg: GossipSimConfig,
                         # recomputing every roll twice (profiler:
                         # ~1.2 ms/tick of duplicated pad chains at 1M)
                         news = jax.lax.optimization_barrier(news)
+                    news_bad = None
+                    if byz_j is not None:
+                        # Byzantine mutation: every copy this sender
+                        # relays/serves reaches the validator with
+                        # corrupted content — it is REJECTED (never
+                        # acquired, so an honest copy from another
+                        # edge can still land) and accrues the
+                        # per-edge P4 invalid-delivery penalty
+                        news_bad = jnp.where(byz_j, news, Z)
+                        news = news & ~news_bad
                     heard[w] = heard[w] | news
                     if sc is not None:
                         # P2/P4 credit new-message deliverers, eager and
@@ -2569,6 +2926,8 @@ def make_gossip_step(cfg: GossipSimConfig,
                         # the seen-cache, pubsub.go:851-868)
                         fd_j = acc(fd_j, pc(news & valid_w[w]))
                         iv_j = acc(iv_j, pc(news & ~valid_w[w]))
+                        if news_bad is not None:
+                            iv_j = iv_j + pc(news_bad)
                 if send_cheat is not None:
                     got_cheat = jnp.roll(bit_row(send_cheat, c_send),
                                          off, axis=0)
@@ -2590,6 +2949,7 @@ def make_gossip_step(cfg: GossipSimConfig,
                 mask_c = bit_row(out_bits, c_send)              # [N]
                 ok_j = (bit_row(payload_bits, j) if sc is not None
                         else None)
+                byz_j = bit_row(params.cand_byz, j) if byz_mut else None
                 fd_j = md_j = iv_j = None
                 for w in range(W):
                     sent = jnp.where(mask_c, fresh[w], Z)
@@ -2602,6 +2962,13 @@ def make_gossip_step(cfg: GossipSimConfig,
                     if fp is not None:
                         rolled = rolled & f_alive_w  # down peers hear 0
                     news = rolled & ~seen[w]
+                    news_bad = None
+                    if byz_j is not None:
+                        # Byzantine mutation: rejected at validation —
+                        # P4 accrues, nothing is acquired (see the
+                        # combined path)
+                        news_bad = jnp.where(byz_j, news, Z)
+                        news = news & ~news_bad
                     mesh_heard[w] = mesh_heard[w] | news
                     if tel_acc is not None:
                         tel_acc["payload"] += pc(sent).sum(
@@ -2614,9 +2981,13 @@ def make_gossip_step(cfg: GossipSimConfig,
                         # split loops
                         fd_j = acc(fd_j, pc(news & valid_w[w]))
                         if sc.track_p3:
-                            md_j = acc(md_j, pc(rolled & valid_w[w]
+                            md_ok = (rolled if byz_j is None
+                                     else jnp.where(byz_j, Z, rolled))
+                            md_j = acc(md_j, pc(md_ok & valid_w[w]
                                                 & ~have_start[w]))
                         iv_j = acc(iv_j, pc(news & ~valid_w[w]))
+                        if news_bad is not None:
+                            iv_j = iv_j + pc(news_bad)
                 fd_add[j], md_new[j], inv_add[j] = fd_j, md_j, iv_j
 
             # -- 3. lazy gossip exchange --------------------------------
@@ -2631,6 +3002,7 @@ def make_gossip_step(cfg: GossipSimConfig,
                 ok_j = None
                 if sc is not None:
                     ok_j = bit_row(payload_bits & gossip_bits, j)
+                byz_j = bit_row(params.cand_byz, j) if byz_mut else None
                 req_c = None
                 for w in range(W):
                     sent = jnp.where(send_mask, adv[w], Z)
@@ -2640,6 +3012,12 @@ def make_gossip_step(cfg: GossipSimConfig,
                     if fp is not None:
                         rolled = rolled & f_alive_w  # down peers hear 0
                     news = rolled & ~seen_g[w]
+                    news_bad = None
+                    if byz_j is not None:
+                        # mutated IWANT serves: rejected, P4, never
+                        # acquired (see the combined path)
+                        news_bad = jnp.where(byz_j, news, Z)
+                        news = news & ~news_bad
                     gossip_heard[w] = gossip_heard[w] | news
                     if tel_acc is not None:
                         # requested/served count against START-of-tick
@@ -2667,6 +3045,8 @@ def make_gossip_step(cfg: GossipSimConfig,
                         # like any other delivery: P2 valid, P4 invalid
                         fd_add[j] = fd_add[j] + pc(news & valid_w[w])
                         inv_add[j] = inv_add[j] + pc(news & ~valid_w[w])
+                        if news_bad is not None:
+                            inv_add[j] = inv_add[j] + pc(news_bad)
                 if cheat_src is not None:
                     got_cheat = jnp.roll(bit_row(cheat_src, c_send),
                                          off, axis=0)
@@ -2996,7 +3376,8 @@ def make_gossip_step(cfg: GossipSimConfig,
             have=have, recent=recent, first_tick=first_tick, scores=scores,
             key=state.key, tick=tick + 1, iwant_serves=iwant_serves,
             mesh_b=mesh_b_new, backoff_b=backoff_b, active=active_new,
-            gates=state.gates, gates_fp=state.gates_fp)
+            gates=state.gates, gates_fp=state.gates_fp,
+            inv_viol=state.inv_viol, inv_first=state.inv_first)
         if state.gates is not None:
             # emit the NEXT tick's gate words now, while the updated
             # counters are live in registers (XLA fuses the score math
@@ -3008,6 +3389,10 @@ def make_gossip_step(cfg: GossipSimConfig,
             # step would silently act on.
             new_state = new_state.replace(gates=compute_gates(
                 cfg, sc, params, new_state, salt))
+        if icfg is not None:
+            new_state = apply_invariants(
+                params, state, new_state, have_pre, rejoin_w,
+                delivered_now, f_alive_w)
         if tel is None:
             if rpc_probe:
                 return new_state, delivered_now, rpc_snap
@@ -3211,6 +3596,57 @@ def gossip_run_curve_batch(params: GossipParams, state: GossipState,
             lambda d: count_bits_per_position(d, n_msgs))(delivered)
     state, counts = jax.lax.scan(body, state, None, length=n_ticks)
     return state, counts
+
+
+@partial(jax.jit, static_argnums=(2, 3), donate_argnums=(1,))
+def gossip_run_tournament(params: GossipParams, state: GossipState,
+                          n_ticks: int, step, honest=None):
+    """The attack × defense tournament's device side (round 11):
+    advance B stacked replicas — each carrying its OWN attack
+    formation arrays (sybil/eclipse/byzantine flags, fault tables)
+    and its own ScoreKnobs defense point — ``n_ticks`` in ONE scan of
+    the vmapped step, then reduce every replica's final per-message
+    reach from the possession words, honest-masked when ``honest``
+    (bool [B, N]) is given.  One dispatch end to end: no per-replica
+    host round-trips, no recompiles across the grid (the defense
+    knobs are traced operands).  Returns ``(state_B, reach [B, M])``;
+    the state carry is donated like every runner (models/_batch.py
+    tree_copy for reuse).  With invariant-armed states the per-replica
+    violation masks come back in ``state_B.inv_viol`` — every
+    tournament cell doubles as a property test."""
+    vstep = jax.vmap(step)
+
+    def body(s, _):
+        return vstep(params, s)[0], None
+    state, _ = jax.lax.scan(body, state, None, length=n_ticks)
+    if honest is None:
+        reach = jax.vmap(
+            lambda p, s: reach_counts_from_have(p, s))(params, state)
+    else:
+        reach = jax.vmap(reach_counts_from_have)(params, state,
+                                                 honest)
+    return state, reach
+
+
+def eclipse_takeover(state: GossipState, params: GossipParams,
+                     cfg: GossipSimConfig) -> float:
+    """Host-side eclipse metric: the fraction of the VICTIM set's
+    occupied mesh slots held by eclipse attackers (0 = clean mesh,
+    1 = fully eclipsed).  Stated over victims with nonzero degree;
+    pad lanes excluded on padded states."""
+    mesh = np.asarray(state.mesh)
+    es = np.asarray(params.eclipse_sybil)
+    ev = np.asarray(params.eclipse_victim)
+    n = params.n_true if params.n_true is not None else mesh.shape[-1]
+    mesh, es, ev = mesh[..., :n], es[..., :n], ev[..., :n]
+    occ = np.zeros(mesh.shape, dtype=np.int64)
+    deg = np.zeros(mesh.shape, dtype=np.int64)
+    for c, o in enumerate(cfg.offsets):
+        bit = ((mesh >> np.uint32(c)) & 1).astype(bool)
+        deg += bit
+        occ += bit & np.roll(es, -int(o), axis=-1)
+    v_deg = deg[ev].sum()
+    return float(occ[ev].sum() / max(v_deg, 1))
 
 
 @partial(jax.jit, static_argnums=(2, 3), donate_argnums=(1,))

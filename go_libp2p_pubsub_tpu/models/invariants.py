@@ -1,0 +1,300 @@
+"""In-scan runtime invariant checking: the simulators prove their own
+safety properties on every tick.
+
+"Verification of GossipSub in ACL2s" (PAPERS.md) states the safety
+invariants a correct router maintains — score soundness, mesh-degree /
+membership bounds, no delivery involving down peers.  At million-peer
+scale nobody can eyeball a trajectory, so this module turns those
+properties into CHEAP boolean reductions evaluated INSIDE the scan:
+every run doubles as a property test, and a violated invariant is a
+found implementation bug (or a deliberately seeded one — the checker
+is itself pinned live by tests/test_invariants.py).
+
+Design (mirrors models/telemetry.py):
+
+- ``InvariantConfig`` is the static knob, baked into the compiled
+  step.  ``None`` — the default everywhere — compiles the exact
+  pre-invariant step: zero overhead, bit-identical trajectories
+  (pinned).
+- The checker is a PURE READOUT of values the step already computed
+  (old state, new state, delivered words, fault masks), so the state
+  trajectory with invariants ON is bit-identical to OFF — and the
+  same checker body serves both gossipsub execution paths: the pallas
+  kernel's epilogue hands it the identical outputs the XLA epilogue
+  does.
+- Results ride the state carry as two scalars: ``inv_viol`` — the
+  CUMULATIVE uint32 violation bitmask (bit i = invariant i violated
+  on some tick so far) — and ``inv_first`` — the first violating tick
+  (int32, -1 while clean).  Scan ys stay untouched; ``vmap`` batches
+  them per replica like any other leaf.  States are built without the
+  fields; ``attach(state)`` arms them (an invariant-enabled step
+  refuses an unarmed state with a clear message).
+
+Violation bits (fixed, stable — tools and tests key on them):
+
+====  =====================  ==============================================
+bit   name                   property (must NEVER hold)
+====  =====================  ==============================================
+0     delivery-down          a copy was delivered at a DOWN peer
+1     delivery-invalid       a validation-failing id entered the
+                             delivered set
+2     possession-regression  a possession word lost a bit outside a
+                             cold-restart rejoin clear
+3     mesh-subscription      a mesh bit points at an unsubscribed
+                             candidate edge (or an unsubscribed peer
+                             holds mesh state)
+4     mesh-backoff           an HONEST peer holds a mesh edge that is
+                             under its own backoff (attackers that
+                             bypass backoff — graft-flood / eclipse
+                             sybils — are excluded by construction)
+5     score-p1-off-mesh      a time-in-mesh counter is nonzero on a
+                             non-mesh edge
+6     score-range            a score counter left its sound range
+                             (P2 above its cap + storage-rounding
+                             slack, or any decaying counter negative)
+====  =====================  ==============================================
+
+Coverage by simulator: gossipsub checks all three groups on both
+execution paths; floodsub and randomsub check the applicable
+``delivery`` subset (bits 0/2 — they have no scores, meshes, or
+validation), with ``mesh``/``scores`` declared inert in the graftlint
+contract exactly like the telemetry gauge groups.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import ClassVar
+
+import jax.numpy as jnp
+
+# -- violation bit assignments (stable) ------------------------------------
+
+DELIVERY_DOWN = 0
+DELIVERY_INVALID = 1
+POSSESSION_REGRESSION = 2
+MESH_SUB = 3
+MESH_BACKOFF = 4
+SCORE_P1_OFF_MESH = 5
+SCORE_RANGE = 6
+
+VIOLATION_NAMES = (
+    "delivery-down",
+    "delivery-invalid",
+    "possession-regression",
+    "mesh-subscription",
+    "mesh-backoff",
+    "score-p1-off-mesh",
+    "score-range",
+)
+
+#: bf16 counter storage rounds to 8 significand bits; a stored value
+#: provably <= cap in f32 may read back up to one ULP above it.  The
+#: range check allows that single rounding step and nothing more.
+_CAP_SLACK = 1.0 + 2.0 ** -7
+
+
+@dataclass(frozen=True)
+class InvariantConfig:
+    """Static invariant-check knob (baked into the compiled step).
+
+    Group toggles (a disabled group's checks are trace-time dead):
+
+    - ``delivery``: bits 0-2 — down-peer delivery, invalid-id
+      delivery, possession monotonicity (cold-restart aware).
+    - ``mesh``: bits 3-4 — mesh-membership soundness (gossipsub only).
+    - ``scores``: bits 5-6 — score-counter soundness (scored gossipsub
+      only; trace-time dead on unscored sims).
+    """
+
+    delivery: bool = True
+    mesh: bool = True
+    scores: bool = True
+
+    # Machine-readable thread-or-refuse contract (verified by
+    # tools/graftlint/contracts.py, exactly like TelemetryConfig's):
+    # per path each field is "threaded" (changes the compiled step,
+    # jaxpr-diff proven) or "inert" (documented no-op on that path's
+    # check subset, jaxpr-equality proven).
+    PATHS: ClassVar[tuple[str, ...]] = (
+        "gossip-xla", "gossip-kernel", "flood-circulant",
+        "flood-gather", "randomsub-circulant", "randomsub-dense")
+    _ALL_THREADED: ClassVar[dict[str, str]] = {
+        "gossip-xla": "threaded", "gossip-kernel": "threaded",
+        "flood-circulant": "threaded", "flood-gather": "threaded",
+        "randomsub-circulant": "threaded",
+        "randomsub-dense": "threaded"}
+    _GOSSIP_ONLY: ClassVar[dict[str, str]] = {
+        "gossip-xla": "threaded", "gossip-kernel": "threaded",
+        "flood-circulant": "inert", "flood-gather": "inert",
+        "randomsub-circulant": "inert", "randomsub-dense": "inert"}
+    CONTRACT: ClassVar[dict[str, object]] = {
+        "delivery": _ALL_THREADED,
+        "mesh": _GOSSIP_ONLY,
+        "scores": _GOSSIP_ONLY,
+    }
+
+
+# --------------------------------------------------------------------------
+# Carry plumbing
+# --------------------------------------------------------------------------
+
+
+def attach(state):
+    """Arm a simulator state for invariant checking: returns the state
+    with ``inv_viol`` / ``inv_first`` initialized (u32 0 / i32 -1).
+    Works on all three simulators' states (any flax struct carrying
+    the two fields)."""
+    return state.replace(inv_viol=jnp.uint32(0),
+                         inv_first=jnp.int32(-1))
+
+
+def require_armed(state, sim: str):
+    """Trace-time guard: an invariant-enabled step on an unarmed state
+    would silently have nowhere to record violations."""
+    if getattr(state, "inv_viol", None) is None:
+        raise ValueError(
+            f"invariant checking needs an armed state: pass the {sim} "
+            "state through models.invariants.attach(state) before "
+            "stepping (InvariantConfig was given but inv_viol is None)")
+
+
+def fold(inv_viol, inv_first, bits, tick):
+    """Accumulate one tick's violation ``bits`` into the carry:
+    returns (viol | bits, first-violation tick)."""
+    first = jnp.where((inv_first < 0) & (bits != 0),
+                      jnp.asarray(tick, dtype=jnp.int32), inv_first)
+    return inv_viol | bits, first
+
+
+def _bit(cond_scalar, bit: int) -> jnp.ndarray:
+    return jnp.where(cond_scalar, jnp.uint32(1 << bit), jnp.uint32(0))
+
+
+def report(state) -> dict:
+    """Host-side summary of an armed state's invariant carry:
+    ``{"violations": [names...], "bits": int, "first_tick": int}``."""
+    import numpy as np
+    bits = int(np.asarray(state.inv_viol).reshape(-1)[0]) \
+        if np.asarray(state.inv_viol).ndim else int(state.inv_viol)
+    names = [n for i, n in enumerate(VIOLATION_NAMES) if bits >> i & 1]
+    first = np.asarray(state.inv_first).reshape(-1)
+    return {"violations": names, "bits": bits,
+            "first_tick": int(first[0]) if first.size == 1
+            else [int(x) for x in first]}
+
+
+# --------------------------------------------------------------------------
+# The checks (pure jnp readouts — shared by all simulators/paths)
+# --------------------------------------------------------------------------
+
+
+def delivery_violations(icfg: InvariantConfig, have_old, have_new,
+                        delivered_now, *, alive_w=None,
+                        invalid_words=None,
+                        allowed_clear_w=None) -> jnp.ndarray:
+    """Bits 0-2 over packed possession words ([W, N] uint32).
+
+    ``alive_w``: u32 [N] all-ones-iff-alive word (None = no faults —
+    the down-delivery check is then trace-time dead).
+    ``invalid_words``: u32 [W] per-word validation-failure mask (None
+    = unscored — the invalid-delivery check is dead).
+    ``allowed_clear_w``: u32 [N] all-ones at peers whose possession
+    was LEGITIMATELY cleared this tick (cold-restart rejoin); shrink
+    anywhere else is a violation."""
+    bits = jnp.uint32(0)
+    if not icfg.delivery:
+        return bits
+    if alive_w is not None:
+        bits = bits | _bit(
+            jnp.any((delivered_now & ~alive_w) != 0), DELIVERY_DOWN)
+    if invalid_words is not None:
+        bits = bits | _bit(
+            jnp.any((delivered_now & invalid_words[:, None]) != 0),
+            DELIVERY_INVALID)
+    shrink = have_old & ~have_new
+    if allowed_clear_w is not None:
+        shrink = shrink & ~allowed_clear_w
+    bits = bits | _bit(jnp.any(shrink != 0), POSSESSION_REGRESSION)
+    return bits
+
+
+def wrap_step_delivery(core, icfg: InvariantConfig, sim: str):
+    """Fold the ``delivery``-group checks (bits 0/2 — the applicable
+    subset for the mesh-less simulators) around a floodsub/randomsub
+    step core.  Pure readout: the wrapped core's state trajectory is
+    bit-identical to the bare one's."""
+    from . import faults as _faults
+
+    def core_inv(params, state):
+        require_armed(state, sim)
+        aw = None
+        if params.faults is not None:
+            aw = _faults.alive_word(
+                _faults.alive_mask(params.faults, state.tick))
+        out = core(params, state)
+        bits = delivery_violations(icfg, state.have, out[0].have,
+                                   out[1], alive_w=aw)
+        viol, first = fold(state.inv_viol, state.inv_first, bits,
+                           state.tick)
+        return (out[0].replace(inv_viol=viol, inv_first=first),
+                *out[1:])
+    return core_inv
+
+
+def gossip_mesh_violations(icfg: InvariantConfig, C: int, *, mesh_new,
+                           backoff_new, cand_sub_bits, sub_all,
+                           honest_all=None, mesh_b_new=None,
+                           backoff_b_new=None) -> jnp.ndarray:
+    """Bits 3-4 over the packed mesh/backoff words.
+
+    ``honest_all``: u32 [N] all-ones at peers NOT running a
+    backoff-bypassing attack (graft-flood / eclipse sybils legitimately
+    hold mesh edges inside their own backoff — the partner accepted);
+    None = everyone honest."""
+    from ..ops.graph import pack_rows
+
+    bits = jnp.uint32(0)
+    if not icfg.mesh:
+        return bits
+    ok_edges = cand_sub_bits & sub_all
+    stray = mesh_new & ~ok_edges
+    if mesh_b_new is not None:
+        stray = stray | (mesh_b_new & ~ok_edges)
+    bits = bits | _bit(jnp.any(stray != 0), MESH_SUB)
+    in_backoff = pack_rows(backoff_new > 0)
+    clash = mesh_new & in_backoff
+    if mesh_b_new is not None:
+        clash = clash | (mesh_b_new & pack_rows(backoff_b_new > 0))
+    if honest_all is not None:
+        clash = clash & honest_all
+    bits = bits | _bit(jnp.any(clash != 0), MESH_BACKOFF)
+    return bits
+
+
+def gossip_score_violations(icfg: InvariantConfig, sc, scores_new, *,
+                            mesh_new, mesh_b_new=None) -> jnp.ndarray:
+    """Bits 5-6 over the [C, N] score counters (scored sims only —
+    call sites skip this entirely when scoring is off)."""
+    from ..ops.graph import expand_bits
+
+    bits = jnp.uint32(0)
+    if not icfg.scores or scores_new is None:
+        return bits
+    s = scores_new
+    C = s.time_in_mesh.shape[0]
+    in_mesh = expand_bits(mesh_new, C)
+    p1_stray = jnp.any((s.time_in_mesh > 0) & ~in_mesh)
+    if s.time_in_mesh_b is not None:
+        in_mesh_b = expand_bits(mesh_b_new, C)
+        p1_stray = p1_stray | jnp.any((s.time_in_mesh_b > 0)
+                                      & ~in_mesh_b)
+    bits = bits | _bit(p1_stray, SCORE_P1_OFF_MESH)
+    f32 = lambda x: x.astype(jnp.float32)  # noqa: E731
+    fd = f32(s.first_deliveries)
+    bad = (jnp.any(fd > sc.first_message_deliveries_cap * _CAP_SLACK)
+           | jnp.any(fd < 0)
+           | jnp.any(f32(s.invalid_deliveries) < 0)
+           | jnp.any(f32(s.behaviour_penalty) < 0))
+    bits = bits | _bit(bad, SCORE_RANGE)
+    return bits

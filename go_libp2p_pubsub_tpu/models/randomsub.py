@@ -54,6 +54,7 @@ from ._delivery import (
     update_first_tick,
 )
 from . import faults as _faults
+from . import invariants as _invariants
 from . import telemetry as _telemetry
 
 
@@ -105,6 +106,11 @@ class RandomSubState:
     first_tick: jnp.ndarray  # int16 [W, 32, N] or None
     key: jax.Array           # PRNG key (seed carrier for the lane hash)
     tick: jnp.ndarray        # int32 scalar
+    # in-scan invariant-checker carry (models/invariants.py, round 11)
+    # — None (default) keeps the pytree identical to the pre-invariant
+    # state; invariants.attach(state) arms them
+    inv_viol: jnp.ndarray | None = None      # uint32 []
+    inv_first: jnp.ndarray | None = None     # int32 []
 
 
 def make_randomsub_sim(cfg: RandomSubSimConfig, subs: np.ndarray,
@@ -130,6 +136,12 @@ def make_randomsub_sim(cfg: RandomSubSimConfig, subs: np.ndarray,
             raise ValueError(
                 f"fault_schedule.n_peers={fault_schedule.n_peers} != "
                 f"sim peer count {subs.shape[0]}")
+        if fault_schedule.cold_restart:
+            raise ValueError(
+                "cold_restart: the randomsub simulator refuses "
+                "cold-restart schedules (a cold rejoiner has no "
+                "IHAVE/IWANT repair path to recover through) — "
+                "run it on the gossipsub simulator")
     n, t = subs.shape
     if t != cfg.n_topics:
         raise ValueError("subs topic dim != cfg.n_topics")
@@ -190,7 +202,9 @@ def make_randomsub_sim(cfg: RandomSubSimConfig, subs: np.ndarray,
 
 def make_randomsub_step(cfg: RandomSubSimConfig,
                         telemetry: "_telemetry.TelemetryConfig | None"
-                        = None):
+                        = None,
+                        invariants:
+                        "_invariants.InvariantConfig | None" = None):
     """(params, state) -> (state, delivered_words): one tick = inject due
     publishes, forward the frontier to a Bernoulli(k/pool) subset of
     subscribed candidates, record deliveries.
@@ -202,7 +216,13 @@ def make_randomsub_step(cfg: RandomSubSimConfig,
     score fields stay zero).  Telemetry only READS, so the state
     trajectory is bit-identical; ``None`` (default) compiles the exact
     pre-telemetry step.  The dense MXU step refuses telemetry like it
-    refuses faults."""
+    refuses faults.
+
+    With ``invariants`` (models/invariants.py, round 11) the step
+    folds randomsub's applicable check subset — the ``delivery``
+    group — into the armed state's inv carry (pure readout,
+    trajectory bit-identical; ``None`` compiles the exact
+    pre-invariant step)."""
     offsets = tuple(int(o) for o in cfg.offsets)
     C = len(offsets)
     Z = jnp.uint32(0)
@@ -277,7 +297,8 @@ def make_randomsub_step(cfg: RandomSubSimConfig,
         # a publish is forwarded exactly once, at its inject tick
         new_state = RandomSubState(
             have=have, fresh=new, first_tick=first_tick,
-            key=state.key, tick=tick + 1)
+            key=state.key, tick=tick + 1,
+            inv_viol=state.inv_viol, inv_first=state.inv_first)
         if tel is None:
             return new_state, delivered_now
         kw_f = {}
@@ -299,12 +320,18 @@ def make_randomsub_step(cfg: RandomSubSimConfig,
                     (~link).sum(dtype=jnp.int32) // 2)
         return new_state, delivered_now, _telemetry.make_frame(**kw_f)
 
+    if invariants is not None:
+        return _invariants.wrap_step_delivery(
+            step, invariants, "randomsub (circulant)")
     return step
 
 
 def make_randomsub_dense_step(cfg: RandomSubSimConfig,
                               telemetry:
                               "_telemetry.TelemetryConfig | None"
+                              = None,
+                              invariants:
+                              "_invariants.InvariantConfig | None"
                               = None):
     """MXU formulation for small N (<= ~32k peers): one hop = a bf16
     matmul ``adjacency [N, N] @ frontier [N, M]``.
@@ -402,7 +429,8 @@ def make_randomsub_dense_step(cfg: RandomSubSimConfig,
                                        tick)
         new_state = RandomSubState(
             have=have, fresh=new, first_tick=first_tick,
-            key=state.key, tick=tick + 1)
+            key=state.key, tick=tick + 1,
+            inv_viol=state.inv_viol, inv_first=state.inv_first)
         if tel is None:
             return new_state, delivered_now
         kw_f = {}
@@ -442,6 +470,9 @@ def make_randomsub_dense_step(cfg: RandomSubSimConfig,
                     (~link).sum(dtype=jnp.int32) // 2)
         return new_state, delivered_now, _telemetry.make_frame(**kw_f)
 
+    if invariants is not None:
+        return _invariants.wrap_step_delivery(
+            step, invariants, "randomsub (dense)")
     return step
 
 

@@ -1,0 +1,214 @@
+"""Attack × defense tournament: every formation against every defense
+grid point, ONE device dispatch.
+
+"GossipSub: Attack-Resilient Message Propagation" (PAPERS.md) measures
+resilience as worst-case honest delivery under a family of attacks;
+reproducing that figure naively costs |attacks| x |defenses| separate
+runs and as many recompiles.  Here the whole product runs as one
+batched replica sweep (models/_batch.py stack_trees + vmap):
+
+- every ATTACK FORMATION is pure data — per-replica sybil / eclipse /
+  byzantine flag arrays and churn interval tables under ONE static
+  config with every attack behavior compiled in (an empty flag array
+  makes that behavior inert at run time);
+- every DEFENSE point is a per-replica ``ScoreKnobs`` pytree (traced
+  score-parameter overrides, models/gossipsub.py) — no recompiles
+  across the grid;
+- the runner is ``gossip_run_tournament``: one scan of the vmapped
+  step plus an in-dispatch possession reduction, honest-masked;
+- every replica's state is invariant-armed (models/invariants.py), so
+  each tournament cell doubles as a property test — the report carries
+  the per-cell violation masks (all zero on a correct build).
+
+The committed artifact (TOURNEY_r11.json) pins the worst-case honest
+delivery fraction under REFERENCE defense parameters;
+``tools/tourneystat.py --check`` gates regressions in measure_all.sh.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import faults as _faults
+from . import gossipsub as gs
+from . import invariants as _inv
+
+#: the formation axis.  "clean" is the control row; "spam" runs BOTH
+#: round-7 gossip-repair attacks (IHAVE broken-promise + IWANT flood);
+#: "eclipse" / "byzantine" / "cold_restart" are the round-11 surface.
+ATTACKS = ("clean", "spam", "eclipse", "byzantine", "cold_restart")
+
+#: the defense axis: ScoreKnobs override dicts (gossipsub.py
+#: SCORE_KNOB_FIELDS).  "reference" is the shipped ScoreSimConfig;
+#: "weak" turns the P4/P7 penalties off (the v1.1-without-teeth
+#: ablation); "hardened" quadruples them and tightens the thresholds
+#: (graylist at the static publish threshold, gossip near zero).
+DEFENSES = {
+    "reference": {},
+    "weak": {"invalid_message_deliveries_weight": 0.0,
+             "behaviour_penalty_weight": 0.0},
+    "hardened": {"invalid_message_deliveries_weight": -40.0,
+                 "behaviour_penalty_weight": -40.0,
+                 "graylist_threshold": -50.0,
+                 "gossip_threshold": -5.0},
+}
+
+
+def tournament_static_config(offsets, n_topics: int):
+    """The ONE (cfg, score_cfg) every replica shares: all attack
+    behaviors compiled in, selected per replica by the flag arrays."""
+    cfg = gs.GossipSimConfig(offsets=offsets, n_topics=n_topics)
+    sc = gs.ScoreSimConfig(sybil_ihave_spam=True, sybil_iwant_spam=True,
+                           sybil_eclipse=True, byzantine_mutation=True)
+    return cfg, sc
+
+
+def tournament_grid(n: int, t: int, m: int, horizon: int, *,
+                    attack_frac: float = 0.2, victim_frac: float = 0.1,
+                    churn_frac: float = 0.15, seed: int = 0,
+                    attacks=ATTACKS, defenses=None):
+    """Build the replica grid: returns ``(cfg, sc, builds, meta)``
+    where ``builds`` is a list of make_gossip_sim kwarg dicts (one per
+    attack × defense cell, attack-major) and ``meta`` the matching
+    ``{"attack", "defense"}`` row descriptors.
+
+    Attacker/victim/churn sets and the message table are FIXED across
+    the grid (same peers, same publishes), so cells differ only in
+    which behavior is armed — the clean row is the control.  Origins
+    are drawn from peers that are attackers in NO formation."""
+    defenses = DEFENSES if defenses is None else defenses
+    rng = np.random.default_rng(seed)
+    attackers = np.zeros(n, dtype=bool)
+    attackers[: int(n * attack_frac)] = True
+    victims = np.zeros(n, dtype=bool)
+    victims[int(n * attack_frac):
+            int(n * (attack_frac + victim_frac))] = True
+    pool = np.flatnonzero(~attackers & ~victims)
+    # messages: honest origins, publishes spread over the first 60% of
+    # the horizon so the churn windows overlap live traffic
+    origin = pool[rng.integers(0, len(pool), m)]
+    topic = (origin % t).astype(np.int64)
+    pub_tick = np.sort(rng.integers(0, max(1, int(horizon * 0.6)),
+                                    m)).astype(np.int32)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+
+    # churn table (cold_restart row): churn_frac of the POOL cycles
+    # down for 8 ticks mid-horizon, staggered in 3 waves.  Every
+    # replica's schedule shares ONE [N, K] interval-table shape: the
+    # no-churn replicas carry the same number of (0, 0, 0) no-op
+    # intervals (FaultSchedule allows start == end exactly for this).
+    churners = pool[rng.random(len(pool)) < churn_frac]
+    lo = max(1, int(horizon * 0.3))
+    ivs = [(int(p), lo + int(p % 3) * 4, lo + 8 + int(p % 3) * 4)
+           for p in churners]
+    noop_ivs = [(int(p), 0, 0) for p in churners]
+    zeros = np.zeros(n, dtype=bool)
+
+    def sched(churn: bool, rseed: int):
+        return _faults.FaultSchedule(
+            n_peers=n, horizon=horizon,
+            down_intervals=(ivs if churn else noop_ivs),
+            cold_restart=True, seed=rseed)
+
+    builds, meta = [], []
+    for attack in attacks:
+        for dname, knobs in defenses.items():
+            # ONE shared seed across the whole grid (mesh PRNG and
+            # fault coins alike): cells are paired controls — they
+            # differ ONLY in the armed behavior/knobs, so a
+            # cross-cell delta is the attack/defense effect, not
+            # mesh-randomization noise
+            builds.append(dict(
+                subs=subs, msg_topic=topic, msg_origin=origin,
+                msg_publish_tick=pub_tick, seed=seed,
+                track_first_tick=False,
+                sybil=(attackers if attack == "spam" else zeros),
+                eclipse_sybil=(attackers if attack == "eclipse"
+                               else zeros),
+                eclipse_victim=(victims if attack == "eclipse"
+                                else zeros),
+                byzantine=(attackers if attack == "byzantine"
+                           else zeros),
+                fault_schedule=sched(attack == "cold_restart", seed),
+                score_knobs=dict(knobs),
+            ))
+            meta.append({"attack": attack, "defense": dname})
+    return builds, meta, dict(attackers=attackers, victims=victims,
+                              origin=origin, topic=topic,
+                              pub_tick=pub_tick, subs=subs)
+
+
+def run_tournament(n: int, t: int, m: int, n_ticks: int, *,
+                   n_candidates: int = 16, seed: int = 0,
+                   attacks=ATTACKS, defenses=None,
+                   invariants=True) -> dict:
+    """Build + run the full grid in one dispatch; returns the report:
+
+    ``{"rows": [{attack, defense, delivery_fraction, takeover,
+    inv_bits, inv_first}, ...], "worst_case": {defense:
+    {delivery_fraction, attack}}, ...}``.
+
+    Delivery fraction is the honest-population mean over messages of
+    reached/want — 1.0 means every honest subscriber of every topic
+    got every honest publish."""
+    import jax
+
+    defenses = DEFENSES if defenses is None else defenses
+    offsets = gs.make_gossip_offsets(t, n_candidates, n, seed=seed)
+    cfg, sc = tournament_static_config(offsets, t)
+    builds, meta, ctx = tournament_grid(n, t, m, n_ticks, seed=seed,
+                                        attacks=attacks,
+                                        defenses=defenses)
+    icfg = _inv.InvariantConfig() if invariants else None
+    pairs = [gs.make_gossip_sim(cfg, score_cfg=sc, **b)
+             for b in builds]
+    states = [p[1] for p in pairs]
+    if invariants:
+        states = [_inv.attach(s) for s in states]
+    params = gs.stack_trees([p[0] for p in pairs])
+    state = gs.stack_trees(states)
+    params = jax.device_put(params)
+    state = jax.device_put(state)
+    step = gs.make_gossip_step(cfg, sc, invariants=icfg)
+
+    attackers, victims = ctx["attackers"], ctx["victims"]
+    honest_row = ~attackers  # victims/churners are honest population
+    honest = np.broadcast_to(honest_row, (len(builds), n)).copy()
+    state, reach = gs.gossip_run_tournament(params, state, n_ticks,
+                                            step, honest)
+    reach = np.asarray(reach)
+
+    members = np.arange(n) % t
+    want = np.array([(honest_row & (members == tau)).sum()
+                     for tau in ctx["topic"]], dtype=np.float64)
+    rows = []
+    for b, mrow in enumerate(meta):
+        frac = float((reach[b] / want).mean())
+        row = dict(mrow, delivery_fraction=round(frac, 4))
+        if mrow["attack"] == "eclipse":
+            p_b = gs.index_trees(params, b)
+            s_b = gs.index_trees(state, b)
+            row["eclipse_takeover"] = round(
+                gs.eclipse_takeover(s_b, p_b, cfg), 4)
+        if invariants:
+            row["inv_bits"] = int(np.asarray(state.inv_viol)[b])
+            row["inv_first"] = int(np.asarray(state.inv_first)[b])
+        rows.append(row)
+
+    worst = {}
+    for dname in defenses:
+        d_rows = [r for r in rows if r["defense"] == dname]
+        w = min(d_rows, key=lambda r: r["delivery_fraction"])
+        worst[dname] = {"delivery_fraction": w["delivery_fraction"],
+                        "attack": w["attack"]}
+    return {
+        "n_peers": n, "n_topics": t, "n_msgs": m, "ticks": n_ticks,
+        "replicas": len(builds),
+        "attacks": list(attacks), "defenses": list(defenses),
+        "rows": rows, "worst_case": worst,
+        "reference_worst_case_delivery":
+            worst.get("reference", {}).get("delivery_fraction"),
+        "invariant_violations": sum(r.get("inv_bits", 0) != 0
+                                    for r in rows),
+    }

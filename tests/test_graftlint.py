@@ -172,19 +172,23 @@ def test_only_graftlint_fixture_dir_is_exempt(tmp_path):
 
 def test_declared_matrix_shape():
     combos = ja.declared_matrix()
-    assert len(combos) == 44
+    assert len(combos) == 50
     # base 32: all three sims x telemetry x faults x batched; split
     # axis only on gossipsub.  Round-10 variants: gather/dense
     # (tel x faults), rpc (tel, faulted), hist (faults, scored).
+    # Round-11 variants: inv (the in-scan invariant checker — gossip
+    # on both fault axes, flood/randomsub faulted) and attack (the
+    # eclipse+byzantine+knobs+cold-restart surface, sequential + the
+    # batched tournament runner).
     key = lambda c: (c["sim"], c["split"], c["telemetry"],  # noqa: E731
                      c["faults"], c["batched"], c["variant"])
-    assert len({key(c) for c in combos}) == 44
+    assert len({key(c) for c in combos}) == 50
     assert sum(not c["variant"] for c in combos) == 32
-    for sim, n in (("gossipsub", 20), ("floodsub", 12),
-                   ("randomsub", 12)):
+    for sim, n in (("gossipsub", 24), ("floodsub", 13),
+                   ("randomsub", 13)):
         assert sum(c["sim"] == sim for c in combos) == n
     for var, n in (("gather", 4), ("dense", 4), ("rpc", 2),
-                   ("hist", 2)):
+                   ("hist", 2), ("inv", 4), ("attack", 2)):
         assert sum(c["variant"] == var for c in combos) == n
     axes = {ax: {c[ax] for c in combos}
             for ax in ("telemetry", "faults", "batched")}
@@ -276,14 +280,17 @@ def test_audit_catches_a_seeded_callback_and_missing_donation():
 
 
 def test_contract_declarations_complete():
-    """Every field of the three contracted configs is declared, for
+    """Every field of the five contracted configs is declared, for
     every declared path — no probes run (fast completeness gate)."""
     import dataclasses
     from go_libp2p_pubsub_tpu.models.faults import FaultSchedule
-    from go_libp2p_pubsub_tpu.models.gossipsub import GossipSimConfig
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        GossipSimConfig, ScoreSimConfig)
+    from go_libp2p_pubsub_tpu.models.invariants import InvariantConfig
     from go_libp2p_pubsub_tpu.models.telemetry import TelemetryConfig
 
-    for cls in (GossipSimConfig, TelemetryConfig, FaultSchedule):
+    for cls in (GossipSimConfig, ScoreSimConfig, TelemetryConfig,
+                FaultSchedule, InvariantConfig):
         fields = {f.name for f in dataclasses.fields(cls)}
         assert set(cls.CONTRACT) == fields, cls.__name__
         for fld, spec in cls.CONTRACT.items():
@@ -294,16 +301,25 @@ def test_contract_declarations_complete():
 
 def test_contract_refusals_and_build_time_hold():
     """The build-time reject claims verified directly (the fast,
-    no-trace subset).  _REFUSALS is EMPTY since round 10 — the pallas
-    kernel flipped to threaded in round 9 and the flood-gather /
-    randomsub-dense paths in round 10 (see
-    test_contract_fault_threading_fast and
-    test_contract_telemetry_kernel_threaded_fast) — and must stay
-    empty unless a future path genuinely refuses observability
-    configs."""
+    no-trace subset).  _REFUSALS — emptied in round 10 — carries the
+    round-11 CAPABILITY refusals now: the mesh-less simulators refuse
+    cold-restart schedules, and the pallas kernel refuses the
+    P3/byzantine score family.  The cheap (build-only) cold-restart
+    probes run here; the kernel refusal probe traces a step and is
+    exercised by test_attacks.py + the @slow full sweep."""
     from tools.graftlint import contracts as ct
 
-    assert ct._REFUSALS == {}
+    assert set(ct._REFUSALS) == {
+        ("FaultSchedule", "flood-circulant"),
+        ("FaultSchedule", "flood-gather"),
+        ("FaultSchedule", "randomsub-circulant"),
+        ("FaultSchedule", "randomsub-dense"),
+        ("ScoreSimConfig", "kernel"),
+    }
+    for key, (probe, match) in ct._REFUSALS.items():
+        if key[0] != "FaultSchedule":
+            continue
+        assert ct._expect_raise(probe, match, label=str(key)) == [], key
     for key, (probe, match) in ct._BUILD_TIME.items():
         assert ct._expect_raise(probe, match, label=str(key)) == [], key
     # and the match is load-bearing: the right exception with the
@@ -312,6 +328,11 @@ def test_contract_refusals_and_build_time_hold():
         raise ValueError("some incidental validation error")
     assert ct._expect_raise(wrong_reason, r"refuses fault configs",
                             label="x") != []
+    # probe-refusal registry (round 11): the remaining rpc_probe
+    # capability gaps stay named, live, and NotImplementedError-typed
+    for label, (probe, match) in ct._PROBE_REFUSALS.items():
+        assert ct._expect_raise(probe, match, label=label,
+                                exc=NotImplementedError) == [], label
 
 
 def test_contract_fault_threading_fast():

@@ -428,3 +428,95 @@ def test_gossipsub_direct_peers_core_vs_sim():
             break
     else:
         raise AssertionError(f"envelope breach after retry: {last}")
+
+
+# -- faulted cross-validation (round 11): churn on BOTH sides ---------------
+
+
+def test_core_churn_harness_smoke():
+    """Fast harness check: a peer churned across the publish window
+    records leave+join, misses messages while down, and the rest of
+    the cluster still fully delivers."""
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    from go_libp2p_pubsub_tpu.interop import run_core_gossipsub
+    from go_libp2p_pubsub_tpu.pb.trace import TraceType
+
+    n, C = 24, 6
+    offsets = gs.make_gossip_offsets(1, C, n, seed=3)
+    pubs = [0, 3, 7, 11, 15, 19]
+    churn = [(5, 0.0, 0.7), (9, 0.05, 0.7)]
+    run = run_core_gossipsub(offsets, n, pubs, warm_s=0.8,
+                             settle_s=1.0, churn=churn)
+    ev = run.extra["churn_events"]
+    assert {(p, kind) for p, kind, _ in ev} == {
+        (5, "leave"), (5, "join"), (9, "leave"), (9, "join")}
+    hops = hops_from_trace(run)
+    # churned peers missed at least one publish-window message ...
+    assert (hops[5] < 0).any() or (hops[9] < 0).any()
+    # ... while every untouched peer got everything
+    untouched = np.ones(n, dtype=bool)
+    untouched[[5, 9]] = False
+    assert (hops[untouched] >= 0).all()
+
+
+@pytest.mark.slow
+def test_gossipsub_churned_core_vs_sim_delivery():
+    """BASELINE cross-validation under FAULTS (ROADMAP known gap): the
+    asyncio core cluster and the vectorized simulator run the SAME
+    FaultSchedule JOIN/LEAVE windows (churn_from_schedule maps ticks
+    to heartbeats) and their delivery pictures must agree — full
+    delivery at non-churned peers on both sides, and the per-message
+    mean delivery fraction matching within a loose asyncio-timing
+    envelope."""
+    import go_libp2p_pubsub_tpu.models.faults as fl
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    from go_libp2p_pubsub_tpu.interop import (
+        churn_from_schedule, run_core_gossipsub)
+
+    n, C, M = 40, 8, 12
+    heartbeat_s = 0.05
+    offsets = gs.make_gossip_offsets(1, C, n, seed=3)
+    rng = np.random.default_rng(5)
+    victims = [4, 9, 17, 23, 31]
+    publishers = [int(p) for p in
+                  rng.choice(np.setdiff1d(np.arange(n), victims), M)]
+    # sim timeline: warm to tick 90, publishes at 90, victims down
+    # ticks [88, 106) — across the whole publish burst
+    pub_tick, down = 90, (88, 106)
+    sched = fl.FaultSchedule(
+        n_peers=n, horizon=130,
+        down_intervals=[(v, down[0], down[1]) for v in victims])
+    cfg = gs.GossipSimConfig(
+        offsets=offsets, n_topics=1, d=3, d_lo=2, d_hi=6, d_score=2,
+        d_out=1, d_lazy=2, backoff_ticks=8)
+    subs = np.ones((n, 1), dtype=bool)
+    params, state = gs.make_gossip_sim(
+        cfg, subs, np.zeros(M, np.int64), np.array(publishers),
+        np.full(M, pub_tick, np.int32), fault_schedule=sched)
+    out = gs.gossip_run(params, state, 120,
+                        gs.make_gossip_step(cfg))
+    ft = np.asarray(gs.first_tick_matrix(out, M))
+    sim_frac = (ft >= 0).mean(axis=0)
+    untouched = np.ones(n, dtype=bool)
+    untouched[victims] = False
+    assert (ft[untouched] >= 0).all()
+
+    churn = churn_from_schedule(sched, heartbeat_s,
+                                start_tick=pub_tick)
+    last = None
+    for warm_s, settle_s in ((2.0, 1.6), (3.5, 2.2)):
+        run = run_core_gossipsub(offsets, n, publishers,
+                                 heartbeat_s=heartbeat_s,
+                                 warm_s=warm_s, settle_s=settle_s,
+                                 churn=churn)
+        hops = hops_from_trace(run)
+        core_frac = (hops >= 0).mean(axis=0)
+        delta = abs(core_frac.mean() - sim_frac.mean())
+        core_untouched_ok = (hops[untouched] >= 0).all()
+        last = (delta, core_frac.mean(), sim_frac.mean())
+        if core_untouched_ok and delta < 0.15:
+            break
+    else:
+        raise AssertionError(f"churned delivery disagrees: {last}")
+    # the fault bit on both sides: churned peers miss SOME deliveries
+    assert sim_frac.mean() < 1.0

@@ -1,0 +1,261 @@
+"""In-scan runtime invariant checking (models/invariants.py).
+
+Pins the round-11 acceptance properties:
+(a) ``invariants=None`` is bit-identical to the pre-invariant step
+    (state pytree and trajectory);
+(b) with the checker ON, every pre-existing state field's trajectory
+    is bit-identical too (the checker only reads), and all green
+    paths — scored, faulted, attacked, flood, randomsub, batched —
+    report ZERO violations;
+(c) the checker actually FIRES: a deliberately seeded defect (state
+    surgery creating an impossible state, and a broken step wrapper)
+    trips the right bit and records the first violating tick.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import go_libp2p_pubsub_tpu.models.faults as fl
+import go_libp2p_pubsub_tpu.models.floodsub as fs
+import go_libp2p_pubsub_tpu.models.gossipsub as gs
+import go_libp2p_pubsub_tpu.models.invariants as iv
+import go_libp2p_pubsub_tpu.models.randomsub as rs
+from go_libp2p_pubsub_tpu.ops.graph import make_circulant_offsets
+
+
+def build(n=240, t=2, m=8, seed=0, score=True, sched=None, cfg_kw=None,
+          **sim_kw):
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, 16, n, seed=1), n_topics=t,
+        **(cfg_kw or {}))
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    rng = np.random.default_rng(seed)
+    topic = rng.integers(0, t, m)
+    origin = rng.integers(0, n // t, m) * t + topic
+    ticks = rng.integers(0, 10, m).astype(np.int32)
+    sc = gs.ScoreSimConfig(**sim_kw.pop("score_kw", {})) if score \
+        else None
+    params, state = gs.make_gossip_sim(
+        cfg, subs, topic, origin, ticks, seed=seed, score_cfg=sc,
+        fault_schedule=sched, **sim_kw)
+    return cfg, sc, params, state
+
+
+def leaves_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+def test_invariants_off_bit_identical():
+    """invariants=None compiles the exact pre-invariant step: same
+    pytree (the None carry fields contribute no leaves), same
+    trajectory."""
+    cfg, sc, params, state = build()
+    base = gs.gossip_run(params, gs.tree_copy(state), 20,
+                         gs.make_gossip_step(cfg, sc))
+    off = gs.gossip_run(params, state, 20,
+                        gs.make_gossip_step(cfg, sc, invariants=None))
+    assert leaves_equal(base, off)
+    assert base.inv_viol is None and off.inv_viol is None
+
+
+def test_invariants_on_trajectory_identical_and_green():
+    """Checker ON: every pre-existing field bit-identical (pure
+    readout), zero violations, first_tick stays -1."""
+    cfg, sc, params, state = build()
+    base = gs.gossip_run(params, gs.tree_copy(state), 25,
+                         gs.make_gossip_step(cfg, sc))
+    on = gs.gossip_run(params, iv.attach(state), 25,
+                       gs.make_gossip_step(
+                           cfg, sc, invariants=iv.InvariantConfig()))
+    assert leaves_equal(base, on.replace(inv_viol=None, inv_first=None))
+    assert iv.report(on) == {"violations": [], "bits": 0,
+                             "first_tick": -1}
+
+
+@pytest.mark.parametrize("score", [False, True])
+def test_invariants_green_under_faults(score):
+    """Churn + link loss + partition + cold restart: still zero
+    violations (the checker knows the legitimate clears)."""
+    n = 240
+    sched = fl.FaultSchedule(
+        n_peers=n, horizon=40,
+        down_intervals=[(3, 2, 8), (9, 5, 12), (40, 1, 30)],
+        drop_prob=0.05,
+        partition_group=(np.arange(n) % 2).astype(np.int32),
+        partition_windows=[(6, 12)], cold_restart=True, seed=2)
+    cfg, sc, params, state = build(n=n, score=score, sched=sched)
+    out = gs.gossip_run(params, iv.attach(state), 30,
+                        gs.make_gossip_step(
+                            cfg, sc, invariants=iv.InvariantConfig()))
+    assert iv.report(out)["bits"] == 0
+
+
+def test_invariants_green_under_attacks():
+    """Graft-flood + IHAVE/IWANT spam sybils: the attackers' own
+    backoff-bypassing mesh edges are excluded by construction, so a
+    green adversarial run stays green."""
+    n = 240
+    sybil = (np.arange(n) % 5) == 0
+    cfg, sc, params, state = build(
+        n=n, score=True, sybil=sybil,
+        score_kw=dict(sybil_ihave_spam=True, sybil_iwant_spam=True,
+                      sybil_graft_flood=True))
+    out = gs.gossip_run(params, iv.attach(state), 25,
+                        gs.make_gossip_step(
+                            cfg, sc, invariants=iv.InvariantConfig()))
+    assert iv.report(out)["bits"] == 0
+
+
+def test_invariants_batched_matches_sequential():
+    """vmap over invariant-armed replicas: per-replica carries equal
+    the sequential runs bit-for-bit."""
+    cfg, sc, params0, state0 = build(seed=0)
+    _, _, params1, state1 = build(seed=1)
+    step = gs.make_gossip_step(cfg, sc,
+                               invariants=iv.InvariantConfig())
+    params = gs.stack_trees([params0, params1])
+    state = gs.stack_trees([iv.attach(state0), iv.attach(state1)])
+    batch = gs.gossip_run_batch(params, state, 15, step)
+    for i, (p_i, s_i) in enumerate(((params0, state0),
+                                    (params1, state1))):
+        seq = gs.gossip_run(p_i, iv.attach(s_i), 15, step)
+        assert leaves_equal(seq, gs.index_trees(batch, i))
+
+
+def test_seeded_mesh_defect_fires():
+    """State surgery: a forged mesh bit at an UNSUBSCRIBED candidate
+    edge survives the step (existing mesh bits are not re-validated)
+    and must trip mesh-subscription on the very first tick."""
+    cfg, sc, params, state = build()
+    # candidate c of peer p is subscribed iff bit c of cand_sub_bits;
+    # find a peer with at least one unsubscribed candidate... with
+    # every peer subscribed the unsub edge must be synthesized: mark
+    # one peer unsubscribed in a fresh sim instead
+    n = 240
+    subs = np.zeros((n, 2), dtype=bool)
+    subs[np.arange(n), np.arange(n) % 2] = True
+    subs[7] = False                      # peer 7 subscribes nothing
+    rng = np.random.default_rng(0)
+    topic = rng.integers(0, 2, 8)
+    origin = rng.integers(0, n // 2, 8) * 2 + topic
+    origin = np.where(origin == 7, (origin + 2) % n, origin)
+    topic = (origin % 2).astype(topic.dtype)
+    params, state = gs.make_gossip_sim(
+        cfg, subs, topic, origin, rng.integers(0, 5, 8).astype(
+            np.int32), score_cfg=sc)
+    victim = 7 - int(cfg.offsets[0])     # peer whose candidate 0 is 7
+    mesh = np.zeros(n, dtype=np.uint32)
+    mesh[victim % n] = 1                 # forged edge at unsub peer 7
+    state = state.replace(mesh=gs.jnp.asarray(mesh))
+    state = gs.refresh_gates(cfg, sc, params, state)
+    out = gs.gossip_run(params, iv.attach(state), 3,
+                        gs.make_gossip_step(
+                            cfg, sc, invariants=iv.InvariantConfig()))
+    rep = iv.report(out)
+    assert "mesh-subscription" in rep["violations"]
+    assert rep["first_tick"] == 0
+
+
+def test_seeded_broken_step_fires_delivery_bits():
+    """A deliberately broken step — delivering at a DOWN peer and
+    shrinking possession — trips the delivery-group bits through the
+    same fold the in-step wiring uses."""
+    n = 240
+    sched = fl.FaultSchedule(n_peers=n, horizon=40,
+                             down_intervals=[(5, 0, 30)])
+    cfg, sc, params, state = build(n=n, sched=sched)
+    icfg = iv.InvariantConfig()
+    base = gs.make_gossip_step(cfg, sc)
+
+    def broken(params, state):
+        s2, delivered = base(params, state)
+        # deliver a copy at down peer 5, and lose every origin's own
+        # copy (possession shrinks at peers that HAVE content)
+        bad = np.zeros((delivered.shape[0], n), dtype=np.uint32)
+        bad[0, 5] = 1
+        delivered = delivered | gs.jnp.asarray(bad)
+        # shrink = a bit the PREVIOUS state held and the new one lacks
+        drop = gs.jnp.where(state.tick >= 3,
+                            params.origin_words & state.have,
+                            gs.jnp.uint32(0))
+        s2 = s2.replace(have=s2.have & ~drop)
+        aw = fl.alive_word(fl.alive_mask(params.faults, state.tick))
+        bits = iv.delivery_violations(
+            icfg, state.have, s2.have, delivered, alive_w=aw,
+            invalid_words=params.invalid_words)
+        viol, first = iv.fold(state.inv_viol, state.inv_first, bits,
+                              state.tick)
+        return s2.replace(inv_viol=viol, inv_first=first), delivered
+
+    out = gs.gossip_run(params, iv.attach(state), 14, broken)
+    rep = iv.report(out)
+    assert "delivery-down" in rep["violations"]
+    assert "possession-regression" in rep["violations"]
+    assert rep["first_tick"] >= 0
+
+
+def test_flood_and_randomsub_green_and_armed_guard():
+    n, t, m = 120, 2, 6
+    subs = np.zeros((n, t), bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    rng = np.random.default_rng(0)
+    topic = rng.integers(0, t, m)
+    origin = rng.integers(0, n // t, m) * t + topic
+    ticks = np.zeros(m, np.int32)
+    offs = tuple(int(o) for o in make_circulant_offsets(t, 8, n,
+                                                        seed=1))
+    sched = fl.FaultSchedule(n_peers=n, horizon=12,
+                             down_intervals=((0, 0, 4),),
+                             drop_prob=0.1)
+    icfg = iv.InvariantConfig()
+    p, s = fs.make_flood_sim(None, None, subs, None, topic, origin,
+                             ticks, fault_schedule=sched,
+                             fault_offsets=offs)
+    core = fs.make_circulant_step_core(offs, invariants=icfg)
+    with pytest.raises(ValueError, match="attach"):
+        jax.eval_shape(core, p, s)       # unarmed state refused
+    out, _ = fs.flood_run_curve(p, iv.attach(s), 10, core, m)
+    assert iv.report(out)["bits"] == 0
+
+    rcfg = rs.RandomSubSimConfig(
+        offsets=rs.make_randomsub_offsets(t, 8, n, seed=1),
+        n_topics=t, d=3)
+    p2, s2 = rs.make_randomsub_sim(rcfg, subs, topic, origin, ticks,
+                                   fault_schedule=sched)
+    out2 = rs.randomsub_run(p2, iv.attach(s2), 10,
+                            rs.make_randomsub_step(rcfg,
+                                                   invariants=icfg))
+    assert iv.report(out2)["bits"] == 0
+
+
+def test_invariants_kernel_path_interpret():
+    """The pallas path folds the SAME checker in its epilogue:
+    green on a faulted scored run, and the carried bits equal the
+    XLA path's (both zero, trajectories parity-pinned elsewhere)."""
+    n, t, m = 512, 2, 8
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, 16, n, seed=1), n_topics=t)
+    sc = gs.ScoreSimConfig()
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    rng = np.random.default_rng(0)
+    topic = rng.integers(0, t, m)
+    origin = rng.integers(0, n // t, m) * t + topic
+    ticks = rng.integers(0, 5, m).astype(np.int32)
+    sched = fl.FaultSchedule(n_peers=n, horizon=20,
+                             down_intervals=[(3, 1, 6)],
+                             cold_restart=True)
+    params, state = gs.make_gossip_sim(
+        cfg, subs, topic, origin, ticks, score_cfg=sc,
+        fault_schedule=sched, pad_to_block=128)
+    step = gs.make_gossip_step(cfg, sc, receive_block=128,
+                               receive_interpret=True,
+                               invariants=iv.InvariantConfig())
+    out = gs.gossip_run(params, iv.attach(state), 8, step)
+    assert iv.report(out)["bits"] == 0

@@ -690,6 +690,45 @@ def test_rpc_stream_drop_rpc_under_faults():
         assert (p, k) not in down, (p, k, e.type)
 
 
+def test_rpc_stream_captures_flood_publish():
+    """Round 11 (the fixed round-10 refusal): with WithFloodPublish, a
+    publisher's due messages ride SEND_RPCs to EVERY subscribed
+    candidate above the publish threshold — far beyond its mesh
+    degree — and flood-only edges carry exactly the due publishes."""
+    from go_libp2p_pubsub_tpu.interop import export as ex
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        ScoreSimConfig, gossip_run_rpc_snapshots)
+
+    n, t, m, T = 200, 2, 4, 6
+    cfg = GossipSimConfig(offsets=make_gossip_offsets(t, 16, n, seed=4),
+                          n_topics=t)
+    sc = ScoreSimConfig(flood_publish=True)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    origin = np.array([10, 11, 24, 37])
+    topic = (origin % t).astype(np.int64)
+    ticks = np.array([2, 2, 3, 3], dtype=np.int32)
+    params, state = make_gossip_sim(cfg, subs, topic, origin, ticks,
+                                    score_cfg=sc)
+    peer_topic = (np.arange(n) % t).astype(np.int64)
+    _, rsnaps = gossip_run_rpc_snapshots(
+        params, state, T, make_gossip_step(cfg, sc, rpc_probe=True))
+    rsnaps = {k: np.asarray(v) for k, v in rsnaps.items()}
+    events = ex.rpc_events(rsnaps, cfg.offsets, topic, peer_topic)
+    mid = {msg_id(j): j for j in range(m)}
+    for j, (o, pt) in enumerate(zip(origin, ticks)):
+        sends = [e for e in events
+                 if e.type == TraceType.SEND_RPC
+                 and e.peer_id == b"sim-%d" % o
+                 and e.timestamp // 10**9 == int(pt)
+                 and any(mid.get(mm.message_id) == j
+                         for mm in (e.send_rpc.meta.messages or ()))]
+        # flood: every subscribed candidate gets a copy at the publish
+        # tick — with C=16 and ~half the ring in-topic that is well
+        # above the mesh bound Dhi
+        assert len(sends) > cfg.d_hi, (j, len(sends))
+
+
 def test_peer_events_churn_semantics():
     """ADD_PEER at tick 0 for live circulant partners; REMOVE_PEER by
     live observers when a peer goes down; symmetric re-ADD on rejoin."""

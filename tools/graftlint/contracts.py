@@ -75,18 +75,23 @@ _ARTIFACT_CACHE: dict[tuple, tuple] = {}
 
 
 def _gossip_artifact(path, cfg_kw=None, *, n_topics=T, paired=False,
-                     px=7, attack=False):
+                     px=7, attack=False, sc_kw=None, sybil=False,
+                     app=False, eclipse=False, byz=False):
     """(jaxpr_text, build_leaves) of a scored gossip step on ``path``
-    ("xla" | "kernel") under config overrides.  ``attack`` switches to
-    the IWANT-spam adversarial config (some knobs — the
-    gossip-repair abuse bounds — only compile in under attack).
+    ("xla" | "kernel") under config overrides.  ``sc_kw`` overrides
+    ScoreSimConfig fields (the round-11 score-contract probes);
+    ``attack`` is the legacy IWANT-spam shorthand (sets the sc toggle
+    AND the sybil flags — some knobs, the gossip-repair abuse bounds,
+    only compile in under attack).  ``sybil``/``app``/``eclipse``/
+    ``byz`` arm the sim arrays a probed toggle needs to be live.
     Memoized: every probe shares its base artifact."""
     import jax
     import numpy as np
     import go_libp2p_pubsub_tpu.models.gossipsub as gs
 
-    key = (path, n_topics, paired, px, attack,
-           tuple(sorted((cfg_kw or {}).items())))
+    key = (path, n_topics, paired, px, attack, sybil, app, eclipse,
+           byz, tuple(sorted((cfg_kw or {}).items())),
+           tuple(sorted((sc_kw or {}).items())))
     if key in _ARTIFACT_CACHE:
         return _ARTIFACT_CACHE[key]
 
@@ -101,12 +106,28 @@ def _gossip_artifact(path, cfg_kw=None, *, n_topics=T, paired=False,
     else:
         kw.pop("offsets_seed", None)
     cfg = gs.GossipSimConfig(offsets=offsets, **kw)
-    sc = gs.ScoreSimConfig(sybil_iwant_spam=attack)
+    sc_fields = dict(sc_kw or {})
+    if attack:
+        sc_fields.setdefault("sybil_iwant_spam", True)
+    sc = gs.ScoreSimConfig(**sc_fields)
     subs, topic, origin, ticks = _inputs(n_topics, paired=paired)
     sim_kw = dict(score_cfg=sc)
     step_kw = {}
-    if attack:
+    if attack or sybil:
         sim_kw["sybil"] = (np.arange(N) % 5) == 0
+    if app:
+        # nonzero app scores + shared IPs: the P5/P6 bakes (and the
+        # colocation threshold) only show in the build when live
+        ip = np.arange(N)
+        ip[::4] = 0
+        sim_kw.update(
+            app_score=(np.arange(N) % 3).astype(np.float32),
+            peer_ip=ip)
+    if eclipse:
+        sim_kw.update(eclipse_sybil=(np.arange(N) % 5) == 0,
+                      eclipse_victim=(np.arange(N) % 5) == 1)
+    if byz:
+        sim_kw.update(byzantine=(np.arange(N) % 5) == 0)
     if px is not None:
         sim_kw["px_candidates"] = px
     if path == "kernel":
@@ -255,6 +276,108 @@ def _faults_artifact(path, sched_kw=None):
     return jax.tree_util.tree_leaves(params)
 
 
+def _invariants_artifact(path, inv_kw=None):
+    """jaxpr text of an invariant-enabled step on one execution path,
+    over a scored+faulted base sim (gossip paths) or a faulted one
+    (flood/randomsub) so every check group has live inputs — the
+    round-11 twin of _telemetry_artifact."""
+    import jax
+    import go_libp2p_pubsub_tpu.models.floodsub as fs
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    import go_libp2p_pubsub_tpu.models.invariants as iv
+    import go_libp2p_pubsub_tpu.models.randomsub as rs
+    from go_libp2p_pubsub_tpu.ops.graph import make_circulant_offsets
+
+    key = ("inv", path, tuple(sorted((inv_kw or {}).items())))
+    if key in _ARTIFACT_CACHE:
+        return _ARTIFACT_CACHE[key]
+    icfg = iv.InvariantConfig(**(inv_kw or {}))
+    subs, topic, origin, ticks = _inputs(T)
+    sched = _fault_schedule()
+    if path in ("gossip-xla", "gossip-kernel"):
+        cfg = gs.GossipSimConfig(
+            offsets=gs.make_gossip_offsets(T, C, N, seed=1),
+            n_topics=T, d=3, d_lo=2, d_hi=6, d_score=2, d_out=1,
+            d_lazy=2, backoff_ticks=8)
+        sc = gs.ScoreSimConfig()
+        sim_kw, step_kw = {}, {}
+        if path == "gossip-kernel":
+            sim_kw["pad_to_block"] = KERNEL_BLOCK
+            step_kw["receive_block"] = KERNEL_BLOCK
+        params, state = gs.make_gossip_sim(
+            cfg, subs, topic, origin, ticks, score_cfg=sc,
+            fault_schedule=sched, **sim_kw)
+        state = iv.attach(state)
+        step = gs.make_gossip_step(cfg, sc, invariants=icfg, **step_kw)
+    elif path == "flood-circulant":
+        offs = tuple(int(o) for o in
+                     make_circulant_offsets(T, C, N, seed=1))
+        params, state = fs.make_flood_sim(
+            None, None, subs, None, topic, origin, ticks,
+            fault_schedule=sched, fault_offsets=offs)
+        state = iv.attach(state)
+        step = fs.make_circulant_step_core(offs, invariants=icfg)
+    elif path == "flood-gather":
+        nbrs, mask = _gather_table()
+        params, state = fs.make_flood_sim(
+            nbrs, mask, subs, None, topic, origin, ticks,
+            fault_schedule=sched)
+        state = iv.attach(state)
+        step = fs.make_gather_step_core(invariants=icfg)
+    elif path == "randomsub-circulant":
+        rcfg = rs.RandomSubSimConfig(
+            offsets=rs.make_randomsub_offsets(T, C, N, seed=1),
+            n_topics=T, d=3)
+        params, state = rs.make_randomsub_sim(
+            rcfg, subs, topic, origin, ticks, fault_schedule=sched)
+        state = iv.attach(state)
+        step = rs.make_randomsub_step(rcfg, invariants=icfg)
+    elif path == "randomsub-dense":
+        rcfg = rs.RandomSubSimConfig(
+            offsets=rs.make_randomsub_offsets(T, C, N, seed=1),
+            n_topics=T, d=3)
+        params, state = rs.make_randomsub_sim(
+            rcfg, subs, topic, origin, ticks, dense=True,
+            fault_schedule=sched)
+        state = iv.attach(state)
+        step = rs.make_randomsub_dense_step(rcfg, invariants=icfg)
+    else:
+        raise ValueError(f"no invariants probe path {path!r}")
+    out = str(jax.make_jaxpr(step)(params, state))
+    _ARTIFACT_CACHE[key] = out
+    return out
+
+
+def _cold_restart_artifact(path, cold: bool):
+    """jaxpr text of a churned gossip step with/without the
+    cold-restart clear — the FaultSchedule.cold_restart threading
+    proof (the flag is static on FaultParams, so a build-leaf diff
+    cannot see it)."""
+    import jax
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+
+    key = ("cold", path, cold)
+    if key in _ARTIFACT_CACHE:
+        return _ARTIFACT_CACHE[key]
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(T, C, N, seed=1),
+        n_topics=T, d=3, d_lo=2, d_hi=6, d_score=2, d_out=1,
+        d_lazy=2, backoff_ticks=8)
+    subs, topic, origin, ticks = _inputs(T)
+    sched = _fault_schedule(cold_restart=cold)
+    sim_kw, step_kw = {}, {}
+    if path == "gossip-kernel":
+        sim_kw["pad_to_block"] = KERNEL_BLOCK
+        step_kw["receive_block"] = KERNEL_BLOCK
+    params, state = gs.make_gossip_sim(
+        cfg, subs, topic, origin, ticks, fault_schedule=sched,
+        **sim_kw)
+    step = gs.make_gossip_step(cfg, **step_kw)
+    out = str(jax.make_jaxpr(step)(params, state))
+    _ARTIFACT_CACHE[key] = out
+    return out
+
+
 def _leaves_differ(a, b) -> bool:
     import numpy as np
     if len(a) != len(b):
@@ -327,13 +450,98 @@ _TEL_PROBES = {
 }
 
 #: FaultSchedule threaded probes: schedule overrides whose compiled
-#: FaultParams must differ in the built params
+#: FaultParams must differ in the built params.  cold_restart is
+#: handled by its own jaxpr-diff prover (the flag is static).
 _FAULT_PROBES = {
     "down_intervals": dict(down_intervals=((0, 0, 3), (3, 1, 3))),
     "drop_prob": dict(drop_prob=0.2),
     "partition_group": dict(partition_group="mod4"),
     "partition_windows": dict(partition_windows=((0, 2),)),
     "seed": dict(seed=1),
+}
+
+#: ScoreSimConfig threaded probes (round 11): each entry is
+#: (base spec, probed sc_kw) — the probe artifact merges the probed
+#: fields over the base's sc_kw, sharing every build flag, so the two
+#: differ in ONLY the probed field.  Build flags arm the sim arrays a
+#: toggle needs to be live (sybil flags for the spam toggles, app
+#: scores / shared IPs for the P5/P6 bakes, eclipse/byzantine arrays
+#: for the round-11 formations).
+_SC = "sc_kw"
+_SCORE_PROBES = {
+    "topic_weight": ({}, {"topic_weight": 2.0}),
+    "topic_score_cap": ({}, {"topic_score_cap": 50.0}),
+    "time_in_mesh_weight": ({}, {"time_in_mesh_weight": 0.3}),
+    "time_in_mesh_quantum": ({}, {"time_in_mesh_quantum": 2}),
+    "time_in_mesh_cap": ({}, {"time_in_mesh_cap": 20.0}),
+    "first_message_deliveries_weight":
+        ({}, {"first_message_deliveries_weight": 2.0}),
+    "first_message_deliveries_decay":
+        ({}, {"first_message_deliveries_decay": 0.8}),
+    "first_message_deliveries_cap":
+        ({}, {"first_message_deliveries_cap": 60.0}),
+    "mesh_message_deliveries_weight":
+        ({}, {"mesh_message_deliveries_weight": -1.0}),
+    "mesh_message_deliveries_decay":
+        ({_SC: {"mesh_message_deliveries_weight": -1.0}},
+         {"mesh_message_deliveries_decay": 0.8}),
+    "mesh_message_deliveries_cap":
+        ({_SC: {"mesh_message_deliveries_weight": -1.0}},
+         {"mesh_message_deliveries_cap": 30.0}),
+    "mesh_message_deliveries_threshold":
+        ({_SC: {"mesh_message_deliveries_weight": -1.0}},
+         {"mesh_message_deliveries_threshold": 2.0}),
+    "mesh_message_deliveries_activation":
+        ({_SC: {"mesh_message_deliveries_weight": -1.0}},
+         {"mesh_message_deliveries_activation": 8}),
+    "mesh_failure_penalty_weight":
+        ({}, {"mesh_failure_penalty_weight": -1.0}),
+    "mesh_failure_penalty_decay":
+        ({_SC: {"mesh_failure_penalty_weight": -1.0}},
+         {"mesh_failure_penalty_decay": 0.8}),
+    "invalid_message_deliveries_weight":
+        ({}, {"invalid_message_deliveries_weight": -20.0}),
+    "invalid_message_deliveries_decay":
+        ({}, {"invalid_message_deliveries_decay": 0.9}),
+    "app_specific_weight": ({"app": True},
+                            {"app_specific_weight": 2.0}),
+    "ip_colocation_factor_weight":
+        ({"app": True}, {"ip_colocation_factor_weight": -10.0}),
+    "ip_colocation_factor_threshold":
+        ({"app": True}, {"ip_colocation_factor_threshold": 2.0}),
+    "behaviour_penalty_weight":
+        ({}, {"behaviour_penalty_weight": -20.0}),
+    "behaviour_penalty_decay":
+        ({}, {"behaviour_penalty_decay": 0.8}),
+    "behaviour_penalty_threshold":
+        ({}, {"behaviour_penalty_threshold": 1.0}),
+    "decay_to_zero": ({}, {"decay_to_zero": 0.02}),
+    "gossip_threshold": ({}, {"gossip_threshold": -12.0}),
+    "publish_threshold": ({}, {"publish_threshold": -40.0}),
+    "graylist_threshold": ({}, {"graylist_threshold": -70.0}),
+    "opportunistic_graft_threshold":
+        ({}, {"opportunistic_graft_threshold": 2.0}),
+    "opportunistic_graft_ticks":
+        ({}, {"opportunistic_graft_ticks": 30}),
+    "opportunistic_graft_peers":
+        ({}, {"opportunistic_graft_peers": 3}),
+    "flood_publish": ({}, {"flood_publish": True}),
+    "sybil_ihave_spam": ({"sybil": True}, {"sybil_ihave_spam": True}),
+    "sybil_graft_flood": ({"sybil": True},
+                          {"sybil_graft_flood": True}),
+    "sybil_iwant_spam": ({"sybil": True}, {"sybil_iwant_spam": True}),
+    "sybil_eclipse": ({"eclipse": True}, {"sybil_eclipse": True}),
+    "byzantine_mutation": ({"byz": True}, {"byzantine_mutation": True}),
+    "counter_dtype": ({}, {"counter_dtype": "float32"}),
+}
+
+#: InvariantConfig probes: (base InvariantConfig kwargs, probe kwargs)
+#: — the base turns every group off so the probe isolates one group
+_INV_OFF = dict(delivery=False, mesh=False, scores=False)
+_INV_PROBES = {
+    "delivery": (_INV_OFF, dict(delivery=True)),
+    "mesh": (_INV_OFF, dict(mesh=True)),
+    "scores": (_INV_OFF, dict(scores=True)),
 }
 
 
@@ -369,18 +577,173 @@ def _fault_threaded(field, path):
     return _leaves_differ(base, probe)
 
 
+def _score_threaded(field, path):
+    base_spec, probed = _SCORE_PROBES[field]
+    flags = {k: v for k, v in base_spec.items() if k != _SC}
+    base_sc = dict(base_spec.get(_SC, {}))
+    base = _gossip_artifact(path, sc_kw=base_sc, **flags)
+    probe = _gossip_artifact(path, sc_kw={**base_sc, **probed},
+                             **flags)
+    return base[0] != probe[0] or _leaves_differ(base[1], probe[1])
+
+
+def _inv_probe(field, path, want_inert):
+    base_kw, probe_kw = _INV_PROBES[field]
+    base = _invariants_artifact(path, base_kw)
+    probe = _invariants_artifact(path, {**base_kw, **probe_kw})
+    differs = base != probe
+    return (not differs) if want_inert else differs
+
+
+def _cold_restart_threaded(path):
+    return (_cold_restart_artifact(path, False)
+            != _cold_restart_artifact(path, True))
+
+
 # -- refusal probes (one per (class, path)) --------------------------------
 
 #: (probe, required-message regex): a refusal only counts when the
 #: raised ValueError is THE refusal, not an incidental one — an
 #: unrelated validation error must not vacuously satisfy the contract.
-#: Empty since round 10: the gossip-kernel entries went in round 9
-#: (in-kernel fault masks + telemetry tallies) and the flood-gather /
-#: randomsub-dense entries in round 10 (gather/dense fault compilers +
-#: telemetry subsets) — no path refuses observability configs any
-#: more; a still-refused-but-now-accepted declaration would be a
-#: finding.
-_REFUSALS: dict = {}
+#: Emptied in round 10 (no path refuses OBSERVABILITY configs);
+#: repopulated in round 11 with genuine capability refusals: the
+#: mesh-less simulators refuse cold-restart schedules (no IHAVE/IWANT
+#: repair to recover through), and the pallas kernel refuses the
+#: P3-family / byzantine-mutation score configs (the fused kernel
+#: elides the per-edge provenance loops both need).
+
+
+def _reject_cold_restart_flood_circulant():
+    import go_libp2p_pubsub_tpu.models.floodsub as fs
+    from go_libp2p_pubsub_tpu.ops.graph import make_circulant_offsets
+    offs = tuple(int(o) for o in
+                 make_circulant_offsets(T, C, N, seed=1))
+    subs, topic, origin, ticks = _inputs(T)
+    fs.make_flood_sim(None, None, subs, None, topic, origin, ticks,
+                      fault_schedule=_fault_schedule(cold_restart=True),
+                      fault_offsets=offs)   # must raise
+
+
+def _reject_cold_restart_flood_gather():
+    import go_libp2p_pubsub_tpu.models.floodsub as fs
+    nbrs, mask = _gather_table()
+    subs, topic, origin, ticks = _inputs(T)
+    fs.make_flood_sim(nbrs, mask, subs, None, topic, origin, ticks,
+                      fault_schedule=_fault_schedule(
+                          cold_restart=True))   # must raise
+
+
+def _reject_cold_restart_randomsub(dense: bool):
+    import go_libp2p_pubsub_tpu.models.randomsub as rs
+    rcfg = rs.RandomSubSimConfig(
+        offsets=rs.make_randomsub_offsets(T, C, N, seed=1),
+        n_topics=T, d=3)
+    subs, topic, origin, ticks = _inputs(T)
+    rs.make_randomsub_sim(rcfg, subs, topic, origin, ticks,
+                          dense=dense,
+                          fault_schedule=_fault_schedule(
+                              cold_restart=True))   # must raise
+
+
+def _reject_kernel_score_cfg():
+    """The kernel path must refuse the P3-family AND byzantine score
+    configs INDEPENDENTLY: a P3-only and a byzantine-only config each
+    trigger the capability refusal at trace time.  The probe raises
+    the refusal only after verifying BOTH — deleting either clause
+    from kernel_capability makes this probe NOT raise, which the
+    contract checker reports."""
+    import re
+    import jax
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    import numpy as np
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(T, C, N, seed=1), n_topics=T,
+        d=3, d_lo=2, d_hi=6, d_score=2, d_out=1, d_lazy=2,
+        backoff_ticks=8)
+    subs, topic, origin, ticks = _inputs(T)
+    probes = (
+        (gs.ScoreSimConfig(mesh_message_deliveries_weight=-1.0), {}),
+        (gs.ScoreSimConfig(byzantine_mutation=True),
+         dict(byzantine=(np.arange(N) % 5) == 0)),
+    )
+    for sc, sim_kw in probes:
+        params, state = gs.make_gossip_sim(
+            cfg, subs, topic, origin, ticks, score_cfg=sc,
+            pad_to_block=KERNEL_BLOCK, **sim_kw)
+        step = gs.make_gossip_step(cfg, sc,
+                                   receive_block=KERNEL_BLOCK)
+        try:
+            jax.eval_shape(step, params, state)
+        except ValueError as e:
+            if not re.search(r"not supported by the pallas step",
+                             str(e)):
+                raise
+            continue
+        return   # this condition did NOT refuse -> claim is false
+    raise ValueError(
+        "config not supported by the pallas step (P3-only and "
+        "byzantine-only refusals each verified independently)")
+
+
+_REFUSALS: dict = {
+    ("FaultSchedule", "flood-circulant"):
+        (_reject_cold_restart_flood_circulant,
+         r"cold_restart: the floodsub simulator refuses"),
+    ("FaultSchedule", "flood-gather"):
+        (_reject_cold_restart_flood_gather,
+         r"cold_restart: the floodsub simulator refuses"),
+    ("FaultSchedule", "randomsub-circulant"):
+        (lambda: _reject_cold_restart_randomsub(False),
+         r"cold_restart: the randomsub simulator refuses"),
+    ("FaultSchedule", "randomsub-dense"):
+        (lambda: _reject_cold_restart_randomsub(True),
+         r"cold_restart: the randomsub simulator refuses"),
+    ("ScoreSimConfig", "kernel"):
+        (_reject_kernel_score_cfg,
+         r"not supported by the pallas step"),
+}
+
+
+#: Round-11 standalone probe-refusal registry: capabilities that are
+#: PARAMETERS of make_gossip_step rather than config fields (so the
+#: per-field CONTRACT machinery cannot carry them).  Each remaining
+#: rpc_probe refusal gets an entry proving the refusal is live and
+#: names itself — removing the refusal without removing the entry (or
+#: vice versa) is a finding.  These raise NotImplementedError (a
+#: named capability gap, not invalid input).
+def _probe_rpc_paired():
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(T, C, N, seed=1, paired=True),
+        n_topics=T, paired_topics=True, d=3, d_lo=2, d_hi=6,
+        d_score=2, d_out=1, d_lazy=2, backoff_ticks=8)
+    gs.make_gossip_step(cfg, rpc_probe=True)   # must raise
+
+
+def _probe_rpc_mixed_protocol():
+    import jax
+    import numpy as np
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(T, C, N, seed=1), n_topics=T,
+        d=3, d_lo=2, d_hi=6, d_score=2, d_out=1, d_lazy=2,
+        backoff_ticks=8)
+    subs, topic, origin, ticks = _inputs(T)
+    params, state = gs.make_gossip_sim(
+        cfg, subs, topic, origin, ticks,
+        flood_proto=(np.arange(N) % 7) == 0)
+    step = gs.make_gossip_step(cfg, rpc_probe=True)
+    jax.eval_shape(step, params, state)   # must raise
+
+
+_PROBE_REFUSALS = {
+    "rpc_probe[paired-topics]":
+        (_probe_rpc_paired,
+         r"paired-topic mode is not probe-supported"),
+    "rpc_probe[mixed-protocol]":
+        (_probe_rpc_mixed_protocol,
+         r"mixed-protocol overlays are not probe-supported"),
+}
 
 
 # -- build-time reject probes ----------------------------------------------
@@ -440,9 +803,12 @@ _BUILD_TIME = {
 
 def _contracted_classes():
     from go_libp2p_pubsub_tpu.models.faults import FaultSchedule
-    from go_libp2p_pubsub_tpu.models.gossipsub import GossipSimConfig
+    from go_libp2p_pubsub_tpu.models.gossipsub import (
+        GossipSimConfig, ScoreSimConfig)
+    from go_libp2p_pubsub_tpu.models.invariants import InvariantConfig
     from go_libp2p_pubsub_tpu.models.telemetry import TelemetryConfig
-    return (GossipSimConfig, TelemetryConfig, FaultSchedule)
+    return (GossipSimConfig, ScoreSimConfig, TelemetryConfig,
+            FaultSchedule, InvariantConfig)
 
 
 def _threaded_prover(cls_name, field, path, status):
@@ -450,8 +816,14 @@ def _threaded_prover(cls_name, field, path, status):
     None when unregistered."""
     if cls_name == "GossipSimConfig" and field in _GOSSIP_PROBES:
         return lambda: _gossip_threaded(field, path)
+    if cls_name == "ScoreSimConfig" and field in _SCORE_PROBES:
+        return lambda: _score_threaded(field, path)
     if cls_name == "TelemetryConfig" and field in _TEL_PROBES:
         return lambda: _tel_probe(field, path, status == "inert")
+    if cls_name == "InvariantConfig" and field in _INV_PROBES:
+        return lambda: _inv_probe(field, path, status == "inert")
+    if cls_name == "FaultSchedule" and field == "cold_restart":
+        return lambda: _cold_restart_threaded(path)
     if cls_name == "FaultSchedule" and field in _FAULT_PROBES:
         return lambda: _fault_threaded(field, path)
     return None
@@ -549,24 +921,34 @@ def check_contracts(log=None) -> list[str]:
         if log is not None:
             log(f"  contract {name}: "
                 f"{len(fields)} fields x {len(paths)} paths checked")
+
+    # round 11: standalone probe-refusal entries (make_gossip_step
+    # capabilities, not config fields) — NotImplementedError, message
+    # matched, one entry per remaining rpc_probe refusal
+    for label, (probe, match) in sorted(_PROBE_REFUSALS.items()):
+        problems.extend(_expect_raise(
+            probe, match, label=f"probe-refusal {label}",
+            exc=NotImplementedError))
+    if log is not None:
+        log(f"  probe refusals: {len(_PROBE_REFUSALS)} checked")
     return problems
 
 
-def _expect_raise(probe, match, label) -> list[str]:
+def _expect_raise(probe, match, label, exc=ValueError) -> list[str]:
     import re
     try:
         probe()
-    except ValueError as e:
+    except exc as e:
         if re.search(match, str(e)):
             return []
-        # a ValueError that is NOT the declared refusal message would
+        # an exception that is NOT the declared refusal message would
         # let an unrelated validation error vacuously 'prove' the
         # contract — require the message, pytest.raises(match=) style
-        return [f"contract: {label} raised ValueError({e!s}) which "
-                f"does not match the declared refusal {match!r}"]
+        return [f"contract: {label} raised {exc.__name__}({e!s}) "
+                f"which does not match the declared refusal {match!r}"]
     except Exception as e:  # graftlint: ignore[broad-except]
         # wrong exception class = the refusal is an accident, not a
         # contract — report it rather than crash the checker
         return [f"contract: {label} raised {type(e).__name__} "
-                f"instead of ValueError: {e}"]
+                f"instead of {exc.__name__}: {e}"]
     return [f"contract: {label} did NOT raise (claim is false)"]

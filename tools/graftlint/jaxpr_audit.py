@@ -114,6 +114,22 @@ def declared_matrix() -> list[dict]:
     for faults in (False, True):
         out.append(dict(sim="gossipsub", split=False, telemetry=True,
                         faults=faults, batched=False, variant="hist"))
+    # round-11 variant cases: the in-scan invariant checker (gossip on
+    # both fault axes; flood/randomsub check their delivery subset
+    # faulted), and the attack surface — eclipse + byzantine + traced
+    # defense knobs + cold-restart churn under ONE step, sequential
+    # plus the batched tournament runner
+    for faults in (False, True):
+        out.append(dict(sim="gossipsub", split=False, telemetry=False,
+                        faults=faults, batched=False, variant="inv"))
+    out.append(dict(sim="floodsub", split=False, telemetry=False,
+                    faults=True, batched=False, variant="inv"))
+    out.append(dict(sim="randomsub", split=False, telemetry=False,
+                    faults=True, batched=False, variant="inv"))
+    for batched in (False, True):
+        out.append(dict(sim="gossipsub", split=False, telemetry=False,
+                        faults=True, batched=batched,
+                        variant="attack"))
     return out
 
 
@@ -148,6 +164,7 @@ def build_cases() -> list[AuditCase]:
     import jax
     import go_libp2p_pubsub_tpu.models.floodsub as fs
     import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    import go_libp2p_pubsub_tpu.models.invariants as iv
     import go_libp2p_pubsub_tpu.models.randomsub as rs
     import go_libp2p_pubsub_tpu.models.telemetry as tl
     from go_libp2p_pubsub_tpu.ops.graph import make_circulant_offsets
@@ -208,6 +225,89 @@ def build_cases() -> list[AuditCase]:
             step = gs.make_gossip_step(cfg, telemetry=tel,
                                        rpc_probe=True)
             runner = gs.gossip_run_rpc_snapshots
+            args, statics = (params, state, TICKS, step), (2, 3)
+
+        elif variant == "inv":
+            # the in-scan invariant checker (round 11): gossipsub runs
+            # every group on a scored sim; flood/randomsub their
+            # delivery subset.  States are invariant-armed.
+            icfg = iv.InvariantConfig()
+            subs, topic, origin, ticks = _sim_inputs(T)
+            if sim == "gossipsub":
+                cfg = gs.GossipSimConfig(
+                    offsets=gs.make_gossip_offsets(T, C, N, seed=1),
+                    n_topics=T, d=3, d_lo=2, d_hi=6, d_score=2,
+                    d_out=1, d_lazy=2, backoff_ticks=8)
+                sc = gs.ScoreSimConfig()
+                params, state = gs.make_gossip_sim(
+                    cfg, subs, topic, origin, ticks, seed=0,
+                    score_cfg=sc, fault_schedule=fsched)
+                state = iv.attach(state)
+                step = gs.make_gossip_step(cfg, sc, invariants=icfg)
+                runner = gs.gossip_run
+                args, statics = (params, state, TICKS, step), (2, 3)
+            elif sim == "floodsub":
+                offs = tuple(int(o) for o in
+                             make_circulant_offsets(T, C, N, seed=1))
+                params, state = fs.make_flood_sim(
+                    None, None, subs, None, topic, origin, ticks,
+                    fault_schedule=fsched, fault_offsets=offs)
+                state = iv.attach(state)
+                core = fs.make_circulant_step_core(offs,
+                                                   invariants=icfg)
+                runner = fs.flood_run_curve
+                args, statics = ((params, state, TICKS, core, M),
+                                 (2, 3, 4))
+            else:   # randomsub
+                rcfg = rs.RandomSubSimConfig(
+                    offsets=rs.make_randomsub_offsets(T, C, N, seed=1),
+                    n_topics=T, d=3)
+                params, state = rs.make_randomsub_sim(
+                    rcfg, subs, topic, origin, ticks,
+                    fault_schedule=fsched)
+                state = iv.attach(state)
+                step = rs.make_randomsub_step(rcfg, invariants=icfg)
+                runner = rs.randomsub_run
+                args, statics = (params, state, TICKS, step), (2, 3)
+
+        elif variant == "attack":
+            # the round-11 attack surface under ONE step: eclipse +
+            # byzantine + both spam behaviors compiled in, traced
+            # defense knobs, cold-restart churn — sequential and
+            # through the batched tournament runner
+            import dataclasses
+            import numpy as np
+            cfg = gs.GossipSimConfig(
+                offsets=gs.make_gossip_offsets(T, C, N, seed=1),
+                n_topics=T, d=3, d_lo=2, d_hi=6, d_score=2, d_out=1,
+                d_lazy=2, backoff_ticks=8)
+            sc = gs.ScoreSimConfig(
+                sybil_ihave_spam=True, sybil_iwant_spam=True,
+                sybil_eclipse=True, byzantine_mutation=True)
+            subs, topic, origin, ticks = _sim_inputs(T)
+
+            def build_attack(r):
+                sched = dataclasses.replace(audit_fault_schedule(r),
+                                            cold_restart=True)
+                return gs.make_gossip_sim(
+                    cfg, subs, topic, origin, ticks, seed=r,
+                    score_cfg=sc,
+                    sybil=(np.arange(N) % 11) == 0,
+                    eclipse_sybil=(np.arange(N) % 11) == 1,
+                    eclipse_victim=(np.arange(N) % 11) == 2,
+                    byzantine=(np.arange(N) % 11) == 3,
+                    score_knobs={"behaviour_penalty_weight": -20.0},
+                    fault_schedule=sched)
+
+            step = gs.make_gossip_step(cfg, sc)
+            if b:
+                builds = [build_attack(r) for r in range(BATCH)]
+                params = gs.stack_trees([p for p, _ in builds])
+                state = gs.stack_trees([s for _, s in builds])
+                runner = gs.gossip_run_tournament
+            else:
+                params, state = build_attack(0)
+                runner = gs.gossip_run
             args, statics = (params, state, TICKS, step), (2, 3)
 
         elif variant == "hist":

@@ -149,6 +149,30 @@ if [ "${PIPESTATUS[0]}" -ne 0 ]; then
   sync_log
   exit 5
 fi
+# 4d. adversarial tournament + invariant overhead (round 11): the
+# attack x defense sweep in one dispatch, then the tourneystat gate
+# over the artifact the bench just wrote (worst-case honest delivery
+# under reference score params must stay within slack of the
+# committed TOURNEY_r11.json; any runtime invariant violation fails),
+# plus the invariant-checker overhead rows on both execution paths
+run 2700 python bench_suite.py gossipsub_tournament \
+    gossipsub_invariants gossipsub_invariants_kernel
+echo "=== tourneystat --check gate ===" | tee -a "$log"
+env JAX_PLATFORMS=cpu python tools/tourneystat.py \
+    /tmp/gossipsub_tournament.json \
+    --check TOURNEY_r11.json 2>&1 | tee -a "$log"
+trc=${PIPESTATUS[0]}
+if [ "$trc" -eq 2 ]; then
+  echo "!! tourneystat gate failed — unusable tournament artifact" \
+      "(bench crashed or wrote a truncated file?)" | tee -a "$log"
+  sync_log
+  exit 6
+elif [ "$trc" -ne 0 ]; then
+  echo "!! tourneystat gate failed — worst-case delivery regressed" \
+      "or a cell reported an invariant violation" | tee -a "$log"
+  sync_log
+  exit 6
+fi
 # 5. GSPMD overhead + diagnostics
 run 1800 python tools/bench_sharded.py
 run 1800 python tools/bench_micro.py 1000000 100

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""tourneystat: inspect an attack×defense tournament artifact and gate
+regressions against a committed baseline.
+
+    python tools/tourneystat.py /tmp/gossipsub_tournament.json
+    python tools/tourneystat.py /tmp/gossipsub_tournament.json \
+        --check TOURNEY_r11.json [--slack 0.05]
+
+Prints the per-cell delivery table and the worst-case row per defense.
+Exit codes (tracestat --check convention):
+
+  0  clean
+  1  regression: an invariant violation in any cell, or (with
+     --check) the worst-case honest delivery fraction under the
+     REFERENCE defense dropped more than ``--slack`` below the
+     committed baseline, or the attack/defense coverage shrank
+  2  unusable input: missing/unparseable artifact or empty rows
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load(path: str) -> dict:
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"tourneystat: cannot read {path}: {e}", file=sys.stderr)
+        raise SystemExit(2)
+    if not obj.get("rows"):
+        print(f"tourneystat: {path} carries no tournament rows",
+              file=sys.stderr)
+        raise SystemExit(2)
+    return obj
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tourneystat",
+                                 description=__doc__)
+    ap.add_argument("artifact")
+    ap.add_argument("--check", metavar="BASELINE",
+                    help="committed baseline artifact to gate against")
+    ap.add_argument("--slack", type=float, default=0.05,
+                    help="allowed drop in reference worst-case "
+                         "delivery (default 0.05)")
+    ns = ap.parse_args(argv)
+
+    cur = load(ns.artifact)
+    rc = 0
+    print(f"tournament: {cur['n_peers']} peers x {cur['n_topics']} "
+          f"topics, {cur['replicas']} cells, {cur['ticks']} ticks")
+    for row in cur["rows"]:
+        extra = ""
+        if "eclipse_takeover" in row:
+            extra += f"  takeover={row['eclipse_takeover']:.3f}"
+        if row.get("inv_bits", 0):
+            extra += (f"  INVARIANT-VIOLATION bits={row['inv_bits']:#x}"
+                      f" first_tick={row.get('inv_first')}")
+        print(f"  {row['attack']:<13s} x {row['defense']:<10s} "
+              f"delivery={row['delivery_fraction']:.4f}{extra}")
+    for dname, w in cur["worst_case"].items():
+        print(f"worst[{dname}]: {w['delivery_fraction']:.4f} "
+              f"({w['attack']})")
+
+    viol = cur.get("invariant_violations", 0)
+    if viol:
+        print(f"tourneystat: {viol} cell(s) report runtime invariant "
+              "violations", file=sys.stderr)
+        rc = 1
+
+    if ns.check:
+        base = load(ns.check)
+        missing = (set(base.get("attacks", []))
+                   - set(cur.get("attacks", [])))
+        missing |= (set(base.get("defenses", []))
+                    - set(cur.get("defenses", [])))
+        if missing:
+            print("tourneystat: coverage shrank vs baseline: "
+                  f"missing {sorted(missing)}", file=sys.stderr)
+            rc = 1
+        ref_cur = cur.get("reference_worst_case_delivery")
+        ref_base = base.get("reference_worst_case_delivery")
+        if ref_cur is None or ref_base is None:
+            print("tourneystat: no reference worst-case in artifact "
+                  "or baseline", file=sys.stderr)
+            return 2
+        floor = ref_base - ns.slack
+        verdict = "OK" if ref_cur >= floor else "REGRESSED"
+        print(f"check: reference worst-case {ref_cur:.4f} vs baseline "
+              f"{ref_base:.4f} (floor {floor:.4f}) -> {verdict}")
+        if ref_cur < floor:
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -166,7 +166,16 @@ class FaultSchedule:
         "n_peers": "build-time",
         "horizon": "build-time",
         "down_intervals": _THREADED,
-        "drop_prob": _THREADED,
+        # round 12: the link-drop rate is liftable through the gossip
+        # knob surface (sim_knobs={"drop_prob": ...} overrides the
+        # compiled FaultParams leaf — no retrace across rates, proven
+        # by the traced probe); the non-gossip paths keep the plain
+        # threaded proof (leaf value diff)
+        "drop_prob": {
+            "gossip-xla": "traced", "gossip-kernel": "traced",
+            "flood-circulant": "threaded", "flood-gather": "threaded",
+            "randomsub-circulant": "threaded",
+            "randomsub-dense": "threaded"},
         "partition_group": _THREADED,
         "partition_windows": _THREADED,
         "seed": _THREADED,

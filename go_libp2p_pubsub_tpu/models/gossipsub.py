@@ -125,7 +125,10 @@ from ._delivery import (
 )
 from . import faults as _faults
 from . import invariants as _invariants
+from . import knobs as _knobs
 from . import telemetry as _telemetry
+from .knobs import SimKnobs, KnobStaticFieldError  # noqa: F401
+#   (re-exported: the sweep engine's user surface — models/knobs.py)
 
 
 # --------------------------------------------------------------------------
@@ -200,23 +203,33 @@ class GossipSimConfig:
     # config field without a contract entry (or an entry without a
     # probe) fails `python -m tools.graftlint`.
     PATHS: ClassVar[tuple[str, ...]] = ("xla", "kernel")
+    # round 12: every liftable numeric field is "traced" — threaded
+    # (baked) AND provably liftable to a SimKnobs operand with NO
+    # retrace across knob values (models/knobs.py; the prover runs
+    # both proofs).  Shape-bearing fields stay "threaded" (baked
+    # only) and are rejected by the knob surface by name.  The one
+    # exception: gossip_retransmission stays baked-threaded on the
+    # kernel path (its serve-budget multiply runs in-kernel; the
+    # kernel refuses knob points on iwant-spam configs — see
+    # SimKnobs.CONTRACT for the matching refusal).
     CONTRACT: ClassVar[dict[str, object]] = {
         "offsets": "threaded",
         "n_topics": "threaded",
         "px_rotation": "threaded",
         "paired_topics": "threaded",
-        "d": "threaded",
-        "d_lo": "threaded",
-        "d_hi": "threaded",
-        "d_score": "threaded",
-        "d_out": "threaded",
-        "d_lazy": "threaded",
-        "gossip_factor": "threaded",
+        "d": "traced",
+        "d_lo": "traced",
+        "d_hi": "traced",
+        "d_score": "traced",
+        "d_out": "traced",
+        "d_lazy": "traced",
+        "gossip_factor": "traced",
         "history_gossip": "threaded",
         "history_length": "threaded",
-        "backoff_ticks": "threaded",
-        "fanout_ttl_ticks": "threaded",
-        "gossip_retransmission": "threaded",
+        "backoff_ticks": "traced",
+        "fanout_ttl_ticks": "traced",
+        "gossip_retransmission": {"xla": "traced",
+                                  "kernel": "threaded"},
         # statically-enforced IHAVE invariants: build-time rejection in
         # make_gossip_sim / __post_init__, never run-time truncation
         "max_ihave_length": "build-time",
@@ -651,6 +664,12 @@ class GossipParams:
     cand_byz: jnp.ndarray | None = None           # uint32 [N]
     # traced defense-knob overrides (attack tournament); None = baked
     score_knobs: ScoreKnobs | None = None
+    # -- round-12 config-as-data (models/knobs.py): the full liftable
+    # protocol-parameter surface as traced scalar leaves — degree
+    # family, gossip_factor, retransmission budget, backoff/fanout-TTL
+    # ticks, plus the ScoreKnobs defense sub-tree folded in.  None =
+    # every parameter baked from the static config, bit-identically.
+    sim_knobs: _knobs.SimKnobs | None = None
 
 
 @struct.dataclass
@@ -759,7 +778,8 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
                     eclipse_sybil: np.ndarray | None = None,
                     eclipse_victim: np.ndarray | None = None,
                     byzantine: np.ndarray | None = None,
-                    score_knobs: dict | None = None):
+                    score_knobs: dict | None = None,
+                    sim_knobs: dict | None = None):
     """Build (params, state).  subs: bool [N, T] — but each peer may only
     subscribe to its residue-class topic (circulant classes are closed, so
     cross-class subscriptions would never receive anything).
@@ -795,8 +815,22 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
       score_cfg.byzantine_mutation).
     - score_knobs: dict over SCORE_KNOB_FIELDS — traced defense-knob
       overrides for the attack×defense tournament (missing keys fall
-      back to the score_cfg value; sign/order validated here).  XLA
-      path only.
+      back to the score_cfg value; sign/order validated here).  Both
+      execution paths since round 12 (the kernel takes them as SMEM
+      scalars).
+
+    sim_knobs (round 12, models/knobs.py) lifts the full liftable
+    protocol surface to traced operands: a dict mixing protocol knobs
+    (SIM_KNOB_FIELDS — d family, gossip_factor, backoff/fanout ticks,
+    gossip_retransmission), ScoreKnobs defense fields (folded into the
+    SimKnobs.score sub-tree; requires score_cfg), and the fault knob
+    ``drop_prob`` (overrides the compiled FaultParams link-drop rate;
+    requires a fault_schedule whose drop_prob is nonzero so the link
+    code path compiles in — knob value 0.0 is then a value-level
+    no-drop).  Shape-bearing fields raise KnobStaticFieldError by
+    name.  Missing keys take the config's own values, bit-identically
+    to the baked step.  Mutually exclusive with ``score_knobs`` (one
+    override surface per sim).
     """
     n, t = subs.shape
     if t != cfg.n_topics:
@@ -1021,6 +1055,37 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
         kw.update(faults=_faults.compile_faults(
             fault_schedule, cfg.offsets, pack_links=True))
 
+    if sim_knobs is not None:
+        if score_knobs is not None:
+            raise ValueError(
+                "pass parameter overrides through ONE surface: "
+                "sim_knobs (which folds the ScoreKnobs fields in) or "
+                "the legacy score_knobs dict, not both")
+        proto_kv, score_kv, fault_kv = _knobs.split_knob_overrides(
+            sim_knobs, SCORE_KNOB_FIELDS)
+        kw.update(sim_knobs=_knobs.make_sim_knobs(
+            cfg, score_cfg, {**proto_kv, **score_kv},
+            px_candidates=px_candidates))
+        if fault_kv:
+            fp0 = kw.get("faults")
+            if fp0 is None:
+                raise ValueError(
+                    "sim_knobs: the drop_prob knob overrides a "
+                    "compiled FaultParams leaf — pass a "
+                    "fault_schedule alongside it")
+            if fp0.drop_prob is None or fp0.drop_prob.ndim != 0:
+                raise ValueError(
+                    "sim_knobs: the drop_prob knob needs a schedule "
+                    "with a nonzero SCALAR drop_prob (the link-fault "
+                    "code path must compile in, and the per-edge "
+                    "[C, N] form is not scalar-overridable); knob "
+                    "value 0.0 then disables drops at run time")
+            dpv = float(fault_kv["drop_prob"])
+            if not (0.0 <= dpv <= 1.0):
+                raise ValueError(
+                    f"sim_knobs: drop_prob={dpv} outside [0, 1]")
+            kw["faults"] = fp0.replace(drop_prob=jnp.float32(dpv))
+
     params = GossipParams(
         subscribed=jnp.asarray(padl(subscribed)),
         cand_sub_bits=jnp.asarray(padl(cand_bits(subscribed))),
@@ -1158,6 +1223,18 @@ def mesh_matrix(state: GossipState, cfg: GossipSimConfig) -> jnp.ndarray:
 # --------------------------------------------------------------------------
 
 
+def active_score_knobs(params: GossipParams) -> ScoreKnobs | None:
+    """The ScoreKnobs override in effect for this sim, whichever
+    surface armed it: the legacy ``score_knobs`` param or the round-12
+    ``SimKnobs.score`` sub-tree (make_gossip_sim enforces at most one
+    of the two)."""
+    if params.score_knobs is not None:
+        return params.score_knobs
+    if params.sim_knobs is not None:
+        return params.sim_knobs.score
+    return None
+
+
 def compute_scores(sc: ScoreSimConfig, params: GossipParams,
                    st: GossipState) -> jnp.ndarray:
     """The peer-score formula, densified: f32 [C, N] — peer p's opinion of
@@ -1187,7 +1264,7 @@ def compute_scores(sc: ScoreSimConfig, params: GossipParams,
     # tournament defense knobs (ScoreKnobs): traced overrides of the
     # baked weights — absent (the default) this is the exact pre-knob
     # arithmetic with python-float constants
-    kn = params.score_knobs
+    kn = active_score_knobs(params)
     w_inv = (kn.invalid_message_deliveries_weight if kn is not None
              else sc.invalid_message_deliveries_weight)
     w_bp = (kn.behaviour_penalty_weight if kn is not None
@@ -1255,7 +1332,7 @@ def score_snapshot(sc: ScoreSimConfig, params: GossipParams,
     tim = f32(s.time_in_mesh)
     invd = f32(s.invalid_deliveries)
     w = sc.topic_weight
-    kn = params.score_knobs
+    kn = active_score_knobs(params)
     w_inv = (kn.invalid_message_deliveries_weight if kn is not None
              else sc.invalid_message_deliveries_weight)
     w_bp = (kn.behaviour_penalty_weight if kn is not None
@@ -1376,7 +1453,7 @@ def compute_gates(cfg: GossipSimConfig, sc: ScoreSimConfig | None,
     rows = []
     if sc is not None:
         score = compute_scores(sc, params, st)              # [C, N]
-        kn = params.score_knobs
+        kn = active_score_knobs(params)
         gray_thr = (kn.graylist_threshold if kn is not None
                     else sc.graylist_threshold)
         gsp_thr = (kn.gossip_threshold if kn is not None
@@ -1479,9 +1556,16 @@ def gossip_targets_row(cfg: GossipSimConfig, sc: ScoreSimConfig | None,
     if gossip_row is not None:
         elig = elig & gossip_row                            # gossip gate
     n_elig = popcount32(elig)
+    # round-12 knobs: d_lazy / gossip_factor ride the params as traced
+    # scalars when armed — value-identical arithmetic at the defaults
+    skn = params.sim_knobs
+    k_lazy = (skn.d_lazy if skn is not None
+              else jnp.int32(cfg.d_lazy))
+    k_factor = (skn.gossip_factor if skn is not None
+                else cfg.gossip_factor)
     n_gossip = jnp.maximum(
-        jnp.int32(cfg.d_lazy),
-        (cfg.gossip_factor * n_elig.astype(jnp.float32)).astype(
+        k_lazy,
+        (k_factor * n_elig.astype(jnp.float32)).astype(
             jnp.int32))
     if cfg.binomial_gossip_sampling:
         # Bernoulli(k/|elig|) per eligible edge: same inclusion
@@ -1571,17 +1655,32 @@ def kernel_capability(cfg: GossipSimConfig, sc: ScoreSimConfig | None,
     (needs the split-loop provenance the fused kernel elides), a
     state without carried gates, a re-weighted NONZERO static
     score bake (the kernel adds the baked P5+P6 term as-is; an
-    all-zero bake is weight-independent), Byzantine payload mutation
-    (per-edge content corruption needs the per-edge receive loops the
-    fused kernel elides), and traced score knobs (the kernel emits
-    next-tick gates in-kernel from BAKED thresholds)."""
+    all-zero bake is weight-independent), and Byzantine payload
+    mutation (per-edge content corruption needs the per-edge receive
+    loops the fused kernel elides).
+
+    Traced knobs are a CAPABILITY since round 12: the ScoreKnobs
+    defense sub-tree and the cheap SimKnobs scalars the kernel
+    consumes in-VMEM (gossip_factor, d_lazy, backoff_ticks) ride one
+    SMEM f32 operand; the degree-family knobs are consumed in the
+    shared XLA prologue and need no kernel work.  The ONE knob that
+    legitimately stays XLA-only is ``gossip_retransmission`` under
+    the IWANT-spam attack config — its serve-budget multiply runs
+    in-kernel from the baked constant, so a SimKnobs point on an
+    iwant-spam config is refused by name (graftlint carries the
+    matching probe)."""
+    if (params.sim_knobs is not None and sc is not None
+            and sc.sybil_iwant_spam):
+        return ("sim_knobs: gossip_retransmission stays XLA-only on "
+                "the pallas step (the in-kernel IWANT serve budget "
+                "bakes it) — run iwant-spam knob sweeps on the XLA "
+                "path, or drop sybil_iwant_spam from the config")
     if (cfg.n_candidates > 16 or params.origin_words.shape[0] == 0
             or params.flood_proto is not None
             or state.gates is None
             or (sc is not None
                 and ((sc.byzantine_mutation
                       and params.cand_byz is not None)
-                     or params.score_knobs is not None
                      or sc.track_p3
                      or (not params.static_score_zero
                          and params.static_score_weights
@@ -1589,7 +1688,7 @@ def kernel_capability(cfg: GossipSimConfig, sc: ScoreSimConfig | None,
                              sc.ip_colocation_factor_weight))))):
         return ("config not supported by the pallas step (needs C<=16, "
                 "W>=1, carried gates, matching static score weights, "
-                "no flood_proto/track_p3/byzantine/score_knobs)")
+                "no flood_proto/track_p3/byzantine)")
     return None
 
 
@@ -1879,6 +1978,39 @@ def make_gossip_step(cfg: GossipSimConfig,
                             lane_seed(tick + 1, 1, salt)])
         cdt = (jnp.dtype(sc.counter_dtype) if sc is not None else None)
         head = ([jnp.stack(valid_w)] if sc is not None else []) + [gseeds]
+        # round-12 knobs: the in-kernel consumers (gossip_factor +
+        # d_lazy in the next-tick targets emission, backoff_ticks in
+        # the backoff write, the four ScoreKnobs fields in the score /
+        # gate stage) ride ONE f32 SMEM vector.  Order is the kernel's
+        # KNOB_* layout (ops/pallas/receive.py); i32-valued knobs are
+        # exact through the f32 carry (values << 2^24).  The
+        # degree-family knobs are consumed in the shared prologue
+        # above and need nothing here.
+        skn_k = params.sim_knobs
+        kkn = active_score_knobs(params)
+        with_kn = skn_k is not None or kkn is not None
+        if with_kn:
+            kvals = [
+                (skn_k.gossip_factor if skn_k is not None
+                 else cfg.gossip_factor),
+                (skn_k.d_lazy if skn_k is not None else cfg.d_lazy),
+                (skn_k.backoff_ticks if skn_k is not None
+                 else cfg.backoff_ticks),
+            ]
+            if sc is not None:
+                kvals += [
+                    (kkn.invalid_message_deliveries_weight
+                     if kkn is not None
+                     else sc.invalid_message_deliveries_weight),
+                    (kkn.behaviour_penalty_weight if kkn is not None
+                     else sc.behaviour_penalty_weight),
+                    (kkn.graylist_threshold if kkn is not None
+                     else sc.graylist_threshold),
+                    (kkn.gossip_threshold if kkn is not None
+                     else sc.gossip_threshold),
+                ]
+            head = head + [jnp.stack(
+                [jnp.asarray(v, dtype=jnp.float32) for v in kvals])]
         # the sybil word serves BOTH attack paths in-kernel: the IHAVE
         # advert override (gated there on sc.sybil_ihave_spam) and the
         # IWANT-flood serve accrual (gated on sc.sybil_iwant_spam)
@@ -1954,7 +2086,7 @@ def make_gossip_step(cfg: GossipSimConfig,
                             else None),
                 freshb_st=(jnp.stack(fresh_b) if paired else None),
                 with_faults=with_f, with_telemetry=with_t,
-                tel_lat_buckets=lat_b)
+                tel_lat_buckets=lat_b, with_knobs=with_kn)
         else:
             def flat8(rows):
                 return jnp.concatenate(
@@ -1987,7 +2119,7 @@ def make_gossip_step(cfg: GossipSimConfig,
                 with_same_ip=params.cand_same_ip is not None,
                 with_static=with_static,
                 with_faults=with_f, with_telemetry=with_t,
-                tel_lat_buckets=lat_b)
+                tel_lat_buckets=lat_b, with_knobs=with_kn)
             base0 = jnp.zeros((1,), dtype=jnp.uint32)
             outs = krn(*head, base0, *flats, *blocked)
         tel_row = None
@@ -2176,6 +2308,22 @@ def make_gossip_step(cfg: GossipSimConfig,
         sub_all = jnp.where(sub, ALL, Z)   # uint32 [N] gate
         n = sub.shape[0]
         W = state.have.shape[0]
+        # -- round-12 config-as-data (models/knobs.py): when the params
+        # carry a SimKnobs pytree, every liftable protocol scalar reads
+        # from its traced leaves; otherwise the static config bakes in
+        # as before.  Integer compares and f32 products are value-equal
+        # at the defaults, so knobbed-defaults == baked bit-identically
+        # (tests/test_knobs.py pins every path).
+        skn = params.sim_knobs
+        K_d = skn.d if skn is not None else cfg.d
+        K_d_lo = skn.d_lo if skn is not None else cfg.d_lo
+        K_d_hi = skn.d_hi if skn is not None else cfg.d_hi
+        K_d_score = skn.d_score if skn is not None else cfg.d_score
+        K_d_out = skn.d_out if skn is not None else cfg.d_out
+        K_retrans = (skn.gossip_retransmission if skn is not None
+                     else cfg.gossip_retransmission)
+        K_fanout_ttl = (skn.fanout_ttl_ticks if skn is not None
+                        else cfg.fanout_ttl_ticks)
         kernel_on = (params.n_true is not None
                      if use_pallas_receive is None else use_pallas_receive)
         # Byzantine id-preserving payload mutation (round 11): live
@@ -2344,10 +2492,10 @@ def make_gossip_step(cfg: GossipSimConfig,
         # :1505-1542).  Fanout only ever carries the owner's own
         # publishes — unsubscribed peers accept nothing to relay.
         last_pub = jnp.where(publishing, tick, state.last_pub)
-        alive = (~sub) & (tick - last_pub < cfg.fanout_ttl_ticks)
+        alive = (~sub) & (tick - last_pub < K_fanout_ttl)
         fanout = jnp.where(alive, state.fanout, Z)
         f_deg = popcount32(fanout)
-        f_need = jnp.where(alive, cfg.d - f_deg, 0)
+        f_need = jnp.where(alive, K_d - f_deg, 0)
         f_elig = params.cand_sub_bits & ~fanout
         if params.cand_direct is not None:
             # direct peers receive everything anyway; spending fanout
@@ -2556,7 +2704,7 @@ def make_gossip_step(cfg: GossipSimConfig,
                 # no grafting AT dead candidates, and no maintenance BY
                 # a dead peer
                 can_graft = can_graft & f_cand_alive & f_alive_all
-            need = jnp.where(deg < cfg.d_lo, cfg.d - deg, 0)
+            need = jnp.where(deg < K_d_lo, K_d - deg, 0)
             grafts = jax.lax.cond(
                 jnp.any(need > 0),
                 lambda: sel_k(can_graft, need, u_spec(ph_graft)),
@@ -2565,28 +2713,28 @@ def make_gossip_step(cfg: GossipSimConfig,
             # prune down to D when deg > Dhi.  v1.0: random retention;
             # v1.1: keep the Dscore best by score, then at least Dout
             # outbound, random fill to D (gossipsub.go:1376-1435).
-            over = deg > cfg.d_hi
+            over = deg > K_d_hi
 
             def compute_prunes():
                 if sc is None:
-                    keep = sel_k(mesh_ng, jnp.full_like(deg, cfg.d),
+                    keep = sel_k(mesh_ng, jnp.full_like(deg, K_d),
                                  u_spec(ph_prune))
                 else:
                     score = score_fn()
                     rnd = lane_uniform((C, n), tick, ph_prune, salt,
                                        stride=n_stream)
                     top = select_k_by_priority_bits(
-                        mesh_ng, score, jnp.full_like(deg, cfg.d_score),
+                        mesh_ng, score, jnp.full_like(deg, K_d_score),
                         tiebreak=rnd)
                     n_out_top = popcount32(top & OUT_MASK)
-                    need_out = jnp.maximum(0, cfg.d_out - n_out_top)
+                    need_out = jnp.maximum(0, K_d_out - n_out_top)
                     out_keep = select_k_by_priority_bits(
                         mesh_ng & ~top & OUT_MASK, rnd, need_out)
                     taken = top | out_keep
                     n_taken = popcount32(taken)
                     fill = select_k_by_priority_bits(
                         mesh_ng & ~taken, rnd,
-                        jnp.maximum(cfg.d - n_taken, 0))
+                        jnp.maximum(K_d - n_taken, 0))
                     keep = taken | fill
                 return mesh_ng & ~keep & jnp.where(over, ALL, Z)
 
@@ -3242,7 +3390,8 @@ def make_gossip_step(cfg: GossipSimConfig,
         # free at t+B — identical to the absolute-expiry form); PRUNE
         # receipt / retraction takes max(existing, B-1) — the overwrite,
         # since remaining never exceeds B-1
-        bo16 = jnp.int16(cfg.backoff_ticks - 1)
+        bo16 = (jnp.int16(cfg.backoff_ticks - 1) if skn is None
+                else (skn.backoff_ticks - 1).astype(jnp.int16))
 
         def bo_update(bo_old, trig):
             dec = jnp.maximum(bo_old - jnp.int16(1), jnp.int16(0))
@@ -3293,7 +3442,7 @@ def make_gossip_step(cfg: GossipSimConfig,
                                  else adv_count + pcw)
                 partner_adv = jnp.stack(
                     [jnp.roll(adv_count, -off) for off in offsets])
-                budget = cfg.gossip_retransmission * partner_adv
+                budget = K_retrans * partner_adv
                 flood = jnp.where((s32 < budget) & (partner_adv > 0),
                                   partner_adv, 0)
                 if fp is not None:
@@ -3599,21 +3748,29 @@ def gossip_run_curve_batch(params: GossipParams, state: GossipState,
 
 
 @partial(jax.jit, static_argnums=(2, 3), donate_argnums=(1,))
-def gossip_run_tournament(params: GossipParams, state: GossipState,
+def gossip_run_knob_batch(params: GossipParams, state: GossipState,
                           n_ticks: int, step, honest=None):
-    """The attack × defense tournament's device side (round 11):
-    advance B stacked replicas — each carrying its OWN attack
-    formation arrays (sybil/eclipse/byzantine flags, fault tables)
-    and its own ScoreKnobs defense point — ``n_ticks`` in ONE scan of
-    the vmapped step, then reduce every replica's final per-message
-    reach from the possession words, honest-masked when ``honest``
-    (bool [B, N]) is given.  One dispatch end to end: no per-replica
-    host round-trips, no recompiles across the grid (the defense
-    knobs are traced operands).  Returns ``(state_B, reach [B, M])``;
-    the state carry is donated like every runner (models/_batch.py
-    tree_copy for reuse).  With invariant-armed states the per-replica
-    violation masks come back in ``state_B.inv_viol`` — every
-    tournament cell doubles as a property test."""
+    """The sweep engine's device side (round 12): advance B stacked
+    replicas — each carrying its OWN SimKnobs protocol point, fault
+    tables, attack formation arrays, seed, and message schedule under
+    ONE static config — ``n_ticks`` in ONE scan of the vmapped step,
+    then reduce every replica's final per-message reach from the
+    possession words, honest-masked when ``honest`` (bool [B, N]) is
+    given.  B *different* scenarios, one compiled executable: no
+    per-replica host round-trips, no recompiles across the batch (all
+    heterogeneity is traced operands — stack the per-replica
+    (params, state) with ``stack_trees``).  Returns
+    ``(state_B, reach [B, M])``; the state carry is donated like every
+    runner (models/_batch.py tree_copy for reuse).  With
+    invariant-armed states the per-replica violation masks come back
+    in ``state_B.inv_viol`` — every scenario doubles as a property
+    test.  Per replica the trajectory is bit-identical to the
+    sequential gossip_run (vmap adds no arithmetic; pinned by
+    tests/test_knobs.py).
+
+    The round-11 attack × defense tournament (models/tournament.py)
+    runs on this dispatch — ``gossip_run_tournament`` is this
+    function."""
     vstep = jax.vmap(step)
 
     def body(s, _):
@@ -3626,6 +3783,11 @@ def gossip_run_tournament(params: GossipParams, state: GossipState,
         reach = jax.vmap(reach_counts_from_have)(params, state,
                                                  honest)
     return state, reach
+
+
+#: the round-11 name: the tournament was the first knob-batched sweep;
+#: round 12 generalized its runner to the whole scenario surface
+gossip_run_tournament = gossip_run_knob_batch
 
 
 def eclipse_takeover(state: GossipState, params: GossipParams,

@@ -1,0 +1,293 @@
+"""Config-as-data: the SimKnobs device pytree (ROADMAP direction 2).
+
+Every numeric ``GossipSimConfig`` field whose value does NOT determine
+array shapes is liftable from a baked compile-time constant to a traced
+f32/i32 SCALAR LEAF riding the sim params — so ONE compiled executable
+serves arbitrary protocol parameter points, and ``stack_trees``/``vmap``
+batches advance replicas with HETEROGENEOUS configs (not just seeds) in
+one dispatch.  PR 7 proved the pattern on the four ScoreKnobs defense
+parameters; this module generalizes it to the whole liftable surface:
+
+- the degree family ``d / d_lo / d_hi / d_score / d_out / d_lazy``
+  (consumed in popcount compares and selection counts — integer data),
+- ``gossip_factor`` (the emitGossip coverage fraction, f32),
+- ``gossip_retransmission`` (the IWANT serve-budget multiplier),
+- ``backoff_ticks`` / ``fanout_ttl_ticks`` (tick-count compares),
+- the existing ``ScoreKnobs`` defense sub-tree (folded in as ``score``),
+- the ``FaultSchedule`` link-drop rate (``drop_prob`` — already a
+  traced ``FaultParams`` leaf; the knob surface overrides its value, so
+  sweeps vary loss rates per replica under one schedule shape).  Churn
+  rates ride the ``[N, K]`` down-interval tables, which are per-replica
+  data already — pad every replica to one K with ``(p, 0, 0)`` no-ops.
+
+Shape-bearing fields stay STATIC and are rejected by name
+(``KnobStaticFieldError``): ``offsets`` (the circulant topology — roll
+offsets are baked into every edge transfer), ``n_topics`` (residue-
+class layout), ``history_length`` / ``history_gossip`` (the mcache ring
+shape [Hg, W, N] and its baked expiry divisor), and the telemetry
+histogram bucket shapes (TelemetryConfig, not reachable from here).
+Mode toggles (``paired_topics``, ``px_rotation``,
+``binomial_gossip_sampling``) select compiled code paths and stay
+static too.
+
+Bit-identity contract: a ``SimKnobs`` built at the config's own values
+produces the EXACT baked trajectory (integer compares and f32 products
+are value-equal; tests/test_knobs.py pins all execution paths), so
+arming knobs costs nothing but the scalar operands.
+
+Validation is host-side and eager: the same ordering invariants
+``GossipSimConfig.__post_init__`` enforces (Dlo <= D <= Dhi, Dscore <=
+D, Dout < Dlo and Dout <= D/2, Dhi < C, backoff int16 range, ...)
+apply to every knob point, with the bad field named.
+"""
+
+from __future__ import annotations
+
+from typing import ClassVar
+
+import jax.numpy as jnp
+from flax import struct
+
+__all__ = [
+    "SIM_KNOB_FIELDS",
+    "FAULT_KNOB_FIELDS",
+    "STATIC_KNOB_REASONS",
+    "KnobStaticFieldError",
+    "SimKnobs",
+    "split_knob_overrides",
+    "make_sim_knobs",
+    "knob_values",
+]
+
+
+#: the liftable GossipSimConfig scalar surface, in SimKnobs field order.
+#: Integer-valued fields ride as i32 scalars, gossip_factor as f32.
+SIM_KNOB_FIELDS = (
+    "d", "d_lo", "d_hi", "d_score", "d_out", "d_lazy",
+    "gossip_factor", "gossip_retransmission",
+    "backoff_ticks", "fanout_ttl_ticks",
+)
+
+#: FaultSchedule knobs: traced overrides applied to the compiled
+#: FaultParams leaves (make_gossip_sim), not carried on SimKnobs.
+FAULT_KNOB_FIELDS = ("drop_prob",)
+
+#: shape-bearing / mode-selecting fields, rejected BY NAME with the
+#: reason they must stay compile-time (the sweepd request validator and
+#: make_sim_knobs share this table).
+STATIC_KNOB_REASONS = {
+    "offsets": "the circulant topology: ring offsets are baked into "
+               "every edge-transfer roll and the kernel DMA plan",
+    "n_topics": "the residue-class layout: membership, deliver masks "
+                "and offset moduli are built from it",
+    "history_length": "the mcache expiry divisor is baked with the "
+                      "ring layout (serve-ledger ceil-div)",
+    "history_gossip": "shapes the [Hg, W, N] recent ring",
+    "paired_topics": "selects the two-mesh compiled step",
+    "px_rotation": "selects the PX rotation epilogue code path",
+    "binomial_gossip_sampling": "selects the sampling backend "
+                                "(Bernoulli vs rank-compare code path)",
+    "max_ihave_length": "a build-time static invariant, never run-time",
+    "max_ihave_messages": "a build-time static invariant, never "
+                          "run-time",
+    # telemetry histogram shapes live on TelemetryConfig, but name the
+    # common ones so a sweepd request that tries them gets the reason
+    "latency_buckets": "shapes the telemetry latency histogram output",
+    "degree_buckets": "shapes the telemetry degree histogram output",
+    "score_bucket_edges": "shapes the telemetry score histogram output",
+}
+
+_INT_KNOBS = frozenset(SIM_KNOB_FIELDS) - {"gossip_factor"}
+
+
+class KnobStaticFieldError(ValueError):
+    """A shape-bearing (or mode-selecting) config field was passed as a
+    knob.  The message names the field and why it must stay static."""
+
+
+@struct.dataclass
+class SimKnobs:
+    """Traced protocol-parameter overrides: every leaf is a SCALAR
+    device array (i32 for the integer family, f32 for gossip_factor),
+    so ``stack_trees`` turns a list of knob points into [B] vectors the
+    vmapped step maps over — B *different* protocol configs, one
+    compiled executable.  ``score`` folds the PR-7 ScoreKnobs defense
+    sub-tree in (None when no score overrides ride).
+
+    Build through ``make_sim_knobs`` (validated); fields left
+    unspecified take the config's own values, which is bit-identical
+    to the baked step (pinned by tests/test_knobs.py)."""
+
+    d: jnp.ndarray                      # i32 []
+    d_lo: jnp.ndarray                   # i32 []
+    d_hi: jnp.ndarray                   # i32 []
+    d_score: jnp.ndarray                # i32 []
+    d_out: jnp.ndarray                  # i32 []
+    d_lazy: jnp.ndarray                 # i32 []
+    gossip_factor: jnp.ndarray          # f32 []
+    gossip_retransmission: jnp.ndarray  # i32 []
+    backoff_ticks: jnp.ndarray          # i32 []
+    fanout_ttl_ticks: jnp.ndarray       # i32 []
+    # the ScoreKnobs defense sub-tree (models/gossipsub.py), None when
+    # no score-parameter overrides ride this knob point
+    score: object = None
+
+    # Machine-readable contract (tools/graftlint/contracts.py): every
+    # knob leaf must be provably "traced" on each path — jaxpr
+    # IDENTICAL across two knob values (no retrace) while the build
+    # leaves differ.  gossip_retransmission is kernel-"refused": the
+    # only config where it is live (sybil_iwant_spam) computes the
+    # serve budget in-kernel from the baked constant, and the kernel
+    # refuses knob points there by name (message-matched probe).
+    PATHS: ClassVar[tuple[str, ...]] = ("xla", "kernel")
+    CONTRACT: ClassVar[dict[str, object]] = {
+        "d": "traced",
+        "d_lo": "traced",
+        "d_hi": "traced",
+        "d_score": "traced",
+        "d_out": "traced",
+        "d_lazy": "traced",
+        "gossip_factor": "traced",
+        "gossip_retransmission": {"xla": "traced",
+                                  "kernel": "refused"},
+        "backoff_ticks": "traced",
+        "fanout_ttl_ticks": "traced",
+        "score": "traced",
+    }
+
+
+def split_knob_overrides(overrides: dict, score_fields=None) -> tuple:
+    """Partition a raw knob dict into (protocol, score, fault) override
+    dicts, rejecting static fields by name and unknown fields with the
+    full valid-knob list.  ``score_fields`` defaults to gossipsub's
+    SCORE_KNOB_FIELDS (passed in to avoid the import cycle)."""
+    if score_fields is None:
+        from . import gossipsub as _gs
+        score_fields = _gs.SCORE_KNOB_FIELDS
+    proto, score, fault = {}, {}, {}
+    for key, val in dict(overrides).items():
+        if key in STATIC_KNOB_REASONS:
+            raise KnobStaticFieldError(
+                f"sim_knobs: {key!r} is a static (shape-bearing) "
+                f"config field and cannot be swept as a knob — "
+                f"{STATIC_KNOB_REASONS[key]}.  Recompile with a new "
+                "config to change it.")
+        if key in SIM_KNOB_FIELDS:
+            proto[key] = val
+        elif key in score_fields:
+            score[key] = val
+        elif key in FAULT_KNOB_FIELDS:
+            fault[key] = val
+        else:
+            raise ValueError(
+                f"sim_knobs: unknown knob {key!r} — sweepable knobs "
+                f"are {SIM_KNOB_FIELDS + tuple(score_fields) + FAULT_KNOB_FIELDS}")
+    return proto, score, fault
+
+
+def _validate_point(vals: dict, n_candidates: int,
+                    px_candidates: int | None = None) -> None:
+    """The GossipSimConfig.__post_init__ ordering invariants, applied
+    to a resolved knob point (host floats/ints), naming the bad
+    field(s)."""
+    d, d_lo, d_hi = vals["d"], vals["d_lo"], vals["d_hi"]
+    if not (d_lo <= d <= d_hi):
+        raise ValueError(
+            f"sim_knobs: need d_lo <= d <= d_hi (got {d_lo}, {d}, "
+            f"{d_hi}; gossipsub.go:33-35)")
+    if vals["d_score"] > d:
+        raise ValueError(
+            f"sim_knobs: need d_score <= d (got {vals['d_score']} > "
+            f"{d})")
+    if vals["d_out"] >= d_lo or vals["d_out"] > d // 2:
+        raise ValueError(
+            f"sim_knobs: need d_out < d_lo and d_out <= d/2 (got "
+            f"d_out={vals['d_out']}; gossipsub.go:266-272)")
+    ceiling = n_candidates if px_candidates is None else px_candidates
+    if d_hi >= ceiling:
+        raise ValueError(
+            f"sim_knobs: need d_hi < {'px_candidates' if px_candidates is not None else 'C'}"
+            f"={ceiling} (got d_hi={d_hi}) — the selection space "
+            "cannot satisfy the degree bound")
+    if not (1 <= vals["backoff_ticks"] <= 32767):
+        raise ValueError(
+            f"sim_knobs: backoff_ticks={vals['backoff_ticks']} must "
+            "fit int16 remaining-tick storage (1..32767)")
+    if vals["gossip_retransmission"] < 1:
+        raise ValueError(
+            f"sim_knobs: gossip_retransmission="
+            f"{vals['gossip_retransmission']} must be >= 1")
+    if vals["fanout_ttl_ticks"] < 1:
+        raise ValueError(
+            f"sim_knobs: fanout_ttl_ticks={vals['fanout_ttl_ticks']} "
+            "must be >= 1")
+    if vals["d_lazy"] < 0:
+        raise ValueError(
+            f"sim_knobs: d_lazy={vals['d_lazy']} must be >= 0")
+    if not (0.0 <= vals["gossip_factor"] <= 1.0):
+        raise ValueError(
+            f"sim_knobs: gossip_factor={vals['gossip_factor']} "
+            "outside [0, 1]")
+
+
+def knob_values(cfg, overrides: dict | None = None) -> dict:
+    """The resolved host-side values of a knob point over ``cfg``
+    (override where given, config default otherwise)."""
+    overrides = overrides or {}
+    out = {}
+    for f in SIM_KNOB_FIELDS:
+        v = overrides.get(f, getattr(cfg, f))
+        out[f] = float(v) if f == "gossip_factor" else int(v)
+    return out
+
+
+def make_sim_knobs(cfg, score_cfg=None, overrides: dict | None = None,
+                   px_candidates: int | None = None) -> SimKnobs:
+    """Build a validated SimKnobs point over ``cfg``.
+
+    ``overrides`` may mix protocol knobs (SIM_KNOB_FIELDS) and
+    ScoreKnobs defense fields (folded into the ``score`` sub-tree;
+    require ``score_cfg``).  Static fields raise KnobStaticFieldError
+    by name; every resolved point passes the config's own ordering
+    invariants."""
+    from . import gossipsub as _gs
+
+    proto, score_kv, fault = split_knob_overrides(
+        overrides or {}, _gs.SCORE_KNOB_FIELDS)
+    if fault:
+        raise ValueError(
+            "sim_knobs: fault knobs (drop_prob) are applied to the "
+            "compiled FaultParams by make_gossip_sim — pass them "
+            "through its sim_knobs dict, not make_sim_knobs directly")
+    vals = knob_values(cfg, proto)
+    _validate_point(vals, cfg.n_candidates, px_candidates)
+
+    if score_kv and score_cfg is None:
+        raise ValueError(
+            "sim_knobs: score-parameter knobs "
+            f"{sorted(score_kv)} require score_cfg")
+    score = None
+    if score_cfg is not None:
+        # the score sub-tree is ALWAYS armed on scored sims (defaults
+        # = the score_cfg values, bit-identical to baked) so stacked
+        # replica batches mixing defended and reference points share
+        # one pytree structure (stack_trees needs matching leaves)
+        kv = {f: float(score_kv.get(f, getattr(score_cfg, f)))
+              for f in _gs.SCORE_KNOB_FIELDS}
+        for f in ("invalid_message_deliveries_weight",
+                  "behaviour_penalty_weight"):
+            if kv[f] > 0:
+                raise ValueError(f"sim_knobs: {f} must be <= 0")
+        if not (kv["graylist_threshold"]
+                <= score_cfg.publish_threshold
+                <= kv["gossip_threshold"] <= 0):
+            raise ValueError(
+                "sim_knobs: need graylist <= publish (static) <= "
+                "gossip threshold <= 0")
+        score = _gs.ScoreKnobs(
+            **{f: jnp.float32(kv[f]) for f in _gs.SCORE_KNOB_FIELDS})
+
+    leaf = {f: (jnp.float32(vals[f]) if f == "gossip_factor"
+                else jnp.int32(vals[f]))
+            for f in SIM_KNOB_FIELDS}
+    return SimKnobs(score=score, **leaf)

@@ -11,11 +11,15 @@ batched replica sweep (models/_batch.py stack_trees + vmap):
   byzantine flag arrays and churn interval tables under ONE static
   config with every attack behavior compiled in (an empty flag array
   makes that behavior inert at run time);
-- every DEFENSE point is a per-replica ``ScoreKnobs`` pytree (traced
-  score-parameter overrides, models/gossipsub.py) — no recompiles
-  across the grid;
-- the runner is ``gossip_run_tournament``: one scan of the vmapped
-  step plus an in-dispatch possession reduction, honest-masked;
+- every DEFENSE point is a per-replica ``SimKnobs`` pytree (round 12:
+  the full config-as-data surface, models/knobs.py, with the
+  ScoreKnobs defense fields folded in as its ``score`` sub-tree) — no
+  recompiles across the grid, and a defense point may now also vary
+  protocol knobs (degree family, gossip_factor, backoff ticks);
+- the runner is ``gossip_run_tournament`` — since round 12 an alias
+  of the sweep engine's ``gossip_run_knob_batch``: one scan of the
+  vmapped step plus an in-dispatch possession reduction,
+  honest-masked;
 - every replica's state is invariant-armed (models/invariants.py), so
   each tournament cell doubles as a property test — the report carries
   the per-cell violation masks (all zero on a correct build).
@@ -43,6 +47,26 @@ ATTACKS = ("clean", "spam", "eclipse", "byzantine", "cold_restart")
 #: "weak" turns the P4/P7 penalties off (the v1.1-without-teeth
 #: ablation); "hardened" quadruples them and tightens the thresholds
 #: (graylist at the static publish threshold, gossip near zero).
+#: the round-12 auto-tuned defense point: ``tune_defense`` ran
+#: coordinate descent over TUNE_SPACE at the committed tournament
+#: shape (20k x 20t x 150 ticks, one recompile-free batched dispatch
+#: per candidate set, ~20 min CPU for the full search) and CONFIRMED
+#: the reference parameters as the argmax — every non-degenerate
+#: candidate ties exactly on (worst-case delivery 0.9139 under
+#: cold_restart, attack-column mean 0.98278, eclipse takeover
+#: 0.2987), because the binding worst case is churn data loss no
+#: score parameter can prevent and any nonzero penalty already
+#: contains the score-sensitive attacks; the only strict loser is
+#: penalties-off (the "weak" row: takeover 0.3207).  Delta vs
+#: reference: +0.0000 — committed with its worst-case row in
+#: TOURNEY_r12.json and re-measured every pass (the tuned point is
+#: pinned EXPLICITLY rather than as {} so a future ScoreSimConfig
+#: default change cannot silently move it).
+TUNED_DEFENSE = {"invalid_message_deliveries_weight": -10.0,
+                 "behaviour_penalty_weight": -10.0,
+                 "graylist_threshold": -80.0,
+                 "gossip_threshold": -10.0}
+
 DEFENSES = {
     "reference": {},
     "weak": {"invalid_message_deliveries_weight": 0.0,
@@ -51,6 +75,7 @@ DEFENSES = {
                  "behaviour_penalty_weight": -40.0,
                  "graylist_threshold": -50.0,
                  "gossip_threshold": -5.0},
+    "tuned": TUNED_DEFENSE,
 }
 
 
@@ -131,12 +156,28 @@ def tournament_grid(n: int, t: int, m: int, horizon: int, *,
                 byzantine=(attackers if attack == "byzantine"
                            else zeros),
                 fault_schedule=sched(attack == "cold_restart", seed),
-                score_knobs=dict(knobs),
+                sim_knobs=dict(knobs),
             ))
             meta.append({"attack": attack, "defense": dname})
     return builds, meta, dict(attackers=attackers, victims=victims,
                               origin=origin, topic=topic,
                               pub_tick=pub_tick, subs=subs)
+
+
+#: one step per (cfg, sc, invariants) — defense/knob values are traced
+#: operands, so every run_tournament / tune_defense evaluation over the
+#: same shape reuses ONE compiled executable (the jit cache keys on the
+#: step object; a fresh closure per call would recompile every time)
+_STEP_CACHE: dict = {}
+
+
+def _cached_step(cfg, sc, invariants: bool):
+    key = (cfg, sc, invariants)
+    if key not in _STEP_CACHE:
+        _STEP_CACHE[key] = gs.make_gossip_step(
+            cfg, sc,
+            invariants=_inv.InvariantConfig() if invariants else None)
+    return _STEP_CACHE[key]
 
 
 def run_tournament(n: int, t: int, m: int, n_ticks: int, *,
@@ -160,7 +201,6 @@ def run_tournament(n: int, t: int, m: int, n_ticks: int, *,
     builds, meta, ctx = tournament_grid(n, t, m, n_ticks, seed=seed,
                                         attacks=attacks,
                                         defenses=defenses)
-    icfg = _inv.InvariantConfig() if invariants else None
     pairs = [gs.make_gossip_sim(cfg, score_cfg=sc, **b)
              for b in builds]
     states = [p[1] for p in pairs]
@@ -170,7 +210,7 @@ def run_tournament(n: int, t: int, m: int, n_ticks: int, *,
     state = gs.stack_trees(states)
     params = jax.device_put(params)
     state = jax.device_put(state)
-    step = gs.make_gossip_step(cfg, sc, invariants=icfg)
+    step = _cached_step(cfg, sc, invariants)
 
     attackers, victims = ctx["attackers"], ctx["victims"]
     honest_row = ~attackers  # victims/churners are honest population
@@ -211,4 +251,126 @@ def run_tournament(n: int, t: int, m: int, n_ticks: int, *,
             worst.get("reference", {}).get("delivery_fraction"),
         "invariant_violations": sum(r.get("inv_bits", 0) != 0
                                     for r in rows),
+    }
+
+
+# --------------------------------------------------------------------------
+# Defense auto-tuning (round 12, ROADMAP direction-5 leftover): the
+# tournament MEASURES the attack x defense grid; with the knob dispatch
+# making defense points free (traced operands, zero recompiles), an
+# optimizer over the knob space is one batched dispatch per step.
+# --------------------------------------------------------------------------
+
+#: the coordinate-descent search space.  graylist candidates respect
+#: the static publish threshold (-50): graylist <= publish is a build
+#: invariant (make_sim_knobs names it on violation).
+TUNE_SPACE = {
+    "invalid_message_deliveries_weight": (-5.0, -10.0, -20.0, -40.0),
+    "behaviour_penalty_weight": (-5.0, -10.0, -20.0, -40.0),
+    "graylist_threshold": (-80.0, -65.0, -50.0),
+    "gossip_threshold": (-10.0, -5.0, -2.0),
+}
+
+
+def tune_defense(n: int, t: int, m: int, n_ticks: int, *,
+                 seed: int = 0, passes: int = 1, space=None,
+                 attacks=ATTACKS, start=None, log=None) -> dict:
+    """Coordinate descent over the ScoreKnobs defense space, maximizing
+    the WORST-CASE honest delivery fraction across the attack column.
+
+    Each coordinate step evaluates every candidate value x every attack
+    as ONE ``gossip_run_knob_batch`` dispatch (run_tournament with the
+    candidates as the defenses axis).  The defense points are traced
+    SimKnobs operands and _cached_step pins the step object, so knob
+    VALUES never recompile — but the vmapped runner's jit cache keys
+    on the stacked replica count too, so the search compiles once per
+    DISTINCT candidate-batch size (three at the default TUNE_SPACE:
+    B = 10 for the base/final runs, 20 for the weight coordinates, 15
+    for the thresholds; the B=20 executable is shared with the
+    20-cell tournament bench).
+
+    Objective: LEXICOGRAPHIC (worst-case delivery, attack-column mean
+    delivery, -eclipse takeover).  The binding worst case at the
+    tournament shape is cold-restart churn — peers lose data while
+    down, which no score parameter can prevent — and honest DELIVERY
+    is robust enough that every non-degenerate penalty setting
+    contains the score-sensitive attacks too, so candidates routinely
+    tie on both delivery keys.  The third key is where the defense
+    knobs actually bite at this shape: the fraction of victim mesh
+    slots the eclipse formation occupies (``eclipse_takeover`` —
+    0.64 under reference scoring vs 0.81 with penalties off, round
+    11), minimized.  Returns ``{"tuned": point, "tuned_worst_case":
+    {...}, "tuned_mean": float, "tuned_takeover": float,
+    "reference_worst_case": {...}, "reference_mean": float,
+    "reference_takeover": float, "delta": float (worst-case),
+    "delta_mean": float, "delta_takeover": float (negative =
+    improvement), "history": [...]}``.
+    """
+    space = dict(TUNE_SPACE if space is None else space)
+    point = dict(start or {})
+    history = []
+
+    def takeover_of(report, dname):
+        tk = [r.get("eclipse_takeover") for r in report["rows"]
+              if r["defense"] == dname
+              and r.get("eclipse_takeover") is not None]
+        return tk[0] if tk else 0.0
+
+    def objective(report, dname):
+        col = [r["delivery_fraction"] for r in report["rows"]
+               if r["defense"] == dname]
+        return (report["worst_case"][dname]["delivery_fraction"],
+                round(sum(col) / len(col), 6),
+                -takeover_of(report, dname))
+
+    # the reference row rides along once for the delta
+    base = run_tournament(n, t, m, n_ticks, seed=seed, attacks=attacks,
+                          defenses={"reference": {},
+                                    "start": dict(point)})
+    ref_worst = base["worst_case"]["reference"]
+    best = objective(base, "start")
+    if log:
+        log(f"tune: start (worst, mean)={best} "
+            f"(reference worst {ref_worst['delivery_fraction']:.4f})")
+    for p in range(passes):
+        for coord, values in space.items():
+            cands = {}
+            for v in values:
+                cands[f"{coord}={v}"] = dict(point, **{coord: v})
+            rep = run_tournament(n, t, m, n_ticks, seed=seed,
+                                 attacks=attacks, defenses=cands)
+            scored = {name: objective(rep, name) for name in cands}
+            name, val = max(scored.items(), key=lambda kv: kv[1])
+            history.append({"pass": p, "coord": coord,
+                            "candidates": scored})
+            if val > best:
+                best = val
+                point = dict(cands[name])
+                if log:
+                    log(f"tune: {name} -> (worst, mean)={val} "
+                        "(new best)")
+            elif log:
+                log(f"tune: {coord} best candidate {name} "
+                    f"(worst, mean)={val} <= {best}, keeping point")
+    final = run_tournament(n, t, m, n_ticks, seed=seed, attacks=attacks,
+                           defenses={"reference": {},
+                                     "tuned": dict(point)})
+    tuned_worst = final["worst_case"]["tuned"]
+    ref_worst = final["worst_case"]["reference"]
+    tuned_obj = objective(final, "tuned")
+    ref_obj = objective(final, "reference")
+    return {
+        "tuned": point,
+        "tuned_worst_case": tuned_worst,
+        "tuned_mean": tuned_obj[1],
+        "tuned_takeover": -tuned_obj[2],
+        "reference_worst_case": ref_worst,
+        "reference_mean": ref_obj[1],
+        "reference_takeover": -ref_obj[2],
+        "delta": round(tuned_worst["delivery_fraction"]
+                       - ref_worst["delivery_fraction"], 4),
+        "delta_mean": round(tuned_obj[1] - ref_obj[1], 6),
+        "delta_takeover": round(ref_obj[2] - tuned_obj[2], 4),
+        "history": history,
+        "shape": {"n": n, "t": t, "m": m, "ticks": n_ticks},
     }

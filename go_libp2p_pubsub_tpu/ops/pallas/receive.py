@@ -176,6 +176,20 @@ CTRL2_A_B = 3      # slot-B "no PRUNE would come back"
  TEL_NEW_IDS,       # new acquisitions (recv - new = dup_suppressed)
  ) = range(8)
 TEL_ROWS = 8
+# round-12 knob vector (``with_knobs``): one f32 SMEM operand carrying
+# the traced protocol/defense scalars the kernel consumes in-VMEM.
+# Layout: rows 0-2 always (the unscored kernel takes a length-3
+# vector); rows 3-6 only on scored configs.  Integer-valued knobs are
+# exact through the f32 carry (values << 2^24; the kernel casts back
+# to i32 at the consumer).
+(KNOB_GF,        # gossip_factor (next-tick targets emission)
+ KNOB_DLAZY,     # d_lazy (targets floor)
+ KNOB_BT,        # backoff_ticks (backoff-write restart value)
+ KNOB_INVW,      # ScoreKnobs invalid_message_deliveries_weight
+ KNOB_BPW,       # ScoreKnobs behaviour_penalty_weight
+ KNOB_GRAY,      # ScoreKnobs graylist_threshold (accept gate)
+ KNOB_GSP,       # ScoreKnobs gossip_threshold (gossip gate)
+ ) = range(7)
 # with tel_lat_buckets = L > 0 (round 10), rows TEL_ROWS..TEL_ROWS+L-1
 # append the delivery-latency bucket tallies: row TEL_ROWS + b counts
 # this tick's delivered message copies whose latency lands in bucket b
@@ -281,7 +295,8 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
                     force_extended=False, stream_n=None,
                     with_px=False, with_same_ip=False,
                     with_static=True, with_faults=False,
-                    with_telemetry=False, tel_lat_buckets=0):
+                    with_telemetry=False, tel_lat_buckets=0,
+                    with_knobs=False):
     C = cfg.n_candidates
     B = block
     cinv = cfg.cinv
@@ -308,6 +323,9 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     gseed_ref = nxt()       # u32 [2]: mixed lane seeds for tick + 1
     #                         [0] gater draw (phase 6), [1] gossip
     #                         targets (phase 1)
+    knobs_ref = (nxt() if with_knobs else None)
+    #                         f32 [3 or 7]: traced knob scalars in
+    #                         KNOB_* order (round 12)
     latmask_ref = (nxt() if with_telemetry and tel_lat_buckets
                    else None)  # u32 [L, W] per-tick bucket masks
     base_ref = nxt()        # u32 [1]: global peer index of local
@@ -682,15 +700,18 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
             for b in range(tel_lat_buckets):
                 t_lat[b] = t_lat[b] + pcount(dw & latmask_ref[b, w])
     # backoff = remaining ticks: triggers restart at B-1, else
-    # decrement toward 0 (i32 detour: mosaic lacks 16-bit min/max)
+    # decrement toward 0 (i32 detour: mosaic lacks 16-bit min/max).
+    # With knobs the restart value reads from the SMEM vector (exact
+    # i32 through the f32 carry).
+    bt1 = (knobs_ref[KNOB_BT].astype(jnp.int32) - 1 if with_knobs
+           else cfg.backoff_ticks - 1)
     bo32 = bo_in[...].astype(jnp.int32)
-    bo_new = jnp.where(_expand(bo_trig, C), cfg.backoff_ticks - 1,
+    bo_new = jnp.where(_expand(bo_trig, C), bt1,
                        jnp.maximum(bo32 - 1, 0))
     out_bo[...] = bo_new.astype(jnp.int16)
     if paired:
         bob32 = bob_in[...].astype(jnp.int32)
-        bob_new = jnp.where(_expand(bo_trig_b, C),
-                            cfg.backoff_ticks - 1,
+        bob_new = jnp.where(_expand(bo_trig_b, C), bt1,
                             jnp.maximum(bob32 - 1, 0))
         out_bo_b[...] = bob_new.astype(jnp.int16)
 
@@ -730,9 +751,12 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
         if gossip_g is not None:
             elig = elig & gossip_g
         n_el = jax.lax.population_count(elig).astype(jnp.int32)
+        k_lazy = (knobs_ref[KNOB_DLAZY].astype(jnp.int32)
+                  if with_knobs else jnp.int32(cfg.d_lazy))
+        k_gf = knobs_ref[KNOB_GF] if with_knobs else cfg.gossip_factor
         n_go = jnp.maximum(
-            jnp.int32(cfg.d_lazy),
-            (cfg.gossip_factor * n_el.astype(jnp.float32)).astype(
+            k_lazy,
+            (k_gf * n_el.astype(jnp.float32)).astype(
                 jnp.int32))
         u_g = lane_u(gseed_ref[1])
         if cfg.binomial_gossip_sampling:
@@ -847,12 +871,19 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
         inv_n = inv_new.astype(jnp.float32)
         tim_n = tim_new.astype(jnp.int32).astype(jnp.float32)
         w_t = sc.topic_weight
+        # round-12 knobs: the four ScoreKnobs defense scalars read
+        # from the SMEM vector (same op order as the XLA
+        # compute_scores, so knob parity is bit-exact)
+        w_inv = (knobs_ref[KNOB_INVW] if with_knobs
+                 else sc.invalid_message_deliveries_weight)
+        w_bp = (knobs_ref[KNOB_BPW] if with_knobs
+                else sc.behaviour_penalty_weight)
         topic_part = (w_t * sc.time_in_mesh_weight
                       * jnp.minimum(tim_n / sc.time_in_mesh_quantum,
                                     sc.time_in_mesh_cap)
                       + (w_t * sc.first_message_deliveries_weight)
                       * fd_n
-                      + (w_t * sc.invalid_message_deliveries_weight)
+                      + (w_t * w_inv)
                       * inv_n * inv_n)
         if paired:
             # per-slot P1 for the SECOND topic (compute_scores)
@@ -867,9 +898,13 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
                             - sc.behaviour_penalty_threshold)
         if with_static:
             topic_part = topic_part + static_ref[...]
-        score = topic_part + sc.behaviour_penalty_weight * bp_ex * bp_ex
-        accept_g = packb(score >= sc.graylist_threshold)
-        gossip_g = packb(score >= sc.gossip_threshold)
+        score = topic_part + w_bp * bp_ex * bp_ex
+        gray_t = (knobs_ref[KNOB_GRAY] if with_knobs
+                  else sc.graylist_threshold)
+        gsp_t = (knobs_ref[KNOB_GSP] if with_knobs
+                 else sc.gossip_threshold)
+        accept_g = packb(score >= gray_t)
+        gossip_g = packb(score >= gsp_t)
         pub_g = packb(score >= sc.publish_threshold)
         nonneg_g = packb(score >= 0)
         # RED gater (peer_gater.go:320-363); stats keyed by source
@@ -983,7 +1018,7 @@ def sharded_receive(cfg, sc, n_true: int, block: int, counter_dtype,
                     inj_st=None, with_px=False, with_same_ip=False,
                     ctrl2_rows=None, freshb_st=None, with_static=True,
                     with_faults=False, with_telemetry=False,
-                    tel_lat_buckets=0):
+                    tel_lat_buckets=0, with_knobs=False):
     """Multi-chip kernel dispatch: shard_map over the peer axis, one
     pallas kernel invocation per shard with ring-halo exchange.
 
@@ -1000,8 +1035,9 @@ def sharded_receive(cfg, sc, n_true: int, block: int, counter_dtype,
     halo ring must be the true ring) and n_true must divide evenly
     into D shards of whole blocks (n_true % (D * block) == 0).
 
-    ``head`` = [valid (sc only), gseeds(, latmask — tel_lat_buckets
-    only, replicated)]; ``ctrl_rows`` u8 [C, N];
+    ``head`` = [valid (sc only), gseeds(, knobs — with_knobs only,
+    replicated)(, latmask — tel_lat_buckets only, replicated)];
+    ``ctrl_rows`` u8 [C, N];
     ``fresh_st``/``adv_st`` u32 [W, N]; ``blocked`` = the per-peer
     operands in make_receive_update order.  Returns the kernel's
     outputs with global [*, N] shapes.
@@ -1029,7 +1065,7 @@ def sharded_receive(cfg, sc, n_true: int, block: int, counter_dtype,
         force_extended=True, stream_n=n_true, with_px=with_px,
         with_same_ip=with_same_ip, with_static=with_static,
         with_faults=with_faults, with_telemetry=with_telemetry,
-        tel_lat_buckets=tel_lat_buckets)
+        tel_lat_buckets=tel_lat_buckets, with_knobs=with_knobs)
     n_head = len(head)
     paired = cfg.paired_topics
     n_gates = n_gate_rows(sc is not None, paired)
@@ -1101,11 +1137,15 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
                         with_static: bool = True,
                         with_faults: bool = False,
                         with_telemetry: bool = False,
-                        tel_lat_buckets: int = 0):
+                        tel_lat_buckets: int = 0,
+                        with_knobs: bool = False):
     """Build the kernel caller.
 
     Operand order (args): [valid u32 [W] (sc only)], gseeds u32 [2]
-    (tick+1 gater + targets lane seeds), [latmask u32 [L, W]
+    (tick+1 gater + targets lane seeds), [knobs f32 [3 or 7]
+    (with_knobs only: the round-12 traced protocol/defense scalars in
+    KNOB_* order — gossip_factor, d_lazy, backoff_ticks, then on
+    scored configs the four ScoreKnobs fields)], [latmask u32 [L, W]
     (tel_lat_buckets = L > 0 only: the tick's delivery-latency bucket
     masks, models/telemetry.py latency_bucket_masks)], base u32 [1]
     (global peer
@@ -1163,7 +1203,7 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
         stream_n=stream_n, with_px=with_px,
         with_same_ip=with_same_ip, with_static=with_static,
         with_faults=with_faults, with_telemetry=with_telemetry,
-        tel_lat_buckets=tel_lat_buckets)
+        tel_lat_buckets=tel_lat_buckets, with_knobs=with_knobs)
 
     b1 = lambda: pl.BlockSpec((B,), lambda i: (i,))  # noqa: E731
     bw = lambda: pl.BlockSpec((W, B), lambda i: (0, i))  # noqa: E731
@@ -1174,6 +1214,8 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
     if has_sc:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))  # valid
     in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))      # gseeds
+    if with_knobs:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))  # knobs
     if with_telemetry and tel_lat_buckets:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))  # latmask
     in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))      # base

@@ -172,23 +172,26 @@ def test_only_graftlint_fixture_dir_is_exempt(tmp_path):
 
 def test_declared_matrix_shape():
     combos = ja.declared_matrix()
-    assert len(combos) == 50
+    assert len(combos) == 52
     # base 32: all three sims x telemetry x faults x batched; split
     # axis only on gossipsub.  Round-10 variants: gather/dense
     # (tel x faults), rpc (tel, faulted), hist (faults, scored).
     # Round-11 variants: inv (the in-scan invariant checker — gossip
     # on both fault axes, flood/randomsub faulted) and attack (the
     # eclipse+byzantine+knobs+cold-restart surface, sequential + the
-    # batched tournament runner).
+    # batched tournament runner).  Round-12 variant: knobs (the
+    # config-as-data surface — heterogeneous SimKnobs points,
+    # sequential + the knob-batched sweep runner).
     key = lambda c: (c["sim"], c["split"], c["telemetry"],  # noqa: E731
                      c["faults"], c["batched"], c["variant"])
-    assert len({key(c) for c in combos}) == 50
+    assert len({key(c) for c in combos}) == 52
     assert sum(not c["variant"] for c in combos) == 32
-    for sim, n in (("gossipsub", 24), ("floodsub", 13),
+    for sim, n in (("gossipsub", 26), ("floodsub", 13),
                    ("randomsub", 13)):
         assert sum(c["sim"] == sim for c in combos) == n
     for var, n in (("gather", 4), ("dense", 4), ("rpc", 2),
-                   ("hist", 2), ("inv", 4), ("attack", 2)):
+                   ("hist", 2), ("inv", 4), ("attack", 2),
+                   ("knobs", 2)):
         assert sum(c["variant"] == var for c in combos) == n
     axes = {ax: {c[ax] for c in combos}
             for ax in ("telemetry", "faults", "batched")}
@@ -315,6 +318,9 @@ def test_contract_refusals_and_build_time_hold():
         ("FaultSchedule", "randomsub-circulant"),
         ("FaultSchedule", "randomsub-dense"),
         ("ScoreSimConfig", "kernel"),
+        # round 12: the one XLA-only knob — gossip_retransmission on
+        # iwant-spam configs refuses the kernel path by name
+        ("SimKnobs", "kernel"),
     }
     for key, (probe, match) in ct._REFUSALS.items():
         if key[0] != "FaultSchedule":
@@ -329,10 +335,14 @@ def test_contract_refusals_and_build_time_hold():
     assert ct._expect_raise(wrong_reason, r"refuses fault configs",
                             label="x") != []
     # probe-refusal registry (round 11): the remaining rpc_probe
-    # capability gaps stay named, live, and NotImplementedError-typed
-    for label, (probe, match) in ct._PROBE_REFUSALS.items():
+    # capability gaps stay named and live — NotImplementedError by
+    # default; round-12 entries may carry an explicit exception class
+    # (the sim_knobs static-field ratchet is ValueError-typed)
+    for label, spec in ct._PROBE_REFUSALS.items():
+        probe, match = spec[0], spec[1]
+        exc = spec[2] if len(spec) > 2 else NotImplementedError
         assert ct._expect_raise(probe, match, label=label,
-                                exc=NotImplementedError) == [], label
+                                exc=exc) == [], label
 
 
 def test_contract_fault_threading_fast():

@@ -1,0 +1,394 @@
+"""Round-12 sweep engine: SimKnobs config-as-data (models/knobs.py),
+the knob-batched runner, and the resident scenario server
+(tools/sweepd.py).
+
+The load-bearing claims, each pinned here:
+
+- knobbed-defaults == baked BIT-IDENTITY on all six gossip execution
+  paths (XLA combined, XLA split, pallas kernel, vmapped batch,
+  paired-topic, PX rotation) — arming knobs at the config's own values
+  changes nothing;
+- heterogeneous-config vmap == the per-config sequential loop,
+  bit-identical per replica (ONE compiled executable advances B
+  *different* scenarios);
+- no retrace across knob values (jaxpr identity), the whole point;
+- shape-bearing fields are rejected AS KNOBS with a named error;
+- the kernel path consumes the SMEM knob scalars bit-identically to
+  the XLA path, and refuses the one XLA-only knob configuration
+  (gossip_retransmission under IWANT spam) by name;
+- sweepd round-trip: scenarios in, metric rows out, ZERO recompiles
+  (compile-counter hook).
+"""
+
+import io
+import json
+import re
+
+import numpy as np
+import pytest
+
+import jax
+
+import go_libp2p_pubsub_tpu.models.faults as fl
+import go_libp2p_pubsub_tpu.models.gossipsub as gs
+from go_libp2p_pubsub_tpu.models import knobs as kn
+
+N, T, M, C = 80, 2, 6, 8
+BLOCK = 128
+TICKS = 6
+
+
+def _inputs():
+    subs = np.zeros((N, T), dtype=bool)
+    subs[np.arange(N), np.arange(N) % T] = True
+    rng = np.random.default_rng(0)
+    topic = rng.integers(0, T, M)
+    origin = rng.integers(0, N // T, M) * T + topic
+    ticks = np.zeros(M, dtype=np.int32)
+    return subs, topic, origin, ticks
+
+
+def _cfg(paired=False):
+    return gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(T, C, N, seed=1, paired=paired),
+        n_topics=T, d=3, d_lo=2, d_hi=6, d_score=2, d_out=1,
+        d_lazy=2, backoff_ticks=8, paired_topics=paired)
+
+
+def _paired_subs():
+    subs = np.zeros((N, T), dtype=bool)
+    own = np.arange(N) % T
+    subs[np.arange(N), own] = True
+    subs[np.arange(N), (own + T // 2) % T] = True
+    return subs
+
+
+def _state_leaves(state):
+    return jax.tree_util.tree_leaves(state)
+
+
+def _assert_states_equal(a, b, label):
+    la, lb = _state_leaves(a), _state_leaves(b)
+    assert len(la) == len(lb), label
+    for x, y in zip(la, lb):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), label
+
+
+# -- knobbed-defaults == baked, six execution paths ------------------------
+
+#: (name, sim extra kwargs, step extra kwargs, batched?)
+PATHS = [
+    ("xla-combined", {}, {}, False),
+    ("xla-split", {}, {"force_split": True}, False),
+    ("kernel", {"pad_to_block": BLOCK},
+     {"receive_block": BLOCK, "receive_interpret": True}, False),
+    ("batched", {}, {}, True),
+    ("paired", {"paired": True}, {}, False),
+    ("px", {"px_candidates": 7}, {}, False),
+]
+
+
+@pytest.mark.parametrize("name,sim_kw,step_kw,batched",
+                         PATHS, ids=[p[0] for p in PATHS])
+def test_knobbed_defaults_bit_identical(name, sim_kw, step_kw, batched):
+    sim_kw = dict(sim_kw)
+    paired = sim_kw.pop("paired", False)
+    cfg = _cfg(paired=paired)
+    sc = gs.ScoreSimConfig()
+    subs, topic, origin, ticks = _inputs()
+    if paired:
+        subs = _paired_subs()
+    step = gs.make_gossip_step(cfg, sc, **step_kw)
+
+    def build(knobbed):
+        kw = dict(sim_kw)
+        if knobbed:
+            kw["sim_knobs"] = {}
+        if batched:
+            builds = [gs.make_gossip_sim(cfg, subs, topic, origin,
+                                         ticks, score_cfg=sc, seed=r,
+                                         **kw) for r in range(2)]
+            return (gs.stack_trees([b[0] for b in builds]),
+                    gs.stack_trees([b[1] for b in builds]))
+        return gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                                  score_cfg=sc, **kw)
+
+    run = gs.gossip_run_batch if batched else gs.gossip_run
+    p0, s0 = build(False)
+    p1, s1 = build(True)
+    out0 = run(p0, s0, TICKS, step)
+    out1 = run(p1, s1, TICKS, step)
+    for field in ("mesh", "fanout", "last_pub", "backoff", "have",
+                  "recent", "tick", "mesh_b", "backoff_b", "active"):
+        a, b = getattr(out0, field), getattr(out1, field)
+        if a is None and b is None:
+            continue
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            (name, field)
+    _assert_states_equal(out0.scores, out1.scores, (name, "scores"))
+    for ga, gb in zip(out0.gates, out1.gates):
+        assert np.array_equal(np.asarray(ga), np.asarray(gb)), name
+
+
+def test_knobbed_defaults_unscored():
+    cfg = _cfg()
+    subs, topic, origin, ticks = _inputs()
+    step = gs.make_gossip_step(cfg)
+    p0, s0 = gs.make_gossip_sim(cfg, subs, topic, origin, ticks)
+    p1, s1 = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                                sim_knobs={})
+    out0 = gs.gossip_run(p0, s0, TICKS, step)
+    out1 = gs.gossip_run(p1, s1, TICKS, step)
+    for field in ("mesh", "have", "backoff"):
+        assert np.array_equal(np.asarray(getattr(out0, field)),
+                              np.asarray(getattr(out1, field))), field
+
+
+# -- heterogeneous-config vmap == sequential -------------------------------
+
+def test_heterogeneous_vmap_matches_sequential():
+    cfg = _cfg()
+    sc = gs.ScoreSimConfig()
+    subs, topic, origin, ticks = _inputs()
+    step = gs.make_gossip_step(cfg, sc)
+    points = [{}, {"d": 4, "d_hi": 5},
+              {"gossip_factor": 0.5, "d_lazy": 3},
+              {"backoff_ticks": 4, "graylist_threshold": -60.0}]
+    builds = [gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                                 score_cfg=sc, seed=7, sim_knobs=k)
+              for k in points]
+    params = gs.stack_trees([b[0] for b in builds])
+    state = gs.stack_trees([gs.tree_copy(b[1]) for b in builds])
+    stateB, reach = gs.gossip_run_knob_batch(params, state, TICKS + 2,
+                                             step)
+    for i, (p, s) in enumerate(builds):
+        s2 = gs.gossip_run(p, gs.tree_copy(s), TICKS + 2, step)
+        bi = gs.index_trees(stateB, i)
+        for field in ("mesh", "have", "backoff", "fanout"):
+            assert np.array_equal(np.asarray(getattr(bi, field)),
+                                  np.asarray(getattr(s2, field))), \
+                (i, field)
+        want = np.asarray(gs.reach_counts_from_have(p, s2))
+        assert np.array_equal(np.asarray(reach)[i], want), i
+
+
+def test_no_retrace_across_knob_values():
+    cfg = _cfg()
+    sc = gs.ScoreSimConfig()
+    subs, topic, origin, ticks = _inputs()
+    step = gs.make_gossip_step(cfg, sc)
+    a = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                           score_cfg=sc,
+                           sim_knobs={"d": 4, "gossip_factor": 0.3})
+    b = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                           score_cfg=sc,
+                           sim_knobs={"d": 3, "gossip_factor": 0.9,
+                                      "backoff_ticks": 20})
+    assert (str(jax.make_jaxpr(step)(*a))
+            == str(jax.make_jaxpr(step)(*b)))
+
+
+# -- validation ------------------------------------------------------------
+
+def test_static_field_as_knob_raises_named_error():
+    cfg = _cfg()
+    subs, topic, origin, ticks = _inputs()
+    for field in ("offsets", "n_topics", "history_length",
+                  "history_gossip", "paired_topics"):
+        with pytest.raises(kn.KnobStaticFieldError,
+                           match=re.escape(repr(field))):
+            gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                               sim_knobs={field: 1})
+
+
+def test_unknown_knob_lists_valid_surface():
+    cfg = _cfg()
+    subs, topic, origin, ticks = _inputs()
+    with pytest.raises(ValueError, match="unknown knob 'dd'"):
+        gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                           sim_knobs={"dd": 4})
+
+
+def test_knob_point_ordering_invariants():
+    cfg = _cfg()
+    with pytest.raises(ValueError, match="d_lo <= d <= d_hi"):
+        kn.make_sim_knobs(cfg, overrides={"d": 1})
+    with pytest.raises(ValueError, match="backoff_ticks"):
+        kn.make_sim_knobs(cfg, overrides={"backoff_ticks": 0})
+    with pytest.raises(ValueError, match="d_hi < C"):
+        kn.make_sim_knobs(cfg, overrides={"d_hi": 8})
+    with pytest.raises(ValueError, match="gossip_factor"):
+        kn.make_sim_knobs(cfg, overrides={"gossip_factor": 1.5})
+
+
+def test_drop_prob_knob_requires_schedule():
+    cfg = _cfg()
+    subs, topic, origin, ticks = _inputs()
+    with pytest.raises(ValueError, match="fault_schedule"):
+        gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                           sim_knobs={"drop_prob": 0.1})
+
+
+def test_one_override_surface_only():
+    cfg = _cfg()
+    sc = gs.ScoreSimConfig()
+    subs, topic, origin, ticks = _inputs()
+    with pytest.raises(ValueError, match="ONE surface"):
+        gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                           score_cfg=sc, sim_knobs={},
+                           score_knobs={"gossip_threshold": -5.0})
+
+
+# -- fault drop knob -------------------------------------------------------
+
+def test_drop_prob_knob_matches_schedule_rate():
+    cfg = _cfg()
+    sc = gs.ScoreSimConfig()
+    subs, topic, origin, ticks = _inputs()
+    step = gs.make_gossip_step(cfg, sc)
+    schedA = fl.FaultSchedule(n_peers=N, horizon=10, drop_prob=0.5,
+                              seed=3)
+    pA, sA = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                                score_cfg=sc, fault_schedule=schedA,
+                                sim_knobs={"drop_prob": 0.1})
+    schedB = fl.FaultSchedule(n_peers=N, horizon=10, drop_prob=0.1,
+                              seed=3)
+    pB, sB = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                                score_cfg=sc, fault_schedule=schedB,
+                                sim_knobs={})
+    outA = gs.gossip_run(pA, sA, 8, step)
+    outB = gs.gossip_run(pB, sB, 8, step)
+    for field in ("mesh", "have", "backoff"):
+        assert np.array_equal(np.asarray(getattr(outA, field)),
+                              np.asarray(getattr(outB, field))), field
+
+
+# -- kernel path -----------------------------------------------------------
+
+def test_kernel_knob_parity_non_defaults():
+    cfg = _cfg()
+    sc = gs.ScoreSimConfig()
+    subs, topic, origin, ticks = _inputs()
+    knobs = {"d": 4, "d_hi": 5, "gossip_factor": 0.5,
+             "backoff_ticks": 5, "d_lazy": 3,
+             "graylist_threshold": -60.0,
+             "behaviour_penalty_weight": -20.0}
+    px, sx = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                                score_cfg=sc, sim_knobs=knobs)
+    outx = gs.gossip_run(px, sx, TICKS, gs.make_gossip_step(cfg, sc))
+    pk, sk = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                                score_cfg=sc, sim_knobs=knobs,
+                                pad_to_block=BLOCK)
+    stepk = gs.make_gossip_step(cfg, sc, receive_block=BLOCK,
+                                receive_interpret=True)
+    outk = gs.gossip_run(pk, sk, TICKS, stepk)
+    assert np.array_equal(np.asarray(outk.mesh)[:N],
+                          np.asarray(outx.mesh))
+    assert np.array_equal(np.asarray(outk.have)[:, :N],
+                          np.asarray(outx.have))
+    assert np.array_equal(np.asarray(outk.backoff)[:, :N],
+                          np.asarray(outx.backoff))
+
+
+def test_kernel_refuses_iwant_spam_knobs_by_name():
+    cfg = _cfg()
+    sc = gs.ScoreSimConfig(sybil_iwant_spam=True)
+    subs, topic, origin, ticks = _inputs()
+    p, s = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                              score_cfg=sc,
+                              sybil=(np.arange(N) % 5) == 0,
+                              sim_knobs={}, pad_to_block=BLOCK)
+    step = gs.make_gossip_step(cfg, sc, receive_block=BLOCK,
+                               receive_interpret=True)
+    with pytest.raises(ValueError,
+                       match="gossip_retransmission stays XLA-only"):
+        jax.eval_shape(step, p, s)
+
+
+def test_kernel_accepts_score_knobs_now():
+    """The PR-7 refusal is lifted: a legacy score_knobs build takes
+    the kernel path (SMEM scalars), bit-identical to XLA."""
+    cfg = _cfg()
+    sc = gs.ScoreSimConfig()
+    subs, topic, origin, ticks = _inputs()
+    skn = {"behaviour_penalty_weight": -20.0,
+           "gossip_threshold": -5.0}
+    px, sx = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                                score_cfg=sc, score_knobs=skn)
+    outx = gs.gossip_run(px, sx, TICKS, gs.make_gossip_step(cfg, sc))
+    pk, sk = gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                                score_cfg=sc, score_knobs=skn,
+                                pad_to_block=BLOCK)
+    stepk = gs.make_gossip_step(cfg, sc, receive_block=BLOCK,
+                                receive_interpret=True)
+    outk = gs.gossip_run(pk, sk, TICKS, stepk)
+    assert np.array_equal(np.asarray(outk.mesh)[:N],
+                          np.asarray(outx.mesh))
+    assert np.array_equal(np.asarray(outk.have)[:, :N],
+                          np.asarray(outx.have))
+
+
+# -- sweepd ---------------------------------------------------------------
+
+def test_sweepd_round_trip_zero_recompiles():
+    from tools.sweepd import SweepServer
+
+    srv = SweepServer(n=200, t=2, m=6, ticks=8, batch=3, seed=0)
+    compiles0 = srv.compiles()
+    rows = srv.submit([
+        {"id": "a", "seed": 1},
+        {"id": "b", "knobs": {"d": 5, "gossip_factor": 0.4}},
+        {"id": "c", "drop_prob": 0.05},
+    ])
+    assert [r["id"] for r in rows] == ["a", "b", "c"]
+    assert all(r["ok"] for r in rows), rows
+    assert all(r["inv_bits"] == 0 for r in rows), rows
+    # compile-counter hook: ONE executable total, and a second wave of
+    # different configs adds none
+    assert compiles0 == 0
+    assert srv.compiles() == 1
+    n_compiles = srv.compiles()
+    rows2 = srv.submit([
+        {"id": "d", "knobs": {"backoff_ticks": 4}},
+        {"id": "e", "attack": "spam", "attack_frac": 0.1},
+        {"id": "f", "churn": True},
+    ])
+    assert all(r["ok"] for r in rows2), rows2
+    assert srv.compiles() == n_compiles, "sweepd recompiled"
+    stats = srv.stats()
+    assert stats["served"] == 6
+    assert stats["configs_per_compile"] >= 6
+
+
+def test_sweepd_line_protocol_and_errors():
+    from tools.sweepd import SweepServer
+
+    srv = SweepServer(n=200, t=2, m=6, ticks=8, batch=2, seed=0)
+    lines = [
+        json.dumps({"id": "ok1"}),
+        json.dumps({"id": "bad", "knobs": {"offsets": [1, -1]}}),
+        json.dumps({"id": "ok2", "knobs": {"d_lazy": 4}}),
+        json.dumps({"cmd": "stats"}),
+    ]
+    out = io.StringIO()
+    srv.serve_lines(lines, out)
+    rows = [json.loads(line) for line in
+            out.getvalue().strip().splitlines()]
+    by_id = {r.get("id"): r for r in rows if "id" in r}
+    assert by_id["ok1"]["ok"] and by_id["ok2"]["ok"]
+    assert not by_id["bad"]["ok"]
+    assert "offsets" in by_id["bad"]["error"]
+    stats_rows = [r for r in rows if r.get("stats")]
+    assert stats_rows and stats_rows[0]["compiles"] == 1
+
+
+# -- tournament integration ------------------------------------------------
+
+def test_tournament_defenses_include_tuned():
+    from go_libp2p_pubsub_tpu.models.tournament import (
+        DEFENSES, TUNED_DEFENSE)
+    assert DEFENSES["tuned"] == TUNED_DEFENSE
+    # the tuned point is a valid knob point over the tournament config
+    cfg = _cfg()
+    kn.make_sim_knobs(cfg, gs.ScoreSimConfig(),
+                      overrides=dict(TUNED_DEFENSE))

@@ -36,7 +36,12 @@ import dataclasses
 N, T, M, C = 80, 2, 6, 8
 KERNEL_BLOCK = 1024
 
-_VALID = ("threaded", "inert", "refused", "build-time")
+_VALID = ("threaded", "inert", "refused", "build-time", "traced")
+#   "traced" (round 12, the sweep engine): threaded (baked) AND
+#   liftable to a traced SimKnobs operand — the prover additionally
+#   builds two knob points over ONE static config and requires the
+#   step's jaxpr to be IDENTICAL (no retrace across knob values)
+#   while the build leaves differ (the value rides as data).
 
 
 # --------------------------------------------------------------------------
@@ -76,7 +81,8 @@ _ARTIFACT_CACHE: dict[tuple, tuple] = {}
 
 def _gossip_artifact(path, cfg_kw=None, *, n_topics=T, paired=False,
                      px=7, attack=False, sc_kw=None, sybil=False,
-                     app=False, eclipse=False, byz=False):
+                     app=False, eclipse=False, byz=False,
+                     sim_knobs=None, faulted=False):
     """(jaxpr_text, build_leaves) of a scored gossip step on ``path``
     ("xla" | "kernel") under config overrides.  ``sc_kw`` overrides
     ScoreSimConfig fields (the round-11 score-contract probes);
@@ -91,7 +97,9 @@ def _gossip_artifact(path, cfg_kw=None, *, n_topics=T, paired=False,
 
     key = (path, n_topics, paired, px, attack, sybil, app, eclipse,
            byz, tuple(sorted((cfg_kw or {}).items())),
-           tuple(sorted((sc_kw or {}).items())))
+           tuple(sorted((sc_kw or {}).items())),
+           tuple(sorted((sim_knobs or {}).items())),
+           sim_knobs is not None, faulted)
     if key in _ARTIFACT_CACHE:
         return _ARTIFACT_CACHE[key]
 
@@ -130,6 +138,10 @@ def _gossip_artifact(path, cfg_kw=None, *, n_topics=T, paired=False,
         sim_kw.update(byzantine=(np.arange(N) % 5) == 0)
     if px is not None:
         sim_kw["px_candidates"] = px
+    if sim_knobs is not None:
+        sim_kw["sim_knobs"] = dict(sim_knobs)
+    if faulted:
+        sim_kw["fault_schedule"] = _fault_schedule()
     if path == "kernel":
         sim_kw["pad_to_block"] = KERNEL_BLOCK
         step_kw["receive_block"] = KERNEL_BLOCK
@@ -423,6 +435,64 @@ _GOSSIP_PROBES = {
         cfg_kw={"binomial_gossip_sampling": False}),
 }
 
+#: Round-12 traced-knob probes (models/knobs.py SimKnobs): two knob
+#: points over ONE static config — (point A, point B, artifact flags).
+#: The "traced" prover requires the jaxpr to be IDENTICAL across the
+#: two points (no retrace — the whole sweep-engine claim) while the
+#: build leaves differ (the value rides as a traced operand).  Values
+#: respect the probe config's ordering invariants (d=3, d_lo=2,
+#: d_hi=6, d_score=2, d_out=1, px=7).
+_KNOB_TRACED_PROBES = {
+    "d": ({"d": 3}, {"d": 4}, {}),
+    "d_lo": ({"d_lo": 2}, {"d_lo": 3}, {}),
+    "d_hi": ({"d_hi": 6}, {"d_hi": 5}, {}),
+    "d_score": ({"d_score": 2}, {"d_score": 3}, {}),
+    "d_out": ({"d_out": 1}, {"d_out": 0}, {}),
+    "d_lazy": ({"d_lazy": 2}, {"d_lazy": 3}, {}),
+    "gossip_factor": ({"gossip_factor": 0.25},
+                      {"gossip_factor": 0.5}, {}),
+    # live only under the IWANT-spam attack config (XLA path; the
+    # kernel refuses the knob there — its contract says so)
+    "gossip_retransmission": ({"gossip_retransmission": 3},
+                              {"gossip_retransmission": 4},
+                              {"attack": True}),
+    "backoff_ticks": ({"backoff_ticks": 8}, {"backoff_ticks": 9}, {}),
+    "fanout_ttl_ticks": ({"fanout_ttl_ticks": 60},
+                         {"fanout_ttl_ticks": 7}, {}),
+}
+
+
+def _knob_traced(field, path) -> bool:
+    """No-retrace proof for one liftable field: jaxpr identical across
+    two knob values, build leaves differ."""
+    kv_a, kv_b, flags = _KNOB_TRACED_PROBES[field]
+    a = _gossip_artifact(path, sim_knobs=dict(kv_a), **flags)
+    b = _gossip_artifact(path, sim_knobs=dict(kv_b), **flags)
+    return a[0] == b[0] and _leaves_differ(a[1], b[1])
+
+
+def _score_knob_traced(path) -> bool:
+    """The SimKnobs.score sub-tree (folded ScoreKnobs): no retrace
+    across defense points, values ride as data — on BOTH paths (the
+    round-12 kernel takes the four scalars as SMEM operands)."""
+    a = _gossip_artifact(path,
+                         sim_knobs={"behaviour_penalty_weight": -15.0})
+    b = _gossip_artifact(path,
+                         sim_knobs={"behaviour_penalty_weight": -25.0})
+    return a[0] == b[0] and _leaves_differ(a[1], b[1])
+
+
+def _fault_knob_traced(gossip_path) -> bool:
+    """FaultSchedule.drop_prob as a traced knob: the link-loss rate is
+    a FaultParams leaf the sim_knobs surface overrides — no retrace
+    across rates, leaves differ."""
+    a = _gossip_artifact(gossip_path, sim_knobs={"drop_prob": 0.1},
+                         faulted=True)
+    b = _gossip_artifact(gossip_path, sim_knobs={"drop_prob": 0.2},
+                         faulted=True)
+    return a[0] == b[0] and _leaves_differ(a[1], b[1])
+
+
 #: TelemetryConfig probes: (base TelemetryConfig kwargs, probe kwargs)
 _TEL_PROBES = {
     "counters": (dict(counters=True, wire=False),
@@ -685,7 +755,31 @@ def _reject_kernel_score_cfg():
         "byzantine-only refusals each verified independently)")
 
 
+def _reject_kernel_retrans_knob():
+    """The ONE XLA-only knob: a SimKnobs point on an IWANT-spam config
+    must be refused by the kernel path (the in-kernel serve budget
+    bakes gossip_retransmission), message-matched."""
+    import jax
+    import numpy as np
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(T, C, N, seed=1), n_topics=T,
+        d=3, d_lo=2, d_hi=6, d_score=2, d_out=1, d_lazy=2,
+        backoff_ticks=8)
+    sc = gs.ScoreSimConfig(sybil_iwant_spam=True)
+    subs, topic, origin, ticks = _inputs(T)
+    params, state = gs.make_gossip_sim(
+        cfg, subs, topic, origin, ticks, score_cfg=sc,
+        sybil=(np.arange(N) % 5) == 0, sim_knobs={},
+        pad_to_block=KERNEL_BLOCK)
+    step = gs.make_gossip_step(cfg, sc, receive_block=KERNEL_BLOCK)
+    jax.eval_shape(step, params, state)   # must raise
+
+
 _REFUSALS: dict = {
+    ("SimKnobs", "kernel"):
+        (_reject_kernel_retrans_knob,
+         r"gossip_retransmission stays XLA-only"),
     ("FaultSchedule", "flood-circulant"):
         (_reject_cold_restart_flood_circulant,
          r"cold_restart: the floodsub simulator refuses"),
@@ -736,6 +830,14 @@ def _probe_rpc_mixed_protocol():
     jax.eval_shape(step, params, state)   # must raise
 
 
+def _probe_static_knob():
+    """Shape-bearing fields must be rejected BY NAME at the knob
+    surface (models/knobs.py KnobStaticFieldError, a ValueError) —
+    the sweep engine's static ratchet."""
+    from go_libp2p_pubsub_tpu.models.knobs import split_knob_overrides
+    split_knob_overrides({"history_gossip": 2})   # must raise
+
+
 _PROBE_REFUSALS = {
     "rpc_probe[paired-topics]":
         (_probe_rpc_paired,
@@ -743,6 +845,12 @@ _PROBE_REFUSALS = {
     "rpc_probe[mixed-protocol]":
         (_probe_rpc_mixed_protocol,
          r"mixed-protocol overlays are not probe-supported"),
+    # round 12: entries may carry an explicit exception class as a
+    # third element (default NotImplementedError)
+    "sim_knobs[static-field]":
+        (_probe_static_knob,
+         r"'history_gossip' is a static \(shape-bearing\) config "
+         r"field", ValueError),
 }
 
 
@@ -806,14 +914,35 @@ def _contracted_classes():
     from go_libp2p_pubsub_tpu.models.gossipsub import (
         GossipSimConfig, ScoreSimConfig)
     from go_libp2p_pubsub_tpu.models.invariants import InvariantConfig
+    from go_libp2p_pubsub_tpu.models.knobs import SimKnobs
     from go_libp2p_pubsub_tpu.models.telemetry import TelemetryConfig
     return (GossipSimConfig, ScoreSimConfig, TelemetryConfig,
-            FaultSchedule, InvariantConfig)
+            FaultSchedule, InvariantConfig, SimKnobs)
 
 
 def _threaded_prover(cls_name, field, path, status):
     """The registered prover for one (class, field, path) claim, or
     None when unregistered."""
+    if status == "traced":
+        # "traced" = threaded (baked) AND liftable: the baked probe
+        # must still pass (a regression to inert hides behind the
+        # knob otherwise), plus the no-retrace knob proof
+        if (cls_name == "GossipSimConfig"
+                and field in _KNOB_TRACED_PROBES
+                and field in _GOSSIP_PROBES):
+            return lambda: (_gossip_threaded(field, path)
+                            and _knob_traced(field, path))
+        if cls_name == "SimKnobs":
+            if field == "score":
+                return lambda: _score_knob_traced(path)
+            if field in _KNOB_TRACED_PROBES:
+                return lambda: _knob_traced(field, path)
+            return None
+        if cls_name == "FaultSchedule" and field == "drop_prob":
+            gp = "kernel" if path == "gossip-kernel" else "xla"
+            return lambda: (_fault_threaded(field, path)
+                            and _fault_knob_traced(gp))
+        return None
     if cls_name == "GossipSimConfig" and field in _GOSSIP_PROBES:
         return lambda: _gossip_threaded(field, path)
     if cls_name == "ScoreSimConfig" and field in _SCORE_PROBES:
@@ -925,10 +1054,11 @@ def check_contracts(log=None) -> list[str]:
     # round 11: standalone probe-refusal entries (make_gossip_step
     # capabilities, not config fields) — NotImplementedError, message
     # matched, one entry per remaining rpc_probe refusal
-    for label, (probe, match) in sorted(_PROBE_REFUSALS.items()):
+    for label, spec in sorted(_PROBE_REFUSALS.items()):
+        probe, match = spec[0], spec[1]
+        exc = spec[2] if len(spec) > 2 else NotImplementedError
         problems.extend(_expect_raise(
-            probe, match, label=f"probe-refusal {label}",
-            exc=NotImplementedError))
+            probe, match, label=f"probe-refusal {label}", exc=exc))
     if log is not None:
         log(f"  probe refusals: {len(_PROBE_REFUSALS)} checked")
     return problems

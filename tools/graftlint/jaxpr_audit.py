@@ -130,6 +130,16 @@ def declared_matrix() -> list[dict]:
         out.append(dict(sim="gossipsub", split=False, telemetry=False,
                         faults=True, batched=batched,
                         variant="attack"))
+    # round-12 knob cases: the config-as-data surface — per-replica
+    # SimKnobs protocol points (degree family + gossip_factor +
+    # backoff + defense weights + the traced fault drop rate) through
+    # the sequential step and the knob-batched sweep runner
+    # (gossip_run_knob_batch), donation + no-64-bit on the stacked
+    # scalar operands
+    for batched in (False, True):
+        out.append(dict(sim="gossipsub", split=False, telemetry=False,
+                        faults=True, batched=batched,
+                        variant="knobs"))
     return out
 
 
@@ -307,6 +317,41 @@ def build_cases() -> list[AuditCase]:
                 runner = gs.gossip_run_tournament
             else:
                 params, state = build_attack(0)
+                runner = gs.gossip_run
+            args, statics = (params, state, TICKS, step), (2, 3)
+
+        elif variant == "knobs":
+            # the round-12 sweep surface: HETEROGENEOUS SimKnobs
+            # points (distinct degree/coverage/backoff/defense/fault
+            # values per replica) under one step — the scenario-server
+            # workload (tools/sweepd.py)
+            cfg = gs.GossipSimConfig(
+                offsets=gs.make_gossip_offsets(T, C, N, seed=1),
+                n_topics=T, d=3, d_lo=2, d_hi=6, d_score=2, d_out=1,
+                d_lazy=2, backoff_ticks=8)
+            sc = gs.ScoreSimConfig()
+            subs, topic, origin, ticks = _sim_inputs(T)
+
+            def build_knob(r):
+                return gs.make_gossip_sim(
+                    cfg, subs, topic, origin, ticks, seed=r,
+                    score_cfg=sc,
+                    fault_schedule=audit_fault_schedule(r),
+                    sim_knobs={"d": 3 + r, "d_lazy": 2 + r,
+                               "gossip_factor": 0.25 + 0.25 * r,
+                               "backoff_ticks": 8 + r,
+                               "drop_prob": 0.05 * (r + 1),
+                               "behaviour_penalty_weight":
+                                   -10.0 * (r + 1)})
+
+            step = gs.make_gossip_step(cfg, sc)
+            if b:
+                builds = [build_knob(r) for r in range(BATCH)]
+                params = gs.stack_trees([p for p, _ in builds])
+                state = gs.stack_trees([s for _, s in builds])
+                runner = gs.gossip_run_knob_batch
+            else:
+                params, state = build_knob(0)
                 runner = gs.gossip_run
             args, statics = (params, state, TICKS, step), (2, 3)
 

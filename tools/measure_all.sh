@@ -160,7 +160,7 @@ run 2700 python bench_suite.py gossipsub_tournament \
 echo "=== tourneystat --check gate ===" | tee -a "$log"
 env JAX_PLATFORMS=cpu python tools/tourneystat.py \
     /tmp/gossipsub_tournament.json \
-    --check TOURNEY_r11.json 2>&1 | tee -a "$log"
+    --check TOURNEY_r12.json 2>&1 | tee -a "$log"
 trc=${PIPESTATUS[0]}
 if [ "$trc" -eq 2 ]; then
   echo "!! tourneystat gate failed — unusable tournament artifact" \
@@ -172,6 +172,29 @@ elif [ "$trc" -ne 0 ]; then
       "or a cell reported an invariant violation" | tee -a "$log"
   sync_log
   exit 6
+fi
+# 4e. sweep engine (round 12): the resident scenario server's serving
+# row — >= 20 distinct protocol/fault/attack configs from ONE compiled
+# executable, heterogeneous sweep within 2x of the seed-batch row —
+# plus the kernel-path sequential twin, then the sweepstat gate over
+# the artifact the bench just wrote (configs-per-compile and
+# throughput vs the committed SWEEP_r12.json)
+run 2700 python bench_suite.py gossipsub_sweepd gossipsub_sweepd_kernel
+echo "=== sweepstat --check gate ===" | tee -a "$log"
+env JAX_PLATFORMS=cpu python tools/sweepstat.py \
+    /tmp/gossipsub_sweepd.json \
+    --check SWEEP_r12.json 2>&1 | tee -a "$log"
+src=${PIPESTATUS[0]}
+if [ "$src" -eq 2 ]; then
+  echo "!! sweepstat gate failed — unusable sweep artifact" \
+      "(bench crashed or wrote a truncated file?)" | tee -a "$log"
+  sync_log
+  exit 7
+elif [ "$src" -ne 0 ]; then
+  echo "!! sweepstat gate failed — configs-per-compile or sweep" \
+      "throughput regressed" | tee -a "$log"
+  sync_log
+  exit 7
 fi
 # 5. GSPMD overhead + diagnostics
 run 1800 python tools/bench_sharded.py

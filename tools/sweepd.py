@@ -1,0 +1,496 @@
+#!/usr/bin/env python
+"""sweepd: the resident, recompile-free scenario server (round 12).
+
+The production-serving face of the config-as-data sweep engine
+(models/knobs.py): ONE process compiles ONE executable for a fixed
+simulation shape, then serves an open-ended stream of scenario
+requests — parameter studies, attack tournaments, CI regression
+sweeps — at full device utilization with ZERO further compiles.  Every
+request is pure data (a SimKnobs protocol point, a fault rate, an
+attack formation, a seed); requests are validated, bucket-batched into
+the fixed-shape ``gossip_run_knob_batch`` dispatch (padding partial
+batches with the reference scenario), and answered with per-scenario
+delivery / invariant metric rows.
+
+Protocol: JSON lines on stdin (default) or a Unix socket (--socket).
+One scenario request per line:
+
+    {"id": "s1", "knobs": {"d": 8, "gossip_factor": 0.4},
+     "drop_prob": 0.02, "churn": true,
+     "attack": "spam", "attack_frac": 0.1, "seed": 3}
+
+Every field except ``id`` is optional; ``knobs`` takes any liftable
+protocol/defense knob (models/knobs.py SIM_KNOB_FIELDS + the ScoreKnobs
+fields) — shape-bearing fields are rejected by name with the reason
+they must stay static (KnobStaticFieldError; the error comes back as
+the scenario's result row, it never kills the server).  Control lines:
+``{"cmd": "flush"}`` dispatches a partial batch immediately,
+``{"cmd": "stats"}`` emits the counters row.  EOF flushes and exits.
+
+Result rows (one JSON line per scenario, in completion order):
+
+    {"id": "s1", "ok": true, "delivery_fraction": 0.98,
+     "honest_delivery_fraction": 0.99, "inv_bits": 0, "batch": 0}
+
+Counters (``stats`` / final line): requests served, batches
+dispatched, COMPILES (the jit cache size of the batched runner — the
+whole point: it stays 1), replica heartbeats/s, wall seconds.
+
+Import surface: ``SweepServer`` is the embeddable engine —
+bench_suite's ``gossipsub_sweepd`` row and tests drive it in-process;
+``main()`` wraps it in the line protocol.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")  # graftlint: ignore[sys-path-insert]
+
+import numpy as np  # noqa: E402
+
+#: scenario attack kinds (the tournament's formation axis; "clean" is
+#: the no-attack control)
+ATTACK_KINDS = ("clean", "spam", "eclipse", "byzantine")
+
+
+class SweepServer:
+    """The resident engine: one compiled executable, arbitrary
+    scenarios.
+
+    The static surface is fixed at construction (peer count, topics,
+    message schedule, candidate ring, batch size, attack/victim/churn
+    pools, invariant arming, execution path); everything else arrives
+    as request data.  All attack behaviors are compiled in (the
+    tournament's static-config trick) and selected per scenario by
+    flag arrays, so a batch may mix clean sweeps with attacked cells.
+    """
+
+    def __init__(self, n: int = 10_000, t: int = 10, m: int = 16,
+                 ticks: int = 100, batch: int = 6,
+                 n_candidates: int = 16, seed: int = 0,
+                 invariants: bool = True, kernel: bool = False,
+                 receive_block: int = 128, interpret: bool = True,
+                 attack_pool_frac: float = 0.2,
+                 victim_pool_frac: float = 0.1,
+                 churn_pool_frac: float = 0.1):
+        import go_libp2p_pubsub_tpu.models.gossipsub as gs
+        import go_libp2p_pubsub_tpu.models.invariants as iv
+        from go_libp2p_pubsub_tpu.models.tournament import (
+            tournament_static_config)
+
+        self.gs, self.iv = gs, iv
+        self.n, self.t, self.m, self.ticks = n, t, m, ticks
+        self.batch = batch
+        self.kernel = kernel
+        if kernel and batch != 1:
+            # the pallas kernel has no vmap rule: the kernel-path
+            # server is the SEQUENTIAL zero-recompile demonstration
+            raise ValueError(
+                "kernel-path sweepd serves scenarios sequentially "
+                "(no vmap rule for the pallas step): use batch=1")
+        rng = np.random.default_rng(seed)
+        offsets = gs.make_gossip_offsets(t, n_candidates, n, seed=seed)
+        if kernel:
+            # the pallas step refuses two of the tournament's armed
+            # behaviors with knobs: sybil_iwant_spam (the in-kernel
+            # serve budget bakes gossip_retransmission — the one
+            # XLA-only knob) and byzantine_mutation (per-edge content
+            # corruption needs the split loops).  The kernel server
+            # arms the rest; its attack axis shrinks accordingly.
+            self.cfg = gs.GossipSimConfig(offsets=offsets, n_topics=t)
+            self.sc = gs.ScoreSimConfig(sybil_ihave_spam=True,
+                                        sybil_eclipse=True)
+            self.attack_kinds = ("clean", "spam", "eclipse")
+        else:
+            self.cfg, self.sc = tournament_static_config(offsets, t)
+            self.attack_kinds = ATTACK_KINDS
+        self.invariants = (iv.InvariantConfig() if invariants
+                           else None)
+        step_kw = {}
+        self.sim_fixed_kw = {}
+        if kernel:
+            self.sim_fixed_kw["pad_to_block"] = receive_block
+            step_kw = dict(receive_block=receive_block,
+                           receive_interpret=interpret)
+        self.step = gs.make_gossip_step(self.cfg, self.sc,
+                                        invariants=self.invariants,
+                                        **step_kw)
+
+        # fixed peer-role pools: scenario attack_frac selects a PREFIX
+        # of the attacker pool, so formations stay data under one shape
+        self.attack_pool = np.zeros(n, dtype=bool)
+        self.attack_pool[: int(n * attack_pool_frac)] = True
+        self.victims = np.zeros(n, dtype=bool)
+        self.victims[int(n * attack_pool_frac):
+                     int(n * (attack_pool_frac
+                              + victim_pool_frac))] = True
+        pool = np.flatnonzero(~self.attack_pool & ~self.victims)
+        # fixed message schedule from never-attacker origins, publishes
+        # inside the first 60% of the horizon
+        origin = pool[rng.integers(0, len(pool), m)]
+        self.topic = (origin % t).astype(np.int64)
+        self.origin = origin
+        self.pub_tick = np.sort(
+            rng.integers(0, max(1, int(ticks * 0.6)), m)
+        ).astype(np.int32)
+        self.subs = np.zeros((n, t), dtype=bool)
+        self.subs[np.arange(n), np.arange(n) % t] = True
+        # fixed churner set; scenario "churn" toggles live intervals
+        # vs (p, 0, 0) no-ops so every replica's [N, K] table shares
+        # one shape (the FaultSchedule padding contract)
+        churners = pool[rng.random(len(pool)) < churn_pool_frac]
+        lo = max(1, int(ticks * 0.3))
+        self._churn_ivs = tuple(
+            (int(p), min(lo + int(p % 3) * 4, ticks),
+             min(lo + 8 + int(p % 3) * 4, ticks))
+            for p in churners)
+        self._noop_ivs = tuple((int(p), 0, 0) for p in churners)
+        self._zeros = np.zeros(n, dtype=bool)
+        self.members = np.arange(n) % t
+
+        # counters
+        self.served = 0
+        self.batches = 0
+        self.errors = 0
+        self.wall_s = 0.0
+        self._pending: list[dict] = []
+        self._t0 = time.perf_counter()
+        # the runner's jit cache is process-global (other shapes /
+        # servers share it): THIS server's compile count is the
+        # cache-size delta since construction
+        self._cache_base = self._runner()._cache_size()
+
+    # -- request validation / build ------------------------------------
+
+    def _build_kwargs(self, req: dict) -> dict:
+        """make_gossip_sim kwargs for one validated request.  Raises
+        ValueError (incl. KnobStaticFieldError) naming the bad field —
+        the caller turns it into an error row."""
+        from go_libp2p_pubsub_tpu.models import knobs as kn
+
+        known = {"id", "cmd", "seed", "knobs", "drop_prob", "churn",
+                 "attack", "attack_frac"}
+        unknown = set(req) - known
+        if unknown:
+            raise ValueError(
+                f"scenario: unknown field(s) {sorted(unknown)} — "
+                f"valid fields are {sorted(known)}")
+        raw_knobs = req.get("knobs") or {}
+        if not isinstance(raw_knobs, dict):
+            raise ValueError(
+                "scenario: knobs must be a JSON object, got "
+                f"{type(raw_knobs).__name__}")
+        knobs = dict(raw_knobs)
+        # static-field rejection up front (named reason), so the error
+        # row carries the KnobStaticFieldError message; the fault
+        # split also catches drop_prob NESTED in knobs (valid — it IS
+        # a knob) so it cannot be silently clobbered by the top-level
+        # default below
+        _, _, fault_kv = kn.split_knob_overrides(knobs)
+        if "drop_prob" in req and "drop_prob" in fault_kv:
+            raise ValueError(
+                "scenario: drop_prob given both top-level and inside "
+                "knobs — pick one")
+        drop = float(fault_kv.get("drop_prob",
+                                  req.get("drop_prob", 0.0)))
+        if not (0.0 <= drop <= 1.0):
+            raise ValueError(f"scenario: drop_prob={drop} outside "
+                             "[0, 1]")
+        knobs["drop_prob"] = drop
+        attack = req.get("attack", "clean")
+        if attack not in self.attack_kinds:
+            raise ValueError(
+                f"scenario: unknown attack {attack!r} — this "
+                f"server's kinds are {self.attack_kinds}"
+                + (" (byzantine is XLA-only: the kernel elides the "
+                   "per-edge loops it needs)"
+                   if attack in ATTACK_KINDS else ""))
+        frac = float(req.get("attack_frac",
+                             0.0 if attack == "clean" else 0.1))
+        pool_frac = self.attack_pool.mean()
+        if not (0.0 <= frac <= pool_frac):
+            raise ValueError(
+                f"scenario: attack_frac={frac} outside [0, "
+                f"{pool_frac}] (the server's attacker pool)")
+        attackers = self._zeros
+        if attack != "clean" and frac > 0:
+            attackers = np.zeros(self.n, dtype=bool)
+            attackers[: int(self.n * frac)] = True
+        churn = bool(req.get("churn", False))
+        # the placeholder schedule rate is irrelevant: the traced
+        # drop_prob knob overrides it (0.0 = no drops at run time);
+        # it only needs to be nonzero so the link path compiles in
+        import go_libp2p_pubsub_tpu.models.faults as fl
+        sched = fl.FaultSchedule(
+            n_peers=self.n, horizon=self.ticks,
+            down_intervals=(self._churn_ivs if churn
+                            else self._noop_ivs),
+            drop_prob=0.5, seed=int(req.get("seed", 0)))
+        return dict(
+            subs=self.subs, msg_topic=self.topic,
+            msg_origin=self.origin, msg_publish_tick=self.pub_tick,
+            seed=int(req.get("seed", 0)), track_first_tick=False,
+            sybil=(attackers if attack == "spam" else self._zeros),
+            eclipse_sybil=(attackers if attack == "eclipse"
+                           else self._zeros),
+            eclipse_victim=(self.victims if attack == "eclipse"
+                            else self._zeros),
+            byzantine=(None if "byzantine" not in self.attack_kinds
+                       else (attackers if attack == "byzantine"
+                             else self._zeros)),
+            fault_schedule=sched, sim_knobs=knobs,
+            **self.sim_fixed_kw)
+
+    # -- dispatch ------------------------------------------------------
+
+    def submit(self, requests: list[dict]) -> list[dict]:
+        """Validate + serve a list of scenario requests; returns one
+        result row per request (order preserved).  Invalid requests
+        come back as ``{"id", "ok": false, "error"}`` rows without
+        poisoning the rest of their batch."""
+        gs = self.gs
+        rows: list[dict | None] = [None] * len(requests)
+        good: list[tuple[int, dict, dict]] = []
+        for i, req in enumerate(requests):
+            if not isinstance(req, dict):
+                self.errors += 1
+                rows[i] = {"id": i, "ok": False,
+                           "error": "scenario: request must be a "
+                                    f"JSON object, got "
+                                    f"{type(req).__name__}"}
+                continue
+            try:
+                good.append((i, req, self._build_kwargs(req)))
+            except (ValueError, TypeError) as e:
+                # TypeError covers wrong-TYPED fields in well-formed
+                # JSON ({"knobs": [1, 2]}, {"seed": {}}): one bad
+                # scenario must never poison its batch or the server
+                self.errors += 1
+                rows[i] = {"id": req.get("id", i), "ok": False,
+                           "error": str(e)}
+        for lo in range(0, len(good), max(self.batch, 1)):
+            chunk = good[lo:lo + self.batch]
+            pad = self.batch - len(chunk)
+            kwargs = [kw for _, _, kw in chunk]
+            # pad partial batches with the reference scenario so the
+            # dispatch shape (and so the executable) never changes
+            kwargs += [self._build_kwargs({})] * pad
+            t0 = time.perf_counter()
+            builds = [gs.make_gossip_sim(self.cfg, score_cfg=self.sc,
+                                         **kw) for kw in kwargs]
+            states = [b[1] for b in builds]
+            if self.invariants is not None:
+                states = [self.iv.attach(s) for s in states]
+            honest = np.stack(
+                [~(np.asarray(kw["sybil"]) | np.asarray(
+                    kw["eclipse_sybil"])
+                   | (np.asarray(kw["byzantine"])
+                      if kw["byzantine"] is not None else False))
+                 for kw in kwargs])
+            if self.batch == 1:
+                stateB, reach = _run_single_fn()(
+                    builds[0][0], states[0], self.ticks, self.step,
+                    honest[0])
+                reach = np.asarray(reach)[None]
+                inv_bits = (np.asarray(stateB.inv_viol)[None]
+                            if self.invariants is not None else None)
+                inv_first = (np.asarray(stateB.inv_first)[None]
+                             if self.invariants is not None else None)
+            else:
+                params = gs.stack_trees([b[0] for b in builds])
+                state = gs.stack_trees(states)
+                stateB, reach = gs.gossip_run_knob_batch(
+                    params, state, self.ticks, self.step, honest)
+                reach = np.asarray(reach)
+                inv_bits = (np.asarray(stateB.inv_viol)
+                            if self.invariants is not None else None)
+                inv_first = (np.asarray(stateB.inv_first)
+                             if self.invariants is not None else None)
+            self.wall_s += time.perf_counter() - t0
+            self.batches += 1
+            want_all = np.array(
+                [(self.members == tau).sum() for tau in self.topic],
+                dtype=np.float64)
+            for k, (i, req, kw) in enumerate(chunk):
+                honest_row = honest[k]
+                want = np.array(
+                    [(honest_row & (self.members == tau)).sum()
+                     for tau in self.topic], dtype=np.float64)
+                row = {
+                    "id": req.get("id", i), "ok": True,
+                    "batch": self.batches - 1,
+                    "honest_delivery_fraction":
+                        round(float((reach[k] / want).mean()), 4),
+                    "delivery_fraction":
+                        round(float((reach[k] / want_all).mean()), 4),
+                }
+                if inv_bits is not None:
+                    row["inv_bits"] = int(inv_bits[k])
+                    row["inv_first"] = int(inv_first[k])
+                rows[i] = row
+                self.served += 1
+        return rows  # type: ignore[return-value]
+
+    # -- counters ------------------------------------------------------
+
+    def _runner(self):
+        return (_run_single_fn() if self.batch == 1
+                else self.gs.gossip_run_knob_batch)
+
+    def compiles(self) -> int:
+        """Number of executables THIS server compiled (the batched
+        runner's jit-cache growth since construction) — the
+        zero-recompile claim is ``compiles() == 1`` after any number
+        of scenarios."""
+        return self._runner()._cache_size() - self._cache_base
+
+    def stats(self) -> dict:
+        dev = self.wall_s
+        return {
+            "stats": True, "served": self.served,
+            "batches": self.batches, "errors": self.errors,
+            "compiles": self.compiles(),
+            "configs_per_compile":
+                round(self.served / max(self.compiles(), 1), 2),
+            "replica_hbps": round(
+                self.served * self.ticks / dev, 2) if dev else None,
+            "requests_per_sec": round(
+                self.served / dev, 3) if dev else None,
+            "wall_s": round(time.perf_counter() - self._t0, 2),
+            "device_s": round(dev, 2),
+            "shape": {"n": self.n, "t": self.t, "m": self.m,
+                      "ticks": self.ticks, "batch": self.batch,
+                      "kernel": self.kernel},
+        }
+
+    # -- line protocol -------------------------------------------------
+
+    def serve_lines(self, lines, out) -> None:
+        """Drive the server from an iterable of JSON lines, writing
+        result rows to ``out`` (a writable file object).  Requests
+        accumulate to full batches; ``{"cmd": "flush"}`` dispatches a
+        partial batch, ``{"cmd": "stats"}`` emits counters.  EOF
+        flushes."""
+        def emit(obj):
+            out.write(json.dumps(obj) + "\n")
+            out.flush()
+
+        def flush():
+            if self._pending:
+                reqs = list(self._pending)
+                self._pending.clear()
+                for row in self.submit(reqs):
+                    emit(row)
+
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line)
+            except json.JSONDecodeError as e:
+                self.errors += 1
+                emit({"ok": False, "error": f"bad JSON: {e}"})
+                continue
+            if not isinstance(req, dict):
+                self.errors += 1
+                emit({"ok": False,
+                      "error": "request must be a JSON object, got "
+                               f"{type(req).__name__}"})
+                continue
+            cmd = req.get("cmd")
+            if cmd == "flush":
+                flush()
+            elif cmd == "stats":
+                flush()
+                emit(self.stats())
+            elif cmd:
+                self.errors += 1
+                emit({"ok": False,
+                      "error": f"unknown cmd {cmd!r} (flush/stats)"})
+            else:
+                self._pending.append(req)
+                if len(self._pending) >= self.batch:
+                    flush()
+        flush()
+        emit(self.stats())
+
+
+def _make_run_single():
+    """batch=1 runner (the kernel-path server): same contract as
+    gossip_run_knob_batch — donated carry, in-dispatch honest-masked
+    reach — without the vmap the pallas step lacks a rule for.  One
+    module-level jit so its cache size IS the compile counter."""
+    import jax
+    from functools import partial
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+
+    @partial(jax.jit, static_argnums=(2, 3), donate_argnums=(1,))
+    def _run_single(params, state, n_ticks, step, honest):
+        def body(s, _):
+            return step(params, s)[0], None
+        state, _ = jax.lax.scan(body, state, None, length=n_ticks)
+        return state, gs.reach_counts_from_have(params, state, honest)
+    return _run_single
+
+
+_RUN_SINGLE = None
+
+
+def _run_single_fn():
+    """Lazy singleton for the batch=1 runner (keeps import jax-free
+    until a kernel-path server actually dispatches)."""
+    global _RUN_SINGLE
+    if _RUN_SINGLE is None:
+        _RUN_SINGLE = _make_run_single()
+    return _RUN_SINGLE
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="sweepd", description=__doc__)
+    ap.add_argument("--peers", type=int, default=10_000)
+    ap.add_argument("--topics", type=int, default=10)
+    ap.add_argument("--msgs", type=int, default=16)
+    ap.add_argument("--ticks", type=int, default=100)
+    ap.add_argument("--batch", type=int, default=6)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--no-invariants", action="store_true")
+    ap.add_argument("--kernel", action="store_true",
+                    help="pallas-kernel path (sequential, batch=1)")
+    ap.add_argument("--socket", metavar="PATH",
+                    help="serve a Unix socket instead of stdin")
+    ns = ap.parse_args(argv)
+
+    srv = SweepServer(n=ns.peers, t=ns.topics, m=ns.msgs,
+                      ticks=ns.ticks,
+                      batch=(1 if ns.kernel else ns.batch),
+                      seed=ns.seed, invariants=not ns.no_invariants,
+                      kernel=ns.kernel)
+    if ns.socket:
+        import socket as sk
+        import os
+        try:
+            os.unlink(ns.socket)
+        except FileNotFoundError:
+            pass
+        with sk.socket(sk.AF_UNIX, sk.SOCK_STREAM) as server_sock:
+            server_sock.bind(ns.socket)
+            server_sock.listen(1)
+            print(f"sweepd: listening on {ns.socket}",
+                  file=sys.stderr, flush=True)
+            while True:
+                conn, _ = server_sock.accept()
+                with conn, conn.makefile("r") as rf, \
+                        conn.makefile("w") as wf:
+                    srv.serve_lines(rf, wf)
+    else:
+        srv.serve_lines(sys.stdin, sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

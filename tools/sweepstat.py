@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""sweepstat: inspect a sweep-engine bench artifact and gate
+regressions against a committed baseline.
+
+    python tools/sweepstat.py /tmp/gossipsub_sweepd.json
+    python tools/sweepstat.py /tmp/gossipsub_sweepd.json \
+        --check SWEEP_r12.json [--ratio-slack 2.0] [--hbps-slack 0.5]
+
+Prints the per-scenario delivery table and the serving counters.
+Exit codes (tracestat/tourneystat --check convention):
+
+  0  clean
+  1  regression: a failed or invariant-violating scenario row, fewer
+     configs served per compile than the baseline (the engine started
+     recompiling), the heterogeneous-sweep wall-clock exceeding the
+     same-shape seed-batch row by more than the 2x contract, or (with
+     --check) replica throughput dropping more than ``--hbps-slack``
+     below the committed baseline
+  2  unusable input: missing/unparseable artifact, no scenario rows,
+     or no compile counter (the zero-recompile claim can't be checked)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load(path: str) -> dict:
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"sweepstat: cannot read {path}: {e}", file=sys.stderr)
+        raise SystemExit(2)
+    if not obj.get("rows"):
+        print(f"sweepstat: {path} carries no scenario rows",
+              file=sys.stderr)
+        raise SystemExit(2)
+    if not obj.get("compiles"):
+        print(f"sweepstat: {path} carries no compile counter — the "
+              "zero-recompile claim cannot be checked", file=sys.stderr)
+        raise SystemExit(2)
+    return obj
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="sweepstat",
+                                 description=__doc__)
+    ap.add_argument("artifact")
+    ap.add_argument("--check", metavar="BASELINE",
+                    help="committed baseline artifact to gate against")
+    ap.add_argument("--ratio-slack", type=float, default=2.0,
+                    help="max heterogeneous-sweep / seed-batch "
+                         "wall-clock ratio (default 2.0 — the "
+                         "acceptance contract)")
+    ap.add_argument("--hbps-slack", type=float, default=0.5,
+                    help="allowed fractional replica-throughput drop "
+                         "vs baseline (default 0.5; CPU/TPU passes "
+                         "share one artifact schema)")
+    ns = ap.parse_args(argv)
+
+    cur = load(ns.artifact)
+    rc = 0
+    shape = cur.get("shape", {})
+    print(f"sweepd: {shape.get('n')} peers x {shape.get('t')} topics, "
+          f"{cur.get('configs_served')} configs in "
+          f"{cur.get('batches')} batches of {shape.get('batch')}, "
+          f"{shape.get('ticks')} ticks")
+    for row in cur["rows"]:
+        if not row.get("ok"):
+            print(f"  {str(row.get('id')):<16s} FAILED: "
+                  f"{row.get('error')}")
+            continue
+        extra = ""
+        if row.get("inv_bits", 0):
+            extra = (f"  INVARIANT-VIOLATION bits="
+                     f"{row['inv_bits']:#x} first="
+                     f"{row.get('inv_first')}")
+        print(f"  {str(row.get('id')):<16s} "
+              f"honest_delivery={row['honest_delivery_fraction']:.4f}"
+              f"{extra}")
+    print(f"compiles={cur['compiles']} configs_per_compile="
+          f"{cur.get('configs_per_compile')} replica_hbps="
+          f"{cur.get('replica_hbps')} sweep_vs_seed_ratio="
+          f"{cur.get('sweep_vs_seed_ratio')}")
+
+    bad = [r for r in cur["rows"]
+           if not r.get("ok") or r.get("inv_bits", 0)]
+    if bad:
+        print(f"sweepstat: {len(bad)} scenario row(s) failed or "
+              "violated invariants", file=sys.stderr)
+        rc = 1
+    ratio = cur.get("sweep_vs_seed_ratio")
+    if ratio is not None and ratio > ns.ratio_slack:
+        print(f"sweepstat: heterogeneous sweep is {ratio:.2f}x the "
+              f"seed-batch wall-clock (> {ns.ratio_slack}x contract)",
+              file=sys.stderr)
+        rc = 1
+
+    if ns.check:
+        base = load(ns.check)
+        cpc_cur = cur.get("configs_per_compile", 0)
+        cpc_base = base.get("configs_per_compile", 0)
+        if cpc_cur < cpc_base:
+            print(f"sweepstat: configs-per-compile regressed: "
+                  f"{cpc_cur} < baseline {cpc_base} (the engine is "
+                  "recompiling across scenarios)", file=sys.stderr)
+            rc = 1
+        hb_cur, hb_base = (cur.get("replica_hbps"),
+                           base.get("replica_hbps"))
+        if hb_cur is not None and hb_base:
+            floor = hb_base * (1.0 - ns.hbps_slack)
+            verdict = "OK" if hb_cur >= floor else "REGRESSED"
+            print(f"check: replica_hbps {hb_cur:.2f} vs baseline "
+                  f"{hb_base:.2f} (floor {floor:.2f}) -> {verdict}")
+            if hb_cur < floor:
+                rc = 1
+        missing = (set(map(str, base.get("scenario_ids", [])))
+                   - set(str(r.get("id")) for r in cur["rows"]))
+        if missing:
+            print("sweepstat: scenario coverage shrank vs baseline: "
+                  f"missing {sorted(missing)}", file=sys.stderr)
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

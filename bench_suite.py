@@ -51,6 +51,15 @@ suite is the full matrix for tracking all baseline configs.)
                    kernel twin serves sequentially through the pallas
                    step (no vmap rule) with the same zero-recompile
                    counter, alias-paired to the XLA row
+  gossipsub_pipelined
+                   round 13: the event-driven-time sweep
+                   (models/delays.py) — delay_base {1, 2, 4} (+ a
+                   jittered point) through ONE knob-batched compiled
+                   executable at 100k peers with the K=8 delay line
+                   and the device latency histogram on; commits the
+                   delivery-latency percentile curves (DELAY_r13.json
+                   / the delaystat gate, measure_all step 4f) — the
+                   pipelined-gossip picture vs the one-hop baseline
 
 Usage: python bench_suite.py [config ...]   (default: all)
 """
@@ -1151,6 +1160,113 @@ def bench_gossipsub_sweepd_kernel():
          extra={"alias_of": name})
 
 
+def bench_gossipsub_pipelined():
+    """Round 13: the pipelined-gossip regime (models/delays.py,
+    ROADMAP direction 3; "The Algorithm of Pipelined Gossiping" /
+    OPTIMUMP2P, PAPERS.md).  ONE knob-batched dispatch sweeps the
+    heartbeat/RTT ratio — delay_base in {1, 2, 4} plus a jittered
+    point — over the 100k v1.1 config with the K=8 delay line and the
+    device-side latency histogram on.  The ``base1`` row is the
+    one-hop pre-delay baseline (bit-identical to the round-12 step,
+    pinned by tests/test_delays.py); the delayed rows commit the
+    FIRST genuinely multi-bucket delivery-latency percentile curves.
+    The pipelined picture: per-hop delay stretches the latency
+    distribution ~linearly (p50/p99 ≈ base x the one-hop curve)
+    while the pipeline keeps delivering (delivery fraction holds) —
+    the delay sweep itself compiles ONE executable (delay_base/
+    delay_jitter are traced SimKnobs leaves).  Writes
+    /tmp/gossipsub_pipelined.json for ``delaystat --check``
+    (measure_all step 4f)."""
+    import jax
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    import go_libp2p_pubsub_tpu.models.telemetry as tl
+    from go_libp2p_pubsub_tpu.histutil import hist_percentiles
+    from go_libp2p_pubsub_tpu.models.delays import DelayConfig
+
+    n, t, m, ticks, K = 100_000, 100, 24, 48, 8
+    rng = np.random.default_rng(0)
+    subs = _subs_matrix(n, t)
+    topic, origin, pub = _msgs(rng, n, t, m, 8)
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, 16, n, seed=7), n_topics=t)
+    sc = gs.ScoreSimConfig()
+    tcfg = tl.TelemetryConfig(counters=False, wire=False, mesh=False,
+                              scores=False, faults=False,
+                              latency_hist=True, latency_buckets=ticks)
+    dc = DelayConfig(base=1, jitter=0, k_slots=K)
+    points = [("base1", {"delay_base": 1}),
+              ("base2", {"delay_base": 2}),
+              ("base4", {"delay_base": 4}),
+              ("base4j2", {"delay_base": 4, "delay_jitter": 2})]
+    builds = [gs.make_gossip_sim(subs=subs, msg_topic=topic,
+                                 msg_origin=origin,
+                                 msg_publish_tick=pub, seed=3,
+                                 cfg=cfg, score_cfg=sc, delays=dc,
+                                 track_first_tick=False,
+                                 sim_knobs=kv)
+              for _, kv in points]
+    params = gs.stack_trees([p for p, _ in builds])
+    state = gs.stack_trees([s for _, s in builds])
+    step = gs.make_gossip_step(cfg, sc, telemetry=tcfg)
+    runner = tl.telemetry_run_batch
+    cache0 = runner._cache_size()
+    t0 = time.perf_counter()
+    state_b, frames = runner(params, state, ticks, step)
+    jax.block_until_ready(state_b.have)
+    dt = time.perf_counter() - t0
+    compiles = runner._cache_size() - cache0
+    hists = np.asarray(
+        tl.frames_to_arrays(frames)["latency_hist"]).sum(0)  # [B, L]
+    reach = np.asarray(jax.vmap(
+        lambda p, s: gs.reach_counts_from_have(p, s))(params,
+                                                      state_b))
+    per_topic = n // t
+    rows = []
+    for i, (rid, kv) in enumerate(points):
+        lat = hist_percentiles(hists[i])
+        rows.append({
+            "id": rid,
+            "delay_base": int(kv.get("delay_base", 1)),
+            "delay_jitter": int(kv.get("delay_jitter", 0)),
+            "delivery_fraction": round(
+                float(reach[i].mean()) / per_topic, 4),
+            "latency": lat,
+            "hist": [int(c) for c in hists[i]],
+        })
+    base_row = rows[0]
+    for row in rows:
+        # the pipelined contract, enforced HERE too (delaystat
+        # re-checks the committed artifact): delay stretches latency,
+        # it must not lose traffic
+        assert (row["delivery_fraction"]
+                >= base_row["delivery_fraction"] - 0.05), rows
+        if row["delay_base"] > 1:
+            assert sum(1 for c in row["hist"] if c) >= 2, row
+    assert compiles <= 1, f"delay sweep recompiled: {compiles}"
+    art = {
+        "round": 13,
+        "shape": {"n": n, "t": t, "m": m, "ticks": ticks,
+                  "k_slots": K},
+        "compiles": int(compiles),
+        "wall_s": round(dt, 2),
+        "replica_hbps": round(len(points) * ticks / dt, 2),
+        "rows": rows,
+    }
+    with open("/tmp/gossipsub_pipelined.json", "w") as f:
+        json.dump(art, f, indent=1)
+    name = f"gossipsub_pipelined_{n}peers_replica_heartbeats_per_sec"
+    emit(name, art["replica_hbps"], "heartbeats/s",
+         extra={"points": [r["id"] for r in rows],
+                "compiles": int(compiles),
+                "p99_by_base": {r["id"]: r["latency"]["p99"]
+                                for r in rows}})
+    emit("gossipsub_pipelined_p99_stretch_base4",
+         rows[2]["latency"]["p99"]
+         / max(base_row["latency"]["p99"], 1), "x",
+         extra={"base1_p99": base_row["latency"]["p99"],
+                "base4_p99": rows[2]["latency"]["p99"]})
+
+
 BENCHES = {
     "floodsub_hosts": bench_floodsub_hosts,
     "randomsub_10k": bench_randomsub_10k,
@@ -1171,6 +1287,7 @@ BENCHES = {
     "gossipsub_invariants_kernel": bench_gossipsub_invariants_kernel,
     "gossipsub_sweepd": bench_gossipsub_sweepd,
     "gossipsub_sweepd_kernel": bench_gossipsub_sweepd_kernel,
+    "gossipsub_pipelined": bench_gossipsub_pipelined,
 }
 
 

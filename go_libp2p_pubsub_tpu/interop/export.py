@@ -488,7 +488,9 @@ def _ids_of(words_col: np.ndarray, n_msgs: int) -> list[int]:
 def rpc_events(rpc_snaps: dict, offsets, msg_topic: np.ndarray,
                peer_topic: np.ndarray, start_tick: int = 0,
                n_true: int | None = None,
-               topic_name=lambda t: f"topic-{t}"):
+               topic_name=lambda t: f"topic-{t}",
+               peer_topic_b: np.ndarray | None = None,
+               slot_b_words: np.ndarray | None = None):
     """Per-edge RPC probe snapshots -> SEND_RPC / RECV_RPC / DROP_RPC
     TraceEvents with full RPCMeta (reference trace.proto types 6/7/8).
 
@@ -524,7 +526,16 @@ def rpc_events(rpc_snaps: dict, offsets, msg_topic: np.ndarray,
     round-10 refusal): a ``flood``-targeted edge carries the sender's
     own due publishes (``inj``) in its RPC — on flood-only edges those
     are the whole payload, on mesh edges they were already inside the
-    fresh set."""
+    fresh set.
+
+    PAIRED-TOPIC overlays (round 13 — the lifted refusal): snapshots
+    carrying the per-slot fields (``fwd_b`` / ``graft_b`` /
+    ``prune_b`` / ``fresh_a`` / ``fresh_b``) reconstruct both topic
+    slots — slot-B mesh forwards merge into the same edge RPC,
+    GRAFT/PRUNE metas carry each slot's own topic, and with
+    ``slot_b_words`` (GossipParams.slot_b_words, uint32 [W, N]) the
+    merged IHAVE splits into per-topic entries; pass
+    ``peer_topic_b`` (each peer's SECOND topic)."""
     offs = tuple(int(o) for o in offsets)
     fwd = np.asarray(rpc_snaps["fwd"])
     ihave = np.asarray(rpc_snaps["ihave"])
@@ -540,6 +551,23 @@ def rpc_events(rpc_snaps: dict, offsets, msg_topic: np.ndarray,
     flood = (np.asarray(rpc_snaps["flood"])
              if "flood" in rpc_snaps else None)
     inj = np.asarray(rpc_snaps["inj"]) if "inj" in rpc_snaps else None
+    # round-13 paired-slot fields
+    paired = "fwd_b" in rpc_snaps
+    if paired:
+        if peer_topic_b is None:
+            raise ValueError(
+                "rpc_events: paired-topic snapshots need "
+                "peer_topic_b (each peer's second topic slot)")
+        fwd_b = np.asarray(rpc_snaps["fwd_b"])
+        graft_b = np.asarray(rpc_snaps["graft_b"])
+        prune_b = np.asarray(rpc_snaps["prune_b"])
+        fresh_a = np.asarray(rpc_snaps["fresh_a"])
+        fresh_b = np.asarray(rpc_snaps["fresh_b"])
+    else:
+        fwd_b = graft_b = prune_b = fresh_b = None
+        fresh_a = fresh
+    slot_b = (None if slot_b_words is None
+              else np.asarray(slot_b_words, dtype=np.uint32))
     t_ticks = fwd.shape[0]
     n = fwd.shape[1] if n_true is None else n_true
     n_msgs = len(msg_topic)
@@ -553,11 +581,14 @@ def rpc_events(rpc_snaps: dict, offsets, msg_topic: np.ndarray,
     for k in range(t_ticks):
         ts = (start_tick + k) * NS_PER_TICK
         fresh_any = np.zeros(n, dtype=bool)
+        fb_any = np.zeros(n, dtype=bool)
         adv_any = np.zeros(n, dtype=bool)
         inj_any = np.zeros(n, dtype=bool)
         for w in range(fresh.shape[1]):
-            fresh_any |= fresh[k, w, :n] != 0
+            fresh_any |= fresh_a[k, w, :n] != 0
             adv_any |= adv[k, w, :n] != 0
+            if fresh_b is not None:
+                fb_any |= fresh_b[k, w, :n] != 0
             if inj is not None:
                 inj_any |= inj[k, w, :n] != 0
         for c, off in enumerate(offs):
@@ -568,28 +599,75 @@ def rpc_events(rpc_snaps: dict, offsets, msg_topic: np.ndarray,
             p_e = (prune[k, :n] & bit) != 0
             fl_e = (((flood[k, :n] & bit) != 0) & inj_any
                     if flood is not None else np.zeros(n, dtype=bool))
-            attempted = (f_e | ih_e | g_e | p_e | fl_e) & alive[k, :n]
+            if paired:
+                fb_e = ((fwd_b[k, :n] & bit) != 0) & fb_any
+                gb_e = (graft_b[k, :n] & bit) != 0
+                pb_e = (prune_b[k, :n] & bit) != 0
+            else:
+                fb_e = gb_e = pb_e = np.zeros(n, dtype=bool)
+            attempted = (f_e | ih_e | g_e | p_e | fl_e
+                         | fb_e | gb_e | pb_e) & alive[k, :n]
             for p in np.flatnonzero(attempted):
                 p = int(p)
                 q = (p + off) % n
-                # fresh ⊇ inj, so a mesh edge that also floods needs
-                # no merge; a flood-ONLY edge carries just the due
-                # publishes
-                msgs = (_ids_of(fresh[k, :, p], n_msgs) if f_e[p]
-                        else _ids_of(inj[k, :, p], n_msgs) if fl_e[p]
-                        else [])
+                # slot-A fresh ⊇ slot-A inj, so a mesh edge that also
+                # floods needs no merge for its own slot; flood-ONLY
+                # edges carry just the due publishes, and slot-B mesh
+                # content merges into the same edge RPC (disjoint id
+                # sets by construction)
+                msgs = sorted(set(
+                    (_ids_of(fresh_a[k, :, p], n_msgs) if f_e[p]
+                     else [])
+                    + (_ids_of(fresh_b[k, :, p], n_msgs)
+                       if paired and fb_e[p] else [])
+                    + (_ids_of(inj[k, :, p], n_msgs) if fl_e[p]
+                       else [])))
                 ctl_kw = {}
                 if ih_e[p]:
-                    ctl_kw["ihave"] = [tr.ControlIHaveMeta(
-                        topic=topic_name(int(peer_topic[p])),
-                        message_ids=[msg_id(m) for m in _ids_of(
-                            adv[k, :, p], n_msgs)])]
+                    if slot_b is not None:
+                        # per-topic IHAVE split: message m rides the
+                        # slot its bit in slot_b_words[:, p] says
+                        ids_all = _ids_of(adv[k, :, p], n_msgs)
+                        on_b = {m for m in ids_all
+                                if (int(slot_b[m // 32, p])
+                                    >> (m % 32)) & 1}
+                        entries = []
+                        ids_a = [m for m in ids_all if m not in on_b]
+                        if ids_a:
+                            entries.append(tr.ControlIHaveMeta(
+                                topic=topic_name(int(peer_topic[p])),
+                                message_ids=[msg_id(m)
+                                             for m in ids_a]))
+                        if on_b:
+                            entries.append(tr.ControlIHaveMeta(
+                                topic=topic_name(
+                                    int(peer_topic_b[p])),
+                                message_ids=[msg_id(m) for m in
+                                             sorted(on_b)]))
+                        ctl_kw["ihave"] = entries
+                    else:
+                        ctl_kw["ihave"] = [tr.ControlIHaveMeta(
+                            topic=topic_name(int(peer_topic[p])),
+                            message_ids=[msg_id(m) for m in _ids_of(
+                                adv[k, :, p], n_msgs)])]
+                grafts_meta = []
                 if g_e[p]:
-                    ctl_kw["graft"] = [tr.ControlGraftMeta(
-                        topic=topic_name(int(peer_topic[p])))]
+                    grafts_meta.append(tr.ControlGraftMeta(
+                        topic=topic_name(int(peer_topic[p]))))
+                if paired and gb_e[p]:
+                    grafts_meta.append(tr.ControlGraftMeta(
+                        topic=topic_name(int(peer_topic_b[p]))))
+                if grafts_meta:
+                    ctl_kw["graft"] = grafts_meta
+                prunes_meta = []
                 if p_e[p]:
-                    ctl_kw["prune"] = [tr.ControlPruneMeta(
-                        topic=topic_name(int(peer_topic[p])))]
+                    prunes_meta.append(tr.ControlPruneMeta(
+                        topic=topic_name(int(peer_topic[p]))))
+                if paired and pb_e[p]:
+                    prunes_meta.append(tr.ControlPruneMeta(
+                        topic=topic_name(int(peer_topic_b[p]))))
+                if prunes_meta:
+                    ctl_kw["prune"] = prunes_meta
                 meta = tr.RPCMeta(
                     messages=msg_metas(msgs),
                     control=(tr.ControlMeta(**ctl_kw) if ctl_kw

@@ -1,0 +1,252 @@
+"""Event-driven time: per-edge delay lines, jitter, and the
+pipelined-gossip regime (ROADMAP direction 3).
+
+Every simulator in the repo previously ran the seed's "one tick = one
+heartbeat = one network hop" contract, which hides the heartbeat/RTT
+ratio real GossipSub deployments tune around ("The Algorithm of
+Pipelined Gossiping" arXiv:1504.03277; OPTIMUMP2P arXiv:2508.04833 —
+PAPERS.md) and makes the round-10 ``latency_hist`` telemetry a
+degenerate hop count.  This module makes network time EVENT-DRIVEN
+while keeping the scan fixed-shape:
+
+- ``DelayConfig`` is the user-facing knob: a per-hop **base** delay in
+  ticks, an integer **jitter** bound (the extra delay of each directed
+  edge-tick is sampled uniformly from ``[0, jitter]`` inside the scan,
+  from the config's own ``seed`` — independent of the simulator PRNG,
+  so batched replicas may vary delay seeds), and the **k_slots** depth
+  of the circular delay line.  ``base + jitter <= k_slots`` is
+  validated at build time with the offending field named.
+- ``compile_delays`` lowers it to ``DelayParams``: ``base``/``jitter``
+  ride as TRACED i32 scalar leaves (sweepable through the SimKnobs
+  surface — ``sim_knobs={"delay_base": ...}`` — with zero recompiles,
+  exactly like ``FaultSchedule.drop_prob``), while ``k_slots`` is
+  shape-bearing (it sizes the delay-line state) and is rejected by
+  name at the knob surface (``models/knobs.py``).
+
+Two compiled forms, chosen by what each simulator's send side depends
+on:
+
+- **Materialized delay line** (gossipsub): a K-slot circular buffer on
+  the edge dimension carried through the scan — payload words enqueue
+  as ``line[(t + d - 1) mod K, edge]`` and the tick's arrivals dequeue
+  from slot ``t mod K`` (slot cleared after the read).  GossipSub
+  needs the materialized form because a send word is a function of the
+  full mesh/gossip state at the SEND tick, which no later tick can
+  reconstruct.  Control transfers ride packed [N] delay rows the same
+  way (one ctrl line per class: GRAFT, PRUNE, retraction, broken-
+  promise advert), so the GRAFT/PRUNE handshake becomes genuinely
+  multi-tick: a GRAFT sent at ``t`` arrives at ``t + d - 1``, the
+  partner resolves accept/backoff-violation against its state AT
+  ARRIVAL, and a rejection travels back as a delayed retraction over
+  the reverse direction (negative acknowledgment — a lost retraction
+  leaves the optimistic edge until the normal PRUNE/churn paths
+  settle it, replacing the same-tick positive-ack round trip).
+- **Source-history ring** (floodsub, randomsub): those senders are
+  pure functions of (possession/frontier, tick) — both recomputable —
+  so the delay line "compiles to" a [K, W, N] ring of past source
+  words plus per-lag REPLAYED send draws: the arrivals at tick ``t``
+  are the lag-``l`` sends of tick ``t - l`` whose sampled delay was
+  exactly ``l + 1``, for ``l in [0, K)``.  Same event semantics, K
+  words of state instead of K x C.
+
+Delay convention: ``d = 1`` means the pre-PR timing — content sent at
+tick ``t`` is part of the receiver's acquisition AT tick ``t`` (one
+tick = one hop).  ``DelayConfig(base=1, jitter=0, k_slots=1)`` is
+therefore BIT-IDENTICAL to the pre-delay step on every execution path
+(the K=1 enqueue/dequeue is a value-level pass-through; pinned by
+tests/test_delays.py), and ``delays=None`` compiles the exact
+pre-delay step.
+
+Timing semantics under delays (documented deviations, all exact at
+base=1/jitter=0):
+
+- The sim's collapsed IHAVE -> IWANT -> serve gossip-repair round
+  costs ONE delayed transfer (the round's legs are not individually
+  delayed); the heartbeat/RTT regime it models is carried by the
+  payload pipeline.
+- Receiver-side score gates (graylist / gater / gossip threshold)
+  apply at SEND time — the edge's standing when the RPC left — while
+  inbound CONTROL is gated at ARRIVAL (AcceptFrom evaluates the
+  receiver's current opinion).
+- Per-tick jitter is sampled per DIRECTED edge at the receiver's lane
+  (row = the receiver's candidate bit for the sender), so the two
+  directions of an undirected edge draw independent delays.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import ClassVar
+
+import jax.numpy as jnp
+from flax import struct
+
+from ..ops.graph import lane_uniform, pack_rows
+
+__all__ = [
+    "DELAY_PHASE",
+    "DelayConfig",
+    "DelayParams",
+    "compile_delays",
+    "edge_delays",
+    "arrive_now",
+    "slot_select_words",
+    "line_dequeue",
+]
+
+#: lane_uniform phase for the per-edge-tick delay draws — disjoint
+#: from the simulator phases (gossipsub 1-7/12/13/15, randomsub 1) and
+#: the fault stream's LINK_PHASE = 9, and additionally salted by the
+#: schedule's own seed.
+DELAY_PHASE = 11
+
+
+@dataclass(frozen=True)
+class DelayConfig:
+    """Validated per-edge delay spec (host side).
+
+    base: minimum ticks per hop, >= 1 (1 = the pre-delay one-hop
+        contract).  Traced — sweepable as the ``delay_base`` knob.
+    jitter: max EXTRA ticks per directed edge-tick; the extra is
+        sampled uniformly from [0, jitter] in-scan.  Traced
+        (``delay_jitter`` knob).
+    k_slots: depth of the circular delay line; must hold the
+        worst-case delay (base + jitter <= k_slots).  SHAPE-BEARING —
+        static, rejected by name at the knob surface.
+    seed: the delay stream's own lane-hash salt, independent of the
+        simulator PRNG key (batched replicas may vary it per replica).
+    """
+
+    base: int = 1
+    jitter: int = 0
+    k_slots: int = 1
+    seed: int = 0
+
+    # Machine-readable thread-or-refuse contract (verified by
+    # tools/graftlint/contracts.py).  base/jitter are "traced" on the
+    # gossip paths (liftable through the SimKnobs surface with the
+    # no-retrace jaxpr proof) and "threaded" on the ring-replay paths
+    # (traced DelayParams leaves — value diff, no knob surface there).
+    # k_slots sizes the delay-line / ring state (build diff) and is
+    # rejected by name as a knob; seed is a threaded leaf.
+    PATHS: ClassVar[tuple[str, ...]] = (
+        "gossip-xla", "gossip-kernel", "flood-circulant",
+        "flood-gather", "randomsub-circulant", "randomsub-dense")
+    _TRACED_GOSSIP: ClassVar[dict[str, str]] = {
+        "gossip-xla": "traced", "gossip-kernel": "traced",
+        "flood-circulant": "threaded", "flood-gather": "threaded",
+        "randomsub-circulant": "threaded",
+        "randomsub-dense": "threaded"}
+    CONTRACT: ClassVar[dict[str, object]] = {
+        "base": _TRACED_GOSSIP,
+        "jitter": _TRACED_GOSSIP,
+        "k_slots": "threaded",
+        "seed": "threaded",
+    }
+
+    def __post_init__(self):
+        if int(self.base) < 1:
+            raise ValueError(
+                f"DelayConfig: base={self.base} must be >= 1 (1 = the "
+                "one-tick-one-hop contract)")
+        if int(self.jitter) < 0:
+            raise ValueError(
+                f"DelayConfig: jitter={self.jitter} must be >= 0")
+        if int(self.k_slots) < 1:
+            raise ValueError(
+                f"DelayConfig: k_slots={self.k_slots} must be >= 1")
+        if int(self.base) + int(self.jitter) > int(self.k_slots):
+            raise ValueError(
+                f"DelayConfig: k_slots={self.k_slots} cannot hold the "
+                f"worst-case delay base+jitter="
+                f"{int(self.base) + int(self.jitter)} — the K-slot "
+                "circular line wraps; raise k_slots")
+
+    def validate_point(self, base=None, jitter=None) -> None:
+        """The same invariants applied to a resolved KNOB point
+        (host ints), naming the bad field — k_slots stays the
+        compiled value."""
+        b = int(self.base if base is None else base)
+        j = int(self.jitter if jitter is None else jitter)
+        if b < 1:
+            raise ValueError(
+                f"delay_base={b} must be >= 1 (delay knobs)")
+        if j < 0:
+            raise ValueError(
+                f"delay_jitter={j} must be >= 0 (delay knobs)")
+        if b + j > int(self.k_slots):
+            raise ValueError(
+                f"delay knobs: base+jitter={b + j} exceeds the "
+                f"compiled k_slots={self.k_slots} — the delay-line "
+                "depth is shape-bearing; rebuild with a deeper "
+                "DelayConfig to sweep this point")
+
+
+@struct.dataclass
+class DelayParams:
+    """Compiled device form: base/jitter/seed are traced scalar
+    leaves (stack_trees/vmap batches sweep them per replica under one
+    executable); k_slots is static aux data."""
+
+    base: jnp.ndarray       # i32 []
+    jitter: jnp.ndarray     # i32 []
+    seed: jnp.ndarray       # u32 []
+    k_slots: int = struct.field(pytree_node=False, default=1)
+
+
+def compile_delays(dcfg: DelayConfig) -> DelayParams:
+    return DelayParams(
+        base=jnp.int32(int(dcfg.base)),
+        jitter=jnp.int32(int(dcfg.jitter)),
+        seed=jnp.uint32(int(dcfg.seed) & 0xFFFFFFFF),
+        k_slots=int(dcfg.k_slots))
+
+
+def edge_delays(dp: DelayParams, shape, tick,
+                stride: int | None = None) -> jnp.ndarray:
+    """i32 ``shape``: the integer delay (in ticks, >= 1) of each
+    directed edge-lane for transfers SENT at ``tick``, clipped into
+    [1, k_slots].  Row convention: index the row by the RECEIVER's
+    candidate bit for the sender, evaluated at the receiver's lane.
+
+    Stateless (counter-hash), so the ring-replay paths can re-evaluate
+    past ticks' draws exactly."""
+    u = lane_uniform(shape, jnp.asarray(tick), DELAY_PHASE, dp.seed,
+                     stride=stride)
+    extra = jnp.minimum(
+        (u * (dp.jitter + 1).astype(jnp.float32)).astype(jnp.int32),
+        dp.jitter)
+    return jnp.clip(dp.base + extra, 1, dp.k_slots)
+
+
+def arrive_now(dp: DelayParams, shape, send_tick, lag: int,
+               stride: int | None = None) -> jnp.ndarray:
+    """bool ``shape``: the transfers sent at ``send_tick`` over each
+    directed edge arrive exactly ``lag`` ticks later (delay == lag+1)
+    — the ring-replay paths' per-lag mask."""
+    return edge_delays(dp, shape, send_tick, stride=stride) == (lag + 1)
+
+
+def slot_select_words(d_edge: jnp.ndarray, tick,
+                      k_slots: int) -> list:
+    """Packed slot-selection words for the materialized line: K uint32
+    [N] rows, ``out[s]`` bit j set iff the edge-j transfer sent this
+    tick lands in slot ``s`` (= ``(tick + d - 1) mod K``).  The rows
+    partition the edge bits across slots (d in [1, K] bijects onto
+    the K slots)."""
+    slot = jnp.mod(jnp.asarray(tick) + d_edge - 1, k_slots)  # [C, N]
+    return [pack_rows(slot == s) for s in range(k_slots)]
+
+
+def line_dequeue(line: jnp.ndarray, tick):
+    """(arrivals, cleared line): read slot ``tick mod K`` of a
+    [K, ...] delay line and zero it for reuse K ticks from now."""
+    import jax
+
+    k = line.shape[0]
+    cur = jnp.mod(jnp.asarray(tick), k)
+    arr = jax.lax.dynamic_index_in_dim(line, cur, axis=0,
+                                       keepdims=False)
+    cleared = jax.lax.dynamic_update_slice_in_dim(
+        line, jnp.zeros_like(arr)[None], cur, axis=0)
+    return arr, cleared

@@ -28,13 +28,25 @@ Fault model (one tick = one heartbeat = one hop, as everywhere):
   ``alive_mask`` evaluates the interval table per tick: an [N, K]
   compare, K = max intervals per peer.
 - **Link loss**: each UNDIRECTED candidate edge is down for a whole
-  tick with probability ``drop_prob`` (scalar, or per-edge [C, N] —
-  validated symmetric, since one edge has two views).  Symmetry comes
-  free from the draw itself: uniforms are drawn at the positive-offset
-  bits only and transferred to the partner's negative bits, so both
-  endpoints see the same coin.  A down link carries nothing either
-  way that tick — payload, IHAVE, and the GRAFT/PRUNE handshake alike
-  (the reference's DROP_RPC drops whole RPCs).
+  tick with probability ``drop_prob`` (scalar, or per-edge [C, N]).
+  For symmetric arrays (and scalars) symmetry comes free from the
+  draw itself: uniforms are drawn at the positive-offset bits only
+  and transferred to the partner's negative bits, so both endpoints
+  see the same coin, and a down link carries nothing either way that
+  tick — payload, IHAVE, and the GRAFT/PRUNE handshake alike (the
+  reference's DROP_RPC drops whole RPCs).
+- **Directed link loss** (round 13): an ASYMMETRIC [C, N] array is
+  accepted too — ``drop_prob[c, p]`` is then the loss rate of the
+  DIRECTED transfer p -> p+o_c, each direction drawing its own coin
+  (``FaultParams.directed_drops``).  Link masks gate SENDS, so the
+  per-direction semantics fall out of the existing masking: only the
+  p -> q traffic is lost when p's view drops.  A directed drop can
+  leave a half-notified handshake for a while (a lost one-way PRUNE /
+  A-response), exactly as a lost RPC does in the reference — gossip
+  repair and the next heartbeat settle it.  The scalar and symmetric-
+  array paths are BIT-IDENTICAL to the pre-directed form (the
+  directed draw only compiles in for asymmetric arrays; pinned by
+  tests/test_faults.py).
 - **Partitions**: a static group assignment [N] plus up-to-P tick
   windows.  While any window is active, every candidate edge whose
   endpoints sit in different groups is cut, splitting the peer set;
@@ -123,10 +135,14 @@ class FaultSchedule:
         cleared at the rejoin tick (gossipsub only; see module
         docstring).  Static (baked into the compiled step), so every
         replica of a stacked batch must agree on it.
-    drop_prob: probability an undirected candidate edge is down for a
-        tick — a float, or a [C, N] per-edge array (symmetric across
-        the edge's two views; checked in compile_faults where the
-        offsets are known).
+    drop_prob: probability a candidate edge is down for a tick — a
+        float (undirected), or a [C, N] per-edge array.  A symmetric
+        array (both views of each edge agree — checked in
+        compile_faults where the offsets are known) keeps the
+        undirected shared-coin semantics bit-identically; an
+        ASYMMETRIC array selects per-DIRECTION loss (round 13):
+        ``drop_prob[c, p]`` governs the directed transfer
+        p -> p+o_c independently of the reverse direction.
     partition_group: optional int [N] group assignment; edges between
         groups are cut during every partition window.
     partition_windows: iterable of ``(start, end)`` half-open tick
@@ -311,6 +327,12 @@ class FaultParams:
     # state-clear branch, so stacked replicas must agree; per-replica
     # churn still varies through the interval tables)
     cold_restart: bool = struct.field(pytree_node=False, default=False)
+    # round 13: per-DIRECTION link loss (STATIC branch selector —
+    # compile_faults sets it iff the per-edge [C, N] drop_prob array
+    # is asymmetric; the symmetric/scalar shared-coin draw compiles
+    # unchanged otherwise, bit-identically)
+    directed_drops: bool = struct.field(pytree_node=False,
+                                        default=False)
 
 
 # lane_uniform phase for the per-tick link draws.  Must stay disjoint
@@ -356,15 +378,17 @@ def compile_faults(schedule: FaultSchedule, offsets,
                 f"drop_prob: per-edge form is [C={dp.shape[0]}, N] but "
                 f"the offset set has C={C} candidates")
         # one undirected edge, two views: p's bit c and (p+o_c)'s bit
-        # cinv[c] describe the same link and must carry the same
-        # probability (np.roll(x, -o)[p] = x[p+o])
-        for c, o in enumerate(offs):
-            if not np.allclose(dp[c], np.roll(dp[cinv[c]], -o)):
-                raise ValueError(
-                    "drop_prob: per-edge probabilities must be "
-                    "symmetric — peer p's bit c and peer p+o_c's bit "
-                    "cinv[c] describe one edge")
+        # cinv[c] describe the same link (np.roll(x, -o)[p] = x[p+o]).
+        # When the two views agree everywhere the array is SYMMETRIC
+        # and the shared-coin undirected draw compiles in unchanged;
+        # a disagreement anywhere selects the round-13 per-DIRECTION
+        # draw (each view its own independent coin at its own rate).
+        symmetric = all(
+            np.allclose(dp[c], np.roll(dp[cinv[c]], -o))
+            for c, o in enumerate(offs))
         kw["drop_prob"] = jnp.asarray(dp)
+        if not symmetric:
+            kw["directed_drops"] = True
     elif float(dp) > 0.0:
         kw["drop_prob"] = jnp.float32(float(dp))
 
@@ -459,22 +483,28 @@ def link_ok_bits(fp: FaultParams, offsets, cinv, tick,
     ALL = jnp.uint32((1 << C) - 1)
     drop = jnp.zeros((n,), dtype=jnp.uint32)
     if fp.drop_prob is not None:
-        pos = jnp.uint32(sum(1 << c for c, o in enumerate(offsets)
-                             if int(o) > 0))
-        draw = pack_rows(_link_drop_draw(
-            fp, C, n, tick, n_stream if n_stream is not None else n))
-        draw = draw & pos
-        # transfer the positive bits to the partner's negative bits
-        # (transfer_bits without the cfg dependency: bit c rolled by
-        # offsets[c] lands in the partner's bit cinv[c])
-        mirror = jnp.zeros_like(draw)
-        for c, off in enumerate(offsets):
-            if int(off) <= 0:
-                continue
-            b = (draw >> jnp.uint32(c)) & jnp.uint32(1)
-            mirror = mirror | (jnp.roll(b, int(off), axis=0)
-                               << jnp.uint32(cinv[c]))
-        drop = draw | mirror
+        draw_f = _link_drop_draw(
+            fp, C, n, tick, n_stream if n_stream is not None else n)
+        if fp.directed_drops:
+            # per-DIRECTION coins (round 13): every bit draws at its
+            # own lane against its own rate — no positive-bit mirror,
+            # the two views of an edge drop independently
+            drop = pack_rows(draw_f)
+        else:
+            pos = jnp.uint32(sum(1 << c for c, o in enumerate(offsets)
+                                 if int(o) > 0))
+            draw = pack_rows(draw_f) & pos
+            # transfer the positive bits to the partner's negative
+            # bits (transfer_bits without the cfg dependency: bit c
+            # rolled by offsets[c] lands in the partner's bit cinv[c])
+            mirror = jnp.zeros_like(draw)
+            for c, off in enumerate(offsets):
+                if int(off) <= 0:
+                    continue
+                b = (draw >> jnp.uint32(c)) & jnp.uint32(1)
+                mirror = mirror | (jnp.roll(b, int(off), axis=0)
+                                   << jnp.uint32(cinv[c]))
+            drop = draw | mirror
     if fp.cross_bits is not None:
         drop = drop | jnp.where(_partition_active(fp, tick),
                                 fp.cross_bits, jnp.uint32(0))
@@ -634,12 +664,16 @@ def link_ok_rows(fp: FaultParams, offsets, cinv, tick,
     if fp.drop_prob is not None:
         draw = _link_drop_draw(
             fp, C, n, tick, n_stream if n_stream is not None else n)
-        rows = [None] * C
-        for c, off in enumerate(offsets):
-            if int(off) > 0:
-                rows[c] = draw[c]
-                rows[cinv[c]] = jnp.roll(draw[c], int(off), axis=0)
-        up = ~jnp.stack(rows, axis=0)
+        if fp.directed_drops:
+            # per-DIRECTION coins (round 13): no mirror
+            up = ~draw
+        else:
+            rows = [None] * C
+            for c, off in enumerate(offsets):
+                if int(off) > 0:
+                    rows[c] = draw[c]
+                    rows[cinv[c]] = jnp.roll(draw[c], int(off), axis=0)
+            up = ~jnp.stack(rows, axis=0)
     if fp.cross_rows is not None:
         up = up & ~(fp.cross_rows
                     & _partition_active(fp, tick))

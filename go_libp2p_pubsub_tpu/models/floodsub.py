@@ -40,6 +40,7 @@ from ._delivery import (
     reach_counts_from_first_tick,
     update_first_tick,
 )
+from . import delays as _delays
 from . import faults as _faults
 from . import invariants as _invariants
 from . import telemetry as _telemetry
@@ -58,6 +59,11 @@ class FloodParams:
     publish_tick: jnp.ndarray  # int32 [M]
     # compiled fault schedule (models/faults.py) — circulant step only
     faults: _faults.FaultParams | None = None
+    # round-13 event-driven time (models/delays.py): per-edge delay +
+    # jitter.  Floodsub's sender is a pure function of (possession,
+    # tick), so the delay line compiles to the state's source-history
+    # RING plus per-lag replayed send draws — see delays.py.
+    delays: _delays.DelayParams | None = None
 
 
 @struct.dataclass
@@ -72,6 +78,10 @@ class FloodState:
     # state; invariants.attach(state) arms them
     inv_viol: jnp.ndarray | None = None      # uint32 []
     inv_first: jnp.ndarray | None = None     # int32 []
+    # round-13 source-history ring (delay-armed sims only): slot
+    # t mod K holds the possession words at the START of tick t, so
+    # lag-l arrivals replay the tick-(t-l) sends exactly
+    src_ring: jnp.ndarray | None = None      # uint32 [K, W, N]
 
 
 def make_flood_sim(nbrs: np.ndarray, nbr_mask: np.ndarray, subs: np.ndarray,
@@ -79,7 +89,8 @@ def make_flood_sim(nbrs: np.ndarray, nbr_mask: np.ndarray, subs: np.ndarray,
                    msg_origin: np.ndarray, msg_publish_tick: np.ndarray,
                    track_first_tick: bool = True,
                    fault_schedule: _faults.FaultSchedule | None = None,
-                   fault_offsets=None):
+                   fault_offsets=None,
+                   delays: _delays.DelayConfig | None = None):
     """Build (params, state) for a flood simulation.
 
     subs/relays: bool [N, T]; msg_*: [M] arrays describing the message table.
@@ -92,6 +103,11 @@ def make_flood_sim(nbrs: np.ndarray, nbr_mask: np.ndarray, subs: np.ndarray,
     schedule compiles against the nbrs table itself
     (compile_faults_gather — per-undirected-pair link coins, baked
     partition-crossing slots) and flood_step honors it.
+
+    delays (round 13, models/delays.py) makes every hop take
+    ``base + jitter-draw`` ticks: the circulant and gather cores both
+    honor it through the source-history ring (``DelayConfig(1, 0, 1)``
+    is bit-identical to the pre-delay step, pinned).
     """
     n = subs.shape[0]
     m = len(msg_topic)
@@ -142,6 +158,8 @@ def make_flood_sim(nbrs: np.ndarray, nbr_mask: np.ndarray, subs: np.ndarray,
         origin_words=pack_bits_pm(jnp.asarray(origin_bits)),
         publish_tick=jnp.asarray(msg_publish_tick, dtype=jnp.int32),
         faults=fparams,
+        delays=(None if delays is None
+                else _delays.compile_delays(delays)),
     )
     w = params.fwd_words.shape[0]
     state = FloodState(
@@ -149,6 +167,9 @@ def make_flood_sim(nbrs: np.ndarray, nbr_mask: np.ndarray, subs: np.ndarray,
         first_tick=(jnp.full((w, WORD_BITS, n), -1, dtype=jnp.int16)
                     if track_first_tick else None),
         tick=jnp.zeros((), dtype=jnp.int32),
+        src_ring=(None if delays is None
+                  else jnp.zeros((int(delays.k_slots), w, n),
+                                 dtype=jnp.uint32)),
     )
     return params, state
 
@@ -188,7 +209,102 @@ def make_gather_step_core(telemetry:
     ws = _telemetry.wire_sizes(tel) if tel is not None else None
     pc = jax.lax.population_count
 
+    def delayed_gather(params: FloodParams, state: FloodState):
+        # round-13 event-driven hop over the gather table: lag-l
+        # arrivals replay the tick-(t-l) sends from the source-history
+        # ring, keeping the slots whose sampled delay was exactly l+1
+        # (models/delays.py — the table-path twin of the circulant
+        # delayed core below)
+        dlp = params.delays
+        K = dlp.k_slots
+        fp = params.faults
+        tick = state.tick
+        W = state.have.shape[0]
+        alive = aw_now = None
+        if fp is not None:
+            alive = _faults.alive_mask(fp, tick)
+            aw_now = _faults.alive_word(alive)
+        count = tel is not None and tel.counters
+        sent_cnt = recv_cnt = jnp.int32(0) if count else None
+        heard = jnp.zeros_like(state.have)
+        ok_now = src_now = None
+        for lag in range(K):
+            t_s = tick - lag
+            src = (state.have if lag == 0
+                   else jax.lax.dynamic_index_in_dim(
+                       state.src_ring, jnp.mod(t_s, K), axis=0,
+                       keepdims=False))
+            src = src & params.fwd_words
+            ok = params.nbr_mask
+            if fp is not None:
+                src = src & _faults.alive_word(
+                    _faults.alive_mask(fp, t_s))[None, :]
+                link_s = _faults.link_ok_gather(fp, params.nbrs, t_s)
+                if link_s is not None:
+                    ok = ok & link_s
+            if lag == 0:
+                ok_now, src_now = ok, src
+            okl = ok & _delays.arrive_now(dlp, params.nbrs.shape,
+                                          t_s, lag)
+            gathered = src.at[:, params.nbrs].get(
+                mode="fill", fill_value=0)                 # [W, N, K]
+            gathered = jnp.where(okl[None, :, :], gathered,
+                                 jnp.uint32(0))
+            arr = jnp.zeros_like(state.have)
+            for k in range(params.nbrs.shape[1]):
+                arr = arr | gathered[:, :, k]
+            if aw_now is not None:
+                arr = arr & aw_now[None, :]                # receiver up
+            heard = heard | arr
+            if count:
+                recv = (gathered if aw_now is None
+                        else gathered & aw_now[None, :, None])
+                recv_cnt = recv_cnt + pc(recv).sum(dtype=jnp.int32)
+        if count:
+            # payload copies SENT this tick (every delay class): the
+            # full tick-t send set, before delay routing
+            g_now = src_now.at[:, params.nbrs].get(
+                mode="fill", fill_value=0)
+            g_now = jnp.where(ok_now[None, :, :], g_now,
+                              jnp.uint32(0))
+            sent_cnt = pc(g_now).sum(dtype=jnp.int32)
+        ring = jax.lax.dynamic_update_slice_in_dim(
+            state.src_ring, state.have[None], jnp.mod(tick, K),
+            axis=0)
+        new_state, delivered = _finish_step(params, state, heard,
+                                            alive=alive,
+                                            src_ring=ring)
+        if tel is None:
+            return new_state, delivered
+        kw_f = {}
+        if count:
+            accepted = (heard & ~state.have
+                        & (params.fwd_words | params.deliver_words))
+            kw_f.update(
+                payload_sent=sent_cnt,
+                dup_suppressed=recv_cnt - pc(accepted).sum(
+                    dtype=jnp.int32))
+            if tel.wire:
+                kw_f["bytes_payload"] = (
+                    sent_cnt.astype(jnp.float32)
+                    * float(ws.payload_frame))
+        if tel.latency_hist:
+            kw_f["latency_hist"] = _telemetry.latency_histogram(
+                delivered, params.publish_tick, tick,
+                tel.latency_buckets)
+        if tel.faults and fp is not None:
+            kw_f["down_peers"] = (~alive).sum(dtype=jnp.int32)
+            if fp.drop_prob is not None or fp.cross_nk is not None:
+                link_now = _faults.link_ok_gather(fp, params.nbrs,
+                                                  tick)
+                kw_f["dropped_edge_ticks"] = (
+                    (~link_now & params.nbr_mask).sum(
+                        dtype=jnp.int32) // 2)
+        return new_state, delivered, _telemetry.make_frame(**kw_f)
+
     def core(params: FloodParams, state: FloodState):
+        if params.delays is not None:
+            return delayed_gather(params, state)
         fp = params.faults
         src = state.have & params.fwd_words                # [W, N]
         alive = aw = link_up = None
@@ -271,7 +387,8 @@ def make_circulant_flood_step(offsets):
 
 def _finish_step(params: FloodParams, state: FloodState,
                  heard: jnp.ndarray,
-                 alive: jnp.ndarray | None = None
+                 alive: jnp.ndarray | None = None,
+                 src_ring: jnp.ndarray | None = None
                  ) -> tuple[FloodState, jnp.ndarray]:
     # the hop used what peers had at the END of the previous tick —
     # a publish at tick t reaches direct neighbors at t+1
@@ -296,7 +413,9 @@ def _finish_step(params: FloodParams, state: FloodState,
     new_state = FloodState(have=have, first_tick=first_tick,
                            tick=state.tick + 1,
                            inv_viol=state.inv_viol,
-                           inv_first=state.inv_first)
+                           inv_first=state.inv_first,
+                           src_ring=(src_ring if src_ring is not None
+                                     else state.src_ring))
     return new_state, delivered_now
 
 
@@ -431,12 +550,105 @@ def make_circulant_step_core(offsets,
         if tel.faults and fp is not None:
             kw_f["down_peers"] = (~alive).sum(dtype=jnp.int32)
             if link is not None:
-                # two [C, N] views per undirected edge; halve
+                # UNITS: undirected mode halves the two views per
+                # edge; directed mode counts DIRECTED edge-ticks (a
+                # partition cut downs both directions and counts 2)
                 kw_f["dropped_edge_ticks"] = (
-                    (~link).sum(dtype=jnp.int32) // 2)
+                    (~link).sum(dtype=jnp.int32)
+                    // (1 if fp.directed_drops else 2))
+        return new_state, delivered, _telemetry.make_frame(**kw_f)
+
+    def delayed_core(params: FloodParams, state: FloodState):
+        # round-13 event-driven hop (models/delays.py): lag-l arrivals
+        # replay the tick-(t-l) sends from the source-history ring,
+        # keeping the edges whose sampled delay was exactly l+1.  The
+        # send-time masks (alive/link) are recomputed statelessly at
+        # the SEND tick; the receiver-alive mask applies at ARRIVAL.
+        dlp = params.delays
+        K = dlp.k_slots
+        fp = params.faults
+        tick = state.tick
+        W, n = state.have.shape
+        C = len(offsets)
+        Z = jnp.uint32(0)
+        alive = aw_now = None
+        if fp is not None:
+            alive = _faults.alive_mask(fp, tick)
+            aw_now = _faults.alive_word(alive)
+        count = tel is not None and tel.counters
+        sent_cnt = recv_cnt = jnp.int32(0) if count else None
+        w_rows = [jnp.zeros((n,), dtype=jnp.uint32) for _ in range(W)]
+        link_now = src_now = None
+        for lag in range(K):
+            t_s = tick - lag
+            src = (state.have if lag == 0
+                   else jax.lax.dynamic_index_in_dim(
+                       state.src_ring, jnp.mod(t_s, K), axis=0,
+                       keepdims=False))
+            src = src & params.fwd_words
+            link_s = None
+            if fp is not None:
+                src = src & _faults.alive_word(
+                    _faults.alive_mask(fp, t_s))[None, :]
+                link_s = _faults.link_ok_rows(fp, offsets, cinv, t_s)
+            if lag == 0:
+                link_now, src_now = link_s, src
+            dmask = _delays.arrive_now(dlp, (C, n), t_s, lag)
+            for c, off in enumerate(offsets):
+                m = (dmask[c] if link_s is None
+                     else dmask[c] & link_s[c])
+                for w in range(W):
+                    sent = jnp.where(m, src[w], Z)
+                    rolled = jnp.roll(sent, off, axis=0)
+                    if aw_now is not None:
+                        rolled = rolled & aw_now       # receiver up
+                    w_rows[w] = w_rows[w] | rolled
+                    if count:
+                        recv_cnt = recv_cnt + pc(rolled).sum(
+                            dtype=jnp.int32)
+        if count:
+            # payload copies SENT this tick (every delay class)
+            for c in range(C):
+                for w in range(W):
+                    s0 = (src_now[w] if link_now is None
+                          else jnp.where(link_now[c], src_now[w], Z))
+                    sent_cnt = sent_cnt + pc(s0).sum(dtype=jnp.int32)
+        heard = jnp.stack(w_rows, axis=0)
+        ring = jax.lax.dynamic_update_slice_in_dim(
+            state.src_ring, state.have[None], jnp.mod(tick, K),
+            axis=0)
+        new_state, delivered = _finish_step(params, state, heard,
+                                            alive=alive,
+                                            src_ring=ring)
+        if tel is None:
+            return new_state, delivered
+        kw_f = {}
+        if count:
+            accepted = (heard & ~state.have
+                        & (params.fwd_words | params.deliver_words))
+            kw_f.update(
+                payload_sent=sent_cnt,
+                dup_suppressed=recv_cnt - pc(accepted).sum(
+                    dtype=jnp.int32))
+            if tel.wire:
+                kw_f["bytes_payload"] = (
+                    sent_cnt.astype(jnp.float32)
+                    * float(ws.payload_frame))
+        if tel.latency_hist:
+            kw_f["latency_hist"] = _telemetry.latency_histogram(
+                delivered, params.publish_tick, tick,
+                tel.latency_buckets)
+        if tel.faults and fp is not None:
+            kw_f["down_peers"] = (~alive).sum(dtype=jnp.int32)
+            if link_now is not None:
+                kw_f["dropped_edge_ticks"] = (
+                    (~link_now).sum(dtype=jnp.int32)
+                    // (1 if fp.directed_drops else 2))
         return new_state, delivered, _telemetry.make_frame(**kw_f)
 
     def core(params: FloodParams, state: FloodState):
+        if params.delays is not None:
+            return delayed_core(params, state)
         if tel is not None:
             return telemetry_core(params, state)
         if params.faults is None:

@@ -123,6 +123,7 @@ from ._delivery import (
     first_tick_to_matrix,
     update_first_tick,
 )
+from . import delays as _delays
 from . import faults as _faults
 from . import invariants as _invariants
 from . import knobs as _knobs
@@ -670,6 +671,12 @@ class GossipParams:
     # ticks, plus the ScoreKnobs defense sub-tree folded in.  None =
     # every parameter baked from the static config, bit-identically.
     sim_knobs: _knobs.SimKnobs | None = None
+    # -- round-13 event-driven time (models/delays.py): per-edge delay
+    # lines + jitter.  base/jitter ride as TRACED i32 leaves (the
+    # delay_base / delay_jitter knobs sweep them recompile-free); the
+    # K-slot depth is static and sizes the GossipState delay lines.
+    # None = the exact one-tick-one-hop pre-delay step.
+    delays: _delays.DelayParams | None = None
 
 
 @struct.dataclass
@@ -758,6 +765,18 @@ class GossipState:
     # invariants.attach(state) arms them.
     inv_viol: jnp.ndarray | None = None      # uint32 []
     inv_first: jnp.ndarray | None = None     # int32 []
+    # round-13 event-driven time (models/delays.py): the K-slot
+    # circular delay lines carried through the scan.  pay_line holds
+    # in-flight payload/gossip words per receiving edge (slot s, edge
+    # bit j, word w); ctrl_line holds the packed in-flight control
+    # words (rows: GRAFT, PRUNE, retraction(, broken-promise advert));
+    # gsp_line is the gossip-class twin of pay_line, allocated only
+    # for the split execution paths (track_p3 / force_split) that need
+    # mesh-vs-gossip arrival provenance.  All None when delays are off
+    # — the pytree stays identical to the pre-delay state.
+    pay_line: jnp.ndarray | None = None      # uint32 [K, C, W, N]
+    ctrl_line: jnp.ndarray | None = None     # uint32 [K, R, N]
+    gsp_line: jnp.ndarray | None = None      # uint32 [K, C, W, N]
 
 
 def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
@@ -779,7 +798,9 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
                     eclipse_victim: np.ndarray | None = None,
                     byzantine: np.ndarray | None = None,
                     score_knobs: dict | None = None,
-                    sim_knobs: dict | None = None):
+                    sim_knobs: dict | None = None,
+                    delays: _delays.DelayConfig | None = None,
+                    delays_split: bool = False):
     """Build (params, state).  subs: bool [N, T] — but each peer may only
     subscribe to its residue-class topic (circulant classes are closed, so
     cross-class subscriptions would never receive anything).
@@ -831,6 +852,19 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
     name.  Missing keys take the config's own values, bit-identically
     to the baked step.  Mutually exclusive with ``score_knobs`` (one
     override surface per sim).
+
+    delays (round 13, models/delays.py) arms event-driven time: a
+    DelayConfig compiles to traced base/jitter scalars on the params
+    plus the K-slot circular delay lines on the state, so payload/
+    gossip/control transfers take heterogeneous integer ticks instead
+    of exactly one.  ``DelayConfig(base=1, jitter=0, k_slots=1)`` is
+    bit-identical to the pre-delay step (pinned); the ``delay_base`` /
+    ``delay_jitter`` sim_knobs sweep the heartbeat/RTT ratio
+    recompile-free (the k_slots depth is shape-bearing and rejected
+    by name).  ``delays_split=True`` additionally allocates the
+    gossip-class delay line the SPLIT execution paths (track_p3 /
+    force_split builds of make_gossip_step) need for mesh-vs-gossip
+    arrival provenance.
     """
     n, t = subs.shape
     if t != cfg.n_topics:
@@ -1055,14 +1089,26 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
         kw.update(faults=_faults.compile_faults(
             fault_schedule, cfg.offsets, pack_links=True))
 
+    if delays is not None:
+        if cfg.paired_topics:
+            # named capability gap (graftlint probe-refusal registry):
+            # the two-mesh overlay would need per-slot payload and
+            # ctrl delay lines plus delayed cross-slot routing
+            raise NotImplementedError(
+                "delays: paired-topic mode is not delay-supported "
+                "(per-slot delay lines and delayed cross-slot control "
+                "routing are not modeled); run delays on a "
+                "single-topic-per-peer config")
+        kw.update(delays=_delays.compile_delays(delays))
+
     if sim_knobs is not None:
         if score_knobs is not None:
             raise ValueError(
                 "pass parameter overrides through ONE surface: "
                 "sim_knobs (which folds the ScoreKnobs fields in) or "
                 "the legacy score_knobs dict, not both")
-        proto_kv, score_kv, fault_kv = _knobs.split_knob_overrides(
-            sim_knobs, SCORE_KNOB_FIELDS)
+        proto_kv, score_kv, fault_kv, delay_kv = \
+            _knobs.split_knob_overrides(sim_knobs, SCORE_KNOB_FIELDS)
         kw.update(sim_knobs=_knobs.make_sim_knobs(
             cfg, score_cfg, {**proto_kv, **score_kv},
             px_candidates=px_candidates))
@@ -1085,6 +1131,19 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
                 raise ValueError(
                     f"sim_knobs: drop_prob={dpv} outside [0, 1]")
             kw["faults"] = fp0.replace(drop_prob=jnp.float32(dpv))
+        if delay_kv:
+            if delays is None:
+                raise ValueError(
+                    "sim_knobs: the delay_base/delay_jitter knobs "
+                    "override compiled DelayParams leaves — pass a "
+                    "DelayConfig alongside them (the delay-line code "
+                    "path must compile in; its k_slots depth bounds "
+                    "the sweepable points)")
+            db = int(delay_kv.get("delay_base", delays.base))
+            dj = int(delay_kv.get("delay_jitter", delays.jitter))
+            delays.validate_point(base=db, jitter=dj)
+            kw["delays"] = kw["delays"].replace(
+                base=jnp.int32(db), jitter=jnp.int32(dj))
 
     params = GossipParams(
         subscribed=jnp.asarray(padl(subscribed)),
@@ -1129,6 +1188,25 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
             act[:len(direct_packed)] |= direct_packed
         active0 = jnp.asarray(act)
 
+    # round-13 delay lines (models/delays.py): the K-slot circular
+    # buffers start empty.  ctrl rows: GRAFT, PRUNE, retraction, plus
+    # the broken-promise advert row iff some withholding behavior can
+    # be live (the step derives the same predicate at trace time, so
+    # the shapes agree).
+    pay_line0 = ctrl_line0 = gsp_line0 = None
+    if delays is not None:
+        kd = int(delays.k_slots)
+        has_cheat = (score_cfg is not None
+                     and (score_cfg.sybil_ihave_spam
+                          or promise_break is not None))
+        pay_line0 = jnp.zeros((kd, c, w, n), dtype=jnp.uint32)
+        ctrl_line0 = jnp.zeros((kd, 3 + int(has_cheat), n),
+                               dtype=jnp.uint32)
+        if delays_split:
+            gsp_line0 = jnp.zeros((kd, c, w, n), dtype=jnp.uint32)
+    elif delays_split:
+        raise ValueError("delays_split=True needs a DelayConfig")
+
     state = GossipState(
         mesh=zbits(),
         fanout=zbits(),
@@ -1167,6 +1245,7 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
         backoff_b=(jnp.zeros((c, n), dtype=jnp.int16)
                    if cfg.paired_topics else None),
         active=active0,
+        pay_line=pay_line0, ctrl_line=ctrl_line0, gsp_line=gsp_line0,
     )
     # seed the gate pipeline: tick 0's gate words, exactly what the
     # step's epilogue would have emitted at the end of tick -1
@@ -1183,7 +1262,8 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
 
 
 def transfer_bits(bits: jnp.ndarray, cfg: GossipSimConfig,
-                  pair: bool = False) -> jnp.ndarray:
+                  pair: bool = False,
+                  n_true: int | None = None) -> jnp.ndarray:
     """Packed-mask edge transfer: what each peer's partners sent it.
 
     bits: uint32 [N], bit c describing edge (p, p+o_c).  Bit c rolled by
@@ -1195,12 +1275,21 @@ def transfer_bits(bits: jnp.ndarray, cfg: GossipSimConfig,
     rolls: the rolls dominate the cost, so two masks for the price of
     one (used for GRAFT+PRUNE handshakes and the packed payload/gossip
     score gates).
+
+    ``n_true`` (round 13, the delayed-exchange path on PADDED kernel
+    states): wrap the rolls at the TRUE ring instead of the padded
+    length — pad lanes carry zeros.  None (or == len) is the plain
+    roll, bit-identically.
     """
     sel = jnp.uint32(0x1_0001) if pair else jnp.uint32(1)
     out = jnp.zeros_like(bits)
+    wrap = n_true is not None and n_true != bits.shape[0]
     for c, off in enumerate(cfg.offsets):
         b = (bits >> jnp.uint32(c)) & sel
-        out = out | (jnp.roll(b, off, axis=0) << jnp.uint32(cfg.cinv[c]))
+        rolled = (jnp.concatenate([jnp.roll(b[:n_true], off, axis=0),
+                                   b[n_true:]])
+                  if wrap else jnp.roll(b, off, axis=0))
+        out = out | (rolled << jnp.uint32(cfg.cinv[c]))
     return out
 
 
@@ -1675,6 +1764,18 @@ def kernel_capability(cfg: GossipSimConfig, sc: ScoreSimConfig | None,
                 "the pallas step (the in-kernel IWANT serve budget "
                 "bakes it) — run iwant-spam knob sweeps on the XLA "
                 "path, or drop sybil_iwant_spam from the config")
+    if (params.delays is not None and sc is not None
+            and sc.sybil_iwant_spam):
+        # round-13 attack-heavy kernel corner (named refusal,
+        # graftlint probe): the in-kernel IWANT-flood budget reads
+        # the partner advert views the delayed kernel no longer
+        # streams (arrivals ride the delay line as one blocked
+        # operand instead)
+        return ("delays: sybil_iwant_spam stays XLA-only on the "
+                "pallas step under delays (the in-kernel flood "
+                "budget needs the partner advert views the delayed "
+                "kernel does not stream) — run iwant-spam delay "
+                "sweeps on the XLA path")
     if (cfg.n_candidates > 16 or params.origin_words.shape[0] == 0
             or params.flood_proto is not None
             or state.gates is None
@@ -1717,8 +1818,10 @@ def make_gossip_step(cfg: GossipSimConfig,
     ``interop.export.rpc_events`` reconstructs into the reference's
     per-RPC SEND_RPC / RECV_RPC / DROP_RPC metadata streams.  Probe
     data is a pure READOUT (the state trajectory is bit-identical) and
-    works on both execution paths; paired-topic and mixed-protocol
-    overlays are not probe-supported (they raise).
+    works on both execution paths; paired-topic overlays are
+    probe-supported since round 13 (per-slot masks + slot-split
+    payload in the snapshot); mixed-protocol overlays and delay-armed
+    sims are not (they raise by name).
 
     With ``telemetry`` (models/telemetry.py) the step instead returns
     ``(state, delivered_words, TelemetryFrame)`` — per-tick protocol
@@ -1783,17 +1886,14 @@ def make_gossip_step(cfg: GossipSimConfig,
                    or (sc is not None and sc.track_p3)):
         raise ValueError("paired_topics needs the combined path "
                         "(C<=16, no track_p3/force_split)")
-    if rpc_probe and paired:
-        # the remaining probe refusals, by name: PAIRED-TOPIC overlays
-        # (here) and MIXED-PROTOCOL overlays (flood_proto, raised at
-        # trace time in the step where the params are visible).  The
-        # round-10 flood_publish refusal is FIXED: flood sends ride
-        # the probe's ``flood``/``inj`` words since round 11.
-        raise NotImplementedError(
-            "rpc_probe: paired-topic mode is not probe-supported (the "
-            "per-slot RPC split is not captured); run the probe on a "
-            "single-topic-per-peer config.  Remaining probe refusals: "
-            "paired_topics, mixed-protocol (flood_proto) overlays")
+    # rpc_probe coverage (round 13): PAIRED-TOPIC overlays are
+    # probe-supported — the snapshot carries the per-slot masks
+    # (fwd_b / graft_b / prune_b) and the slot-split payload words
+    # (fresh_a / fresh_b), and interop.export.rpc_events reconstructs
+    # per-slot GRAFT/PRUNE topics and a slot-split IHAVE.  The ONE
+    # remaining probe refusal is MIXED-PROTOCOL overlays (flood_proto,
+    # raised at trace time in the step where the params are visible);
+    # delay-armed sims also refuse the probe (see the delays block).
 
     # random-k selection backend.  The mosaic kernel (bit-identical
     # output) is kept as an option, but measured inside the real scanned
@@ -1874,7 +1974,7 @@ def make_gossip_step(cfg: GossipSimConfig,
                        gossip_bits, accept_bits, valid_w, tick, salt,
                        flood_bits=None, neg=None, sel_b=None,
                        fresh_b=None, fmasks=None, have_pre=None,
-                       rejoin_w=None):
+                       rejoin_w=None, dex=None):
         """Pallas path: one mega-kernel does the payload receive,
         handshake resolution, and per-edge counter/backoff updates in
         a single HBM pass over the [C, N] state (ops/pallas/receive).
@@ -2018,8 +2118,23 @@ def make_gossip_step(cfg: GossipSimConfig,
                     if sc is not None and params.sybil is not None
                     and (sc.sybil_ihave_spam or sc.sybil_iwant_spam)
                     else jnp.zeros_like(sub_all))
+        with_dl = dex is not None
         blocked = []
-        if sc is not None:
+        if with_dl:
+            # round-13 delay mode: the dequeued payload slot rides as
+            # one blocked [C*W, N] operand (receiver-alive masked
+            # here — the kernel consumes final arrival words), the
+            # handshake arrivals as pre-masked packed words; the
+            # sender streams and their DMA machinery are not built.
+            arr = dex["arr_pay"]
+            if fmasks is not None:
+                arr = arr & fmasks["alive_w"][None, None, :]
+            blocked += [arr.reshape(C * W, n_pad),
+                        dex["graft_arr"], dex["prune_arr"],
+                        dex["retract"]]
+            if track_promises:
+                blocked += [dex["cheat_arr"]]
+        elif sc is not None:
             blocked += [payload_bits, gossip_bits, accept_bits]
         blocked += [sub_all, params.cand_sub_bits, fanout, syb_mask,
                     would_accept, backoff_bits2, grafts, dropped,
@@ -2043,14 +2158,19 @@ def make_gossip_step(cfg: GossipSimConfig,
             blocked += [state.iwant_serves]
             if params.cand_same_ip is not None:
                 blocked += [params.cand_same_ip]
-        if fmasks is not None:
+        if fmasks is not None and not with_dl:
             blocked += [fmasks["alive_w"]]
             if sc is not None and sc.sybil_iwant_spam:
                 blocked += [fmasks["flood_ok"]]
-        with_f = fmasks is not None
+        with_f = fmasks is not None and not with_dl
+        # delay mode: the latency histogram is assembled in the
+        # epilogue from delivered_now (the in-kernel tallies count
+        # sender-stream views the delayed kernel does not hold)
         lat_b = (tel.latency_buckets
-                 if tel is not None and tel.latency_hist else 0)
-        with_t = tel is not None and (tel.counters or lat_b > 0)
+                 if tel is not None and tel.latency_hist
+                 and not with_dl else 0)
+        with_t = (tel is not None and (tel.counters or lat_b > 0)
+                  and not with_dl)
         if lat_b:
             # latency-bucket operands: the tick's message masks (SMEM,
             # replicated on the sharded path) and the effective
@@ -2061,6 +2181,14 @@ def make_gossip_step(cfg: GossipSimConfig,
             if sc is not None:
                 dlv_eff = dlv_eff & ~params.invalid_words[:, None]
             blocked += [dlv_eff]
+        if with_dl and shard_mesh is not None:
+            # named capability gap (graftlint probe-refusal registry):
+            # the delay-line enqueue's true-ring rolls and the halo
+            # exchange have not been composed
+            raise NotImplementedError(
+                "delays: the sharded (multi-chip) kernel path is not "
+                "delay-supported — run delayed kernel sims "
+                "single-device, or the XLA path under GSPMD")
         if shard_mesh is not None:
             # multi-chip: shard_map over the peer axis — per-shard
             # halo exchange (ICI collective-permutes) + the unmodified
@@ -2099,18 +2227,21 @@ def make_gossip_step(cfg: GossipSimConfig,
                                  pln["p32"], pln["e32"])
                      for w in range(W)])
 
-            flats = [flat8(ctrl_rows)]
-            if paired:
-                flats.append(flat8(ctrl2_rows))
-            flats.append(flat32(fresh))
-            if paired:
-                flats.append(flat32(fresh_b))
-            flats.append(flat32(adv))
-            if flood_bits is not None:
-                # flood-publish payload: the sender's own due
-                # publishes ride their own per-edge view
-                # (CTRL_FLOOD targets)
-                flats.append(flat32(injected))
+            if with_dl:
+                flats = []      # arrivals ride blocked, not streams
+            else:
+                flats = [flat8(ctrl_rows)]
+                if paired:
+                    flats.append(flat8(ctrl2_rows))
+                flats.append(flat32(fresh))
+                if paired:
+                    flats.append(flat32(fresh_b))
+                flats.append(flat32(adv))
+                if flood_bits is not None:
+                    # flood-publish payload: the sender's own due
+                    # publishes ride their own per-edge view
+                    # (CTRL_FLOOD targets)
+                    flats.append(flat32(injected))
             krn = make_receive_update(
                 cfg, sc, n_true, receive_block, cdt, W,
                 track_promises=track_promises,
@@ -2119,7 +2250,8 @@ def make_gossip_step(cfg: GossipSimConfig,
                 with_same_ip=params.cand_same_ip is not None,
                 with_static=with_static,
                 with_faults=with_f, with_telemetry=with_t,
-                tel_lat_buckets=lat_b, with_knobs=with_kn)
+                tel_lat_buckets=lat_b, with_knobs=with_kn,
+                with_delays=with_dl)
             base0 = jnp.zeros((1,), dtype=jnp.uint32)
             outs = krn(*head, base0, *flats, *blocked)
         tel_row = None
@@ -2194,7 +2326,11 @@ def make_gossip_step(cfg: GossipSimConfig,
             mesh_b=mesh_b_new, backoff_b=backoff_b_new,
             active=active_new, gates=gates_new,
             gates_fp=state.gates_fp,
-            inv_viol=state.inv_viol, inv_first=state.inv_first)
+            inv_viol=state.inv_viol, inv_first=state.inv_first,
+            pay_line=(dex["pay_line"] if with_dl else state.pay_line),
+            ctrl_line=(dex["ctrl_line"] if with_dl
+                       else state.ctrl_line),
+            gsp_line=state.gsp_line)
         if icfg is not None:
             new_state = apply_invariants(
                 params, state, new_state, have_pre, rejoin_w,
@@ -2284,22 +2420,40 @@ def make_gossip_step(cfg: GossipSimConfig,
                     score_t[:, :n_true], mask_t[:, :n_true],
                     tel.score_bucket_edges)
         if tel.latency_hist:
-            # in-kernel bucket tallies (rows TEL_ROWS..): exact i32
-            # counts of the same delivered-copy sets the XLA path
-            # scatters in latency_histogram — equal bit for bit (the
-            # sharded path psums the rows with the counters)
-            from ..ops.pallas.receive import TEL_ROWS
-            kw_f["latency_hist"] = tel_row[TEL_ROWS:].sum(
-                axis=1, dtype=jnp.int32)
+            if with_dl:
+                # delay mode: scatter delivered_now against the
+                # publish table in the epilogue — the in-kernel
+                # tallies count sender-stream views the delayed
+                # kernel does not hold.  Same values as the XLA
+                # path's histogram by construction.
+                kw_f["latency_hist"] = _telemetry.latency_histogram(
+                    delivered_now, params.publish_tick, tick,
+                    tel.latency_buckets)
+            else:
+                # in-kernel bucket tallies (rows TEL_ROWS..): exact
+                # i32 counts of the same delivered-copy sets the XLA
+                # path scatters in latency_histogram — equal bit for
+                # bit (the sharded path psums the rows with the
+                # counters)
+                from ..ops.pallas.receive import TEL_ROWS
+                kw_f["latency_hist"] = tel_row[TEL_ROWS:].sum(
+                    axis=1, dtype=jnp.int32)
         if tel.faults and fmasks is not None:
             # unpadded masks: pad lanes are alive-with-links-up by
-            # construction and must not enter the counts
+            # construction and must not enter the counts.  UNITS: with
+            # undirected (scalar/symmetric) drops, two packed views per
+            # edge — halve to undirected edge-ticks.  Under DIRECTED
+            # drops the tally is in DIRECTED edge-ticks by definition:
+            # each down direction counts 1, so a partition cut (both
+            # directions genuinely down) counts 2 — consistent within
+            # the mode, deliberately not comparable across modes.
             kw_f["down_peers"] = (~fmasks["alive_u"]).sum(
                 dtype=jnp.int32)
             if fmasks["link_u"] is not None:
                 kw_f["dropped_edge_ticks"] = (
                     popcount32(~fmasks["link_u"] & ALL).sum(
-                        dtype=jnp.int32) // 2)
+                        dtype=jnp.int32)
+                    // (1 if params.faults.directed_drops else 2))
         return new_state, delivered_now, _telemetry.make_frame(**kw_f)
 
     def step(params: GossipParams, state: GossipState):
@@ -2330,6 +2484,41 @@ def make_gossip_step(cfg: GossipSimConfig,
         # when the config toggle AND the mutator arrays are both there
         byz_mut = (sc is not None and sc.byzantine_mutation
                    and params.cand_byz is not None)
+        # -- round-13 event-driven time (models/delays.py): when the
+        # params carry DelayParams, every transfer rides the K-slot
+        # delay lines instead of arriving in-tick.  The named
+        # capability gaps raise here (graftlint probe-refusal
+        # registry): the probe's same-tick SEND/RECV reconstruction
+        # and the telemetry send/receive accounting both assume the
+        # one-tick-one-hop contract.
+        dl = params.delays
+        if dl is not None:
+            if paired:
+                raise NotImplementedError(
+                    "delays: paired-topic mode is not delay-supported "
+                    "(per-slot delay lines and delayed cross-slot "
+                    "control routing are not modeled); run delays on "
+                    "a single-topic-per-peer config")
+            if rpc_probe:
+                raise NotImplementedError(
+                    "rpc_probe: delay-armed sims are not "
+                    "probe-supported (the per-RPC reconstruction "
+                    "pairs SEND and RECV in one tick and cannot "
+                    "place in-flight delay slots); capture RPC "
+                    "streams on a delays=None build")
+            if tel is not None and tel.counters:
+                raise NotImplementedError(
+                    "delays: the telemetry counters group is not "
+                    "delay-supported (send/receive RPC accounting "
+                    "would need one delay line per traffic class) — "
+                    "run delays with TelemetryConfig(counters=False, "
+                    "wire=False); the histogram, gauge, and fault "
+                    "groups all thread")
+            if state.pay_line is None or state.ctrl_line is None:
+                raise ValueError(
+                    "delay-armed params need delay-line state: build "
+                    "(params, state) together through "
+                    "make_gossip_sim(..., delays=DelayConfig(...))")
         if kernel_on:
             if params.n_true is None:
                 raise ValueError(
@@ -2828,6 +3017,213 @@ def make_gossip_step(cfg: GossipSimConfig,
         mesh_sel, backoff_bits2 = sel_a["mesh_sel"], sel_a["backoff_bits2"]
         would_accept, a_sent = sel_a["would_accept"], sel_a["a_sent"]
 
+        # -- round-13 event-driven exchange (models/delays.py).  This
+        # tick's sends — exactly the pre-delay send words, gated at
+        # SEND time — roll toward their receivers and enqueue into the
+        # K-slot delay lines at slot (t + d - 1) mod K, d sampled per
+        # directed edge-tick; the tick's ARRIVALS dequeue from slot
+        # t mod K (d = 1 transfers pass straight through, which is why
+        # DelayConfig(1, 0, 1) is bit-identical to the pre-delay
+        # step).  Shared by the XLA paths and the kernel dispatch so
+        # the two can never drift.
+        def delay_exchange(split: bool):
+            K = dl.k_slots
+            M1 = jnp.uint32(0xFFFFFFFF)
+            nt = params.n_true
+
+            def roll_t(x, off):
+                # circulant rolls wrap at the TRUE ring on padded
+                # (kernel-path) states; pad lanes carry zeros
+                if nt is None or nt == n:
+                    return jnp.roll(x, off, axis=0)
+                return jnp.concatenate(
+                    [jnp.roll(x[:nt], off, axis=0), x[nt:]])
+
+            def transfer_t(bits, pair=False):
+                # the module-level edge-duality transfer, wrapping at
+                # the true ring on padded states
+                return transfer_bits(bits, cfg, pair=pair, n_true=nt)
+
+            d_edge = _delays.edge_delays(dl, (C, n), tick,
+                                         stride=n_stream)
+            slot_sel = _delays.slot_select_words(d_edge, tick, K)
+            cheat_raw = (jnp.where(withhold, targets, Z)
+                         if withhold is not None else None)
+
+            # ---- payload/gossip send words (SEND-time gating) ------
+            send_gsp = (targets if withhold is None
+                        else jnp.where(withhold, Z, targets))
+            if not split and sc is not None:
+                # combined form: the receiver's packed payload∧gossip
+                # gates travel to the sender as one pair transfer
+                open_word = ALL | (ALL << jnp.uint32(16))
+                gate_recv = jax.lax.cond(
+                    jnp.all((payload_bits & gossip_bits) == ALL),
+                    lambda: jnp.full_like(payload_bits, open_word),
+                    lambda: transfer_t(
+                        payload_bits
+                        | ((payload_bits & gossip_bits)
+                           << jnp.uint32(16)), pair=True))
+                send_fwd = out_bits & gate_recv
+                send_gsp = send_gsp & (gate_recv >> jnp.uint32(16))
+                send_flood = (flood_bits & gate_recv
+                              if flood_bits is not None else None)
+            else:
+                send_fwd, send_flood = out_bits, flood_bits
+
+            # ---- enqueue: roll each edge's fused (or per-class)
+            # word and route it to its sampled slot ------------------
+            def enqueue_edges(line, word_of):
+                """OR per-(slot, edge, word) contributions into a
+                [K, C, W, N] line; ``word_of(c_send, w)`` returns the
+                ROLLED, receiver-gated word for that edge."""
+                if W == 0:
+                    return line
+                adds = [[[None] * W for _ in range(C)]
+                        for _ in range(K)]
+                for c_send, off in enumerate(offsets):
+                    j = cinv[c_send]
+                    sel_j = [jnp.where(bit_row(slot_sel[s], j), M1, Z)
+                             for s in range(K)]
+                    for w in range(W):
+                        rolled = word_of(c_send, off, j, w)
+                        for s in range(K):
+                            adds[s][j][w] = rolled & sel_j[s]
+                return line | jnp.stack(
+                    [jnp.stack([jnp.stack(aw) for aw in ac])
+                     for ac in adds])
+
+            if not split:
+                def fused_word(c_send, off, j, w):
+                    m_f = bit_row(send_fwd, c_send)
+                    m_g = bit_row(send_gsp, c_send)
+                    sent = (jnp.where(m_f, fresh[w], Z)
+                            | jnp.where(m_g, adv[w], Z))
+                    if send_flood is not None:
+                        sent = sent | jnp.where(
+                            bit_row(send_flood, c_send), injected[w],
+                            Z)
+                    return roll_t(sent, off)
+
+                pay_line = enqueue_edges(state.pay_line, fused_word)
+                gsp_line = state.gsp_line
+                arr_pay, pay_line = _delays.line_dequeue(pay_line,
+                                                         tick)
+                arr_gsp = None
+            else:
+                # split form: mesh/eager and gossip classes keep their
+                # own lines (P3 needs the arrival provenance); the
+                # receiver gate words apply at enqueue, post-roll —
+                # the same values the pre-delay split loops produced
+                def mesh_word(c_send, off, j, w):
+                    sent = jnp.where(bit_row(send_fwd, c_send),
+                                     fresh[w], Z)
+                    if send_flood is not None:
+                        sent = sent | jnp.where(
+                            bit_row(send_flood, c_send), injected[w],
+                            Z)
+                    rolled = roll_t(sent, off)
+                    if sc is not None:
+                        rolled = jnp.where(bit_row(payload_bits, j),
+                                           rolled, Z)
+                    return rolled
+
+                def gsp_word(c_send, off, j, w):
+                    sent = jnp.where(bit_row(send_gsp, c_send),
+                                     adv[w], Z)
+                    rolled = roll_t(sent, off)
+                    if sc is not None:
+                        rolled = jnp.where(
+                            bit_row(payload_bits & gossip_bits, j),
+                            rolled, Z)
+                    return rolled
+
+                pay_line = enqueue_edges(state.pay_line, mesh_word)
+                gsp_line = enqueue_edges(state.gsp_line, gsp_word)
+                arr_pay, pay_line = _delays.line_dequeue(pay_line,
+                                                         tick)
+                arr_gsp, gsp_line = _delays.line_dequeue(gsp_line,
+                                                         tick)
+
+            # ---- control enqueue + dequeue -------------------------
+            if fp is not None:
+                grafts_tx = grafts & f_send_ok
+                dropped_tx = dropped & f_send_ok
+            else:
+                grafts_tx, dropped_tx = grafts, dropped
+            graft_fly = transfer_t(grafts_tx)
+            prune_fly = transfer_t(dropped_tx)
+            cheat_fly = None
+            if cheat_raw is not None:
+                # broken-promise adverts: gossip-gated at SEND like
+                # real gossip (the receiver only IWANTs accepted
+                # adverts), indexed at the receiver after transfer
+                cheat_fly = transfer_t(cheat_raw)
+                if sc is not None:
+                    cheat_fly = cheat_fly & payload_bits & gossip_bits
+            R = state.ctrl_line.shape[1]
+            zrow = jnp.zeros_like(graft_fly)
+            ctrl_line = state.ctrl_line | jnp.stack(
+                [jnp.stack([graft_fly & slot_sel[s],
+                            prune_fly & slot_sel[s], zrow]
+                           + ([cheat_fly & slot_sel[s]]
+                              if R == 4 else []))
+                 for s in range(K)])
+            arr_ctrl, ctrl_line = _delays.line_dequeue(ctrl_line,
+                                                       tick)
+            graft_raw = arr_ctrl[0]
+            prune_arr = arr_ctrl[1]
+            retr_arr = arr_ctrl[2]
+            cheat_arr = arr_ctrl[3] if R == 4 else None
+            if fp is not None:
+                # a down peer processes no inbound control
+                graft_raw = graft_raw & f_alive_all
+                prune_arr = prune_arr & f_alive_all
+                retr_arr = retr_arr & f_alive_all
+                if cheat_arr is not None:
+                    cheat_arr = cheat_arr & f_alive_all
+            if sc is not None:
+                # graylisted peers' control traffic dropped outright
+                # at ARRIVAL (AcceptFrom); the retraction leg is a
+                # PRUNE-response and is not graylist-gated, as in the
+                # pre-delay resolve
+                graft_arr = graft_raw & accept_bits
+                prune_arr = prune_arr & accept_bits
+            else:
+                graft_arr = graft_raw
+
+            # ---- handshake resolution at ARRIVAL + the delayed
+            # negative-acknowledgment second leg ---------------------
+            violation = graft_arr & backoff_bits2
+            accept = graft_arr & would_accept
+            conf = a_sent
+            if fp is not None:
+                # an unsendable confirmation counts as a rejection
+                # (the grafter's confirm window times out)
+                conf = conf & f_send_ok
+            retr_src = graft_raw & ~conf
+            retr_fly = transfer_t(retr_src)
+            d1_bits = pack_rows(d_edge == 1)
+            retract = retr_fly & d1_bits
+            retr_later = retr_fly & ~d1_bits
+            ctrl_line = ctrl_line | jnp.stack(
+                [jnp.stack([zrow, zrow, retr_later & slot_sel[s]]
+                           + ([zrow] if R == 4 else []))
+                 for s in range(K)])
+            if fp is not None:
+                # a failed local write is known immediately (the
+                # connection write errored) and a dead grafter
+                # processes no inbound retraction
+                retract = (retract & f_alive_all) | (grafts
+                                                     & ~f_send_ok)
+            retract = retract | retr_arr
+            return dict(arr_pay=arr_pay, arr_gsp=arr_gsp,
+                        pay_line=pay_line, gsp_line=gsp_line,
+                        ctrl_line=ctrl_line, graft_arr=graft_arr,
+                        prune_arr=prune_arr, retract=retract,
+                        cheat_arr=cheat_arr, violation=violation,
+                        accept=accept)
+
         rpc_snap = None
         if rpc_probe:
             if params.flood_proto is not None:
@@ -2835,8 +3231,8 @@ def make_gossip_step(cfg: GossipSimConfig,
                     "rpc_probe: mixed-protocol overlays are not "
                     "probe-supported (floodsub-proto flooding rides "
                     "outside the captured edge masks).  Remaining "
-                    "probe refusals: paired_topics, mixed-protocol "
-                    "(flood_proto) overlays")
+                    "probe refusals: mixed-protocol (flood_proto) "
+                    "overlays, delay-armed sims")
 
             def stk(rows):
                 return (jnp.stack(rows) if W
@@ -2859,6 +3255,23 @@ def make_gossip_step(cfg: GossipSimConfig,
                 alive=(f_alive if fp is not None
                        else jnp.ones((n,), dtype=bool)),
                 fresh=stk(fresh), adv=stk(adv), seen=stk(seen))
+            if paired:
+                # round 13 (the lifted refusal): the SLOT-B attempt
+                # masks and the slot-split payload words, so the
+                # exporter can emit per-slot GRAFT/PRUNE topics and
+                # split each edge's payload/IHAVE by topic slot
+                fwd_b_raw = state.mesh_b
+                if params.cand_direct is not None:
+                    fwd_b_raw = fwd_b_raw | (params.cand_direct
+                                             & params.cand_sub_bits)
+                if (sc is not None and sc.sybil_eclipse
+                        and params.eclipse_sybil is not None):
+                    fwd_b_raw = jnp.where(params.eclipse_sybil, Z,
+                                          fwd_b_raw)
+                rpc_snap.update(
+                    fwd_b=fwd_b_raw,
+                    graft_b=sel_b["grafts"], prune_b=sel_b["dropped"],
+                    fresh_a=stk(fresh_a), fresh_b=stk(fresh_b))
 
         if kernel_on:
             # PX rotation folds in BOTH slots' negative-score drops
@@ -2867,7 +3280,10 @@ def make_gossip_step(cfg: GossipSimConfig,
             if paired and sel_b["neg"] is not None:
                 neg_px = (sel_b["neg"] if neg_px is None
                           else neg_px | sel_b["neg"])
+            dex_k = (delay_exchange(split=False) if dl is not None
+                     else None)
             outk = _finish_kernel(
+                dex=dex_k,
                 params=params, state=state, fanout=fanout,
                 last_pub=last_pub, injected=injected,
                 fresh=(fresh_a if paired else fresh),
@@ -2937,7 +3353,104 @@ def make_gossip_step(cfg: GossipSimConfig,
         # trajectories (credit-policy differences are documented above).
         combined = (C <= 16 and (sc is None or not sc.track_p3)
                     and not force_split)
-        if combined:
+        dex = None
+        if dl is not None:
+            if not combined and state.gsp_line is None:
+                raise ValueError(
+                    "the split execution path under delays needs the "
+                    "gossip-class delay line: build the sim with "
+                    "make_gossip_sim(..., delays=..., "
+                    "delays_split=True)")
+            dex = delay_exchange(split=not combined)
+        if dex is not None and combined:
+            # -- 2+3 delayed (round 13): this tick's sends went into
+            # the delay line inside delay_exchange; what remains is
+            # the ARRIVAL half of the old fused loop — news split,
+            # Byzantine rejection, and the per-edge P2/P4 provenance
+            # counts — over the dequeued slot.
+            heard = [Z] * W
+            for j in range(C):
+                byz_j = bit_row(params.cand_byz, j) if byz_mut else None
+                fd_j = iv_j = None
+                for w in range(W):
+                    got = dex["arr_pay"][j, w]
+                    if fp is not None:
+                        got = got & f_alive_w  # down peers hear 0
+                    news = got & ~seen[w]
+                    if sc is not None:
+                        news = jax.lax.optimization_barrier(news)
+                    news_bad = None
+                    if byz_j is not None:
+                        news_bad = jnp.where(byz_j, news, Z)
+                        news = news & ~news_bad
+                    heard[w] = heard[w] | news
+                    if sc is not None:
+                        fd_j = acc(fd_j, pc(news & valid_w[w]))
+                        iv_j = acc(iv_j, pc(news & ~valid_w[w]))
+                        if news_bad is not None:
+                            iv_j = iv_j + pc(news_bad)
+                fd_add[j], inv_add[j] = fd_j, iv_j
+                if dex["cheat_arr"] is not None:
+                    broken_add[j] = (bit_row(dex["cheat_arr"], j)
+                                     & lack_any)
+            new_heard_bits = [jnp.where(sub, hw, Z) for hw in heard]
+        elif dex is not None:
+            # -- delayed SPLIT loops: mesh/eager and gossip arrivals
+            # keep their class provenance through separate lines (P3
+            # counts duplicate mesh copies at ARRIVAL)
+            mesh_heard = [Z] * W
+            for j in range(C):
+                byz_j = bit_row(params.cand_byz, j) if byz_mut else None
+                fd_j = md_j = iv_j = None
+                for w in range(W):
+                    got = dex["arr_pay"][j, w]
+                    if fp is not None:
+                        got = got & f_alive_w
+                    news = got & ~seen[w]
+                    news_bad = None
+                    if byz_j is not None:
+                        news_bad = jnp.where(byz_j, news, Z)
+                        news = news & ~news_bad
+                    mesh_heard[w] = mesh_heard[w] | news
+                    if sc is not None:
+                        fd_j = acc(fd_j, pc(news & valid_w[w]))
+                        if sc.track_p3:
+                            md_ok = (got if byz_j is None
+                                     else jnp.where(byz_j, Z, got))
+                            md_j = acc(md_j, pc(md_ok & valid_w[w]
+                                                & ~have_start[w]))
+                        iv_j = acc(iv_j, pc(news & ~valid_w[w]))
+                        if news_bad is not None:
+                            iv_j = iv_j + pc(news_bad)
+                fd_add[j], md_new[j], inv_add[j] = fd_j, md_j, iv_j
+            seen_g = [seen[w] | mesh_heard[w] for w in range(W)]
+            gossip_heard = [Z] * W
+            for j in range(C):
+                byz_j = bit_row(params.cand_byz, j) if byz_mut else None
+                for w in range(W):
+                    got = dex["arr_gsp"][j, w]
+                    if fp is not None:
+                        got = got & f_alive_w
+                    news = got & ~seen_g[w]
+                    news_bad = None
+                    if byz_j is not None:
+                        news_bad = jnp.where(byz_j, news, Z)
+                        news = news & ~news_bad
+                    gossip_heard[w] = gossip_heard[w] | news
+                    if sc is not None:
+                        fd_add[j] = acc(fd_add[j],
+                                        pc(news & valid_w[w]))
+                        inv_add[j] = acc(inv_add[j],
+                                         pc(news & ~valid_w[w]))
+                        if news_bad is not None:
+                            inv_add[j] = inv_add[j] + pc(news_bad)
+                if dex["cheat_arr"] is not None:
+                    broken_add[j] = (bit_row(dex["cheat_arr"], j)
+                                     & lack_any)
+            new_heard_bits = [
+                jnp.where(sub, mesh_heard[w] | gossip_heard[w], Z)
+                for w in range(W)]
+        elif combined:
             # -- 2+3 fused: ONE roll per edge carries the eager-forward,
             # flood-publish, AND lazy-gossip payloads.  The receiver-side
             # score gates (payload at graylist, payload∧gossip at gossip
@@ -3313,7 +3826,19 @@ def make_gossip_step(cfg: GossipSimConfig,
             # in the reference (gossipsub.go:856-937)
             return mesh_new, bo_trig, violation, prune_recv | retract
 
-        if not paired:
+        if dex is not None:
+            # delayed handshake (round 13): arrivals were resolved at
+            # dequeue time in delay_exchange — the same accept /
+            # violation / retraction algebra as resolve(), evaluated
+            # against the ARRIVAL tick's state, with the rejection
+            # round trip riding the ctrl line as a delayed retraction
+            mesh = ((mesh_sel | dex["accept"]) & ~dex["prune_arr"]
+                    ) & ~dex["retract"]
+            bo_trigger = dropped | dex["prune_arr"] | dex["retract"]
+            backoff_violation = dex["violation"]
+            px_rot = dex["prune_arr"] | dex["retract"]
+            mesh_b_new = violation_b = None
+        elif not paired:
             mesh, bo_trigger, backoff_violation, px_rot = resolve(
                 sel_a, *raw_transfers(sel_a))
             mesh_b_new = violation_b = None
@@ -3526,7 +4051,13 @@ def make_gossip_step(cfg: GossipSimConfig,
             key=state.key, tick=tick + 1, iwant_serves=iwant_serves,
             mesh_b=mesh_b_new, backoff_b=backoff_b, active=active_new,
             gates=state.gates, gates_fp=state.gates_fp,
-            inv_viol=state.inv_viol, inv_first=state.inv_first)
+            inv_viol=state.inv_viol, inv_first=state.inv_first,
+            pay_line=(dex["pay_line"] if dex is not None
+                      else state.pay_line),
+            ctrl_line=(dex["ctrl_line"] if dex is not None
+                       else state.ctrl_line),
+            gsp_line=(dex["gsp_line"] if dex is not None
+                      else state.gsp_line))
         if state.gates is not None:
             # emit the NEXT tick's gate words now, while the updated
             # counters are live in registers (XLA fuses the score math
@@ -3633,9 +4164,13 @@ def make_gossip_step(cfg: GossipSimConfig,
         if tel.faults and fp is not None:
             kw_f["down_peers"] = (~f_alive).sum(dtype=jnp.int32)
             if f_link is not None:
-                # one undirected edge has two packed views; halve
+                # UNITS: undirected mode halves the two views per edge;
+                # directed mode counts DIRECTED edge-ticks (a partition
+                # cut = 2: both directions are down) — see the kernel
+                # frame site
                 kw_f["dropped_edge_ticks"] = (
-                    popcount32(~f_link & ALL).sum(dtype=jnp.int32) // 2)
+                    popcount32(~f_link & ALL).sum(dtype=jnp.int32)
+                    // (1 if fp.directed_drops else 2))
         frame = _telemetry.make_frame(**kw_f)
         if rpc_probe:
             return new_state, delivered_now, frame, rpc_snap

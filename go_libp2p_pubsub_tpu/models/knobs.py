@@ -72,6 +72,13 @@ SIM_KNOB_FIELDS = (
 #: FaultParams leaves (make_gossip_sim), not carried on SimKnobs.
 FAULT_KNOB_FIELDS = ("drop_prob",)
 
+#: DelayConfig knobs (round 13, models/delays.py): traced overrides
+#: applied to the compiled DelayParams leaves by make_gossip_sim —
+#: the heartbeat/RTT ratio sweeps recompile-free, exactly like
+#: drop_prob.  Requires a DelayConfig on the sim (the delay-line code
+#: path must compile in; the line depth k_slots stays shape-bearing).
+DELAY_KNOB_FIELDS = ("delay_base", "delay_jitter")
+
 #: shape-bearing / mode-selecting fields, rejected BY NAME with the
 #: reason they must stay compile-time (the sweepd request validator and
 #: make_sim_knobs share this table).
@@ -90,6 +97,16 @@ STATIC_KNOB_REASONS = {
     "max_ihave_length": "a build-time static invariant, never run-time",
     "max_ihave_messages": "a build-time static invariant, never "
                           "run-time",
+    # the delay-line depth (models/delays.py DelayConfig.k_slots) is
+    # shape-bearing: it sizes the K-slot circular delay-line state
+    # carried through the scan.  Both spellings rejected by name.
+    "k_slots": "shapes the [K, ...] delay-line state carried through "
+               "the scan (models/delays.py) — sweep delay_base / "
+               "delay_jitter instead, within the compiled depth",
+    "delay_k_slots": "shapes the [K, ...] delay-line state carried "
+                     "through the scan (models/delays.py) — sweep "
+                     "delay_base / delay_jitter instead, within the "
+                     "compiled depth",
     # telemetry histogram shapes live on TelemetryConfig, but name the
     # common ones so a sweepd request that tries them gets the reason
     "latency_buckets": "shapes the telemetry latency histogram output",
@@ -157,14 +174,15 @@ class SimKnobs:
 
 
 def split_knob_overrides(overrides: dict, score_fields=None) -> tuple:
-    """Partition a raw knob dict into (protocol, score, fault) override
-    dicts, rejecting static fields by name and unknown fields with the
-    full valid-knob list.  ``score_fields`` defaults to gossipsub's
-    SCORE_KNOB_FIELDS (passed in to avoid the import cycle)."""
+    """Partition a raw knob dict into (protocol, score, fault, delay)
+    override dicts, rejecting static fields by name and unknown fields
+    with the full valid-knob list.  ``score_fields`` defaults to
+    gossipsub's SCORE_KNOB_FIELDS (passed in to avoid the import
+    cycle)."""
     if score_fields is None:
         from . import gossipsub as _gs
         score_fields = _gs.SCORE_KNOB_FIELDS
-    proto, score, fault = {}, {}, {}
+    proto, score, fault, delay = {}, {}, {}, {}
     for key, val in dict(overrides).items():
         if key in STATIC_KNOB_REASONS:
             raise KnobStaticFieldError(
@@ -178,11 +196,15 @@ def split_knob_overrides(overrides: dict, score_fields=None) -> tuple:
             score[key] = val
         elif key in FAULT_KNOB_FIELDS:
             fault[key] = val
+        elif key in DELAY_KNOB_FIELDS:
+            delay[key] = val
         else:
+            all_knobs = (SIM_KNOB_FIELDS + tuple(score_fields)
+                         + FAULT_KNOB_FIELDS + DELAY_KNOB_FIELDS)
             raise ValueError(
                 f"sim_knobs: unknown knob {key!r} — sweepable knobs "
-                f"are {SIM_KNOB_FIELDS + tuple(score_fields) + FAULT_KNOB_FIELDS}")
-    return proto, score, fault
+                f"are {all_knobs}")
+    return proto, score, fault, delay
 
 
 def _validate_point(vals: dict, n_candidates: int,
@@ -252,13 +274,14 @@ def make_sim_knobs(cfg, score_cfg=None, overrides: dict | None = None,
     invariants."""
     from . import gossipsub as _gs
 
-    proto, score_kv, fault = split_knob_overrides(
+    proto, score_kv, fault, delay = split_knob_overrides(
         overrides or {}, _gs.SCORE_KNOB_FIELDS)
-    if fault:
+    if fault or delay:
         raise ValueError(
-            "sim_knobs: fault knobs (drop_prob) are applied to the "
-            "compiled FaultParams by make_gossip_sim — pass them "
-            "through its sim_knobs dict, not make_sim_knobs directly")
+            "sim_knobs: fault/delay knobs (drop_prob, delay_base, "
+            "delay_jitter) are applied to the compiled FaultParams/"
+            "DelayParams by make_gossip_sim — pass them through its "
+            "sim_knobs dict, not make_sim_knobs directly")
     vals = knob_values(cfg, proto)
     _validate_point(vals, cfg.n_candidates, px_candidates)
 

@@ -53,6 +53,7 @@ from ._delivery import (
     reach_counts_from_first_tick,
     update_first_tick,
 )
+from . import delays as _delays
 from . import faults as _faults
 from . import invariants as _invariants
 from . import telemetry as _telemetry
@@ -97,6 +98,12 @@ class RandomSubParams:
     publish_tick: jnp.ndarray    # int32 [M]
     # compiled fault schedule (models/faults.py) — circulant step only
     faults: _faults.FaultParams | None = None
+    # round-13 event-driven time (models/delays.py): randomsub's
+    # sender is a pure function of (frontier, tick), so the delay
+    # line compiles to the state's frontier-history ring plus per-lag
+    # replayed send/delay draws (both the circulant rolls and the
+    # dense MXU adjacency are re-drawable hashes)
+    delays: _delays.DelayParams | None = None
 
 
 @struct.dataclass
@@ -111,6 +118,10 @@ class RandomSubState:
     # state; invariants.attach(state) arms them
     inv_viol: jnp.ndarray | None = None      # uint32 []
     inv_first: jnp.ndarray | None = None     # int32 []
+    # round-13 frontier-history ring (delay-armed sims only): slot
+    # t mod K holds the tick-t frontier (fresh | injected), so lag-l
+    # arrivals replay the tick-(t-l) sends exactly
+    src_ring: jnp.ndarray | None = None      # uint32 [K, W, N]
 
 
 def make_randomsub_sim(cfg: RandomSubSimConfig, subs: np.ndarray,
@@ -118,7 +129,8 @@ def make_randomsub_sim(cfg: RandomSubSimConfig, subs: np.ndarray,
                        msg_publish_tick: np.ndarray, seed: int = 0,
                        track_first_tick: bool = True,
                        dense: bool = False,
-                       fault_schedule: _faults.FaultSchedule | None = None):
+                       fault_schedule: _faults.FaultSchedule | None = None,
+                       delays: _delays.DelayConfig | None = None):
     """Build (params, state).  Same residue-class topic model as the
     GossipSub simulator: peer p may only subscribe to topic p mod T.
 
@@ -187,6 +199,8 @@ def make_randomsub_sim(cfg: RandomSubSimConfig, subs: np.ndarray,
                 if dense
                 else _faults.compile_faults(fault_schedule, cfg.offsets,
                                             pack_links=False)),
+        delays=(None if delays is None
+                else _delays.compile_delays(delays)),
     )
     w = params.origin_words.shape[0]
     state = RandomSubState(
@@ -196,6 +210,9 @@ def make_randomsub_sim(cfg: RandomSubSimConfig, subs: np.ndarray,
                     if track_first_tick else None),
         key=jax.random.PRNGKey(seed),
         tick=jnp.zeros((), dtype=jnp.int32),
+        src_ring=(None if delays is None
+                  else jnp.zeros((int(delays.k_slots), w, n),
+                                 dtype=jnp.uint32)),
     )
     return params, state
 
@@ -251,31 +268,89 @@ def make_randomsub_step(cfg: RandomSubSimConfig,
             injected = [inj & aw for inj in injected]
         frontier = [state.fresh[w] | injected[w] for w in range(W)]
 
-        # per-edge Bernoulli sends of the frontier (fresh draw per tick)
-        u = lane_uniform((C, n), tick, 1, salt)
-        send = params.cand_subscribed & (u < params.send_prob[None, :])
-        if fp is not None:
-            # a down peer sends nothing; a down link carries nothing
-            send = send & alive[None, :]
-            link = _faults.link_ok_rows(fp, offsets, cinv, tick)
-            if link is not None:
-                send = send & link
         tel_sent = tel_recv = None
         if tel is not None and tel.counters:
             tel_sent = jnp.int32(0)
             tel_recv = jnp.int32(0)
-        heard = [Z] * W
-        for c, off in enumerate(offsets):
-            mask_c = send[c]
-            for w in range(W):
-                sent = jnp.where(mask_c, frontier[w], Z)
-                rolled = jnp.roll(sent, off, axis=0)
-                heard[w] = heard[w] | rolled
-                if tel_sent is not None:
-                    tel_sent += pc(sent).sum(dtype=jnp.int32)
-                    tel_recv += pc(rolled if aw is None
-                                   else rolled & aw).sum(
-                        dtype=jnp.int32)
+        dlp = params.delays
+        ring_new = state.src_ring
+        if dlp is None:
+            # per-edge Bernoulli sends of the frontier (fresh draw
+            # per tick), arriving in-tick — the pre-delay hop
+            u = lane_uniform((C, n), tick, 1, salt)
+            send = params.cand_subscribed & (u
+                                             < params.send_prob[None, :])
+            if fp is not None:
+                # a down peer sends nothing; a down link carries
+                # nothing
+                send = send & alive[None, :]
+                link = _faults.link_ok_rows(fp, offsets, cinv, tick)
+                if link is not None:
+                    send = send & link
+            heard = [Z] * W
+            for c, off in enumerate(offsets):
+                mask_c = send[c]
+                for w in range(W):
+                    sent = jnp.where(mask_c, frontier[w], Z)
+                    rolled = jnp.roll(sent, off, axis=0)
+                    heard[w] = heard[w] | rolled
+                    if tel_sent is not None:
+                        tel_sent += pc(sent).sum(dtype=jnp.int32)
+                        tel_recv += pc(rolled if aw is None
+                                       else rolled & aw).sum(
+                            dtype=jnp.int32)
+        else:
+            # round-13 event-driven hop (models/delays.py): lag-l
+            # arrivals replay the tick-(t-l) sends from the frontier
+            # ring — the send draw, fault masks, and delay draw at
+            # the SEND tick are all stateless hashes
+            K = dlp.k_slots
+            heard = [Z] * W
+            for lag in range(K):
+                t_s = tick - lag
+                if lag == 0:
+                    fr_l = frontier
+                else:
+                    fr_arr = jax.lax.dynamic_index_in_dim(
+                        state.src_ring, jnp.mod(t_s, K), axis=0,
+                        keepdims=False)
+                    fr_l = [fr_arr[w] for w in range(W)]
+                u_l = lane_uniform((C, n), t_s, 1, salt)
+                send_l = params.cand_subscribed & (
+                    u_l < params.send_prob[None, :])
+                if fp is not None:
+                    send_l = send_l & _faults.alive_mask(
+                        fp, t_s)[None, :]
+                    link_l = _faults.link_ok_rows(fp, offsets, cinv,
+                                                  t_s)
+                    if link_l is not None:
+                        send_l = send_l & link_l
+                if lag == 0:
+                    link = (link_l if fp is not None else None)
+                    if tel_sent is not None:
+                        # copies SENT this tick (every delay class)
+                        for c in range(C):
+                            for w in range(W):
+                                tel_sent += pc(jnp.where(
+                                    send_l[c], frontier[w], Z)).sum(
+                                    dtype=jnp.int32)
+                send_l = send_l & _delays.arrive_now(dlp, (C, n),
+                                                     t_s, lag)
+                for c, off in enumerate(offsets):
+                    mask_c = send_l[c]
+                    for w in range(W):
+                        sent = jnp.where(mask_c, fr_l[w], Z)
+                        rolled = jnp.roll(sent, off, axis=0)
+                        heard[w] = heard[w] | rolled
+                        if tel_recv is not None:
+                            tel_recv += pc(rolled if aw is None
+                                           else rolled & aw).sum(
+                                dtype=jnp.int32)
+            frontier_arr = (jnp.stack(frontier) if W
+                            else jnp.zeros((0, n), dtype=jnp.uint32))
+            ring_new = jax.lax.dynamic_update_slice_in_dim(
+                state.src_ring, frontier_arr[None], jnp.mod(tick, K),
+                axis=0)
 
         if fp is not None:
             # a down peer receives nothing
@@ -298,7 +373,8 @@ def make_randomsub_step(cfg: RandomSubSimConfig,
         new_state = RandomSubState(
             have=have, fresh=new, first_tick=first_tick,
             key=state.key, tick=tick + 1,
-            inv_viol=state.inv_viol, inv_first=state.inv_first)
+            inv_viol=state.inv_viol, inv_first=state.inv_first,
+            src_ring=ring_new)
         if tel is None:
             return new_state, delivered_now
         kw_f = {}
@@ -316,8 +392,12 @@ def make_randomsub_step(cfg: RandomSubSimConfig,
         if tel.faults and fp is not None:
             kw_f["down_peers"] = (~alive).sum(dtype=jnp.int32)
             if link is not None:
+                # UNITS: undirected mode halves the two views per
+                # edge; directed mode counts DIRECTED edge-ticks (a
+                # partition cut downs both directions and counts 2)
                 kw_f["dropped_edge_ticks"] = (
-                    (~link).sum(dtype=jnp.int32) // 2)
+                    (~link).sum(dtype=jnp.int32)
+                    // (1 if fp.directed_drops else 2))
         return new_state, delivered_now, _telemetry.make_frame(**kw_f)
 
     if invariants is not None:
@@ -388,25 +468,74 @@ def make_randomsub_dense_step(cfg: RandomSubSimConfig,
         # a peer's frontier is already in its own seen set, so they are
         # no-ops downstream; cross-topic sends only need masking for
         # T > 1 (same residue class).
-        u = lane_uniform((n, n), tick, 1, salt)
-        adj = u < params.send_prob[None, :]
+        pq = None
         if T > 1:
             pq = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0) \
                 - jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
-            adj = adj & ((pq % T) == 0)
-        link = None
-        if fp is not None:
-            # a down peer sends nothing; a cut pair carries nothing
-            adj = adj & alive[None, :]
-            link = _faults.link_ok_dense(fp, n, tick)
-            if link is not None:
-                adj = adj & link
-        adj_send = adj          # sender-side view (sent = left the peer)
-        if fp is not None:
-            adj = adj & alive[:, None]          # receiver up
 
-        cnt = jnp.dot(adj.astype(jnp.bfloat16), fmat,
-                      preferred_element_type=jnp.float32)       # [N, M]
+        def draw_adj(t_s):
+            """The sender-side adjacency of tick ``t_s`` (stateless
+            redraw — the delay replay evaluates past ticks)."""
+            u_l = lane_uniform((n, n), t_s, 1, salt)
+            a = u_l < params.send_prob[None, :]
+            if pq is not None:
+                a = a & ((pq % T) == 0)
+            lnk = None
+            if fp is not None:
+                # a down peer sends nothing; a cut pair carries
+                # nothing
+                a = a & _faults.alive_mask(fp, t_s)[None, :]
+                lnk = _faults.link_ok_dense(fp, n, t_s)
+                if lnk is not None:
+                    a = a & lnk
+            return a, lnk
+
+        dlp = params.delays
+        ring_new = state.src_ring
+        recv_adjs = None
+        if dlp is None:
+            adj, link = draw_adj(tick)
+            adj_send = adj      # sender-side view (sent = left the peer)
+            if fp is not None:
+                adj = adj & alive[:, None]          # receiver up
+            cnt = jnp.dot(adj.astype(jnp.bfloat16), fmat,
+                          preferred_element_type=jnp.float32)   # [N, M]
+        else:
+            # round-13 event-driven hop: K lag matmuls — the lag-l
+            # adjacency is tick-(t-l)'s redraw masked to the pairs
+            # whose sampled delay was exactly l+1, contracted against
+            # that tick's frontier from the ring
+            K = dlp.k_slots
+            cnt = None
+            recv_adjs = []      # (arrival adjacency, frontier) pairs
+            for lag in range(K):
+                t_s = tick - lag
+                if lag == 0:
+                    fr_l, fmat_l = frontier, fmat
+                else:
+                    fr_arr = jax.lax.dynamic_index_in_dim(
+                        state.src_ring, jnp.mod(t_s, K), axis=0,
+                        keepdims=False)
+                    fr_l = [fr_arr[w] for w in range(W)]
+                    cols_l = [((fr_l[w][:, None] >> shifts)
+                               & jnp.uint32(1)) for w in range(W)]
+                    fmat_l = jnp.concatenate(cols_l, axis=1).astype(
+                        jnp.bfloat16)
+                a_l, lnk_l = draw_adj(t_s)
+                if lag == 0:
+                    adj_send, link = a_l, lnk_l
+                a_l = a_l & _delays.arrive_now(dlp, (n, n), t_s, lag)
+                if fp is not None:
+                    a_l = a_l & alive[:, None]      # receiver up NOW
+                recv_adjs.append((a_l, fr_l))
+                term = jnp.dot(a_l.astype(jnp.bfloat16), fmat_l,
+                               preferred_element_type=jnp.float32)
+                cnt = term if cnt is None else cnt + term
+            frontier_arr = (jnp.stack(frontier) if W
+                            else jnp.zeros((0, n), dtype=jnp.uint32))
+            ring_new = jax.lax.dynamic_update_slice_in_dim(
+                state.src_ring, frontier_arr[None], jnp.mod(tick, K),
+                axis=0)
         heard_bits = (cnt > 0.5)
         heard = [
             (heard_bits[:, w * WORD_BITS:(w + 1) * WORD_BITS]
@@ -430,7 +559,8 @@ def make_randomsub_dense_step(cfg: RandomSubSimConfig,
         new_state = RandomSubState(
             have=have, fresh=new, first_tick=first_tick,
             key=state.key, tick=tick + 1,
-            inv_viol=state.inv_viol, inv_first=state.inv_first)
+            inv_viol=state.inv_viol, inv_first=state.inv_first,
+            src_ring=ring_new)
         if tel is None:
             return new_state, delivered_now
         kw_f = {}
@@ -439,17 +569,28 @@ def make_randomsub_dense_step(cfg: RandomSubSimConfig,
             # the sender's whole frontier, so copies = frontier
             # popcount weighted by the (masked) adjacency — summed in
             # i32, not read off the bf16 matmul
-            frontier_cnt = None
-            for w in range(W):
-                pcw = pc(frontier[w]).astype(jnp.int32)
-                frontier_cnt = (pcw if frontier_cnt is None
-                                else frontier_cnt + pcw)
-            if frontier_cnt is None:
-                frontier_cnt = jnp.zeros((n,), dtype=jnp.int32)
+            def cnt_of(fr):
+                out = None
+                for w in range(W):
+                    pcw = pc(fr[w]).astype(jnp.int32)
+                    out = pcw if out is None else out + pcw
+                return (out if out is not None
+                        else jnp.zeros((n,), dtype=jnp.int32))
+
+            frontier_cnt = cnt_of(frontier)
             sent_cnt = jnp.where(adj_send, frontier_cnt[None, :],
                                  0).sum(dtype=jnp.int32)
-            recv_cnt = jnp.where(adj, frontier_cnt[None, :],
-                                 0).sum(dtype=jnp.int32)
+            if recv_adjs is None:
+                recv_cnt = jnp.where(adj, frontier_cnt[None, :],
+                                     0).sum(dtype=jnp.int32)
+            else:
+                # delayed arrivals: each lag's adjacency carries that
+                # send tick's frontier
+                recv_cnt = jnp.int32(0)
+                for a_l, fr_l in recv_adjs:
+                    recv_cnt = recv_cnt + jnp.where(
+                        a_l, cnt_of(fr_l)[None, :], 0).sum(
+                        dtype=jnp.int32)
             kw_f.update(payload_sent=sent_cnt,
                         dup_suppressed=recv_cnt - pc(new).sum(
                             dtype=jnp.int32))

@@ -296,7 +296,7 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
                     with_px=False, with_same_ip=False,
                     with_static=True, with_faults=False,
                     with_telemetry=False, tel_lat_buckets=0,
-                    with_knobs=False):
+                    with_knobs=False, with_delays=False):
     C = cfg.n_candidates
     B = block
     cinv = cfg.cinv
@@ -332,15 +332,30 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     #                         position 0 (nonzero on the sharded
     #                         path: each shard's kernel must draw
     #                         the GLOBAL peer's uniform stream)
-    ctrl_hbm = nxt()
-    ctrl2_hbm = nxt() if paired else None
-    fresh_hbm = nxt()
-    freshb_hbm = nxt() if paired else None
-    adv_hbm = nxt()
-    inj_hbm = nxt() if flood_pub else None
-    pay_ref = nxt() if has_sc else None
-    gsp_ref = nxt() if has_sc else None
-    acc_ref = nxt() if has_sc else None
+    if with_delays:
+        # round-13 delay mode: the payload delay-line's dequeued slot
+        # rides as ONE blocked operand (arrivals per receiving edge,
+        # already send-gated, rolled, and receiver-alive masked in the
+        # XLA enqueue), and the handshake arrivals come pre-resolved
+        # as packed words — no sender streams, no DMA machinery.
+        arr_ref = nxt()         # u32 [C*W, B] (row j*W + w)
+        garr_ref = nxt()        # u32 [B] GRAFT arrivals (masked)
+        parr_ref = nxt()        # u32 [B] PRUNE arrivals (masked)
+        rarr_ref = nxt()        # u32 [B] retraction union
+        charr_ref = nxt() if track_promises else None
+        ctrl_hbm = ctrl2_hbm = fresh_hbm = None
+        freshb_hbm = adv_hbm = inj_hbm = None
+        pay_ref = gsp_ref = acc_ref = None
+    else:
+        ctrl_hbm = nxt()
+        ctrl2_hbm = nxt() if paired else None
+        fresh_hbm = nxt()
+        freshb_hbm = nxt() if paired else None
+        adv_hbm = nxt()
+        inj_hbm = nxt() if flood_pub else None
+        pay_ref = nxt() if has_sc else None
+        gsp_ref = nxt() if has_sc else None
+        acc_ref = nxt() if has_sc else None
     sub_ref = nxt()
     csub_ref = nxt()        # cand_sub_bits
     fan_ref = nxt()         # updated fanout (tick t's phase-1b output)
@@ -388,14 +403,17 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
         out_iws = nxt()
     out_px = nxt() if with_px else None
     out_tel = nxt() if with_telemetry else None
-    cbufs = [nxt() for _ in range(N_SLOTS)]
-    c2bufs = [nxt() for _ in range(N_SLOTS)] if paired else None
-    # payload buffers: [slot][fresh w... adv w...], all separate 1-D
-    # scratches (DMA into a row of a 2-D VMEM buffer hits sublane
-    # alignment limits)
-    pbufs = [[nxt() for _ in range(n_pay * W)]
-             for _ in range(N_SLOTS)]
-    sems = nxt()
+    if with_delays:
+        cbufs = c2bufs = pbufs = sems = None
+    else:
+        cbufs = [nxt() for _ in range(N_SLOTS)]
+        c2bufs = [nxt() for _ in range(N_SLOTS)] if paired else None
+        # payload buffers: [slot][fresh w... adv w...], all separate
+        # 1-D scratches (DMA into a row of a 2-D VMEM buffer hits
+        # sublane alignment limits)
+        pbufs = [[nxt() for _ in range(n_pay * W)]
+                 for _ in range(N_SLOTS)]
+        sems = nxt()
 
     i = pl.program_id(0)
     aligned = pln["aligned"]
@@ -449,13 +467,15 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
             for k in range(n_pay):
                 dma_pay(slot, j, k, w).wait()
 
-    for j0 in range(min(N_SLOTS - 1, C)):
-        start_all(j0 % N_SLOTS, j0)
+    if not with_delays:
+        for j0 in range(min(N_SLOTS - 1, C)):
+            start_all(j0 % N_SLOTS, j0)
 
     sub_all = sub_ref[...]
     if has_sc:
-        pay_bits = pay_ref[...]
-        gsp_bits = gsp_ref[...]
+        if not with_delays:
+            pay_bits = pay_ref[...]
+            gsp_bits = gsp_ref[...]
         valid = [valid_ref[w] for w in range(W)]
     seen_a = seen_ref[...]
     seen = [seen_a[w] for w in range(W)]
@@ -490,7 +510,38 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
         prune_recv_b = jnp.zeros((B,), jnp.uint32)
         a_recv_b = jnp.zeros((B,), jnp.uint32)
 
-    for j in range(C):
+    if with_delays:
+        # round-13 arrivals: the dequeued delay-line slot's per-edge
+        # words are already send-gated, rolled, and receiver-alive
+        # masked (XLA enqueue side) — per edge only the news split
+        # and the P2/P4 provenance counts remain; the handshake
+        # arrivals come as pre-masked packed words.
+        for j in range(C):
+            fd_j = iv_j = None
+            for w in range(W):
+                news = arr_ref[j * W + w] & ~seen[w]
+                heard[w] = heard[w] | news
+                if has_sc:
+                    nv = jax.lax.population_count(
+                        news & valid[w]).astype(jnp.int32)
+                    ni = jax.lax.population_count(
+                        news & ~valid[w]).astype(jnp.int32)
+                    fd_j = nv if fd_j is None else fd_j + nv
+                    iv_j = ni if iv_j is None else iv_j + ni
+            fd_cnt[j], inv_cnt[j] = fd_j, iv_j
+        graft_recv = garr_ref[...]
+        prune_recv = parr_ref[...]
+        retract_in = rarr_ref[...]
+        if track_promises:
+            # behavioral broken promise at ARRIVAL: the delayed
+            # advert word (send-gated at enqueue) against the
+            # receiver currently lacking some possible id
+            broken_recv = charr_ref[...] & jnp.where(
+                lacked != 0, jnp.uint32(0xFFFFFFFF), Z)
+
+    # sender-stream edge loop (skipped whole in delay mode — the
+    # block above consumed the arrival operands instead)
+    for j in (() if with_delays else range(C)):
         if j + N_SLOTS - 1 < C:
             start_all((j + N_SLOTS - 1) % N_SLOTS, j + N_SLOTS - 1)
         wait_all(j % N_SLOTS, j)
@@ -625,7 +676,7 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
             broken_recv = broken_recv | (
                 (adv_r & (u1 ^ m_g) & okg_u & lacked) << jnp.uint32(j))
 
-    if with_faults:
+    if with_faults and not with_delays:
         # a down receiver processes no inbound control and records no
         # broken promise this tick (XLA resolve: & f_alive_all / the
         # lack_any & f_alive gate); the alive word is all-ones or
@@ -639,7 +690,7 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
             graft_recv_b = graft_recv_b & alive_w_blk
             prune_recv_b = prune_recv_b & alive_w_blk
             a_recv_b = a_recv_b & alive_w_blk
-    if has_sc:
+    if has_sc and not with_delays:
         accb = acc_ref[...]
         graft_recv = graft_recv & accb
         prune_recv = prune_recv & accb
@@ -652,7 +703,10 @@ def _receive_kernel(*refs, cfg, sc, block, n_true, w_words,
     dropped = drop_ref[...]
     viol = graft_recv & bo2
     accept = graft_recv & wa
-    retract = grafts & ~a_recv
+    # delay mode: the retraction union (delayed negative-ack second
+    # leg + failed-send retractions) arrives pre-resolved from the
+    # ctrl delay line; otherwise the same-tick positive-ack round trip
+    retract = retract_in if with_delays else (grafts & ~a_recv)
     mesh = ((meshsel_ref[...] | accept) & ~prune_recv) & ~retract
     out_mesh[...] = mesh
     bo_trig = dropped | prune_recv | retract
@@ -1138,8 +1192,23 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
                         with_faults: bool = False,
                         with_telemetry: bool = False,
                         tel_lat_buckets: int = 0,
-                        with_knobs: bool = False):
+                        with_knobs: bool = False,
+                        with_delays: bool = False):
     """Build the kernel caller.
+
+    ``with_delays`` (round 13, models/delays.py): the payload
+    delay-line's DEQUEUED slot replaces the sender streams — operands
+    become [valid (sc)], gseeds, [knobs], base, arr u32 [C*W, N_pad]
+    (blocked; row j*W + w = the tick's arrivals over receiving edge
+    j, already send-gated/rolled/receiver-alive-masked by the XLA
+    enqueue), graft/prune/retract[, cheat (track_promises)] u32
+    [N_pad] pre-masked handshake arrival words, then the per-peer
+    operands from ``sub`` onward unchanged (no ctrl/fresh/adv flats,
+    no pay/gsp/acc gate words, no fault/telemetry operands —
+    with_faults/with_telemetry must be False; arrival masking and the
+    frame live on the XLA side).  The enqueue itself is XLA (the line
+    is state), so delay mode trades the kernel's roll elision for the
+    fused counter/handshake/gate machinery.
 
     Operand order (args): [valid u32 [W] (sc only)], gseeds u32 [2]
     (tick+1 gater + targets lane seeds), [knobs f32 [3 or 7]
@@ -1195,6 +1264,11 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
     n_pad, grid = pln["n_pad"], pln["grid"]
     B = block
     W = w_words
+    if with_delays:
+        # arrival masking and the telemetry frame live on the XLA
+        # side in delay mode; paired is refused upstream
+        assert not (with_faults or with_telemetry or paired), \
+            "with_delays composes its fault/telemetry work in XLA"
 
     kern = functools.partial(
         _receive_kernel, cfg=cfg, sc=sc, block=block, n_true=n_true,
@@ -1203,7 +1277,8 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
         stream_n=stream_n, with_px=with_px,
         with_same_ip=with_same_ip, with_static=with_static,
         with_faults=with_faults, with_telemetry=with_telemetry,
-        tel_lat_buckets=tel_lat_buckets, with_knobs=with_knobs)
+        tel_lat_buckets=tel_lat_buckets, with_knobs=with_knobs,
+        with_delays=with_delays)
 
     b1 = lambda: pl.BlockSpec((B,), lambda i: (i,))  # noqa: E731
     bw = lambda: pl.BlockSpec((W, B), lambda i: (0, i))  # noqa: E731
@@ -1219,10 +1294,16 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
     if with_telemetry and tel_lat_buckets:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))  # latmask
     in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))      # base
-    # flats: ctrl(, ctrl2), fresh(, fresh_b), adv(, injected)
-    in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * (n_ctrl + n_pay)
-    if has_sc:
-        in_specs += [b1(), b1(), b1()]        # pay, gsp, acc
+    if with_delays:
+        # arr [C*W, B] + the pre-masked handshake arrival words
+        in_specs += [pl.BlockSpec((C * W, B), lambda i: (0, i))]
+        in_specs += [b1()] * (3 + (1 if track_promises else 0))
+    else:
+        # flats: ctrl(, ctrl2), fresh(, fresh_b), adv(, injected)
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * (n_ctrl
+                                                           + n_pay)
+        if has_sc:
+            in_specs += [b1(), b1(), b1()]    # pay, gsp, acc
     # sub, cand_sub, fanout, sybil, wa, bo2, grafts, dropped, meshsel
     # (+ the slot-B handshake words in paired mode)
     in_specs += [b1()] * (14 if paired else 9)
@@ -1282,7 +1363,7 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
         out_shape += [jax.ShapeDtypeStruct((n_tel, 128), jnp.int32)]
         out_specs += [pl.BlockSpec((n_tel, 128), lambda i: (0, 0))]
 
-    scratch = (
+    scratch = () if with_delays else (
         [pltpu.VMEM((B + ALIGN8,), jnp.uint8)]
         * (N_SLOTS * n_ctrl)
         + [pltpu.VMEM((B + ALIGN32,), jnp.uint32)]

@@ -96,22 +96,63 @@ def test_schedule_validation_names_field(kw, field):
         fl.FaultSchedule(n_peers=20, horizon=100, **kw)
 
 
-def test_schedule_per_edge_drop_prob_symmetry_checked():
+def test_schedule_per_edge_drop_prob_symmetry_detected():
+    # round 13: an asymmetric [C, N] array no longer raises — it
+    # selects the per-DIRECTION draw; a symmetric one keeps the
+    # shared-coin undirected path (directed_drops stays False)
     n = 60
     offs = tuple(int(o) for o in make_circulant_offsets(1, 4, n, seed=0))
     asym = np.zeros((4, n), dtype=np.float32)
     asym[0, 3] = 0.5     # one view of an edge, not its partner view
     sched = fl.FaultSchedule(n_peers=n, horizon=10, drop_prob=asym)
-    with pytest.raises(ValueError, match="drop_prob"):
-        fl.compile_faults(sched, offs)
-    # the symmetrized form compiles
+    assert fl.compile_faults(sched, offs).directed_drops
+    # the symmetrized form compiles to the undirected shared-coin path
     sym = np.zeros((4, n), dtype=np.float32)
     idx = {o: i for i, o in enumerate(offs)}
     cinv = [idx[-o] for o in offs]
     sym[0, 3] = 0.5
     sym[cinv[0], (3 + offs[0]) % n] = 0.5
-    fl.compile_faults(
+    assert not fl.compile_faults(
+        fl.FaultSchedule(n_peers=n, horizon=10, drop_prob=sym),
+        offs).directed_drops
+
+
+def test_directed_drop_prob_per_direction_loss():
+    """Asymmetric [C, N] drop_prob: the lossy direction drops at its
+    own rate while the reverse view stays (nearly) clean, and the
+    symmetric-array path remains bit-identical to the scalar draw."""
+    n = 80
+    offs = tuple(int(o) for o in make_circulant_offsets(1, 4, n, seed=0))
+    idx = {o: i for i, o in enumerate(offs)}
+    cinv = [idx[-o] for o in offs]
+    # symmetric array == scalar, bit for bit
+    sym = np.full((4, n), 0.2, dtype=np.float32)
+    fp_a = fl.compile_faults(
         fl.FaultSchedule(n_peers=n, horizon=10, drop_prob=sym), offs)
+    fp_s = fl.compile_faults(
+        fl.FaultSchedule(n_peers=n, horizon=10, drop_prob=0.2), offs)
+    for t in range(5):
+        np.testing.assert_array_equal(
+            np.asarray(fl.link_ok_bits(fp_a, offs, cinv, jnp.int32(t))),
+            np.asarray(fl.link_ok_bits(fp_s, offs, cinv, jnp.int32(t))))
+    # directed: direction 0 lossy, everything else clean
+    asym = np.zeros((4, n), dtype=np.float32)
+    asym[0, :] = 0.9
+    fp_d = fl.compile_faults(
+        fl.FaultSchedule(n_peers=n, horizon=10, drop_prob=asym), offs)
+    ups = np.stack([np.asarray(fl.link_ok_bits(
+        fp_d, offs, cinv, jnp.int32(t))) for t in range(20)])
+    up0 = ((ups >> 0) & 1).mean()
+    up_rev = ((ups >> cinv[0]) & 1).mean()
+    assert up0 < 0.25, up0            # ~10% up
+    assert up_rev == 1.0, up_rev      # reverse direction never drops
+    # the unpacked rows form agrees with the packed form
+    rows = fl.link_ok_rows(fp_d, offs, cinv, jnp.int32(3))
+    bits = fl.link_ok_bits(fp_d, offs, cinv, jnp.int32(3))
+    for c in range(4):
+        np.testing.assert_array_equal(
+            np.asarray(rows[c]),
+            ((np.asarray(bits) >> c) & 1).astype(bool))
 
 
 def test_link_masks_symmetric_and_seed_dependent():

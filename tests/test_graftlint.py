@@ -172,7 +172,7 @@ def test_only_graftlint_fixture_dir_is_exempt(tmp_path):
 
 def test_declared_matrix_shape():
     combos = ja.declared_matrix()
-    assert len(combos) == 52
+    assert len(combos) == 57
     # base 32: all three sims x telemetry x faults x batched; split
     # axis only on gossipsub.  Round-10 variants: gather/dense
     # (tel x faults), rpc (tel, faulted), hist (faults, scored).
@@ -181,17 +181,19 @@ def test_declared_matrix_shape():
     # eclipse+byzantine+knobs+cold-restart surface, sequential + the
     # batched tournament runner).  Round-12 variant: knobs (the
     # config-as-data surface — heterogeneous SimKnobs points,
-    # sequential + the knob-batched sweep runner).
+    # sequential + the knob-batched sweep runner).  Round-13 variant:
+    # delays (event-driven time — delayed gossip sequential/knob-
+    # batched/split, delayed flood + randomsub ring replay).
     key = lambda c: (c["sim"], c["split"], c["telemetry"],  # noqa: E731
                      c["faults"], c["batched"], c["variant"])
-    assert len({key(c) for c in combos}) == 52
+    assert len({key(c) for c in combos}) == 57
     assert sum(not c["variant"] for c in combos) == 32
-    for sim, n in (("gossipsub", 26), ("floodsub", 13),
-                   ("randomsub", 13)):
+    for sim, n in (("gossipsub", 29), ("floodsub", 14),
+                   ("randomsub", 14)):
         assert sum(c["sim"] == sim for c in combos) == n
     for var, n in (("gather", 4), ("dense", 4), ("rpc", 2),
                    ("hist", 2), ("inv", 4), ("attack", 2),
-                   ("knobs", 2)):
+                   ("knobs", 2), ("delays", 5)):
         assert sum(c["variant"] == var for c in combos) == n
     axes = {ax: {c[ax] for c in combos}
             for ax in ("telemetry", "faults", "batched")}
@@ -283,9 +285,10 @@ def test_audit_catches_a_seeded_callback_and_missing_donation():
 
 
 def test_contract_declarations_complete():
-    """Every field of the five contracted configs is declared, for
-    every declared path — no probes run (fast completeness gate)."""
+    """Every field of the contracted configs is declared, for every
+    declared path — no probes run (fast completeness gate)."""
     import dataclasses
+    from go_libp2p_pubsub_tpu.models.delays import DelayConfig
     from go_libp2p_pubsub_tpu.models.faults import FaultSchedule
     from go_libp2p_pubsub_tpu.models.gossipsub import (
         GossipSimConfig, ScoreSimConfig)
@@ -293,7 +296,7 @@ def test_contract_declarations_complete():
     from go_libp2p_pubsub_tpu.models.telemetry import TelemetryConfig
 
     for cls in (GossipSimConfig, ScoreSimConfig, TelemetryConfig,
-                FaultSchedule, InvariantConfig):
+                FaultSchedule, InvariantConfig, DelayConfig):
         fields = {f.name for f in dataclasses.fields(cls)}
         assert set(cls.CONTRACT) == fields, cls.__name__
         for fld, spec in cls.CONTRACT.items():

@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""delaystat: inspect a pipelined-gossip bench artifact and gate
+regressions against a committed baseline.
+
+    python tools/delaystat.py /tmp/gossipsub_pipelined.json
+    python tools/delaystat.py /tmp/gossipsub_pipelined.json \
+        --check DELAY_r13.json [--p99-slack 2] [--delivery-slack 0.05]
+
+Prints the delay/heartbeat sweep table: per delay point the delivery
+fraction and the delivery-latency percentiles (in ticks, from the
+device-side ``latency_hist``).  The artifact is the round-13
+"pipelined gossip" picture: per-hop delay stretches the latency
+distribution roughly linearly while the pipeline keeps delivering —
+the one-hop ``base1`` row doubles as the pre-delay v1.1 baseline.
+
+Exit codes (tracestat/tourneystat/sweepstat --check convention):
+
+  0  clean
+  1  regression: a delayed row whose delivery fraction fell more than
+     ``--delivery-slack`` below the one-hop row, the knob sweep
+     recompiling (compiles > baseline), or (with --check) any
+     row-matched p99 exceeding the committed baseline by more than
+     ``--p99-slack`` ticks, a delivery-fraction drop past the slack,
+     or delay-point coverage shrinking
+  2  unusable input: missing/unparseable artifact, no rows, a missing
+     one-hop baseline row, or a DELAYED row whose latency histogram
+     is degenerate (single-bucket — the event-driven pipeline is not
+     actually spreading arrivals, so nothing can be gated)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load(path: str) -> dict:
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"delaystat: cannot read {path}: {e}", file=sys.stderr)
+        raise SystemExit(2)
+    rows = obj.get("rows")
+    if not rows:
+        print(f"delaystat: {path} carries no delay-point rows",
+              file=sys.stderr)
+        raise SystemExit(2)
+    if not any(r.get("delay_base") == 1 and not r.get("delay_jitter")
+               for r in rows):
+        print(f"delaystat: {path} has no one-hop (delay_base=1, "
+              "jitter=0) baseline row", file=sys.stderr)
+        raise SystemExit(2)
+    for r in rows:
+        hist = r.get("hist") or []
+        nonzero = sum(1 for c in hist if c)
+        if nonzero == 0:
+            print(f"delaystat: row {r.get('id')} has an empty "
+                  "latency histogram", file=sys.stderr)
+            raise SystemExit(2)
+        if r.get("delay_base", 1) > 1 and nonzero < 2:
+            print(f"delaystat: row {r.get('id')} is delayed but its "
+                  "latency histogram is single-bucket — the delay "
+                  "line is not spreading arrivals", file=sys.stderr)
+            raise SystemExit(2)
+    return obj
+
+
+def _onehop(obj: dict) -> dict:
+    return next(r for r in obj["rows"]
+                if r.get("delay_base") == 1
+                and not r.get("delay_jitter"))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="delaystat", description=__doc__)
+    ap.add_argument("artifact")
+    ap.add_argument("--check", metavar="BASELINE",
+                    help="committed baseline artifact to gate against")
+    ap.add_argument("--p99-slack", type=float, default=2.0,
+                    help="allowed p99 delivery-latency growth vs "
+                         "baseline, in ticks (default 2)")
+    ap.add_argument("--delivery-slack", type=float, default=0.05,
+                    help="allowed delivery-fraction drop (default "
+                         "0.05) — vs the one-hop row inline, and vs "
+                         "the committed row under --check")
+    ns = ap.parse_args(argv)
+
+    cur = load(ns.artifact)
+    rc = 0
+    shape = cur.get("shape", {})
+    print(f"pipelined-gossip sweep: {shape.get('n')} peers x "
+          f"{shape.get('t')} topics, {shape.get('ticks')} ticks, "
+          f"K={shape.get('k_slots')} delay slots, "
+          f"compiles={cur.get('compiles')}")
+    for row in cur["rows"]:
+        lat = row.get("latency", {})
+        print(f"  {str(row.get('id')):<10s} "
+              f"base={row.get('delay_base')} "
+              f"jitter={row.get('delay_jitter', 0)}  "
+              f"delivery={row.get('delivery_fraction'):.4f}  "
+              f"p50={lat.get('p50')} p90={lat.get('p90')} "
+              f"p99={lat.get('p99')} ticks")
+
+    base_row = _onehop(cur)
+    floor = base_row["delivery_fraction"] - ns.delivery_slack
+    for row in cur["rows"]:
+        if row["delivery_fraction"] < floor:
+            print(f"delaystat: row {row['id']} delivery "
+                  f"{row['delivery_fraction']:.4f} fell below the "
+                  f"one-hop row's floor {floor:.4f} — the delayed "
+                  "pipeline is losing traffic, not just stretching "
+                  "it", file=sys.stderr)
+            rc = 1
+    if cur.get("compiles", 1) > 1:
+        print(f"delaystat: the delay-knob sweep compiled "
+              f"{cur['compiles']} executables — delay_base/"
+              "delay_jitter must be traced (zero-recompile)",
+              file=sys.stderr)
+        rc = 1
+
+    if ns.check:
+        base = load(ns.check)
+        by_id = {str(r.get("id")): r for r in base["rows"]}
+        missing = set(by_id) - {str(r.get("id")) for r in cur["rows"]}
+        if missing:
+            print("delaystat: delay-point coverage shrank vs "
+                  f"baseline: missing {sorted(missing)}",
+                  file=sys.stderr)
+            rc = 1
+        for row in cur["rows"]:
+            ref = by_id.get(str(row.get("id")))
+            if ref is None:
+                continue
+            p99_c = (row.get("latency") or {}).get("p99")
+            p99_b = (ref.get("latency") or {}).get("p99")
+            if p99_b is not None and p99_c is not None:
+                verdict = ("OK" if p99_c <= p99_b + ns.p99_slack
+                           else "REGRESSED")
+                print(f"check: {row['id']} p99 {p99_c} vs baseline "
+                      f"{p99_b} (+{ns.p99_slack} slack) -> {verdict}")
+                if p99_c > p99_b + ns.p99_slack:
+                    rc = 1
+            dref = ref.get("delivery_fraction")
+            if (dref is not None and row["delivery_fraction"]
+                    < dref - ns.delivery_slack):
+                print(f"delaystat: {row['id']} delivery "
+                      f"{row['delivery_fraction']:.4f} vs baseline "
+                      f"{dref:.4f} regressed past the slack",
+                      file=sys.stderr)
+                rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -82,7 +82,7 @@ _ARTIFACT_CACHE: dict[tuple, tuple] = {}
 def _gossip_artifact(path, cfg_kw=None, *, n_topics=T, paired=False,
                      px=7, attack=False, sc_kw=None, sybil=False,
                      app=False, eclipse=False, byz=False,
-                     sim_knobs=None, faulted=False):
+                     sim_knobs=None, faulted=False, delayed=False):
     """(jaxpr_text, build_leaves) of a scored gossip step on ``path``
     ("xla" | "kernel") under config overrides.  ``sc_kw`` overrides
     ScoreSimConfig fields (the round-11 score-contract probes);
@@ -99,7 +99,7 @@ def _gossip_artifact(path, cfg_kw=None, *, n_topics=T, paired=False,
            byz, tuple(sorted((cfg_kw or {}).items())),
            tuple(sorted((sc_kw or {}).items())),
            tuple(sorted((sim_knobs or {}).items())),
-           sim_knobs is not None, faulted)
+           sim_knobs is not None, faulted, delayed)
     if key in _ARTIFACT_CACHE:
         return _ARTIFACT_CACHE[key]
 
@@ -142,6 +142,9 @@ def _gossip_artifact(path, cfg_kw=None, *, n_topics=T, paired=False,
         sim_kw["sim_knobs"] = dict(sim_knobs)
     if faulted:
         sim_kw["fault_schedule"] = _fault_schedule()
+    if delayed:
+        from go_libp2p_pubsub_tpu.models.delays import DelayConfig
+        sim_kw["delays"] = DelayConfig(base=1, jitter=1, k_slots=4)
     if path == "kernel":
         sim_kw["pad_to_block"] = KERNEL_BLOCK
         step_kw["receive_block"] = KERNEL_BLOCK
@@ -358,6 +361,89 @@ def _invariants_artifact(path, inv_kw=None):
     out = str(jax.make_jaxpr(step)(params, state))
     _ARTIFACT_CACHE[key] = out
     return out
+
+
+def _delays_artifact(path, dly_kw=None):
+    """Build leaves of a delay-armed sim on one of the six execution
+    paths (round 13): the DelayParams scalars AND the delay-line /
+    source-ring state shapes ride the build, so a value diff proves
+    base/jitter/seed threaded and a shape diff proves k_slots."""
+    import jax
+    import go_libp2p_pubsub_tpu.models.floodsub as fs
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    import go_libp2p_pubsub_tpu.models.randomsub as rs
+    from go_libp2p_pubsub_tpu.models.delays import DelayConfig
+    from go_libp2p_pubsub_tpu.ops.graph import make_circulant_offsets
+
+    key = ("dly", path, tuple(sorted((dly_kw or {}).items())))
+    if key in _ARTIFACT_CACHE:
+        return _ARTIFACT_CACHE[key]
+    base = dict(base=1, jitter=1, k_slots=4, seed=0)
+    base.update(dly_kw or {})
+    dc = DelayConfig(**base)
+    subs, topic, origin, ticks = _inputs(T)
+    if path in ("gossip-xla", "gossip-kernel"):
+        cfg = gs.GossipSimConfig(
+            offsets=gs.make_gossip_offsets(T, C, N, seed=1),
+            n_topics=T, d=3, d_lo=2, d_hi=6, d_score=2, d_out=1,
+            d_lazy=2, backoff_ticks=8)
+        built = gs.make_gossip_sim(
+            cfg, subs, topic, origin, ticks, score_cfg=gs.ScoreSimConfig(),
+            delays=dc,
+            pad_to_block=(KERNEL_BLOCK if path == "gossip-kernel"
+                          else None))
+    elif path == "flood-circulant":
+        offs = tuple(int(o) for o in
+                     make_circulant_offsets(T, C, N, seed=1))
+        built = fs.make_flood_sim(
+            None, None, subs, None, topic, origin, ticks,
+            fault_offsets=offs, delays=dc)
+    elif path == "flood-gather":
+        nbrs, mask = _gather_table()
+        built = fs.make_flood_sim(nbrs, mask, subs, None, topic,
+                                  origin, ticks, delays=dc)
+    elif path in ("randomsub-circulant", "randomsub-dense"):
+        rcfg = rs.RandomSubSimConfig(
+            offsets=rs.make_randomsub_offsets(T, C, N, seed=1),
+            n_topics=T, d=3)
+        built = rs.make_randomsub_sim(
+            rcfg, subs, topic, origin, ticks,
+            dense=(path == "randomsub-dense"), delays=dc)
+    else:
+        raise ValueError(f"no delays probe path {path!r}")
+    out = jax.tree_util.tree_leaves(built)
+    _ARTIFACT_CACHE[key] = out
+    return out
+
+
+#: DelayConfig threaded probes (value/shape diff on the build leaves)
+_DELAY_PROBES = {
+    "base": dict(base=2),
+    "jitter": dict(jitter=2),
+    "k_slots": dict(k_slots=6),
+    "seed": dict(seed=1),
+}
+
+#: DelayConfig traced-knob probes (gossip paths): two delay knob
+#: points over ONE delay-armed static config — jaxpr identical (no
+#: retrace), build leaves differ
+_DELAY_KNOB_PROBES = {
+    "base": ({"delay_base": 1}, {"delay_base": 3}),
+    "jitter": ({"delay_jitter": 0}, {"delay_jitter": 2}),
+}
+
+
+def _delay_threaded(field, path):
+    base = _delays_artifact(path)
+    probe = _delays_artifact(path, _DELAY_PROBES[field])
+    return _leaves_differ(base, probe)
+
+
+def _delay_knob_traced(field, path):
+    kv_a, kv_b = _DELAY_KNOB_PROBES[field]
+    a = _gossip_artifact(path, sim_knobs=dict(kv_a), delayed=True)
+    b = _gossip_artifact(path, sim_knobs=dict(kv_b), delayed=True)
+    return a[0] == b[0] and _leaves_differ(a[1], b[1])
 
 
 def _cold_restart_artifact(path, cold: bool):
@@ -805,15 +891,6 @@ _REFUSALS: dict = {
 #: names itself — removing the refusal without removing the entry (or
 #: vice versa) is a finding.  These raise NotImplementedError (a
 #: named capability gap, not invalid input).
-def _probe_rpc_paired():
-    import go_libp2p_pubsub_tpu.models.gossipsub as gs
-    cfg = gs.GossipSimConfig(
-        offsets=gs.make_gossip_offsets(T, C, N, seed=1, paired=True),
-        n_topics=T, paired_topics=True, d=3, d_lo=2, d_hi=6,
-        d_score=2, d_out=1, d_lazy=2, backoff_ticks=8)
-    gs.make_gossip_step(cfg, rpc_probe=True)   # must raise
-
-
 def _probe_rpc_mixed_protocol():
     import jax
     import numpy as np
@@ -838,10 +915,103 @@ def _probe_static_knob():
     split_knob_overrides({"history_gossip": 2})   # must raise
 
 
+def _probe_static_delay_depth():
+    """The delay-line depth is shape-bearing (round 13) and rejected
+    by name at the knob surface."""
+    from go_libp2p_pubsub_tpu.models.knobs import split_knob_overrides
+    split_knob_overrides({"delay_k_slots": 8})   # must raise
+
+
+def _delayed_gossip_build(**kw):
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    from go_libp2p_pubsub_tpu.models.delays import DelayConfig
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(T, C, N, seed=1), n_topics=T,
+        d=3, d_lo=2, d_hi=6, d_score=2, d_out=1, d_lazy=2,
+        backoff_ticks=8)
+    subs, topic, origin, ticks = _inputs(T)
+    params, state = gs.make_gossip_sim(
+        cfg, subs, topic, origin, ticks,
+        delays=DelayConfig(base=1, jitter=1, k_slots=4), **kw)
+    return gs, cfg, params, state
+
+
+def _probe_delays_paired():
+    """Delays + paired-topic mode: named capability gap, refused at
+    BUILD time (per-slot delay lines are not modeled)."""
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    from go_libp2p_pubsub_tpu.models.delays import DelayConfig
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(T, C, N, seed=1, paired=True),
+        n_topics=T, paired_topics=True, d=3, d_lo=2, d_hi=6,
+        d_score=2, d_out=1, d_lazy=2, backoff_ticks=8)
+    subs, topic, origin, ticks = _inputs(T, paired=True)
+    gs.make_gossip_sim(cfg, subs, topic, origin, ticks,
+                       delays=DelayConfig(1, 0, 1))   # must raise
+
+
+def _probe_delays_rpc():
+    """Delays + rpc_probe: the per-RPC reconstruction cannot place
+    in-flight slots — refused by name at trace time."""
+    import jax
+    gs, cfg, params, state = _delayed_gossip_build()
+    step = gs.make_gossip_step(cfg, rpc_probe=True)
+    jax.eval_shape(step, params, state)   # must raise
+
+
+def _probe_delays_tel_counters():
+    """Delays + the telemetry counters group: send/receive accounting
+    would need per-class delay lines — refused by name (the
+    histogram/gauge/fault groups all thread)."""
+    import jax
+    import go_libp2p_pubsub_tpu.models.telemetry as tl
+    gs, cfg, params, state = _delayed_gossip_build()
+    step = gs.make_gossip_step(cfg, telemetry=tl.TelemetryConfig())
+    jax.eval_shape(step, params, state)   # must raise
+
+
+def _probe_delays_kernel_sharded():
+    """Delays + the sharded (multi-chip) kernel path: the delay-line
+    enqueue's true-ring rolls and the halo exchange are not composed —
+    refused by name (a 1-device mesh suffices to reach the guard)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    gs, cfg, params, state = _delayed_gossip_build(
+        pad_to_block=KERNEL_BLOCK)
+    mesh = Mesh(np.array(jax.devices("cpu")[:1]), ("peers",))
+    step = gs.make_gossip_step(cfg, receive_block=KERNEL_BLOCK,
+                               shard_mesh=mesh)
+    jax.eval_shape(step, params, state)   # must raise
+
+
+def _probe_delays_kernel_iwant():
+    """Delays + sybil_iwant_spam on the pallas step: the in-kernel
+    flood budget needs the partner advert views the delayed kernel
+    does not stream — XLA-only, refused by name."""
+    import jax
+    import numpy as np
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    from go_libp2p_pubsub_tpu.models.delays import DelayConfig
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(T, C, N, seed=1), n_topics=T,
+        d=3, d_lo=2, d_hi=6, d_score=2, d_out=1, d_lazy=2,
+        backoff_ticks=8)
+    sc = gs.ScoreSimConfig(sybil_iwant_spam=True)
+    subs, topic, origin, ticks = _inputs(T)
+    params, state = gs.make_gossip_sim(
+        cfg, subs, topic, origin, ticks, score_cfg=sc,
+        sybil=(np.arange(N) % 5) == 0,
+        delays=DelayConfig(base=1, jitter=1, k_slots=4),
+        pad_to_block=KERNEL_BLOCK)
+    step = gs.make_gossip_step(cfg, sc, receive_block=KERNEL_BLOCK)
+    jax.eval_shape(step, params, state)   # must raise
+
+
 _PROBE_REFUSALS = {
-    "rpc_probe[paired-topics]":
-        (_probe_rpc_paired,
-         r"paired-topic mode is not probe-supported"),
+    # round 13: the rpc_probe[paired-topics] refusal is LIFTED (the
+    # probe captures per-slot masks + slot-split payload; see
+    # interop/export.py rpc_events) — mixed-protocol remains
     "rpc_probe[mixed-protocol]":
         (_probe_rpc_mixed_protocol,
          r"mixed-protocol overlays are not probe-supported"),
@@ -851,6 +1021,27 @@ _PROBE_REFUSALS = {
         (_probe_static_knob,
          r"'history_gossip' is a static \(shape-bearing\) config "
          r"field", ValueError),
+    # round 13: the event-driven-time capability gaps, each named
+    "sim_knobs[delay-k-slots]":
+        (_probe_static_delay_depth,
+         r"'delay_k_slots' is a static \(shape-bearing\) config "
+         r"field", ValueError),
+    "delays[paired-topics]":
+        (_probe_delays_paired,
+         r"paired-topic mode is not delay-supported"),
+    "delays[rpc-probe]":
+        (_probe_delays_rpc,
+         r"delay-armed sims are not probe-supported"),
+    "delays[telemetry-counters]":
+        (_probe_delays_tel_counters,
+         r"telemetry counters group is not delay-supported"),
+    "delays[kernel-iwant-spam]":
+        (_probe_delays_kernel_iwant,
+         r"sybil_iwant_spam stays XLA-only on the pallas step under "
+         r"delays", ValueError),
+    "delays[kernel-sharded]":
+        (_probe_delays_kernel_sharded,
+         r"sharded \(multi-chip\) kernel path is not delay-supported"),
 }
 
 
@@ -910,6 +1101,7 @@ _BUILD_TIME = {
 
 
 def _contracted_classes():
+    from go_libp2p_pubsub_tpu.models.delays import DelayConfig
     from go_libp2p_pubsub_tpu.models.faults import FaultSchedule
     from go_libp2p_pubsub_tpu.models.gossipsub import (
         GossipSimConfig, ScoreSimConfig)
@@ -917,7 +1109,7 @@ def _contracted_classes():
     from go_libp2p_pubsub_tpu.models.knobs import SimKnobs
     from go_libp2p_pubsub_tpu.models.telemetry import TelemetryConfig
     return (GossipSimConfig, ScoreSimConfig, TelemetryConfig,
-            FaultSchedule, InvariantConfig, SimKnobs)
+            FaultSchedule, InvariantConfig, SimKnobs, DelayConfig)
 
 
 def _threaded_prover(cls_name, field, path, status):
@@ -942,6 +1134,10 @@ def _threaded_prover(cls_name, field, path, status):
             gp = "kernel" if path == "gossip-kernel" else "xla"
             return lambda: (_fault_threaded(field, path)
                             and _fault_knob_traced(gp))
+        if cls_name == "DelayConfig" and field in _DELAY_KNOB_PROBES:
+            gp = "kernel" if path == "gossip-kernel" else "xla"
+            return lambda: (_delay_threaded(field, path)
+                            and _delay_knob_traced(field, gp))
         return None
     if cls_name == "GossipSimConfig" and field in _GOSSIP_PROBES:
         return lambda: _gossip_threaded(field, path)
@@ -955,6 +1151,8 @@ def _threaded_prover(cls_name, field, path, status):
         return lambda: _cold_restart_threaded(path)
     if cls_name == "FaultSchedule" and field in _FAULT_PROBES:
         return lambda: _fault_threaded(field, path)
+    if cls_name == "DelayConfig" and field in _DELAY_PROBES:
+        return lambda: _delay_threaded(field, path)
     return None
 
 

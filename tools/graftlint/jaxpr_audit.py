@@ -140,6 +140,22 @@ def declared_matrix() -> list[dict]:
         out.append(dict(sim="gossipsub", split=False, telemetry=False,
                         faults=True, batched=batched,
                         variant="knobs"))
+    # round-13 variant cases: event-driven time (models/delays.py) —
+    # delayed gossip through the combined path (sequential faulted +
+    # knob-batched over HETEROGENEOUS delay points) and the split
+    # path (separate mesh/gossip delay lines), delayed flood and
+    # randomsub through the source-ring replay; donation + no-64-bit
+    # must hold on the new [K, ...] delay-line carries
+    for batched in (False, True):
+        out.append(dict(sim="gossipsub", split=False, telemetry=False,
+                        faults=True, batched=batched,
+                        variant="delays"))
+    out.append(dict(sim="gossipsub", split=True, telemetry=False,
+                    faults=False, batched=False, variant="delays"))
+    out.append(dict(sim="floodsub", split=False, telemetry=False,
+                    faults=True, batched=False, variant="delays"))
+    out.append(dict(sim="randomsub", split=False, telemetry=False,
+                    faults=True, batched=False, variant="delays"))
     return out
 
 
@@ -354,6 +370,63 @@ def build_cases() -> list[AuditCase]:
                 params, state = build_knob(0)
                 runner = gs.gossip_run
             args, statics = (params, state, TICKS, step), (2, 3)
+
+        elif variant == "delays":
+            # round-13 event-driven time: the K-slot delay lines ride
+            # the donated state carry; the batched case sweeps
+            # HETEROGENEOUS delay knob points through the knob runner
+            from go_libp2p_pubsub_tpu.models.delays import DelayConfig
+            dc = DelayConfig(base=2, jitter=1, k_slots=4)
+            subs, topic, origin, ticks = _sim_inputs(T)
+            if sim == "gossipsub":
+                cfg = gs.GossipSimConfig(
+                    offsets=gs.make_gossip_offsets(T, C, N, seed=1),
+                    n_topics=T, d=3, d_lo=2, d_hi=6, d_score=2,
+                    d_out=1, d_lazy=2, backoff_ticks=8)
+                sc = gs.ScoreSimConfig()
+                split = combo["split"]
+
+                def build_delay(r):
+                    return gs.make_gossip_sim(
+                        cfg, subs, topic, origin, ticks, seed=r,
+                        score_cfg=sc, delays=dc, delays_split=split,
+                        fault_schedule=(audit_fault_schedule(r)
+                                        if fsched else None),
+                        sim_knobs=({"delay_base": 1 + r,
+                                    "delay_jitter": r} if b
+                                   else None))
+
+                step = gs.make_gossip_step(cfg, sc, force_split=split)
+                if b:
+                    builds = [build_delay(r) for r in range(BATCH)]
+                    params = gs.stack_trees([p for p, _ in builds])
+                    state = gs.stack_trees([s for _, s in builds])
+                    runner = gs.gossip_run_knob_batch
+                else:
+                    params, state = build_delay(0)
+                    runner = gs.gossip_run
+                args, statics = (params, state, TICKS, step), (2, 3)
+            elif sim == "floodsub":
+                offs = tuple(int(o) for o in
+                             make_circulant_offsets(T, C, N, seed=1))
+                params, state = fs.make_flood_sim(
+                    None, None, subs, None, topic, origin, ticks,
+                    fault_schedule=fsched, fault_offsets=offs,
+                    delays=dc)
+                core = fs.make_circulant_step_core(offs)
+                runner = fs.flood_run_curve
+                args, statics = ((params, state, TICKS, core, M),
+                                 (2, 3, 4))
+            else:   # randomsub
+                rcfg = rs.RandomSubSimConfig(
+                    offsets=rs.make_randomsub_offsets(T, C, N, seed=1),
+                    n_topics=T, d=3)
+                params, state = rs.make_randomsub_sim(
+                    rcfg, subs, topic, origin, ticks,
+                    fault_schedule=fsched, delays=dc)
+                step = rs.make_randomsub_step(rcfg)
+                runner = rs.randomsub_run
+                args, statics = (params, state, TICKS, step), (2, 3)
 
         elif variant == "hist":
             # all three histogram groups live (score_hist needs a

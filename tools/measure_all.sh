@@ -196,6 +196,31 @@ elif [ "$src" -ne 0 ]; then
   sync_log
   exit 7
 fi
+# 4f. event-driven time (round 13): the pipelined-gossip sweep — the
+# heartbeat/RTT ratio (delay_base/delay_jitter knobs) swept through
+# ONE compiled executable over the 100k v1.1 config with the K-slot
+# delay line, committing the first multi-bucket delivery-latency
+# percentile curves — then the delaystat gate over the artifact the
+# bench just wrote (p99 within slack of the committed DELAY_r13.json,
+# delivery fraction holding, zero recompiles across delay points)
+run 2700 python bench_suite.py gossipsub_pipelined
+echo "=== delaystat --check gate ===" | tee -a "$log"
+env JAX_PLATFORMS=cpu python tools/delaystat.py \
+    /tmp/gossipsub_pipelined.json \
+    --check DELAY_r13.json 2>&1 | tee -a "$log"
+drc=${PIPESTATUS[0]}
+if [ "$drc" -eq 2 ]; then
+  echo "!! delaystat gate failed — unusable delay-sweep artifact" \
+      "(bench crashed, or a delayed row's histogram is degenerate?)" \
+      | tee -a "$log"
+  sync_log
+  exit 8
+elif [ "$drc" -ne 0 ]; then
+  echo "!! delaystat gate failed — delivery-latency p99 or delivery" \
+      "fraction regressed past slack" | tee -a "$log"
+  sync_log
+  exit 8
+fi
 # 5. GSPMD overhead + diagnostics
 run 1800 python tools/bench_sharded.py
 run 1800 python tools/bench_micro.py 1000000 100

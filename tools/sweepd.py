@@ -190,7 +190,13 @@ class SweepServer:
         # split also catches drop_prob NESTED in knobs (valid — it IS
         # a knob) so it cannot be silently clobbered by the top-level
         # default below
-        _, _, fault_kv = kn.split_knob_overrides(knobs)
+        _, _, fault_kv, delay_kv = kn.split_knob_overrides(knobs)
+        if delay_kv:
+            raise ValueError(
+                "scenario: delay knobs (delay_base/delay_jitter) need "
+                "a delay-armed server config — this server was built "
+                "without a DelayConfig, so the delay-line code path "
+                "is not compiled in")
         if "drop_prob" in req and "drop_prob" in fault_kv:
             raise ValueError(
                 "scenario: drop_prob given both top-level and inside "

@@ -60,6 +60,16 @@ suite is the full matrix for tracking all baseline configs.)
                    delivery-latency percentile curves (DELAY_r13.json
                    / the delaystat gate, measure_all step 4f) — the
                    pipelined-gossip picture vs the one-hop baseline
+  gossipsub_multichip
+                   round 14: whole-sim scale-out over the ``peers``
+                   mesh axis (parallel/sharded.py) — the 1M D-scaling
+                   curve (D in {1, 2, 4, 8}: warm wall-clock, one
+                   compile per D, boundary-collective census from the
+                   compiled HLO, final-state digest BIT-IDENTICAL to
+                   D=1) plus the 10M-peer flagship row at max D;
+                   /tmp artifact for the shardstat gate (measure_all
+                   step 4g), ``hardware_queued``-tagged when run on
+                   the CPU virtual mesh
 
 Usage: python bench_suite.py [config ...]   (default: all)
 """
@@ -1267,6 +1277,161 @@ def bench_gossipsub_pipelined():
                 "base4_p99": rows[2]["latency"]["p99"]})
 
 
+def bench_gossipsub_multichip():
+    """Round 14: whole-sim multi-chip scale-out (parallel/sharded.py,
+    ROADMAP direction 1).  The ENTIRE scan carry — possession words,
+    per-edge counters, mesh/backoff, scores — runs sharded over the
+    ``peers`` mesh axis via the carry-pinned runner (no per-tick
+    resharding; the circulant rolls lower to boundary collectives).
+    Two deliverables, both into /tmp/gossipsub_multichip.json for the
+    ``shardstat --check`` gate (measure_all step 4g):
+
+    * the D-scaling curve at the 1M v1.1-shape config — per D in
+      {1, 2, 4, 8} the warm wall-clock, compile count (must be 1),
+      the boundary-collective census from the compiled HLO of a
+      probe-shape twin, and BIT-IDENTITY of the final state digest
+      against the D=1 row (the sharding layer is a layout contract);
+    * the 10M-peer flagship row at max D.  On the CPU virtual mesh
+      (``--xla_force_host_platform_device_count``) the artifact is
+      tagged ``hardware_queued`` — the real-mesh row lands via the
+      tpu_watch protocol when the relay next recovers.
+
+    Shapes are env-tunable (GOSSIP_MULTICHIP_N /
+    GOSSIP_MULTICHIP_FLAGSHIP_N; FLAGSHIP_N=0 skips the 10M row)."""
+    import hashlib
+
+    import jax
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    from go_libp2p_pubsub_tpu.parallel import mesh as pm
+    from go_libp2p_pubsub_tpu.parallel import sharded as ps
+
+    n = int(os.environ.get("GOSSIP_MULTICHIP_N", 1_000_000))
+    n_flag = int(os.environ.get("GOSSIP_MULTICHIP_FLAGSHIP_N",
+                                10_000_000))
+    t, m, ticks, n_probe = 10, 24, 8, 4096
+    ndev = len(jax.devices())
+    Ds = [d for d in (1, 2, 4, 8) if d <= ndev]
+
+    def build(n_, t_, m_):
+        rng = np.random.default_rng(0)
+        cfg = gs.GossipSimConfig(
+            offsets=gs.make_gossip_offsets(t_, 16, n_, seed=7),
+            n_topics=t_)
+        sc = gs.ScoreSimConfig()
+        subs = _subs_matrix(n_, t_)
+        topic, origin, pub = _msgs(rng, n_, t_, m_, 3)
+        params, state = gs.make_gossip_sim(
+            cfg, subs, topic, origin, pub, seed=3, score_cfg=sc,
+            track_first_tick=False)
+        return cfg, sc, params, state
+
+    def digest(out):
+        h = hashlib.sha256()
+        for leaf in (out.have, out.mesh, out.backoff, out.tick):
+            h.update(np.asarray(leaf).tobytes())
+        return h.hexdigest()[:16]
+
+    cfg, sc, params, state = build(n, t, m)
+    step = gs.make_gossip_step(cfg, sc)
+    pcfg, psc, pparams, pstate = build(n_probe, t, m)
+    pstep = gs.make_gossip_step(pcfg, psc)
+
+    rows, ref_digest = [], None
+    for D in Ds:
+        mesh = pm.make_mesh(D)
+        params_s, state_s, sh = ps.shard_sim(
+            params, gs.tree_copy(state), mesh, n)
+        cache0 = ps.sharded_gossip_run._cache_size()
+        t0 = time.perf_counter()
+        out = ps.sharded_gossip_run(params_s, state_s, ticks, step, sh)
+        jax.block_until_ready(out.have)
+        cold = time.perf_counter() - t0
+        # warm twin from a fresh (donated-away) carry
+        _, state_s, _ = ps.shard_sim(params, gs.tree_copy(state),
+                                     mesh, n)
+        t0 = time.perf_counter()
+        out = ps.sharded_gossip_run(params_s, state_s, ticks, step, sh)
+        jax.block_until_ready(out.have)
+        dt = time.perf_counter() - t0
+        compiles = ps.sharded_gossip_run._cache_size() - cache0
+        # boundary-collective census on the probe-shape twin (same
+        # step structure; lowering the 1M program again would just
+        # recompile it)
+        pp, st, psh = ps.shard_sim(pparams, gs.tree_copy(pstate),
+                                   mesh, n_probe)
+        hlo = ps.sharded_gossip_run.lower(
+            pp, st, ticks, pstep, psh).compile().as_text()
+        coll = ps.collective_stats(hlo)
+        dg = digest(out)
+        if ref_digest is None:
+            ref_digest = dg
+        rows.append({
+            "id": f"D{D}", "devices": D, "n": n,
+            "compiles": int(compiles),
+            "wall_s": round(dt, 3), "cold_s": round(cold, 2),
+            "heartbeats_per_sec": round(ticks / dt, 3),
+            "peer_ticks_per_sec": round(n * ticks / dt, 1),
+            "bit_identical": dg == ref_digest, "digest": dg,
+            "collectives": {k: v for k, v in coll.items()
+                            if k != "total_bytes"},
+            "collective_bytes": coll["total_bytes"],
+            "probe_n": n_probe,
+        })
+        assert compiles == 1, (D, compiles)
+        assert dg == ref_digest, (D, dg, ref_digest)
+        if D > 1:
+            # the whole-sim carry really partitions: boundary
+            # collectives must appear once the mesh has >1 shard
+            assert coll["total_bytes"] > 0, (D, coll)
+
+    if n_flag:
+        D = Ds[-1]
+        mesh = pm.make_mesh(D)
+        fcfg, fsc, fparams, fstate = build(n_flag, t, m)
+        fstep = gs.make_gossip_step(fcfg, fsc)
+        fparams_s, fstate_s, fsh = ps.shard_sim(fparams, fstate,
+                                                mesh, n_flag)
+        t0 = time.perf_counter()
+        fout = ps.sharded_gossip_run(fparams_s, fstate_s, ticks,
+                                     fstep, fsh)
+        jax.block_until_ready(fout.have)
+        fdt = time.perf_counter() - t0
+        rows.append({
+            "id": "flagship", "devices": D, "n": n_flag,
+            "wall_s": round(fdt, 2),
+            "heartbeats_per_sec": round(ticks / fdt, 3),
+            "peer_ticks_per_sec": round(n_flag * ticks / fdt, 1),
+            "digest": digest(fout),
+        })
+
+    backend = jax.default_backend()
+    art = {
+        "round": 14,
+        "platform": backend,
+        "n_devices": ndev,
+        "hardware_queued": backend != "tpu",
+        "shape": {"n": n, "t": t, "m": m, "ticks": ticks,
+                  "flagship_n": n_flag},
+        "rows": rows,
+    }
+    with open("/tmp/gossipsub_multichip.json", "w") as f:
+        json.dump(art, f, indent=1)
+    emit(f"gossipsub_multichip_{n}peers_peer_ticks_per_sec",
+         rows[len(Ds) - 1]["peer_ticks_per_sec"], "peer-ticks/s",
+         extra={"devices": Ds[-1], "compiles_per_D": 1,
+                "bit_identical": all(r.get("bit_identical", True)
+                                     for r in rows),
+                "collective_bytes_probe":
+                    rows[len(Ds) - 1]["collective_bytes"]})
+    if n_flag:
+        emit(f"gossipsub_multichip_flagship_{n_flag}peers"
+             "_heartbeats_per_sec",
+             rows[-1]["heartbeats_per_sec"], "heartbeats/s",
+             extra={"devices": rows[-1]["devices"],
+                    "platform": backend,
+                    "hardware_queued": backend != "tpu"})
+
+
 BENCHES = {
     "floodsub_hosts": bench_floodsub_hosts,
     "randomsub_10k": bench_randomsub_10k,
@@ -1288,6 +1453,7 @@ BENCHES = {
     "gossipsub_sweepd": bench_gossipsub_sweepd,
     "gossipsub_sweepd_kernel": bench_gossipsub_sweepd_kernel,
     "gossipsub_pipelined": bench_gossipsub_pipelined,
+    "gossipsub_multichip": bench_gossipsub_multichip,
 }
 
 

@@ -2181,14 +2181,6 @@ def make_gossip_step(cfg: GossipSimConfig,
             if sc is not None:
                 dlv_eff = dlv_eff & ~params.invalid_words[:, None]
             blocked += [dlv_eff]
-        if with_dl and shard_mesh is not None:
-            # named capability gap (graftlint probe-refusal registry):
-            # the delay-line enqueue's true-ring rolls and the halo
-            # exchange have not been composed
-            raise NotImplementedError(
-                "delays: the sharded (multi-chip) kernel path is not "
-                "delay-supported — run delayed kernel sims "
-                "single-device, or the XLA path under GSPMD")
         if shard_mesh is not None:
             # multi-chip: shard_map over the peer axis — per-shard
             # halo exchange (ICI collective-permutes) + the unmodified
@@ -2200,12 +2192,21 @@ def make_gossip_step(cfg: GossipSimConfig,
                     "sharded kernel path needs n_true == n_pad (no pad "
                     "lanes): pick n divisible by the block so "
                     "pad_to_block adds nothing")
+            # round-14 delay lift: in delay mode the XLA enqueue
+            # (delay_exchange — its true-ring rolls shard into
+            # boundary collective-permutes under GSPMD) has already
+            # produced final per-receiver arrival words, so the
+            # sharded kernel consumes them as ordinary blocked
+            # operands — no sender streams, no halo exchange.
             outs = sharded_receive(
                 cfg, sc, n_true, receive_block, cdt, W,
                 track_promises, receive_interpret, shard_mesh,
-                shard_axis, head, jnp.stack(ctrl_rows),
-                jnp.stack(fresh), jnp.stack(adv), blocked,
-                inj_st=(jnp.stack(injected) if flood_bits is not None
+                shard_axis, head,
+                None if with_dl else jnp.stack(ctrl_rows),
+                None if with_dl else jnp.stack(fresh),
+                None if with_dl else jnp.stack(adv), blocked,
+                inj_st=(jnp.stack(injected)
+                        if flood_bits is not None and not with_dl
                         else None),
                 with_px=state.active is not None,
                 with_same_ip=params.cand_same_ip is not None,
@@ -2214,7 +2215,8 @@ def make_gossip_step(cfg: GossipSimConfig,
                             else None),
                 freshb_st=(jnp.stack(fresh_b) if paired else None),
                 with_faults=with_f, with_telemetry=with_t,
-                tel_lat_buckets=lat_b, with_knobs=with_kn)
+                tel_lat_buckets=lat_b, with_knobs=with_kn,
+                with_delays=with_dl)
         else:
             def flat8(rows):
                 return jnp.concatenate(

@@ -1072,7 +1072,8 @@ def sharded_receive(cfg, sc, n_true: int, block: int, counter_dtype,
                     inj_st=None, with_px=False, with_same_ip=False,
                     ctrl2_rows=None, freshb_st=None, with_static=True,
                     with_faults=False, with_telemetry=False,
-                    tel_lat_buckets=0, with_knobs=False):
+                    tel_lat_buckets=0, with_knobs=False,
+                    with_delays=False):
     """Multi-chip kernel dispatch: shard_map over the peer axis, one
     pallas kernel invocation per shard with ring-halo exchange.
 
@@ -1095,6 +1096,17 @@ def sharded_receive(cfg, sc, n_true: int, block: int, counter_dtype,
     ``fresh_st``/``adv_st`` u32 [W, N]; ``blocked`` = the per-peer
     operands in make_receive_update order.  Returns the kernel's
     outputs with global [*, N] shapes.
+
+    ``with_delays`` (round 14): delay mode has NO sender streams — the
+    XLA-side enqueue (models/delays.py line_dequeue under GSPMD, whose
+    true-ring rolls lower to boundary collective-permutes) already
+    produced final per-RECEIVER arrival words, so every delay operand
+    is an ordinary blocked operand sharded on its trailing peer axis
+    and the kernel needs no halo at all: pass ctrl_rows/fresh_st/
+    adv_st as None and the dequeued arr + handshake words at the front
+    of ``blocked`` (make_receive_update operand order).  Bit-identity
+    with the single-device delayed kernel follows from the per-shard
+    ``base`` + global ``stream_n`` draws, exactly as in stream mode.
     """
     from jax.sharding import PartitionSpec as P
     try:
@@ -1119,23 +1131,29 @@ def sharded_receive(cfg, sc, n_true: int, block: int, counter_dtype,
         force_extended=True, stream_n=n_true, with_px=with_px,
         with_same_ip=with_same_ip, with_static=with_static,
         with_faults=with_faults, with_telemetry=with_telemetry,
-        tel_lat_buckets=tel_lat_buckets, with_knobs=with_knobs)
+        tel_lat_buckets=tel_lat_buckets, with_knobs=with_knobs,
+        with_delays=with_delays)
     n_head = len(head)
     paired = cfg.paired_topics
     n_gates = n_gate_rows(sc is not None, paired)
     n_ctrl = 2 if paired else 1
 
     # flats order mirrors the kernel: ctrl(, ctrl2), fresh(, fresh_b),
-    # adv(, injected) — first n_ctrl are u8 (p8 halos), rest u32 (p32)
-    flats_in = [ctrl_rows]
-    if paired:
-        flats_in.append(ctrl2_rows)
-    flats_in.append(fresh_st)
-    if paired:
-        flats_in.append(freshb_st)
-    flats_in.append(adv_st)
-    if inj_st is not None:
-        flats_in.append(inj_st)
+    # adv(, injected) — first n_ctrl are u8 (p8 halos), rest u32 (p32).
+    # Delay mode has no flats (arrivals are per-receiver blocked
+    # operands already finalized by the XLA enqueue) and so no halo.
+    if with_delays:
+        flats_in = []
+    else:
+        flats_in = [ctrl_rows]
+        if paired:
+            flats_in.append(ctrl2_rows)
+        flats_in.append(fresh_st)
+        if paired:
+            flats_in.append(freshb_st)
+        flats_in.append(adv_st)
+        if inj_st is not None:
+            flats_in.append(inj_st)
     n_flats = len(flats_in)
 
     def body(*ops):

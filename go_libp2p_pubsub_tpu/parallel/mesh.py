@@ -38,10 +38,36 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_peer_tree(tree, mesh: Mesh, n_peers: int):
+def check_peer_divisible(n_peers: int, mesh: Mesh,
+                         block: int | None = None) -> int:
+    """Validate that ``n_peers`` splits evenly over the mesh's peer
+    axis (and, when ``block`` is given, into whole kernel blocks per
+    shard) — raising a NAMED error here instead of the shape blow-up
+    GSPMD/shard_map would produce deep inside the scan.  Returns D."""
+    D = int(mesh.shape[PEER_AXIS])
+    if n_peers % D != 0:
+        raise ValueError(
+            f"shard_peer_tree: n_peers={n_peers} does not divide "
+            f"evenly over the {D}-device '{PEER_AXIS}' mesh axis — "
+            "pick n as a multiple of the device count (the peer axis "
+            "splits into equal contiguous shards)")
+    if block is not None and n_peers % (D * block) != 0:
+        raise ValueError(
+            f"shard_peer_tree: n_peers={n_peers} is not divisible by "
+            f"D*block = {D}*{block} — the sharded kernel needs whole "
+            f"receive blocks per shard; pick n as a multiple of "
+            f"lcm(n_topics, {D * block})")
+    return D
+
+
+def shard_peer_tree(tree, mesh: Mesh, n_peers: int,
+                    block: int | None = None):
     """Place every array in the pytree: arrays with a peer-sized axis are
-    sharded over that axis (the last such axis — peer-minor layout), the
-    rest replicated."""
+    sharded over that axis (the LAST such axis — peer-minor layout, so a
+    dense [N, N] array shards its trailing/receiver axis as documented),
+    the rest replicated.  ``block`` additionally validates the sharded
+    kernel's whole-blocks-per-shard divisibility up front."""
+    check_peer_divisible(n_peers, mesh, block)
     repl = replicated(mesh)
 
     def place(x):

@@ -1,0 +1,182 @@
+"""Whole-sim multi-chip sharding (round 14, ROADMAP direction 1).
+
+``mesh.py`` places a (params, state) tree once; this module makes the
+placement a CONTRACT for the whole run: PartitionSpec trees built by
+the same last-peer-axis rule, and pinned runners whose scan carry is
+re-constrained to the input sharding every tick — so the trajectory
+stays sharded end to end with no per-tick resharding (GSPMD has no
+freedom to move the carry; the circulant rolls lower to boundary
+collective-permutes and the telemetry/invariant reductions to
+all-reduces, which ``collective_stats`` counts out of the compiled
+HLO).  Per shard the arithmetic is untouched — the sharded trajectory
+is bit-identical to the single-device run (tests/test_sharded.py pins
+D in {2, 4, 8} on the CPU mesh, both execution paths).
+
+The runners mirror models/gossipsub.py's (donated carry, static step),
+with one extra static leaf: the NamedSharding tree.  Knob-batched
+states ([B, ..., N] leaves, replicated scalar knobs) shard under the
+same rule — the peer axis is still the last peer-sized axis — which is
+what lets sweepd serve scenario streams per-shard (``--devices``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import PEER_AXIS, check_peer_divisible, shard_peer_tree
+
+__all__ = [
+    "peer_spec", "peer_spec_tree", "named_sharding_tree", "shard_sim",
+    "sharded_gossip_run", "sharded_gossip_run_curve",
+    "sharded_gossip_run_knob_batch", "collective_stats",
+]
+
+
+def peer_spec(shape, n_peers: int) -> P:
+    """The placement rule as a PartitionSpec: the LAST axis whose
+    extent equals ``n_peers`` splits over the peers mesh axis (a dense
+    [N, N] array shards its trailing/receiver axis), everything else
+    replicates."""
+    spec = [None] * len(shape)
+    for axis in reversed(range(len(shape))):
+        if shape[axis] == n_peers:
+            spec[axis] = PEER_AXIS
+            return P(*spec)
+    return P()
+
+
+def peer_spec_tree(tree, n_peers: int):
+    """PartitionSpec tree over a (params, state, ...) pytree — the
+    spec-level twin of mesh.shard_peer_tree (same rule, no device
+    placement)."""
+    return jax.tree_util.tree_map(
+        lambda x: peer_spec(jnp.shape(x), n_peers), tree)
+
+
+def named_sharding_tree(tree, mesh: Mesh, n_peers: int):
+    """NamedSharding tree for ``tree`` on ``mesh`` — hashable (static
+    jit leaf) because every node is a frozen dataclass/tuple of
+    NamedShardings."""
+    return jax.tree_util.tree_map(
+        lambda x: NamedSharding(mesh, peer_spec(jnp.shape(x),
+                                                n_peers)), tree)
+
+
+def shard_sim(params, state, mesh: Mesh, n_peers: int,
+              block: int | None = None):
+    """Validate divisibility (named errors, mesh.check_peer_divisible)
+    and place BOTH trees.  Returns (params, state, state_shardings);
+    pass the shardings to the pinned runners below."""
+    check_peer_divisible(n_peers, mesh, block)
+    params = shard_peer_tree(params, mesh, n_peers)
+    state = shard_peer_tree(state, mesh, n_peers)
+    return params, state, named_sharding_tree(state, mesh, n_peers)
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4), donate_argnums=(1,))
+def sharded_gossip_run(params, state, n_ticks: int, step, shardings):
+    """gossip_run with the carry PINNED: every tick's new state is
+    re-constrained to ``shardings`` (the input placement), so the whole
+    scan runs sharded with no per-tick resharding.  Donated like every
+    runner — the sharded buffers are reused in place."""
+    def body(s, _):
+        s2 = step(params, s)[0]
+        return jax.lax.with_sharding_constraint(s2, shardings), None
+    state, _ = jax.lax.scan(body, state, None, length=n_ticks)
+    return state
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4, 5), donate_argnums=(1,))
+def sharded_gossip_run_curve(params, state, n_ticks: int, step,
+                             shardings, n_msgs: int):
+    """gossip_run_curve, carry-pinned: per-tick delivered counts come
+    back replicated (the popcount reduction over the sharded peer axis
+    lowers to an all-reduce)."""
+    from ..models.gossipsub import count_bits_per_position
+
+    def body(s, _):
+        s2, delivered = step(params, s)
+        s2 = jax.lax.with_sharding_constraint(s2, shardings)
+        return s2, count_bits_per_position(delivered, n_msgs)
+    state, counts = jax.lax.scan(body, state, None, length=n_ticks)
+    return state, counts
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4), donate_argnums=(1,))
+def sharded_gossip_run_knob_batch(params, state, n_ticks: int, step,
+                                  shardings, honest=None):
+    """The sweep engine's device side on the mesh: B stacked scenario
+    replicas ([B, ..., N] leaves sharded on the trailing peer axis,
+    knob scalars replicated) advanced in ONE carry-pinned scan of the
+    vmapped step, then the per-replica reach reduction (all-reduce
+    over the peer shards).  Per replica and per shard the trajectory
+    is bit-identical to the single-device gossip_run_knob_batch."""
+    from ..models.gossipsub import reach_counts_from_have
+    vstep = jax.vmap(step)
+
+    def body(s, _):
+        s2 = vstep(params, s)[0]
+        return jax.lax.with_sharding_constraint(s2, shardings), None
+    state, _ = jax.lax.scan(body, state, None, length=n_ticks)
+    if honest is None:
+        reach = jax.vmap(
+            lambda p, s: reach_counts_from_have(p, s))(params, state)
+    else:
+        reach = jax.vmap(reach_counts_from_have)(params, state,
+                                                 honest)
+    return state, reach
+
+
+_DTYPE_BYTES = {
+    "pred": 1, "u8": 1, "s8": 1, "u16": 2, "s16": 2, "bf16": 2,
+    "f16": 2, "u32": 4, "s32": 4, "f32": 4, "u64": 8, "s64": 8,
+    "f64": 8,
+}
+
+
+def collective_stats(hlo_text: str) -> dict:
+    """Count the boundary collectives in compiled HLO text and total
+    their operand bytes — the number behind the VMEM-residency /
+    boundary-traffic claim (tools/profile_bytes.py --devices,
+    tools/shardstat.py).  Returns
+    ``{op: {"count": k, "bytes": b}, ...}`` for the collective ops
+    present (collective-permute, all-reduce, all-gather,
+    reduce-scatter, all-to-all) plus a ``"total_bytes"`` sum.
+
+    Bytes are per-op OUTPUT shapes (each instance is one boundary
+    transfer of that shape per shard), parsed from lines like
+    ``x = u32[16,125] collective-permute(...)``.
+    """
+    import re
+
+    ops = ("collective-permute", "all-reduce", "all-gather",
+           "reduce-scatter", "all-to-all")
+    pat = re.compile(
+        r"=\s+(?:\(([^)]*)\)|(\w+)\[([\d,]*)\][^ ]*)\s+"
+        r"(" + "|".join(re.escape(o) for o in ops) + r")(?:-start)?\(")
+
+    def shape_bytes(dtype: str, dims: str) -> int:
+        n = 1
+        for d in filter(None, dims.split(",")):
+            n *= int(d)
+        return n * _DTYPE_BYTES.get(dtype, 4)
+
+    out: dict = {}
+    for m in pat.finditer(hlo_text):
+        tup, dtype, dims, op = m.groups()
+        if tup is not None:
+            b = 0
+            for em in re.finditer(r"(\w+)\[([\d,]*)\]", tup):
+                b += shape_bytes(*em.groups())
+        else:
+            b = shape_bytes(dtype, dims)
+        ent = out.setdefault(op, {"count": 0, "bytes": 0})
+        ent["count"] += 1
+        ent["bytes"] += b
+    out["total_bytes"] = sum(
+        v["bytes"] for k, v in out.items() if k != "total_bytes")
+    return out

@@ -360,6 +360,37 @@ def test_sweepd_round_trip_zero_recompiles():
     assert stats["configs_per_compile"] >= 6
 
 
+def test_sweepd_devices_round_trip_matches_single():
+    """Round 14: a devices=4 server serves the same scenario stream as
+    the single-device server with IDENTICAL result rows (the sharded
+    knob-batch dispatch is bit-identical per replica), still at one
+    compile; indivisible peer counts are refused by name up front."""
+    import pytest
+    from tools.sweepd import SweepServer
+
+    reqs = [
+        {"id": "a", "seed": 1},
+        {"id": "b", "knobs": {"d": 5, "gossip_factor": 0.4}},
+        {"id": "c", "drop_prob": 0.05},
+        {"id": "d", "attack": "spam", "attack_frac": 0.1},
+    ]
+    srv1 = SweepServer(n=200, t=2, m=6, ticks=8, batch=4, seed=0)
+    srvD = SweepServer(n=200, t=2, m=6, ticks=8, batch=4, seed=0,
+                       devices=4)
+    rows1 = srv1.submit([dict(r) for r in reqs])
+    rowsD = srvD.submit([dict(r) for r in reqs])
+    assert rows1 == rowsD
+    assert srvD.compiles() == 1
+    assert srvD.stats()["shape"]["devices"] == 4
+
+    with pytest.raises(ValueError, match="divide evenly over the"):
+        SweepServer(n=202, t=2, m=6, ticks=8, batch=2, seed=0,
+                    devices=4)
+    with pytest.raises(ValueError, match="sequential demonstration"):
+        SweepServer(n=200, t=2, m=6, ticks=8, batch=1, seed=0,
+                    kernel=True, devices=2)
+
+
 def test_sweepd_line_protocol_and_errors():
     from tools.sweepd import SweepServer
 

@@ -1,0 +1,335 @@
+"""Round-14 whole-sim sharding (ROADMAP direction 1): the sharded
+trajectory is BIT-IDENTICAL to the single-device run on the virtual
+CPU mesh (conftest forces 8 host devices), on BOTH execution paths —
+the XLA step under GSPMD placement and the pallas kernel under
+shard_map — with faults, telemetry, event-driven delays, and the
+attack surface on, sequential and batched-over-seeds.  Identity is
+exact array equality over the whole state pytree: the sharding layer
+is a layout contract, never an arithmetic change."""
+
+import functools
+
+import numpy as np
+import pytest
+
+import go_libp2p_pubsub_tpu.models.gossipsub as gs
+import go_libp2p_pubsub_tpu.models.telemetry as tl
+from go_libp2p_pubsub_tpu.models.delays import DelayConfig
+from go_libp2p_pubsub_tpu.models.faults import FaultSchedule
+from go_libp2p_pubsub_tpu.parallel import mesh as pm
+from go_libp2p_pubsub_tpu.parallel import sharded as ps
+from go_libp2p_pubsub_tpu.parallel.mesh import (
+    check_peer_divisible, shard_peer_tree)
+
+N, T, M, TICKS, BLOCK = 512, 4, 8, 10, 64
+
+
+def _scenario(seed=0):
+    rng = np.random.default_rng(seed)
+    subs = np.zeros((N, T), dtype=bool)
+    subs[np.arange(N), np.arange(N) % T] = True
+    topic = rng.integers(0, T, M)
+    origin = rng.integers(0, N // T, M) * T + topic
+    tick0 = np.sort(rng.integers(0, 6, M)).astype(np.int32)
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(T, 16, N, seed=7), n_topics=T)
+    return cfg, subs, topic, origin, tick0
+
+
+def _faults():
+    return FaultSchedule(
+        n_peers=N, horizon=TICKS, drop_prob=0.05, seed=5,
+        down_intervals=tuple((int(p), 2, 5) for p in range(0, N, 41)))
+
+
+def _trees_equal(a, b):
+    import jax
+    fa, _ = jax.tree_util.tree_flatten(
+        jax.tree_util.tree_map(np.asarray, a))
+    fb, _ = jax.tree_util.tree_flatten(
+        jax.tree_util.tree_map(np.asarray, b))
+    assert len(fa) == len(fb)
+    return all(np.array_equal(x, y) for x, y in zip(fa, fb))
+
+
+# -- XLA path: everything on -----------------------------------------------
+
+# The armed scenario (delays + faults + sybil ihave-spam) and its
+# single-device references are module-cached: every D parametrization
+# reuses ONE reference compile+run and ONE step object, so each extra
+# device count only pays its own sharded executable (tier-1 budget).
+
+@functools.lru_cache(maxsize=None)
+def _armed():
+    cfg, subs, topic, origin, tick0 = _scenario()
+    sc = gs.ScoreSimConfig(sybil_ihave_spam=True)
+    sybil = (np.arange(N) % 37 == 0)
+    tcfg = tl.TelemetryConfig(
+        counters=False, wire=False, mesh=False, scores=False,
+        faults=False, latency_hist=True, latency_buckets=TICKS)
+
+    def build():
+        return gs.make_gossip_sim(
+            cfg, subs, topic, origin, tick0, seed=3, score_cfg=sc,
+            delays=DelayConfig(base=2, jitter=1, k_slots=4),
+            fault_schedule=_faults(), sybil=sybil,
+            track_first_tick=False)
+
+    tel_step = gs.make_gossip_step(cfg, sc, telemetry=tcfg)
+    run_step = gs.make_gossip_step(cfg, sc)
+    return build, tel_step, run_step
+
+
+@functools.lru_cache(maxsize=None)
+def _armed_tel_ref():
+    build, tel_step, _ = _armed()
+    params, state = build()
+    s_ref, fr_ref = tl.telemetry_run(params, state, TICKS, tel_step)
+    return s_ref, np.asarray(tl.frames_to_arrays(fr_ref)["latency_hist"])
+
+
+@functools.lru_cache(maxsize=None)
+def _armed_run_ref():
+    build, _, run_step = _armed()
+    params, state = build()
+    return gs.gossip_run(params, state, TICKS, run_step)
+
+
+@pytest.mark.parametrize("D", [2, 4, 8])
+def test_xla_everything_on_bit_identity(D):
+    """GSPMD placement + telemetry_run: delays + faults + sybil
+    ihave-spam + latency-hist telemetry, state AND frames identical."""
+    build, tel_step, _ = _armed()
+    s_ref, h_ref = _armed_tel_ref()
+
+    mesh = pm.make_mesh(D)
+    params, state = build()
+    params_s, state_s, _ = ps.shard_sim(params, state, mesh, N)
+    s_D, fr_D = tl.telemetry_run(params_s, state_s, TICKS, tel_step)
+    assert _trees_equal(s_ref, s_D)
+    assert np.array_equal(
+        h_ref, np.asarray(tl.frames_to_arrays(fr_D)["latency_hist"]))
+
+
+@pytest.mark.parametrize("D", [2, 4, 8])
+def test_xla_pinned_runner_bit_identity(D):
+    """The carry-pinned sharded_gossip_run (with_sharding_constraint
+    every tick) against single-device gossip_run — delays + faults +
+    attacks, no telemetry."""
+    build, _, run_step = _armed()
+    s_ref = _armed_run_ref()
+
+    mesh = pm.make_mesh(D)
+    params, state = build()
+    params_s, state_s, shardings = ps.shard_sim(params, state, mesh, N)
+    s_D = ps.sharded_gossip_run(params_s, state_s, TICKS, run_step,
+                                shardings)
+    assert _trees_equal(s_ref, s_D)
+
+
+# -- pallas kernel path under shard_map ------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _kernel_tel_parts():
+    blk = 128
+    cfg, subs, topic, origin, tick0 = _scenario()
+    sc = gs.ScoreSimConfig()
+    tcfg = tl.TelemetryConfig()
+
+    def build():
+        return gs.make_gossip_sim(
+            cfg, subs, topic, origin, tick0, seed=3, score_cfg=sc,
+            fault_schedule=_faults(), track_first_tick=False,
+            pad_to_block=blk)
+
+    step1 = gs.make_gossip_step(cfg, sc, receive_block=blk,
+                                receive_interpret=True, telemetry=tcfg)
+    params, state = build()
+    s_ref, fr_ref = tl.telemetry_run(params, state, TICKS, step1)
+    return blk, cfg, sc, tcfg, build, s_ref, fr_ref
+
+
+@pytest.mark.parametrize(
+    "D", [2, pytest.param(4, marks=pytest.mark.slow)])
+def test_kernel_faults_telemetry_bit_identity(D):
+    """shard_map kernel dispatch (ring-halo ppermutes + telemetry
+    psum) with faults on: identical to the single-device kernel.
+    block=128, not the usual 64: the in-kernel telemetry fold tallies
+    into 128 lanes, so the telemetry kernel needs blocks >= 128 (a
+    pre-existing kernel-path constraint, not a sharding one)."""
+    blk, cfg, sc, tcfg, build, s_ref, fr_ref = _kernel_tel_parts()
+
+    mesh = pm.make_mesh(D)
+    stepD = gs.make_gossip_step(cfg, sc, receive_block=blk,
+                                receive_interpret=True,
+                                shard_mesh=mesh, telemetry=tcfg)
+    params, state = build()
+    params_s, state_s, _ = ps.shard_sim(params, state, mesh, N,
+                                        block=blk)
+    s_D, fr_D = tl.telemetry_run(params_s, state_s, TICKS, stepD)
+    assert _trees_equal(s_ref, s_D)
+    ref, dev = tl.frames_to_arrays(fr_ref), tl.frames_to_arrays(fr_D)
+    assert set(ref) == set(dev)
+    for k in ref:
+        a, b = np.asarray(ref[k]), np.asarray(dev[k])
+        if np.issubdtype(a.dtype, np.floating):
+            # float SUMMARIES (score_mean & co) reduce over the peer
+            # axis in shard order — last-ULP tolerance; the integer
+            # tallies and the state trajectory itself stay exact
+            assert np.allclose(a, b, rtol=1e-6, atol=0), k
+        else:
+            assert np.array_equal(a, b), k
+
+
+@functools.lru_cache(maxsize=None)
+def _kernel_delay_parts():
+    cfg, subs, topic, origin, tick0 = _scenario()
+    sc = gs.ScoreSimConfig()
+
+    def build():
+        return gs.make_gossip_sim(
+            cfg, subs, topic, origin, tick0, seed=3, score_cfg=sc,
+            delays=DelayConfig(base=2, jitter=1, k_slots=4),
+            fault_schedule=_faults(), track_first_tick=False,
+            pad_to_block=BLOCK)
+
+    step1 = gs.make_gossip_step(cfg, sc, receive_block=BLOCK,
+                                receive_interpret=True)
+    params, state = build()
+    s_ref = gs.gossip_run(params, state, TICKS, step1)
+    return cfg, sc, build, s_ref
+
+
+@pytest.mark.parametrize(
+    "D", [2, pytest.param(4, marks=pytest.mark.slow)])
+def test_kernel_delays_bit_identity(D):
+    """The round-14 lift: delays x sharded kernel (previously a named
+    refusal).  The delay-mode kernel has no sender streams, so the
+    sharded dispatch needs no halo — per-receiver blocked operands
+    only — and stays bit-identical, faults included."""
+    cfg, sc, build, s_ref = _kernel_delay_parts()
+
+    mesh = pm.make_mesh(D)
+    stepD = gs.make_gossip_step(cfg, sc, receive_block=BLOCK,
+                                receive_interpret=True,
+                                shard_mesh=mesh)
+    params, state = build()
+    params_s, state_s, shardings = ps.shard_sim(params, state, mesh,
+                                                N, block=BLOCK)
+    s_D = ps.sharded_gossip_run(params_s, state_s, TICKS, stepD,
+                                shardings)
+    assert _trees_equal(s_ref, s_D)
+
+
+# -- batched over seeds -----------------------------------------------------
+
+def test_knob_batch_over_seeds_bit_identity():
+    """sweepd's device side on the mesh: B seed-replicas stacked on a
+    leading axis, peer axis still sharded, one carry-pinned scan of
+    the vmapped step — states and reach identical to the
+    single-device knob-batch runner."""
+    cfg, subs, topic, origin, tick0 = _scenario()
+    sc = gs.ScoreSimConfig()
+
+    def build():
+        builds = [gs.make_gossip_sim(
+            cfg, subs, topic, origin, tick0, seed=r, score_cfg=sc,
+            fault_schedule=_faults(), sim_knobs={}, track_first_tick=False)
+            for r in range(3)]
+        return (gs.stack_trees([b[0] for b in builds]),
+                gs.stack_trees([b[1] for b in builds]))
+
+    step = gs.make_gossip_step(cfg, sc)
+    params, state = build()
+    s_ref, r_ref = gs.gossip_run_knob_batch(params, state, TICKS, step)
+
+    mesh = pm.make_mesh(4)
+    params, state = build()
+    params_s, state_s, shardings = ps.shard_sim(params, state, mesh, N)
+    s_D, r_D = ps.sharded_gossip_run_knob_batch(params_s, state_s,
+                                                TICKS, step, shardings)
+    assert _trees_equal(s_ref, s_D)
+    assert np.array_equal(np.asarray(r_ref), np.asarray(r_D))
+
+
+def test_curve_runner_bit_identity():
+    cfg, subs, topic, origin, tick0 = _scenario()
+    sc = gs.ScoreSimConfig()
+
+    def build():
+        return gs.make_gossip_sim(cfg, subs, topic, origin, tick0,
+                                  seed=3, score_cfg=sc,
+                                  track_first_tick=False)
+
+    step = gs.make_gossip_step(cfg, sc)
+    params, state = build()
+    s_ref, c_ref = gs.gossip_run_curve(params, state, TICKS, step, M)
+
+    mesh = pm.make_mesh(8)
+    params, state = build()
+    params_s, state_s, shardings = ps.shard_sim(params, state, mesh, N)
+    s_D, c_D = ps.sharded_gossip_run_curve(params_s, state_s, TICKS,
+                                           step, shardings, M)
+    assert _trees_equal(s_ref, s_D)
+    assert np.array_equal(np.asarray(c_ref), np.asarray(c_D))
+
+
+# -- placement rule + hardening --------------------------------------------
+
+def test_peer_spec_square_matrix_picks_last_axis():
+    """[N, N] arrays shard the trailing (receiver) axis, matching the
+    kernel's per-receiver blocking; [N] shards axis 0; peer-free
+    shapes replicate."""
+    from jax.sharding import PartitionSpec as P
+    assert ps.peer_spec((N, N), N) == P(None, pm.PEER_AXIS)
+    assert ps.peer_spec((3, N, N), N) == P(None, None, pm.PEER_AXIS)
+    assert ps.peer_spec((N,), N) == P(pm.PEER_AXIS)
+    assert ps.peer_spec((N, 7), N) == P(pm.PEER_AXIS, None)
+    assert ps.peer_spec((3, 5), N) == P()
+
+
+def test_shard_peer_tree_square_matrix_shards_receiver_axis():
+    import jax
+    mesh = pm.make_mesh(8)
+    arr = shard_peer_tree(np.arange(16 * 16).reshape(16, 16), mesh, 16)
+    spans = sorted(
+        (s.index[1].start or 0, s.data.shape) for s in
+        jax.device_put(arr, arr.sharding).addressable_shards)
+    assert [sp[0] for sp in spans] == [k * 2 for k in range(8)]
+    assert all(sp[1] == (16, 2) for sp in spans)
+
+
+def test_check_peer_divisible_named_errors():
+    mesh = pm.make_mesh(4)
+    assert check_peer_divisible(N, mesh) == 4
+    assert check_peer_divisible(N, mesh, block=BLOCK) == 4
+    with pytest.raises(ValueError, match="divide evenly over the"):
+        check_peer_divisible(N - 2, mesh)
+    with pytest.raises(ValueError, match="whole receive blocks"):
+        check_peer_divisible(N, mesh, block=96)
+
+
+def test_shard_sim_refuses_indivisible():
+    cfg, subs, topic, origin, tick0 = _scenario()
+    params, state = gs.make_gossip_sim(cfg, subs, topic, origin,
+                                       tick0, track_first_tick=False)
+    mesh = pm.make_mesh(4)
+    with pytest.raises(ValueError, match="whole receive blocks"):
+        ps.shard_sim(params, state, mesh, N, block=96)
+
+
+# -- collective accounting --------------------------------------------------
+
+def test_collective_stats_parses_hlo():
+    hlo = """
+  %x = u32[16,125]{1,0} collective-permute(%a), source_target_pairs=...
+  %y = (f32[8]{0}, f32[8]{0}) all-reduce-start(%b, %c), replica_groups=...
+  %z = s32[4,2]{1,0} all-gather(%d), dimensions={1}
+"""
+    st = ps.collective_stats(hlo)
+    assert st["collective-permute"] == {"count": 1, "bytes": 16 * 125 * 4}
+    assert st["all-reduce"] == {"count": 1, "bytes": 2 * 8 * 4}
+    assert st["all-gather"] == {"count": 1, "bytes": 4 * 2 * 4}
+    assert st["total_bytes"] == 16 * 125 * 4 + 64 + 32
+    assert ps.collective_stats("%r = f32[2]{0} add(%a, %b)") == {
+        "total_bytes": 0}

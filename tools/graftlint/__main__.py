@@ -57,7 +57,17 @@ def main(argv=None) -> int:
         # is already importable, so go_libp2p_pubsub_tpu resolves too.
         # Force the CPU backend (as tools/validate_curves.py does): the
         # trace/lower passes must run even when the TPU relay is down —
-        # a static preflight must never be a second TPU client.
+        # a static preflight must never be a second TPU client.  The
+        # round-14 sharded audit cases want >= 2 CPU devices (they
+        # degrade to a 1-shard mesh otherwise), so request a virtual
+        # host mesh BEFORE jax initializes its backends.
+        import os
+        if "jax" not in sys.modules and \
+                "--xla_force_host_platform_device_count" not in \
+                os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8").strip()
         import jax
         jax.config.update("jax_platforms", "cpu")
         if not ns.no_audit:

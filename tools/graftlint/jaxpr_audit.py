@@ -69,10 +69,14 @@ class AuditCase:
     telemetry: bool
     faults: bool
     batched: bool
-    variant: str = ""        # "" | gather | dense | rpc | hist
+    variant: str = ""        # "" | gather | dense | rpc | hist | ...
     trace: object = field(repr=False, default=None)   # () -> ClosedJaxpr
     lower: object = field(repr=False, default=None)   # () -> lowered text
     n_carry_leaves: int = 0
+    #: primitives that MUST appear in the traced jaxpr (round 14: the
+    #: shard_map kernel dispatch asserts its boundary collectives —
+    #: halo ppermutes + telemetry psum — are actually present)
+    expect_primitives: tuple = ()
 
     @property
     def name(self) -> str:
@@ -156,6 +160,23 @@ def declared_matrix() -> list[dict]:
                     faults=True, batched=False, variant="delays"))
     out.append(dict(sim="randomsub", split=False, telemetry=False,
                     faults=True, batched=False, variant="delays"))
+    # round-14 variant cases: the whole-sim multi-chip surface
+    # (parallel/sharded.py) — the carry-pinned GSPMD runner sequential
+    # (faulted + delayed) and knob-batched, plus the shard_map kernel
+    # dispatch: streamed (halo ppermutes + telemetry psum asserted in
+    # the jaxpr) and delayed (the round-14 lift: no halo, arrival
+    # words ride as sharded blocked operands).  Donation and the
+    # 64-bit ban must hold across the sharding boundary.
+    for batched in (False, True):
+        out.append(dict(sim="gossipsub", split=False, telemetry=False,
+                        faults=True, batched=batched,
+                        variant="sharded"))
+    out.append(dict(sim="gossipsub", split=False, telemetry=True,
+                    faults=True, batched=False,
+                    variant="sharded-kernel"))
+    out.append(dict(sim="gossipsub", split=False, telemetry=False,
+                    faults=True, batched=False,
+                    variant="sharded-kernel-delays"))
     return out
 
 
@@ -428,6 +449,95 @@ def build_cases() -> list[AuditCase]:
                 runner = rs.randomsub_run
                 args, statics = (params, state, TICKS, step), (2, 3)
 
+        elif variant == "sharded":
+            # round-14 whole-sim GSPMD sharding: the carry-pinned
+            # runners over a 2-shard CPU mesh (1-shard when the host
+            # exposes a single CPU device — the trace is identical),
+            # with the full composition live: faults + delays +
+            # (batched) heterogeneous knob points
+            from go_libp2p_pubsub_tpu.models.delays import DelayConfig
+            from go_libp2p_pubsub_tpu.parallel import mesh as pmesh
+            from go_libp2p_pubsub_tpu.parallel import sharded as psh
+            mesh = pmesh.make_mesh(devices=jax.devices("cpu")[:2])
+            dc = DelayConfig(base=2, jitter=1, k_slots=4)
+            cfg = gs.GossipSimConfig(
+                offsets=gs.make_gossip_offsets(T, C, N, seed=1),
+                n_topics=T, d=3, d_lo=2, d_hi=6, d_score=2, d_out=1,
+                d_lazy=2, backoff_ticks=8)
+            sc = gs.ScoreSimConfig()
+            subs, topic, origin, ticks = _sim_inputs(T)
+
+            def build_shard(r):
+                return gs.make_gossip_sim(
+                    cfg, subs, topic, origin, ticks, seed=r,
+                    score_cfg=sc, delays=dc,
+                    fault_schedule=audit_fault_schedule(r),
+                    sim_knobs=({"delay_base": 1 + r,
+                                "gossip_factor": 0.25 + 0.25 * r}
+                               if b else None))
+
+            step = gs.make_gossip_step(cfg, sc)
+            if b:
+                builds = [build_shard(r) for r in range(BATCH)]
+                params = gs.stack_trees([p for p, _ in builds])
+                state = gs.stack_trees([s for _, s in builds])
+                params, state, shardings = psh.shard_sim(
+                    params, state, mesh, N)
+                runner = psh.sharded_gossip_run_knob_batch
+            else:
+                params, state = build_shard(0)
+                params, state, shardings = psh.shard_sim(
+                    params, state, mesh, N)
+                runner = psh.sharded_gossip_run
+            args = (params, state, TICKS, step, shardings)
+            statics = (2, 3, 4)
+
+        elif variant in ("sharded-kernel", "sharded-kernel-delays"):
+            # round-14 shard_map kernel dispatch, traced at the real
+            # divisibility shape (n = D * block, no pad lanes).  The
+            # streamed case must show the halo collective-permutes and
+            # the telemetry psum IN THE JAXPR; the delayed case is the
+            # lifted round-14 path — no halo (arrival words are
+            # per-receiver blocked operands), shard_map still present.
+            import numpy as np
+            from go_libp2p_pubsub_tpu.models.delays import DelayConfig
+            from go_libp2p_pubsub_tpu.models.faults import FaultSchedule
+            from go_libp2p_pubsub_tpu.parallel import mesh as pmesh
+            mesh = pmesh.make_mesh(devices=jax.devices("cpu")[:2])
+            D = mesh.shape[pmesh.PEER_AXIS]
+            kb = 1024            # contracts.KERNEL_BLOCK
+            n_k = D * kb
+            delayed = variant.endswith("delays")
+            cfg = gs.GossipSimConfig(
+                offsets=gs.make_gossip_offsets(T, C, n_k, seed=1),
+                n_topics=T, d=3, d_lo=2, d_hi=6, d_score=2, d_out=1,
+                d_lazy=2, backoff_ticks=8)
+            sc = gs.ScoreSimConfig()
+            subs_k = np.zeros((n_k, T), dtype=bool)
+            subs_k[np.arange(n_k), np.arange(n_k) % T] = True
+            rng = np.random.default_rng(0)
+            topic_k = rng.integers(0, T, M)
+            origin_k = rng.integers(0, n_k // T, M) * T + topic_k
+            ticks_k = np.zeros(M, dtype=np.int32)
+            sched = FaultSchedule(
+                n_peers=n_k, horizon=max(TICKS, 4),
+                down_intervals=((0, 0, 2), (3, 1, 3)),
+                drop_prob=0.1, seed=0)
+            params, state = gs.make_gossip_sim(
+                cfg, subs_k, topic_k, origin_k, ticks_k, seed=0,
+                score_cfg=sc, fault_schedule=sched,
+                pad_to_block=kb,
+                delays=(DelayConfig(base=2, jitter=1, k_slots=4)
+                        if delayed else None))
+            step = gs.make_gossip_step(
+                cfg, sc, receive_block=kb, receive_interpret=True,
+                shard_mesh=mesh,
+                telemetry=(tl.TelemetryConfig() if combo["telemetry"]
+                           else None))
+            runner = tl.telemetry_run if combo["telemetry"] \
+                else gs.gossip_run
+            args, statics = (params, state, TICKS, step), (2, 3)
+
         elif variant == "hist":
             # all three histogram groups live (score_hist needs a
             # scored sim)
@@ -532,6 +642,12 @@ def build_cases() -> list[AuditCase]:
 
         case = AuditCase(**combo)
         case.n_carry_leaves = len(jax.tree_util.tree_leaves(state))
+        if variant == "sharded-kernel":
+            case.expect_primitives = ("shard_map", "ppermute", "psum")
+        elif variant == "sharded-kernel-delays":
+            # the lifted delay path needs NO halo — but the dispatch
+            # must still be the shard_map one
+            case.expect_primitives = ("shard_map",)
         # late-binding via default args: the thunks must be pure
         # trace/lower closures over THIS combo's objects
         case.trace = (lambda r=runner, a=args, s=statics:
@@ -580,8 +696,10 @@ def audit_case(case: AuditCase) -> list[str]:
     closed = case.trace()
 
     dtypes = set()
+    prims_seen = set()
     for eqn in _iter_eqns(closed):
         prim = eqn.primitive.name
+        prims_seen.add(prim)
         if "callback" in prim or prim in ("infeed", "outfeed"):
             problems.append(
                 f"{case.name}: no-host-callback: primitive '{prim}' "
@@ -602,6 +720,14 @@ def audit_case(case: AuditCase) -> list[str]:
             f"{case.name}: no-64bit: {', '.join(bad)} aval(s) in the "
             "traced runner")
 
+    missing = [p for p in case.expect_primitives
+               if p not in prims_seen]
+    if missing:
+        problems.append(
+            f"{case.name}: expected-collectives: primitive(s) "
+            f"{', '.join(missing)} absent from the traced runner — "
+            "the sharded dispatch lost its boundary collectives")
+
     const_bytes = sum(getattr(c, "nbytes", 0)
                       for c in _iter_consts(closed))
     if const_bytes > CONST_BUDGET_BYTES:
@@ -615,30 +741,42 @@ def audit_case(case: AuditCase) -> list[str]:
     # the LAST n_carry_leaves entry-function arguments (params leaves
     # first) — so the aliased set must be exactly that trailing range.
     # A bare occurrence count would let aliasing on OTHER buffers mask
-    # a dropped state donation.
+    # a dropped state donation.  Multi-device (sharded) lowerings
+    # record donation as ``jax.buffer_donor`` instead of
+    # ``tf.aliasing_output`` (aliasing is resolved at compile time,
+    # after GSPMD fixes the output shardings) — _aliased_args accepts
+    # either marker.
     expect = set(range(nargs - case.n_carry_leaves, nargs))
     if aliased != expect:
         problems.append(
-            f"{case.name}: donation: aliased args {sorted(aliased)} "
-            f"!= the state-carry args {sorted(expect)} — the donated "
-            "carry is not (exactly) the aliased buffer set")
+            f"{case.name}: donation: aliased/donor args "
+            f"{sorted(aliased)} != the state-carry args "
+            f"{sorted(expect)} — the donated carry is not (exactly) "
+            "the aliased buffer set")
     return problems
 
 
-_ARG_RE = re.compile(r"%arg(\d+): [^,)]*?\{([^{}]*)\}")
-
-
 def _aliased_args(lowered: str) -> tuple[set, int]:
-    """(indices of @main arguments carrying tf.aliasing_output, total
-    argument count) from the lowered StableHLO text."""
+    """(indices of @main arguments carrying tf.aliasing_output OR
+    jax.buffer_donor — the multi-device donation marker — plus the
+    total argument count) from the lowered StableHLO text.
+
+    Parsed by splitting the signature at each ``%argN:`` rather than
+    by an attr-dict regex: sharded lowerings carry ``mhlo.sharding``
+    attr strings with NESTED braces ("{devices=[2]<=[2]}"), which a
+    flat ``\\{[^{}]*\\}`` match silently skips."""
     m = re.search(r"func\.func public @main\((.*?)\)\s*->", lowered,
                   re.S)
     if m is None:
         return set(), 0
     sig = m.group(1)
     nargs = len(set(re.findall(r"%arg(\d+):", sig)))
-    aliased = {int(a) for a, attrs in _ARG_RE.findall(sig)
-               if "tf.aliasing_output" in attrs}
+    aliased = set()
+    for part in re.split(r"(?=%arg\d+:)", sig):
+        am = re.match(r"%arg(\d+):", part)
+        if am and ("tf.aliasing_output" in part
+                   or "jax.buffer_donor" in part):
+            aliased.add(int(am.group(1)))
     return aliased, nargs
 
 

@@ -221,6 +221,31 @@ elif [ "$drc" -ne 0 ]; then
   sync_log
   exit 8
 fi
+# 4g. multi-chip scale-out (round 14): the whole-sim carry sharded
+# over the ``peers`` mesh axis — the 1M D-scaling curve (one compile
+# per D, boundary-collective census, final-state digest bit-identical
+# to D=1) plus the 10M-peer flagship row at max D — then the shardstat
+# gate over the artifact the bench just wrote (bit-identity, compile
+# counts, collective presence, and throughput vs the committed
+# MULTICHIP_r14.json)
+run 3600 python bench_suite.py gossipsub_multichip
+echo "=== shardstat --check gate ===" | tee -a "$log"
+env JAX_PLATFORMS=cpu python tools/shardstat.py \
+    /tmp/gossipsub_multichip.json \
+    --check MULTICHIP_r14.json 2>&1 | tee -a "$log"
+shrc=${PIPESTATUS[0]}
+if [ "$shrc" -eq 2 ]; then
+  echo "!! shardstat gate failed — unusable multichip artifact" \
+      "(bench crashed, or no D-scaling curve?)" | tee -a "$log"
+  sync_log
+  exit 9
+elif [ "$shrc" -ne 0 ]; then
+  echo "!! shardstat gate failed — sharded trajectory diverged from" \
+      "single-device, a mesh recompiled, or throughput regressed" \
+      | tee -a "$log"
+  sync_log
+  exit 9
+fi
 # 5. GSPMD overhead + diagnostics
 run 1800 python tools/bench_sharded.py
 run 1800 python tools/bench_micro.py 1000000 100

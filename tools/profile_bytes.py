@@ -12,11 +12,21 @@ in detail but array-level traffic is backend-invariant enough to rank
 components and catch accidental re-materializations (e.g. the static
 score bake was read by SEVEN fusions before the zero-elision).
 
-Usage: python tools/profile_bytes.py [n_peers]
+With ``--devices D`` (round 14) the same step is instead profiled
+SHARDED over a D-device ``peers`` mesh (parallel/sharded.py): the
+compiled partition's "bytes accessed" (per-shard traffic — should
+shrink ~1/D as the carry partitions) plus the boundary-collective
+census from the compiled HLO (op counts and transferred bytes via
+``collective_stats`` — the part of the traffic that becomes ICI on
+real hardware).  On CPU use the virtual mesh
+(``--xla_force_host_platform_device_count``).
+
+Usage: python tools/profile_bytes.py [n_peers] [--devices D]
 """
 
 from __future__ import annotations
 
+import argparse
 import sys
 
 import numpy as np
@@ -30,7 +40,14 @@ def main():
 
     import go_libp2p_pubsub_tpu.models.gossipsub as gs
 
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    ap = argparse.ArgumentParser(prog="profile_bytes")
+    ap.add_argument("n_peers", nargs="?", type=int, default=100_000)
+    ap.add_argument("--devices", type=int, default=0,
+                    help="profile the step sharded over a D-device "
+                         "'peers' mesh: per-shard bytes accessed + "
+                         "boundary-collective bytes")
+    ns = ap.parse_args()
+    n = ns.n_peers
     t, m, C = 100, 32, 16
     rng = np.random.default_rng(0)
     cfg = gs.GossipSimConfig(
@@ -50,6 +67,36 @@ def main():
         ca = f.lower(params, state).compile().cost_analysis()
         ca = ca[0] if isinstance(ca, list) else ca
         return ca["bytes accessed"], ca.get("flops", 0.0)
+
+    if ns.devices:
+        from go_libp2p_pubsub_tpu.parallel import mesh as pm
+        from go_libp2p_pubsub_tpu.parallel import sharded as ps
+
+        D = ns.devices
+        mesh = pm.make_mesh(D)
+        params_s, state_s, sh = ps.shard_sim(params, state, mesh, n)
+        step = gs.make_gossip_step(cfg, sc)
+        f = jax.jit(lambda pp, ss: jax.lax.with_sharding_constraint(
+            step(pp, ss)[0], sh))
+        exe = f.lower(params_s, state_s).compile()
+        ca = exe.cost_analysis()
+        ca = ca[0] if isinstance(ca, list) else ca
+        shard_b = ca["bytes accessed"]
+        coll = ps.collective_stats(exe.as_text())
+        print(f"n={n} C={C} devices={D} (peers mesh)")
+        print(f"{'per-shard step traffic':34s} "
+              f"{shard_b / 1e6:9.1f} MB  "
+              f"({ca.get('flops', 0.0) / 1e6:9.1f} Mflop)")
+        for op, v in sorted(coll.items()):
+            if op == "total_bytes":
+                continue
+            print(f"{'boundary ' + op:34s} {v['bytes'] / 1e6:9.3f} MB "
+                  f" ({v['count']} ops)")
+        print(f"{'boundary-collective total':34s} "
+              f"{coll['total_bytes'] / 1e6:9.3f} MB  "
+              f"({coll['total_bytes'] / max(shard_b, 1):.2%} of "
+              "per-shard traffic)")
+        return
 
     saved = {}
 

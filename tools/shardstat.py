@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""shardstat: inspect a multi-chip scale-out bench artifact and gate
+regressions against a committed baseline.
+
+    python tools/shardstat.py /tmp/gossipsub_multichip.json
+    python tools/shardstat.py /tmp/gossipsub_multichip.json \
+        --check MULTICHIP_r14.json [--scaling-slack 4] \
+        [--throughput-slack 0.5]
+
+Prints the D-scaling table: per device count the warm wall-clock,
+peer-ticks/s, compile count, boundary-collective census (from the
+compiled HLO of the probe-shape twin) and the final-state digest,
+plus the flagship row.  The contract being gated is the round-14
+tentpole: the WHOLE sim carry shards over the ``peers`` mesh axis,
+every D-row's trajectory is bit-identical to D=1, each D compiles
+exactly once, and D>1 rows actually partition (boundary collectives
+present).
+
+Exit codes (tracestat/tourneystat/sweepstat/delaystat convention):
+
+  0  clean
+  1  regression: a curve row whose digest differs from the D=1 row
+     (bit-identity broken), a row that compiled more than once
+     (recompile), a D>1 row with NO boundary collectives (the carry
+     silently replicated), max-D throughput below the D=1 row's by
+     more than ``--scaling-slack``x (pathological partitioning), or
+     (with --check) row-matched peer-ticks/s falling below
+     ``--throughput-slack`` x baseline, device coverage shrinking,
+     or the flagship peer count shrinking
+  2  unusable input: missing/unparseable artifact, no rows, no D1
+     curve row, or fewer than two distinct device counts (nothing
+     scales, nothing can be gated)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load(path: str) -> dict:
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"shardstat: cannot read {path}: {e}", file=sys.stderr)
+        raise SystemExit(2)
+    rows = obj.get("rows")
+    if not rows:
+        print(f"shardstat: {path} carries no rows", file=sys.stderr)
+        raise SystemExit(2)
+    curve = [r for r in rows if r.get("id") != "flagship"]
+    if not any(r.get("devices") == 1 for r in curve):
+        print(f"shardstat: {path} has no single-device (D1) curve "
+              "row — bit-identity has no reference", file=sys.stderr)
+        raise SystemExit(2)
+    if len({r.get("devices") for r in curve}) < 2:
+        print(f"shardstat: {path} covers fewer than two device "
+              "counts — there is no scaling curve to gate",
+              file=sys.stderr)
+        raise SystemExit(2)
+    return obj
+
+
+def _curve(obj: dict) -> list:
+    return [r for r in obj["rows"] if r.get("id") != "flagship"]
+
+
+def _flagship(obj: dict):
+    return next((r for r in obj["rows"] if r.get("id") == "flagship"),
+                None)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="shardstat",
+                                 description=__doc__)
+    ap.add_argument("artifact")
+    ap.add_argument("--check", metavar="BASELINE",
+                    help="committed baseline artifact to gate against")
+    ap.add_argument("--scaling-slack", type=float, default=4.0,
+                    help="max allowed throughput DROP at max D vs the "
+                         "D1 row, as a factor (default 4: sharding "
+                         "overhead must not eat 4x — catches "
+                         "pathological collective blowup, not CPU-"
+                         "mesh speedup noise)")
+    ap.add_argument("--throughput-slack", type=float, default=0.5,
+                    help="under --check, each row's peer-ticks/s must "
+                         "stay above this fraction of the committed "
+                         "row (default 0.5)")
+    ns = ap.parse_args(argv)
+
+    cur = load(ns.artifact)
+    rc = 0
+    shape = cur.get("shape", {})
+    print(f"multi-chip scale-out: {shape.get('n')} peers x "
+          f"{shape.get('t')} topics, {shape.get('ticks')} ticks, "
+          f"platform={cur.get('platform')} "
+          f"({cur.get('n_devices')} devices"
+          f"{', hardware row queued' if cur.get('hardware_queued') else ''})")
+    curve = _curve(cur)
+    d1 = next(r for r in curve if r["devices"] == 1)
+    for r in curve:
+        coll = r.get("collectives") or {}
+        cdesc = " ".join(f"{k}x{v['count']}" for k, v in coll.items())
+        print(f"  {r['id']:<4s} n={r['n']:<9d} "
+              f"wall={r['wall_s']:.3f}s "
+              f"peer-ticks/s={r['peer_ticks_per_sec']:.3g}  "
+              f"compiles={r.get('compiles')}  "
+              f"bit_identical={r.get('bit_identical')}  "
+              f"[{cdesc or 'no collectives'}; "
+              f"{r.get('collective_bytes', 0)} B @probe]")
+    fl = _flagship(cur)
+    if fl:
+        print(f"  flagship n={fl['n']} D={fl['devices']} "
+              f"wall={fl['wall_s']}s "
+              f"peer-ticks/s={fl['peer_ticks_per_sec']:.3g}")
+
+    for r in curve:
+        if r["devices"] > 1 and not r.get("bit_identical"):
+            print(f"shardstat: {r['id']} final-state digest "
+                  f"{r.get('digest')} != the D1 row's — the sharded "
+                  "trajectory diverged from single-device",
+                  file=sys.stderr)
+            rc = 1
+        if r.get("compiles", 1) > 1:
+            print(f"shardstat: {r['id']} compiled {r['compiles']} "
+                  "executables — the carry-pinned runner must compile "
+                  "once per mesh", file=sys.stderr)
+            rc = 1
+        if r["devices"] > 1 and not r.get("collective_bytes"):
+            print(f"shardstat: {r['id']} shows no boundary "
+                  "collectives — the carry is replicating, not "
+                  "sharding", file=sys.stderr)
+            rc = 1
+    rmax = max(curve, key=lambda r: r["devices"])
+    if (rmax["peer_ticks_per_sec"]
+            < d1["peer_ticks_per_sec"] / ns.scaling_slack):
+        print(f"shardstat: D{rmax['devices']} throughput "
+              f"{rmax['peer_ticks_per_sec']:.3g} fell more than "
+              f"{ns.scaling_slack}x below the D1 row "
+              f"({d1['peer_ticks_per_sec']:.3g}) — pathological "
+              "partitioning", file=sys.stderr)
+        rc = 1
+
+    if ns.check:
+        base = load(ns.check)
+        by_id = {r["id"]: r for r in _curve(base)}
+        missing = set(by_id) - {r["id"] for r in curve}
+        if missing:
+            print("shardstat: device coverage shrank vs baseline: "
+                  f"missing {sorted(missing)}", file=sys.stderr)
+            rc = 1
+        for r in curve:
+            ref = by_id.get(r["id"])
+            if ref is None:
+                continue
+            floor = ref["peer_ticks_per_sec"] * ns.throughput_slack
+            verdict = ("OK" if r["peer_ticks_per_sec"] >= floor
+                       else "REGRESSED")
+            print(f"check: {r['id']} peer-ticks/s "
+                  f"{r['peer_ticks_per_sec']:.3g} vs baseline "
+                  f"{ref['peer_ticks_per_sec']:.3g} "
+                  f"(x{ns.throughput_slack} slack) -> {verdict}")
+            if verdict == "REGRESSED":
+                rc = 1
+        bfl, cfl = _flagship(base), _flagship(cur)
+        if bfl is not None:
+            if cfl is None or cfl["n"] < bfl["n"]:
+                print("shardstat: flagship peer count shrank vs "
+                      f"baseline ({bfl['n']} -> "
+                      f"{cfl['n'] if cfl else 'missing'})",
+                      file=sys.stderr)
+                rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -38,7 +38,11 @@ whole point: it stays 1), replica heartbeats/s, wall seconds.
 
 Import surface: ``SweepServer`` is the embeddable engine —
 bench_suite's ``gossipsub_sweepd`` row and tests drive it in-process;
-``main()`` wraps it in the line protocol.
+``main()`` wraps it in the line protocol.  A ``devices=D`` server
+(``--devices D``, round 14) shards every batched dispatch over the
+D-device ``peers`` mesh axis (parallel/sharded.py) — per replica the
+result rows are bit-identical to the single-device server, still at
+one compile.
 """
 
 from __future__ import annotations
@@ -76,7 +80,7 @@ class SweepServer:
                  receive_block: int = 128, interpret: bool = True,
                  attack_pool_frac: float = 0.2,
                  victim_pool_frac: float = 0.1,
-                 churn_pool_frac: float = 0.1):
+                 churn_pool_frac: float = 0.1, devices: int = 0):
         import go_libp2p_pubsub_tpu.models.gossipsub as gs
         import go_libp2p_pubsub_tpu.models.invariants as iv
         from go_libp2p_pubsub_tpu.models.tournament import (
@@ -92,6 +96,26 @@ class SweepServer:
             raise ValueError(
                 "kernel-path sweepd serves scenarios sequentially "
                 "(no vmap rule for the pallas step): use batch=1")
+        # round 14: a devices>0 server shards every dispatch over the
+        # D-device 'peers' mesh axis (parallel/sharded.py) — stacked
+        # scenario replicas keep their trailing peer axis sharded
+        # through the whole carry-pinned scan.  Per replica the rows
+        # are bit-identical to the single-device server.
+        self.mesh = None
+        self._shardings = None
+        if devices:
+            if kernel:
+                raise ValueError(
+                    "sweepd: --devices shards the batched XLA "
+                    "dispatch; the kernel-path server is the "
+                    "sequential demonstration — drive the sharded "
+                    "kernel through make_gossip_step(shard_mesh=...) "
+                    "directly instead")
+            from go_libp2p_pubsub_tpu.parallel import mesh as pmesh
+            from go_libp2p_pubsub_tpu.parallel import sharded as psh
+            self._psh = psh
+            self.mesh = pmesh.make_mesh(devices)
+            pmesh.check_peer_divisible(n, self.mesh)
         rng = np.random.default_rng(seed)
         offsets = gs.make_gossip_offsets(t, n_candidates, n, seed=seed)
         if kernel:
@@ -309,8 +333,16 @@ class SweepServer:
             else:
                 params = gs.stack_trees([b[0] for b in builds])
                 state = gs.stack_trees(states)
-                stateB, reach = gs.gossip_run_knob_batch(
-                    params, state, self.ticks, self.step, honest)
+                if self.mesh is not None:
+                    params, state, sh = self._psh.shard_sim(
+                        params, state, self.mesh, self.n)
+                    stateB, reach = \
+                        self._psh.sharded_gossip_run_knob_batch(
+                            params, state, self.ticks, self.step, sh,
+                            honest)
+                else:
+                    stateB, reach = gs.gossip_run_knob_batch(
+                        params, state, self.ticks, self.step, honest)
                 reach = np.asarray(reach)
                 inv_bits = (np.asarray(stateB.inv_viol)
                             if self.invariants is not None else None)
@@ -344,8 +376,11 @@ class SweepServer:
     # -- counters ------------------------------------------------------
 
     def _runner(self):
-        return (_run_single_fn() if self.batch == 1
-                else self.gs.gossip_run_knob_batch)
+        if self.batch == 1:
+            return _run_single_fn()
+        if self.mesh is not None:
+            return self._psh.sharded_gossip_run_knob_batch
+        return self.gs.gossip_run_knob_batch
 
     def compiles(self) -> int:
         """Number of executables THIS server compiled (the batched
@@ -370,7 +405,9 @@ class SweepServer:
             "device_s": round(dev, 2),
             "shape": {"n": self.n, "t": self.t, "m": self.m,
                       "ticks": self.ticks, "batch": self.batch,
-                      "kernel": self.kernel},
+                      "kernel": self.kernel,
+                      "devices": (self.mesh.size
+                                  if self.mesh is not None else 1)},
         }
 
     # -- line protocol -------------------------------------------------
@@ -467,6 +504,10 @@ def main(argv=None) -> int:
     ap.add_argument("--no-invariants", action="store_true")
     ap.add_argument("--kernel", action="store_true",
                     help="pallas-kernel path (sequential, batch=1)")
+    ap.add_argument("--devices", type=int, default=0,
+                    help="shard every dispatch over a D-device "
+                         "'peers' mesh (round 14; XLA batched path "
+                         "only; peers must divide evenly)")
     ap.add_argument("--socket", metavar="PATH",
                     help="serve a Unix socket instead of stdin")
     ns = ap.parse_args(argv)
@@ -475,7 +516,7 @@ def main(argv=None) -> int:
                       ticks=ns.ticks,
                       batch=(1 if ns.kernel else ns.batch),
                       seed=ns.seed, invariants=not ns.no_invariants,
-                      kernel=ns.kernel)
+                      kernel=ns.kernel, devices=ns.devices)
     if ns.socket:
         import socket as sk
         import os

@@ -84,6 +84,8 @@ import time
 
 import numpy as np
 
+from go_libp2p_pubsub_tpu.utils.artifacts import write_json_atomic
+
 
 def emit(metric, value, unit, baseline=None, extra=None):
     line = {"metric": metric, "value": round(value, 2), "unit": unit}
@@ -790,8 +792,7 @@ def bench_gossipsub_tournament():
     rep["tuned_vs_reference_delta"] = round(
         rep["worst_case"]["tuned"]["delivery_fraction"]
         - rep["worst_case"]["reference"]["delivery_fraction"], 4)
-    with open("/tmp/gossipsub_tournament.json", "w") as f:
-        json.dump(rep, f, indent=1)
+    write_json_atomic("/tmp/gossipsub_tournament.json", rep)
     emit(f"gossipsub_tournament_{n}peers_replica_heartbeats_per_sec",
          rep["replicas"] * T / dt, "heartbeats/s",
          extra={"cells": rep["replicas"], "ticks": T,
@@ -1119,8 +1120,7 @@ def bench_gossipsub_sweepd():
         "scenario_ids": [r["id"] for r in sweep_reqs],
         "rows": rows,
     }
-    with open("/tmp/gossipsub_sweepd.json", "w") as f:
-        json.dump(art, f, indent=1)
+    write_json_atomic("/tmp/gossipsub_sweepd.json", art)
     emit(f"gossipsub_sweepd_{n}peers_replica_heartbeats_per_sec",
          art["replica_hbps"], "heartbeats/s",
          extra={"configs": len(sweep_reqs), "compiles": compiles,
@@ -1262,8 +1262,7 @@ def bench_gossipsub_pipelined():
         "replica_hbps": round(len(points) * ticks / dt, 2),
         "rows": rows,
     }
-    with open("/tmp/gossipsub_pipelined.json", "w") as f:
-        json.dump(art, f, indent=1)
+    write_json_atomic("/tmp/gossipsub_pipelined.json", art)
     name = f"gossipsub_pipelined_{n}peers_replica_heartbeats_per_sec"
     emit(name, art["replica_hbps"], "heartbeats/s",
          extra={"points": [r["id"] for r in rows],
@@ -1414,8 +1413,7 @@ def bench_gossipsub_multichip():
                   "flagship_n": n_flag},
         "rows": rows,
     }
-    with open("/tmp/gossipsub_multichip.json", "w") as f:
-        json.dump(art, f, indent=1)
+    write_json_atomic("/tmp/gossipsub_multichip.json", art)
     emit(f"gossipsub_multichip_{n}peers_peer_ticks_per_sec",
          rows[len(Ds) - 1]["peer_ticks_per_sec"], "peer-ticks/s",
          extra={"devices": Ds[-1], "compiles_per_D": 1,
@@ -1430,6 +1428,192 @@ def bench_gossipsub_multichip():
              extra={"devices": rows[-1]["devices"],
                     "platform": backend,
                     "hardware_queued": backend != "tpu"})
+
+
+def bench_gossipsub_checkpoint():
+    """Round 15: preemption-tolerant execution
+    (parallel/checkpoint.py).  The tick horizon splits into S segments
+    of one lax.scan each with the FULL carry snapshotted (CRC-verified,
+    atomic) between segments; scan splitting is exact, so every row
+    must reproduce the single-scan digest BIT-IDENTICALLY.  Rows into
+    /tmp/gossipsub_checkpoint.json for the ``ckptstat --check`` gate
+    (measure_all step 4h):
+
+    * ``single``        the uninterrupted one-scan reference;
+    * ``segmented_S2`` / ``segmented_S4``  the segmented runner at
+      S in {2, 4} — digest, wall-clock (overhead vs single), compile
+      count (equal segments must share ONE executable), snapshot
+      bytes on disk;
+    * ``kill_resume``   a run interrupted via the deferred-SIGTERM
+      machinery (request_stop -> CheckpointInterrupt after the
+      in-flight segment flushes) and resumed from its snapshot;
+    * ``shard_restore`` saved under a shard_sim placement at D=4 and
+      resumed at D=8 (the D->D' restore contract) — skipped (and the
+      artifact tagged) when fewer than 8 devices are visible.
+
+    Shapes are env-tunable (GOSSIP_CKPT_N / GOSSIP_CKPT_TICKS);
+    snapshots live under GOSSIP_CKPT_DIR (default
+    /tmp/gossip_ckpt_bench, wiped per row)."""
+    import hashlib
+    import shutil
+
+    import jax
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
+    from go_libp2p_pubsub_tpu.parallel import mesh as pm
+    from go_libp2p_pubsub_tpu.parallel import sharded as ps
+
+    n = int(os.environ.get("GOSSIP_CKPT_N", 1_000_000))
+    ticks = int(os.environ.get("GOSSIP_CKPT_TICKS", 8))
+    base_dir = os.environ.get("GOSSIP_CKPT_DIR", "/tmp/gossip_ckpt_bench")
+    t, m = 10, 24
+    ndev = len(jax.devices())
+
+    rng = np.random.default_rng(0)
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, 16, n, seed=7), n_topics=t)
+    sc = gs.ScoreSimConfig()
+    subs = _subs_matrix(n, t)
+    topic, origin, pub = _msgs(rng, n, t, m, 3)
+
+    def build():
+        return gs.make_gossip_sim(cfg, subs, topic, origin, pub,
+                                  seed=3, score_cfg=sc,
+                                  track_first_tick=False)
+
+    def digest(out):
+        h = hashlib.sha256()
+        for leaf in (out.have, out.mesh, out.backoff, out.tick):
+            h.update(np.asarray(leaf).tobytes())
+        return h.hexdigest()[:16]
+
+    def fresh_dir(name):
+        d = os.path.join(base_dir, name)
+        shutil.rmtree(d, ignore_errors=True)
+        return d
+
+    fp = ck.config_fingerprint(cfg, sc)
+    step = gs.make_gossip_step(cfg, sc)
+    params, state = build()
+
+    t0 = time.perf_counter()
+    out = gs.gossip_run(params, gs.tree_copy(state), ticks, step)
+    jax.block_until_ready(out.have)
+    t0 = time.perf_counter()   # warm
+    out = gs.gossip_run(params, gs.tree_copy(state), ticks, step)
+    jax.block_until_ready(out.have)
+    wall_single = time.perf_counter() - t0
+    ref = digest(out)
+    rows = [{"id": "single", "n": n, "wall_s": round(wall_single, 3),
+             "digest": ref, "bit_identical": True}]
+
+    for S in (2, 4):
+        # cold pass: counts the compiles (equal segments must share
+        # ONE executable); warm pass in a fresh dir times the honest
+        # overhead — segment dispatch + snapshot I/O, compile excluded
+        d = fresh_dir(f"S{S}")
+        ckc = ck.CheckpointConfig(directory=d, every=max(ticks // S, 1),
+                                  fingerprint=fp)
+        cache0 = gs.gossip_run._cache_size()
+        out = ck.ckpt_gossip_run(params, gs.tree_copy(state), ticks,
+                                 step, ckc)
+        jax.block_until_ready(out.have)
+        compiles = gs.gossip_run._cache_size() - cache0
+        d = fresh_dir(f"S{S}")
+        ckc = ck.CheckpointConfig(directory=d, every=max(ticks // S, 1),
+                                  fingerprint=fp)
+        t0 = time.perf_counter()
+        out = ck.ckpt_gossip_run(params, gs.tree_copy(state), ticks,
+                                 step, ckc)
+        jax.block_until_ready(out.have)
+        dt = time.perf_counter() - t0
+        snap_bytes = sum(
+            os.path.getsize(os.path.join(d, f)) for f in os.listdir(d))
+        dg = digest(out)
+        rows.append({
+            "id": f"segmented_S{S}", "n": n, "segments": S,
+            "every": ckc.every, "wall_s": round(dt, 3),
+            "overhead_x": round(dt / wall_single, 2),
+            "compiles": int(compiles),
+            "snapshot_bytes": int(snap_bytes),
+            "digest": dg, "bit_identical": dg == ref,
+        })
+        assert dg == ref, (S, dg, ref)
+
+    # kill-resume: the deferred-stop machinery interrupts after the
+    # first flushed segment; the SAME call then resumes to completion
+    d = fresh_dir("kill")
+    ckc = ck.CheckpointConfig(directory=d, every=max(ticks // 4, 1),
+                              fingerprint=fp)
+    ck.request_stop()
+    interrupted = False
+    try:
+        ck.ckpt_gossip_run(params, gs.tree_copy(state), ticks, step,
+                           ckc)
+    except ck.CheckpointInterrupt as e:
+        interrupted = True
+        ticks_done = e.ticks_done
+    ck.clear_stop()
+    out = ck.ckpt_gossip_run(params, gs.tree_copy(state), ticks, step,
+                             ckc)
+    jax.block_until_ready(out.have)
+    dg = digest(out)
+    rows.append({
+        "id": "kill_resume", "n": n, "every": ckc.every,
+        "interrupted": interrupted,
+        "resumed_from_tick": ticks_done if interrupted else None,
+        "wall_s": 0.0, "digest": dg, "bit_identical": dg == ref,
+    })
+    assert interrupted and dg == ref, (interrupted, dg, ref)
+
+    # D->D' restore: save sharded at D_save, resume at D_resume
+    if ndev >= 2:
+        d_save = 4 if ndev >= 8 else ndev // 2
+        d_resume = 8 if ndev >= 8 else ndev
+        d = fresh_dir("shard")
+        ckc = ck.CheckpointConfig(directory=d, every=max(ticks // 2, 1),
+                                  fingerprint=fp)
+        mesh_s = pm.make_mesh(d_save)
+        p_s, s_s, sh_s = ps.shard_sim(params, gs.tree_copy(state),
+                                      mesh_s, n)
+        ck.request_stop()
+        try:
+            ck.ckpt_sharded_gossip_run(p_s, s_s, ticks, step, sh_s,
+                                       ckc)
+        except ck.CheckpointInterrupt:
+            pass
+        ck.clear_stop()
+        mesh_r = pm.make_mesh(d_resume)
+        p_r, s_r, sh_r = ps.shard_sim(params, gs.tree_copy(state),
+                                      mesh_r, n)
+        out = ck.ckpt_sharded_gossip_run(p_r, s_r, ticks, step, sh_r,
+                                         ckc)
+        jax.block_until_ready(out.have)
+        dg = digest(out)
+        rows.append({
+            "id": "shard_restore", "n": n,
+            "devices_save": d_save, "devices_resume": d_resume,
+            "wall_s": 0.0, "digest": dg, "bit_identical": dg == ref,
+        })
+        assert dg == ref, (d_save, d_resume, dg, ref)
+
+    shutil.rmtree(base_dir, ignore_errors=True)
+    backend = jax.default_backend()
+    art = {
+        "round": 15,
+        "platform": backend,
+        "n_devices": ndev,
+        "hardware_queued": backend != "tpu",
+        "shape": {"n": n, "t": t, "m": m, "ticks": ticks},
+        "rows": rows,
+    }
+    write_json_atomic("/tmp/gossipsub_checkpoint.json", art)
+    emit(f"gossipsub_checkpoint_{n}peers_segment_overhead_x",
+         rows[2]["overhead_x"], "x single-scan",
+         extra={"segments": 4, "compiles": rows[2]["compiles"],
+                "bit_identical": all(r["bit_identical"] for r in rows),
+                "kill_resume_ok": rows[3]["bit_identical"],
+                "rows": len(rows)})
 
 
 BENCHES = {
@@ -1454,13 +1638,31 @@ BENCHES = {
     "gossipsub_sweepd_kernel": bench_gossipsub_sweepd_kernel,
     "gossipsub_pipelined": bench_gossipsub_pipelined,
     "gossipsub_multichip": bench_gossipsub_multichip,
+    "gossipsub_checkpoint": bench_gossipsub_checkpoint,
 }
 
 
 def main():
+    # Deferred SIGTERM/SIGINT (round 15, op-note #2): a preempted
+    # suite finishes the in-flight segment/bench, flushes what it has,
+    # and exits 0 — ``timeout -k`` never SIGKILLs a mid-operation TPU
+    # client.  Segmented runs snapshot via CheckpointInterrupt; plain
+    # benches stop cleanly at the next bench boundary.
+    from go_libp2p_pubsub_tpu.parallel import checkpoint as _ck
+    _ck.install_kill_handlers()
     which = sys.argv[1:] or list(BENCHES)
     for name in which:
-        BENCHES[name]()
+        try:
+            BENCHES[name]()
+        except _ck.CheckpointInterrupt as e:
+            print(json.dumps({"metric": f"{name}_interrupted",
+                              "resume_snapshot": e.path,
+                              "ticks_done": e.ticks_done}), flush=True)
+            return
+        if _ck.stop_requested():
+            print(json.dumps({"metric": "suite_stopped_after",
+                              "bench": name}), flush=True)
+            return
 
 
 if __name__ == "__main__":

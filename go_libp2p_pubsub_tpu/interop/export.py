@@ -28,6 +28,8 @@ import numpy as np
 
 from ..core.tracer_sinks import proto_to_jsonable
 from ..pb import trace as tr
+from ..utils.artifacts import (write_bytes_atomic, write_json_atomic,
+                               write_text_atomic)
 from ..pb.proto import write_delimited
 from ..pb.trace import TraceType
 
@@ -772,8 +774,7 @@ def write_telemetry_frames(path: str, frames, tcfg,
         obj["latency_hist_by_topic"] = _tl.latency_hists_by_topic(
             counts, publish_tick, msg_topic, tcfg.latency_buckets,
             start_tick=start_tick)
-    with open(path, "w") as f:
-        json.dump(obj, f)
+    write_json_atomic(path, obj, indent=None)
 
 
 def merge_event_streams(*streams):
@@ -787,13 +788,11 @@ def merge_event_streams(*streams):
 
 def write_pb_trace(path: str, events) -> None:
     """Varint-delimited pb file — the PBTracer/reference format."""
-    with open(path, "wb") as f:
-        for evt in events:
-            f.write(write_delimited(evt))
+    write_bytes_atomic(path, b"".join(write_delimited(evt)
+                                      for evt in events))
 
 
 def write_json_trace(path: str, events) -> None:
     """ndjson file — the JSONTracer/reference format."""
-    with open(path, "w") as f:
-        for evt in events:
-            f.write(json.dumps(proto_to_jsonable(evt)) + "\n")
+    write_text_atomic(path, "".join(
+        json.dumps(proto_to_jsonable(evt)) + "\n" for evt in events))

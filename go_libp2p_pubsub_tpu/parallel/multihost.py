@@ -55,7 +55,15 @@ def make_global_mesh() -> Mesh:
     n = len(jax.devices())
     try:
         devices = mesh_utils.create_device_mesh((n,))
-    except (ValueError, AssertionError, NotImplementedError):
+    except (ValueError, NotImplementedError):
+        # mesh_utils only knows real accelerator topologies: ValueError
+        # when it cannot factor the device count onto one, and
+        # NotImplementedError for platforms with no topology table
+        # (CPU/GPU test rigs).  The 1-D peers ring needs no ICI
+        # ordering in that case — enumeration order is fine.  An
+        # AssertionError, by contrast, is a mesh_utils bug and must
+        # surface, not silently degrade the device ordering (round 15:
+        # narrowed from the old blanket tuple).
         devices = np.array(jax.devices())
     return Mesh(devices.reshape(-1), (PEER_AXIS,))
 

@@ -1,0 +1,41 @@
+"""Atomic artifact writes (round 15, op-note hygiene).
+
+A SIGKILLed bench/tool used to be able to leave a half-written
+``*_r*.json`` / trace / frame sidecar behind, which the ``*stat``
+gates can only reject as unusable (exit 2).  Every artifact writer
+goes through these helpers instead: write to ``path + ".tmp"``, fsync,
+``os.replace`` — so an artifact either exists complete or not at all,
+and a killed run can never leave a truncated file for the gates to
+choke on.  (utils/checkpoint.py and parallel/checkpoint.py snapshots
+already follow the same tmp+replace discipline.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+__all__ = ["write_bytes_atomic", "write_text_atomic",
+           "write_json_atomic"]
+
+
+def write_bytes_atomic(path: str, data: bytes) -> None:
+    """Write ``data`` to ``path`` atomically (tmp + os.replace)."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def write_text_atomic(path: str, text: str) -> None:
+    write_bytes_atomic(path, text.encode("utf-8"))
+
+
+def write_json_atomic(path: str, obj, *, indent: int | None = 1,
+                      **json_kwargs) -> None:
+    """json.dump, atomically.  The default indent=1 matches the
+    committed ``*_r*.json`` artifact style."""
+    write_text_atomic(path, json.dumps(obj, indent=indent,
+                                       **json_kwargs))

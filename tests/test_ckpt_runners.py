@@ -1,0 +1,574 @@
+"""Round-15 preemption tolerance (parallel/checkpoint.py): segmented
+checkpointed runs are BIT-IDENTICAL to the single uninterrupted scan on
+every execution path — XLA combined and split, the pallas kernel, flood
+circulant and gather, randomsub circulant and dense — with faults,
+event-driven delays, attacks, and telemetry armed; resume after a
+deleted tail snapshot, after a deferred-SIGTERM interrupt (in-process
+and as a real killed subprocess), and across a device-count change
+(save at D=4, resume at D=8) reproduces the same trajectory; and every
+unusable snapshot — truncated, bit-flipped, wrong magic, wrong config
+fingerprint, wrong peer layout, stale horizon — is rejected BY NAME.
+
+Scan splitting is exact (the tick index rides in the carry and the
+step is deterministic), so segmentation must never cost fidelity:
+identity here is exact array equality over the full state pytree, the
+same contract as tests/test_sharded.py."""
+
+import functools
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import go_libp2p_pubsub_tpu.models.floodsub as fs
+import go_libp2p_pubsub_tpu.models.gossipsub as gs
+import go_libp2p_pubsub_tpu.models.randomsub as rs
+import go_libp2p_pubsub_tpu.models.telemetry as tl
+from go_libp2p_pubsub_tpu.models.delays import DelayConfig
+from go_libp2p_pubsub_tpu.models.faults import FaultSchedule
+from go_libp2p_pubsub_tpu.ops.graph import make_circulant_offsets
+from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
+from go_libp2p_pubsub_tpu.parallel import mesh as pm
+from go_libp2p_pubsub_tpu.parallel import sharded as ps
+
+N, T, M, TICKS, BLOCK = 512, 4, 8, 10, 64
+
+
+def teardown_module(module):
+    """Release this module's cached sims/steps AND the executables
+    compiled against them: at ~500 tests in one pytest process the
+    suite's cumulative compile cache is big enough that the largest
+    compile later in the run (test_trace_export's probe runner) can
+    segfault XLA's CPU backend — freeing our share keeps the whole
+    run at its pre-round-15 footprint."""
+    import jax
+    _armed.cache_clear()
+    _armed_ref.cache_clear()
+    _kernel_parts.cache_clear()
+    _flood_inputs.cache_clear()
+    jax.clear_caches()
+
+#: segment lengths under test: every=5 -> 2 equal segments,
+#: every=3 -> 4 segments (3+3+3+1, the remainder case)
+EVERIES = (5, 3)
+
+
+def _scenario(seed=0):
+    rng = np.random.default_rng(seed)
+    subs = np.zeros((N, T), dtype=bool)
+    subs[np.arange(N), np.arange(N) % T] = True
+    topic = rng.integers(0, T, M)
+    origin = rng.integers(0, N // T, M) * T + topic
+    tick0 = np.sort(rng.integers(0, 6, M)).astype(np.int32)
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(T, 16, N, seed=7), n_topics=T)
+    return cfg, subs, topic, origin, tick0
+
+
+def _faults():
+    return FaultSchedule(
+        n_peers=N, horizon=TICKS, drop_prob=0.05, seed=5,
+        down_intervals=tuple((int(p), 2, 5) for p in range(0, N, 41)))
+
+
+def _trees_equal(a, b):
+    import jax
+    fa, _ = jax.tree_util.tree_flatten(
+        jax.tree_util.tree_map(np.asarray, a))
+    fb, _ = jax.tree_util.tree_flatten(
+        jax.tree_util.tree_map(np.asarray, b))
+    assert len(fa) == len(fb)
+    return all(np.array_equal(x, y) for x, y in zip(fa, fb))
+
+
+def _ckpt(tmp_path, every, **kw):
+    return ck.CheckpointConfig(directory=str(tmp_path / "snaps"),
+                               every=every, **kw)
+
+
+# -- gossip XLA, everything armed (delays + faults + sybil) ----------------
+
+@functools.lru_cache(maxsize=None)
+def _armed():
+    cfg, subs, topic, origin, tick0 = _scenario()
+    sc = gs.ScoreSimConfig(sybil_ihave_spam=True)
+    sybil = (np.arange(N) % 37 == 0)
+    tcfg = tl.TelemetryConfig(
+        counters=False, wire=False, mesh=False, scores=False,
+        faults=False, latency_hist=True, latency_buckets=TICKS)
+
+    def build(split=False):
+        return gs.make_gossip_sim(
+            cfg, subs, topic, origin, tick0, seed=3, score_cfg=sc,
+            delays=DelayConfig(base=2, jitter=1, k_slots=4),
+            delays_split=split,   # the split path needs its own line
+            fault_schedule=_faults(), sybil=sybil,
+            track_first_tick=False)
+
+    steps = {
+        "combined": gs.make_gossip_step(cfg, sc),
+        "split": gs.make_gossip_step(cfg, sc, force_split=True),
+        "tel": gs.make_gossip_step(cfg, sc, telemetry=tcfg),
+    }
+    return cfg, sc, build, steps
+
+
+@functools.lru_cache(maxsize=None)
+def _armed_ref(which):
+    cfg, sc, build, steps = _armed()
+    params, state = build(which == "split")
+    if which == "tel":
+        s_ref, fr = tl.telemetry_run(params, state, TICKS, steps["tel"])
+        return s_ref, tl.frames_to_arrays(fr)
+    return gs.gossip_run(params, state, TICKS, steps[which])
+
+
+@pytest.mark.parametrize("every", EVERIES)
+@pytest.mark.parametrize("which", ["combined", "split"])
+def test_gossip_xla_segmented_bit_identity(which, every, tmp_path):
+    """Both XLA formulations, delays + faults + sybil spam armed."""
+    cfg, sc, build, steps = _armed()
+    s_ref = _armed_ref(which)
+    params, state = build(which == "split")
+    s_seg = ck.ckpt_gossip_run(params, state, TICKS, steps[which],
+                               _ckpt(tmp_path, every))
+    assert _trees_equal(s_ref, s_seg)
+
+
+@pytest.mark.parametrize("every", EVERIES)
+def test_telemetry_segmented_bit_identity(every, tmp_path):
+    """telemetry_run segmented: the per-tick frame blocks concatenate
+    across segments (riding through the snapshots), so BOTH the state
+    and every frame array must match the single scan exactly."""
+    cfg, sc, build, steps = _armed()
+    s_ref, fr_ref = _armed_ref("tel")
+    params, state = build()
+    s_seg, fr_seg = ck.ckpt_telemetry_run(
+        params, state, TICKS, steps["tel"], _ckpt(tmp_path, every))
+    assert _trees_equal(s_ref, s_seg)
+    dev = tl.frames_to_arrays(fr_seg)
+    assert set(fr_ref) == set(dev)
+    for k in fr_ref:
+        assert np.array_equal(np.asarray(fr_ref[k]),
+                              np.asarray(dev[k])), k
+
+
+@pytest.mark.parametrize("every", EVERIES)
+def test_curve_segmented_bit_identity(every, tmp_path):
+    cfg, sc, build, steps = _armed()
+    params, state = build()
+    s_ref, c_ref = gs.gossip_run_curve(params, state, TICKS,
+                                       steps["combined"], M)
+    params, state = build()
+    s_seg, c_seg = ck.ckpt_gossip_run_curve(
+        params, state, TICKS, steps["combined"],
+        _ckpt(tmp_path, every), M)
+    assert _trees_equal(s_ref, s_seg)
+    assert np.array_equal(np.asarray(c_ref), np.asarray(c_seg))
+
+
+def test_knob_batch_segmented_bit_identity(tmp_path):
+    """The sweepd device side, segmented: stacked seed-replicas, final
+    honest-masked reach computed once at the end of the horizon."""
+    cfg, subs, topic, origin, tick0 = _scenario()
+    sc = gs.ScoreSimConfig()
+
+    def build():
+        builds = [gs.make_gossip_sim(
+            cfg, subs, topic, origin, tick0, seed=r, score_cfg=sc,
+            fault_schedule=_faults(), sim_knobs={},
+            track_first_tick=False) for r in range(3)]
+        return (gs.stack_trees([b[0] for b in builds]),
+                gs.stack_trees([b[1] for b in builds]))
+
+    step = gs.make_gossip_step(cfg, sc)
+    params, state = build()
+    s_ref, r_ref = gs.gossip_run_knob_batch(params, state, TICKS, step)
+    params, state = build()
+    s_seg, r_seg = ck.ckpt_gossip_run_knob_batch(
+        params, state, TICKS, step, _ckpt(tmp_path, 3))
+    assert _trees_equal(s_ref, s_seg)
+    assert np.array_equal(np.asarray(r_ref), np.asarray(r_seg))
+
+
+# -- pallas kernel path ----------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _kernel_parts():
+    cfg, subs, topic, origin, tick0 = _scenario()
+    sc = gs.ScoreSimConfig()
+
+    def build():
+        return gs.make_gossip_sim(
+            cfg, subs, topic, origin, tick0, seed=3, score_cfg=sc,
+            fault_schedule=_faults(), track_first_tick=False,
+            pad_to_block=BLOCK)
+
+    step = gs.make_gossip_step(cfg, sc, receive_block=BLOCK,
+                               receive_interpret=True)
+    params, state = build()
+    s_ref = gs.gossip_run(params, state, TICKS, step)
+    return build, step, s_ref
+
+
+@pytest.mark.parametrize("every", EVERIES)
+def test_kernel_segmented_bit_identity(every, tmp_path):
+    build, step, s_ref = _kernel_parts()
+    params, state = build()
+    s_seg = ck.ckpt_gossip_run(params, state, TICKS, step,
+                               _ckpt(tmp_path, every))
+    assert _trees_equal(s_ref, s_seg)
+
+
+# -- flood + randomsub, both variants --------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _flood_inputs():
+    rng = np.random.default_rng(1)
+    subs = np.zeros((N, T), dtype=bool)
+    subs[np.arange(N), np.arange(N) % T] = True
+    topic = rng.integers(0, T, M)
+    origin = rng.integers(0, N // T, M) * T + topic
+    tick0 = np.sort(rng.integers(0, 6, M)).astype(np.int32)
+    offs = tuple(int(o) for o in make_circulant_offsets(T, 16, N,
+                                                        seed=1))
+    return subs, topic, origin, tick0, offs
+
+
+@pytest.mark.parametrize("every", EVERIES)
+@pytest.mark.parametrize("variant", ["circulant", "gather"])
+def test_flood_segmented_bit_identity(variant, every, tmp_path):
+    subs, topic, origin, tick0, offs = _flood_inputs()
+    if variant == "circulant":
+        def build():
+            return fs.make_flood_sim(
+                None, None, subs, None, topic, origin, tick0,
+                fault_schedule=_faults(), fault_offsets=offs,
+                delays=DelayConfig(base=2, jitter=1, k_slots=4))
+        core = fs.make_circulant_step_core(offs)
+    else:
+        nbrs = np.stack([(np.arange(N) + o) % N for o in offs], axis=1)
+        mask = np.ones_like(nbrs, dtype=bool)
+
+        def build():
+            return fs.make_flood_sim(
+                nbrs, mask, subs, None, topic, origin, tick0,
+                fault_schedule=_faults())
+        core = fs.make_gather_step_core()
+
+    params, state = build()
+    s_ref, c_ref = fs.flood_run_curve(params, state, TICKS, core, M)
+    params, state = build()
+    s_seg, c_seg = ck.ckpt_flood_run_curve(
+        params, state, TICKS, core, _ckpt(tmp_path, every), M)
+    assert _trees_equal(s_ref, s_seg)
+    assert np.array_equal(np.asarray(c_ref), np.asarray(c_seg))
+
+
+@pytest.mark.parametrize("every", EVERIES)
+@pytest.mark.parametrize("variant", ["circulant", "dense"])
+def test_randomsub_segmented_bit_identity(variant, every, tmp_path):
+    subs, topic, origin, tick0, _ = _flood_inputs()
+    rcfg = rs.RandomSubSimConfig(
+        offsets=rs.make_randomsub_offsets(T, 16, N, seed=1),
+        n_topics=T, d=3)
+    if variant == "circulant":
+        def build():
+            return rs.make_randomsub_sim(
+                rcfg, subs, topic, origin, tick0,
+                fault_schedule=_faults(),
+                delays=DelayConfig(base=2, jitter=1, k_slots=4))
+        step = rs.make_randomsub_step(rcfg)
+    else:
+        def build():
+            return rs.make_randomsub_sim(
+                rcfg, subs, topic, origin, tick0, dense=True,
+                fault_schedule=_faults())
+        step = rs.make_randomsub_dense_step(rcfg)
+
+    params, state = build()
+    s_ref = rs.randomsub_run(params, state, TICKS, step)
+    params, state = build()
+    s_seg = ck.ckpt_randomsub_run(params, state, TICKS, step,
+                                  _ckpt(tmp_path, every))
+    assert _trees_equal(s_ref, s_seg)
+
+
+# -- resume: crash, kill flag, killed subprocess ---------------------------
+
+def test_resume_after_losing_tail_snapshot(tmp_path):
+    """Delete the final snapshot after a completed segmented run (the
+    mid-run-crash stand-in): re-running the same call resumes from the
+    surviving snapshot and lands on the identical final state."""
+    cfg, sc, build, steps = _armed()
+    s_ref = _armed_ref("combined")
+    ckc = _ckpt(tmp_path, 3, keep=10)
+    params, state = build()
+    ck.ckpt_gossip_run(params, state, TICKS, steps["combined"], ckc)
+    snaps = sorted(os.listdir(ckc.directory))
+    assert len(snaps) == 4   # 3+3+3+1 ticks
+    os.unlink(os.path.join(ckc.directory, snaps[-1]))
+    params, state = build()
+    s_res = ck.ckpt_gossip_run(params, state, TICKS,
+                               steps["combined"], ckc)
+    assert _trees_equal(s_ref, s_res)
+
+
+def test_kill_flag_interrupts_then_resumes(tmp_path):
+    """The deferred-kill contract in-process: with the stop flag up,
+    the engine finishes the CURRENT segment, flushes its snapshot, and
+    raises CheckpointInterrupt naming it; after clear_stop() the same
+    call resumes from that snapshot to the identical final state."""
+    cfg, sc, build, steps = _armed()
+    s_ref = _armed_ref("combined")
+    ckc = _ckpt(tmp_path, 3)
+    ck.request_stop()
+    try:
+        params, state = build()
+        with pytest.raises(ck.CheckpointInterrupt) as ei:
+            ck.ckpt_gossip_run(params, state, TICKS,
+                               steps["combined"], ckc)
+        assert ei.value.ticks_done == 3
+        assert os.path.exists(ei.value.path)
+    finally:
+        ck.clear_stop()
+    params, state = build()
+    s_res = ck.ckpt_gossip_run(params, state, TICKS,
+                               steps["combined"], ckc)
+    assert _trees_equal(s_ref, s_res)
+
+
+_CHILD = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+import numpy as np
+import go_libp2p_pubsub_tpu.models.gossipsub as gs
+from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
+
+N, T, M, TICKS = 256, 4, 6, 400
+rng = np.random.default_rng(0)
+subs = np.zeros((N, T), dtype=bool)
+subs[np.arange(N), np.arange(N) % T] = True
+topic = rng.integers(0, T, M)
+origin = rng.integers(0, N // T, M) * T + topic
+tick0 = np.zeros(M, dtype=np.int32)
+cfg = gs.GossipSimConfig(
+    offsets=gs.make_gossip_offsets(T, 16, N, seed=7), n_topics=T)
+sc = gs.ScoreSimConfig()
+step = gs.make_gossip_step(cfg, sc)
+params, state = gs.make_gossip_sim(
+    cfg, subs, topic, origin, tick0, seed=3, score_cfg=sc,
+    track_first_tick=False)
+ckc = ck.CheckpointConfig(directory={snapdir!r}, every=1)
+try:
+    ck.ckpt_gossip_run(params, state, TICKS, step, ckc)
+    print("DONE", flush=True)
+except ck.CheckpointInterrupt as e:
+    print(f"INTERRUPTED ticks_done={{e.ticks_done}}", flush=True)
+    raise SystemExit(0)
+"""
+
+
+def test_sigterm_killed_subprocess_resumes_identically(tmp_path):
+    """A REAL SIGTERM against a running child process: the installed
+    handlers defer it, the child finishes its in-flight segment,
+    flushes the snapshot, and exits 0; resuming in-process from the
+    child's snapshot directory reproduces the uninterrupted digest."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    snapdir = str(tmp_path / "snaps")
+    script = _CHILD.format(repo=repo, snapdir=snapdir)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    child = subprocess.Popen([sys.executable, "-c", script],
+                             stdout=subprocess.PIPE, text=True,
+                             env=env)
+    try:
+        # wait for the run to be demonstrably mid-flight (2 snapshots
+        # out of 400 segments), then deliver the real signal
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if (os.path.isdir(snapdir)
+                    and len(os.listdir(snapdir)) >= 2):
+                break
+            time.sleep(0.01)
+        else:
+            pytest.fail("child never produced snapshots")
+        child.send_signal(signal.SIGTERM)
+        out, _ = child.communicate(timeout=120)
+    finally:
+        if child.poll() is None:
+            child.kill()
+    assert child.returncode == 0, out
+    assert "INTERRUPTED" in out, out
+
+    # uninterrupted reference, then resume from the child's snapshots
+    def build():
+        rng = np.random.default_rng(0)
+        n, t, m = 256, 4, 6
+        subs = np.zeros((n, t), dtype=bool)
+        subs[np.arange(n), np.arange(n) % t] = True
+        topic = rng.integers(0, t, m)
+        origin = rng.integers(0, n // t, m) * t + topic
+        tick0 = np.zeros(m, dtype=np.int32)
+        cfg = gs.GossipSimConfig(
+            offsets=gs.make_gossip_offsets(t, 16, n, seed=7),
+            n_topics=t)
+        sc = gs.ScoreSimConfig()
+        step = gs.make_gossip_step(cfg, sc)
+        params, state = gs.make_gossip_sim(
+            cfg, subs, topic, origin, tick0, seed=3, score_cfg=sc,
+            track_first_tick=False)
+        return params, state, step
+
+    params, state, step = build()
+    s_ref = gs.gossip_run(params, state, 400, step)
+    params, state, step = build()
+    s_res = ck.ckpt_gossip_run(
+        params, state, 400, step,
+        ck.CheckpointConfig(directory=snapdir, every=1))
+    assert _trees_equal(s_ref, s_res)
+
+
+# -- sharded: D -> D' re-placement -----------------------------------------
+
+def test_sharded_save_d4_resume_d8_bit_identity(tmp_path):
+    """Snapshots hold host-side full arrays, so restore re-places them
+    under ANY shard_sim layout: save under a 4-device mesh, resume
+    under 8, final state identical to the single-device reference."""
+    cfg, sc, build, steps = _armed()
+    s_ref = _armed_ref("combined")
+    ckc = _ckpt(tmp_path, 3)
+    step = steps["combined"]
+
+    mesh4 = pm.make_mesh(4)
+    params, state = build()
+    p4, s4, sh4 = ps.shard_sim(params, state, mesh4, N)
+    ck.request_stop()   # interrupt after the first segment
+    try:
+        with pytest.raises(ck.CheckpointInterrupt):
+            ck.ckpt_sharded_gossip_run(p4, s4, TICKS, step, sh4, ckc)
+    finally:
+        ck.clear_stop()
+
+    mesh8 = pm.make_mesh(8)
+    params, state = build()
+    p8, s8, sh8 = ps.shard_sim(params, state, mesh8, N)
+    s_res = ck.ckpt_sharded_gossip_run(p8, s8, TICKS, step, sh8, ckc)
+    assert _trees_equal(s_ref, s_res)
+
+
+# -- rejection by name -----------------------------------------------------
+
+def _one_snapshot(tmp_path, fingerprint=0):
+    """A completed 2-segment run's newest snapshot path + its config."""
+    cfg, sc, build, steps = _armed()
+    ckc = _ckpt(tmp_path, 5, fingerprint=fingerprint)
+    params, state = build()
+    ck.ckpt_gossip_run(params, state, TICKS, steps["combined"], ckc)
+    found = ck.latest_snapshot(ckc.directory, ckc.tag)
+    assert found is not None
+    return found[1], ckc
+
+
+def test_truncated_snapshot_rejected_by_name(tmp_path):
+    path, _ = _one_snapshot(tmp_path)
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[:-64])
+    with pytest.raises(ValueError, match="truncated snapshot"):
+        ck.snapshot_read(path)
+
+
+def test_bitflipped_snapshot_rejected_by_name(tmp_path):
+    path, _ = _one_snapshot(tmp_path)
+    blob = bytearray(open(path, "rb").read())
+    blob[-100] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(ValueError, match="CRC32 mismatch"):
+        ck.snapshot_read(path)
+
+
+def test_non_snapshot_file_rejected_by_name(tmp_path):
+    p = tmp_path / "junk.ckpt"
+    p.write_bytes(b'{"magic": "something-else"}\n')
+    with pytest.raises(ValueError, match="not a checkpoint snapshot"):
+        ck.snapshot_read(str(p))
+    p.write_bytes(b"no header here")
+    with pytest.raises(ValueError, match="no header line"):
+        ck.snapshot_read(str(p))
+
+
+def test_fingerprint_mismatch_rejected_through_runner(tmp_path):
+    """The engine-level wiring: a runner resuming over a snapshot
+    written under a different config fingerprint must refuse by name,
+    never silently re-run."""
+    cfg, sc, build, steps = _armed()
+    fp = ck.config_fingerprint(cfg, sc)
+    _, ckc = _one_snapshot(tmp_path, fingerprint=fp)
+    params, state = build()
+    bad = ck.CheckpointConfig(directory=ckc.directory, every=5,
+                              fingerprint=fp + 1)
+    with pytest.raises(ValueError, match="fingerprint"):
+        ck.ckpt_gossip_run(params, state, TICKS, steps["combined"],
+                           bad)
+
+
+def test_layout_mismatch_rejected_by_name(tmp_path):
+    """Resuming a 512-peer snapshot into a 256-peer sim must name the
+    offending leaf and the layout contract, not crash in XLA."""
+    path, ckc = _one_snapshot(tmp_path)
+    n2, t = 256, 4
+    rng = np.random.default_rng(0)
+    subs = np.zeros((n2, t), dtype=bool)
+    subs[np.arange(n2), np.arange(n2) % t] = True
+    topic = rng.integers(0, t, M)
+    origin = rng.integers(0, n2 // t, M) * t + topic
+    cfg2 = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, 16, n2, seed=7), n_topics=t)
+    sc2 = gs.ScoreSimConfig()
+    step2 = gs.make_gossip_step(cfg2, sc2)
+    params, state = gs.make_gossip_sim(
+        cfg2, subs, topic, origin, np.zeros(M, np.int32), seed=3,
+        score_cfg=sc2, track_first_tick=False)
+    with pytest.raises(ValueError, match="peer-axis layout or sim "
+                                         "configuration mismatch"):
+        ck.ckpt_gossip_run(params, state, TICKS, step2, ckc)
+
+
+def test_stale_horizon_rejected_by_name(tmp_path):
+    """A snapshot further along than the requested horizon is a config
+    error, not something to silently truncate."""
+    cfg, sc, build, steps = _armed()
+    _, ckc = _one_snapshot(tmp_path)
+    params, state = build()
+    with pytest.raises(ValueError, match="requested horizon"):
+        ck.ckpt_gossip_run(params, state, TICKS - 5,
+                           steps["combined"], ckc)
+
+
+def test_completed_aux_run_rejected_by_name(tmp_path):
+    """An aux-carrying runner (curve/telemetry) re-invoked over an
+    ALREADY COMPLETE snapshot chain cannot reconstruct its aux stream
+    — it must say so, not return half data."""
+    cfg, sc, build, steps = _armed()
+    ckc = _ckpt(tmp_path, 5)
+    params, state = build()
+    ck.ckpt_gossip_run_curve(params, state, TICKS, steps["combined"],
+                             ckc, M)
+    params, state = build()
+    with pytest.raises(ValueError, match="already complete"):
+        ck.ckpt_gossip_run_curve(params, state, TICKS,
+                                 steps["combined"], ckc, M)
+
+
+def test_config_fingerprint_discriminates():
+    cfg, subs, topic, origin, tick0 = _scenario()
+    sc = gs.ScoreSimConfig()
+    a = ck.config_fingerprint(cfg, sc)
+    assert a == ck.config_fingerprint(cfg, sc)
+    cfg2 = gs.GossipSimConfig(offsets=cfg.offsets, n_topics=T, d=5)
+    assert a != ck.config_fingerprint(cfg2, sc)
+    assert a != ck.config_fingerprint(
+        cfg, gs.ScoreSimConfig(sybil_ihave_spam=True))

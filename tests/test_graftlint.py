@@ -172,7 +172,7 @@ def test_only_graftlint_fixture_dir_is_exempt(tmp_path):
 
 def test_declared_matrix_shape():
     combos = ja.declared_matrix()
-    assert len(combos) == 61
+    assert len(combos) == 64
     # base 32: all three sims x telemetry x faults x batched; split
     # axis only on gossipsub.  Round-10 variants: gather/dense
     # (tel x faults), rpc (tel, faulted), hist (faults, scored).
@@ -188,18 +188,21 @@ def test_declared_matrix_shape():
     # 'peers' mesh, sequential + knob-batched) and sharded-kernel /
     # sharded-kernel-delays (shard_map pallas dispatch — the former
     # asserts ppermute+psum halos, the latter the halo-free delay
-    # mode).
+    # mode).  Round-15 variant: ckpt (the segmented checkpoint
+    # engine's dispatch table traced at the split horizon — gossip
+    # sequential + knob-batched, flood sequential).
     key = lambda c: (c["sim"], c["split"], c["telemetry"],  # noqa: E731
                      c["faults"], c["batched"], c["variant"])
-    assert len({key(c) for c in combos}) == 61
+    assert len({key(c) for c in combos}) == 64
     assert sum(not c["variant"] for c in combos) == 32
-    for sim, n in (("gossipsub", 33), ("floodsub", 14),
+    for sim, n in (("gossipsub", 35), ("floodsub", 15),
                    ("randomsub", 14)):
         assert sum(c["sim"] == sim for c in combos) == n
     for var, n in (("gather", 4), ("dense", 4), ("rpc", 2),
                    ("hist", 2), ("inv", 4), ("attack", 2),
                    ("knobs", 2), ("delays", 5), ("sharded", 2),
-                   ("sharded-kernel", 1), ("sharded-kernel-delays", 1)):
+                   ("sharded-kernel", 1), ("sharded-kernel-delays", 1),
+                   ("ckpt", 3)):
         assert sum(c["variant"] == var for c in combos) == n
     axes = {ax: {c[ax] for c in combos}
             for ax in ("telemetry", "faults", "batched")}

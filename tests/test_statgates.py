@@ -1,0 +1,140 @@
+"""Round 15: the ``*stat --check`` gate contract on unusable input.
+
+measure_all.sh branches on the exit code of every stat gate: 2 means
+"unusable artifact" (bench crashed / file mangled), nonzero-else means
+"real regression".  That split only works if a truncated, empty, or
+bit-flipped artifact produces a CLEAN exit 2 with a named reason —
+never a traceback (which the shell would read as a generic crash) and
+never a silent 0.  Round 15 makes every artifact write atomic
+(utils/artifacts.py), so a mangled file should no longer occur — but
+the gates stay the last line of defense, and this pins all six of
+them, on the artifact operand and on the ``--check`` baseline operand.
+
+The committed baselines double as the valid fixtures: each gate run
+against its own committed artifact must come back usable (0 or 1 —
+never 2), which keeps the corruption fixtures honest (corrupting an
+already-unusable file would prove nothing).
+"""
+
+import importlib
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+
+#: (module, committed baseline artifact) — the artifact the bench
+#: writes and the committed baseline share one schema for every gate
+GATES = [
+    ("tools.tourneystat", "TOURNEY_r12.json"),
+    ("tools.sweepstat", "SWEEP_r12.json"),
+    ("tools.delaystat", "DELAY_r13.json"),
+    ("tools.shardstat", "MULTICHIP_r14.json"),
+    ("tools.ckptstat", "CKPT_r15.json"),
+]
+
+MODES = ("truncated", "empty", "bitflip")
+
+
+def _corrupt(mode: str, data: bytes) -> bytes:
+    if mode == "empty":
+        return b""
+    if mode == "truncated":
+        return data[: len(data) // 2]
+    flipped = bytearray(data)
+    flipped[0] ^= 0x08   # '{' -> 's': structurally fatal, 1 bit
+    return bytes(flipped)
+
+
+def _rc(mod, argv):
+    """main(argv)'s exit code whether returned or raised — and any
+    OTHER exception is the traceback failure mode this test exists to
+    forbid, so let it propagate."""
+    try:
+        return mod.main(argv)
+    except SystemExit as e:
+        return e.code if isinstance(e.code, int) else 1
+
+
+@pytest.mark.parametrize("modname,baseline", GATES,
+                         ids=[m.split(".")[1] for m, _ in GATES])
+def test_committed_baseline_is_usable(modname, baseline):
+    mod = importlib.import_module(modname)
+    art = str(REPO / baseline)
+    assert _rc(mod, [art, "--check", art]) in (0, 1)
+
+
+@pytest.mark.parametrize("mode", MODES)
+@pytest.mark.parametrize("modname,baseline", GATES,
+                         ids=[m.split(".")[1] for m, _ in GATES])
+def test_corrupt_artifact_exits_2(modname, baseline, mode, tmp_path):
+    mod = importlib.import_module(modname)
+    good = (REPO / baseline).read_bytes()
+    bad = tmp_path / f"{mode}.json"
+    bad.write_bytes(_corrupt(mode, good))
+    assert _rc(mod, [str(bad), "--check",
+                     str(REPO / baseline)]) == 2
+
+
+@pytest.mark.parametrize("mode", MODES)
+@pytest.mark.parametrize("modname,baseline", GATES,
+                         ids=[m.split(".")[1] for m, _ in GATES])
+def test_corrupt_baseline_exits_2(modname, baseline, mode, tmp_path):
+    """The --check operand is an artifact too: a mangled committed
+    baseline must be a named unusable verdict, not a crash."""
+    mod = importlib.import_module(modname)
+    good = (REPO / baseline).read_bytes()
+    bad = tmp_path / f"{mode}.json"
+    bad.write_bytes(_corrupt(mode, good))
+    assert _rc(mod, [str(REPO / baseline), "--check",
+                     str(bad)]) == 2
+
+
+# -- tracestat: sys.argv CLI, binary pb / ndjson artifact -----------------
+
+
+def _tracestat_rc(monkeypatch, argv):
+    import tools.tracestat as ts
+    monkeypatch.setattr(sys, "argv", ["tracestat"] + argv)
+    try:
+        rc = ts.main()
+        return 0 if rc is None else rc
+    except SystemExit as e:
+        return e.code if isinstance(e.code, int) else 1
+
+
+#: a two-line ndjson trace whose FIRST line is longer than the rest,
+#: so the half-cut truncation always lands mid-line
+_NDJSON = (
+    b'{"type": "PUBLISH_MESSAGE", "publishMessage": {"message_id": '
+    b'"AAAA", "topic": "t0"}, "timestamp": 100, "padding": "xxxxxxxx"}\n'
+    b'{"type": "GRAFT", "timestamp": 101}\n')
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_tracestat_corrupt_trace_exits_2(mode, tmp_path, monkeypatch):
+    bad = tmp_path / "trace.json"
+    bad.write_bytes(_corrupt(mode, _NDJSON))
+    assert _tracestat_rc(monkeypatch, [str(bad)]) == 2
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_tracestat_corrupt_frames_exits_2(mode, tmp_path, monkeypatch):
+    """A mangled frames SIDECAR is the same unusable verdict."""
+    trace = tmp_path / "trace.json"
+    trace.write_bytes(_NDJSON)
+    frames = tmp_path / "frames.json"
+    frames.write_bytes(_corrupt(
+        mode, b'{"latency_hist": [0, 3, 1], "latency_buckets": 3}'))
+    assert _tracestat_rc(
+        monkeypatch, [str(trace), "--frames", str(frames)]) == 2
+
+
+def test_tracestat_corrupt_baseline_exits_2(tmp_path, monkeypatch):
+    trace = tmp_path / "trace.json"
+    trace.write_bytes(_NDJSON)
+    bad = tmp_path / "baseline.json"
+    bad.write_bytes(b'{"cover')
+    assert _tracestat_rc(
+        monkeypatch, [str(trace), "--check", str(bad)]) == 2

@@ -16,6 +16,8 @@ from collections import Counter
 sys.path.insert(0, ".")  # graftlint: ignore[sys-path-insert]
 sys.path.insert(0, "tools")  # graftlint: ignore[sys-path-insert]
 
+from go_libp2p_pubsub_tpu.utils.artifacts import write_text_atomic  # noqa: E402
+
 from bench_kernel import build  # noqa: E402
 
 
@@ -37,8 +39,7 @@ def main():
         return gs.gossip_run(params, state, 100, step)
 
     txt = jax.jit(run).lower(params, state).compile().as_text()
-    with open("/tmp/step_hlo.txt", "w") as f:
-        f.write(txt)
+    write_text_atomic("/tmp/step_hlo.txt", txt)
     print(f"HLO: {len(txt.splitlines())} lines -> /tmp/step_hlo.txt")
 
     # split computations

@@ -1071,6 +1071,51 @@ def _reject_fault_horizon():
     _fault_schedule(horizon=0)   # must raise
 
 
+def _reject_ckpt_directory():
+    from go_libp2p_pubsub_tpu.parallel.checkpoint import (
+        CheckpointConfig)
+    CheckpointConfig(directory="")   # must raise
+
+
+def _reject_ckpt_every():
+    from go_libp2p_pubsub_tpu.parallel.checkpoint import (
+        CheckpointConfig)
+    CheckpointConfig(directory="/tmp/x", every=-1)   # must raise
+
+
+def _reject_ckpt_keep():
+    from go_libp2p_pubsub_tpu.parallel.checkpoint import (
+        CheckpointConfig)
+    CheckpointConfig(directory="/tmp/x", keep=0)   # must raise
+
+
+def _reject_ckpt_tag():
+    from go_libp2p_pubsub_tpu.parallel.checkpoint import (
+        CheckpointConfig)
+    CheckpointConfig(directory="/tmp/x", tag="no spaces!")  # must raise
+
+
+def _reject_ckpt_fingerprint():
+    """The fingerprint field's contract is the RESUME-side reject: a
+    snapshot written under fingerprint A must be refused by name when
+    read expecting B (never silently re-run under the wrong config)."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
+    d = tempfile.mkdtemp(prefix="graftlint_ckpt_")
+    path = os.path.join(d, "probe-seg000000.ckpt")
+    ck.snapshot_save(path, {"fingerprint": 1, "tick": 0},
+                     {"state/x": np.zeros(3, np.int32)})
+    try:
+        ck.snapshot_read(path, expect_fingerprint=2)   # must raise
+    finally:
+        os.unlink(path)
+        os.rmdir(d)
+
+
 _BUILD_TIME = {
     ("GossipSimConfig", "max_ihave_length"):
         (_reject_max_ihave_length, r"exceeds max_ihave_length"),
@@ -1080,6 +1125,21 @@ _BUILD_TIME = {
         (_reject_fault_n_peers, r"n_peers"),
     ("FaultSchedule", "horizon"):
         (_reject_fault_horizon, r"horizon must be >= 1"),
+    # round 15: the checkpoint config is host-side orchestration
+    # end to end — every field build-time, with ``every`` pinned as
+    # the static (never traced) segment-length knob and the
+    # fingerprint's resume-mismatch reject probed by name
+    ("CheckpointConfig", "directory"):
+        (_reject_ckpt_directory, r"directory must be a non-empty path"),
+    ("CheckpointConfig", "every"):
+        (_reject_ckpt_every, r"every=-1 must be >= 0"),
+    ("CheckpointConfig", "keep"):
+        (_reject_ckpt_keep, r"keep=0 must be >= 1"),
+    ("CheckpointConfig", "tag"):
+        (_reject_ckpt_tag, r"tag='no spaces!' must match"),
+    ("CheckpointConfig", "fingerprint"):
+        (_reject_ckpt_fingerprint,
+         r"snapshot config fingerprint .* refusing to resume"),
 }
 
 
@@ -1096,8 +1156,11 @@ def _contracted_classes():
     from go_libp2p_pubsub_tpu.models.invariants import InvariantConfig
     from go_libp2p_pubsub_tpu.models.knobs import SimKnobs
     from go_libp2p_pubsub_tpu.models.telemetry import TelemetryConfig
+    from go_libp2p_pubsub_tpu.parallel.checkpoint import (
+        CheckpointConfig)
     return (GossipSimConfig, ScoreSimConfig, TelemetryConfig,
-            FaultSchedule, InvariantConfig, SimKnobs, DelayConfig)
+            FaultSchedule, InvariantConfig, SimKnobs, DelayConfig,
+            CheckpointConfig)
 
 
 def _threaded_prover(cls_name, field, path, status):

@@ -177,6 +177,18 @@ def declared_matrix() -> list[dict]:
     out.append(dict(sim="gossipsub", split=False, telemetry=False,
                     faults=True, batched=False,
                     variant="sharded-kernel-delays"))
+    # round-15 segmented checkpoint cases: a checkpointed run is the
+    # SAME jitted runner dispatched once per segment
+    # (parallel/checkpoint.segment_dispatch), so every compile-time
+    # invariant must hold at the SPLIT horizon too — donation
+    # preserved across the segment boundary, no 64-bit avals, and no
+    # host callback smuggled into a segment by the snapshot machinery
+    # (snapshots are strictly between-dispatch host I/O)
+    for batched in (False, True):
+        out.append(dict(sim="gossipsub", split=False, telemetry=False,
+                        faults=True, batched=batched, variant="ckpt"))
+    out.append(dict(sim="floodsub", split=False, telemetry=False,
+                    faults=True, batched=False, variant="ckpt"))
     return out
 
 
@@ -537,6 +549,52 @@ def build_cases() -> list[AuditCase]:
             runner = tl.telemetry_run if combo["telemetry"] \
                 else gs.gossip_run
             args, statics = (params, state, TICKS, step), (2, 3)
+
+        elif variant == "ckpt":
+            # round-15 segmented checkpoint runners: trace the engine's
+            # dispatch table at the 2-segment split horizon with the
+            # full composition live (faults + delays; the batched case
+            # is the knob-batch segment).  The snapshot I/O itself is
+            # host-side between dispatches — nothing of it may appear
+            # in the traced segment.
+            from go_libp2p_pubsub_tpu.models.delays import DelayConfig
+            from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
+            dispatch = ck.segment_dispatch()
+            seg = max(1, TICKS // 2)
+            subs, topic, origin, ticks = _sim_inputs(T)
+            if sim == "gossipsub":
+                cfg = gs.GossipSimConfig(
+                    offsets=gs.make_gossip_offsets(T, C, N, seed=1),
+                    n_topics=T, d=3, d_lo=2, d_hi=6, d_score=2,
+                    d_out=1, d_lazy=2, backoff_ticks=8)
+                sc = gs.ScoreSimConfig()
+                dc = DelayConfig(base=2, jitter=1, k_slots=4)
+                step = gs.make_gossip_step(cfg, sc)
+
+                def build_ck(r):
+                    return gs.make_gossip_sim(
+                        cfg, subs, topic, origin, ticks, seed=r,
+                        score_cfg=sc, delays=dc,
+                        fault_schedule=audit_fault_schedule(r))
+
+                if b:
+                    builds = [build_ck(r) for r in range(BATCH)]
+                    params = gs.stack_trees([p for p, _ in builds])
+                    state = gs.stack_trees([s for _, s in builds])
+                    runner = dispatch["gossipsub-batch"]
+                else:
+                    params, state = build_ck(0)
+                    runner = dispatch["gossipsub"]
+                args, statics = (params, state, seg, step), (2, 3)
+            else:   # floodsub
+                offs = tuple(int(o) for o in
+                             make_circulant_offsets(T, C, N, seed=1))
+                params, state = fs.make_flood_sim(
+                    None, None, subs, None, topic, origin, ticks,
+                    fault_schedule=fsched, fault_offsets=offs)
+                step_fn = fs.make_circulant_flood_step(offs)
+                runner = dispatch["floodsub"]
+                args, statics = (params, state, seg, step_fn), (2, 3)
 
         elif variant == "hist":
             # all three histogram groups live (score_hist needs a

@@ -22,6 +22,8 @@ import numpy as np
 
 sys.path.insert(0, ".")  # graftlint: ignore[sys-path-insert]
 
+from go_libp2p_pubsub_tpu.utils.artifacts import write_json_atomic  # noqa: E402
+
 
 def _cmp(out_x, out_k, n, fields_out):
     import go_libp2p_pubsub_tpu.models.gossipsub as gs  # noqa: F401
@@ -164,8 +166,7 @@ def main():
     ok_all &= ok
 
     report["ok"] = bool(ok_all)
-    with open(out_path, "w") as f:
-        json.dump(report, f, indent=1)
+    write_json_atomic(out_path, report)
     bad = [c["field"] for ch in report["checks"]
            for c in ch["fields"] if not c["identical"]]
     print(json.dumps({"kernel_identity_ok": report["ok"],

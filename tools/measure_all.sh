@@ -7,12 +7,32 @@
 # aborts halfway should still leave the kernel-identity artifact and
 # the flagship bench number behind.  The log is copied into the repo
 # after every step for the same reason.
+#
+# Round 15: the pass is RESUMABLE.  Every completed bench step is
+# journaled (keyed on the git HEAD it ran under); when the watcher
+# re-arms after an abort it reruns this script, which skips the
+# already-completed steps instead of restarting from step 0 — and the
+# segmented checkpoint bench additionally resumes mid-run from its own
+# snapshots (parallel/checkpoint.py).  The cheap CPU gates re-run on
+# every resume (their /tmp artifacts survive the completed steps).
+# The journal lives in /tmp on purpose: a reboot clears it together
+# with the artifacts it vouches for.
 set -u
 cd /root/repo
 log=/tmp/measure_all.log
 : > "$log"
 sync_log() { cp "$log" /root/repo/MEASURE_RECOVERY.log; }
 trap sync_log EXIT
+journal=/tmp/measure_all.steps
+head_sha=$(git rev-parse HEAD 2>/dev/null || echo none)
+if [ -f "$journal" ] && [ "$(head -n1 "$journal" 2>/dev/null)" = "$head_sha" ]; then
+  echo "=== resuming measure chain: $(grep -c '^done ' "$journal") step(s)" \
+       "already completed under $head_sha ===" | tee -a "$log"
+else
+  printf '%s\n' "$head_sha" > "$journal"
+fi
+step_done() { echo "done $1" >> "$journal"; }
+step_skip() { grep -qx "done $1" "$journal"; }
 port_open() {
   (exec 3<>/dev/tcp/127.0.0.1/"${AXON_PROBE_PORT:-8082}") 2>/dev/null \
     && exec 3>&- 3<&-
@@ -23,7 +43,7 @@ port_open() {
 # failure, wait for the relay to come back with CAPPED EXPONENTIAL
 # BACKOFF (30s doubling to a 480s cap, ~25 min total), logging each
 # retry; only when the budget is exhausted abort the pass (the watcher
-# re-arms and reruns it from the top on a later recovery).
+# re-arms and reruns it — resuming from the journal, not from step 0).
 wait_for_relay() {
   local delay=30 attempt=0
   while [ "$attempt" -lt 7 ]; do
@@ -46,8 +66,18 @@ wait_for_relay() {
   done
   return 1
 }
+# run <step-id> <timeout> cmd...: journaled TPU step.  KILL_GRACE (the
+# ``timeout -k`` window, default 30s) is sized per step so a SIGTERMed
+# client can finish its in-flight segment and flush its snapshot —
+# SIGKILLing a mid-operation TPU client is exactly the op-note #2
+# tunnel-wedge failure mode.
 run() {
-  local t="$1"; shift
+  local id="$1" t="$2"; shift 2
+  if step_skip "$id"; then
+    echo "=== skip $id ($*) — completed earlier this pass ===" \
+      | tee -a "$log"
+    return 0
+  fi
   # MEASURE_DEADLINE (epoch secs): stop starting new TPU steps near the
   # driver's own end-of-round bench window — two concurrent TPU clients
   # wedge the tunnel (PERF_NOTES operational notes)
@@ -58,7 +88,7 @@ run() {
     exit 3
   fi
   echo "=== $* ===" | tee -a "$log"
-  timeout -k 30 "$t" "$@" 2>&1 | grep -v WARNING | tee -a "$log"
+  timeout -k "${KILL_GRACE:-30}" "$t" "$@" 2>&1 | grep -v WARNING | tee -a "$log"
   local rc=${PIPESTATUS[0]}
   echo "--- rc=$rc ---" | tee -a "$log"
   sync_log
@@ -72,13 +102,14 @@ run() {
     # the relay died DURING the step above, so its artifact may be
     # truncated: re-run that one step once on the recovered relay
     echo "=== retrying after relay recovery: $* ===" | tee -a "$log"
-    timeout -k 30 "$t" "$@" 2>&1 | grep -v WARNING | tee -a "$log"
-    echo "--- retry rc=${PIPESTATUS[0]} ---" | tee -a "$log"
+    timeout -k "${KILL_GRACE:-30}" "$t" "$@" 2>&1 | grep -v WARNING | tee -a "$log"
+    rc=${PIPESTATUS[0]}
+    echo "--- retry rc=$rc ---" | tee -a "$log"
     sync_log
     # flapping relay: if it died AGAIN during the retry, abort the
     # pass now rather than letting the next step burn its full
     # timeout against a dead backend (the watcher re-arms with its
-    # own backoff and reruns the pass from the top)
+    # own backoff and reruns the pass — journal intact)
     if ! port_open; then
       echo "!! relay died again during the retry — aborting pass" \
         | tee -a "$log"
@@ -86,6 +117,8 @@ run() {
       exit 2
     fi
   fi
+  [ "$rc" -eq 0 ] && step_done "$id"
+  return 0
 }
 # 0. lint preflight (CPU-only, seconds): a measurement pass burning
 # chip-hours from a tree that doesn't even lint is a wasted window —
@@ -113,30 +146,30 @@ if [ "${PIPESTATUS[0]}" -ne 0 ]; then
   exit 4
 fi
 # 1. hardware kernel-identity artifact (small run, judge deliverable)
-run 1800 python tools/kernel_identity.py 200000 KERNEL_IDENTITY_r05.json
+run s1 1800 python tools/kernel_identity.py 200000 KERNEL_IDENTITY_r05.json
 # 2. the flagship driver metric — forced-XLA so the pass ALWAYS
 # produces a plain flagship row for pick_bench_path to compare against
 # (a committed kernel pin would otherwise make bench.py emit only the
 # _kernel row and the picker would clear a still-valid pin)
-run 1800 env GOSSIP_BENCH_KERNEL=0 python bench.py
+run s2 1800 env GOSSIP_BENCH_KERNEL=0 python bench.py
 # 3. XLA vs kernel timing at 1M (decides the default path)
-run 2700 python tools/bench_kernel.py 1000000 xla kernel kernela
-run 2700 python tools/bench_kernel.py 1000000 kernela --noroll
+run s3a 2700 python tools/bench_kernel.py 1000000 xla kernel kernela
+run s3b 2700 python tools/bench_kernel.py 1000000 kernela --noroll
 # 4. the bench-suite rows, both paths
-run 2700 python bench_suite.py gossipsub_v10 gossipsub_v11_multitopic \
+run s4 2700 python bench_suite.py gossipsub_v10 gossipsub_v11_multitopic \
     gossipsub_v11_adversarial gossipsub_v11_everything
-run 2700 env GOSSIP_BENCH_KERNEL=1 python bench_suite.py gossipsub_v11 \
+run s4k 2700 env GOSSIP_BENCH_KERNEL=1 python bench_suite.py gossipsub_v11 \
     gossipsub_v11_adversarial gossipsub_v11_multitopic \
     gossipsub_v11_everything
 # 4b. faulted + observed runs on the kernel path (round 9): the
 # kernel-path fault-mask and telemetry overheads, measured on mosaic
-run 2700 python bench_suite.py gossipsub_v11_churn_kernel \
+run s4b 2700 python bench_suite.py gossipsub_v11_churn_kernel \
     gossipsub_telemetry_kernel
 # 4c. trace pipeline (round 10): 13-type export throughput on both
 # paths, then the tracestat regression gate over the artifacts the
 # bench just wrote (coverage must stay 13/13 and device-histogram p99
 # within 1 tick of the committed OBS_r10.json baseline)
-run 2700 python bench_suite.py gossipsub_trace_export \
+run s4c 2700 python bench_suite.py gossipsub_trace_export \
     gossipsub_trace_export_kernel
 echo "=== tracestat --check gate ===" | tee -a "$log"
 env JAX_PLATFORMS=cpu python tools/tracestat.py \
@@ -155,7 +188,7 @@ fi
 # under reference score params must stay within slack of the
 # committed TOURNEY_r11.json; any runtime invariant violation fails),
 # plus the invariant-checker overhead rows on both execution paths
-run 2700 python bench_suite.py gossipsub_tournament \
+run s4d 2700 python bench_suite.py gossipsub_tournament \
     gossipsub_invariants gossipsub_invariants_kernel
 echo "=== tourneystat --check gate ===" | tee -a "$log"
 env JAX_PLATFORMS=cpu python tools/tourneystat.py \
@@ -179,7 +212,7 @@ fi
 # plus the kernel-path sequential twin, then the sweepstat gate over
 # the artifact the bench just wrote (configs-per-compile and
 # throughput vs the committed SWEEP_r12.json)
-run 2700 python bench_suite.py gossipsub_sweepd gossipsub_sweepd_kernel
+run s4e 2700 python bench_suite.py gossipsub_sweepd gossipsub_sweepd_kernel
 echo "=== sweepstat --check gate ===" | tee -a "$log"
 env JAX_PLATFORMS=cpu python tools/sweepstat.py \
     /tmp/gossipsub_sweepd.json \
@@ -203,7 +236,7 @@ fi
 # percentile curves — then the delaystat gate over the artifact the
 # bench just wrote (p99 within slack of the committed DELAY_r13.json,
 # delivery fraction holding, zero recompiles across delay points)
-run 2700 python bench_suite.py gossipsub_pipelined
+run s4f 2700 python bench_suite.py gossipsub_pipelined
 echo "=== delaystat --check gate ===" | tee -a "$log"
 env JAX_PLATFORMS=cpu python tools/delaystat.py \
     /tmp/gossipsub_pipelined.json \
@@ -228,7 +261,7 @@ fi
 # gate over the artifact the bench just wrote (bit-identity, compile
 # counts, collective presence, and throughput vs the committed
 # MULTICHIP_r14.json)
-run 3600 python bench_suite.py gossipsub_multichip
+run s4g 3600 python bench_suite.py gossipsub_multichip
 echo "=== shardstat --check gate ===" | tee -a "$log"
 env JAX_PLATFORMS=cpu python tools/shardstat.py \
     /tmp/gossipsub_multichip.json \
@@ -246,8 +279,36 @@ elif [ "$shrc" -ne 0 ]; then
   sync_log
   exit 9
 fi
+# 4h. preemption-tolerant execution (round 15): the segmented-scan
+# checkpoint rows — segmented(S in {2,4}) digests BIT-IDENTICAL to the
+# single scan, the kill-resume row (deferred SIGTERM -> snapshot ->
+# resume), and the sharded D=4 save -> D=8 resume row — then the
+# ckptstat gate over the artifact the bench just wrote (resume
+# bit-identity, recompile-per-segment, segment overhead vs the
+# committed CKPT_r15.json).  KILL_GRACE=120: a SIGTERMed bench gets
+# two minutes to finish the in-flight 1M segment and flush its
+# snapshot before timeout escalates to SIGKILL.
+KILL_GRACE=120 run s4h 2700 python bench_suite.py gossipsub_checkpoint
+echo "=== ckptstat --check gate ===" | tee -a "$log"
+env JAX_PLATFORMS=cpu python tools/ckptstat.py \
+    /tmp/gossipsub_checkpoint.json \
+    --check CKPT_r15.json 2>&1 | tee -a "$log"
+ckrc=${PIPESTATUS[0]}
+if [ "$ckrc" -eq 2 ]; then
+  echo "!! ckptstat gate failed — unusable checkpoint artifact" \
+      "(bench crashed or wrote a truncated file?)" | tee -a "$log"
+  sync_log
+  exit 10
+elif [ "$ckrc" -ne 0 ]; then
+  echo "!! ckptstat gate failed — resume bit-identity broke, a" \
+      "segment recompiled, or snapshot overhead passed slack" \
+      | tee -a "$log"
+  sync_log
+  exit 10
+fi
 # 5. GSPMD overhead + diagnostics
-run 1800 python tools/bench_sharded.py
-run 1800 python tools/bench_micro.py 1000000 100
-run 1800 python tools/profile_trace.py 1000000 xla
+run s5a 1800 python tools/bench_sharded.py
+run s5b 1800 python tools/bench_micro.py 1000000 100
+run s5c 1800 python tools/profile_trace.py 1000000 xla
+rm -f "$journal"
 echo DONE | tee -a "$log"

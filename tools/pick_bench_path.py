@@ -25,6 +25,13 @@ import os
 import re
 import sys
 
+# the tool runs from arbitrary cwds (tpu_watch, tests) — anchor the
+# repo root on the script location, not the working directory
+sys.path.insert(0, os.path.dirname(os.path.dirname(  # graftlint: ignore[sys-path-insert]
+    os.path.abspath(__file__))))
+
+from go_libp2p_pubsub_tpu.utils.artifacts import write_json_atomic  # noqa: E402
+
 # 7+ digit peer counts only: the 1M-scale TPU rows (1000000 plain /
 # 1024000 kernel-padded).  The CPU-fallback row (100000 peers) is a
 # 10x-smaller problem and must not enter the comparison.
@@ -66,11 +73,10 @@ def main():
         return
     # require a real margin: path choice should not flap on noise
     if best_k > 1.02 * best_x:
-        with open(cfg, "w") as f:
-            json.dump({"kernel": True,
-                       "measured_xla_hbs": best_x,
-                       "measured_kernel_hbs": best_k}, f)
-            f.write("\n")
+        write_json_atomic(cfg, {"kernel": True,
+                                "measured_xla_hbs": best_x,
+                                "measured_kernel_hbs": best_k},
+                          indent=None)
         print("pick_bench_path: kernel path pinned")
     elif os.path.exists(cfg):
         # a COMPLETED comparison the kernel lost: the pin is genuinely

@@ -182,6 +182,10 @@ class SweepServer:
         self.errors = 0
         self.wall_s = 0.0
         self._pending: list[dict] = []
+        #: raw journal lines parallel to _pending (round 15: the
+        #: accepted-but-undispatched scenarios a crash must not lose)
+        self._pending_raw: list[str] = []
+        self._journal: str | None = None
         self._t0 = time.perf_counter()
         # the runner's jit cache is process-global (other shapes /
         # servers share it): THIS server's compile count is the
@@ -412,12 +416,47 @@ class SweepServer:
 
     # -- line protocol -------------------------------------------------
 
-    def serve_lines(self, lines, out) -> None:
+    def _journal_append(self, raw: str) -> None:
+        if self._journal is None:
+            return
+        import os
+        with open(self._journal, "a") as f:
+            f.write(raw + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def _journal_compact(self) -> None:
+        """Rewrite the journal to exactly the still-undispatched lines
+        (atomically: a crash mid-compaction must not lose scenarios)."""
+        if self._journal is None:
+            return
+        from go_libp2p_pubsub_tpu.utils.artifacts import (
+            write_text_atomic)
+        write_text_atomic(self._journal,
+                          "".join(r + "\n" for r in self._pending_raw))
+
+    def serve_lines(self, lines, out, *, journal=None) -> None:
         """Drive the server from an iterable of JSON lines, writing
         result rows to ``out`` (a writable file object).  Requests
         accumulate to full batches; ``{"cmd": "flush"}`` dispatches a
         partial batch, ``{"cmd": "stats"}`` emits counters.  EOF
-        flushes."""
+        flushes.
+
+        Round 15 crash-hardening: with ``journal=PATH`` every accepted
+        scenario line is appended (fsync'd) to PATH before it can be
+        batched, and the journal is compacted back to the
+        still-undispatched lines after every dispatch — so a killed
+        server loses NO accepted scenario.  Lines already in PATH at
+        entry are replayed first (the restart path).  A pending
+        kill-flag (parallel/checkpoint.request_stop, set by the
+        deferred SIGTERM/SIGINT handlers) drains the server at the
+        next line boundary: the in-flight bucket batch is dispatched,
+        its rows and the final stats row are emitted, and serve_lines
+        returns instead of reading further."""
+        from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
+
+        self._journal = journal
+
         def emit(obj):
             out.write(json.dumps(obj) + "\n")
             out.flush()
@@ -426,25 +465,29 @@ class SweepServer:
             if self._pending:
                 reqs = list(self._pending)
                 self._pending.clear()
-                for row in self.submit(reqs):
+                self._pending_raw.clear()
+                rows = self.submit(reqs)
+                # compact only once the dispatch COMPLETED: a crash
+                # mid-submit leaves the lines journaled, and replaying
+                # a dispatched (deterministic) scenario only burns
+                # device time — losing an accepted one loses data
+                self._journal_compact()
+                for row in rows:
                     emit(row)
 
-        for line in lines:
-            line = line.strip()
-            if not line:
-                continue
+        def handle(raw: str, *, journal_new: bool) -> None:
             try:
-                req = json.loads(line)
+                req = json.loads(raw)
             except json.JSONDecodeError as e:
                 self.errors += 1
                 emit({"ok": False, "error": f"bad JSON: {e}"})
-                continue
+                return
             if not isinstance(req, dict):
                 self.errors += 1
                 emit({"ok": False,
                       "error": "request must be a JSON object, got "
                                f"{type(req).__name__}"})
-                continue
+                return
             cmd = req.get("cmd")
             if cmd == "flush":
                 flush()
@@ -457,8 +500,38 @@ class SweepServer:
                       "error": f"unknown cmd {cmd!r} (flush/stats)"})
             else:
                 self._pending.append(req)
+                self._pending_raw.append(raw)
+                if journal_new:
+                    self._journal_append(raw)
                 if len(self._pending) >= self.batch:
                     flush()
+
+        if journal is not None:
+            try:
+                with open(journal) as f:
+                    replay = [ln.strip() for ln in f if ln.strip()]
+            except FileNotFoundError:
+                replay = []
+            if replay:
+                print(f"sweepd: replaying {len(replay)} journaled "
+                      "scenario line(s) from an interrupted run",
+                      file=sys.stderr, flush=True)
+                for raw in replay:
+                    # already on disk: re-append would duplicate them
+                    handle(raw, journal_new=False)
+                # re-sync: a flush during the replay compacted away
+                # lines accepted after it, so rewrite the journal to
+                # exactly the surviving partial batch
+                self._journal_compact()
+
+        for line in lines:
+            line = line.strip()
+            if line:
+                handle(line, journal_new=True)
+            if ck.stop_requested():
+                print("sweepd: stop requested — draining the pending "
+                      "batch and exiting", file=sys.stderr, flush=True)
+                break
         flush()
         emit(self.stats())
 
@@ -510,32 +583,69 @@ def main(argv=None) -> int:
                          "only; peers must divide evenly)")
     ap.add_argument("--socket", metavar="PATH",
                     help="serve a Unix socket instead of stdin")
+    ap.add_argument("--journal", metavar="PATH",
+                    help="fsync'd journal of accepted-but-"
+                         "undispatched scenario lines; lines left in "
+                         "PATH by a killed server are replayed on "
+                         "restart (round 15)")
     ns = ap.parse_args(argv)
+
+    # round 15: deferred SIGTERM/SIGINT (parallel/checkpoint.py) —
+    # the handler only sets a flag; the serve loops drain the pending
+    # batch, emit its rows, and exit cleanly instead of dying with a
+    # half-dispatched batch or a stale socket file
+    from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
+    prev = ck.install_kill_handlers()
 
     srv = SweepServer(n=ns.peers, t=ns.topics, m=ns.msgs,
                       ticks=ns.ticks,
                       batch=(1 if ns.kernel else ns.batch),
                       seed=ns.seed, invariants=not ns.no_invariants,
                       kernel=ns.kernel, devices=ns.devices)
-    if ns.socket:
-        import socket as sk
-        import os
-        try:
+    try:
+        if ns.socket:
+            import socket as sk
+            import os
+            try:
+                os.unlink(ns.socket)
+            except FileNotFoundError:
+                pass
+            with sk.socket(sk.AF_UNIX, sk.SOCK_STREAM) as server_sock:
+                server_sock.bind(ns.socket)
+                server_sock.listen(1)
+                # 1s accept timeout: the drain flag is polled between
+                # accepts, so a SIGTERM with no client connected still
+                # exits promptly
+                server_sock.settimeout(1.0)
+                print(f"sweepd: listening on {ns.socket}",
+                      file=sys.stderr, flush=True)
+                while not ck.stop_requested():
+                    try:
+                        conn, _ = server_sock.accept()
+                    except TimeoutError:
+                        continue
+                    try:
+                        with conn, conn.makefile("r") as rf, \
+                                conn.makefile("w") as wf:
+                            srv.serve_lines(rf, wf,
+                                            journal=ns.journal)
+                    except (BrokenPipeError, ConnectionResetError) \
+                            as e:
+                        # a client vanishing mid-conversation must
+                        # never kill the resident server: its accepted
+                        # lines are journaled, the next client (or the
+                        # restart replay) picks them up
+                        print(f"sweepd: client disconnected "
+                              f"({e.__class__.__name__}) — server "
+                              "stays up", file=sys.stderr, flush=True)
             os.unlink(ns.socket)
-        except FileNotFoundError:
-            pass
-        with sk.socket(sk.AF_UNIX, sk.SOCK_STREAM) as server_sock:
-            server_sock.bind(ns.socket)
-            server_sock.listen(1)
-            print(f"sweepd: listening on {ns.socket}",
+            print("sweepd: drained — socket removed, exiting",
                   file=sys.stderr, flush=True)
-            while True:
-                conn, _ = server_sock.accept()
-                with conn, conn.makefile("r") as rf, \
-                        conn.makefile("w") as wf:
-                    srv.serve_lines(rf, wf)
-    else:
-        srv.serve_lines(sys.stdin, sys.stdout)
+        else:
+            srv.serve_lines(sys.stdin, sys.stdout,
+                            journal=ns.journal)
+    finally:
+        ck._restore_handlers(prev)
     return 0
 
 

@@ -99,7 +99,11 @@ while true; do
           >>"$log" 2>&1 || true
       fi
       # pass aborted on a relay death: keep watching — a later
-      # recovery reruns the whole pass (artifact writes are idempotent).
+      # recovery reruns measure_all, which RESUMES from its step
+      # journal (/tmp/measure_all.steps, keyed on git HEAD): completed
+      # bench steps are skipped, and the segmented checkpoint bench
+      # additionally resumes mid-run from its own snapshots, so an
+      # abort costs the in-flight step, never the pass so far.
       # Back off exponentially (capped) so a flapping relay is not
       # hammered with full measurement passes; each retry is logged.
       [ "$mrc" -eq 0 ] && exit 0

@@ -58,6 +58,8 @@ import time
 import numpy as np
 
 sys.path.insert(0, ".")  # graftlint: ignore[sys-path-insert]
+
+from go_libp2p_pubsub_tpu.utils.artifacts import write_json_atomic  # noqa: E402
 #   (script-style tool, documented to run from the repo root)
 
 # cap on replicas per shared-topology chunk: keeps >= 2 distinct
@@ -252,8 +254,7 @@ def _degradation_sweep(chunks, n, M, HOPS, sequential, out_path,
         "levels": levels,
         "sweep_seconds": round(dt, 3),
     }
-    with open(out_path, "w") as f:
-        json.dump(report, f, indent=1)
+    write_json_atomic(out_path, report)
     print(json.dumps({
         "degradation_levels": list(levels),
         "final_fractions": [levels[k]["final_delivered_fraction"]
@@ -341,8 +342,7 @@ def _telemetry_sweep(chunks, n, M, sequential, out_path, mode="?"):
         "control_overhead_ratio": round(bc / bp, 4) if bp else 0.0,
         "sweep_seconds": round(dt, 3),
     }
-    with open(out_path, "w") as f:
-        json.dump(report, f, indent=1)
+    write_json_atomic(out_path, report)
     print(json.dumps({
         "telemetry_runs": report["config"]["runs"],
         "control_overhead_ratio": report["control_overhead_ratio"],
@@ -509,8 +509,7 @@ def main():
         summary = {"curves_max_abs_delta": report["max_abs_delta"],
                    "curves_mean_abs_delta": report["mean_abs_delta"],
                    "runs": len(sim_curves)}
-    with open(out_path, "w") as f:
-        json.dump(report, f, indent=1)
+    write_json_atomic(out_path, report)
     print(json.dumps(summary))
 
 

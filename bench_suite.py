@@ -70,6 +70,14 @@ suite is the full matrix for tracking all baseline configs.)
                    /tmp artifact for the shardstat gate (measure_all
                    step 4g), ``hardware_queued``-tagged when run on
                    the CPU virtual mesh
+  gossipsub_resident
+                   round 16: the tick-resident megakernel
+                   (make_fused_window) — T=8 ticks per pallas
+                   dispatch with the carry resident in VMEM, digest
+                   bit-identical to the per-tick kernel, ONE compile,
+                   plus the analytic per-tick HBM ledger (100k/1M
+                   points, VMEM-budget verdicts); /tmp artifact for
+                   the residentstat gate (measure_all step 4i)
 
 Usage: python bench_suite.py [config ...]   (default: all)
 """
@@ -1616,6 +1624,151 @@ def bench_gossipsub_checkpoint():
                 "rows": len(rows)})
 
 
+def bench_gossipsub_resident():
+    """Round 16: the tick-resident gossip megakernel
+    (make_fused_window / gossip_run_fused).  One pallas dispatch per
+    T=8-tick window with the whole per-shard carry resident in VMEM
+    across grid steps, vs the per-tick kernel staging the carry
+    through HBM every tick.  Three contracts, one artifact
+    (/tmp/gossipsub_resident.json for the ``residentstat --check``
+    gate, measure_all step 4i):
+
+    * BIT-IDENTITY: the fused trajectory's final-state digest must
+      equal the per-tick kernel's (residency is a scheduling change,
+      never an arithmetic one);
+    * ONE COMPILE: the whole fused run is one executable
+      (compile-counter asserted) — windows re-dispatch, never
+      re-trace;
+    * the BYTE LEDGER: analytic per-tick HBM bytes
+      (ops/pallas/receive.fused_working_set_bytes — the pallas body
+      is opaque to XLA's bytes-accessed counter) for the bench shape
+      plus the 100k/1M ledger points, with the VMEM working set and
+      the budget verdict per point (1M refuses: the carry is past the
+      96MB budget — the refusal is part of the record).
+
+    Mosaic on TPU; CPU hosts run both paths in interpret mode, where
+    the digest/compile/ledger rows are the measurement and wall-clock
+    is indicative only.  Shape env-tunable via GOSSIP_RESIDENT_N
+    (must be a multiple of lcm(block, 1024))."""
+    import hashlib
+
+    import jax
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    from go_libp2p_pubsub_tpu.ops.pallas.receive import (
+        FUSED_ALIGN, fused_working_set_bytes)
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    block = int(os.environ.get("GOSSIP_BENCH_BLOCK", "8192"))
+    n = int(os.environ.get("GOSSIP_RESIDENT_N",
+                           1_048_576 if on_accel else 131_072))
+    assert n % block == 0 and n % FUSED_ALIGN == 0, (n, block)
+    t, m, C = 10, 24, 16
+    Tw = 8          # fused window length; >= 5x needs the T=8 window
+    ticks = Tw * 2
+
+    rng = np.random.default_rng(0)
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, C, n, seed=7), n_topics=t)
+    subs = _subs_matrix(n, t)
+    topic, origin, pub = _msgs(rng, n, t, m, ticks // 2)
+    params, state = gs.make_gossip_sim(cfg, subs, topic, origin, pub,
+                                       seed=3, pad_to_block=block)
+    params = jax.device_put(params)
+
+    def digest(s):
+        h = hashlib.sha256()
+        for leaf in (s.have, s.recent, s.mesh, s.fanout, s.last_pub,
+                     s.backoff, s.tick):
+            h.update(np.asarray(leaf).tobytes())
+        return h.hexdigest()[:16]
+
+    # per-tick kernel reference: same padded layout, same block plan
+    step = gs.make_gossip_step(cfg, None, receive_block=block,
+                               receive_interpret=not on_accel)
+    out = gs.gossip_run(params, gs.tree_copy(state), ticks, step)
+    jax.block_until_ready(out.have)
+    t0 = time.perf_counter()
+    out = gs.gossip_run(params, gs.tree_copy(state), ticks, step)
+    jax.block_until_ready(out.have)
+    wall_unfused = time.perf_counter() - t0
+    ref = digest(out)
+    rows = [{"id": "unfused_kernel", "n": n, "ticks": ticks,
+             "wall_s": round(wall_unfused, 3),
+             "heartbeats_per_sec": round(ticks / wall_unfused, 2),
+             "digest": ref, "bit_identical": True}]
+
+    # fused window: T=8 ticks per pallas dispatch, carry resident
+    window = gs.make_fused_window(cfg, None, ticks_fused=Tw,
+                                  receive_block=block,
+                                  receive_interpret=not on_accel,
+                                  on_refusal="raise")
+    reason = window.capability(params, state)
+    assert reason is None, reason
+    cache0 = gs.gossip_run_fused._cache_size()
+    out = gs.gossip_run_fused(params, gs.tree_copy(state), ticks,
+                              window)
+    jax.block_until_ready(out.have)
+    compiles = gs.gossip_run_fused._cache_size() - cache0
+    t0 = time.perf_counter()
+    out = gs.gossip_run_fused(params, gs.tree_copy(state), ticks,
+                              window)
+    jax.block_until_ready(out.have)
+    wall_fused = time.perf_counter() - t0
+    dg = digest(out)
+    rows.append({
+        "id": f"fused_T{Tw}", "n": n, "ticks": ticks,
+        "ticks_fused": Tw, "wall_s": round(wall_fused, 3),
+        "heartbeats_per_sec": round(ticks / wall_fused, 2),
+        "compiles": int(compiles),
+        "digest": dg, "bit_identical": dg == ref,
+    })
+    assert dg == ref, (dg, ref)
+    assert compiles == 1, f"fused run recompiled: {compiles}"
+
+    # analytic HBM/VMEM ledger: the bench shape + the 100k and 1M
+    # points (W=1 at m<=32; hg is the config default)
+    from go_libp2p_pubsub_tpu.models.gossipsub import FUSED_VMEM_BUDGET
+    W = (m + 31) // 32
+    hg = cfg.history_gossip
+    ledger = []
+    for n_l in sorted({102_400, n, 1_048_576}):
+        ws = fused_working_set_bytes(C, W, hg, n_l, ticks=Tw)
+        red = (ws["unfused_hbm_bytes_per_tick"]
+               / max(ws["hbm_bytes_per_tick"], 1.0))
+        ledger.append({
+            "n": n_l, "ticks_fused": Tw,
+            "carry_bytes_per_peer": ws["carry_bytes_per_peer"],
+            "vmem_bytes": int(ws["vmem_bytes"]),
+            "vmem_budget_bytes": int(FUSED_VMEM_BUDGET),
+            "fits": ws["vmem_bytes"] <= FUSED_VMEM_BUDGET,
+            "unfused_hbm_bytes_per_tick":
+                int(ws["unfused_hbm_bytes_per_tick"]),
+            "fused_hbm_bytes_per_tick": int(ws["hbm_bytes_per_tick"]),
+            "hbm_reduction_x": round(red, 2),
+        })
+
+    backend = jax.default_backend()
+    art = {
+        "round": 16,
+        "platform": backend,
+        "hardware_queued": backend != "tpu",
+        "interpret": not on_accel,
+        "shape": {"n": n, "t": t, "m": m, "C": C, "ticks": ticks,
+                  "ticks_fused": Tw, "block": block},
+        "rows": rows,
+        "ledger": ledger,
+    }
+    write_json_atomic("/tmp/gossipsub_resident.json", art)
+    bench_point = next(e for e in ledger if e["n"] == n)
+    emit(f"gossipsub_resident_{n}peers_hbm_reduction_x",
+         bench_point["hbm_reduction_x"], "x per-tick HBM bytes",
+         extra={"ticks_fused": Tw, "compiles": int(compiles),
+                "bit_identical": dg == ref,
+                "fused_hbps": rows[1]["heartbeats_per_sec"],
+                "unfused_hbps": rows[0]["heartbeats_per_sec"],
+                "interpret": not on_accel})
+
+
 BENCHES = {
     "floodsub_hosts": bench_floodsub_hosts,
     "randomsub_10k": bench_randomsub_10k,
@@ -1639,6 +1792,7 @@ BENCHES = {
     "gossipsub_pipelined": bench_gossipsub_pipelined,
     "gossipsub_multichip": bench_gossipsub_multichip,
     "gossipsub_checkpoint": bench_gossipsub_checkpoint,
+    "gossipsub_resident": bench_gossipsub_resident,
 }
 
 

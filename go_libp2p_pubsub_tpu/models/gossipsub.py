@@ -1793,6 +1793,116 @@ def kernel_capability(cfg: GossipSimConfig, sc: ScoreSimConfig | None,
     return None
 
 
+#: VMEM the fused window's resident carry may claim (input pair +
+#: revisited output pair + per-tick stream double-buffers).  Sized
+#: under the v5e 128 MiB/core arena with headroom for Mosaic's own
+#: scratch; the refusal reports the computed working set against it.
+FUSED_VMEM_BUDGET = 96 * 1024 * 1024
+
+
+def kernel_ticks_fused_capability(
+        cfg: GossipSimConfig, sc: ScoreSimConfig | None,
+        params: GossipParams, state: GossipState, ticks: int, *,
+        vmem_budget_bytes: int = FUSED_VMEM_BUDGET,
+        sharded: bool = False) -> str | None:
+    """Capability dispatch for the round-16 tick-resident window:
+    ``None`` when T ticks can fold into one resident pallas_call, else
+    the named refusal ``make_fused_window`` falls back (or raises) by.
+    Every refusal is prefixed ``kernel_ticks_fused:`` and
+    message-matched by graftlint contract probes — keep stable.
+
+    Residency is refused where it is genuinely impossible, and the
+    byte-bound refusals REPORT the bytes: the resident carry must fit
+    the VMEM budget twice over (entry pair + revisited output pair),
+    so scored accumulators, delay lines, and large C·W carries fall
+    back to the per-tick kernel with the working set in the message."""
+    from ..ops.pallas.receive import (
+        FUSED_ALIGN, fused_working_set_bytes)
+
+    if ticks < 1:
+        return ("kernel_ticks_fused: window must be >= 1 tick "
+                f"(got {ticks})")
+    base = kernel_capability(cfg, sc, params, state)
+    if base is not None:
+        return "kernel_ticks_fused: " + base
+    if params.n_true is None:
+        return ("kernel_ticks_fused: needs the padded pallas layout "
+                "(make_gossip_sim(pad_to_block=...))")
+    if sharded:
+        # multi-chip composition: each tick's ring-halo exchange is an
+        # ICI collective — the carry must leave VMEM every tick anyway,
+        # so the sharded dispatch keeps the per-tick kernel and the
+        # window runs as a scan of steps (bit-identical by definition)
+        return ("kernel_ticks_fused: the sharded dispatch keeps the "
+                "per-tick kernel (the ring-halo exchange leaves VMEM "
+                "every tick) — fused windows fall back to the "
+                "scan-of-steps form under shard_map")
+    if sc is not None:
+        extra = 0
+        if state.scores is not None:
+            for leaf in jax.tree_util.tree_leaves(state.scores):
+                extra += int(leaf.size) * leaf.dtype.itemsize
+        return ("kernel_ticks_fused: scored configs stay per-tick — "
+                f"the [C, N] score accumulators add {extra} bytes to "
+                "the resident carry and the gater draw needs the "
+                "start-of-tick score pass; run scored sims on the "
+                "per-tick kernel")
+    if cfg.paired_topics:
+        return ("kernel_ticks_fused: paired-topic overlays stay "
+                "per-tick (the slot-B mesh/backoff carry doubles the "
+                "resident working set)")
+    if params.delays is not None:
+        extra = 0
+        for line in (state.pay_line, state.ctrl_line, state.gsp_line):
+            if line is not None:
+                extra += int(line.size) * line.dtype.itemsize
+        return ("kernel_ticks_fused: delay-armed sims stay per-tick — "
+                f"the K-slot delay lines add {extra} bytes of resident "
+                "carry and the dequeue runs in the XLA prologue "
+                "between kernel ticks")
+    if params.sim_knobs is not None:
+        return ("kernel_ticks_fused: knob-carrying sims stay per-tick "
+                "(the degree-family knobs are consumed in the XLA "
+                "prologue the fused window elides)")
+    if state.active is not None:
+        return ("kernel_ticks_fused: px candidate rotation stays "
+                "per-tick (the rotation re-emits the targets gate in "
+                "the XLA epilogue between kernel ticks)")
+    if params.cand_direct is not None:
+        return ("kernel_ticks_fused: direct-peer overlays stay "
+                "per-tick (direct edges rewrite the ctrl pack in the "
+                "XLA prologue)")
+    n_pad = params.subscribed.shape[0]
+    if params.n_true != n_pad:
+        return ("kernel_ticks_fused: needs n_true == n_pad (the "
+                "resident whole-ring lane rolls wrap at the padded "
+                "length) — pick n divisible by the block so "
+                "pad_to_block adds nothing")
+    if params.n_true % FUSED_ALIGN != 0:
+        return ("kernel_ticks_fused: needs n_true % "
+                f"{FUSED_ALIGN} == 0 (u32 lane-roll tile); got "
+                f"{params.n_true}")
+    W = state.have.shape[0]
+    lat_b = 0
+    ws = fused_working_set_bytes(
+        cfg.n_candidates, W, cfg.history_gossip, params.n_true,
+        ticks=ticks, lat_buckets=lat_b,
+        with_faults=params.faults is not None,
+        cold_restart=(params.faults is not None
+                      and params.faults.cold_restart),
+        with_telemetry=False)
+    if ws["vmem_bytes"] > vmem_budget_bytes:
+        return ("kernel_ticks_fused: resident carry past the VMEM "
+                f"budget — working set {ws['vmem_bytes']} bytes "
+                f"(carry {ws['carry_bytes']} B x 2 resident pairs + "
+                f"static {ws['static_bytes']} B + per-tick buffers) "
+                f"> budget {vmem_budget_bytes} B at "
+                f"n={params.n_true}, C={cfg.n_candidates}, W={W} — "
+                "shard the sim over more chips or run the per-tick "
+                "kernel")
+    return None
+
+
 def make_gossip_step(cfg: GossipSimConfig,
                      score_cfg: ScoreSimConfig | None = None,
                      use_pallas_select: bool | None = None,
@@ -4181,6 +4291,265 @@ def make_gossip_step(cfg: GossipSimConfig,
     return step
 
 
+def make_fused_window(cfg: GossipSimConfig,
+                      score_cfg: ScoreSimConfig | None = None, *,
+                      ticks_fused: int = 8,
+                      receive_block: int = 8192,
+                      receive_interpret: bool = False,
+                      telemetry: _telemetry.TelemetryConfig | None = None,
+                      shard_mesh=None, shard_axis: str = "peers",
+                      vmem_budget_bytes: int = FUSED_VMEM_BUDGET,
+                      on_refusal: str = "fallback"):
+    """Build the round-16 tick-resident window: ``window(params,
+    state)`` advances ``ticks_fused`` ticks in ONE pallas_call with a
+    sequential ``(ticks,)`` grid, the whole per-shard carry resident
+    in VMEM across grid steps (ops/pallas/receive.py
+    make_fused_gossip_update).  Returns ``(state, delivered)`` with
+    ``delivered`` u32 [T, W, N] — row t is tick ``state.tick + t``'s
+    delivered words — or ``(state, delivered, frames)`` with
+    ``telemetry`` (frames stacked [T, ...] like the scanned runners').
+
+    Dispatch is by ``kernel_ticks_fused_capability``: where residency
+    is impossible (scored carry, delays, sharded halo exchange, carry
+    past the VMEM budget — every refusal named and byte-reported) the
+    window runs as a ``lax.scan`` of the ordinary step over the same
+    T ticks, bit-identical by definition; pass ``on_refusal="raise"``
+    to surface the refusal instead.  On the resident path the
+    trajectory is bit-identical to T per-tick steps on BOTH existing
+    paths (pinned by tests/test_fused_kernel.py): the in-kernel tick
+    body transcribes the unscored combined step op for op and the
+    lane-hash draws are seeded per tick exactly as the step seeds
+    them.  Compose with checkpointing by aligning segment boundaries:
+    ``ckpt run`` refuses ``every % ticks_fused != 0`` by name."""
+    sc = score_cfg
+    tel = telemetry
+    T = int(ticks_fused)
+    if T < 1:
+        raise ValueError(f"ticks_fused must be >= 1 (got {T})")
+    step = make_gossip_step(cfg, sc, receive_block=receive_block,
+                            receive_interpret=receive_interpret,
+                            shard_mesh=shard_mesh,
+                            shard_axis=shard_axis, telemetry=tel)
+    step_gates_fp = gates_fingerprint(cfg, sc)
+    C = cfg.n_candidates
+    offsets = tuple(int(o) for o in cfg.offsets)
+    cinv = cfg.cinv
+    hg = cfg.history_gossip
+    ALL = jnp.uint32((1 << C) - 1)
+    Z = jnp.uint32(0)
+
+    def fallback_window(params, state):
+        def body(s, _):
+            out = step(params, s)
+            return out[0], out[1:]
+        state, ys = jax.lax.scan(body, state, None, length=T)
+        return (state,) + tuple(ys)
+
+    def fused_window(params, state):
+        from ..ops.pallas.receive import (
+            TEL_PAYLOAD, TEL_IHAVE_IDS, TEL_IWANT_SERVED, TEL_RECV,
+            TEL_IWANT_REQ, TEL_IHAVE_RPCS, TEL_IWANT_RPCS,
+            TEL_NEW_IDS, TEL_ROWS, make_fused_gossip_update)
+
+        n = params.subscribed.shape[0]
+        n_true = params.n_true
+        W = state.have.shape[0]
+        tick0 = state.tick
+        salt = jax.random.key_data(state.key)[-1]
+        if state.gates is not None and len(state.gates) != 2:
+            raise ValueError(
+                f"state carries {len(state.gates)} gate words but "
+                "this step's config expects 2 — the state was built "
+                "for a different score config; rebuild it or "
+                "refresh_gates with the matching config")
+        if (state.gates_fp is not None
+                and state.gates_fp != step_gates_fp):
+            raise ValueError(
+                "state's carried gates were emitted under a different "
+                "(cfg, score_cfg) than this step's — refresh_gates "
+                "with the new config before stepping")
+        sub_all = jnp.where(params.subscribed, ALL, Z)
+        tick_l = [tick0 + t for t in range(T)]
+        seeds = jnp.stack([
+            jnp.stack([lane_seed(tk, 4, salt), lane_seed(tk, 2, salt),
+                       lane_seed(tk, 3, salt),
+                       lane_seed(tk + 1, 1, salt)])
+            for tk in tick_l])
+        due = jnp.stack([pack_bits(params.publish_tick == tk)
+                         for tk in tick_l])
+        fp = params.faults
+        with_f = fp is not None
+        cold = with_f and fp.cold_restart
+        lat_b = (tel.latency_buckets
+                 if tel is not None and tel.latency_hist else 0)
+        with_t = (tel is not None
+                  and (tel.counters or lat_b > 0 or tel.mesh
+                       or tel.degree_hist))
+        alive_rows = sok_rows = cal_rows = rej_rows = None
+        alive_u_l, link_u_l = [], []
+        if with_f:
+            n_tr = fp.down_start.shape[0]
+
+            def fpad(a, fill):
+                if a is None or n_tr == n:
+                    return a
+                return jnp.concatenate(
+                    [a, jnp.full((n - n_tr,), fill, dtype=a.dtype)])
+
+            a_l, s_l, c_l, r_l = [], [], [], []
+            for tk in tick_l:
+                f_alive_u = _faults.alive_mask(fp, tk)
+                f_link_u = _faults.link_ok_bits(fp, offsets, cinv, tk,
+                                                n_true)
+                f_cand_u = _faults.cand_alive_bits(f_alive_u, offsets)
+                alive_u_l.append(f_alive_u)
+                link_u_l.append(f_link_u)
+                f_alive = fpad(f_alive_u, True)
+                f_alive_w = _faults.alive_word(f_alive)
+                f_alive_all = jnp.where(f_alive, ALL, Z)
+                f_link = fpad(f_link_u, ALL)
+                f_send_ok = (f_alive_all if f_link is None
+                             else f_alive_all & f_link)
+                a_l.append(f_alive_w)
+                s_l.append(f_send_ok)
+                c_l.append(fpad(f_cand_u, ALL))
+                if cold:
+                    r_l.append(_faults.alive_word(
+                        fpad(_faults.rejoined_mask(fp, tk), False)))
+            alive_rows = jnp.stack(a_l)
+            sok_rows = jnp.stack(s_l)
+            cal_rows = jnp.stack(c_l)
+            if cold:
+                rej_rows = jnp.stack(r_l)
+        krn = make_fused_gossip_update(
+            cfg, n_true, W, hg, T, interpret=receive_interpret,
+            stream_n=n_true, with_faults=with_f, cold_restart=cold,
+            with_telemetry=with_t, tel_lat_buckets=lat_b)
+        args = [jnp.asarray(tick0, jnp.int32).reshape(1), seeds, due,
+                jnp.zeros((1,), jnp.uint32)]
+        if with_t and lat_b:
+            args.append(jnp.stack([_telemetry.latency_bucket_masks(
+                params.publish_tick, tk, lat_b, W)
+                for tk in tick_l]))
+        args += [sub_all, params.cand_sub_bits, params.origin_words]
+        if with_t and lat_b:
+            args.append(params.deliver_words)
+        args += [state.have, state.recent.reshape(hg * W, n),
+                 state.mesh, state.fanout, state.last_pub,
+                 state.backoff, state.gates[0], state.gates[1]]
+        if with_f:
+            args += [alive_rows, sok_rows, cal_rows]
+        if cold:
+            args += [rej_rows]
+        outs = krn(*args)
+        (have_f, rec_f, mesh_f, fan_f, lp_f, bo_f, tgt_f, bog_f,
+         acq) = outs[:9]
+        mesh_rows = tel_rows = None
+        if with_t:
+            mesh_rows, tel_rows = outs[9], outs[10]
+        delivered = acq & params.deliver_words[None]
+        ft = state.first_tick
+        for t in range(T):
+            ft = update_first_tick(ft, delivered[t], tick_l[t])
+        new_state = state.replace(
+            mesh=mesh_f, fanout=fan_f, last_pub=lp_f, backoff=bo_f,
+            have=have_f, recent=rec_f.reshape(hg, W, n),
+            first_tick=ft, tick=tick0 + T, gates=(tgt_f, bog_f))
+        if tel is None:
+            return new_state, delivered
+
+        # -- per-tick frame assembly (resident path): the counter /
+        # latency tallies come back as the kernel's per-tick emission
+        # rows; graft/prune sends ride the two extra in-kernel rows
+        # (the per-tick epilogue that counted them is fused away);
+        # the mesh gauges reduce the emitted per-tick mesh rows; the
+        # faults group recomputes the tick's mask words here — every
+        # value equals the scanned step's frame bit for bit.
+        ws = _telemetry.wire_sizes(tel)
+        frames = []
+        for t in range(T):
+            kw_f = {}
+            if tel.counters:
+                sums = tel_rows[t].sum(axis=1)
+                graft_cnt = sums[TEL_ROWS + lat_b]
+                prune_cnt = sums[TEL_ROWS + lat_b + 1]
+                kw_f.update(
+                    payload_sent=sums[TEL_PAYLOAD],
+                    ihave_rpcs=sums[TEL_IHAVE_RPCS],
+                    ihave_ids=sums[TEL_IHAVE_IDS],
+                    iwant_rpcs=sums[TEL_IWANT_RPCS],
+                    iwant_ids_requested=sums[TEL_IWANT_REQ],
+                    iwant_ids_served=sums[TEL_IWANT_SERVED],
+                    graft_sends=graft_cnt, prune_sends=prune_cnt,
+                    dup_suppressed=sums[TEL_RECV]
+                    - sums[TEL_NEW_IDS])
+                if tel.wire:
+                    f32c = lambda x: x.astype(jnp.float32)  # noqa: E731
+                    kw_f["bytes_payload"] = (
+                        f32c(sums[TEL_PAYLOAD]
+                             + sums[TEL_IWANT_SERVED])
+                        * float(ws.payload_frame))
+                    kw_f["bytes_control"] = (
+                        f32c(sums[TEL_IHAVE_RPCS])
+                        * float(ws.ihave_base)
+                        + f32c(sums[TEL_IHAVE_IDS])
+                        * float(ws.ihave_per_id)
+                        + f32c(sums[TEL_IWANT_RPCS])
+                        * float(ws.iwant_base)
+                        + f32c(sums[TEL_IWANT_REQ])
+                        * float(ws.iwant_per_id)
+                        + f32c(graft_cnt) * float(ws.graft_frame)
+                        + f32c(prune_cnt) * float(ws.prune_frame))
+            if tel.mesh or tel.degree_hist:
+                deg_t = popcount32(mesh_rows[t][:n_true])
+                if tel.mesh:
+                    mn_d, mean_d, mx_d = _telemetry.degree_stats(
+                        deg_t, params.subscribed[:n_true])
+                    kw_f.update(mesh_deg_min=mn_d,
+                                mesh_deg_mean=mean_d,
+                                mesh_deg_max=mx_d)
+                if tel.degree_hist:
+                    kw_f["mesh_deg_hist"] = \
+                        _telemetry.degree_histogram(
+                            deg_t, params.subscribed[:n_true],
+                            tel.degree_buckets)
+            if tel.latency_hist:
+                kw_f["latency_hist"] = tel_rows[
+                    t, TEL_ROWS:TEL_ROWS + lat_b].sum(
+                        axis=1, dtype=jnp.int32)
+            if tel.faults and with_f:
+                kw_f["down_peers"] = (~alive_u_l[t]).sum(
+                    dtype=jnp.int32)
+                if link_u_l[t] is not None:
+                    kw_f["dropped_edge_ticks"] = (
+                        popcount32(~link_u_l[t] & ALL).sum(
+                            dtype=jnp.int32)
+                        // (1 if fp.directed_drops else 2))
+            frames.append(_telemetry.make_frame(**kw_f))
+        frames_st = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *frames)
+        return new_state, delivered, frames_st
+
+    def window(params, state):
+        reason = kernel_ticks_fused_capability(
+            cfg, sc, params, state, T,
+            vmem_budget_bytes=vmem_budget_bytes,
+            sharded=shard_mesh is not None)
+        if reason is not None:
+            if on_refusal == "raise":
+                raise ValueError(reason)
+            return fallback_window(params, state)
+        return fused_window(params, state)
+
+    window.ticks_fused = T
+    window.capability = lambda params, state: \
+        kernel_ticks_fused_capability(
+            cfg, sc, params, state, T,
+            vmem_budget_bytes=vmem_budget_bytes,
+            sharded=shard_mesh is not None)
+    return window
+
+
 # --------------------------------------------------------------------------
 # Runners / metrics (mirror models/floodsub.py)
 # --------------------------------------------------------------------------
@@ -4213,6 +4582,69 @@ def gossip_run_curve(params: GossipParams, state: GossipState, n_ticks: int,
         return s2, count_bits_per_position(delivered, n_msgs)
     state, counts = jax.lax.scan(body, state, None, length=n_ticks)
     return state, counts
+
+
+def _check_fused_horizon(n_ticks: int, ticks_fused: int) -> int:
+    if n_ticks % ticks_fused != 0:
+        raise ValueError(
+            f"scan horizon not divisible by the fused window: "
+            f"n_ticks={n_ticks}, ticks_fused={ticks_fused} — pick a "
+            "horizon that is a multiple of the window (or a window "
+            "that divides it)")
+    return n_ticks // ticks_fused
+
+
+@partial(jax.jit, static_argnums=(2, 3), donate_argnums=(1,))
+def gossip_run_fused(params: GossipParams, state: GossipState,
+                     n_ticks: int, window) -> GossipState:
+    """gossip_run over the tick-resident window (make_fused_window):
+    the horizon chunks into ``n_ticks / window.ticks_fused`` fused
+    windows scanned back to back — ONE pallas dispatch per window
+    instead of per tick.  The final state is bit-identical to
+    ``gossip_run`` with the per-tick step (pinned); a horizon the
+    window does not divide raises by name.  State carry donated as in
+    every runner."""
+    n_win = _check_fused_horizon(n_ticks, window.ticks_fused)
+
+    def body(s, _):
+        return window(params, s)[0], None
+    state, _ = jax.lax.scan(body, state, None, length=n_win)
+    return state
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4), donate_argnums=(1,))
+def gossip_run_curve_fused(params: GossipParams, state: GossipState,
+                           n_ticks: int, window, n_msgs: int):
+    """gossip_run_curve over fused windows: per-tick delivered counts
+    [n_ticks, M], rows bit-identical to the per-tick runner's."""
+    n_win = _check_fused_horizon(n_ticks, window.ticks_fused)
+
+    def body(s, _):
+        s2, delivered = window(params, s)[:2]
+        # delivered is [Tw, W, N]: one count row per fused tick
+        return s2, jnp.stack([
+            count_bits_per_position(delivered[t], n_msgs)
+            for t in range(window.ticks_fused)])
+    state, counts = jax.lax.scan(body, state, None, length=n_win)
+    return state, counts.reshape(n_ticks, n_msgs)
+
+
+@partial(jax.jit, static_argnums=(2, 3), donate_argnums=(1,))
+def gossip_run_frames_fused(params: GossipParams, state: GossipState,
+                            n_ticks: int, window):
+    """Telemetry runner over fused windows: returns ``(state,
+    frames)`` with every TelemetryFrame leaf stacked [n_ticks, ...] —
+    the same layout (and bit-identical values) as scanning the
+    telemetry step."""
+    n_win = _check_fused_horizon(n_ticks, window.ticks_fused)
+
+    def body(s, _):
+        s2, _delivered, frames = window(params, s)
+        return s2, frames
+    state, frames = jax.lax.scan(body, state, None, length=n_win)
+    # [n_win, Tw, ...] -> [n_ticks, ...] per leaf
+    return state, jax.tree_util.tree_map(
+        lambda x: x.reshape((n_ticks,) + x.shape[2:]), frames)
 
 
 # --------------------------------------------------------------------------

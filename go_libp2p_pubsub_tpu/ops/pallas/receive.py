@@ -1405,3 +1405,606 @@ def make_receive_update(cfg, sc, n_true: int, block: int,
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
     )
+
+
+# ---------------------------------------------------------------------------
+# Round 16: the tick-resident megakernel (kernel_ticks_fused).
+#
+# The per-tick kernel above eliminates the INTRA-tick HBM gap, but every
+# tick still re-dispatches pallas_call and stages the full per-shard
+# carry (possession words, mcache ring, mesh/fanout/backoff, gate rows)
+# through HBM between invocations.  The fused kernel folds T ticks into
+# ONE pallas_call with grid=(T,) — the grid dimension is the TIME axis,
+# sequential by construction — and keeps the whole carry resident in
+# VMEM across grid steps:
+#
+# - resident state rides as (input, output) ref PAIRS whose BlockSpecs
+#   use constant index maps: Mosaic fetches each input block once,
+#   keeps the revisited output block in VMEM for the whole grid, and
+#   flushes it once at exit.  Grid step 0 copies input -> output
+#   (pl.when(t == 0)); every step then read-modify-writes the OUTPUT
+#   refs — the classic revisited-accumulator pattern the per-tick
+#   kernel already uses for its telemetry block, applied to the whole
+#   carry;
+# - HBM is touched per tick only for the genuinely per-tick rows: the
+#   publish-due words and lane seeds (SMEM scalars), the fault mask
+#   rows when a schedule is armed, and the emitted acquisition /
+#   telemetry rows the window epilogue needs;
+# - the block is the WHOLE shard (no peer-axis grid): every tick's
+#   exchange reads every other peer's tick-t state, so partial-shard
+#   residency is impossible for this communication pattern — which is
+#   exactly why the capability refuses (with the working-set bytes in
+#   the message) once the carry outgrows VMEM instead of silently
+#   tiling it back through HBM.
+#
+# The in-kernel tick body is a line-for-line transcription of the
+# UNSCORED combined step (models/gossipsub.py step() + this module's
+# _receive_kernel): same op order, same lane-hash draws (seeds
+# pre-mixed per tick on the host), same select-k rank compare — so the
+# fused trajectory is bit-identical to the per-tick kernel and XLA
+# paths (tests/test_fused_kernel.py pins all three).  Edge views need
+# no DMA machinery at all: with n_true == n_pad and n_true % 1024 == 0
+# the circulant view is an EXACT in-VMEM lane roll of the resident
+# row (_flat_roll with take == len), and the six sender-side ctrl
+# masks pack into one u32 word per sender edge so each edge costs one
+# roll instead of six.
+# ---------------------------------------------------------------------------
+
+FUSED_ALIGN = ALIGN32    # whole-ring lane rolls need the u32 tile
+# per-tick telemetry rows appended after the latency buckets: in-kernel
+# popcounts of the ACTUALLY TRANSMITTED graft/prune words (the XLA
+# frame's tx() fold — the resident window has no per-tick XLA epilogue
+# to count them in)
+TEL_FUSED_EXTRA = 2
+
+
+def fused_carry_bytes(C: int, w_words: int, hg: int) -> int:
+    """Per-peer bytes of the resident carry: have + mcache ring + mesh
+    + fanout + last_pub + backoff + the two carried gate rows."""
+    return (4 * w_words          # have
+            + 4 * hg * w_words   # recent (mcache ring)
+            + 4                  # mesh
+            + 4                  # fanout
+            + 4                  # last_pub (i32)
+            + 2 * C              # backoff (i16)
+            + 4                  # targets gate row
+            + 4)                 # backoff gate row
+
+
+def fused_working_set_bytes(C: int, w_words: int, hg: int, n: int, *,
+                            ticks: int, lat_buckets: int = 0,
+                            with_faults: bool = False,
+                            cold_restart: bool = False,
+                            with_telemetry: bool = False) -> dict:
+    """Static byte accounting for the resident window — the numbers the
+    capability refusal reports and tools/profile_bytes --kernel prints.
+
+    ``vmem_bytes`` estimates the kernel's VMEM working set: the carry
+    twice (input pair + resident output pair), the static per-window
+    operands, and double-buffered per-tick stream/emission rows.
+    ``hbm_bytes_per_tick`` is the fused path's amortized HBM traffic:
+    (entry + exit + static) / ticks plus the genuinely per-tick rows.
+    ``unfused_hbm_bytes_per_tick`` is the per-tick kernel's operand
+    traffic for the same config (its streams + blocked operands +
+    outputs) — the ratio of the two is the residency win.  Analytic by
+    design: XLA cost analysis cannot see through a Mosaic custom call,
+    so the gate pins these closed-form numbers instead.
+    """
+    W, hg_ = w_words, hg
+    carry = fused_carry_bytes(C, W, hg_)
+    static_in = (4            # sub_all
+                 + 4          # cand_sub_bits
+                 + 4 * W      # origin_words
+                 + (4 * W if (with_telemetry and lat_buckets) else 0))
+    stream_tick = ((3 * 4 if with_faults else 0)
+                   + (4 if cold_restart else 0))
+    emit_tick = 4 * W + (4 if with_telemetry else 0)   # acq (+ mesh row)
+    tel_tick = ((TEL_ROWS + lat_buckets + TEL_FUSED_EXTRA) * 128 * 4
+                if with_telemetry else 0)
+    vmem = n * (2 * carry + static_in
+                + 2 * (stream_tick + emit_tick))
+    entry_exit = n * (2 * carry + static_in)
+    per_tick = (entry_exit / ticks
+                + n * (stream_tick + emit_tick) + tel_tick)
+    return dict(carry_bytes=carry * n,
+                carry_bytes_per_peer=carry,
+                static_bytes=static_in * n,
+                vmem_bytes=vmem,
+                entry_exit_bytes=entry_exit,
+                hbm_bytes_per_tick=per_tick,
+                unfused_hbm_bytes_per_tick=unfused_kernel_hbm_bytes_per_tick(
+                    C, W, n, lat_buckets=lat_buckets,
+                    with_faults=with_faults,
+                    with_telemetry=with_telemetry),
+                ticks=ticks)
+
+
+def unfused_kernel_hbm_bytes_per_tick(C: int, w_words: int, n: int, *,
+                                      lat_buckets: int = 0,
+                                      with_faults: bool = False,
+                                      with_telemetry: bool = False
+                                      ) -> float:
+    """Per-tick HBM operand bytes of the UNSCORED per-tick kernel
+    (make_receive_update, aligned plan): the sender streams, the
+    blocked per-peer operands, and the outputs.  Deliberately excludes
+    the XLA prologue/epilogue's own passes over have/recent (which the
+    fused path also absorbs), so the reported fused-vs-unfused ratio is
+    a LOWER bound on the real win."""
+    W = w_words
+    b = (C * n               # ctrl u8 stream
+         + 2 * W * 4 * n     # fresh + adv streams
+         + 9 * 4 * n         # sub..meshsel blocked words
+         + 2 * W * 4 * n     # seen + injected
+         + 2 * C * n         # backoff in (i16)
+         + (4 * n if with_faults else 0)
+         + (4 * W * n if (with_telemetry and lat_buckets) else 0)
+         + W * 4 * n         # out: new_acq
+         + 4 * n             # out: mesh
+         + 2 * C * n         # out: backoff
+         + 2 * 4 * n)        # out: gate rows (targets, backoff)
+    if with_telemetry:
+        b += (TEL_ROWS + lat_buckets) * 128 * 4
+    return float(b)
+
+
+def _fused_gossip_kernel(*refs, cfg, n_true, w_words, hg, ticks,
+                         stream_n=None, with_faults=False,
+                         cold_restart=False, with_telemetry=False,
+                         tel_lat_buckets=0):
+    """One grid step == one tick over the WHOLE resident shard.
+
+    Transcribes the unscored combined step: publish injection, fanout
+    TTL/refill, eager forward + lazy gossip over the circulant edge
+    views, the GRAFT/PRUNE/A handshake, backoff, and the next tick's
+    gate emission — with the carry read from / written to the resident
+    output refs each step."""
+    C = cfg.n_candidates
+    N = n_true
+    W = w_words
+    Hg = hg
+    cinv = cfg.cinv
+    offsets = [int(o) for o in cfg.offsets]
+    deltas = [o % N for o in offsets]
+    K_d = int(cfg.d)
+    K_d_lo = int(cfg.d_lo)
+    K_d_hi = int(cfg.d_hi)
+    K_ttl = int(cfg.fanout_ttl_ticks)
+    bt1 = int(cfg.backoff_ticks) - 1
+    Z = jnp.uint32(0)
+    u1 = jnp.uint32(1)
+    ALLC = jnp.uint32((1 << C) - 1)
+    sn = n_true if stream_n is None else stream_n
+
+    it = iter(refs)
+    nxt = lambda: next(it)  # noqa: E731
+    tick0_ref = nxt()        # i32 [1] (SMEM): window start tick
+    seeds_ref = nxt()        # u32 [T, 4] (SMEM): per-tick lane seeds
+    #                          [fanout ph4, graft ph2, prune ph3,
+    #                           next-tick targets ph1@t+1]
+    due_ref = nxt()          # u32 [T, W] (SMEM): publish-due words
+    base_ref = nxt()         # u32 [1] (SMEM): global peer offset
+    latmask_ref = (nxt() if with_telemetry and tel_lat_buckets
+                   else None)           # u32 [T, L, W] (SMEM)
+    sub_ref = nxt()          # u32 [N] sub_all (static)
+    csub_ref = nxt()         # u32 [N] cand_sub_bits (static)
+    origin_ref = nxt()       # u32 [W, N] origin words (static)
+    dlv_ref = (nxt() if with_telemetry and tel_lat_buckets
+               else None)    # u32 [W, N] effective deliver words
+    have_i = nxt()           # resident input pair ...
+    rec_i = nxt()            # u32 [Hg*W, N] (row h*W + w)
+    mesh_i = nxt()
+    fan_i = nxt()
+    lp_i = nxt()             # i32 [N]
+    bo_i = nxt()             # i16 [C, N]
+    tgt_i = nxt()            # carried targets gate row
+    bog_i = nxt()            # carried backoff gate row
+    if with_faults:
+        alive_ref = nxt()    # u32 [1, N] per-tick receiver-alive word
+        sok_ref = nxt()      # u32 [1, N] per-tick send-ok bits
+        cal_ref = nxt()      # u32 [1, N] per-tick cand-alive bits
+    if cold_restart:
+        rej_ref = nxt()      # u32 [1, N] per-tick rejoin word
+    have_o = nxt()
+    rec_o = nxt()
+    mesh_o = nxt()
+    fan_o = nxt()
+    lp_o = nxt()
+    bo_o = nxt()
+    tgt_o = nxt()
+    bog_o = nxt()
+    acq_o = nxt()            # u32 [1, W, N] per-tick acquisitions
+    meshrow_o = nxt() if with_telemetry else None   # u32 [1, N]
+    tel_o = nxt() if with_telemetry else None  # i32 [1, R, 128]
+
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _seed_resident():
+        have_o[...] = have_i[...]
+        rec_o[...] = rec_i[...]
+        mesh_o[...] = mesh_i[...]
+        fan_o[...] = fan_i[...]
+        lp_o[...] = lp_i[...]
+        bo_o[...] = bo_i[...]
+        tgt_o[...] = tgt_i[...]
+        bog_o[...] = bog_i[...]
+
+    tick_t = tick0_ref[0] + t
+
+    # -- resident carry at tick start ----------------------------------
+    have_a = have_o[...]
+    rec_a = rec_o[...]
+    have_w = [have_a[w] for w in range(W)]
+    rec = [[rec_a[h * W + w] for w in range(W)] for h in range(Hg)]
+    mesh0 = mesh_o[...]
+    fan_prev = fan_o[...]
+    lp = lp_o[...]
+    targets = tgt_o[...]
+    bo_row = bog_o[...]
+    sub_all = sub_ref[...]
+    csub = csub_ref[...]
+    subbed = sub_all != 0
+
+    if with_faults:
+        alive_w = alive_ref[...].reshape(N)
+        sok = sok_ref[...].reshape(N)
+        cal = cal_ref[...].reshape(N)
+        alive_all = alive_w & ALLC
+
+    # -- cold-restart clear (shared-prologue mirror): a peer rejoining
+    # THIS tick comes back cold before anything reads its possession
+    if cold_restart:
+        rej = rej_ref[...].reshape(N)
+        have_w = [h & ~rej for h in have_w]
+        rec = [[r & ~rej for r in row] for row in rec]
+
+    # packed-row helpers (identical to the per-tick kernel's)
+    cidx_i = jax.lax.broadcasted_iota(jnp.int32, (C, N), 0)
+
+    def packb(cond):
+        return (cond.astype(jnp.int32) << cidx_i).sum(
+            axis=0, dtype=jnp.int32).astype(jnp.uint32)
+
+    def lane_u(seed):
+        peer = (jax.lax.broadcasted_iota(jnp.uint32, (C, N), 1)
+                + base_ref[0])
+        lane = (jax.lax.broadcasted_iota(jnp.uint32, (C, N), 0)
+                * jnp.uint32(sn) + peer)
+        h = _fmix32(lane ^ seed)
+        return ((h >> jnp.uint32(8)).astype(jnp.int32)
+                .astype(jnp.float32) * jnp.float32(1 / (1 << 24)))
+
+    def sel_k(elig, need, seed):
+        # ops.graph.select_k_bits's exact-k rank compare, unrolled as
+        # in the per-tick kernel's targets_gate (bit-identical); a
+        # zero ``need`` row selects nothing, so the XLA path's
+        # any(need > 0) shortcut is value-free to skip
+        u_s = lane_u(seed)
+        elig_b = _expand(elig, C)
+        prio = jnp.where(elig_b, u_s, -1.0)
+        ranks = []
+        for i_ in range(C):
+            pi = prio[i_][None, :]
+            beats = (prio > pi) | ((prio == pi) & (cidx_i < i_))
+            ranks.append(beats.astype(jnp.int32).sum(
+                axis=0, dtype=jnp.int32))
+        rank = jnp.stack(ranks)
+        return elig & packb(elig_b & (rank < need[None, :]))
+
+    # -- 1. publish injection ------------------------------------------
+    inj = [origin_ref[w] & due_ref[t, w] & ~have_w[w] for w in range(W)]
+    if with_faults:
+        inj = [x & alive_w for x in inj]
+    publishing = inj[0] != 0
+    for w in range(1, W):
+        publishing = publishing | (inj[w] != 0)
+
+    # -- 1b. fanout TTL + refill ---------------------------------------
+    lp = jnp.where(publishing, tick_t, lp)
+    alive_f = (~subbed) & (tick_t - lp < K_ttl)
+    fanout = jnp.where(alive_f, fan_prev, Z)
+    f_deg = jax.lax.population_count(fanout).astype(jnp.int32)
+    f_need = jnp.where(alive_f, K_d - f_deg, 0)
+    f_elig = csub & ~fanout
+    if with_faults:
+        f_elig = f_elig & cal
+    fanout = fanout | sel_k(f_elig, f_need, seeds_ref[t, 0])
+
+    # -- 2/3a. fresh + advertised windows from the resident ring -------
+    newest = jax.lax.rem(tick_t - 1 + Hg, Hg)
+    fresh = []
+    adv = []
+    for w in range(W):
+        fr = rec[0][w]
+        aw = inj[w] | rec[0][w]
+        for h in range(1, Hg):
+            fr = jnp.where(newest == h, rec[h][w], fr)
+            aw = aw | rec[h][w]
+        fresh.append(fr | inj[w])
+        adv.append(aw)
+    out_bits = mesh0 | fanout
+    if with_faults:
+        out_bits = out_bits & sok
+        targets = targets & sok
+    seen = [have_w[w] | inj[w] for w in range(W)]
+
+    # -- 4. maintenance selections (unscored maintain()) ---------------
+    dead = None
+    if with_faults:
+        dead = mesh0 & ~(cal & alive_all)
+        mesh_ng = mesh0 & ~dead
+    else:
+        mesh_ng = mesh0
+    deg = jax.lax.population_count(mesh_ng).astype(jnp.int32)
+    can_graft = csub & ~mesh_ng & ~bo_row & sub_all
+    if with_faults:
+        can_graft = can_graft & cal & alive_all
+    need = jnp.where(deg < K_d_lo, K_d - deg, 0)
+    grafts = sel_k(can_graft, need, seeds_ref[t, 1])
+    over = deg > K_d_hi
+    keep = sel_k(mesh_ng, jnp.full_like(deg, K_d), seeds_ref[t, 2])
+    prunes = mesh_ng & ~keep & jnp.where(over, ALLC, Z)
+    if with_faults:
+        grafts = grafts & cal & alive_all
+    mesh_sel = (mesh_ng | grafts) & ~prunes
+    dropped = prunes if dead is None else prunes | dead
+    backoff_bits2 = bo_row | dropped
+    would_accept = sub_all & ~backoff_bits2
+    a_sent = would_accept
+
+    # -- exchange: pack the six sender-side masks into ONE u32 word per
+    # sender edge, then every receiving edge view costs one roll
+    g_tx, d_tx, a_tx = grafts, dropped, a_sent
+    if with_faults:
+        g_tx, d_tx, a_tx = grafts & sok, dropped & sok, a_sent & sok
+
+    def bit_of(word, c):
+        return (word >> jnp.uint32(c)) & u1
+
+    ctrl_pack = []
+    for c in range(C):
+        ctrl_pack.append(
+            (bit_of(out_bits, c) << jnp.uint32(CTRL_OUT))
+            | (bit_of(targets, c) << jnp.uint32(CTRL_TGT))
+            | (bit_of(g_tx, c) << jnp.uint32(CTRL_GRAFT))
+            | (bit_of(d_tx, c) << jnp.uint32(CTRL_DROP))
+            | (bit_of(a_tx, c) << jnp.uint32(CTRL_A))
+            | (bit_of(targets, c) << jnp.uint32(CTRL_ADV)))
+
+    heard = [jnp.zeros((N,), jnp.uint32) for _ in range(W)]
+    graft_recv = jnp.zeros((N,), jnp.uint32)
+    prune_recv = jnp.zeros((N,), jnp.uint32)
+    a_recv = jnp.zeros((N,), jnp.uint32)
+    if with_telemetry:
+        pcount = lambda x: jax.lax.population_count(x).astype(  # noqa: E731
+            jnp.int32)
+        zi = jnp.zeros((N,), jnp.int32)
+        t_pay = t_ihv = t_srv = t_recv = zi
+        t_req = t_ihr = t_iwr = t_new = zi
+        i1 = jnp.int32(1)
+        i0 = jnp.int32(0)
+    for j in range(C):
+        dj = deltas[j]
+        ctrl = _flat_roll(ctrl_pack[cinv[j]], dj, N)
+        m_f = (ctrl >> jnp.uint32(CTRL_OUT)) & u1
+        m_g = (ctrl >> jnp.uint32(CTRL_TGT)) & u1
+        g_r = (ctrl >> jnp.uint32(CTRL_GRAFT)) & u1
+        d_r = (ctrl >> jnp.uint32(CTRL_DROP)) & u1
+        a_r = (ctrl >> jnp.uint32(CTRL_A)) & u1
+        adv_r = (ctrl >> jnp.uint32(CTRL_ADV)) & u1
+        graft_recv = graft_recv | (g_r << jnp.uint32(j))
+        prune_recv = prune_recv | (d_r << jnp.uint32(j))
+        a_recv = a_recv | (a_r << jnp.uint32(j))
+        fwd_on = m_f != 0
+        gsp_on = m_g != 0
+        if with_telemetry:
+            adv_on = adv_r != 0
+            req_c = zi
+            adv_nz = jnp.zeros((N,), jnp.bool_)
+        for w in range(W):
+            fresh_q = _flat_roll(fresh[w], dj, N)
+            adv_q = _flat_roll(adv[w], dj, N)
+            fwd_q = jnp.where(fwd_on, fresh_q, Z)
+            gsp_q = jnp.where(gsp_on, adv_q, Z)
+            got = fwd_q | gsp_q
+            if with_faults:
+                got = got & alive_w
+            news = got & ~seen[w]
+            heard[w] = heard[w] | news
+            if with_telemetry:
+                adv_w_q = jnp.where(adv_on, adv_q, Z)
+                gsp_m = (gsp_q & alive_w if with_faults else gsp_q)
+                r_adv = (adv_w_q & alive_w if with_faults else adv_w_q)
+                t_pay = t_pay + pcount(fwd_q)
+                t_ihv = t_ihv + pcount(adv_w_q)
+                t_srv = t_srv + pcount(gsp_m & ~seen[w])
+                t_recv = t_recv + pcount(got)
+                req_c = req_c + pcount(r_adv & ~seen[w])
+                adv_nz = adv_nz | (adv_q != 0)
+        if with_telemetry:
+            t_ihr = t_ihr + jnp.where(adv_on & adv_nz, i1, i0)
+            t_req = t_req + req_c
+            t_iwr = t_iwr + jnp.where(req_c > 0, i1, i0)
+
+    if with_faults:
+        graft_recv = graft_recv & alive_w
+        prune_recv = prune_recv & alive_w
+        a_recv = a_recv & alive_w
+    accept = graft_recv & would_accept
+    retract = grafts & ~a_recv
+    mesh_new = ((mesh_sel | accept) & ~prune_recv) & ~retract
+    bo_trig = dropped | prune_recv | retract
+
+    # -- acquisitions + possession/ring update -------------------------
+    new_acq = [jnp.where(subbed, heard[w], Z) | inj[w]
+               for w in range(W)]
+    if with_telemetry:
+        for w in range(W):
+            t_new = t_new + pcount(jnp.where(subbed, heard[w], Z))
+    if with_telemetry and tel_lat_buckets:
+        dlv_a = dlv_ref[...]
+        t_lat = [zi for _ in range(tel_lat_buckets)]
+        for w in range(W):
+            dw = new_acq[w] & dlv_a[w]
+            for b in range(tel_lat_buckets):
+                t_lat[b] = t_lat[b] + pcount(dw & latmask_ref[t, b, w])
+    have_new = [have_w[w] | new_acq[w] for w in range(W)]
+    slot = jax.lax.rem(tick_t, Hg)
+    rec_rows = []
+    for h in range(Hg):
+        for w in range(W):
+            rec_rows.append(jnp.where(slot == h, new_acq[w],
+                                      rec[h][w]))
+
+    # -- backoff + next tick's gate rows -------------------------------
+    bo32 = bo_o[...].astype(jnp.int32)
+    bo_new = jnp.where(_expand(bo_trig, C), bt1,
+                       jnp.maximum(bo32 - 1, 0))
+    bo_gate = packb(bo_new > 0)
+    elig = csub & ~mesh_new & ~fanout & sub_all
+    n_el = jax.lax.population_count(elig).astype(jnp.int32)
+    n_go = jnp.maximum(
+        jnp.int32(cfg.d_lazy),
+        (cfg.gossip_factor * n_el.astype(jnp.float32)).astype(
+            jnp.int32))
+    u_g = lane_u(seeds_ref[t, 3])
+    if cfg.binomial_gossip_sampling:
+        p_g = jnp.minimum(
+            1.0, n_go.astype(jnp.float32)
+            / jnp.maximum(n_el, 1).astype(jnp.float32))
+        tgt_new = elig & packb(u_g < p_g[None, :])
+    else:
+        elig_b = _expand(elig, C)
+        prio = jnp.where(elig_b, u_g, -1.0)
+        ranks = []
+        for i_ in range(C):
+            pi = prio[i_][None, :]
+            beats = (prio > pi) | ((prio == pi) & (cidx_i < i_))
+            ranks.append(beats.astype(jnp.int32).sum(
+                axis=0, dtype=jnp.int32))
+        rank = jnp.stack(ranks)
+        tgt_new = elig & packb(elig_b & (rank < n_go[None, :]))
+
+    # -- resident write-back + per-tick emission -----------------------
+    have_o[...] = jnp.stack(have_new)
+    rec_o[...] = jnp.stack(rec_rows)
+    mesh_o[...] = mesh_new
+    fan_o[...] = fanout
+    lp_o[...] = lp
+    bo_o[...] = bo_new.astype(jnp.int16)
+    tgt_o[...] = tgt_new
+    bog_o[...] = bo_gate
+    acq_o[...] = jnp.stack(new_acq).reshape(1, W, N)
+    if with_telemetry:
+        meshrow_o[...] = mesh_new.reshape(1, N)
+        if with_faults:
+            g_cnt = pcount(grafts & sok & cal)
+            p_cnt = pcount(dropped & sok & cal)
+        else:
+            g_cnt = pcount(grafts)
+            p_cnt = pcount(dropped)
+        rows_l = [t_pay, t_ihv, t_srv, t_recv,
+                  t_req, t_ihr, t_iwr, t_new]
+        if tel_lat_buckets:
+            rows_l += t_lat
+        rows_l += [g_cnt, p_cnt]
+        rows8 = jnp.stack(rows_l)
+        blk = rows8[:, :128]
+        for k in range(1, N // 128):
+            blk = blk + rows8[:, k * 128:(k + 1) * 128]
+        tel_o[...] = blk.reshape(1, len(rows_l), 128)
+
+
+def make_fused_gossip_update(cfg, n_true: int, w_words: int, hg: int,
+                             ticks: int, *, interpret: bool = False,
+                             stream_n: int | None = None,
+                             with_faults: bool = False,
+                             cold_restart: bool = False,
+                             with_telemetry: bool = False,
+                             tel_lat_buckets: int = 0,
+                             vmem_limit_bytes: int = 128 * 1024 * 1024):
+    """Build the resident-window kernel caller (grid=(ticks,), whole
+    shard per block).
+
+    Operand order (args): tick0 i32 [1], seeds u32 [T, 4], due u32
+    [T, W], base u32 [1] (all SMEM), [latmask u32 [T, L, W] (SMEM,
+    latency telemetry only)], sub_all u32 [N], cand_sub_bits u32 [N],
+    origin u32 [W, N], [deliver_eff u32 [W, N]], have u32 [W, N],
+    recent u32 [Hg*W, N] (row h*W + w), mesh, fanout u32 [N], last_pub
+    i32 [N], backoff i16 [C, N], targets-gate, backoff-gate u32 [N],
+    [alive_w, send_ok, cand_alive u32 [T, N] (fault rows)], [rejoin
+    u32 [T, N] (cold_restart)].
+
+    Returns (have, recent [Hg*W, N], mesh, fanout, last_pub, backoff,
+    targets-gate, backoff-gate, acq u32 [T, W, N][, mesh_rows u32
+    [T, N], tel i32 [T, 8 + L + 2, 128]]) — the resident carry after
+    ``ticks`` ticks plus the per-tick emission rows.
+    """
+    C = cfg.n_candidates
+    N = n_true
+    W = w_words
+    if N % FUSED_ALIGN != 0:
+        raise ValueError(
+            f"fused kernel needs n_true % {FUSED_ALIGN} == 0 (whole-"
+            f"ring lane rolls); got {N}")
+    kern = functools.partial(
+        _fused_gossip_kernel, cfg=cfg, n_true=n_true, w_words=w_words,
+        hg=hg, ticks=ticks, stream_n=stream_n,
+        with_faults=with_faults, cold_restart=cold_restart,
+        with_telemetry=with_telemetry, tel_lat_buckets=tel_lat_buckets)
+
+    smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)  # noqa: E731
+    b1c = lambda: pl.BlockSpec((N,), lambda t: (0,))  # noqa: E731
+    bwc = lambda: pl.BlockSpec((W, N), lambda t: (0, 0))  # noqa: E731
+    bhg = lambda: pl.BlockSpec((hg * W, N), lambda t: (0, 0))  # noqa: E731
+    bcc = lambda: pl.BlockSpec((C, N), lambda t: (0, 0))  # noqa: E731
+    row = lambda: pl.BlockSpec((1, N), lambda t: (t, 0))  # noqa: E731
+
+    in_specs = [smem(), smem(), smem(), smem()]
+    if with_telemetry and tel_lat_buckets:
+        in_specs.append(smem())                    # latmask
+    in_specs += [b1c(), b1c(), bwc()]              # sub, csub, origin
+    if with_telemetry and tel_lat_buckets:
+        in_specs.append(bwc())                     # deliver_eff
+    in_specs += [bwc(), bhg(), b1c(), b1c(), b1c(), bcc(), b1c(),
+                 b1c()]                            # resident inputs
+    if with_faults:
+        in_specs += [row(), row(), row()]
+    if cold_restart:
+        in_specs += [row()]
+
+    out_shape = [
+        jax.ShapeDtypeStruct((W, N), jnp.uint32),          # have
+        jax.ShapeDtypeStruct((hg * W, N), jnp.uint32),     # recent
+        jax.ShapeDtypeStruct((N,), jnp.uint32),            # mesh
+        jax.ShapeDtypeStruct((N,), jnp.uint32),            # fanout
+        jax.ShapeDtypeStruct((N,), jnp.int32),             # last_pub
+        jax.ShapeDtypeStruct((C, N), jnp.int16),           # backoff
+        jax.ShapeDtypeStruct((N,), jnp.uint32),            # targets
+        jax.ShapeDtypeStruct((N,), jnp.uint32),            # bo gate
+        jax.ShapeDtypeStruct((ticks, W, N), jnp.uint32),   # acq
+    ]
+    out_specs = [bwc(), bhg(), b1c(), b1c(), b1c(), bcc(), b1c(),
+                 b1c(),
+                 pl.BlockSpec((1, W, N), lambda t: (t, 0, 0))]
+    if with_telemetry:
+        n_tel = TEL_ROWS + tel_lat_buckets + TEL_FUSED_EXTRA
+        out_shape += [
+            jax.ShapeDtypeStruct((ticks, N), jnp.uint32),  # mesh rows
+            jax.ShapeDtypeStruct((ticks, n_tel, 128), jnp.int32),
+        ]
+        out_specs += [row(),
+                      pl.BlockSpec((1, n_tel, 128),
+                                   lambda t: (t, 0, 0))]
+
+    return pl.pallas_call(
+        kern,
+        out_shape=tuple(out_shape),
+        grid=(ticks,),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs),
+        interpret=interpret,
+        compiler_params=_compiler_params_cls()(
+            vmem_limit_bytes=vmem_limit_bytes,
+        ),
+    )

@@ -78,7 +78,9 @@ __all__ = [
     "config_fingerprint", "snapshot_save", "snapshot_read",
     "latest_snapshot", "install_kill_handlers", "request_stop",
     "stop_requested", "clear_stop",
+    "read_snapshot_chain",
     "ckpt_gossip_run", "ckpt_gossip_run_curve",
+    "ckpt_gossip_run_fused",
     "ckpt_gossip_run_knob_batch", "ckpt_telemetry_run",
     "ckpt_flood_run", "ckpt_flood_run_curve",
     "ckpt_randomsub_run", "ckpt_randomsub_run_curve",
@@ -128,13 +130,22 @@ class CheckpointConfig:
     keep: int = 2
     fingerprint: int = 0
     tag: str = "sim"
+    async_write: bool = False
+    full_every: int = 1
 
     # Machine-readable contract (tools/graftlint/contracts.py): every
     # field is host-side orchestration — "build-time", never traced.
     # ``every`` in particular is the segment-scheduling knob whose
     # static-only verdict the checker pins with a reject probe; the
     # fingerprint's resume-mismatch reject is probed by name against
-    # snapshot_read.
+    # snapshot_read.  ``async_write`` (round 16) overlaps segment k's
+    # encode+CRC+write with segment k+1's compute behind the same
+    # atomic tmp+fsync+os.replace contract (the device→host pull stays
+    # synchronous — the donated carry is reused the moment the next
+    # segment launches); ``full_every`` (round 16) writes a FULL
+    # snapshot every Kth boundary and possession-churn deltas between
+    # them — resume reconstructs the chain bit-identically, an
+    # unusable chain (missing/corrupt base) is rejected by name.
     PATHS: ClassVar[tuple[str, ...]] = ("host",)
     CONTRACT: ClassVar[dict[str, object]] = {
         "directory": "build-time",
@@ -142,6 +153,8 @@ class CheckpointConfig:
         "keep": "build-time",
         "fingerprint": "build-time",
         "tag": "build-time",
+        "async_write": "build-time",
+        "full_every": "build-time",
     }
 
     def __post_init__(self):
@@ -161,6 +174,15 @@ class CheckpointConfig:
             raise ValueError(
                 f"CheckpointConfig: tag={self.tag!r} must match "
                 "[A-Za-z0-9_.-]+ (it is a filename prefix)")
+        if not isinstance(self.async_write, bool):
+            raise ValueError(
+                f"CheckpointConfig: async_write={self.async_write!r} "
+                "must be a bool (host-side writer mode, never traced)")
+        if int(self.full_every) < 1:
+            raise ValueError(
+                f"CheckpointConfig: full_every={self.full_every} must "
+                "be >= 1 (1 = every snapshot full; K > 1 = deltas "
+                "between every Kth full)")
 
 
 class CheckpointInterrupt(RuntimeError):
@@ -297,11 +319,13 @@ def _decode_payload(payload: bytes) -> dict[str, np.ndarray]:
 
 
 def snapshot_save(path: str, header: dict,
-                  by_key: dict[str, np.ndarray]) -> None:
+                  by_key: dict[str, np.ndarray]) -> dict:
     """Write one snapshot file atomically: JSON header line (magic,
     version, payload length + CRC32 appended here) then the npz
     payload.  tmp + ``os.replace`` — a crash mid-write leaves the
-    previous snapshot intact and at worst a ``.tmp`` orphan."""
+    previous snapshot intact and at worst a ``.tmp`` orphan.  Returns
+    the header as written (the delta chain links on its
+    ``payload_crc32``)."""
     payload = _encode_payload(by_key)
     h = dict(header)
     h["magic"] = MAGIC
@@ -315,6 +339,7 @@ def snapshot_save(path: str, header: dict,
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    return h
 
 
 def snapshot_read(path: str, expect_fingerprint: int | None = None
@@ -393,6 +418,201 @@ def latest_snapshot(directory: str, tag: str):
     return best
 
 
+# --------------------------------------------------------------------------
+# Delta snapshots (round 16)
+# --------------------------------------------------------------------------
+#
+# With ``CheckpointConfig.full_every = K > 1`` only every Kth boundary
+# writes the full carry; the segments between encode AGAINST the
+# previous snapshot, exploiting the sim's dominant churn pattern: the
+# possession words are monotone (new bits only) and the mesh/backoff
+# words move on heartbeat cadence, so most leaves change in a sparse
+# fraction of their lanes per segment.  Per leaf the encoder stores
+# (a) nothing when bit-identical to the base, (b) flat changed indices
+# + values when under half the lanes moved, (c) the full leaf
+# otherwise (or on any shape/dtype change — the concatenating aux
+# arrays grow every segment).  The header links the chain
+# (kind/base_segment/base_crc32/full_segment); reconstruction replays
+# it from the full snapshot and verifies every link's CRC, so resume
+# is bit-identical and a chain whose base is missing, corrupted, or
+# CRC-divergent is rejected by the name "unusable delta chain".
+
+_D_IDX = "~didx/"      # payload key prefix: flat changed indices
+_D_VAL = "~dval/"      # payload key prefix: values at those indices
+
+
+def _encode_delta(by_key: dict[str, np.ndarray],
+                  base: dict[str, np.ndarray]
+                  ) -> tuple[dict[str, np.ndarray], dict]:
+    """Encode ``by_key`` against ``base``: (payload dict, header bits).
+    Sparse entries ride as index/value pairs under the ``~didx/`` /
+    ``~dval/`` key prefixes (the npz packer encodes their dtypes as
+    usual); replaced and same keys are listed in the header."""
+    payload: dict[str, np.ndarray] = {}
+    same: list[str] = []
+    replaced: list[str] = []
+    sparse: list[str] = []
+    for k, arr in by_key.items():
+        b = base.get(k)
+        if (b is None or b.shape != arr.shape
+                or b.dtype != arr.dtype):
+            replaced.append(k)
+            payload[k] = arr
+            continue
+        av = arr.reshape(-1)
+        bv = b.reshape(-1)
+        # compare as raw bits so bf16/NaN payloads diff exactly
+        au = av.view(np.dtype(f"u{arr.dtype.itemsize}")) \
+            if arr.dtype.kind not in "biu?" else av
+        bu = bv.view(np.dtype(f"u{arr.dtype.itemsize}")) \
+            if arr.dtype.kind not in "biu?" else bv
+        idx = np.flatnonzero(au != bu)
+        if idx.size == 0:
+            same.append(k)
+        elif idx.size * 2 < av.size:
+            sparse.append(k)
+            payload[_D_IDX + k] = idx.astype(np.int64)
+            payload[_D_VAL + k] = av[idx]
+        else:
+            replaced.append(k)
+            payload[k] = arr
+    removed = sorted(set(base) - set(by_key))
+    bits = {"delta_same": sorted(same),
+            "delta_sparse": sorted(sparse),
+            "delta_replaced": sorted(replaced),
+            "delta_removed": removed}
+    return payload, bits
+
+
+def _apply_delta(base: dict[str, np.ndarray], header: dict,
+                 payload: dict[str, np.ndarray], path: str
+                 ) -> dict[str, np.ndarray]:
+    out: dict[str, np.ndarray] = {}
+    for k in header.get("delta_same", []):
+        if k not in base:
+            raise ValueError(
+                f"{path}: unusable delta chain — delta keeps leaf "
+                f"{k!r} the base snapshot does not carry")
+        out[k] = base[k]
+    for k in header.get("delta_replaced", []):
+        out[k] = payload[k]
+    for k in header.get("delta_sparse", []):
+        if k not in base:
+            raise ValueError(
+                f"{path}: unusable delta chain — delta patches leaf "
+                f"{k!r} the base snapshot does not carry")
+        arr = base[k].copy().reshape(-1)
+        idx = payload[_D_IDX + k]
+        arr[idx] = payload[_D_VAL + k]
+        out[k] = arr.reshape(base[k].shape)
+    return out
+
+
+def _chain_path(directory: str, tag: str, idx: int) -> str:
+    return os.path.join(directory, f"{tag}-seg{idx:06d}.ckpt")
+
+
+def read_snapshot_chain(directory: str, tag: str, idx: int,
+                        expect_fingerprint: int | None = None
+                        ) -> tuple[dict, dict[str, np.ndarray]]:
+    """Read snapshot ``idx``, reconstructing through its delta chain
+    when it is not a full snapshot.  Returns (header, by_key) exactly
+    as ``snapshot_read`` does for a full one; every failure along the
+    chain — a pruned/missing base, a corrupt link, a base whose CRC is
+    not the one the delta was encoded against — raises by the name
+    "unusable delta chain"."""
+    path = _chain_path(directory, tag, idx)
+    header, payload = snapshot_read(path, expect_fingerprint)
+    if header.get("kind", "full") == "full":
+        return header, payload
+    full_idx = header.get("full_segment")
+    if not isinstance(full_idx, int) or full_idx < 1 or full_idx > idx:
+        raise ValueError(
+            f"{path}: unusable delta chain — header names no valid "
+            f"full_segment (got {full_idx!r})")
+    chain = []     # [(path, header, payload)] from full to idx
+    for j in range(full_idx, idx + 1):
+        pj = _chain_path(directory, tag, j)
+        try:
+            hj, kj = snapshot_read(pj, expect_fingerprint)
+        except FileNotFoundError as e:
+            raise ValueError(
+                f"{path}: unusable delta chain — link {pj} is missing "
+                "(pruned with keep smaller than the chain, or deleted)"
+            ) from e
+        except ValueError as e:
+            raise ValueError(
+                f"{path}: unusable delta chain — link {pj} does not "
+                f"read back ({e})") from e
+        chain.append((pj, hj, kj))
+    p0, h0, by_key = chain[0]
+    if h0.get("kind", "full") != "full":
+        raise ValueError(
+            f"{path}: unusable delta chain — link {p0} should be the "
+            "chain's full snapshot but is itself a delta")
+    prev_crc = h0.get("payload_crc32")
+    for pj, hj, kj in chain[1:]:
+        if hj.get("kind", "full") != "delta":
+            raise ValueError(
+                f"{path}: unusable delta chain — link {pj} is not a "
+                "delta (mixed chains: was the directory reused?)")
+        if hj.get("base_crc32") != prev_crc:
+            raise ValueError(
+                f"{path}: unusable delta chain — link {pj} was "
+                "encoded against a different base snapshot than the "
+                "one on disk (CRC mismatch); refusing to resume")
+        by_key = _apply_delta(by_key, hj, kj, pj)
+        prev_crc = hj.get("payload_crc32")
+    return chain[-1][1], by_key
+
+
+# --------------------------------------------------------------------------
+# Async double-buffered writer (round 16)
+# --------------------------------------------------------------------------
+
+
+class _AsyncWriter:
+    """One in-flight snapshot write: ``submit`` joins the previous
+    write (double-buffer depth 1 — segment k's encode+CRC+write
+    overlaps segment k+1's device compute, never two writes), then
+    launches the job on a daemon thread.  A failed write re-raises on
+    the next submit or at ``drain`` — never silently dropped.  The
+    device→host pull happens BEFORE submit (the caller passes host
+    arrays): the donated carry is invalid the moment the next segment
+    launches."""
+
+    def __init__(self):
+        self._thread: threading.Thread | None = None
+        self._err: BaseException | None = None
+
+    def _run(self, job):
+        try:
+            job()
+        except BaseException as e:       # surfaced on next submit/drain
+            self._err = e
+
+    def _join(self):
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    def submit(self, job) -> None:
+        self._join()
+        self._thread = threading.Thread(target=self._run, args=(job,),
+                                        daemon=True)
+        self._thread.start()
+
+    def drain(self) -> None:
+        """Block until the in-flight write (if any) has hit the disk;
+        re-raise its failure.  The kill path calls this before raising
+        CheckpointInterrupt, so the interrupt's snapshot is always
+        durable by the time the exception escapes."""
+        self._join()
+
+
 def _restore_state(by_key: dict[str, np.ndarray], template,
                    shardings=None):
     """Rebuild the state pytree from packed ``state/...`` leaves using
@@ -462,18 +682,26 @@ def _run_segmented(run_segment, state, n_ticks: int,
     if n_ticks < 0:
         raise ValueError(f"n_ticks={n_ticks} must be >= 0")
     every = int(ckpt.every) or max(int(n_ticks), 1)
+    full_every = max(1, int(getattr(ckpt, "full_every", 1)))
     ticks_done = 0
     seg_idx = 0
     aux_acc: dict[str, np.ndarray] | None = None
     aux_treedef = None
     aux_keys: list[str] | None = None
+    # delta-chain bookkeeping: the previous boundary's FULL host dict
+    # (diff base) and its on-disk payload CRC (chain link)
+    prev_by_key: dict[str, np.ndarray] | None = None
+    last_crc: dict[str, object] = {"crc": None}
 
     found = latest_snapshot(ckpt.directory, ckpt.tag)
     if found is not None:
         seg_idx, path = found
-        header, by_key = snapshot_read(
-            path, expect_fingerprint=ckpt.fingerprint)
+        header, by_key = read_snapshot_chain(
+            ckpt.directory, ckpt.tag, seg_idx,
+            expect_fingerprint=ckpt.fingerprint)
         ticks_done = int(header["ticks_done"])
+        prev_by_key = dict(by_key)
+        last_crc["crc"] = header.get("payload_crc32")
         if ticks_done > n_ticks:
             raise ValueError(
                 f"{path}: snapshot is {ticks_done} ticks in but the "
@@ -492,6 +720,8 @@ def _run_segmented(run_segment, state, n_ticks: int,
                 "somewhere fresh to rerun")
 
     prev_handlers = install_kill_handlers()
+    writer = _AsyncWriter() if getattr(ckpt, "async_write", False) \
+        else None
     try:
         while ticks_done < n_ticks:
             seg = min(every, n_ticks - ticks_done)
@@ -521,6 +751,8 @@ def _run_segmented(run_segment, state, n_ticks: int,
                                 f"{ckpt.tag}-seg{seg_idx:06d}.ckpt")
             tick = jax.tree_util.tree_leaves(getattr(state, "tick",
                                                      ticks_done))
+            is_full = (full_every == 1 or prev_by_key is None
+                       or (seg_idx - 1) % full_every == 0)
             header = {
                 "fingerprint": int(ckpt.fingerprint),
                 "tick": int(np.asarray(tick[0]).reshape(-1)[0])
@@ -531,15 +763,54 @@ def _run_segmented(run_segment, state, n_ticks: int,
                 "every": int(ckpt.every),
                 "layout": _layout(state),
                 "tag": ckpt.tag,
+                "kind": "full" if is_full else "delta",
+                "full_every": full_every,
             }
+            if not is_full:
+                header["base_segment"] = seg_idx - 1
+                header["full_segment"] = (
+                    seg_idx - ((seg_idx - 1) % full_every))
+            # the device→host pull is synchronous on purpose: the
+            # donated carry is reused the moment the next segment
+            # launches, so only encode+CRC+write may overlap compute
             by_key = _leaf_dict(state, "state")
             if aux_acc is not None:
                 by_key.update(aux_acc)
-            snapshot_save(path, header, by_key)
-            _prune(ckpt, seg_idx)
+            base = prev_by_key
+            prev_by_key = by_key
+
+            def job(path=path, header=header, by_key=by_key,
+                    base=base, seg_idx=seg_idx):
+                if header["kind"] == "delta":
+                    payload, bits = _encode_delta(by_key, base)
+                    header.update(bits)
+                    # writes are serialized (depth-1 buffer), so the
+                    # previous boundary's CRC is final by the time
+                    # this job runs — async included
+                    header["base_crc32"] = last_crc["crc"]
+                    written = snapshot_save(path, header, payload)
+                else:
+                    written = snapshot_save(path, header, by_key)
+                last_crc["crc"] = written["payload_crc32"]
+                _prune(ckpt, seg_idx)
+
+            if writer is None:
+                job()
+            else:
+                writer.submit(job)
             if stop_requested() and ticks_done < n_ticks:
+                if writer is not None:
+                    writer.drain()
                 raise CheckpointInterrupt(path, ticks_done, n_ticks)
+        if writer is not None:
+            writer.drain()
     finally:
+        if writer is not None:
+            try:
+                writer.drain()
+            except Exception:
+                pass  # only reachable with a primary exception already
+                      # unwinding — the normal path drained above
         _restore_handlers(prev_handlers)
 
     if not has_aux:
@@ -554,13 +825,21 @@ def _run_segmented(run_segment, state, n_ticks: int,
 
 
 def _prune(ckpt: CheckpointConfig, newest: int) -> None:
+    """Delete snapshots older than the ``keep`` window — EXCEPT the
+    links the oldest kept snapshot's delta chain still needs: with
+    ``full_every = K > 1`` the floor drops from the oldest kept index
+    ``o`` to the full snapshot governing it, ``o - ((o-1) % K)``, so a
+    kept delta can always be reconstructed."""
     if not os.path.isdir(ckpt.directory):
         return
+    oldest = max(1, newest - int(ckpt.keep) + 1)
+    full_every = max(1, int(getattr(ckpt, "full_every", 1)))
+    floor = oldest - ((oldest - 1) % full_every)
     for name in os.listdir(ckpt.directory):
         if not name.startswith(ckpt.tag + "-seg"):
             continue
         m = _SEG_RE.search(name)
-        if m is not None and int(m.group(1)) <= newest - int(ckpt.keep):
+        if m is not None and int(m.group(1)) < floor:
             os.unlink(os.path.join(ckpt.directory, name))
 
 
@@ -609,6 +888,31 @@ def ckpt_gossip_run(params, state, n_ticks: int, step,
 
     def seg(s, n):
         return gossip_run(params, s, n, step), None
+    return _run_segmented(seg, state, n_ticks, ckpt)[0]
+
+
+def ckpt_gossip_run_fused(params, state, n_ticks: int, window,
+                          ckpt: CheckpointConfig):
+    """gossip_run_fused, segmented: each segment is a scan of fused
+    windows, so the segment boundary must land ON a window boundary —
+    a ``CheckpointConfig.every`` that would split a fused window is
+    refused by name (snapshots are taken between device dispatches;
+    there is no mid-window carry to save).  Everything else is the
+    ckpt_gossip_run contract: bit-identical resume, kill-safe."""
+    from ..models.gossipsub import gossip_run_fused, _check_fused_horizon
+
+    ticks_fused = int(getattr(window, "ticks_fused", 1))
+    every = int(ckpt.every) or int(n_ticks)
+    if every % ticks_fused != 0:
+        raise ValueError(
+            f"ckpt segment boundary mid-window: CheckpointConfig."
+            f"every={int(ckpt.every)} is not a multiple of "
+            f"ticks_fused={ticks_fused} — align the segment length to "
+            "the fused window")
+    _check_fused_horizon(n_ticks, ticks_fused)
+
+    def seg(s, n):
+        return gossip_run_fused(params, s, n, window), None
     return _run_segmented(seg, state, n_ticks, ckpt)[0]
 
 

@@ -572,3 +572,178 @@ def test_config_fingerprint_discriminates():
     assert a != ck.config_fingerprint(cfg2, sc)
     assert a != ck.config_fingerprint(
         cfg, gs.ScoreSimConfig(sybil_ihave_spam=True))
+
+
+# -- round 16: async double-buffered writer --------------------------------
+
+def test_async_write_bit_identity(tmp_path):
+    """async_write=True overlaps segment k's encode+CRC+write with
+    segment k+1's compute — pure pipelining, so the trajectory AND the
+    on-disk snapshots must equal the synchronous writer's."""
+    cfg, sc, build, steps = _armed()
+    s_ref = _armed_ref("combined")
+    params, state = build()
+    s = ck.ckpt_gossip_run(params, state, TICKS, steps["combined"],
+                           _ckpt(tmp_path, 3, async_write=True))
+    assert _trees_equal(s_ref, s)
+
+
+def test_async_kill_drains_inflight_buffer(tmp_path):
+    """The deferred-kill contract under the async writer: the engine
+    DRAINS the in-flight write before raising CheckpointInterrupt, so
+    the interrupt's named snapshot is durable (readable, correct
+    ticks_done) the moment the exception escapes."""
+    cfg, sc, build, steps = _armed()
+    ckc = _ckpt(tmp_path, 3, async_write=True)
+    ck.request_stop()
+    try:
+        params, state = build()
+        with pytest.raises(ck.CheckpointInterrupt) as ei:
+            ck.ckpt_gossip_run(params, state, TICKS,
+                               steps["combined"], ckc)
+        assert os.path.exists(ei.value.path)
+        header, _ = ck.snapshot_read(ei.value.path)
+        assert header["ticks_done"] == ei.value.ticks_done == 3
+    finally:
+        ck.clear_stop()
+    params, state = build()
+    s_res = ck.ckpt_gossip_run(params, state, TICKS,
+                               steps["combined"], ckc)
+    assert _trees_equal(_armed_ref("combined"), s_res)
+
+
+def test_async_write_failure_surfaces(tmp_path, monkeypatch):
+    """A background write failure is never dropped: it re-raises on
+    the next submit or at the drain."""
+    cfg, sc, build, steps = _armed()
+    params, state = build()
+
+    def boom(path, header, by_key):
+        raise OSError("disk gone mid-write")
+    monkeypatch.setattr(ck, "snapshot_save", boom)
+    with pytest.raises(OSError, match="disk gone mid-write"):
+        ck.ckpt_gossip_run(params, state, TICKS, steps["combined"],
+                           _ckpt(tmp_path, 3, async_write=True))
+
+
+# -- round 16: delta snapshots ---------------------------------------------
+
+def test_delta_chain_bit_identity_and_headers(tmp_path):
+    """full_every=3 over 4 segments: kinds are full/delta/delta/full,
+    the run matches the reference, and a resume that lands ON a delta
+    snapshot (tail full deleted) reconstructs the chain and still
+    reproduces the uninterrupted digest."""
+    cfg, sc, build, steps = _armed()
+    s_ref = _armed_ref("combined")
+    ckc = _ckpt(tmp_path, 3, keep=10, full_every=3)
+    params, state = build()
+    s = ck.ckpt_gossip_run(params, state, TICKS, steps["combined"],
+                           ckc)
+    assert _trees_equal(s_ref, s)
+    kinds = {}
+    for name in sorted(os.listdir(ckc.directory)):
+        h, _ = ck.snapshot_read(os.path.join(ckc.directory, name))
+        kinds[h["segment"]] = h["kind"]
+    assert kinds == {1: "full", 2: "delta", 3: "delta", 4: "full"}
+    os.unlink(os.path.join(ckc.directory, "sim-seg000004.ckpt"))
+    params, state = build()
+    s_res = ck.ckpt_gossip_run(params, state, TICKS,
+                               steps["combined"], ckc)
+    assert _trees_equal(s_ref, s_res)
+
+
+def test_delta_async_curve_aux_bit_identity(tmp_path):
+    """Deltas + async together, with per-tick aux riding the
+    snapshots: the concatenating curve blocks change shape every
+    segment (the full-store fallback inside the delta encoder), and
+    the resumed [TICKS, M] curve is bit-identical."""
+    cfg, sc, build, steps = _armed()
+    params, state = build()
+    s_ref, c_ref = gs.gossip_run_curve(params, state, TICKS,
+                                       steps["combined"], M)
+    ckc = _ckpt(tmp_path, 3, keep=10, full_every=2, async_write=True)
+    params, state = build()
+    s, c = ck.ckpt_gossip_run_curve(params, state, TICKS,
+                                    steps["combined"], ckc, M)
+    assert _trees_equal(s_ref, s)
+    assert np.array_equal(np.asarray(c_ref), np.asarray(c))
+
+
+def test_unusable_delta_chain_missing_full_rejected(tmp_path):
+    cfg, sc, build, steps = _armed()
+    ckc = _ckpt(tmp_path, 3, keep=10, full_every=4)
+    params, state = build()
+    ck.ckpt_gossip_run(params, state, TICKS, steps["combined"], ckc)
+    os.unlink(os.path.join(ckc.directory, "sim-seg000001.ckpt"))
+    params, state = build()
+    with pytest.raises(ValueError, match="unusable delta chain"):
+        ck.ckpt_gossip_run(params, state, TICKS, steps["combined"],
+                           ckc)
+
+
+def test_unusable_delta_chain_corrupt_link_rejected(tmp_path):
+    cfg, sc, build, steps = _armed()
+    ckc = _ckpt(tmp_path, 3, keep=10, full_every=4)
+    params, state = build()
+    ck.ckpt_gossip_run(params, state, TICKS, steps["combined"], ckc)
+    p2 = os.path.join(ckc.directory, "sim-seg000002.ckpt")
+    blob = bytearray(open(p2, "rb").read())
+    blob[-3] ^= 0x40
+    open(p2, "wb").write(bytes(blob))
+    params, state = build()
+    with pytest.raises(ValueError, match="unusable delta chain"):
+        ck.ckpt_gossip_run(params, state, TICKS, steps["combined"],
+                           ckc)
+
+
+def test_unusable_delta_chain_divergent_base_rejected(tmp_path):
+    """A base snapshot that is VALID on its own but is not the one the
+    next delta was encoded against (base_crc32 mismatch) poisons the
+    chain — rewriting seg2 self-consistently must not let seg3 resume
+    against the wrong bits."""
+    cfg, sc, build, steps = _armed()
+    ckc = _ckpt(tmp_path, 3, keep=10, full_every=4)
+    params, state = build()
+    ck.ckpt_gossip_run(params, state, TICKS, steps["combined"], ckc)
+    p2 = os.path.join(ckc.directory, "sim-seg000002.ckpt")
+    h2, k2 = ck.snapshot_read(p2)
+    key = sorted(k2)[0]
+    arr = k2[key].copy()
+    arr.reshape(-1).view(np.uint8)[0] ^= 1
+    k2[key] = arr
+    ck.snapshot_save(p2, h2, k2)
+    params, state = build()
+    with pytest.raises(ValueError, match="unusable delta chain"):
+        ck.ckpt_gossip_run(params, state, TICKS, steps["combined"],
+                           ckc)
+
+
+def test_prune_protects_delta_chain(tmp_path):
+    """keep=2 would retain only segments 3-4, but segment 3 is a delta
+    rooted at the segment-1 full — pruning floors at the governing
+    full so every kept snapshot stays reconstructable."""
+    cfg, sc, build, steps = _armed()
+    ckc = _ckpt(tmp_path, 3, keep=2, full_every=3)
+    params, state = build()
+    ck.ckpt_gossip_run(params, state, TICKS, steps["combined"], ckc)
+    names = sorted(os.listdir(ckc.directory))
+    assert names == [f"sim-seg{i:06d}.ckpt" for i in (1, 2, 3, 4)]
+    h3, _ = ck.read_snapshot_chain(ckc.directory, "sim", 3)
+    assert h3["ticks_done"] == 9
+
+
+def test_full_every_one_headers_stay_full(tmp_path):
+    """The default full_every=1 never writes deltas — back-compat with
+    every pre-round-16 snapshot consumer."""
+    cfg, sc, build, steps = _armed()
+    ckc = _ckpt(tmp_path, 5, keep=10)
+    params, state = build()
+    ck.ckpt_gossip_run(params, state, TICKS, steps["combined"], ckc)
+    for name in sorted(os.listdir(ckc.directory)):
+        h, _ = ck.snapshot_read(os.path.join(ckc.directory, name))
+        assert h["kind"] == "full"
+
+
+def test_full_every_validated():
+    with pytest.raises(ValueError, match="full_every"):
+        ck.CheckpointConfig(directory="x", full_every=0)

@@ -1,0 +1,398 @@
+"""Round-16 tick-resident fused kernel (ops/pallas/receive.py
+make_fused_gossip_update + models/gossipsub.py make_fused_window): a
+window of T ticks folded into ONE pallas_call with the per-shard carry
+resident in VMEM across the sequential ``(ticks,)`` grid is
+BIT-IDENTICAL to T per-tick steps — against the per-tick kernel AND
+the XLA step — for T in {2, 4, 8}, with telemetry frames, fault
+schedules, and cold-restart rejoin armed; the sharded window falls
+back BY NAME to the scan-of-steps form and stays bit-identical on the
+virtual mesh at D in {2, 4}; and every configuration where residency
+is impossible (scored carry, delay lines, unpadded layout, carry past
+the VMEM budget) is refused by a named ``kernel_ticks_fused:`` reason
+that reports the working-set bytes.
+
+Identity is exact array equality over the full state pytree plus the
+delivered words and every telemetry-frame leaf — the same contract the
+round-9 kernel parity and round-14 sharding tests hold."""
+
+import functools
+
+import numpy as np
+import pytest
+
+import go_libp2p_pubsub_tpu.models.gossipsub as gs
+import go_libp2p_pubsub_tpu.models.telemetry as tl
+from go_libp2p_pubsub_tpu.models.delays import DelayConfig
+from go_libp2p_pubsub_tpu.models.faults import FaultSchedule
+
+# FUSED_ALIGN: the resident lane rolls need n_true % 1024 == 0 and
+# n_true == n_pad, so the whole matrix runs at the smallest legal ring
+N, T_TOP, M, C, BLOCK, TICKS = 1024, 4, 8, 16, 1024, 8
+
+
+def teardown_module(module):
+    import jax
+    _sim.cache_clear()
+    _kernel_ref.cache_clear()
+    _tel_ref.cache_clear()
+    _fault_sched.cache_clear()
+    jax.clear_caches()
+
+
+@functools.lru_cache(maxsize=None)
+def _sim():
+    rng = np.random.default_rng(0)
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(T_TOP, C, N, seed=0),
+        n_topics=T_TOP)
+    subs = np.zeros((N, T_TOP), dtype=bool)
+    subs[np.arange(N), np.arange(N) % T_TOP] = True
+    topic = rng.integers(0, T_TOP, M)
+    origin = rng.integers(0, N // T_TOP, M) * T_TOP + topic
+    tick0 = np.sort(rng.integers(0, 6, M)).astype(np.int32)
+    return cfg, subs, topic, origin, tick0
+
+
+@functools.lru_cache(maxsize=None)
+def _fault_sched(cold=False):
+    rng = np.random.default_rng(7)
+    downs = []
+    for p in rng.choice(N, 40, replace=False):
+        s0 = int(rng.integers(0, TICKS - 4))
+        downs.append((int(p), s0, s0 + int(rng.integers(2, 4))))
+    return FaultSchedule(
+        n_peers=N, horizon=TICKS, down_intervals=tuple(sorted(downs)),
+        drop_prob=0.05, seed=3, cold_restart=cold)
+
+
+def _build(padded=True, **kw):
+    cfg, subs, topic, origin, tick0 = _sim()
+    pad = {"pad_to_block": BLOCK} if padded else {}
+    params, state = gs.make_gossip_sim(cfg, subs, topic, origin,
+                                       tick0, **pad, **kw)
+    return cfg, params, state
+
+
+def _window(cfg, Tw, tel=None, **kw):
+    return gs.make_fused_window(
+        cfg, None, ticks_fused=Tw, receive_block=BLOCK,
+        receive_interpret=True, telemetry=tel, on_refusal="raise",
+        **kw)
+
+
+def _run_steps(cfg, params, state, n_ticks, tel=None, kernel=True):
+    """Reference trajectory: n_ticks per-tick steps (kernel or XLA),
+    returning (state, delivered [n_ticks, W, N], frames|None)."""
+    import jax.numpy as jnp
+    step = gs.make_gossip_step(
+        cfg, None, receive_interpret=True, receive_block=BLOCK,
+        use_pallas_receive=kernel, telemetry=tel)
+    s, dl, fr = state, [], []
+    for _ in range(n_ticks):
+        out = step(params, s)
+        s = out[0]
+        dl.append(out[1])
+        if tel is not None:
+            fr.append(out[2])
+    return s, jnp.stack(dl), fr
+
+
+def _run_windows(cfg, params, state, n_ticks, Tw, tel=None):
+    import jax
+    import jax.numpy as jnp
+    win = _window(cfg, Tw, tel=tel)
+    assert win.capability(params, state) is None
+    s, dl, frs = state, [], []
+    for _ in range(n_ticks // Tw):
+        out = win(params, s)
+        s = out[0]
+        dl.append(out[1])
+        if tel is not None:
+            frs.append(out[2])
+    frames = None
+    if tel is not None:
+        frames = jax.tree_util.tree_map(
+            lambda *xs: jnp.concatenate(xs), *frs)
+    return s, jnp.concatenate(dl), frames
+
+
+def _trees_equal(a, b):
+    import jax
+    fa, _ = jax.tree_util.tree_flatten(
+        jax.tree_util.tree_map(np.asarray, a))
+    fb, _ = jax.tree_util.tree_flatten(
+        jax.tree_util.tree_map(np.asarray, b))
+    assert len(fa) == len(fb)
+    return all(np.array_equal(x, y) for x, y in zip(fa, fb))
+
+
+def _state_equal(a, b):
+    # compare state-by-field so a failure names the diverging leaf
+    for name in ("have", "recent", "mesh", "fanout", "last_pub",
+                 "backoff", "first_tick"):
+        va, vb = getattr(a, name), getattr(b, name)
+        if va is None or vb is None:
+            assert va is None and vb is None, name
+            continue
+        assert np.array_equal(np.asarray(va), np.asarray(vb)), name
+    for i, (ga, gb) in enumerate(zip(a.gates or (), b.gates or ())):
+        assert np.array_equal(np.asarray(ga), np.asarray(gb)), \
+            f"gates[{i}]"
+    return True
+
+
+# -- references (one compile+run each, shared across T values) -------------
+
+@functools.lru_cache(maxsize=None)
+def _kernel_ref(faults=False, cold=False):
+    kw = {}
+    if faults or cold:
+        kw["fault_schedule"] = _fault_sched(cold)
+    cfg, params, state = _build(**kw)
+    s, d, _ = _run_steps(cfg, params, state, TICKS)
+    return s, np.asarray(d)
+
+
+@functools.lru_cache(maxsize=None)
+def _tel_ref(faults=False):
+    tel = tl.TelemetryConfig(counters=True, wire=True, mesh=True,
+                             degree_hist=True, latency_hist=True,
+                             faults=True)
+    kw = {"fault_schedule": _fault_sched()} if faults else {}
+    cfg, params, state = _build(**kw)
+    s, d, fr = _run_steps(cfg, params, state, TICKS, tel=tel)
+    import jax
+    import jax.numpy as jnp
+    frames = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *fr)
+    return s, np.asarray(d), frames
+
+
+# -- resident-path parity: fused T ticks == T per-tick steps ---------------
+
+@pytest.mark.parametrize("Tw", [2, 4, 8])
+def test_fused_matches_per_tick_kernel(Tw):
+    s_ref, d_ref = _kernel_ref()
+    cfg, params, state = _build()
+    s, d, _ = _run_windows(cfg, params, state, TICKS, Tw)
+    assert np.array_equal(np.asarray(d), d_ref)
+    assert _state_equal(s, s_ref)
+
+
+def test_fused_matches_xla_step():
+    """The XLA step refuses padded layouts, but at N % BLOCK == 0 the
+    padded build IS the unpadded build (pad adds nothing) — so the
+    unpadded twin's XLA trajectory is the same-scenario reference."""
+    cfg, params, state = _build(padded=False)
+    s_x, d_x, _ = _run_steps(cfg, params, state, TICKS, kernel=False)
+    s_ref, d_ref = _kernel_ref()
+    assert np.array_equal(np.asarray(d_x), d_ref)
+    assert _state_equal(s_x, s_ref)
+
+
+@pytest.mark.parametrize("Tw", [2, 8])
+def test_fused_telemetry_frames_bit_identical(Tw):
+    tel = tl.TelemetryConfig(counters=True, wire=True, mesh=True,
+                             degree_hist=True, latency_hist=True,
+                             faults=True)
+    s_ref, d_ref, fr_ref = _tel_ref()
+    cfg, params, state = _build()
+    s, d, fr = _run_windows(cfg, params, state, TICKS, Tw, tel=tel)
+    assert np.array_equal(np.asarray(d), d_ref)
+    assert _state_equal(s, s_ref)
+    assert _trees_equal(fr, fr_ref)
+
+
+@pytest.mark.parametrize("Tw", [4])
+def test_fused_with_faults(Tw):
+    s_ref, d_ref = _kernel_ref(faults=True)
+    cfg, params, state = _build(fault_schedule=_fault_sched())
+    s, d, _ = _run_windows(cfg, params, state, TICKS, Tw)
+    assert np.array_equal(np.asarray(d), d_ref)
+    assert _state_equal(s, s_ref)
+
+
+def test_fused_with_faults_and_telemetry():
+    tel = tl.TelemetryConfig(counters=True, wire=True, mesh=True,
+                             degree_hist=True, latency_hist=True,
+                             faults=True)
+    s_ref, d_ref, fr_ref = _tel_ref(faults=True)
+    cfg, params, state = _build(fault_schedule=_fault_sched())
+    s, d, fr = _run_windows(cfg, params, state, TICKS, 4, tel=tel)
+    assert np.array_equal(np.asarray(d), d_ref)
+    assert _state_equal(s, s_ref)
+    assert _trees_equal(fr, fr_ref)
+
+
+def test_fused_cold_restart_rejoin():
+    s_ref, d_ref = _kernel_ref(faults=True, cold=True)
+    cfg, params, state = _build(fault_schedule=_fault_sched(True))
+    s, d, _ = _run_windows(cfg, params, state, TICKS, 4)
+    assert np.array_equal(np.asarray(d), d_ref)
+    assert _state_equal(s, s_ref)
+
+
+# -- fused runners ---------------------------------------------------------
+
+def test_gossip_run_fused_matches_run():
+    cfg, params, state = _build()
+    step = gs.make_gossip_step(cfg, None, receive_interpret=True,
+                               receive_block=BLOCK,
+                               use_pallas_receive=True)
+    s_ref = gs.gossip_run(params, state, TICKS, step)
+    cfg, params, state = _build()
+    win = _window(cfg, 4)
+    s = gs.gossip_run_fused(params, state, TICKS, win)
+    assert _state_equal(s, s_ref)
+
+
+def test_gossip_run_curve_fused_matches_curve():
+    cfg, params, state = _build()
+    step = gs.make_gossip_step(cfg, None, receive_interpret=True,
+                               receive_block=BLOCK,
+                               use_pallas_receive=True)
+    s_ref, c_ref = gs.gossip_run_curve(params, state, TICKS, step, M)
+    cfg, params, state = _build()
+    win = _window(cfg, 4)
+    s, c = gs.gossip_run_curve_fused(params, state, TICKS, win, M)
+    assert np.array_equal(np.asarray(c), np.asarray(c_ref))
+    assert _state_equal(s, s_ref)
+
+
+def test_gossip_run_frames_fused_matches_telemetry_run():
+    tel = tl.TelemetryConfig(counters=True, wire=True, mesh=True,
+                             degree_hist=True, latency_hist=True,
+                             faults=True)
+    s_ref, _d, fr_ref = _tel_ref()
+    cfg, params, state = _build()
+    win = _window(cfg, 4, tel=tel)
+    s, fr = gs.gossip_run_frames_fused(params, state, TICKS, win)
+    assert _state_equal(s, s_ref)
+    assert _trees_equal(fr, fr_ref)
+
+
+def test_fused_horizon_not_divisible_raises_by_name():
+    cfg, params, state = _build()
+    win = _window(cfg, 4)
+    with pytest.raises(ValueError,
+                       match="scan horizon not divisible by the fused "
+                             "window"):
+        gs.gossip_run_fused(params, state, TICKS - 2, win)
+
+
+def test_fused_window_length_validated():
+    cfg, _, _ = _build()
+    with pytest.raises(ValueError, match="ticks_fused must be >= 1"):
+        gs.make_fused_window(cfg, ticks_fused=0)
+
+
+# -- checkpoint composition: segment boundaries align to the window --------
+
+def test_ckpt_fused_misaligned_segment_refused_by_name(tmp_path):
+    from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
+
+    cfg, params, state = _build()
+    win = _window(cfg, 4)
+    ckc = ck.CheckpointConfig(directory=str(tmp_path / "snaps"),
+                              every=6)
+    with pytest.raises(ValueError,
+                       match="ckpt segment boundary mid-window"):
+        ck.ckpt_gossip_run_fused(params, state, TICKS, win, ckc)
+
+
+def test_ckpt_fused_aligned_bit_identity(tmp_path):
+    """Aligned segments (every % ticks_fused == 0) compose: the
+    segmented fused run — async writer and delta snapshots on — equals
+    the per-tick kernel reference, resident path engaged."""
+    from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
+
+    s_ref, _d = _kernel_ref()
+    cfg, params, state = _build()
+    win = _window(cfg, 4)
+    assert win.capability(params, state) is None
+    ckc = ck.CheckpointConfig(directory=str(tmp_path / "snaps"),
+                              every=4, keep=10, async_write=True,
+                              full_every=2)
+    s = ck.ckpt_gossip_run_fused(params, state, TICKS, win, ckc)
+    assert _state_equal(s, s_ref)
+
+
+# -- sharded dispatch: named fallback, bit-identical at D in {2, 4} --------
+
+@pytest.mark.parametrize("D", [2, 4])
+def test_sharded_window_falls_back_by_name_and_matches(D):
+    from go_libp2p_pubsub_tpu.parallel import mesh as pm
+    from go_libp2p_pubsub_tpu.parallel import sharded as ps
+
+    # the ring-halo kernel needs n_true % (D*block) == 0: block 256
+    # keeps one plan valid for D in {2, 4} at N=1024
+    cfg, params, state = _build()
+    step = gs.make_gossip_step(cfg, None, receive_interpret=True,
+                               receive_block=256)
+    s_ref = gs.gossip_run(params, state, 8, step)
+
+    mesh = pm.make_mesh(D)
+    cfg, params, state = _build()
+    params_s, state_s, _sh = ps.shard_sim(params, state, mesh, N)
+    win = gs.make_fused_window(cfg, None, ticks_fused=4,
+                               receive_block=256,
+                               receive_interpret=True,
+                               shard_mesh=mesh)
+    reason = win.capability(params_s, state_s)
+    assert reason is not None and "kernel_ticks_fused" in reason
+    assert "shard_map" in reason     # the named sharded fallback
+    s = state_s
+    for _ in range(2):
+        s = win(params_s, s)[0]
+    assert _state_equal(s, s_ref)
+
+
+# -- named refusals: every impossible residency reports WHY ---------------
+
+def test_refusal_unpadded_layout():
+    cfg, params, state = _build(padded=False)
+    r = gs.kernel_ticks_fused_capability(cfg, None, params, state, 4)
+    assert r is not None and "padded pallas layout" in r
+
+
+def test_refusal_scored_reports_accumulator_bytes():
+    sc = gs.ScoreSimConfig()
+    cfg, subs, topic, origin, tick0 = _sim()
+    params, state = gs.make_gossip_sim(cfg, subs, topic, origin,
+                                       tick0, pad_to_block=BLOCK,
+                                       score_cfg=sc)
+    r = gs.kernel_ticks_fused_capability(cfg, sc, params, state, 4)
+    assert r is not None and "scored configs stay per-tick" in r
+    assert "bytes" in r
+
+
+def test_refusal_delays_report_line_bytes():
+    cfg, params, state = _build(
+        delays=DelayConfig(base=2, jitter=1, k_slots=4))
+    r = gs.kernel_ticks_fused_capability(cfg, None, params, state, 4)
+    assert r is not None and "delay-armed sims stay per-tick" in r
+    assert "bytes" in r
+
+
+def test_refusal_vmem_budget_reports_working_set():
+    cfg, params, state = _build()
+    r = gs.kernel_ticks_fused_capability(
+        cfg, None, params, state, 8, vmem_budget_bytes=1 << 16)
+    assert r is not None
+    assert "resident carry past the VMEM budget" in r
+    assert "working set" in r and "bytes" in r
+    # and the full budget accepts the same config
+    assert gs.kernel_ticks_fused_capability(
+        cfg, None, params, state, 8) is None
+
+
+def test_refusal_fallback_dispatch_still_runs():
+    """on_refusal="fallback" (the default): a refused config silently
+    takes the scan-of-steps window and stays bit-identical."""
+    cfg, params, state = _build(padded=False)
+    step = gs.make_gossip_step(cfg, None)
+    s_ref = gs.gossip_run(params, state, 4, step)
+    cfg, params, state = _build(padded=False)
+    win = gs.make_fused_window(cfg, None, ticks_fused=4)
+    assert win.capability(params, state) is not None
+    s = win(params, state)[0]
+    assert _state_equal(s, s_ref)

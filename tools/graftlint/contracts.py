@@ -993,6 +993,142 @@ def _probe_delays_kernel_iwant():
     jax.eval_shape(step, params, state)   # must raise
 
 
+def _fused_gossip_build(n=N, pad=KERNEL_BLOCK, **kw):
+    """A gossip build shaped for the fused-window capability probes:
+    padded pallas layout by default, arming overrides via kw."""
+    import numpy as np
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(T, C, n, seed=1), n_topics=T,
+        d=3, d_lo=2, d_hi=6, d_score=2, d_out=1, d_lazy=2,
+        backoff_ticks=8)
+    subs = np.zeros((n, T), dtype=bool)
+    subs[np.arange(n), np.arange(n) % T] = True
+    rng = np.random.default_rng(0)
+    topic = rng.integers(0, T, M)
+    origin = rng.integers(0, n // T, M) * T + topic
+    ticks = np.zeros(M, dtype=np.int32)
+    if pad is not None:
+        kw["pad_to_block"] = pad
+    params, state = gs.make_gossip_sim(cfg, subs, topic, origin,
+                                       ticks, seed=0, **kw)
+    return gs, cfg, params, state
+
+
+def _probe_fused_unpadded():
+    """The resident window refuses XLA-layout sims by name: residency
+    is a property of the padded pallas carry."""
+    gs, cfg, params, state = _fused_gossip_build(pad=None)
+    win = gs.make_fused_window(cfg, None, ticks_fused=2,
+                               receive_block=KERNEL_BLOCK,
+                               receive_interpret=True,
+                               on_refusal="raise")
+    win(params, state)   # must raise
+
+
+def _probe_fused_scored():
+    """Scored configs stay per-tick — refused with the accumulator
+    bytes in the message, never silently slower-but-wrong."""
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    _, cfg, params, state = _fused_gossip_build(
+        score_cfg=gs.ScoreSimConfig())
+    win = gs.make_fused_window(cfg, gs.ScoreSimConfig(), ticks_fused=2,
+                               receive_block=KERNEL_BLOCK,
+                               receive_interpret=True,
+                               on_refusal="raise")
+    win(params, state)   # must raise
+
+
+def _probe_fused_delays():
+    """Delay-armed sims stay per-tick — the K-slot lines are refused
+    with their resident-carry bytes reported."""
+    from go_libp2p_pubsub_tpu.models.delays import DelayConfig
+    gs, cfg, params, state = _fused_gossip_build(
+        delays=DelayConfig(base=1, jitter=1, k_slots=4))
+    win = gs.make_fused_window(cfg, None, ticks_fused=2,
+                               receive_block=KERNEL_BLOCK,
+                               receive_interpret=True,
+                               on_refusal="raise")
+    win(params, state)   # must raise
+
+
+def _probe_fused_sharded():
+    """The sharded dispatch keeps the per-tick kernel (ring-halo
+    leaves VMEM every tick) — the fused window refuses by name."""
+    from go_libp2p_pubsub_tpu.parallel import mesh as pm
+    import jax
+    gs, cfg, params, state = _fused_gossip_build()
+    mesh = pm.make_mesh(devices=jax.devices("cpu")[:1])
+    win = gs.make_fused_window(cfg, None, ticks_fused=2,
+                               receive_block=KERNEL_BLOCK,
+                               receive_interpret=True,
+                               shard_mesh=mesh, on_refusal="raise")
+    win(params, state)   # must raise
+
+
+def _probe_fused_vmem_budget():
+    """The byte-bound refusal: a carry past the VMEM budget is
+    refused with the working set in the message (an aligned build
+    that the default budget accepts, squeezed by a tiny budget)."""
+    gs, cfg, params, state = _fused_gossip_build(n=KERNEL_BLOCK)
+    win = gs.make_fused_window(cfg, None, ticks_fused=2,
+                               receive_block=KERNEL_BLOCK,
+                               receive_interpret=True,
+                               vmem_budget_bytes=1 << 16,
+                               on_refusal="raise")
+    win(params, state)   # must raise
+
+
+def _probe_fused_horizon():
+    """gossip_run_fused refuses a horizon the window does not divide
+    by name at trace time — no partial windows."""
+    gs, cfg, params, state = _fused_gossip_build(n=KERNEL_BLOCK)
+    win = gs.make_fused_window(cfg, None, ticks_fused=2,
+                               receive_block=KERNEL_BLOCK,
+                               receive_interpret=True,
+                               on_refusal="raise")
+    gs.gossip_run_fused(params, state, 3, win)   # must raise
+
+
+def _probe_fused_ckpt_midwindow():
+    """ckpt_gossip_run_fused refuses a segment length that would split
+    a fused window by name — snapshots land between dispatches only."""
+    from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
+    gs, cfg, params, state = _fused_gossip_build(n=KERNEL_BLOCK)
+    win = gs.make_fused_window(cfg, None, ticks_fused=4,
+                               receive_block=KERNEL_BLOCK,
+                               receive_interpret=True,
+                               on_refusal="raise")
+    ck.ckpt_gossip_run_fused(
+        params, state, 8, win,
+        ck.CheckpointConfig(directory="/tmp/x", every=6))  # must raise
+
+
+def _probe_unusable_delta_chain():
+    """read_snapshot_chain rejects a chain whose full root is gone by
+    the name "unusable delta chain" — a delta must never resume
+    against the wrong (or missing) base."""
+    import os
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
+    d = tempfile.mkdtemp(prefix="graftlint_delta_")
+    try:
+        ck.snapshot_save(
+            os.path.join(d, "probe-seg000002.ckpt"),
+            {"fingerprint": 0, "kind": "delta", "base_segment": 1,
+             "full_segment": 1, "base_crc32": 0,
+             "delta_same": [], "delta_sparse": [],
+             "delta_replaced": ["state/x"], "delta_removed": []},
+            {"state/x": np.zeros(3, np.int32)})
+        ck.read_snapshot_chain(d, "probe", 2)   # must raise
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 _PROBE_REFUSALS = {
     # round 13: the rpc_probe[paired-topics] refusal is LIFTED (the
     # probe captures per-slot masks + slot-split payload; see
@@ -1030,6 +1166,43 @@ _PROBE_REFUSALS = {
     # and the trajectory stays bit-identical (tests/test_sharded.py).
     # delays[telemetry-counters] above is RE-PINNED: it is a property
     # of delay mode itself (per-class delay lines), not of sharding.
+    # round 16: the tick-resident fused window's capability gaps —
+    # every kernel_ticks_fused refusal named (the byte-bound ones
+    # report the working set), plus the two composition refusals
+    # (indivisible horizon, mid-window segment boundary) and the
+    # delta-chain resume reject.  All ValueError: invalid dispatch,
+    # not a capability gap the caller can't see coming.
+    "kernel_ticks_fused[unpadded]":
+        (_probe_fused_unpadded,
+         r"kernel_ticks_fused: needs the padded pallas layout",
+         ValueError),
+    "kernel_ticks_fused[scored]":
+        (_probe_fused_scored,
+         r"kernel_ticks_fused: scored configs stay per-tick — "
+         r"the \[C, N\] score accumulators add \d+ bytes",
+         ValueError),
+    "kernel_ticks_fused[delays]":
+        (_probe_fused_delays,
+         r"kernel_ticks_fused: delay-armed sims stay per-tick — "
+         r"the K-slot delay lines add \d+ bytes", ValueError),
+    "kernel_ticks_fused[sharded]":
+        (_probe_fused_sharded,
+         r"kernel_ticks_fused: the sharded dispatch keeps the "
+         r"per-tick kernel", ValueError),
+    "kernel_ticks_fused[vmem-budget]":
+        (_probe_fused_vmem_budget,
+         r"kernel_ticks_fused: resident carry past the VMEM budget "
+         r"— working set \d+ bytes", ValueError),
+    "kernel_ticks_fused[horizon]":
+        (_probe_fused_horizon,
+         r"scan horizon not divisible by the fused window",
+         ValueError),
+    "kernel_ticks_fused[ckpt-mid-window]":
+        (_probe_fused_ckpt_midwindow,
+         r"ckpt segment boundary mid-window", ValueError),
+    "checkpoint[unusable-delta-chain]":
+        (_probe_unusable_delta_chain,
+         r"unusable delta chain — link .* is missing", ValueError),
 }
 
 
@@ -1095,6 +1268,18 @@ def _reject_ckpt_tag():
     CheckpointConfig(directory="/tmp/x", tag="no spaces!")  # must raise
 
 
+def _reject_ckpt_async_write():
+    from go_libp2p_pubsub_tpu.parallel.checkpoint import (
+        CheckpointConfig)
+    CheckpointConfig(directory="/tmp/x", async_write=1)   # must raise
+
+
+def _reject_ckpt_full_every():
+    from go_libp2p_pubsub_tpu.parallel.checkpoint import (
+        CheckpointConfig)
+    CheckpointConfig(directory="/tmp/x", full_every=0)   # must raise
+
+
 def _reject_ckpt_fingerprint():
     """The fingerprint field's contract is the RESUME-side reject: a
     snapshot written under fingerprint A must be refused by name when
@@ -1140,6 +1325,12 @@ _BUILD_TIME = {
     ("CheckpointConfig", "fingerprint"):
         (_reject_ckpt_fingerprint,
          r"snapshot config fingerprint .* refusing to resume"),
+    # round 16: the async double-buffer switch (bool-typed by name —
+    # host-side writer mode, never traced) and the delta cadence
+    ("CheckpointConfig", "async_write"):
+        (_reject_ckpt_async_write, r"async_write=1 must be a bool"),
+    ("CheckpointConfig", "full_every"):
+        (_reject_ckpt_full_every, r"full_every=0 must be >= 1"),
 }
 
 

@@ -189,6 +189,19 @@ def declared_matrix() -> list[dict]:
                         faults=True, batched=batched, variant="ckpt"))
     out.append(dict(sim="floodsub", split=False, telemetry=False,
                     faults=True, batched=False, variant="ckpt"))
+    # round-16 tick-resident fused window cases: the resident
+    # multi-tick pallas dispatch (whole carry donated into the
+    # windowed scan, no 64-bit avals anywhere in the fused kernel's
+    # seeding/tick arithmetic) plus the sharded fused FALLBACK, which
+    # must keep the per-tick kernel's shard_map/ppermute boundary
+    # collectives — losing them would mean the fallback silently
+    # stopped being the round-14 dispatch
+    for faults in (False, True):
+        out.append(dict(sim="gossipsub", split=False, telemetry=False,
+                        faults=faults, batched=False, variant="fused"))
+    out.append(dict(sim="gossipsub", split=False, telemetry=False,
+                    faults=True, batched=False,
+                    variant="fused-sharded"))
     return out
 
 
@@ -550,6 +563,56 @@ def build_cases() -> list[AuditCase]:
                 else gs.gossip_run
             args, statics = (params, state, TICKS, step), (2, 3)
 
+        elif variant in ("fused", "fused-sharded"):
+            # round-16 tick-resident fused window, traced at the fused
+            # alignment shape (n_true == n_pad, n % 1024 == 0 — the
+            # shared N=80 can never take the resident path).  The
+            # resident case must donate the whole carry into the
+            # windowed dispatch with no 64-bit avals in the in-kernel
+            # tick/seed arithmetic; the sharded case must REFUSE by
+            # name and fall back to the round-14 shard_map dispatch,
+            # whose halo ppermutes must still be in the jaxpr.
+            import numpy as np
+            from go_libp2p_pubsub_tpu.models.faults import FaultSchedule
+            sharded_f = variant == "fused-sharded"
+            if sharded_f:
+                from go_libp2p_pubsub_tpu.parallel import mesh as pmesh
+                mesh_f = pmesh.make_mesh(devices=jax.devices("cpu")[:2])
+                D_f = mesh_f.shape[pmesh.PEER_AXIS]
+            else:
+                mesh_f, D_f = None, 1
+            kb = 1024            # contracts.KERNEL_BLOCK == FUSED_ALIGN
+            n_f = D_f * kb
+            cfg = gs.GossipSimConfig(
+                offsets=gs.make_gossip_offsets(T, C, n_f, seed=1),
+                n_topics=T, d=3, d_lo=2, d_hi=6, d_score=2, d_out=1,
+                d_lazy=2, backoff_ticks=8)
+            subs_f = np.zeros((n_f, T), dtype=bool)
+            subs_f[np.arange(n_f), np.arange(n_f) % T] = True
+            rng = np.random.default_rng(0)
+            topic_f = rng.integers(0, T, M)
+            origin_f = rng.integers(0, n_f // T, M) * T + topic_f
+            ticks_f = np.zeros(M, dtype=np.int32)
+            sched = (FaultSchedule(
+                n_peers=n_f, horizon=4,
+                down_intervals=((0, 0, 2), (3, 1, 3)),
+                drop_prob=0.1, seed=0) if combo["faults"] else None)
+            params, state = gs.make_gossip_sim(
+                cfg, subs_f, topic_f, origin_f, ticks_f, seed=0,
+                fault_schedule=sched, pad_to_block=kb)
+            window = gs.make_fused_window(
+                cfg, None, ticks_fused=2, receive_block=kb,
+                receive_interpret=True, shard_mesh=mesh_f,
+                on_refusal="fallback" if sharded_f else "raise")
+            reason = window.capability(params, state)
+            if sharded_f:
+                assert reason is not None and "shard_map" in reason, \
+                    reason
+            else:
+                assert reason is None, reason
+            runner = gs.gossip_run_fused
+            args, statics = (params, state, 4, window), (2, 3)
+
         elif variant == "ckpt":
             # round-15 segmented checkpoint runners: trace the engine's
             # dispatch table at the 2-segment split horizon with the
@@ -706,6 +769,10 @@ def build_cases() -> list[AuditCase]:
             # the lifted delay path needs NO halo — but the dispatch
             # must still be the shard_map one
             case.expect_primitives = ("shard_map",)
+        elif variant == "fused-sharded":
+            # the named fallback must still be the round-14 streamed
+            # shard_map dispatch, halo ppermutes included
+            case.expect_primitives = ("shard_map", "ppermute")
         # late-binding via default args: the thunks must be pure
         # trace/lower closures over THIS combo's objects
         case.trace = (lambda r=runner, a=args, s=statics:

@@ -306,6 +306,30 @@ elif [ "$ckrc" -ne 0 ]; then
   sync_log
   exit 10
 fi
+# 4i. tick-resident megakernel (round 16): the fused T=8 window —
+# digest BIT-IDENTICAL to the per-tick kernel, ONE compiled
+# executable across windows, and the analytic per-tick HBM ledger
+# (>= 5x reduction at every fitting >= 100k-peer point) — then the
+# residentstat gate over the artifact the bench just wrote, vs the
+# committed RESIDENT_r16.json
+run s4i 2700 python bench_suite.py gossipsub_resident
+echo "=== residentstat --check gate ===" | tee -a "$log"
+env JAX_PLATFORMS=cpu python tools/residentstat.py \
+    /tmp/gossipsub_resident.json \
+    --check RESIDENT_r16.json 2>&1 | tee -a "$log"
+rsrc=${PIPESTATUS[0]}
+if [ "$rsrc" -eq 2 ]; then
+  echo "!! residentstat gate failed — unusable resident artifact" \
+      "(bench crashed, or no byte ledger?)" | tee -a "$log"
+  sync_log
+  exit 11
+elif [ "$rsrc" -ne 0 ]; then
+  echo "!! residentstat gate failed — fused trajectory diverged from" \
+      "the per-tick kernel, a window re-traced, or the HBM reduction" \
+      "fell under the 5x bar" | tee -a "$log"
+  sync_log
+  exit 11
+fi
 # 5. GSPMD overhead + diagnostics
 run s5a 1800 python tools/bench_sharded.py
 run s5b 1800 python tools/bench_micro.py 1000000 100

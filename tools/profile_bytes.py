@@ -21,7 +21,18 @@ census from the compiled HLO (op counts and transferred bytes via
 real hardware).  On CPU use the virtual mesh
 (``--xla_force_host_platform_device_count``).
 
+With ``--kernel`` (round 16) the report is instead the KERNEL path's
+byte ledger: the per-tick HBM bytes of the unfused pallas dispatch
+(every tick stages the full per-shard carry through HBM) against the
+fused ``--fused-ticks T`` window's amortized entry/exit bytes, plus
+the VMEM working-set estimate the ``kernel_ticks_fused`` capability
+refuses on — so both the residency win and the refusal threshold are
+numbers, not prose.  Analytic (ops/pallas/receive.py's
+``fused_working_set_bytes``), not cost-analysis: the pallas body is
+opaque to XLA's bytes-accessed counter.
+
 Usage: python tools/profile_bytes.py [n_peers] [--devices D]
+       python tools/profile_bytes.py [n_peers] --kernel [--fused-ticks T]
 """
 
 from __future__ import annotations
@@ -46,9 +57,47 @@ def main():
                     help="profile the step sharded over a D-device "
                          "'peers' mesh: per-shard bytes accessed + "
                          "boundary-collective bytes")
+    ap.add_argument("--kernel", action="store_true",
+                    help="report the kernel path's byte ledger: "
+                         "unfused per-tick HBM bytes vs the fused "
+                         "window's amortized bytes + VMEM working set")
+    ap.add_argument("--fused-ticks", type=int, default=8,
+                    help="fused window length T for --kernel")
     ns = ap.parse_args()
     n = ns.n_peers
     t, m, C = 100, 32, 16
+
+    if ns.kernel:
+        from go_libp2p_pubsub_tpu.models.gossipsub import (
+            FUSED_VMEM_BUDGET, GossipSimConfig)
+        from go_libp2p_pubsub_tpu.ops.pallas.receive import (
+            FUSED_ALIGN, fused_working_set_bytes)
+
+        hg = GossipSimConfig.__dataclass_fields__[
+            "history_gossip"].default
+        W = (m + 31) // 32
+        n_pad = -(-n // FUSED_ALIGN) * FUSED_ALIGN
+        T = ns.fused_ticks
+        ws = fused_working_set_bytes(C, W, hg, n_pad, ticks=T)
+        print(f"n={n} (padded {n_pad}) C={C} W={W} hg={hg} "
+              f"ticks_fused={T}")
+        print(f"{'resident carry / peer':34s} "
+              f"{ws['carry_bytes_per_peer']:9d} B")
+        print(f"{'VMEM working set':34s} "
+              f"{ws['vmem_bytes'] / 1e6:9.1f} MB  "
+              f"(budget {FUSED_VMEM_BUDGET / 1e6:.0f} MB — "
+              f"{'FITS' if ws['vmem_bytes'] <= FUSED_VMEM_BUDGET else 'REFUSED: kernel_ticks_fused falls back by name'})")
+        print(f"{'window entry+exit HBM':34s} "
+              f"{ws['entry_exit_bytes'] / 1e6:9.1f} MB  "
+              f"(amortized over {T} ticks)")
+        print(f"{'unfused kernel HBM / tick':34s} "
+              f"{ws['unfused_hbm_bytes_per_tick'] / 1e6:9.1f} MB")
+        print(f"{'fused kernel HBM / tick':34s} "
+              f"{ws['hbm_bytes_per_tick'] / 1e6:9.1f} MB")
+        ratio = (ws["unfused_hbm_bytes_per_tick"]
+                 / max(ws["hbm_bytes_per_tick"], 1.0))
+        print(f"{'per-tick HBM reduction':34s} {ratio:9.2f} x")
+        return
     rng = np.random.default_rng(0)
     cfg = gs.GossipSimConfig(
         offsets=gs.make_gossip_offsets(t, C, n, seed=0), n_topics=t)

@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""residentstat: inspect a tick-resident megakernel bench artifact and
+gate the round-16 residency contract against a committed baseline.
+
+    python tools/residentstat.py /tmp/gossipsub_resident.json
+    python tools/residentstat.py /tmp/gossipsub_resident.json \
+        --check RESIDENT_r16.json [--min-reduction 5.0]
+
+Prints the round-16 table: the per-tick kernel row vs the fused
+T-tick-window row (wall-clock, digest, compile count) and the analytic
+byte ledger (per-tick HBM bytes unfused vs fused, the VMEM working set
+and its budget verdict, at the bench shape plus the 100k/1M points).
+The contract being gated is the round-16 tentpole: the fused
+trajectory is BIT-IDENTICAL to the per-tick kernel's, the whole fused
+run is ONE compiled executable, and everywhere the resident carry fits
+the VMEM budget at >= 100k peers the per-tick HBM traffic drops by at
+least --min-reduction x (the ledger is analytic —
+ops/pallas/receive.fused_working_set_bytes — because the pallas body
+is opaque to XLA's bytes-accessed counter).
+
+Exit codes (tracestat/tourneystat/sweepstat/delaystat/shardstat/
+ckptstat convention):
+
+  0  clean
+  1  regression: fused digest differing from the per-tick kernel row
+     (residency changed the arithmetic), a fused run that compiled
+     more than one executable (re-trace per window), a fitting
+     >= 100k-peer ledger point under --min-reduction x, or (with
+     --check) a baseline row/ledger point missing from the current
+     artifact, a baseline-true bit_identical flag going false, or a
+     ledger point's reduction shrinking vs the committed baseline
+  2  unusable input: missing/unparseable artifact, no rows, no
+     unfused reference row, no fused row, or an empty byte ledger
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load(path: str, prog: str = "residentstat") -> dict:
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"{prog}: cannot read {path}: {e}", file=sys.stderr)
+        raise SystemExit(2)
+    rows = obj.get("rows") if isinstance(obj, dict) else None
+    if not rows or not isinstance(rows, list):
+        print(f"{prog}: {path} carries no rows", file=sys.stderr)
+        raise SystemExit(2)
+    if not any(isinstance(r, dict) and r.get("id") == "unfused_kernel"
+               for r in rows):
+        print(f"{prog}: {path} has no per-tick kernel reference row — "
+              "fused bit-identity has no reference", file=sys.stderr)
+        raise SystemExit(2)
+    if not any(isinstance(r, dict)
+               and str(r.get("id", "")).startswith("fused_")
+               for r in rows):
+        print(f"{prog}: {path} has no fused-window row", file=sys.stderr)
+        raise SystemExit(2)
+    if not obj.get("ledger"):
+        print(f"{prog}: {path} carries no byte ledger — the residency "
+              "win is unmeasured", file=sys.stderr)
+        raise SystemExit(2)
+    return obj
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="residentstat",
+                                 description=__doc__)
+    ap.add_argument("artifact")
+    ap.add_argument("--check", metavar="BASELINE",
+                    help="committed baseline artifact to gate against")
+    ap.add_argument("--min-reduction", type=float, default=5.0,
+                    help="minimum per-tick HBM-bytes reduction (x) at "
+                         "every fitting >= 100k-peer ledger point "
+                         "(default 5.0 — the round-16 acceptance bar)")
+    ns = ap.parse_args(argv)
+
+    cur = load(ns.artifact)
+    rows = [r for r in cur["rows"] if isinstance(r, dict)]
+    unfused = next(r for r in rows if r.get("id") == "unfused_kernel")
+    shape = cur.get("shape", {})
+    print(f"tick-resident megakernel: {shape.get('n')} peers x "
+          f"{shape.get('t')} topics, {shape.get('ticks')} ticks in "
+          f"T={shape.get('ticks_fused')} windows, "
+          f"platform={cur.get('platform')}"
+          f"{' (interpret)' if cur.get('interpret') else ''}"
+          f"{', hardware row queued' if cur.get('hardware_queued') else ''}")
+    for r in rows:
+        extra = ""
+        if r.get("compiles") is not None:
+            extra += f"  compiles={r['compiles']}"
+        if r.get("heartbeats_per_sec") is not None:
+            extra += f"  {r['heartbeats_per_sec']} hb/s"
+        print(f"  {r['id']:<16s} wall={r.get('wall_s', 0):.3f}s "
+              f"digest={r.get('digest')} "
+              f"bit_identical={r.get('bit_identical')}{extra}")
+    ledger = [e for e in cur["ledger"] if isinstance(e, dict)]
+    for e in ledger:
+        verdict = ("FITS" if e.get("fits")
+                   else "REFUSED (past VMEM budget)")
+        print(f"  ledger n={e['n']:>8d}: "
+              f"{e.get('unfused_hbm_bytes_per_tick', 0) / 1e6:9.1f} MB"
+              f" -> {e.get('fused_hbm_bytes_per_tick', 0) / 1e6:8.1f}"
+              f" MB /tick ({e.get('hbm_reduction_x')}x)  "
+              f"vmem={e.get('vmem_bytes', 0) / 1e6:.1f} MB {verdict}")
+
+    rc = 0
+    for r in rows:
+        if r["id"] == "unfused_kernel":
+            continue
+        if r.get("digest") != unfused.get("digest") \
+                or not r.get("bit_identical"):
+            print(f"residentstat: {r['id']} digest {r.get('digest')} "
+                  f"!= per-tick kernel {unfused.get('digest')} — "
+                  "residency changed the trajectory", file=sys.stderr)
+            rc = 1
+        if r.get("compiles") is not None and r["compiles"] > 1:
+            print(f"residentstat: {r['id']} compiled {r['compiles']} "
+                  "executables — fused windows must share ONE "
+                  "(re-trace per window regression)", file=sys.stderr)
+            rc = 1
+    for e in ledger:
+        if (e.get("fits") and e.get("n", 0) >= 100_000
+                and e.get("hbm_reduction_x", 0.0) < ns.min_reduction):
+            print(f"residentstat: ledger n={e['n']} reduction "
+                  f"{e.get('hbm_reduction_x')}x under the "
+                  f"{ns.min_reduction}x bar — the resident window no "
+                  "longer amortizes the carry traffic",
+                  file=sys.stderr)
+            rc = 1
+
+    if ns.check:
+        base = load(ns.check)
+        base_rows = {r["id"]: r for r in base["rows"]
+                     if isinstance(r, dict)}
+        cur_ids = {r["id"] for r in rows}
+        missing = set(base_rows) - cur_ids
+        if missing:
+            print("residentstat: row coverage shrank vs baseline: "
+                  f"missing {sorted(missing)}", file=sys.stderr)
+            rc = 1
+        for rid, ref in sorted(base_rows.items()):
+            r = next((x for x in rows if x["id"] == rid), None)
+            if r is None:
+                continue
+            if ref.get("bit_identical") and not r.get("bit_identical"):
+                print(f"residentstat: {rid} was bit_identical in the "
+                      "baseline and no longer is", file=sys.stderr)
+                rc = 1
+            verdict = "OK" if r.get("bit_identical") else "REGRESSED"
+            print(f"check: {rid} bit_identical="
+                  f"{r.get('bit_identical')} vs baseline "
+                  f"{ref.get('bit_identical')} -> {verdict}")
+        base_ledger = {e["n"]: e for e in base.get("ledger", [])
+                       if isinstance(e, dict)}
+        cur_ledger = {e["n"]: e for e in ledger}
+        lmissing = set(base_ledger) - set(cur_ledger)
+        if lmissing:
+            print("residentstat: ledger coverage shrank vs baseline: "
+                  f"missing n={sorted(lmissing)}", file=sys.stderr)
+            rc = 1
+        for n_l, ref in sorted(base_ledger.items()):
+            e = cur_ledger.get(n_l)
+            if e is None:
+                continue
+            got = e.get("hbm_reduction_x", 0.0)
+            want = ref.get("hbm_reduction_x", 0.0)
+            if ref.get("fits") and got < want:
+                print(f"residentstat: ledger n={n_l} reduction "
+                      f"{got}x shrank vs baseline {want}x — carry "
+                      "bytes grew or the window shortened",
+                      file=sys.stderr)
+                rc = 1
+            print(f"check: ledger n={n_l} {got}x vs baseline {want}x "
+                  f"-> {'OK' if not ref.get('fits') or got >= want else 'REGRESSED'}")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

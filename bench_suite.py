@@ -1769,6 +1769,248 @@ def bench_gossipsub_resident():
                 "interpret": not on_accel})
 
 
+def bench_gossipsub_resident_sharded():
+    """Round 17: the SHARDED tick-resident megakernel — VMEM residency
+    x multi-chip sharding composed (make_fused_window(shard_mesh=...)
+    / sharded_gossip_run_fused).  Under shard_map each shard runs ONE
+    resident pallas dispatch per T=8-tick window whose in-kernel
+    remote DMAs carry the ring-halo boundary words between grid ticks;
+    the per-SHARD carry never leaves VMEM inside the window.  Four
+    contracts, one artifact (/tmp/gossipsub_resident_sharded.json for
+    the ``residentstat --check --sharded`` gate, measure_all step 4j):
+
+    * BIT-IDENTITY ACROSS D: the fused-sharded trajectory's final
+      digest at every D in {2, 4} must equal the single-device
+      per-tick kernel's (the halo exchange is a scheduling change,
+      never an arithmetic one);
+    * ONE COMPILE PER D: each fused-sharded run is one executable —
+      windows re-dispatch, never re-trace;
+    * the r16 LEDGER carried forward unchanged (no coverage shrink);
+    * the MULTIPLICATIVE row: the per-(n, D) fits table
+      (fused_working_set_bytes with real circulant offsets — the halo
+      reach is offset geometry) including the headline 1M point,
+      REFUSED at D=1 and FITS by D=8 with multiplicative saving =
+      fused HBM reduction x the D-way carry partition.
+
+    Mosaic + real ICI DMAs on TPU; CPU hosts run the same program on
+    the virtual mesh in interpret mode (set
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8``), where
+    digest/compile/ledger rows are the measurement, wall-clock is
+    indicative only, and the artifact is tagged ``hardware_queued``."""
+    import hashlib
+
+    import jax
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    from go_libp2p_pubsub_tpu.ops.pallas.receive import (
+        FUSED_ALIGN, FUSED_SHARD_TILE, fused_working_set_bytes)
+    from go_libp2p_pubsub_tpu.parallel import mesh as pm
+    from go_libp2p_pubsub_tpu.parallel import sharded as ps
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    ndev = len(jax.devices())
+    block = int(os.environ.get("GOSSIP_BENCH_BLOCK", "8192"))
+    n = int(os.environ.get("GOSSIP_RESIDENT_N",
+                           1_048_576 if on_accel else 131_072))
+    assert n % block == 0 and n % FUSED_ALIGN == 0, (n, block)
+    t, m, C = 10, 24, 16
+    Tw = 8
+    ticks = Tw * 2
+    Ds = [d for d in (2, 4) if d <= ndev and n % d == 0
+          and (n // d) % FUSED_SHARD_TILE == 0]
+
+    rng = np.random.default_rng(0)
+    cfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(t, C, n, seed=7), n_topics=t)
+    subs = _subs_matrix(n, t)
+    topic, origin, pub = _msgs(rng, n, t, m, ticks // 2)
+    params, state = gs.make_gossip_sim(cfg, subs, topic, origin, pub,
+                                       seed=3, pad_to_block=block)
+    params = jax.device_put(params)
+
+    def digest(s):
+        h = hashlib.sha256()
+        for leaf in (s.have, s.recent, s.mesh, s.fanout, s.last_pub,
+                     s.backoff, s.tick):
+            h.update(np.asarray(leaf).tobytes())
+        return h.hexdigest()[:16]
+
+    # single-device per-tick kernel: the arithmetic reference
+    step = gs.make_gossip_step(cfg, None, receive_block=block,
+                               receive_interpret=not on_accel)
+    out = gs.gossip_run(params, gs.tree_copy(state), ticks, step)
+    jax.block_until_ready(out.have)
+    t0 = time.perf_counter()
+    out = gs.gossip_run(params, gs.tree_copy(state), ticks, step)
+    jax.block_until_ready(out.have)
+    wall_unfused = time.perf_counter() - t0
+    ref = digest(out)
+    rows = [{"id": "unfused_kernel", "n": n, "ticks": ticks,
+             "wall_s": round(wall_unfused, 3),
+             "heartbeats_per_sec": round(ticks / wall_unfused, 2),
+             "digest": ref, "bit_identical": True}]
+
+    # single-chip fused window: the residency baseline the sharded
+    # rows multiply against
+    window = gs.make_fused_window(cfg, None, ticks_fused=Tw,
+                                  receive_block=block,
+                                  receive_interpret=not on_accel,
+                                  on_refusal="raise")
+    reason = window.capability(params, state)
+    assert reason is None, reason
+    cache0 = gs.gossip_run_fused._cache_size()
+    out = gs.gossip_run_fused(params, gs.tree_copy(state), ticks,
+                              window)
+    jax.block_until_ready(out.have)
+    compiles = gs.gossip_run_fused._cache_size() - cache0
+    t0 = time.perf_counter()
+    out = gs.gossip_run_fused(params, gs.tree_copy(state), ticks,
+                              window)
+    jax.block_until_ready(out.have)
+    wall_fused = time.perf_counter() - t0
+    dg = digest(out)
+    rows.append({
+        "id": f"fused_T{Tw}", "n": n, "ticks": ticks,
+        "ticks_fused": Tw, "wall_s": round(wall_fused, 3),
+        "heartbeats_per_sec": round(ticks / wall_fused, 2),
+        "compiles": int(compiles),
+        "digest": dg, "bit_identical": dg == ref,
+    })
+    assert dg == ref, (dg, ref)
+    assert compiles == 1, f"fused run recompiled: {compiles}"
+
+    # fused-sharded rows: the composition under test
+    for D in Ds:
+        mesh = pm.make_mesh(D)
+        win = gs.make_fused_window(cfg, None, ticks_fused=Tw,
+                                   receive_block=block,
+                                   receive_interpret=not on_accel,
+                                   shard_mesh=mesh, shard_axis="peers",
+                                   on_refusal="raise")
+        reason = win.capability(params, state)
+        assert reason is None, (D, reason)
+        params_s, state_s, sh = ps.shard_sim(
+            params, gs.tree_copy(state), mesh, n)
+        cache0 = ps.sharded_gossip_run_fused._cache_size()
+        out = ps.sharded_gossip_run_fused(params_s, state_s, ticks,
+                                          win, sh)
+        jax.block_until_ready(out.have)
+        compiles = ps.sharded_gossip_run_fused._cache_size() - cache0
+        # warm twin from a fresh (donated-away) carry
+        _, state_s, _ = ps.shard_sim(params, gs.tree_copy(state),
+                                     mesh, n)
+        t0 = time.perf_counter()
+        out = ps.sharded_gossip_run_fused(params_s, state_s, ticks,
+                                          win, sh)
+        jax.block_until_ready(out.have)
+        dt = time.perf_counter() - t0
+        dg = digest(out)
+        rows.append({
+            "id": f"fused_sharded_D{D}", "n": n, "devices": D,
+            "ticks": ticks, "ticks_fused": Tw,
+            "wall_s": round(dt, 3),
+            "heartbeats_per_sec": round(ticks / dt, 2),
+            "compiles": int(compiles),
+            "digest": dg, "bit_identical": dg == ref,
+        })
+        assert dg == ref, (D, dg, ref)
+        assert compiles == 1, (D, compiles)
+
+    # the r16 ledger, carried forward unchanged (coverage gate), plus
+    # the per-(n, D) fits table with real circulant offsets — the
+    # halo reach and the tailored ctrl segments are offset geometry,
+    # not just magnitudes
+    from go_libp2p_pubsub_tpu.models.gossipsub import FUSED_VMEM_BUDGET
+    W = (m + 31) // 32
+    hg = cfg.history_gossip
+    ledger = []
+    for n_l in sorted({102_400, n, 1_048_576}):
+        ws = fused_working_set_bytes(C, W, hg, n_l, ticks=Tw)
+        red = (ws["unfused_hbm_bytes_per_tick"]
+               / max(ws["hbm_bytes_per_tick"], 1.0))
+        ledger.append({
+            "n": n_l, "ticks_fused": Tw,
+            "carry_bytes_per_peer": ws["carry_bytes_per_peer"],
+            "vmem_bytes": int(ws["vmem_bytes"]),
+            "vmem_budget_bytes": int(FUSED_VMEM_BUDGET),
+            "fits": ws["vmem_bytes"] <= FUSED_VMEM_BUDGET,
+            "unfused_hbm_bytes_per_tick":
+                int(ws["unfused_hbm_bytes_per_tick"]),
+            "fused_hbm_bytes_per_tick": int(ws["hbm_bytes_per_tick"]),
+            "hbm_reduction_x": round(red, 2),
+        })
+
+    fits_table = []
+    for n_l in sorted({102_400, n, 1_048_576}):
+        offs_l = gs.make_gossip_offsets(t, C, n_l, seed=7)
+        for D in (1, 2, 4, 8):
+            if n_l % D or (n_l // D) % FUSED_SHARD_TILE:
+                continue
+            try:
+                ws = fused_working_set_bytes(
+                    C, W, hg, n_l, ticks=Tw, devices=D,
+                    offsets=(offs_l if D > 1 else None))
+            except ValueError as e:
+                fits_table.append({"n": n_l, "devices": D,
+                                   "ticks_fused": Tw,
+                                   "refused": str(e)})
+                continue
+            red = (ws["unfused_hbm_bytes_per_tick"]
+                   / max(ws["hbm_bytes_per_tick"], 1.0))
+            fits_table.append({
+                "n": n_l, "devices": D, "ticks_fused": Tw,
+                "vmem_bytes": int(ws["vmem_bytes"]),
+                "vmem_budget_bytes": int(FUSED_VMEM_BUDGET),
+                "fits": ws["vmem_bytes"] <= FUSED_VMEM_BUDGET,
+                "boundary_bytes_per_tick":
+                    int(ws.get("boundary_bytes_per_tick", 0)),
+                "hbm_reduction_x": round(red, 2),
+                "multiplicative_x": round(red * D, 2),
+            })
+
+    # the headline row: 1M peers, which the single-chip budget
+    # REFUSES, composes to FITS once the ring splits the carry —
+    # with margin by D=8
+    m_pts = {e["devices"]: e for e in fits_table
+             if e["n"] == 1_048_576 and "fits" in e}
+    head = m_pts[8]
+    assert head["fits"], head
+    assert not m_pts[1]["fits"], m_pts[1]
+    multiplicative = {
+        "n": 1_048_576, "devices": 8, "ticks_fused": Tw,
+        "hbm_reduction_x": head["hbm_reduction_x"],
+        "multiplicative_x": head["multiplicative_x"],
+        "fits_by_devices": {str(d): bool(e["fits"])
+                            for d, e in sorted(m_pts.items())},
+        "first_fits_devices": min(d for d, e in m_pts.items()
+                                  if e["fits"]),
+    }
+
+    backend = jax.default_backend()
+    art = {
+        "round": 17,
+        "platform": backend,
+        "n_devices": ndev,
+        "hardware_queued": backend != "tpu",
+        "interpret": not on_accel,
+        "shape": {"n": n, "t": t, "m": m, "C": C, "ticks": ticks,
+                  "ticks_fused": Tw, "block": block, "devices": Ds},
+        "rows": rows,
+        "ledger": ledger,
+        "fits_table": fits_table,
+        "multiplicative": multiplicative,
+    }
+    write_json_atomic("/tmp/gossipsub_resident_sharded.json", art)
+    emit(f"gossipsub_resident_sharded_{n}peers_multiplicative_x",
+         multiplicative["multiplicative_x"],
+         "x per-tick single-chip HBM",
+         extra={"ticks_fused": Tw, "devices": Ds,
+                "first_fits_devices":
+                    multiplicative["first_fits_devices"],
+                "bit_identical": all(r["bit_identical"]
+                                     for r in rows),
+                "interpret": not on_accel})
+
+
 BENCHES = {
     "floodsub_hosts": bench_floodsub_hosts,
     "randomsub_10k": bench_randomsub_10k,
@@ -1793,6 +2035,7 @@ BENCHES = {
     "gossipsub_multichip": bench_gossipsub_multichip,
     "gossipsub_checkpoint": bench_gossipsub_checkpoint,
     "gossipsub_resident": bench_gossipsub_resident,
+    "gossipsub_resident_sharded": bench_gossipsub_resident_sharded,
 }
 
 

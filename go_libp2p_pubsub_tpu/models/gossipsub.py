@@ -1804,7 +1804,7 @@ def kernel_ticks_fused_capability(
         cfg: GossipSimConfig, sc: ScoreSimConfig | None,
         params: GossipParams, state: GossipState, ticks: int, *,
         vmem_budget_bytes: int = FUSED_VMEM_BUDGET,
-        sharded: bool = False) -> str | None:
+        sharded: bool = False, devices: int = 1) -> str | None:
     """Capability dispatch for the round-16 tick-resident window:
     ``None`` when T ticks can fold into one resident pallas_call, else
     the named refusal ``make_fused_window`` falls back (or raises) by.
@@ -1815,9 +1815,17 @@ def kernel_ticks_fused_capability(
     byte-bound refusals REPORT the bytes: the resident carry must fit
     the VMEM budget twice over (entry pair + revisited output pair),
     so scored accumulators, delay lines, and large C·W carries fall
-    back to the per-tick kernel with the working set in the message."""
+    back to the per-tick kernel with the working set in the message.
+
+    With ``sharded=True`` (round 17) the window composes with the
+    multi-chip dispatch: the PER-SHARD carry plus the double-buffered
+    halo slots must fit, the shard extent must hold whole lane tiles,
+    and the candidate reach must stay inside the ``devices``-shard
+    ring — each refused by name; delay-armed sims keep the existing
+    per-tick refusal (the K-slot dequeue runs between kernel ticks)."""
     from ..ops.pallas.receive import (
-        FUSED_ALIGN, fused_working_set_bytes)
+        FUSED_ALIGN, FUSED_SHARD_TILE, fused_halo_spec,
+        fused_working_set_bytes)
 
     if ticks < 1:
         return ("kernel_ticks_fused: window must be >= 1 tick "
@@ -1828,15 +1836,6 @@ def kernel_ticks_fused_capability(
     if params.n_true is None:
         return ("kernel_ticks_fused: needs the padded pallas layout "
                 "(make_gossip_sim(pad_to_block=...))")
-    if sharded:
-        # multi-chip composition: each tick's ring-halo exchange is an
-        # ICI collective — the carry must leave VMEM every tick anyway,
-        # so the sharded dispatch keeps the per-tick kernel and the
-        # window runs as a scan of steps (bit-identical by definition)
-        return ("kernel_ticks_fused: the sharded dispatch keeps the "
-                "per-tick kernel (the ring-halo exchange leaves VMEM "
-                "every tick) — fused windows fall back to the "
-                "scan-of-steps form under shard_map")
     if sc is not None:
         extra = 0
         if state.scores is not None:
@@ -1878,10 +1877,34 @@ def kernel_ticks_fused_capability(
                 "resident whole-ring lane rolls wrap at the padded "
                 "length) — pick n divisible by the block so "
                 "pad_to_block adds nothing")
-    if params.n_true % FUSED_ALIGN != 0:
+    if not sharded and params.n_true % FUSED_ALIGN != 0:
+        # single-device whole-ring lane rolls wrap at the u32 DMA
+        # tile; the sharded path's constraint is per-SHARD (whole
+        # 128-lane tiles, checked below) — the composition can admit
+        # rings the single-device window refuses
         return ("kernel_ticks_fused: needs n_true % "
                 f"{FUSED_ALIGN} == 0 (u32 lane-roll tile); got "
                 f"{params.n_true}")
+    D = int(devices) if sharded else 1
+    if sharded:
+        if D < 2:
+            return ("kernel_ticks_fused: sharded windows need a "
+                    f"known device count >= 2 (got devices={D}) — "
+                    "pass the mesh extent through the dispatch")
+        if params.n_true % D != 0:
+            return ("kernel_ticks_fused: sharded windows need "
+                    f"n_true divisible by devices={D}; got "
+                    f"{params.n_true}")
+        S = params.n_true // D
+        if S % FUSED_SHARD_TILE != 0:
+            return ("kernel_ticks_fused: sharded windows need whole "
+                    f"{FUSED_SHARD_TILE}-lane tiles per shard "
+                    f"(S % {FUSED_SHARD_TILE} == 0); got S={S} at "
+                    f"n={params.n_true}, devices={D}")
+        try:
+            fused_halo_spec(cfg.offsets, S, D)
+        except ValueError as e:
+            return str(e)
     W = state.have.shape[0]
     lat_b = 0
     ws = fused_working_set_bytes(
@@ -1890,16 +1913,20 @@ def kernel_ticks_fused_capability(
         with_faults=params.faults is not None,
         cold_restart=(params.faults is not None
                       and params.faults.cold_restart),
-        with_telemetry=False)
+        with_telemetry=False,
+        devices=D, offsets=(cfg.offsets if sharded else None))
     if ws["vmem_bytes"] > vmem_budget_bytes:
         return ("kernel_ticks_fused: resident carry past the VMEM "
                 f"budget — working set {ws['vmem_bytes']} bytes "
                 f"(carry {ws['carry_bytes']} B x 2 resident pairs + "
-                f"static {ws['static_bytes']} B + per-tick buffers) "
-                f"> budget {vmem_budget_bytes} B at "
-                f"n={params.n_true}, C={cfg.n_candidates}, W={W} — "
-                "shard the sim over more chips or run the per-tick "
-                "kernel")
+                f"static {ws['static_bytes']} B + per-tick buffers"
+                + (f" + halo/stage {ws['halo_bytes'] + ws['stage_bytes']} B"
+                   if D > 1 else "")
+                + f") > budget {vmem_budget_bytes} B at "
+                f"n={params.n_true}, C={cfg.n_candidates}, W={W}"
+                + (f", devices={D} (per-shard)" if D > 1 else "")
+                + " — shard the sim over more chips or run the "
+                "per-tick kernel")
     return None
 
 
@@ -4310,22 +4337,30 @@ def make_fused_window(cfg: GossipSimConfig,
     ``telemetry`` (frames stacked [T, ...] like the scanned runners').
 
     Dispatch is by ``kernel_ticks_fused_capability``: where residency
-    is impossible (scored carry, delays, sharded halo exchange, carry
-    past the VMEM budget — every refusal named and byte-reported) the
-    window runs as a ``lax.scan`` of the ordinary step over the same
-    T ticks, bit-identical by definition; pass ``on_refusal="raise"``
-    to surface the refusal instead.  On the resident path the
-    trajectory is bit-identical to T per-tick steps on BOTH existing
-    paths (pinned by tests/test_fused_kernel.py): the in-kernel tick
-    body transcribes the unscored combined step op for op and the
-    lane-hash draws are seeded per tick exactly as the step seeds
-    them.  Compose with checkpointing by aligning segment boundaries:
-    ``ckpt run`` refuses ``every % ticks_fused != 0`` by name."""
+    is impossible (scored carry, delays, a halo past the shard ring,
+    carry past the VMEM budget — every refusal named and
+    byte-reported) the window runs as a ``lax.scan`` of the ordinary
+    step over the same T ticks, bit-identical by definition; pass
+    ``on_refusal="raise"`` to surface the refusal instead.  On the
+    resident path the trajectory is bit-identical to T per-tick steps
+    on BOTH existing paths (pinned by tests/test_fused_kernel.py):
+    the in-kernel tick body transcribes the unscored combined step op
+    for op and the lane-hash draws are seeded per tick exactly as the
+    step seeds them.  With ``shard_mesh`` (round 17) the window
+    dispatches ``sharded_fused_gossip_update``: one resident pallas
+    invocation PER SHARD whose in-kernel remote DMAs carry the
+    ring-halo boundary words between grid ticks — residency and
+    multi-chip sharding compose, still bit-identical (pinned at
+    D in {2, 4} on the CPU virtual mesh).  Compose with checkpointing
+    by aligning segment boundaries: ``ckpt run`` refuses
+    ``every % ticks_fused != 0`` by name."""
     sc = score_cfg
     tel = telemetry
     T = int(ticks_fused)
     if T < 1:
         raise ValueError(f"ticks_fused must be >= 1 (got {T})")
+    shard_D = (int(shard_mesh.shape[shard_axis])
+               if shard_mesh is not None else 1)
     step = make_gossip_step(cfg, sc, receive_block=receive_block,
                             receive_interpret=receive_interpret,
                             shard_mesh=shard_mesh,
@@ -4421,10 +4456,18 @@ def make_fused_window(cfg: GossipSimConfig,
             cal_rows = jnp.stack(c_l)
             if cold:
                 rej_rows = jnp.stack(r_l)
-        krn = make_fused_gossip_update(
-            cfg, n_true, W, hg, T, interpret=receive_interpret,
-            stream_n=n_true, with_faults=with_f, cold_restart=cold,
-            with_telemetry=with_t, tel_lat_buckets=lat_b)
+        if shard_mesh is not None:
+            from ..ops.pallas.receive import sharded_fused_gossip_update
+            krn = sharded_fused_gossip_update(
+                cfg, n_true, W, hg, T, mesh=shard_mesh,
+                axis_name=shard_axis, interpret=receive_interpret,
+                with_faults=with_f, cold_restart=cold,
+                with_telemetry=with_t, tel_lat_buckets=lat_b)
+        else:
+            krn = make_fused_gossip_update(
+                cfg, n_true, W, hg, T, interpret=receive_interpret,
+                stream_n=n_true, with_faults=with_f, cold_restart=cold,
+                with_telemetry=with_t, tel_lat_buckets=lat_b)
         args = [jnp.asarray(tick0, jnp.int32).reshape(1), seeds, due,
                 jnp.zeros((1,), jnp.uint32)]
         if with_t and lat_b:
@@ -4534,7 +4577,7 @@ def make_fused_window(cfg: GossipSimConfig,
         reason = kernel_ticks_fused_capability(
             cfg, sc, params, state, T,
             vmem_budget_bytes=vmem_budget_bytes,
-            sharded=shard_mesh is not None)
+            sharded=shard_mesh is not None, devices=shard_D)
         if reason is not None:
             if on_refusal == "raise":
                 raise ValueError(reason)
@@ -4546,7 +4589,7 @@ def make_fused_window(cfg: GossipSimConfig,
         kernel_ticks_fused_capability(
             cfg, sc, params, state, T,
             vmem_budget_bytes=vmem_budget_bytes,
-            sharded=shard_mesh is not None)
+            sharded=shard_mesh is not None, devices=shard_D)
     return window
 
 

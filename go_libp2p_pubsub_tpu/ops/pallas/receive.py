@@ -1456,6 +1456,81 @@ FUSED_ALIGN = ALIGN32    # whole-ring lane rolls need the u32 tile
 # frame's tx() fold — the resident window has no per-tick XLA epilogue
 # to count them in)
 TEL_FUSED_EXTRA = 2
+# sharded fused windows need whole telemetry lane tiles per shard
+FUSED_SHARD_TILE = 128
+
+
+def fused_halo_spec(offsets, S: int, D: int) -> dict:
+    """Static hop plan for the round-17 IN-KERNEL ring-halo exchange.
+
+    Under ``shard_map`` each shard holds S = n/D consecutive peers and
+    the fused kernel must see tick-t sender rows up to max|offset|
+    beyond its slice.  Two row classes, two halo shapes:
+
+    - the PAYLOAD rows (fresh + adv message words) are read at every
+      candidate offset, so they carry one shared halo of p_l words on
+      the left and p_r on the right (``ext`` view = halo_l ++ local ++
+      halo_r, candidate j's window = ext[p_l + o_j :][:S]);
+    - each CTRL row c is read at exactly ONE offset (cinv is a
+      permutation — candidate j reads row cinv[j]), so ctrl halos are
+      per-candidate single-sided segments of |o_j| words.  This is the
+      difference between ~2·C·p and ~sum|o_j| resident halo words —
+      the margin that lets the 1M-peer shard fit VMEM at D=8.
+
+    A reach of |o| > S spans multiple shards; remote DMA addresses any
+    shard directly, so hop h just sends to (d ± h) mod D — no chained
+    forwarding.  Raises (by name) when a hop count would reach D: a
+    halo that wraps the whole ring means the config's candidate reach
+    exceeds what D shards can border-exchange.
+
+    Returns dict(p_l, p_r, pay_hops=[(side, h, take, pos), ...],
+    ctl_segs=[(j, row, off, seg, [(h, take, pos), ...]), ...],
+    ctl_words, n_dmas, max_hop).
+    """
+    offs = [int(o) for o in offsets]
+    p_l = max(0, -min(offs)) if offs else 0
+    p_r = max(0, max(offs)) if offs else 0
+    max_hop = -(-max(p_l, p_r) // S) if max(p_l, p_r) else 0
+    if max_hop >= D:
+        raise ValueError(
+            f"kernel_ticks_fused: halo reach {max(p_l, p_r)} spans "
+            f"the whole {D}-shard ring (hop {max_hop} >= D at "
+            f"S={S}) — the candidate offsets exceed what border "
+            "exchange can cover; shard over more chips or run the "
+            "per-tick kernel")
+
+    def side_hops(p, side):
+        hops = []
+        for h in range(1, -(-p // S) + 1):
+            take = min(S, p - (h - 1) * S)
+            # left halo: seg[x] = global[dS - p + x], farthest hop
+            # lands at position 0; right halo: seg[x] = global[dS + S
+            # + x], hop h's piece at (h-1)*S
+            pos = (p - (h - 1) * S - take) if side == "l" \
+                else (h - 1) * S
+            hops.append((side, h, take, pos))
+        return hops
+
+    pay_hops = side_hops(p_l, "l") + side_hops(p_r, "r")
+    ctl_segs = []
+    seg = 0
+    for j, o in enumerate(offs):
+        if o == 0:
+            continue
+        a = abs(o)
+        hops = []
+        for h in range(1, -(-a // S) + 1):
+            take = min(S, a - (h - 1) * S)
+            pos = ((h - 1) * S if o > 0
+                   else a - (h - 1) * S - take)
+            hops.append((h, take, pos))
+        ctl_segs.append((j, o, seg, hops))
+        seg += a
+    # payload hops move all 2W rows in one descriptor each
+    n_dmas = len(pay_hops) + sum(len(h) for _, _, _, h in ctl_segs)
+    return dict(p_l=p_l, p_r=p_r, pay_hops=pay_hops,
+                ctl_segs=ctl_segs, ctl_words=seg, n_dmas=n_dmas,
+                max_hop=max_hop)
 
 
 def fused_carry_bytes(C: int, w_words: int, hg: int) -> int:
@@ -1475,7 +1550,9 @@ def fused_working_set_bytes(C: int, w_words: int, hg: int, n: int, *,
                             ticks: int, lat_buckets: int = 0,
                             with_faults: bool = False,
                             cold_restart: bool = False,
-                            with_telemetry: bool = False) -> dict:
+                            with_telemetry: bool = False,
+                            devices: int = 1,
+                            offsets=None) -> dict:
     """Static byte accounting for the resident window — the numbers the
     capability refusal reports and tools/profile_bytes --kernel prints.
 
@@ -1489,6 +1566,18 @@ def fused_working_set_bytes(C: int, w_words: int, hg: int, n: int, *,
     outputs) — the ratio of the two is the residency win.  Analytic by
     design: XLA cost analysis cannot see through a Mosaic custom call,
     so the gate pins these closed-form numbers instead.
+
+    With ``devices`` D > 1 (round 17) every per-peer term counts the
+    PER-SHARD slice n/D and the working set adds the in-kernel halo
+    machinery (``fused_halo_spec`` over ``offsets``, which must then
+    be given): the [C + 2W, S] send stage, the double-buffered payload
+    halos (2 slots x (p_l + p_r) x 2W words) and the per-candidate
+    ctrl segments (2 slots x sum|o_j| words).  The halo does NOT
+    shrink with D — boundary reach is set by the offsets, not the
+    shard — which is why the D-table's FITS column is not a simple
+    1/D rescale.  ``boundary_bytes_per_tick`` is the per-shard
+    remote-DMA traffic (ICI on hardware), reported separately from
+    the HBM terms.
     """
     W, hg_ = w_words, hg
     carry = fused_carry_bytes(C, W, hg_)
@@ -1501,22 +1590,45 @@ def fused_working_set_bytes(C: int, w_words: int, hg: int, n: int, *,
     emit_tick = 4 * W + (4 if with_telemetry else 0)   # acq (+ mesh row)
     tel_tick = ((TEL_ROWS + lat_buckets + TEL_FUSED_EXTRA) * 128 * 4
                 if with_telemetry else 0)
-    vmem = n * (2 * carry + static_in
-                + 2 * (stream_tick + emit_tick))
-    entry_exit = n * (2 * carry + static_in)
+    D = int(devices)
+    n_s = n if D <= 1 else n // D
+    halo_bytes = stage_bytes = boundary = 0
+    if D > 1:
+        if offsets is None:
+            raise ValueError(
+                "fused_working_set_bytes: devices > 1 needs the "
+                "candidate offsets (the halo reach sets the resident "
+                "halo bytes)")
+        spec = fused_halo_spec(offsets, n_s, D)
+        halo_words = 2 * W * (spec["p_l"] + spec["p_r"]) \
+            + spec["ctl_words"]
+        halo_bytes = 2 * 4 * halo_words          # double-buffered u32
+        stage_bytes = (C + 2 * W) * n_s * 4      # send stage rows
+        boundary = 4 * halo_words                # per tick, per shard
+    vmem = (n_s * (2 * carry + static_in
+                   + 2 * (stream_tick + emit_tick))
+            + halo_bytes + stage_bytes)
+    entry_exit = n_s * (2 * carry + static_in)
     per_tick = (entry_exit / ticks
-                + n * (stream_tick + emit_tick) + tel_tick)
-    return dict(carry_bytes=carry * n,
+                + n_s * (stream_tick + emit_tick) + tel_tick)
+    unfused = unfused_kernel_hbm_bytes_per_tick(
+        C, W, n_s, lat_buckets=lat_buckets, with_faults=with_faults,
+        with_telemetry=with_telemetry)
+    if D > 1:
+        # the per-tick sharded kernel stages its ppermuted extended
+        # sender rows (local + halo) through HBM every tick — the
+        # boundary words ride the unfused side too
+        unfused += boundary
+    return dict(carry_bytes=carry * n_s,
                 carry_bytes_per_peer=carry,
-                static_bytes=static_in * n,
+                static_bytes=static_in * n_s,
                 vmem_bytes=vmem,
                 entry_exit_bytes=entry_exit,
                 hbm_bytes_per_tick=per_tick,
-                unfused_hbm_bytes_per_tick=unfused_kernel_hbm_bytes_per_tick(
-                    C, W, n, lat_buckets=lat_buckets,
-                    with_faults=with_faults,
-                    with_telemetry=with_telemetry),
-                ticks=ticks)
+                unfused_hbm_bytes_per_tick=unfused,
+                ticks=ticks, devices=D, shard_n=n_s,
+                halo_bytes=halo_bytes, stage_bytes=stage_bytes,
+                boundary_bytes_per_tick=boundary)
 
 
 def unfused_kernel_hbm_bytes_per_tick(C: int, w_words: int, n: int, *,
@@ -1550,14 +1662,29 @@ def unfused_kernel_hbm_bytes_per_tick(C: int, w_words: int, n: int, *,
 def _fused_gossip_kernel(*refs, cfg, n_true, w_words, hg, ticks,
                          stream_n=None, with_faults=False,
                          cold_restart=False, with_telemetry=False,
-                         tel_lat_buckets=0):
+                         tel_lat_buckets=0, halo=None,
+                         axis_name=None, devices=1):
     """One grid step == one tick over the WHOLE resident shard.
 
     Transcribes the unscored combined step: publish injection, fanout
     TTL/refill, eager forward + lazy gossip over the circulant edge
     views, the GRAFT/PRUNE/A handshake, backoff, and the next tick's
     gate emission — with the carry read from / written to the resident
-    output refs each step."""
+    output refs each step.
+
+    With ``halo`` (round 17, a ``fused_halo_spec``) the block is one
+    SHARD of a ``devices``-way ring under shard_map and the tick's
+    boundary words cross shards by remote DMA between grid steps
+    instead of leaving VMEM: payload rows halo into double-buffered
+    ``(2, 2W, p)`` slots (slot = t mod 2), ctrl rows into
+    per-candidate segments, and candidate views become halo-extended
+    rolls (payload) / straight concats (ctrl).  The payload DMAs
+    launch before the maintenance pass and the waits sit just before
+    the exchange loop, so the transfer rides under the tick's own
+    local compute; the two slots make the NEIGHBOR's tick-t reads
+    safe against this shard's tick-t+1 sends without any barrier (a
+    shard cannot run 2 ticks ahead: finishing tick t needs every
+    neighbor's tick-t send)."""
     C = cfg.n_candidates
     N = n_true
     W = w_words
@@ -1615,6 +1742,14 @@ def _fused_gossip_kernel(*refs, cfg, n_true, w_words, hg, ticks,
     acq_o = nxt()            # u32 [1, W, N] per-tick acquisitions
     meshrow_o = nxt() if with_telemetry else None   # u32 [1, N]
     tel_o = nxt() if with_telemetry else None  # i32 [1, R, 128]
+    if halo is not None:     # round-17 sharded scratch (trailing)
+        stage_ctl = nxt()    # u32 [C, N] send stage: ctrl rows
+        stage_pay = nxt()    # u32 [2W, N] send stage: fresh + adv
+        pay_l = nxt() if halo["p_l"] else None   # u32 [2, 2W, p_l]
+        pay_r = nxt() if halo["p_r"] else None   # u32 [2, 2W, p_r]
+        ctl_halo = nxt() if halo["ctl_words"] else None  # u32 [2, sum|o|]
+        send_sem = nxt()     # DMA [n_dmas]
+        recv_sem = nxt()     # DMA [n_dmas]
 
     t = pl.program_id(0)
 
@@ -1722,6 +1857,42 @@ def _fused_gossip_kernel(*refs, cfg, n_true, w_words, hg, ticks,
             aw = aw | rec[h][w]
         fresh.append(fr | inj[w])
         adv.append(aw)
+
+    dmas_pending = []
+    if halo is not None:
+        hslot = jax.lax.rem(t, 2)
+        my = jax.lax.axis_index(axis_name)
+        Dv = devices
+        k_dma = 0
+
+        def _nbr(h):
+            return (jax.lax.rem(my - h + Dv, Dv),
+                    jax.lax.rem(my + h, Dv))
+
+        def _rdma(k, src, dst, dev):
+            rd = pltpu.make_async_remote_copy(
+                src_ref=src, dst_ref=dst,
+                send_sem=send_sem.at[k], recv_sem=recv_sem.at[k],
+                device_id=dev,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            rd.start()
+            dmas_pending.append(rd)
+
+        # payload halo launches as soon as the tick's fresh/adv rows
+        # exist — the transfer rides under the maintenance pass below
+        stage_pay[...] = jnp.stack(fresh + adv)
+        for side, h, take, pos in halo["pay_hops"]:
+            left_h, right_h = _nbr(h)
+            if side == "l":
+                # my top slice is shard (d+h)'s left halo
+                _rdma(k_dma, stage_pay.at[:, N - take:N],
+                      pay_l.at[hslot, :, pos:pos + take], right_h)
+            else:
+                # my bottom slice is shard (d-h)'s right halo
+                _rdma(k_dma, stage_pay.at[:, 0:take],
+                      pay_r.at[hslot, :, pos:pos + take], left_h)
+            k_dma += 1
+
     out_bits = mesh0 | fanout
     if with_faults:
         out_bits = out_bits & sok
@@ -1771,6 +1942,69 @@ def _fused_gossip_kernel(*refs, cfg, n_true, w_words, hg, ticks,
             | (bit_of(a_tx, c) << jnp.uint32(CTRL_A))
             | (bit_of(targets, c) << jnp.uint32(CTRL_ADV)))
 
+    if halo is not None:
+        # ctrl halo: each candidate j reads row cinv[j] at ONE offset,
+        # so its halo is a single-sided |o_j|-word segment
+        stage_ctl[...] = jnp.stack(ctrl_pack)
+        for j_s, o_s, seg, hops in halo["ctl_segs"]:
+            r_s = cinv[j_s]
+            for h, take, pos in hops:
+                left_h, right_h = _nbr(h)
+                if o_s > 0:
+                    # receiver's segment covers [dS+S, dS+S+o): my
+                    # bottom slice feeds shard (d-h)'s segment
+                    _rdma(k_dma, stage_ctl.at[r_s, 0:take],
+                          ctl_halo.at[hslot, seg + pos:seg + pos + take],
+                          left_h)
+                else:
+                    # segment covers [dS-|o|, dS): my top slice feeds
+                    # shard (d+h)'s segment
+                    _rdma(k_dma, stage_ctl.at[r_s, N - take:N],
+                          ctl_halo.at[hslot, seg + pos:seg + pos + take],
+                          right_h)
+                k_dma += 1
+        # overlap tail: the next-tick gossip-target draw needs no halo
+        # — issue it while the boundary words are in flight
+        u_g = lane_u(seeds_ref[t, 3])
+        for rd in dmas_pending:
+            rd.wait()
+        p_l_h = halo["p_l"]
+        pay_rows = fresh + adv
+        ext_pay = []
+        for k in range(2 * W):
+            pieces = ([pay_l[hslot, k]] if pay_l is not None else []) \
+                + [pay_rows[k]] \
+                + ([pay_r[hslot, k]] if pay_r is not None else [])
+            ext_pay.append(jnp.concatenate(pieces)
+                           if len(pieces) > 1 else pieces[0])
+        seg_of = {j_s: (o_s, seg)
+                  for j_s, o_s, seg, _ in halo["ctl_segs"]}
+
+        def ctrl_view(j):
+            o = offsets[j]
+            row = ctrl_pack[cinv[j]]
+            if o == 0:
+                return row
+            seg = seg_of[j][1]
+            a = abs(o)
+            if o > 0:
+                pieces = ([row[o:]] if o < N else []) \
+                    + [ctl_halo[hslot, seg + max(0, o - N):seg + o]]
+            else:
+                pieces = [ctl_halo[hslot, seg:seg + min(a, N)]] \
+                    + ([row[:N - a]] if a < N else [])
+            return (jnp.concatenate(pieces) if len(pieces) > 1
+                    else pieces[0])
+
+        def pay_view(k, j):
+            return _flat_roll(ext_pay[k], p_l_h + offsets[j], N)
+    else:
+        def ctrl_view(j):
+            return _flat_roll(ctrl_pack[cinv[j]], deltas[j], N)
+
+        def pay_view(k, j):
+            return _flat_roll((fresh + adv)[k], deltas[j], N)
+
     heard = [jnp.zeros((N,), jnp.uint32) for _ in range(W)]
     graft_recv = jnp.zeros((N,), jnp.uint32)
     prune_recv = jnp.zeros((N,), jnp.uint32)
@@ -1784,8 +2018,7 @@ def _fused_gossip_kernel(*refs, cfg, n_true, w_words, hg, ticks,
         i1 = jnp.int32(1)
         i0 = jnp.int32(0)
     for j in range(C):
-        dj = deltas[j]
-        ctrl = _flat_roll(ctrl_pack[cinv[j]], dj, N)
+        ctrl = ctrl_view(j)
         m_f = (ctrl >> jnp.uint32(CTRL_OUT)) & u1
         m_g = (ctrl >> jnp.uint32(CTRL_TGT)) & u1
         g_r = (ctrl >> jnp.uint32(CTRL_GRAFT)) & u1
@@ -1802,8 +2035,8 @@ def _fused_gossip_kernel(*refs, cfg, n_true, w_words, hg, ticks,
             req_c = zi
             adv_nz = jnp.zeros((N,), jnp.bool_)
         for w in range(W):
-            fresh_q = _flat_roll(fresh[w], dj, N)
-            adv_q = _flat_roll(adv[w], dj, N)
+            fresh_q = pay_view(w, j)
+            adv_q = pay_view(W + w, j)
             fwd_q = jnp.where(fwd_on, fresh_q, Z)
             gsp_q = jnp.where(gsp_on, adv_q, Z)
             got = fwd_q | gsp_q
@@ -1867,7 +2100,8 @@ def _fused_gossip_kernel(*refs, cfg, n_true, w_words, hg, ticks,
         jnp.int32(cfg.d_lazy),
         (cfg.gossip_factor * n_el.astype(jnp.float32)).astype(
             jnp.int32))
-    u_g = lane_u(seeds_ref[t, 3])
+    if halo is None:     # sharded path drew u_g in the overlap tail
+        u_g = lane_u(seeds_ref[t, 3])
     if cfg.binomial_gossip_sampling:
         p_g = jnp.minimum(
             1.0, n_go.astype(jnp.float32)
@@ -1922,7 +2156,9 @@ def make_fused_gossip_update(cfg, n_true: int, w_words: int, hg: int,
                              cold_restart: bool = False,
                              with_telemetry: bool = False,
                              tel_lat_buckets: int = 0,
-                             vmem_limit_bytes: int = 128 * 1024 * 1024):
+                             vmem_limit_bytes: int = 128 * 1024 * 1024,
+                             axis_name: str | None = None,
+                             devices: int = 1):
     """Build the resident-window kernel caller (grid=(ticks,), whole
     shard per block).
 
@@ -1939,11 +2175,37 @@ def make_fused_gossip_update(cfg, n_true: int, w_words: int, hg: int,
     targets-gate, backoff-gate, acq u32 [T, W, N][, mesh_rows u32
     [T, N], tel i32 [T, 8 + L + 2, 128]]) — the resident carry after
     ``ticks`` ticks plus the per-tick emission rows.
+
+    With ``axis_name``/``devices`` (round 17) the caller is the
+    PER-SHARD body of a shard_map ring: ``n_true`` is the shard extent
+    S, ``stream_n`` must be the global ring (the uniform draws stay
+    global — bit-identity with single-device), and the pallas_call
+    gains the halo scratch (send stages, double-buffered halo slots,
+    DMA semaphore pairs per hop) the in-kernel remote-DMA boundary
+    exchange runs on.  Use ``sharded_fused_gossip_update`` for the
+    whole dispatch.
     """
     C = cfg.n_candidates
     N = n_true
     W = w_words
-    if N % FUSED_ALIGN != 0:
+    halo = None
+    if axis_name is not None:
+        if devices < 2:
+            raise ValueError(
+                "fused sharded kernel needs devices >= 2 "
+                f"(got {devices})")
+        if stream_n is None or stream_n != N * devices:
+            raise ValueError(
+                "fused sharded kernel needs stream_n == S * devices "
+                f"(the global ring); got stream_n={stream_n}, "
+                f"S={N}, devices={devices}")
+        if N % FUSED_SHARD_TILE != 0:
+            raise ValueError(
+                "kernel_ticks_fused: sharded windows need whole "
+                f"{FUSED_SHARD_TILE}-lane tiles per shard; got "
+                f"S={N}")
+        halo = fused_halo_spec(cfg.offsets, N, devices)
+    elif N % FUSED_ALIGN != 0:
         raise ValueError(
             f"fused kernel needs n_true % {FUSED_ALIGN} == 0 (whole-"
             f"ring lane rolls); got {N}")
@@ -1951,7 +2213,8 @@ def make_fused_gossip_update(cfg, n_true: int, w_words: int, hg: int,
         _fused_gossip_kernel, cfg=cfg, n_true=n_true, w_words=w_words,
         hg=hg, ticks=ticks, stream_n=stream_n,
         with_faults=with_faults, cold_restart=cold_restart,
-        with_telemetry=with_telemetry, tel_lat_buckets=tel_lat_buckets)
+        with_telemetry=with_telemetry, tel_lat_buckets=tel_lat_buckets,
+        halo=halo, axis_name=axis_name, devices=devices)
 
     smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)  # noqa: E731
     b1c = lambda: pl.BlockSpec((N,), lambda t: (0,))  # noqa: E731
@@ -1997,14 +2260,107 @@ def make_fused_gossip_update(cfg, n_true: int, w_words: int, hg: int,
                       pl.BlockSpec((1, n_tel, 128),
                                    lambda t: (t, 0, 0))]
 
+    scratch = []
+    if halo is not None:
+        u32 = jnp.uint32
+        scratch += [pltpu.VMEM((C, N), u32),        # stage_ctl
+                    pltpu.VMEM((2 * W, N), u32)]    # stage_pay
+        if halo["p_l"]:
+            scratch.append(pltpu.VMEM((2, 2 * W, halo["p_l"]), u32))
+        if halo["p_r"]:
+            scratch.append(pltpu.VMEM((2, 2 * W, halo["p_r"]), u32))
+        if halo["ctl_words"]:
+            scratch.append(pltpu.VMEM((2, halo["ctl_words"]), u32))
+        scratch += [pltpu.SemaphoreType.DMA((halo["n_dmas"],)),
+                    pltpu.SemaphoreType.DMA((halo["n_dmas"],))]
+
     return pl.pallas_call(
         kern,
         out_shape=tuple(out_shape),
         grid=(ticks,),
         in_specs=in_specs,
         out_specs=tuple(out_specs),
+        scratch_shapes=scratch,
         interpret=interpret,
         compiler_params=_compiler_params_cls()(
             vmem_limit_bytes=vmem_limit_bytes,
         ),
     )
+
+
+def sharded_fused_gossip_update(cfg, n_true: int, w_words: int, hg: int,
+                                ticks: int, *, mesh, axis_name: str,
+                                interpret: bool = False,
+                                with_faults: bool = False,
+                                cold_restart: bool = False,
+                                with_telemetry: bool = False,
+                                tel_lat_buckets: int = 0,
+                                vmem_limit_bytes: int = 128 * 1024 * 1024):
+    """Multi-chip RESIDENT-window dispatch (round 17): shard_map over
+    the peer axis, ONE fused pallas invocation per shard whose
+    in-kernel remote DMAs carry the ring-halo boundary words between
+    ticks of the sequential ``(ticks,)`` grid — the per-shard carry
+    never leaves VMEM inside the window.
+
+    Same call signature as the ``make_fused_gossip_update`` caller
+    (INCLUDING the base placeholder at operand 3 — the body replaces
+    it with the shard's global peer offset), same outputs with global
+    [*, N] shapes; the telemetry lane-partials come back psum'd
+    (i32 — exact, order-free), so frame assembly upstream is
+    unchanged.  Bit-identity with the single-device window follows
+    from the global ``stream_n`` draws + per-shard ``base``, exactly
+    as in the per-tick sharded dispatch.
+    """
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:        # older jax
+        from jax.experimental.shard_map import shard_map
+
+    D = mesh.shape[axis_name]
+    S = n_true // D
+    if n_true % D != 0:
+        raise ValueError(
+            f"fused sharded kernel needs n_true divisible by D={D}; "
+            f"got {n_true}")
+    krn = make_fused_gossip_update(
+        cfg, S, w_words, hg, ticks, interpret=interpret,
+        stream_n=n_true, with_faults=with_faults,
+        cold_restart=cold_restart, with_telemetry=with_telemetry,
+        tel_lat_buckets=tel_lat_buckets,
+        vmem_limit_bytes=vmem_limit_bytes,
+        axis_name=axis_name, devices=D)
+
+    lat = bool(with_telemetry and tel_lat_buckets)
+    n_smem = 4 + (1 if lat else 0)       # tick0, seeds, due, base(, latmask)
+
+    def body(*ops):
+        d = jax.lax.axis_index(axis_name)
+        base = (jnp.uint32(S) * d.astype(jnp.uint32)).reshape(1)
+        ops = list(ops)
+        ops[3] = base
+        outs = tuple(krn(*ops))
+        if with_telemetry:
+            outs = outs[:-1] + (jax.lax.psum(outs[-1], axis_name),)
+        return outs
+
+    ax = axis_name
+    in_specs = tuple(
+        [P()] * n_smem
+        + [P(ax), P(ax), P(None, ax)]                # sub, csub, origin
+        + ([P(None, ax)] if lat else [])             # deliver_eff
+        + [P(None, ax), P(None, ax), P(ax), P(ax),   # have, rec, mesh, fan
+           P(ax), P(None, ax), P(ax), P(ax)]         # lp, bo, tgt, bog
+        + ([P(None, ax)] * 3 if with_faults else [])
+        + ([P(None, ax)] if cold_restart else []))
+    out_specs = tuple(
+        [P(None, ax), P(None, ax), P(ax), P(ax), P(ax),
+         P(None, ax), P(ax), P(ax), P(None, None, ax)]
+        + ([P(None, ax), P(None, None)] if with_telemetry else []))
+    try:
+        fn = shard_map(body, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False)
+    except TypeError:          # older jax: check_rep instead
+        fn = shard_map(body, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_rep=False)
+    return fn

@@ -84,7 +84,8 @@ __all__ = [
     "ckpt_gossip_run_knob_batch", "ckpt_telemetry_run",
     "ckpt_flood_run", "ckpt_flood_run_curve",
     "ckpt_randomsub_run", "ckpt_randomsub_run_curve",
-    "ckpt_sharded_gossip_run", "ckpt_sharded_gossip_run_knob_batch",
+    "ckpt_sharded_gossip_run", "ckpt_sharded_gossip_run_fused",
+    "ckpt_sharded_gossip_run_knob_batch",
     "segment_dispatch",
 ]
 
@@ -1006,6 +1007,36 @@ def ckpt_sharded_gossip_run(params, state, n_ticks: int, step,
 
     def seg(s, n):
         return sharded_gossip_run(params, s, n, step, shardings), None
+    return _run_segmented(seg, state, n_ticks, ckpt,
+                          shardings=shardings)[0]
+
+
+def ckpt_sharded_gossip_run_fused(params, state, n_ticks: int,
+                                  window, shardings,
+                                  ckpt: CheckpointConfig):
+    """sharded_gossip_run_fused, segmented (round 17): segments scan
+    RESIDENT windows on the mesh, so both composition contracts apply
+    at once — the segment boundary must land ON a window boundary
+    (the ckpt_gossip_run_fused mid-window refusal, by name: there is
+    no mid-window carry to save while it sits in VMEM) and snapshots
+    hold host-side FULL arrays so resume re-places under any device
+    count (the D→D' restore contract)."""
+    from ..models.gossipsub import _check_fused_horizon
+    from .sharded import sharded_gossip_run_fused
+
+    ticks_fused = int(getattr(window, "ticks_fused", 1))
+    every = int(ckpt.every) or int(n_ticks)
+    if every % ticks_fused != 0:
+        raise ValueError(
+            f"ckpt segment boundary mid-window: CheckpointConfig."
+            f"every={int(ckpt.every)} is not a multiple of "
+            f"ticks_fused={ticks_fused} — align the segment length to "
+            "the fused window")
+    _check_fused_horizon(n_ticks, ticks_fused)
+
+    def seg(s, n):
+        return sharded_gossip_run_fused(params, s, n, window,
+                                        shardings), None
     return _run_segmented(seg, state, n_ticks, ckpt,
                           shardings=shardings)[0]
 

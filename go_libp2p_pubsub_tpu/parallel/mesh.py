@@ -60,6 +60,31 @@ def check_peer_divisible(n_peers: int, mesh: Mesh,
     return D
 
 
+def check_fused_shardable(n_true: int, mesh: Mesh, offsets) -> int:
+    """Round-17 twin of check_peer_divisible for the RESIDENT window:
+    validate up front that the fused in-kernel-halo dispatch can place
+    ``n_true`` peers over the mesh — even shards, whole lane tiles per
+    shard, and a candidate reach the ring's halo exchange can cover —
+    raising the same NAMED ``kernel_ticks_fused:`` errors the
+    capability dispatch reports, instead of a shape blow-up inside
+    shard_map.  Returns D."""
+    from ..ops.pallas.receive import FUSED_SHARD_TILE, fused_halo_spec
+    D = int(mesh.shape[PEER_AXIS])
+    if n_true % D != 0:
+        raise ValueError(
+            f"kernel_ticks_fused: sharded windows need n_true "
+            f"divisible by devices={D}; got {n_true}")
+    S = n_true // D
+    if S % FUSED_SHARD_TILE != 0:
+        raise ValueError(
+            f"kernel_ticks_fused: sharded windows need whole "
+            f"{FUSED_SHARD_TILE}-lane tiles per shard "
+            f"(S % {FUSED_SHARD_TILE} == 0); got S={S} at "
+            f"n={n_true}, devices={D}")
+    fused_halo_spec(offsets, S, D)   # raises by name on halo overreach
+    return D
+
+
 def shard_peer_tree(tree, mesh: Mesh, n_peers: int,
                     block: int | None = None):
     """Place every array in the pytree: arrays with a peer-sized axis are

@@ -32,6 +32,7 @@ from .mesh import PEER_AXIS, check_peer_divisible, shard_peer_tree
 __all__ = [
     "peer_spec", "peer_spec_tree", "named_sharding_tree", "shard_sim",
     "sharded_gossip_run", "sharded_gossip_run_curve",
+    "sharded_gossip_run_fused", "sharded_gossip_run_curve_fused",
     "sharded_gossip_run_knob_batch", "collective_stats",
 ]
 
@@ -104,6 +105,48 @@ def sharded_gossip_run_curve(params, state, n_ticks: int, step,
         return s2, count_bits_per_position(delivered, n_msgs)
     state, counts = jax.lax.scan(body, state, None, length=n_ticks)
     return state, counts
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4), donate_argnums=(1,))
+def sharded_gossip_run_fused(params, state, n_ticks: int, window,
+                             shardings):
+    """gossip_run_fused on the mesh (round 17): the horizon chunks
+    into ``n_ticks / window.ticks_fused`` RESIDENT windows — one
+    in-kernel-halo pallas dispatch per shard per window — with the
+    carry re-constrained to the input placement between windows.
+    Build ``window`` with ``shard_mesh=``; the final state is
+    bit-identical to the single-device ``gossip_run_fused`` (and so
+    to the per-tick runners).  A horizon the window does not divide
+    raises by name; carry donated as in every runner."""
+    from ..models.gossipsub import _check_fused_horizon
+    n_win = _check_fused_horizon(n_ticks, window.ticks_fused)
+
+    def body(s, _):
+        s2 = window(params, s)[0]
+        return jax.lax.with_sharding_constraint(s2, shardings), None
+    state, _ = jax.lax.scan(body, state, None, length=n_win)
+    return state
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4, 5), donate_argnums=(1,))
+def sharded_gossip_run_curve_fused(params, state, n_ticks: int,
+                                   window, shardings, n_msgs: int):
+    """gossip_run_curve_fused, carry-pinned on the mesh: per-tick
+    delivered counts [n_ticks, M] come back replicated (the popcount
+    reduction over the sharded peer axis lowers to an all-reduce),
+    rows bit-identical to the per-tick runners'."""
+    from ..models.gossipsub import (_check_fused_horizon,
+                                    count_bits_per_position)
+    n_win = _check_fused_horizon(n_ticks, window.ticks_fused)
+
+    def body(s, _):
+        s2, delivered = window(params, s)[:2]
+        s2 = jax.lax.with_sharding_constraint(s2, shardings)
+        return s2, jnp.stack([
+            count_bits_per_position(delivered[t], n_msgs)
+            for t in range(window.ticks_fused)])
+    state, counts = jax.lax.scan(body, state, None, length=n_win)
+    return state, counts.reshape(n_ticks, n_msgs)
 
 
 @partial(jax.jit, static_argnums=(2, 3, 4), donate_argnums=(1,))

@@ -215,7 +215,8 @@ def _kernel_parts():
     return build, step, s_ref
 
 
-@pytest.mark.parametrize("every", EVERIES)
+@pytest.mark.parametrize(
+    "every", [5, pytest.param(3, marks=pytest.mark.slow)])
 def test_kernel_segmented_bit_identity(every, tmp_path):
     build, step, s_ref = _kernel_parts()
     params, state = build()
@@ -373,6 +374,7 @@ except ck.CheckpointInterrupt as e:
 """
 
 
+@pytest.mark.slow
 def test_sigterm_killed_subprocess_resumes_identically(tmp_path):
     """A REAL SIGTERM against a running child process: the installed
     handlers defer it, the child finishes its in-flight segment,
@@ -434,6 +436,7 @@ def test_sigterm_killed_subprocess_resumes_identically(tmp_path):
 
 # -- sharded: D -> D' re-placement -----------------------------------------
 
+@pytest.mark.slow
 def test_sharded_save_d4_resume_d8_bit_identity(tmp_path):
     """Snapshots hold host-side full arrays, so restore re-places them
     under ANY shard_sim layout: save under a 4-device mesh, resume

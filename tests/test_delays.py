@@ -167,15 +167,18 @@ def _run_gossip(delays, *, kernel=False, split=False, score=True,
     return state
 
 
+@pytest.mark.slow
 def test_identity_gossip_combined():
     _assert_state_equal(_run_gossip(None), _run_gossip(IDENTITY))
 
 
+@pytest.mark.slow
 def test_identity_gossip_split():
     _assert_state_equal(_run_gossip(None, split=True),
                         _run_gossip(IDENTITY, split=True))
 
 
+@pytest.mark.slow
 def test_identity_gossip_kernel_interpret():
     # true lanes only: pad-lane LEDGER rows are garbage-tolerated by
     # contract (iwant_serve_level docstring) and legitimately differ
@@ -247,6 +250,7 @@ def test_identity_randomsub_circulant_and_dense():
 # --------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_delays_slow_dissemination_and_kernel_parity():
     """Heterogeneous delays genuinely slow the pipeline (fewer
     possession bits after the same tick budget) and the pallas kernel
@@ -335,6 +339,7 @@ def test_delay_knob_validation():
                            sim_knobs={"delay_base": 2})
 
 
+@pytest.mark.slow
 def test_delayed_latency_hist_sums_and_multibucket():
     """Under delays the latency histogram is a REAL multi-bucket
     distribution whose per-tick sums still equal the delivered
@@ -374,6 +379,7 @@ def test_delayed_latency_hist_sums_and_multibucket():
                                   frames_by_path[True])
 
 
+@pytest.mark.slow
 def test_invariants_green_under_delays_with_cold_restart():
     subs, topic, origin, tks = _inputs()
     cfg = _gossip_cfg()

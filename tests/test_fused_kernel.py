@@ -4,12 +4,15 @@ window of T ticks folded into ONE pallas_call with the per-shard carry
 resident in VMEM across the sequential ``(ticks,)`` grid is
 BIT-IDENTICAL to T per-tick steps — against the per-tick kernel AND
 the XLA step — for T in {2, 4, 8}, with telemetry frames, fault
-schedules, and cold-restart rejoin armed; the sharded window falls
-back BY NAME to the scan-of-steps form and stays bit-identical on the
-virtual mesh at D in {2, 4}; and every configuration where residency
-is impossible (scored carry, delay lines, unpadded layout, carry past
-the VMEM budget) is refused by a named ``kernel_ticks_fused:`` reason
-that reports the working-set bytes.
+schedules, and cold-restart rejoin armed; the sharded window (round
+17) runs RESIDENT with the ring-halo exchange inside the kernel
+(remote DMA between grid ticks, double-buffered halo slots) and stays
+bit-identical on the virtual mesh at D in {2, 4} — including faults +
+telemetry, the runner twins, and the ckpt composition; and every
+configuration where residency is impossible (scored carry, delay
+lines, unpadded layout, shard tiles/halo reach, carry past the VMEM
+budget) is refused by a named ``kernel_ticks_fused:`` reason that
+reports the working-set bytes.
 
 Identity is exact array equality over the full state pytree plus the
 delivered words and every telemetry-frame leaf — the same contract the
@@ -169,7 +172,8 @@ def _tel_ref(faults=False):
 
 # -- resident-path parity: fused T ticks == T per-tick steps ---------------
 
-@pytest.mark.parametrize("Tw", [2, 4, 8])
+@pytest.mark.parametrize(
+    "Tw", [pytest.param(2, marks=pytest.mark.slow), 4, 8])
 def test_fused_matches_per_tick_kernel(Tw):
     s_ref, d_ref = _kernel_ref()
     cfg, params, state = _build()
@@ -190,6 +194,7 @@ def test_fused_matches_xla_step():
 
 
 @pytest.mark.parametrize("Tw", [2, 8])
+@pytest.mark.slow
 def test_fused_telemetry_frames_bit_identical(Tw):
     tel = tl.TelemetryConfig(counters=True, wire=True, mesh=True,
                              degree_hist=True, latency_hist=True,
@@ -203,6 +208,7 @@ def test_fused_telemetry_frames_bit_identical(Tw):
 
 
 @pytest.mark.parametrize("Tw", [4])
+@pytest.mark.slow
 def test_fused_with_faults(Tw):
     s_ref, d_ref = _kernel_ref(faults=True)
     cfg, params, state = _build(fault_schedule=_fault_sched())
@@ -211,6 +217,7 @@ def test_fused_with_faults(Tw):
     assert _state_equal(s, s_ref)
 
 
+@pytest.mark.slow
 def test_fused_with_faults_and_telemetry():
     tel = tl.TelemetryConfig(counters=True, wire=True, mesh=True,
                              degree_hist=True, latency_hist=True,
@@ -223,6 +230,7 @@ def test_fused_with_faults_and_telemetry():
     assert _trees_equal(fr, fr_ref)
 
 
+@pytest.mark.slow
 def test_fused_cold_restart_rejoin():
     s_ref, d_ref = _kernel_ref(faults=True, cold=True)
     cfg, params, state = _build(fault_schedule=_fault_sched(True))
@@ -316,34 +324,221 @@ def test_ckpt_fused_aligned_bit_identity(tmp_path):
     assert _state_equal(s, s_ref)
 
 
-# -- sharded dispatch: named fallback, bit-identical at D in {2, 4} --------
+# -- sharded dispatch (round 17): in-kernel halo, resident at D in {2,4} --
 
-@pytest.mark.parametrize("D", [2, 4])
-def test_sharded_window_falls_back_by_name_and_matches(D):
+@pytest.mark.parametrize(
+    "D", [2, pytest.param(4, marks=pytest.mark.slow)])
+def test_sharded_window_resident_bit_identity(D):
+    """The COMPOSED path: fused window × shard_map with the ring-halo
+    boundary exchange inside the kernel (remote DMA between grid
+    ticks) — capability accepts, and the sharded resident trajectory
+    equals the single-device per-tick kernel bit for bit."""
     from go_libp2p_pubsub_tpu.parallel import mesh as pm
     from go_libp2p_pubsub_tpu.parallel import sharded as ps
 
-    # the ring-halo kernel needs n_true % (D*block) == 0: block 256
-    # keeps one plan valid for D in {2, 4} at N=1024
-    cfg, params, state = _build()
-    step = gs.make_gossip_step(cfg, None, receive_interpret=True,
-                               receive_block=256)
-    s_ref = gs.gossip_run(params, state, 8, step)
-
+    s_ref, d_ref = _kernel_ref()
     mesh = pm.make_mesh(D)
     cfg, params, state = _build()
     params_s, state_s, _sh = ps.shard_sim(params, state, mesh, N)
     win = gs.make_fused_window(cfg, None, ticks_fused=4,
-                               receive_block=256,
+                               receive_block=BLOCK,
                                receive_interpret=True,
-                               shard_mesh=mesh)
-    reason = win.capability(params_s, state_s)
-    assert reason is not None and "kernel_ticks_fused" in reason
-    assert "shard_map" in reason     # the named sharded fallback
-    s = state_s
+                               shard_mesh=mesh, on_refusal="raise")
+    assert win.capability(params_s, state_s) is None
+    s, dl = state_s, []
     for _ in range(2):
-        s = win(params_s, s)[0]
+        out = win(params_s, s)
+        s = out[0]
+        dl.append(np.asarray(out[1]))
+    assert np.array_equal(np.concatenate(dl), d_ref)
     assert _state_equal(s, s_ref)
+
+
+def test_sharded_window_faults_telemetry_bit_identity():
+    """Faults + cold-restart rejoin + the full telemetry surface on
+    the composed path at D=2: states, delivered words, and every
+    frame leaf equal the single-device fused window's (which round-16
+    pinned to the scanned per-tick step)."""
+    from go_libp2p_pubsub_tpu.parallel import mesh as pm
+    from go_libp2p_pubsub_tpu.parallel import sharded as ps
+
+    tel = tl.TelemetryConfig(counters=True, wire=True, mesh=True,
+                             degree_hist=True, latency_hist=True,
+                             faults=True)
+    cfg, params, state = _build(fault_schedule=_fault_sched(True))
+    s_ref, d_ref, fr_ref = _run_windows(cfg, params, state, TICKS, 4,
+                                        tel=tel)
+    mesh = pm.make_mesh(2)
+    cfg, params, state = _build(fault_schedule=_fault_sched(True))
+    params_s, state_s, _sh = ps.shard_sim(params, state, mesh, N)
+    win = gs.make_fused_window(cfg, None, ticks_fused=4,
+                               receive_block=BLOCK,
+                               receive_interpret=True, telemetry=tel,
+                               shard_mesh=mesh, on_refusal="raise")
+    assert win.capability(params_s, state_s) is None
+    import jax
+    import jax.numpy as jnp
+    s, dl, frs = state_s, [], []
+    for _ in range(TICKS // 4):
+        out = win(params_s, s)
+        s = out[0]
+        dl.append(np.asarray(out[1]))
+        frs.append(out[2])
+    frames = jax.tree_util.tree_map(
+        lambda *xs: jnp.concatenate(xs), *frs)
+    assert np.array_equal(np.concatenate(dl), np.asarray(d_ref))
+    assert _state_equal(s, s_ref)
+    assert _trees_equal(frames, fr_ref)
+
+
+def test_sharded_window_double_buffer_hand_off():
+    """Halo double-buffer correctness: a T=4 window alternates halo
+    slots (t mod 2) and reads at tick t+1 exactly the boundary words
+    written at tick t; four T=1 windows only ever touch slot 0 with a
+    fresh exchange per dispatch.  Bit-identity between the two pins
+    the slot hand-off."""
+    from go_libp2p_pubsub_tpu.parallel import mesh as pm
+    from go_libp2p_pubsub_tpu.parallel import sharded as ps
+
+    mesh = pm.make_mesh(2)
+    cfg, params, state = _build()
+    params_s, state_s, _sh = ps.shard_sim(params, state, mesh, N)
+
+    def run(Tw, n_ticks):
+        win = gs.make_fused_window(cfg, None, ticks_fused=Tw,
+                                   receive_block=BLOCK,
+                                   receive_interpret=True,
+                                   shard_mesh=mesh,
+                                   on_refusal="raise")
+        s, dl = state_s, []
+        for _ in range(n_ticks // Tw):
+            out = win(params_s, s)
+            s = out[0]
+            dl.append(np.asarray(out[1]))
+        return s, np.concatenate(dl)
+
+    s4, d4 = run(4, 4)
+    s1, d1 = run(1, 4)
+    assert np.array_equal(d4, d1)
+    assert _state_equal(s4, s1)
+
+
+def test_sharded_runner_twins_bit_identity():
+    """sharded_gossip_run_fused / _curve_fused (carry-pinned mesh
+    runners) equal the single-device fused runners."""
+    from go_libp2p_pubsub_tpu.parallel import mesh as pm
+    from go_libp2p_pubsub_tpu.parallel import sharded as ps
+
+    cfg, params, state = _build()
+    win1 = _window(cfg, 4)
+    s_ref, c_ref = gs.gossip_run_curve_fused(params, state, TICKS,
+                                             win1, M)
+    mesh = pm.make_mesh(2)
+    cfg, params, state = _build()
+    winD = gs.make_fused_window(cfg, None, ticks_fused=4,
+                                receive_block=BLOCK,
+                                receive_interpret=True,
+                                shard_mesh=mesh, on_refusal="raise")
+    params_s, state_s, sh = ps.shard_sim(params, state, mesh, N)
+    s, c = ps.sharded_gossip_run_curve_fused(params_s, state_s, TICKS,
+                                             winD, sh, M)
+    assert np.array_equal(np.asarray(c), np.asarray(c_ref))
+    assert _state_equal(s, s_ref)
+
+
+def test_ckpt_sharded_fused_composes(tmp_path):
+    """ckpt × sharded × fused: aligned segments resume bit-identical;
+    a mid-window segment length is refused by name."""
+    from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
+    from go_libp2p_pubsub_tpu.parallel import mesh as pm
+    from go_libp2p_pubsub_tpu.parallel import sharded as ps
+
+    s_ref, _d = _kernel_ref()
+    mesh = pm.make_mesh(2)
+    cfg, params, state = _build()
+    winD = gs.make_fused_window(cfg, None, ticks_fused=4,
+                                receive_block=BLOCK,
+                                receive_interpret=True,
+                                shard_mesh=mesh, on_refusal="raise")
+    params_s, state_s, sh = ps.shard_sim(params, state, mesh, N)
+    ckc = ck.CheckpointConfig(directory=str(tmp_path / "snaps"),
+                              every=4)
+    s = ck.ckpt_sharded_gossip_run_fused(params_s, state_s, TICKS,
+                                         winD, sh, ckc)
+    assert _state_equal(s, s_ref)
+    ckc2 = ck.CheckpointConfig(directory=str(tmp_path / "snaps2"),
+                               every=6)
+    with pytest.raises(ValueError,
+                       match="ckpt segment boundary mid-window"):
+        ck.ckpt_sharded_gossip_run_fused(params_s, state_s, TICKS,
+                                         winD, sh, ckc2)
+
+
+# -- halo geometry: spec unit cases + named sharded refusals ---------------
+
+def test_fused_halo_spec_geometry():
+    from go_libp2p_pubsub_tpu.ops.pallas.receive import fused_halo_spec
+
+    offs = [3, -5, 0, 130, -200]
+    S, D = 128, 4
+    spec = fused_halo_spec(offs, S, D)
+    assert spec["p_l"] == 200 and spec["p_r"] == 130
+    # ctrl segments: one per nonzero offset, sum(|o|) words total
+    assert spec["ctl_words"] == sum(abs(o) for o in offs)
+    assert [j for j, _, _, _ in spec["ctl_segs"]] == [0, 1, 3, 4]
+    # payload hops tile each side in <= S-word pieces
+    for side, h, take, pos in spec["pay_hops"]:
+        assert 1 <= take <= S
+        p = spec["p_l"] if side == "l" else spec["p_r"]
+        assert 0 <= pos and pos + take <= p
+    assert spec["max_hop"] == 2          # 200 reaches 2 shards over
+    assert spec["n_dmas"] == len(spec["pay_hops"]) + sum(
+        len(h) for _, _, _, h in spec["ctl_segs"])
+
+
+def test_fused_halo_spec_overreach_refused_by_name():
+    from go_libp2p_pubsub_tpu.ops.pallas.receive import fused_halo_spec
+
+    with pytest.raises(ValueError, match="halo reach .* spans"):
+        fused_halo_spec([500], 128, 2)   # hop 4 >= D=2
+
+
+def test_refusal_sharded_tile_and_divisibility():
+    cfg, params, state = _build()
+    r = gs.kernel_ticks_fused_capability(
+        cfg, None, params, state, 4, sharded=True, devices=16)
+    assert r is not None and "whole 128-lane tiles per shard" in r
+    r = gs.kernel_ticks_fused_capability(
+        cfg, None, params, state, 4, sharded=True, devices=3)
+    assert r is not None and "divisible by devices" in r
+    r = gs.kernel_ticks_fused_capability(
+        cfg, None, params, state, 4, sharded=True, devices=1)
+    assert r is not None and "known device count" in r
+
+
+def test_refusal_sharded_delays_stays_per_tick():
+    """fused-sharded × delays: the honest hole that remains — the
+    K-slot dequeue runs between kernel ticks, so delay-armed sims keep
+    the per-tick refusal under shard_map too."""
+    cfg, params, state = _build(
+        delays=DelayConfig(base=2, jitter=1, k_slots=4))
+    r = gs.kernel_ticks_fused_capability(
+        cfg, None, params, state, 4, sharded=True, devices=2)
+    assert r is not None and "delay-armed sims stay per-tick" in r
+
+
+def test_refusal_sharded_vmem_reports_per_shard_set():
+    """The sharded VMEM refusal reports the PER-SHARD working set
+    including the halo/stage bytes, and a budget that refuses D=2
+    can accept D=4 (the per-shard carry halves)."""
+    cfg, params, state = _build()
+    r = gs.kernel_ticks_fused_capability(
+        cfg, None, params, state, 8, sharded=True, devices=2,
+        vmem_budget_bytes=1 << 16)
+    assert r is not None
+    assert "halo/stage" in r and "devices=2 (per-shard)" in r
+    assert gs.kernel_ticks_fused_capability(
+        cfg, None, params, state, 8, sharded=True, devices=2) is None
 
 
 # -- named refusals: every impossible residency reports WHY ---------------

@@ -172,7 +172,7 @@ def test_only_graftlint_fixture_dir_is_exempt(tmp_path):
 
 def test_declared_matrix_shape():
     combos = ja.declared_matrix()
-    assert len(combos) == 67
+    assert len(combos) == 70
     # base 32: all three sims x telemetry x faults x batched; split
     # axis only on gossipsub.  Round-10 variants: gather/dense
     # (tel x faults), rpc (tel, faulted), hist (faults, scored).
@@ -191,28 +191,32 @@ def test_declared_matrix_shape():
     # mode).  Round-15 variant: ckpt (the segmented checkpoint
     # engine's dispatch table traced at the split horizon — gossip
     # sequential + knob-batched, flood sequential).  Round-16
-    # variants: fused (the tick-resident window through
+    # variant: fused (the tick-resident window through
     # gossip_run_fused, plain + faulted, traced at the 1024-aligned
-    # fused shape) and fused-sharded (the named sharded fallback —
-    # must still show the round-14 shard_map/ppermute dispatch).
+    # fused shape).  Round-17 variant: fused-sharded (the COMPOSED
+    # dispatch — one resident pallas call per shard under shard_map
+    # with the in-kernel remote-DMA ring halo; telemetry x faults,
+    # the telemetry cases additionally asserting the cross-mesh
+    # frame psum).
     key = lambda c: (c["sim"], c["split"], c["telemetry"],  # noqa: E731
                      c["faults"], c["batched"], c["variant"])
-    assert len({key(c) for c in combos}) == 67
+    assert len({key(c) for c in combos}) == 70
     assert sum(not c["variant"] for c in combos) == 32
-    for sim, n in (("gossipsub", 38), ("floodsub", 15),
+    for sim, n in (("gossipsub", 41), ("floodsub", 15),
                    ("randomsub", 14)):
         assert sum(c["sim"] == sim for c in combos) == n
     for var, n in (("gather", 4), ("dense", 4), ("rpc", 2),
                    ("hist", 2), ("inv", 4), ("attack", 2),
                    ("knobs", 2), ("delays", 5), ("sharded", 2),
                    ("sharded-kernel", 1), ("sharded-kernel-delays", 1),
-                   ("ckpt", 3), ("fused", 2), ("fused-sharded", 1)):
+                   ("ckpt", 3), ("fused", 2), ("fused-sharded", 4)):
         assert sum(c["variant"] == var for c in combos) == n
     axes = {ax: {c[ax] for c in combos}
             for ax in ("telemetry", "faults", "batched")}
     assert all(v == {False, True} for v in axes.values())
 
 
+@pytest.mark.slow
 def test_audit_covers_matrix_without_compiling_a_sim():
     """The audit traces/lowers every declared combo and passes — under
     a backend-compile guard (the dispatch-count trace guard): building
@@ -388,6 +392,7 @@ def test_contract_telemetry_kernel_threaded_fast():
     assert ct._tel_probe("counters", "gossip-kernel", False)
 
 
+@pytest.mark.slow
 def test_contract_detects_an_undeclared_field(monkeypatch):
     """Adding a config field without a contract entry is a finding —
     the ratchet the checker exists for."""

@@ -360,6 +360,7 @@ def test_sweepd_round_trip_zero_recompiles():
     assert stats["configs_per_compile"] >= 6
 
 
+@pytest.mark.slow
 def test_sweepd_devices_round_trip_matches_single():
     """Round 14: a devices=4 server serves the same scenario stream as
     the single-device server with IDENTICAL result rows (the sharded

@@ -175,6 +175,7 @@ def test_kernel_matches_xla_serve_ledger_live():
     assert np.asarray(out_x.iwant_serves).max() > 0   # non-vacuous
 
 
+@pytest.mark.slow
 def test_kernel_matches_xla_v11_adversarial():
     """IHAVE-spam sybils + invalid traffic: the spam/valid gating and
     broken-promise P7 bookkeeping ride the kernel's ctrl bytes."""
@@ -186,6 +187,7 @@ def test_kernel_matches_xla_v11_adversarial():
     assert np.asarray(out_x.scores.behaviour_penalty).max() > 0
 
 
+@pytest.mark.slow
 def test_kernel_matches_xla_v11_iwant_flood():
     """BOTH gossip-repair attacks (IHAVE broken-promise spam + the
     IWANT retransmission flood) on the kernel path: the in-kernel
@@ -362,6 +364,7 @@ def test_kernel_matches_xla_paired(score):
     assert np.asarray(out_x.have).any()
 
 
+@pytest.mark.slow
 def test_kernel_matches_xla_everything_on():
     """The EVERYTHING-ON configuration on the kernel path: paired
     topics + PX rotation + direct peers + shared-IP sybils + both
@@ -414,9 +417,11 @@ def test_padded_state_requires_kernel():
         step(params, state)
 
 
-@pytest.mark.parametrize("score,variant",
-                         [(True, "plain"), (False, "plain"),
-                          (True, "loaded"), (True, "paired")])
+@pytest.mark.parametrize(
+    "score,variant",
+    [(True, "plain"), (False, "plain"),
+     pytest.param(True, "loaded", marks=pytest.mark.slow),
+     pytest.param(True, "paired", marks=pytest.mark.slow)])
 def test_sharded_kernel_matches_single_device(score, variant):
     """The shard_map multi-chip kernel dispatch (ring-halo exchange +
     per-shard kernel, ops/pallas/receive.sharded_receive) must produce
@@ -461,6 +466,7 @@ def test_sharded_kernel_matches_single_device(score, variant):
     assert np.asarray(out_1.have).any()
 
 
+@pytest.mark.slow
 def test_kernel_matches_xla_shared_ip_gater():
     """Shared-IP gater grouping on the kernel path (peer_gater.go:
     119-151): the in-kernel gate emission sums gater stats over
@@ -510,6 +516,7 @@ def test_kernel_matches_xla_shared_ip_gater():
     assert np.asarray(out_x.scores.invalid_deliveries).max() > 0
 
 
+@pytest.mark.slow
 def test_kernel_matches_xla_aligned_wrap():
     """Aligned plan (n divisible by the u8 tile alignment and the
     block): DMA starts computed mod n at run time, composes reduced to
@@ -551,6 +558,7 @@ def test_kernel_slots_env_validated_at_import():
 
 
 @pytest.mark.parametrize("score", [True, False])
+@pytest.mark.slow
 def test_kernel_matches_xla_faults(score):
     """Churn + link loss + a mid-run partition on the kernel path:
     the per-tick alive/link mask words ride the ctrl bytes (sender
@@ -567,6 +575,7 @@ def test_kernel_matches_xla_faults(score):
     assert np.asarray(out_x.have).any()
 
 
+@pytest.mark.slow
 def test_kernel_matches_xla_telemetry_frames():
     """Telemetry through the kernel: the in-kernel counter tallies
     (RPC sends by type, duplicates, bytes-on-wire) and the epilogue
@@ -594,6 +603,7 @@ def test_kernel_matches_xla_telemetry_frames():
                                   np.asarray(out_k_plain.mesh))
 
 
+@pytest.mark.slow
 def test_kernel_matches_xla_faults_plus_telemetry():
     """Faults AND telemetry at once on the kernel path — the two
     ROADMAP workloads together: fault counters land in the frames,
@@ -611,6 +621,7 @@ def test_kernel_matches_xla_faults_plus_telemetry():
     assert ax["payload_sent"].sum() > 0
 
 
+@pytest.mark.slow
 def test_kernel_matches_xla_faults_iwant_flood():
     """IWANT-retransmission-flood sybils UNDER faults: the in-kernel
     flood accrual is gated by the send-ok ∧ cand-alive operand (a
@@ -629,6 +640,7 @@ def test_kernel_matches_xla_faults_iwant_flood():
     assert np.asarray(out_x.iwant_serves).max() > 0
 
 
+@pytest.mark.slow
 def test_kernel_matches_xla_batched_fault_seeds():
     """Batched-over-seeds faulted replicas: the XLA batched runner
     (vmapped step, per-replica fault seeds) against the kernel run
@@ -673,6 +685,7 @@ def test_kernel_matches_xla_batched_fault_seeds():
     assert (h[0] != h[1]).any() or (h[0] != h[2]).any()
 
 
+@pytest.mark.slow
 def test_kernel_zero_fault_schedule_bit_identical():
     """A zero-fault schedule through the kernel == no schedule at all
     (the masks are all-ones; masking with them is the identity) — the
@@ -695,6 +708,7 @@ def test_kernel_zero_fault_schedule_bit_identical():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_sharded_kernel_faults_telemetry():
     """Faults + telemetry through the SHARDED kernel dispatch: the
     per-peer mask operands shard like any blocked operand, the tel
@@ -783,6 +797,7 @@ def test_kernel_faults_telemetry_full_matrix(variant):
                                       np.asarray(out_k.active)[:n])
 
 
+@pytest.mark.slow
 def test_kernel_histogram_frames_bit_identical_to_xla():
     """Round 10: the in-kernel latency-bucket tallies (TEL_ROWS..
     rows of the tel output) and the epilogue degree/score histograms
@@ -818,6 +833,7 @@ def test_kernel_histogram_frames_bit_identical_to_xla():
     assert lat.sum() > 0
 
 
+@pytest.mark.slow
 def test_kernel_latency_hist_without_counters():
     """latency_hist alone (counters off) still routes the kernel's
     tel output: the bucket rows ride without the counter groups and
@@ -845,6 +861,7 @@ def test_kernel_latency_hist_without_counters():
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_kernel_rpc_probe_matches_xla_trajectory():
     """rpc_probe on the kernel path: pure readout (trajectory equals
     the probe-free kernel run), and the probe's [:n] leaves equal the

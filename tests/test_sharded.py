@@ -96,6 +96,7 @@ def _armed_run_ref():
 
 
 @pytest.mark.parametrize("D", [2, 4, 8])
+@pytest.mark.slow
 def test_xla_everything_on_bit_identity(D):
     """GSPMD placement + telemetry_run: delays + faults + sybil
     ihave-spam + latency-hist telemetry, state AND frames identical."""
@@ -112,6 +113,7 @@ def test_xla_everything_on_bit_identity(D):
 
 
 @pytest.mark.parametrize("D", [2, 4, 8])
+@pytest.mark.slow
 def test_xla_pinned_runner_bit_identity(D):
     """The carry-pinned sharded_gossip_run (with_sharding_constraint
     every tick) against single-device gossip_run — delays + faults +
@@ -151,6 +153,7 @@ def _kernel_tel_parts():
 
 @pytest.mark.parametrize(
     "D", [2, pytest.param(4, marks=pytest.mark.slow)])
+@pytest.mark.slow
 def test_kernel_faults_telemetry_bit_identity(D):
     """shard_map kernel dispatch (ring-halo ppermutes + telemetry
     psum) with faults on: identical to the single-device kernel.
@@ -202,6 +205,7 @@ def _kernel_delay_parts():
 
 @pytest.mark.parametrize(
     "D", [2, pytest.param(4, marks=pytest.mark.slow)])
+@pytest.mark.slow
 def test_kernel_delays_bit_identity(D):
     """The round-14 lift: delays x sharded kernel (previously a named
     refusal).  The delay-mode kernel has no sender streams, so the
@@ -221,8 +225,47 @@ def test_kernel_delays_bit_identity(D):
     assert _trees_equal(s_ref, s_D)
 
 
+# -- fused x sharded (round 17): resident windows with in-kernel halo ------
+
+@pytest.mark.parametrize(
+    "D", [2, pytest.param(4, marks=pytest.mark.slow)])
+def test_fused_sharded_resident_bit_identity(D):
+    """The round-17 lift: fused windows x sharded dispatch (previously
+    a named refusal) — the in-kernel remote-DMA halo keeps the
+    per-shard carry VMEM-resident across the window, and the composed
+    trajectory equals the single-device per-tick XLA step bit for bit,
+    faults included.  Note the composition also EXTENDS coverage: at
+    N=512 the single-device fused window is refused (n % 1024), but
+    the per-shard tile constraint (S % 128) admits D in {2, 4}."""
+    cfg, subs, topic, origin, tick0 = _scenario()
+
+    def build():
+        return gs.make_gossip_sim(
+            cfg, subs, topic, origin, tick0, seed=3,
+            fault_schedule=_faults(), track_first_tick=False,
+            pad_to_block=BLOCK)
+
+    step1 = gs.make_gossip_step(cfg, None, receive_block=BLOCK,
+                                receive_interpret=True)
+    params, state = build()
+    s_ref = gs.gossip_run(params, state, 8, step1)
+
+    mesh = pm.make_mesh(D)
+    win = gs.make_fused_window(cfg, None, ticks_fused=4,
+                               receive_block=BLOCK,
+                               receive_interpret=True,
+                               shard_mesh=mesh, on_refusal="raise")
+    params, state = build()
+    params_s, state_s, shardings = ps.shard_sim(params, state, mesh, N)
+    assert win.capability(params_s, state_s) is None
+    s_D = ps.sharded_gossip_run_fused(params_s, state_s, 8, win,
+                                      shardings)
+    assert _trees_equal(s_ref, s_D)
+
+
 # -- batched over seeds -----------------------------------------------------
 
+@pytest.mark.slow
 def test_knob_batch_over_seeds_bit_identity():
     """sweepd's device side on the mesh: B seed-replicas stacked on a
     leading axis, peer axis still sharded, one carry-pinned scan of

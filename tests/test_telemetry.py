@@ -81,6 +81,7 @@ def test_wire_sizes_match_pb_encodings():
 
 
 @pytest.mark.parametrize("scored", [False, True])
+@pytest.mark.slow
 def test_gossip_state_identical_with_telemetry(scored):
     cfg, subs, topic, origin, ticks = gossip_inputs()
     sc = gs.ScoreSimConfig() if scored else None
@@ -99,6 +100,7 @@ def test_gossip_state_identical_with_telemetry(scored):
     assert arr["graft_sends"].sum() > 0
 
 
+@pytest.mark.slow
 def test_gossip_split_path_state_identical_with_telemetry():
     """The force_split (separate mesh/gossip loop) formulation carries
     its own telemetry tallies — state must stay untouched there too."""
@@ -187,6 +189,7 @@ def test_pallas_step_accepts_telemetry():
 # --------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_batched_frames_match_sequential():
     cfg, subs, topic, origin, ticks = gossip_inputs(n=300, t=3, m=6)
     sc = gs.ScoreSimConfig()
@@ -371,6 +374,7 @@ def hist_tcfg(**kw):
     return tl.TelemetryConfig(**base)
 
 
+@pytest.mark.slow
 def test_histogram_sums_match_scalar_counters():
     """Every histogram sums exactly to its population: latency to the
     tick's delivered-copy count, degree to the subscribed-peer count,
@@ -403,6 +407,7 @@ def test_histogram_sums_match_scalar_counters():
         sco.sum(axis=1), np.full(sco.shape[0], mask.sum()))
 
 
+@pytest.mark.slow
 def test_histogram_off_trajectory_identical_and_consistent_stats():
     """Enabling histogram groups must not perturb the run: the state
     trajectory AND the scalar frame groups are bit-identical with and
@@ -439,6 +444,7 @@ def test_histogram_off_trajectory_identical_and_consistent_stats():
         maxs, np.minimum(np.asarray(fr_on.mesh_deg_max), cap))
 
 
+@pytest.mark.slow
 def test_latency_histogram_batched_matches_sequential():
     cfg, subs, topic, origin, ticks = gossip_inputs(n=300)
     spec = dict(subs=subs, msg_topic=topic, msg_origin=origin,
@@ -541,6 +547,7 @@ def test_randomsub_dense_telemetry_subset_with_faults():
     assert tree_equal(fin, fin2)
 
 
+@pytest.mark.slow
 def test_latency_hists_by_topic_sum_to_device_hist():
     """The host-side per-topic split adds up to the device-side
     latency_hist frames exactly — two views of the same deliveries."""

@@ -3,6 +3,8 @@ and reproduce the sim's reachability curves (SURVEY.md §5.1 contract)."""
 
 import json
 
+import pytest
+
 import numpy as np
 
 from go_libp2p_pubsub_tpu.interop.export import (
@@ -402,6 +404,7 @@ def test_reject_events_match_invalid_acquisitions():
                    for e in events)
 
 
+@pytest.mark.slow
 def test_duplicate_events_match_telemetry_dup_counter():
     """The eager-forward replay's per-tick DUPLICATE_MESSAGE count
     EQUALS the telemetry seen-cache counter on a gossip-free,
@@ -446,6 +449,7 @@ def test_duplicate_events_match_telemetry_dup_counter():
     assert per_tick.sum() > 0                         # non-vacuous
 
 
+@pytest.mark.slow
 def test_duplicate_events_paired_mode_matches_telemetry():
     """Paired-topic runs: with mesh_b_snapshots + slot_b_words the
     replay splits each sender's fresh set by topic slot and walks
@@ -576,6 +580,7 @@ def all_13_events(run):
                                   rpcs)
 
 
+@pytest.mark.slow
 def test_full_faulted_run_exports_all_13_types_and_replays(tmp_path):
     """THE acceptance pin: one faulted gossipsub run exports every one
     of the reference's 13 TraceEvent types; written with
@@ -604,6 +609,7 @@ def test_full_faulted_run_exports_all_13_types_and_replays(tmp_path):
     np.testing.assert_array_equal(mesh_rt, np.asarray(out.mesh))
 
 
+@pytest.mark.slow
 def test_rpc_stream_aggregates_equal_telemetry_counters():
     """On a fault-free unscored run, the per-RPC stream's per-tick
     aggregates equal the telemetry counters EXACTLY: two independent
@@ -671,6 +677,7 @@ def test_rpc_stream_aggregates_equal_telemetry_counters():
     np.testing.assert_array_equal(agg["prune"], arrs["prune_sends"])
 
 
+@pytest.mark.slow
 def test_rpc_stream_drop_rpc_under_faults():
     """Fault-masked edges emit DROP_RPC: with link loss and churn the
     stream carries drops; dead senders attempt nothing (no event with
@@ -754,6 +761,7 @@ def test_peer_events_churn_semantics():
                               (5, 4, 3)]
 
 
+@pytest.mark.slow
 def test_tracestat_frames_percentiles_and_check_gate(tmp_path):
     """tracestat prefers histogram frames for latency percentiles,
     reports 13/13 coverage, and the --check gate passes against its

@@ -1052,18 +1052,41 @@ def _probe_fused_delays():
     win(params, state)   # must raise
 
 
-def _probe_fused_sharded():
-    """The sharded dispatch keeps the per-tick kernel (ring-halo
-    leaves VMEM every tick) — the fused window refuses by name."""
+def _probe_fused_sharded_devices():
+    """Round 17 LIFTS the blanket sharded refusal — the in-kernel
+    halo exchange composes residency with the ring.  What remains is
+    the degenerate mesh: a 1-extent shard axis has no ring to exchange
+    over and is refused by name."""
     from go_libp2p_pubsub_tpu.parallel import mesh as pm
     import jax
-    gs, cfg, params, state = _fused_gossip_build()
+    gs, cfg, params, state = _fused_gossip_build(n=KERNEL_BLOCK)
     mesh = pm.make_mesh(devices=jax.devices("cpu")[:1])
     win = gs.make_fused_window(cfg, None, ticks_fused=2,
                                receive_block=KERNEL_BLOCK,
                                receive_interpret=True,
                                shard_mesh=mesh, on_refusal="raise")
     win(params, state)   # must raise
+
+
+def _probe_fused_sharded_tile():
+    """Per-shard resident windows roll whole 128-lane tiles; an S
+    that splits a tile is refused by name at kernel-build time (the
+    capability reports the same sentence before dispatch)."""
+    from go_libp2p_pubsub_tpu.ops.pallas.receive import (
+        make_fused_gossip_update)
+    _, cfg, _, _ = _fused_gossip_build()
+    S = KERNEL_BLOCK + 64   # splits a 128-lane tile
+    make_fused_gossip_update(cfg, S, 1, cfg.history_gossip, 2,
+                             interpret=True, stream_n=S * 2,
+                             axis_name="peers", devices=2)  # must raise
+
+
+def _probe_fused_sharded_halo_reach():
+    """A candidate offset reaching a whole ring around is refused by
+    name — the in-kernel halo exchange covers < D hops, never a
+    wrap-around (which would deadlock the DMA plan)."""
+    from go_libp2p_pubsub_tpu.ops.pallas.receive import fused_halo_spec
+    fused_halo_spec([500], 128, 2)   # hop 4 >= D=2: must raise
 
 
 def _probe_fused_vmem_budget():
@@ -1185,10 +1208,26 @@ _PROBE_REFUSALS = {
         (_probe_fused_delays,
          r"kernel_ticks_fused: delay-armed sims stay per-tick — "
          r"the K-slot delay lines add \d+ bytes", ValueError),
-    "kernel_ticks_fused[sharded]":
-        (_probe_fused_sharded,
-         r"kernel_ticks_fused: the sharded dispatch keeps the "
-         r"per-tick kernel", ValueError),
+    # round 17: the kernel_ticks_fused[sharded] blanket refusal is
+    # LIFTED — the fused window now dispatches
+    # sharded_fused_gossip_update (one resident pallas invocation per
+    # shard, in-kernel remote-DMA ring-halo exchange between grid
+    # ticks; tests/test_fused_kernel.py pins bit-identity at
+    # D in {2, 4}).  What remains are the composition's own named
+    # gaps: a degenerate 1-extent mesh, a shard that splits a
+    # 128-lane tile, and a candidate reach spanning the whole ring.
+    "kernel_ticks_fused[sharded-devices]":
+        (_probe_fused_sharded_devices,
+         r"kernel_ticks_fused: sharded windows need a known device "
+         r"count >= 2", ValueError),
+    "kernel_ticks_fused[sharded-tile]":
+        (_probe_fused_sharded_tile,
+         r"kernel_ticks_fused: sharded windows need whole 128-lane "
+         r"tiles per shard", ValueError),
+    "kernel_ticks_fused[sharded-halo-reach]":
+        (_probe_fused_sharded_halo_reach,
+         r"kernel_ticks_fused: halo reach \d+ spans the whole "
+         r"\d+-shard ring", ValueError),
     "kernel_ticks_fused[vmem-budget]":
         (_probe_fused_vmem_budget,
          r"kernel_ticks_fused: resident carry past the VMEM budget "

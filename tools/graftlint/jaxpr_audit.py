@@ -192,16 +192,22 @@ def declared_matrix() -> list[dict]:
     # round-16 tick-resident fused window cases: the resident
     # multi-tick pallas dispatch (whole carry donated into the
     # windowed scan, no 64-bit avals anywhere in the fused kernel's
-    # seeding/tick arithmetic) plus the sharded fused FALLBACK, which
-    # must keep the per-tick kernel's shard_map/ppermute boundary
-    # collectives — losing them would mean the fallback silently
-    # stopped being the round-14 dispatch
+    # seeding/tick arithmetic)
     for faults in (False, True):
         out.append(dict(sim="gossipsub", split=False, telemetry=False,
                         faults=faults, batched=False, variant="fused"))
-    out.append(dict(sim="gossipsub", split=False, telemetry=False,
-                    faults=True, batched=False,
-                    variant="fused-sharded"))
+    # round-17 fused-sharded cases: the COMPOSED dispatch — one
+    # resident pallas invocation per shard inside shard_map whose
+    # in-kernel remote DMAs (dma_start/dma_wait) carry the ring-halo
+    # boundary between grid ticks.  No ppermute may be needed (the
+    # boundary never leaves the kernel); telemetry frames must psum
+    # across the mesh; donation and the 64-bit ban must hold through
+    # the shard_map boundary.
+    for telemetry in (False, True):
+        for faults in (False, True):
+            out.append(dict(sim="gossipsub", split=False,
+                            telemetry=telemetry, faults=faults,
+                            batched=False, variant="fused-sharded"))
     return out
 
 
@@ -569,14 +575,17 @@ def build_cases() -> list[AuditCase]:
             # shared N=80 can never take the resident path).  The
             # resident case must donate the whole carry into the
             # windowed dispatch with no 64-bit avals in the in-kernel
-            # tick/seed arithmetic; the sharded case must REFUSE by
-            # name and fall back to the round-14 shard_map dispatch,
-            # whose halo ppermutes must still be in the jaxpr.
+            # tick/seed arithmetic.  The round-17 fused-sharded case
+            # is the COMPOSED dispatch: capability must ACCEPT, and
+            # the traced program must be the shard_map of one resident
+            # pallas call per shard with the in-kernel remote-DMA halo
+            # (dma_start/dma_wait) — no ppermute boundary collectives.
             import numpy as np
             from go_libp2p_pubsub_tpu.models.faults import FaultSchedule
             sharded_f = variant == "fused-sharded"
             if sharded_f:
                 from go_libp2p_pubsub_tpu.parallel import mesh as pmesh
+                from go_libp2p_pubsub_tpu.parallel import sharded as psh
                 mesh_f = pmesh.make_mesh(devices=jax.devices("cpu")[:2])
                 D_f = mesh_f.shape[pmesh.PEER_AXIS]
             else:
@@ -603,15 +612,20 @@ def build_cases() -> list[AuditCase]:
             window = gs.make_fused_window(
                 cfg, None, ticks_fused=2, receive_block=kb,
                 receive_interpret=True, shard_mesh=mesh_f,
-                on_refusal="fallback" if sharded_f else "raise")
+                telemetry=(tl.TelemetryConfig() if combo["telemetry"]
+                           else None),
+                on_refusal="raise")
             reason = window.capability(params, state)
+            assert reason is None, reason
             if sharded_f:
-                assert reason is not None and "shard_map" in reason, \
-                    reason
+                params, state, sh_f = psh.shard_sim(
+                    params, state, mesh_f, n_f)
+                runner = psh.sharded_gossip_run_fused
+                args = (params, state, 4, window, sh_f)
+                statics = (2, 3, 4)
             else:
-                assert reason is None, reason
-            runner = gs.gossip_run_fused
-            args, statics = (params, state, 4, window), (2, 3)
+                runner = gs.gossip_run_fused
+                args, statics = (params, state, 4, window), (2, 3)
 
         elif variant == "ckpt":
             # round-15 segmented checkpoint runners: trace the engine's
@@ -770,9 +784,15 @@ def build_cases() -> list[AuditCase]:
             # must still be the shard_map one
             case.expect_primitives = ("shard_map",)
         elif variant == "fused-sharded":
-            # the named fallback must still be the round-14 streamed
-            # shard_map dispatch, halo ppermutes included
-            case.expect_primitives = ("shard_map", "ppermute")
+            # round 17: the composed dispatch — one resident pallas
+            # call per shard under shard_map, the ring-halo boundary
+            # carried by in-kernel remote DMAs between grid ticks
+            # (no ppermute: the boundary never leaves the kernel);
+            # telemetry tallies psum across the mesh
+            case.expect_primitives = ("shard_map", "pallas_call",
+                                      "dma_start", "dma_wait")
+            if combo["telemetry"]:
+                case.expect_primitives += ("psum",)
         # late-binding via default args: the thunks must be pure
         # trace/lower closures over THIS combo's objects
         case.trace = (lambda r=runner, a=args, s=statics:

@@ -330,6 +330,35 @@ elif [ "$rsrc" -ne 0 ]; then
   sync_log
   exit 11
 fi
+# 4j. sharded tick-resident megakernel (round 17): the fused window
+# with in-kernel ring-halo exchange under shard_map — digest
+# BIT-IDENTICAL to the single-device per-tick kernel at every D in
+# {2, 4}, ONE compile per D, the per-(n, devices) fits table with
+# real circulant offsets, and the 1M multiplicative flip (REFUSED at
+# D=1 -> FITS at D=8) — then the residentstat --sharded gate vs the
+# committed RESIDENT_r17.json.  The virtual mesh comes from the env
+# here (CPU hosts; on TPU the real mesh is jax.devices()).
+run s4j 2700 env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python bench_suite.py gossipsub_resident_sharded
+echo "=== residentstat --sharded --check gate ===" | tee -a "$log"
+env JAX_PLATFORMS=cpu python tools/residentstat.py \
+    /tmp/gossipsub_resident_sharded.json \
+    --sharded --check RESIDENT_r17.json 2>&1 | tee -a "$log"
+rssrc=${PIPESTATUS[0]}
+if [ "$rssrc" -eq 2 ]; then
+  echo "!! residentstat --sharded gate failed — unusable sharded" \
+      "resident artifact (bench crashed, no fused_sharded rows, or" \
+      "no fits table?)" | tee -a "$log"
+  sync_log
+  exit 12
+elif [ "$rssrc" -ne 0 ]; then
+  echo "!! residentstat --sharded gate failed — a fused-sharded" \
+      "trajectory diverged from the per-tick kernel, a window" \
+      "re-traced, the 1M flip is gone, or the multiplicative saving" \
+      "shrank" | tee -a "$log"
+  sync_log
+  exit 12
+fi
 # 5. GSPMD overhead + diagnostics
 run s5a 1800 python tools/bench_sharded.py
 run s5b 1800 python tools/bench_micro.py 1000000 100

@@ -31,8 +31,17 @@ numbers, not prose.  Analytic (ops/pallas/receive.py's
 ``fused_working_set_bytes``), not cost-analysis: the pallas body is
 opaque to XLA's bytes-accessed counter.
 
+``--kernel --devices D`` (round 17) composes the two: per (D, T)
+point, the PER-SHARD working set of the in-kernel-halo resident
+window (carry/D + double-buffered halo slots + send stages, real
+circulant offsets), its fits verdict, the remote-DMA boundary bytes
+per tick, and the projected MULTIPLICATIVE saving — the fused HBM
+reduction x the D-way partition — including the 1M @ D=8 flip the
+RESIDENT_r17 ledger commits.
+
 Usage: python tools/profile_bytes.py [n_peers] [--devices D]
        python tools/profile_bytes.py [n_peers] --kernel [--fused-ticks T]
+       python tools/profile_bytes.py [n_peers] --kernel --devices D
 """
 
 from __future__ import annotations
@@ -69,7 +78,7 @@ def main():
 
     if ns.kernel:
         from go_libp2p_pubsub_tpu.models.gossipsub import (
-            FUSED_VMEM_BUDGET, GossipSimConfig)
+            FUSED_VMEM_BUDGET, GossipSimConfig, make_gossip_offsets)
         from go_libp2p_pubsub_tpu.ops.pallas.receive import (
             FUSED_ALIGN, fused_working_set_bytes)
 
@@ -97,6 +106,42 @@ def main():
         ratio = (ws["unfused_hbm_bytes_per_tick"]
                  / max(ws["hbm_bytes_per_tick"], 1.0))
         print(f"{'per-tick HBM reduction':34s} {ratio:9.2f} x")
+        if ns.devices:
+            # round 17: compose the fused ledger with the per-shard
+            # boundary split — projected MULTIPLICATIVE saving per
+            # (D, T): the fused per-tick HBM reduction x the D-way
+            # carry partition, with the in-kernel halo's boundary
+            # bytes and the per-shard VMEM verdict alongside.  Real
+            # circulant offsets (the halo reach and the tailored ctrl
+            # segments are offset geometry, not just magnitudes).
+            offsets = make_gossip_offsets(t, C, n_pad, seed=0)
+            print()
+            print(f"{'(D, T)':>8s} {'pershard MB':>11s} "
+                  f"{'verdict':>8s} {'halo B/tick':>11s} "
+                  f"{'reduce x':>9s} {'multiplicative x':>17s}")
+            d_list = [d for d in (1, 2, 4, 8, 16, 32)
+                      if d <= ns.devices and n_pad % d == 0]
+            if ns.devices not in d_list and n_pad % ns.devices == 0:
+                d_list.append(ns.devices)
+            for D in d_list:
+                for Tt in sorted({4, 8, T}):
+                    try:
+                        w = fused_working_set_bytes(
+                            C, W, hg, n_pad, ticks=Tt,
+                            devices=D,
+                            offsets=(offsets if D > 1 else None))
+                    except ValueError as e:
+                        print(f"{f'({D},{Tt})':>8s} "
+                              f"{'—':>11s} {'REFUSED':>8s}  {e}")
+                        continue
+                    fits = w["vmem_bytes"] <= FUSED_VMEM_BUDGET
+                    red = (w["unfused_hbm_bytes_per_tick"]
+                           / max(w["hbm_bytes_per_tick"], 1.0))
+                    print(f"{f'({D},{Tt})':>8s} "
+                          f"{w['vmem_bytes'] / 1e6:11.1f} "
+                          f"{'FITS' if fits else 'REFUSED':>8s} "
+                          f"{w.get('boundary_bytes_per_tick', 0):>11d} "
+                          f"{red:9.2f} {red * D:17.2f}")
         return
     rng = np.random.default_rng(0)
     cfg = gs.GossipSimConfig(

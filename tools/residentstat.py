@@ -1,10 +1,14 @@
 #!/usr/bin/env python
 """residentstat: inspect a tick-resident megakernel bench artifact and
-gate the round-16 residency contract against a committed baseline.
+gate the round-16 residency contract (and, with ``--sharded``, the
+round-17 residency-x-sharding composition) against a committed
+baseline.
 
     python tools/residentstat.py /tmp/gossipsub_resident.json
     python tools/residentstat.py /tmp/gossipsub_resident.json \
         --check RESIDENT_r16.json [--min-reduction 5.0]
+    python tools/residentstat.py /tmp/gossipsub_resident_sharded.json \
+        --sharded --check RESIDENT_r17.json
 
 Prints the round-16 table: the per-tick kernel row vs the fused
 T-tick-window row (wall-clock, digest, compile count) and the analytic
@@ -18,6 +22,16 @@ least --min-reduction x (the ledger is analytic —
 ops/pallas/receive.fused_working_set_bytes — because the pallas body
 is opaque to XLA's bytes-accessed counter).
 
+With ``--sharded`` the round-17 contract is gated on top: the
+artifact must carry at least one ``fused_sharded_D*`` row (each
+digest-identical to the per-tick reference and ONE compile — the
+in-kernel halo exchange is a scheduling change), the per-(n, devices)
+``fits_table``, and the ``multiplicative`` headline object whose 1M
+point flips from NOT-fitting at D=1 to FITTING at D=8 (the
+composition's reason to exist); --check additionally refuses
+fits-table coverage shrink, a fitting baseline point going REFUSED,
+and a shrinking multiplicative saving.
+
 Exit codes (tracestat/tourneystat/sweepstat/delaystat/shardstat/
 ckptstat convention):
 
@@ -25,12 +39,15 @@ ckptstat convention):
   1  regression: fused digest differing from the per-tick kernel row
      (residency changed the arithmetic), a fused run that compiled
      more than one executable (re-trace per window), a fitting
-     >= 100k-peer ledger point under --min-reduction x, or (with
-     --check) a baseline row/ledger point missing from the current
-     artifact, a baseline-true bit_identical flag going false, or a
-     ledger point's reduction shrinking vs the committed baseline
+     >= 100k-peer ledger point under --min-reduction x, a --sharded
+     1M flip that no longer flips, or (with --check) a baseline
+     row/ledger/fits-table point missing from the current artifact, a
+     baseline-true bit_identical or fits flag going false, or a
+     reduction/multiplicative shrinking vs the committed baseline
   2  unusable input: missing/unparseable artifact, no rows, no
-     unfused reference row, no fused row, or an empty byte ledger
+     unfused reference row, no fused row, an empty byte ledger, or
+     (with --sharded) no fused-sharded row, no fits_table, or no
+     multiplicative object
 """
 
 from __future__ import annotations
@@ -78,6 +95,11 @@ def main(argv=None) -> int:
                     help="minimum per-tick HBM-bytes reduction (x) at "
                          "every fitting >= 100k-peer ledger point "
                          "(default 5.0 — the round-16 acceptance bar)")
+    ap.add_argument("--sharded", action="store_true",
+                    help="gate the round-17 residency-x-sharding "
+                         "composition: fused_sharded_D* rows, the "
+                         "per-(n, devices) fits table, and the 1M "
+                         "multiplicative flip")
     ns = ap.parse_args(argv)
 
     cur = load(ns.artifact)
@@ -109,6 +131,37 @@ def main(argv=None) -> int:
               f" MB /tick ({e.get('hbm_reduction_x')}x)  "
               f"vmem={e.get('vmem_bytes', 0) / 1e6:.1f} MB {verdict}")
 
+    fits_table = [e for e in cur.get("fits_table", [])
+                  if isinstance(e, dict)]
+    mult = cur.get("multiplicative")
+    if ns.sharded:
+        if not any(str(r.get("id", "")).startswith("fused_sharded_D")
+                   for r in rows):
+            print("residentstat: --sharded artifact has no "
+                  "fused_sharded_D* row — the composition is "
+                  "unmeasured", file=sys.stderr)
+            return 2
+        if not fits_table or not isinstance(mult, dict):
+            print("residentstat: --sharded artifact carries no "
+                  "fits_table/multiplicative — the per-(n, devices) "
+                  "ledger is missing", file=sys.stderr)
+            return 2
+        for e in fits_table:
+            if "refused" in e:
+                print(f"  fits n={e['n']:>8d} D={e['devices']}: "
+                      f"REFUSED by name ({e['refused'][:64]}...)")
+                continue
+            print(f"  fits n={e['n']:>8d} D={e['devices']}: "
+                  f"vmem={e.get('vmem_bytes', 0) / 1e6:6.1f} MB "
+                  f"{'FITS   ' if e.get('fits') else 'REFUSED'} "
+                  f"halo={e.get('boundary_bytes_per_tick', 0) / 1e6:.1f}"
+                  f" MB/tick  {e.get('hbm_reduction_x')}x -> "
+                  f"{e.get('multiplicative_x')}x multiplicative")
+        print(f"  multiplicative: n={mult.get('n')} "
+              f"D={mult.get('devices')} "
+              f"{mult.get('multiplicative_x')}x "
+              f"(first fits at D={mult.get('first_fits_devices')})")
+
     rc = 0
     for r in rows:
         if r["id"] == "unfused_kernel":
@@ -131,6 +184,14 @@ def main(argv=None) -> int:
                   f"{e.get('hbm_reduction_x')}x under the "
                   f"{ns.min_reduction}x bar — the resident window no "
                   "longer amortizes the carry traffic",
+                  file=sys.stderr)
+            rc = 1
+    if ns.sharded:
+        fbd = mult.get("fits_by_devices", {})
+        if fbd.get("1") is not False or fbd.get("8") is not True:
+            print("residentstat: the 1M multiplicative flip is gone — "
+                  f"fits_by_devices={fbd} (want the D=1 carry past "
+                  "the budget and the D=8 per-shard carry fitting)",
                   file=sys.stderr)
             rc = 1
 
@@ -178,6 +239,44 @@ def main(argv=None) -> int:
                 rc = 1
             print(f"check: ledger n={n_l} {got}x vs baseline {want}x "
                   f"-> {'OK' if not ref.get('fits') or got >= want else 'REGRESSED'}")
+        if ns.sharded:
+            base_ft = {(e["n"], e["devices"]): e
+                       for e in base.get("fits_table", [])
+                       if isinstance(e, dict)}
+            cur_ft = {(e["n"], e["devices"]): e for e in fits_table}
+            fmissing = set(base_ft) - set(cur_ft)
+            if fmissing:
+                print("residentstat: fits-table coverage shrank vs "
+                      f"baseline: missing (n, D)={sorted(fmissing)}",
+                      file=sys.stderr)
+                rc = 1
+            for key, ref in sorted(base_ft.items()):
+                e = cur_ft.get(key)
+                if e is None or "refused" in ref:
+                    continue
+                if ref.get("fits") and not e.get("fits"):
+                    print(f"residentstat: fits n={key[0]} D={key[1]} "
+                          "fit in the baseline and no longer does — "
+                          "the per-shard working set grew past the "
+                          "budget", file=sys.stderr)
+                    rc = 1
+                got = e.get("multiplicative_x", 0.0)
+                want = ref.get("multiplicative_x", 0.0)
+                if ref.get("fits") and got < want:
+                    print(f"residentstat: fits n={key[0]} D={key[1]} "
+                          f"multiplicative {got}x shrank vs baseline "
+                          f"{want}x", file=sys.stderr)
+                    rc = 1
+            bm = base.get("multiplicative") or {}
+            got = (mult or {}).get("multiplicative_x", 0.0)
+            want = bm.get("multiplicative_x", 0.0)
+            print(f"check: multiplicative {got}x vs baseline {want}x "
+                  f"-> {'OK' if got >= want else 'REGRESSED'}")
+            if got < want:
+                print(f"residentstat: the headline multiplicative "
+                      f"saving {got}x shrank vs baseline {want}x",
+                      file=sys.stderr)
+                rc = 1
     return rc
 
 

@@ -70,6 +70,18 @@ suite is the full matrix for tracking all baseline configs.)
                    /tmp artifact for the shardstat gate (measure_all
                    step 4g), ``hardware_queued``-tagged when run on
                    the CPU virtual mesh
+  gossipsub_serving
+                   round 18: the fault-tolerant multi-tenant front
+                   end (go_libp2p_pubsub_tpu/serving) under load —
+                   Zipf shape popularity / Poisson arrivals through
+                   the shape-bucketed LRU executable cache (compile
+                   count == traced bucket count, evictions free), an
+                   overload burst with explicit rejection rows, a
+                   SIGKILL-mid-long-scenario + journal-replay restart
+                   resumed to the bit-identical digest, and the
+                   traced-vs-AOT (jax.export) cold-start race; /tmp
+                   artifact for the servestat gate (measure_all
+                   step 4k)
   gossipsub_resident
                    round 16: the tick-resident megakernel
                    (make_fused_window) — T=8 ticks per pallas
@@ -2011,6 +2023,320 @@ def bench_gossipsub_resident_sharded():
                 "interpret": not on_accel})
 
 
+_SERVE_KILL_CHILD = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+from go_libp2p_pubsub_tpu.serving import FrontendConfig, ScenarioFrontend
+fe = ScenarioFrontend(FrontendConfig(
+    batch=2, max_buckets=2, long_ticks={long_ticks},
+    ckpt_dir={ckpt_dir!r}, ckpt_every={every},
+    server_kw={{"seed": 0}}))
+lines = [{line!r}] if {first} else []
+fe.serve_lines(lines, sys.stdout, journal={journal!r})
+"""
+
+_SERVE_COLD_CHILD = r"""
+import json, sys, time
+t0 = time.perf_counter()
+sys.path.insert(0, {repo!r})
+from go_libp2p_pubsub_tpu.serving import FrontendConfig, ScenarioFrontend
+fe = ScenarioFrontend(FrontendConfig(
+    batch=4, max_buckets=4, aot_dir={aot!r}, server_kw={{"seed": 0}}))
+first = None
+rows = []
+for n, t, m, ticks in ((256, 2, 8, 16), (128, 2, 4, 8)):
+    for i in range(4):
+        fe.admit({{"id": f"c-n{{n}}-ticks{{ticks}}-{{i}}", "n": n,
+                   "t": t, "m": m, "ticks": ticks, "seed": i}})
+    rows += fe.drain()
+    if first is None:
+        first = time.perf_counter() - t0
+st = fe.stats()
+print(json.dumps({{
+    "cold": True, "first_result_s": round(first, 3),
+    "total_s": round(time.perf_counter() - t0, 3),
+    "compiles": st["compiles"], "aot_loads": st["aot_loads"],
+    "aot_exports": st["aot_exports"],
+    "traced_buckets": st["traced_buckets"],
+    "rows": [[r.get("id"), r.get("delivery_fraction"),
+              r.get("honest_delivery_fraction")] for r in rows],
+}}), flush=True)
+"""
+
+
+def bench_gossipsub_serving():
+    """Round 18: the fault-tolerant multi-tenant front end
+    (go_libp2p_pubsub_tpu/serving) under generated load.  Four phases,
+    one artifact (/tmp/gossipsub_serving.json) for the ``servestat
+    --check`` gate (measure_all step 4k):
+
+    * ``load``          GOSSIP_SERVE_REQS (default 2000) requests with
+      Zipf-popular shapes over a 5-shape pool (max_buckets=4, so the
+      cold shape cycles through LRU eviction) and Poisson arrivals
+      paced at GOSSIP_SERVE_RPS (default 400/s); a slice carries tight
+      deadlines (named timeout rows) and elevated priority.  Reports
+      throughput, p50/p99 queue latency, and the headline contract:
+      compile count == distinct traced bucket shapes (evictions and
+      rebuilds add ZERO compiles).
+    * ``overload``      a burst into a queue_cap=32 front end
+      dispatching every 4th arrival: admissions past the cap come back
+      as EXPLICIT ``overloaded`` rejection rows; the accounting
+      identity (admitted == served + errors + timeouts + transient +
+      queued + parked) proves nothing was silently dropped.
+    * ``kill_recovery`` a subprocess serving one LONG scenario
+      (ckpt-segmented) is SIGKILLed mid-run after >= 2 snapshots; a
+      restarted server replays the CRC'd journal, resumes from the
+      snapshot, and must land on the BIT-IDENTICAL digest of an
+      uninterrupted reference run.
+    * ``cold_start``    time-to-first-result for a fresh process,
+      traced-and-exported vs AOT-loaded (jax.export blobs keyed on
+      bucket spec + config fingerprint): the AOT pass must reach full
+      bucket coverage with ZERO compiles and bit-identical rows."""
+    import io
+    import signal
+    import subprocess
+    import tempfile
+    import zlib
+
+    import jax
+    from go_libp2p_pubsub_tpu.serving import (FrontendConfig,
+                                              ScenarioFrontend)
+
+    n_reqs = int(os.environ.get("GOSSIP_SERVE_REQS", 2000))
+    rps = float(os.environ.get("GOSSIP_SERVE_RPS", 400.0))
+    kill_ticks = int(os.environ.get("GOSSIP_SERVE_KILL_TICKS", 400))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    work = tempfile.mkdtemp(prefix="gossip_serve_bench_")
+
+    # -- load phase: Zipf shapes, Poisson arrivals ---------------------
+    pool = [(256, 2, 8, 16), (128, 2, 4, 8), (256, 4, 8, 16),
+            (64, 2, 4, 8), (256, 2, 8, 24)]
+    zipf_a = 1.1
+    w = np.array([1.0 / (r + 1) ** zipf_a for r in range(len(pool))])
+    w /= w.sum()
+    rng = np.random.default_rng(18)
+    shape_ix = rng.choice(len(pool), size=n_reqs, p=w)
+    gaps = rng.exponential(1.0 / rps, size=n_reqs)
+    fe = ScenarioFrontend(FrontendConfig(
+        max_buckets=4, batch=8, queue_cap=max(4 * n_reqs, 4096),
+        server_kw={"seed": 0}))
+    rows = []
+    t_load = time.perf_counter()
+    t_next = t_load
+    for i in range(n_reqs):
+        t_next += gaps[i]
+        lag = t_next - time.perf_counter()
+        if lag > 0:
+            time.sleep(lag)
+        n, t, m, ticks = pool[shape_ix[i]]
+        req = {"id": f"r{i}", "n": n, "t": t, "m": m, "ticks": ticks,
+               "seed": int(i % 64)}
+        if i % 20 == 0:
+            req["deadline_s"] = 0.05    # tight: times out under backlog
+        if i % 10 == 0:
+            req["priority"] = 1
+        rej = fe.admit(req)
+        if rej is not None:
+            rows.append(rej)
+        rows.extend(fe.dispatch_ready())
+    rows.extend(fe.drain())
+    load_wall = time.perf_counter() - t_load
+    st = fe.stats()
+    assert st["admitted"] == n_reqs and st["queued"] == 0, st
+    assert (st["served"] + st["errors"] + st["timeouts"]
+            + st["transient_failures"]) == n_reqs, st
+    assert st["compiles"] == st["traced_buckets"] == len(pool), st
+    assert st["evictions"] > 0, st     # the pool outnumbers the cap
+    ok_rows = [r for r in rows if r.get("ok") and "queue_s" in r]
+    assert all(r.get("inv_bits", 0) == 0 for r in ok_rows)
+    q = np.array([r["queue_s"] for r in ok_rows])
+    load = {
+        "admitted": st["admitted"], "served": st["served"],
+        "errors": st["errors"], "timeouts": st["timeouts"],
+        "transient_failures": st["transient_failures"],
+        "queued": st["queued"], "parked": st["parked"],
+        "rejected_overload": st["rejected_overload"],
+        "retries": st["retries"],
+        "throughput_rps": round(st["served"] / load_wall, 2),
+        "p50_queue_s": round(float(np.percentile(q, 50)), 4),
+        "p99_queue_s": round(float(np.percentile(q, 99)), 4),
+        "wall_s": round(load_wall, 2),
+        "device_s": st["device_s"],
+        "evictions": st["evictions"],
+    }
+
+    # -- overload phase: burst into a tiny admission cap ---------------
+    # arrivals outrun service on purpose: one dispatch (<= one batch
+    # of 8) per 16 admissions, so the queue crosses the cap and stays
+    # there — admissions past it must come back as named rejections
+    fe2 = ScenarioFrontend(FrontendConfig(
+        max_buckets=2, batch=8, queue_cap=32, server_kw={"seed": 0}))
+    over_rows = []
+    for i in range(300):
+        rej = fe2.admit({"id": f"o{i}", "n": 256, "t": 2, "m": 8,
+                         "ticks": 16, "seed": int(i % 16)})
+        if rej is not None:
+            over_rows.append(rej)
+        if i % 16 == 15:
+            over_rows.extend(fe2.dispatch_ready())
+    over_rows.extend(fe2.drain())
+    st2 = fe2.stats()
+    assert st2["rejected_overload"] > 0, st2
+    assert all(r.get("overloaded") and "overloaded:" in r["error"]
+               for r in over_rows if not r.get("ok")
+               and not r.get("timeout")), over_rows
+    assert (st2["admitted"] + st2["rejected_overload"] == 300
+            and st2["queued"] == 0), st2
+    overload = {
+        "requests": 300, "queue_cap": 32,
+        "admitted": st2["admitted"], "served": st2["served"],
+        "errors": st2["errors"], "timeouts": st2["timeouts"],
+        "transient_failures": st2["transient_failures"],
+        "queued": st2["queued"], "parked": st2["parked"],
+        "rejected_overload": st2["rejected_overload"],
+        "reject_rate": round(st2["rejected_overload"] / 300, 4),
+    }
+
+    # -- kill recovery: SIGKILL mid-long-scenario, restart, digest ----
+    kill_req = {"id": "kill1", "n": 256, "t": 2, "m": 8,
+                "ticks": kill_ticks, "seed": 1}
+    raw = json.dumps(kill_req, sort_keys=True)
+    ckpt_dir = os.path.join(work, "ckpt")
+    journal = os.path.join(work, "serve.journal")
+    snapdir = os.path.join(
+        ckpt_dir, f"kill1-{zlib.crc32(raw.encode()):08x}")
+    env = dict(os.environ, JAX_PLATFORMS=jax.default_backend())
+    long_ticks = kill_ticks // 2
+
+    def kill_child(first):
+        script = _SERVE_KILL_CHILD.format(
+            repo=repo, long_ticks=long_ticks, ckpt_dir=ckpt_dir,
+            every=2, line=raw, first=int(first), journal=journal)
+        return subprocess.Popen([sys.executable, "-c", script],
+                                stdout=subprocess.PIPE, text=True,
+                                env=env)
+
+    child = kill_child(first=True)
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        if (os.path.isdir(snapdir)
+                and sum(f.endswith(".ckpt")
+                        for f in os.listdir(snapdir)) >= 2):
+            break
+        if child.poll() is not None:
+            raise AssertionError(
+                "kill child finished before it could be killed: "
+                + (child.communicate()[0] or ""))
+        time.sleep(0.01)
+    else:
+        raise AssertionError("kill child never produced snapshots")
+    child.send_signal(signal.SIGKILL)
+    child.wait(timeout=60)
+
+    # the uninterrupted reference (different snapshot dir, different
+    # segmentation — the digest must not depend on either)
+    fe_ref = ScenarioFrontend(FrontendConfig(
+        batch=2, max_buckets=2, long_ticks=long_ticks,
+        ckpt_dir=os.path.join(work, "ckpt_ref"),
+        ckpt_every=max(kill_ticks // 2, 1), server_kw={"seed": 0}))
+    buf = io.StringIO()
+    fe_ref.serve_lines([raw], buf)
+    ref_row = next(json.loads(ln) for ln in buf.getvalue().splitlines()
+                   if json.loads(ln).get("long"))
+    assert ref_row["ok"], ref_row
+
+    restart = kill_child(first=False)
+    out, _ = restart.communicate(timeout=600)
+    assert restart.returncode == 0, out
+    parsed = [json.loads(ln) for ln in out.splitlines()]
+    res_row = next(r for r in parsed if r.get("long"))
+    res_stats = next(r for r in parsed if r.get("stats"))
+    assert res_row["resumed"], res_row
+    match = res_row["digest"] == ref_row["digest"]
+    assert match, (res_row, ref_row)
+    kill_recovery = {
+        "ticks": kill_ticks, "sigkill": True,
+        "admitted": res_stats["admitted"],
+        "served": res_stats["served"],
+        "errors": res_stats["errors"],
+        "timeouts": res_stats["timeouts"],
+        "transient_failures": res_stats["transient_failures"],
+        "queued": res_stats["queued"], "parked": res_stats["parked"],
+        "resumed": res_stats["long_resumed"],
+        "digest": res_row["digest"], "digest_match": match,
+    }
+
+    # -- cold start: traced+exported vs AOT-loaded ---------------------
+    aot_dir = os.path.join(work, "aot")
+
+    def cold_child():
+        script = _SERVE_COLD_CHILD.format(repo=repo, aot=aot_dir)
+        r = subprocess.run([sys.executable, "-c", script],
+                           capture_output=True, text=True, env=env,
+                           timeout=900)
+        assert r.returncode == 0, r.stderr
+        return next(json.loads(ln) for ln in r.stdout.splitlines()
+                    if json.loads(ln).get("cold"))
+
+    traced = cold_child()     # empty cache: traces + exports blobs
+    aot = cold_child()        # warm cache: loads blobs, zero compiles
+    assert traced["compiles"] == traced["aot_exports"] == 2, traced
+    assert aot["compiles"] == 0 and aot["aot_loads"] == 2, aot
+    assert aot["rows"] == traced["rows"], (traced, aot)
+    cold_start = {
+        "buckets": 2,
+        "traced_s": traced["first_result_s"],
+        "traced_total_s": traced["total_s"],
+        "aot_s": aot["first_result_s"],
+        "aot_total_s": aot["total_s"],
+        "speedup_x": round(traced["total_s"] / aot["total_s"], 2),
+        "aot_compiles": aot["compiles"],
+        "aot_loads": aot["aot_loads"],
+        "bit_identical": aot["rows"] == traced["rows"],
+    }
+
+    import shutil
+    shutil.rmtree(work, ignore_errors=True)
+    backend = jax.default_backend()
+    art = {
+        "round": 18,
+        "platform": backend,
+        "hardware_queued": backend != "tpu",
+        "requests": n_reqs,
+        "zipf_a": zipf_a,
+        "arrival_rps": rps,
+        "shape_pool": [f"n{p[0]}-t{p[1]}-m{p[2]}-ticks{p[3]}"
+                       for p in pool],
+        "compiles": st["compiles"],
+        "traced_buckets": st["traced_buckets"],
+        "bucket_count": st["bucket_count"],
+        "evictions": st["evictions"],
+        "load": load,
+        "overload": overload,
+        "kill_recovery": kill_recovery,
+        "cold_start": cold_start,
+        "rows": [
+            dict({"id": "load"}, **load),
+            dict({"id": "overload"}, **overload),
+            dict({"id": "kill_recovery"}, **kill_recovery),
+            dict({"id": "cold_start"}, **cold_start),
+        ],
+    }
+    write_json_atomic("/tmp/gossipsub_serving.json", art)
+    emit("gossipsub_serving_throughput_rps", load["throughput_rps"],
+         "requests/s",
+         extra={"requests": n_reqs, "compiles": st["compiles"],
+                "buckets": st["traced_buckets"],
+                "p99_queue_s": load["p99_queue_s"],
+                "reject_rate": overload["reject_rate"],
+                "kill_recovery_ok": match,
+                "cold_speedup_x": cold_start["speedup_x"]})
+    emit("gossipsub_serving_cold_start_aot_s", cold_start["aot_s"],
+         "s to first result",
+         extra={"traced_s": cold_start["traced_s"],
+                "aot_compiles": cold_start["aot_compiles"]})
+
+
 BENCHES = {
     "floodsub_hosts": bench_floodsub_hosts,
     "randomsub_10k": bench_randomsub_10k,
@@ -2036,6 +2362,7 @@ BENCHES = {
     "gossipsub_checkpoint": bench_gossipsub_checkpoint,
     "gossipsub_resident": bench_gossipsub_resident,
     "gossipsub_resident_sharded": bench_gossipsub_resident_sharded,
+    "gossipsub_serving": bench_gossipsub_serving,
 }
 
 

@@ -79,6 +79,7 @@ __all__ = [
     "latest_snapshot", "install_kill_handlers", "request_stop",
     "stop_requested", "clear_stop",
     "read_snapshot_chain",
+    "journal_encode_line", "journal_decode_line", "read_journal",
     "ckpt_gossip_run", "ckpt_gossip_run_curve",
     "ckpt_gossip_run_fused",
     "ckpt_gossip_run_knob_batch", "ckpt_telemetry_run",
@@ -236,6 +237,73 @@ def install_kill_handlers():
 def _restore_handlers(prev) -> None:
     for sig, handler in prev:
         signal.signal(sig, handler)
+
+
+# --------------------------------------------------------------------------
+# Journal lines (round 18)
+# --------------------------------------------------------------------------
+
+#: separator between a journal line's payload and its integrity suffix
+#: (a tab never appears in the JSON-line protocols that use this)
+_JOURNAL_SEP = "\t#crc32="
+
+
+def journal_encode_line(raw: str) -> str:
+    """One append-only journal line with the snapshot-header integrity
+    treatment: the payload followed by its CRC32 suffix.  A line torn
+    mid-write (the process died inside ``write``) fails the CRC and is
+    detectable as exactly that — torn — instead of surfacing as a
+    corrupt payload downstream (sweepd round 18: a torn tail line used
+    to burn the scenario as a bad-JSON error row on replay)."""
+    if "\n" in raw or "\r" in raw:
+        raise ValueError("journal lines must be newline-free")
+    return f"{raw}{_JOURNAL_SEP}{zlib.crc32(raw.encode()):08x}"
+
+
+def journal_decode_line(line: str) -> str | None:
+    """Recover the payload of one journal line, or ``None`` when the
+    line is torn (CRC suffix mismatched or truncated mid-suffix).
+    Lines written before the CRC suffix existed (no separator) are
+    returned as-is — legacy journals replay unchanged."""
+    payload, sep, crc_hex = line.rpartition(_JOURNAL_SEP)
+    if not sep:
+        return line  # pre-round-18 journal line: no integrity suffix
+    try:
+        want = int(crc_hex, 16)
+    except ValueError:
+        return None  # suffix itself torn mid-write
+    if zlib.crc32(payload.encode()) != want:
+        return None
+    return payload
+
+
+def read_journal(path: str) -> tuple[list[str], int]:
+    """Read a CRC-suffixed journal: returns ``(payloads, n_torn)`` —
+    every line whose integrity suffix verifies (or that predates the
+    suffix), plus the count of torn lines dropped.  A missing journal
+    is an empty one.
+
+    Tail special case: a FINAL line with no separator at all (the
+    writer died before reaching the suffix) decodes as a legacy line,
+    but when any other line in the file carries the suffix the writer
+    was demonstrably CRC-aware — so that tail is torn, not legacy.
+    Only the tail gets this treatment: mid-file suffix-less lines can
+    be a legacy journal continued by an upgraded writer."""
+    try:
+        with open(path) as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+    except FileNotFoundError:
+        return [], 0
+    any_suffixed = any(_JOURNAL_SEP in ln for ln in lines)
+    payloads, torn = [], 0
+    for i, line in enumerate(lines):
+        payload = journal_decode_line(line)
+        if payload is None or (i == len(lines) - 1 and any_suffixed
+                               and _JOURNAL_SEP not in line):
+            torn += 1
+        else:
+            payloads.append(payload)
+    return payloads, torn
 
 
 # --------------------------------------------------------------------------

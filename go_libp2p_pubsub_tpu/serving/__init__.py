@@ -1,0 +1,20 @@
+"""Multi-tenant scenario serving (round 18).
+
+The front-end layer over tools/sweepd.py's resident single-shape
+engine: shape-bucketed multi-executable serving with a bounded LRU
+bucket cache and AOT-persisted executables (buckets.py), plus the
+request lifecycle — admission control, deadlines, bounded retry,
+graceful drain, and preemption-surviving long scenarios
+(frontend.py)."""
+
+from .buckets import (                                      # noqa: F401
+    BucketSpec, BucketLRU, quantize_shape, bucket_fingerprint,
+    aot_blob_path, export_bucket_runner, make_aot_runner)
+from .frontend import (                                     # noqa: F401
+    FrontendConfig, ScenarioFrontend)
+
+__all__ = [
+    "BucketSpec", "BucketLRU", "quantize_shape",
+    "bucket_fingerprint", "aot_blob_path", "export_bucket_runner",
+    "make_aot_runner", "FrontendConfig", "ScenarioFrontend",
+]

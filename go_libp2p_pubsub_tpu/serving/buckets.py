@@ -1,0 +1,229 @@
+"""Shape buckets for the multi-tenant scenario front end (round 18).
+
+A sweepd server compiles ONE executable for ONE static shape.  The
+front end serves arbitrary request shapes by quantizing each incoming
+``(n, t, m, ticks, k_slots)`` into a bounded set of bucket specs — peer
+count / topics / messages round UP to the next power of two, ticks to
+the next tick quantum — and routing (+ padding) the request to its
+bucket's resident server.  Under a ``max_buckets`` cap the
+least-recently-used bucket is evicted; the jit cache is process-global,
+so a re-created bucket of a shape this process already traced costs NO
+new compile.
+
+Cold starts are the expensive part: a fresh process re-traces every
+bucket.  ``export_bucket_runner`` serializes the bucket's batched
+dispatch with ``jax.export`` (flat leaf calling convention — the
+custom pytree treedefs are rebuilt host-side by the loading process,
+so nothing structural rides in the blob), keyed on the bucket spec +
+static-config fingerprint; ``make_aot_runner`` deserializes it into a
+drop-in replacement for the traced dispatch, and the server's compile
+counter stays at ZERO for that bucket.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import zlib
+from collections import OrderedDict
+
+import numpy as np
+
+__all__ = [
+    "BucketSpec", "BucketLRU", "quantize_shape",
+    "bucket_fingerprint", "aot_blob_path", "export_bucket_runner",
+    "make_aot_runner",
+]
+
+#: floors keep tiny requests from quantizing into degenerate sims
+#: (the candidate ring and the residue-class topics need room)
+MIN_PEERS = 64
+MIN_TICKS = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketSpec:
+    """One resident executable's static shape (quantized)."""
+
+    n: int
+    t: int
+    m: int
+    ticks: int
+    k_slots: int = 0
+
+    def key(self) -> str:
+        return (f"n{self.n}-t{self.t}-m{self.m}-ticks{self.ticks}"
+                f"-k{self.k_slots}")
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(int(x) - 1, 0).bit_length()
+
+
+def quantize_shape(n: int, t: int, m: int, ticks: int,
+                   k_slots: int = 0, *,
+                   tick_quantum: int = 8) -> BucketSpec:
+    """Quantize a raw request shape into its bucket spec: n/t/m round
+    up to the next power of two (n floored at MIN_PEERS), ticks to the
+    next multiple of ``tick_quantum``, k_slots to the next power of
+    two (0 = no delay line).  Quantizing UP only — a request never
+    lands in a bucket smaller than itself, so padding is always
+    possible and results are conservative (more peers, more ticks)."""
+    for name, v in (("n", n), ("t", t), ("m", m), ("ticks", ticks)):
+        if not isinstance(v, (int, np.integer)) or isinstance(v, bool) \
+                or v <= 0:
+            raise ValueError(
+                f"shape: {name}={v!r} must be a positive integer")
+    if not isinstance(k_slots, (int, np.integer)) or k_slots < 0:
+        raise ValueError(f"shape: k_slots={k_slots!r} must be a "
+                         "non-negative integer")
+    q = max(1, int(tick_quantum))
+    return BucketSpec(
+        n=max(_next_pow2(n), MIN_PEERS),
+        t=_next_pow2(t),
+        m=_next_pow2(m),
+        ticks=max(-(-int(ticks) // q) * q, MIN_TICKS),
+        k_slots=_next_pow2(k_slots) if k_slots else 0)
+
+
+class BucketLRU:
+    """Bounded mapping of BucketSpec -> bucket entry with LRU
+    eviction.  ``get`` refreshes recency; ``put`` evicts (and returns)
+    the least-recently-used entries past ``max_buckets``."""
+
+    def __init__(self, max_buckets: int):
+        if max_buckets < 1:
+            raise ValueError(
+                f"max_buckets={max_buckets} must be >= 1")
+        self.max_buckets = max_buckets
+        self._d: OrderedDict = OrderedDict()
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def __contains__(self, spec) -> bool:
+        return spec in self._d
+
+    def specs(self) -> list:
+        return list(self._d)
+
+    def get(self, spec):
+        if spec not in self._d:
+            return None
+        self._d.move_to_end(spec)
+        return self._d[spec]
+
+    def put(self, spec, entry) -> list:
+        """Insert (refreshing recency) and return the evicted
+        ``(spec, entry)`` pairs — the caller owns their teardown."""
+        self._d[spec] = entry
+        self._d.move_to_end(spec)
+        evicted = []
+        while len(self._d) > self.max_buckets:
+            evicted.append(self._d.popitem(last=False))
+            self.evictions += 1
+        return evicted
+
+
+# --------------------------------------------------------------------------
+# AOT persistence (jax.export)
+# --------------------------------------------------------------------------
+
+
+def bucket_fingerprint(spec: BucketSpec, server) -> int:
+    """Blob cache key: the bucket spec + the server's static config
+    fingerprint (config_fingerprint over cfg/sc — knob points and
+    formations are traced operands and do NOT contribute)."""
+    from ..parallel.checkpoint import config_fingerprint
+    return zlib.crc32(
+        spec.key().encode()
+        + f"b{server.batch}".encode()
+        + config_fingerprint(server.cfg, server.sc).to_bytes(
+            8, "little", signed=True))
+
+
+def aot_blob_path(aot_dir: str, spec: BucketSpec, server) -> str:
+    return os.path.join(
+        aot_dir,
+        f"bucket-{spec.key()}-{bucket_fingerprint(spec, server):08x}"
+        ".jaxexp")
+
+
+def _reference_batch(server):
+    """One padded reference batch at the server's shape — the aval
+    source for export and the treedef source for the flat calling
+    convention.  Mirrors submit()'s build exactly (invariant
+    attachment included)."""
+    gs = server.gs
+    builds = [gs.make_gossip_sim(server.cfg, score_cfg=server.sc,
+                                 **server._build_kwargs({}))
+              for _ in range(server.batch)]
+    states = [b[1] for b in builds]
+    if server.invariants is not None:
+        states = [server.iv.attach(s) for s in states]
+    params = gs.stack_trees([b[0] for b in builds])
+    state = gs.stack_trees(states)
+    honest = np.ones((server.batch, server.n), dtype=bool)
+    return params, state, honest
+
+
+def export_bucket_runner(server) -> bytes:
+    """Serialize the server's batched dispatch with jax.export.
+
+    The exported function takes FLAT leaf lists (params leaves, state
+    leaves, honest mask) and returns (state leaves, reach) — the
+    loading process rebuilds the treedefs from its own host-side
+    reference build, so no custom pytree registration rides in the
+    blob.  The body is gossip_run_knob_batch's: vmapped step scanned
+    over the horizon, then the honest-masked reach reduction —
+    bit-identical arithmetic, no donation (AOT calls copy the carry;
+    serving correctness over the last word in throughput)."""
+    import jax
+    import jax.export as jax_export
+
+    gs = server.gs
+    params, state, honest = _reference_batch(server)
+    p_leaves, p_def = jax.tree_util.tree_flatten(params)
+    s_leaves, s_def = jax.tree_util.tree_flatten(state)
+    step, ticks = server.step, server.ticks
+
+    def run_flat(p_leaves, s_leaves, honest):
+        prm = jax.tree_util.tree_unflatten(p_def, p_leaves)
+        st = jax.tree_util.tree_unflatten(s_def, s_leaves)
+        vstep = jax.vmap(step)
+
+        def body(s, _):
+            return vstep(prm, s)[0], None
+        st, _ = jax.lax.scan(body, st, None, length=ticks)
+        reach = jax.vmap(gs.reach_counts_from_have)(prm, st, honest)
+        return jax.tree_util.tree_leaves(st), reach
+
+    avals = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+        (p_leaves, s_leaves, honest))
+    exported = jax_export.export(jax.jit(run_flat))(*avals)
+    return exported.serialize()
+
+
+def make_aot_runner(server, blob: bytes):
+    """Deserialize an ``export_bucket_runner`` blob into a drop-in
+    replacement for the server's batched dispatch:
+    ``runner(params, state, honest) -> (state, reach)``.  Attach with
+    ``server._aot_runner = runner`` — the jit cache never grows, so
+    ``server.compiles()`` stays 0 for this bucket."""
+    import jax
+    import jax.export as jax_export
+
+    exported = jax_export.deserialize(blob)
+    _, state, _ = _reference_batch(server)
+    _, s_def = jax.tree_util.tree_flatten(state)
+
+    def runner(params, state, honest):
+        p_leaves = jax.tree_util.tree_leaves(params)
+        s_leaves = jax.tree_util.tree_leaves(state)
+        out_leaves, reach = exported.call(
+            p_leaves, s_leaves, np.asarray(honest))
+        return jax.tree_util.tree_unflatten(s_def, out_leaves), reach
+
+    return runner

@@ -1,0 +1,717 @@
+"""The fault-tolerant multi-tenant scenario front end (round 18).
+
+``ScenarioFrontend`` wraps a bounded set of shape-bucketed sweepd
+servers (buckets.py) with the request lifecycle the north star's
+"heavy traffic" story needs:
+
+  admission control   a queue-depth cap: requests past it come back as
+                      EXPLICIT ``overloaded`` rejection rows — the
+                      front end never silently drops an accepted
+                      request (every admitted request produces exactly
+                      one terminal row: result, error, timeout, or
+                      rejection).
+  deadlines           per-request ``deadline_s`` (seconds from
+                      admission); requests still queued past it are
+                      culled with named ``timeout`` rows before every
+                      dispatch.
+  priority            higher ``priority`` dispatches first (FIFO
+                      within a priority level).
+  bounded retry       transient dispatch failures (RuntimeError/
+                      OSError — NOT request validation errors, which
+                      are terminal rows) retry up to ``max_retries``
+                      times with exponential backoff before the whole
+                      group fails with named rows.
+  graceful drain      a deferred SIGTERM/SIGINT (parallel/checkpoint
+                      stop flag) drains queued short requests, parks
+                      interrupted long ones in the journal, and exits;
+                      kill -9 loses nothing either — the CRC'd journal
+                      replays accepted-but-unserved lines on restart.
+  long scenarios      requests whose bucket horizon reaches
+                      ``long_ticks`` route through the round-15
+                      ``ckpt_*`` runners with a per-request snapshot
+                      directory, so a kill mid-scenario resumes on
+                      restart to the BIT-IDENTICAL digest.
+
+Request schema (front-end fields; everything else is the sweepd
+scenario schema — knobs, drop_prob, churn, attack, attack_frac, seed):
+
+    {"id": "r1", "n": 500, "t": 2, "m": 6, "ticks": 12, "k_slots": 0,
+     "deadline_s": 2.5, "priority": 1, "knobs": {"d": 8}}
+
+Shapes quantize UP into bucket specs (buckets.quantize_shape); the
+request is served at its bucket's shape (more peers / more ticks than
+asked — conservative), and the result row names the bucket it ran in.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import heapq
+import json
+import os
+import shutil
+import sys
+import time
+import zlib
+
+import numpy as np
+
+from . import buckets as bk
+
+__all__ = ["FrontendConfig", "ScenarioFrontend"]
+
+#: front-end request fields, split off before the inner scenario
+#: request reaches the bucket server's validator
+SHAPE_FIELDS = ("n", "t", "m", "ticks", "k_slots")
+FRONT_FIELDS = SHAPE_FIELDS + ("deadline_s", "priority")
+
+
+@dataclasses.dataclass(frozen=True)
+class FrontendConfig:
+    """Host-side front-end spec.
+
+    max_buckets: resident-executable cap (LRU eviction past it).
+    batch: per-bucket dispatch width (>= 2; partial batches pad).
+    queue_cap: admission-control depth — admissions past it are
+        rejected with explicit ``overloaded`` rows.
+    long_ticks: bucket horizons >= this route through the ckpt
+        runners (0 disables the long path).
+    ckpt_dir: snapshot root for long scenarios (one subdir per
+        request id); required when long_ticks > 0.
+    ckpt_every: segment length for long scenarios (0 = horizon/4).
+    aot_dir: executable cache — buckets whose exported blob is found
+        here load with jax.export (zero compiles); buckets traced
+        fresh export their blob here for the next cold start.
+    max_retries / backoff_base_s: bounded retry with exponential
+        backoff on transient dispatch failure.
+    tick_quantum: quantize_shape's tick rounding.
+    default_shape: (n, t, m, ticks) for requests that omit shape
+        fields.
+    server_kw: extra SweepServer kwargs shared by every bucket
+        (seed, invariants, ...).
+    """
+
+    max_buckets: int = 4
+    batch: int = 4
+    queue_cap: int = 512
+    long_ticks: int = 0
+    ckpt_dir: str | None = None
+    ckpt_every: int = 0
+    aot_dir: str | None = None
+    max_retries: int = 2
+    backoff_base_s: float = 0.05
+    tick_quantum: int = 8
+    default_shape: tuple = (256, 2, 8, 16)
+    server_kw: dict = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.batch < 2:
+            raise ValueError(
+                f"FrontendConfig.batch={self.batch} must be >= 2 "
+                "(the front-end compile counter reads the batched "
+                "runner's jit cache; batch=1 is sweepd's sequential "
+                "kernel demonstration, not a serving config)")
+        if self.long_ticks > 0 and not self.ckpt_dir:
+            raise ValueError(
+                "FrontendConfig: long_ticks > 0 needs ckpt_dir — "
+                "preemption-surviving scenarios snapshot to disk")
+
+
+#: step closures shared across bucket rebuilds: jit caches key static
+#: args by IDENTITY, so an evicted-then-recreated bucket must reuse
+#: the step object its shape first compiled under — otherwise the
+#: rebuild re-traces and the process accumulates duplicate executables
+_STEP_MEMO: dict = {}
+
+
+class _QItem:
+    """One admitted request: the raw journal line, its split front/
+    inner fields, its bucket spec, and its lifecycle stamps."""
+
+    __slots__ = ("raw", "req", "inner", "spec", "deadline", "priority",
+                 "seq", "t_admit")
+
+    def __init__(self, raw, req, inner, spec, deadline, priority, seq,
+                 t_admit):
+        self.raw, self.req, self.inner = raw, req, inner
+        self.spec, self.deadline = spec, deadline
+        self.priority, self.seq, self.t_admit = priority, seq, t_admit
+
+
+class _Bucket:
+    """One resident executable: the sweepd server plus its serving
+    bookkeeping."""
+
+    __slots__ = ("spec", "server", "aot", "dispatches")
+
+    def __init__(self, spec, server, aot):
+        self.spec, self.server, self.aot = spec, server, aot
+        self.dispatches = 0
+
+
+class ScenarioFrontend:
+    """See the module docstring.  In-process API:
+
+        fe = ScenarioFrontend(FrontendConfig(...))
+        rej = fe.admit({"id": "r1", "n": 500, "ticks": 12})  # None or
+                                                     # a rejection row
+        rows = fe.dispatch_ready()   # culls deadlines, serves the
+                                     # head bucket when it has a full
+                                     # batch
+        rows += fe.drain()           # force-dispatch everything
+        fe.stats()
+
+    Line protocol: ``serve_lines`` (the tools/sweepd.py shape —
+    flush/stats cmds, CRC'd journal, replay-on-start, deferred-kill
+    drain)."""
+
+    def __init__(self, cfg: FrontendConfig | None = None, **kw):
+        self.cfg = cfg or FrontendConfig(**kw)
+        self.buckets = bk.BucketLRU(self.cfg.max_buckets)
+        self._heap: list = []   # (-priority, seq, _QItem)
+        self._seq = 0
+        self._journal: str | None = None
+        #: raw lines of interrupted long scenarios, kept in the
+        #: journal across compactions until their restart completes
+        self._parked_raw: list[str] = []
+        # counters (every admitted request ends in exactly one of:
+        # served, error, timeout, transient-failure; rejected requests
+        # were never admitted — the accounting identity servestat
+        # checks)
+        self.admitted = 0
+        self.served = 0
+        self.errors = 0
+        self.timeouts = 0
+        self.rejected_overload = 0
+        self.retries = 0
+        self.transient_failures = 0
+        self.long_served = 0
+        self.long_resumed = 0
+        self.aot_loads = 0
+        self.aot_exports = 0
+        self._traced_specs: set = set()
+        self._t0 = time.perf_counter()
+        self.wall_device_s = 0.0
+        # the front end's compile counter: the batched runner's
+        # process-global jit-cache growth since construction (every
+        # bucket dispatches through it; AOT buckets bypass it)
+        import go_libp2p_pubsub_tpu.models.gossipsub as gs
+        self._gs = gs
+        self._cache_base = gs.gossip_run_knob_batch._cache_size()
+        self._long_cache_base = gs.gossip_run._cache_size()
+
+    # -- bucket management --------------------------------------------
+
+    def compiles(self) -> int:
+        """Executables compiled for the short-request serving path
+        since construction — the multi-tenant zero-recompile claim is
+        ``compiles() == number of distinct traced bucket shapes``
+        (AOT-loaded buckets add zero; LRU-evicted-and-rebuilt buckets
+        add zero, the jit cache is process-global)."""
+        return (self._gs.gossip_run_knob_batch._cache_size()
+                - self._cache_base)
+
+    def long_compiles(self) -> int:
+        """Executables compiled for the long-scenario (ckpt) path."""
+        return self._gs.gossip_run._cache_size() - self._long_cache_base
+
+    def _bucket(self, spec: bk.BucketSpec) -> _Bucket:
+        got = self.buckets.get(spec)
+        if got is not None:
+            return got
+        from tools.sweepd import SweepServer
+        server = SweepServer(
+            n=spec.n, t=spec.t, m=spec.m, ticks=spec.ticks,
+            batch=self.cfg.batch, k_slots=spec.k_slots,
+            **self.cfg.server_kw)
+        memo_key = (spec, self.cfg.batch,
+                    json.dumps(self.cfg.server_kw, sort_keys=True,
+                               default=str))
+        if memo_key in _STEP_MEMO:
+            server.step = _STEP_MEMO[memo_key]
+        else:
+            _STEP_MEMO[memo_key] = server.step
+        aot = False
+        if self.cfg.aot_dir:
+            path = bk.aot_blob_path(self.cfg.aot_dir, spec, server)
+            if os.path.exists(path):
+                try:
+                    with open(path, "rb") as f:
+                        server._aot_runner = bk.make_aot_runner(
+                            server, f.read())
+                    aot = True
+                    self.aot_loads += 1
+                except Exception as e:   # stale/foreign blob: retrace
+                    print(f"serving: AOT blob {path} unusable "
+                          f"({e.__class__.__name__}: {e}) — falling "
+                          "back to tracing", file=sys.stderr,
+                          flush=True)
+                    server._aot_runner = None
+            if not aot:
+                try:
+                    os.makedirs(self.cfg.aot_dir, exist_ok=True)
+                    blob = bk.export_bucket_runner(server)
+                    tmp = path + ".tmp"
+                    with open(tmp, "wb") as f:
+                        f.write(blob)
+                    os.replace(tmp, path)
+                    self.aot_exports += 1
+                except Exception as e:
+                    print("serving: AOT export failed "
+                          f"({e.__class__.__name__}: {e}) — bucket "
+                          "serves traced", file=sys.stderr, flush=True)
+        if not aot:
+            self._traced_specs.add(spec)
+        bucket = _Bucket(spec, server, aot)
+        self.buckets.put(spec, bucket)   # evicted servers just drop:
+        # their executables stay in the process-global jit cache, so a
+        # re-created bucket costs a host-side rebuild, not a compile
+        return bucket
+
+    # -- admission -----------------------------------------------------
+
+    def _split(self, req: dict):
+        inner = {k: v for k, v in req.items() if k not in FRONT_FIELDS}
+        dn, dt, dm, dticks = self.cfg.default_shape
+        spec = bk.quantize_shape(
+            req.get("n", dn), req.get("t", dt), req.get("m", dm),
+            req.get("ticks", dticks), req.get("k_slots", 0),
+            tick_quantum=self.cfg.tick_quantum)
+        return inner, spec
+
+    def admit(self, req: dict, *, raw: str | None = None,
+              now: float | None = None) -> dict | None:
+        """Admit one request.  Returns ``None`` on success, or the
+        request's terminal row (explicit ``overloaded`` rejection, or
+        a validation error row) — the caller emits it."""
+        now = time.monotonic() if now is None else now
+        if not isinstance(req, dict):
+            self.errors += 1
+            return {"ok": False,
+                    "error": "request must be a JSON object, got "
+                             f"{type(req).__name__}"}
+        if len(self._heap) >= self.cfg.queue_cap:
+            self.rejected_overload += 1
+            return {"id": req.get("id"), "ok": False,
+                    "overloaded": True,
+                    "error": f"overloaded: queue depth "
+                             f"{len(self._heap)} at the admission cap "
+                             f"({self.cfg.queue_cap}) — the request "
+                             "was rejected explicitly (never silently "
+                             "dropped); resubmit after the queue "
+                             "drains"}
+        try:
+            inner, spec = self._split(req)
+            deadline_s = req.get("deadline_s")
+            deadline = (None if deadline_s is None
+                        else now + float(deadline_s))
+            priority = int(req.get("priority", 0))
+        except (ValueError, TypeError) as e:
+            self.errors += 1
+            return {"id": req.get("id"), "ok": False, "error": str(e)}
+        item = _QItem(raw if raw is not None else json.dumps(req),
+                      req, inner, spec, deadline, priority, self._seq,
+                      now)
+        heapq.heappush(self._heap, (-priority, self._seq, item))
+        self._seq += 1
+        self.admitted += 1
+        return None
+
+    # -- dispatch ------------------------------------------------------
+
+    def _cull_deadlines(self, now: float) -> list[dict]:
+        rows = []
+        keep = []
+        for entry in self._heap:
+            item = entry[2]
+            if item.deadline is not None and now > item.deadline:
+                self.timeouts += 1
+                rows.append({
+                    "id": item.req.get("id"), "ok": False,
+                    "timeout": True,
+                    "error": "deadline exceeded: request waited "
+                             f"{now - item.t_admit:.3f}s in queue, "
+                             f"past its deadline_s="
+                             f"{item.req.get('deadline_s')} — culled "
+                             "before dispatch"})
+            else:
+                keep.append(entry)
+        if len(keep) != len(self._heap):
+            self._heap = keep
+            heapq.heapify(self._heap)
+        return rows
+
+    def _pop_group(self) -> list[_QItem]:
+        """Pop the head item plus queued same-bucket items up to the
+        batch width (priority order, FIFO within a level)."""
+        if not self._heap:
+            return []
+        head = heapq.heappop(self._heap)[2]
+        group, keep = [head], []
+        want = self.cfg.batch - 1
+        while self._heap and want:
+            entry = heapq.heappop(self._heap)
+            if entry[2].spec == head.spec:
+                group.append(entry[2])
+                want -= 1
+            else:
+                keep.append(entry)
+        for entry in keep:
+            heapq.heappush(self._heap, entry)
+        return group
+
+    def _is_long(self, spec: bk.BucketSpec) -> bool:
+        return (self.cfg.long_ticks > 0
+                and spec.ticks >= self.cfg.long_ticks)
+
+    def _submit_with_retry(self, bucket: _Bucket,
+                           items: list[_QItem]) -> list[dict]:
+        from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
+        reqs = [item.inner for item in items]
+        attempt = 0
+        while True:
+            try:
+                t0 = time.perf_counter()
+                rows = bucket.server.submit([dict(r) for r in reqs])
+                self.wall_device_s += time.perf_counter() - t0
+                bucket.dispatches += 1
+                return rows
+            except ck.CheckpointInterrupt:
+                raise   # drain machinery, not a dispatch failure
+            except (ValueError, TypeError) as e:
+                # request-level problems are terminal rows, never
+                # retried (determinism: the same input fails the same
+                # way)
+                self.errors += len(items)
+                return [{"id": it.req.get("id"), "ok": False,
+                         "error": str(e)} for it in items]
+            except (RuntimeError, OSError) as e:
+                attempt += 1
+                if attempt > self.cfg.max_retries:
+                    self.transient_failures += len(items)
+                    return [{"id": it.req.get("id"), "ok": False,
+                             "transient": True,
+                             "error": "dispatch failed after "
+                                      f"{attempt} attempts "
+                                      f"({e.__class__.__name__}: {e})"}
+                            for it in items]
+                self.retries += 1
+                time.sleep(self.cfg.backoff_base_s
+                           * (2 ** (attempt - 1)))
+
+    # -- long scenarios (preemption-surviving) -------------------------
+
+    def _ckpt_paths(self, item: _QItem):
+        rid = str(item.req.get("id", item.seq))
+        safe = "".join(c if c.isalnum() or c in "._-" else "_"
+                       for c in rid) or "req"
+        return os.path.join(self.cfg.ckpt_dir,
+                            f"{safe}-{zlib.crc32(item.raw.encode()):08x}")
+
+    def _dispatch_long(self, item: _QItem) -> dict:
+        """One preemption-surviving scenario through the round-15
+        segmented runner: per-request snapshot directory, fingerprint
+        bound to the request AND the bucket's static config, resume
+        from the latest snapshot on restart, bit-identical digest."""
+        from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
+
+        bucket = self._bucket(item.spec)
+        server = bucket.server
+        gs = server.gs
+        kw = server._build_kwargs(item.inner)   # may raise → caller
+        params, state = gs.make_gossip_sim(server.cfg,
+                                           score_cfg=server.sc, **kw)
+        if server.invariants is not None:
+            state = server.iv.attach(state)
+        honest = ~(np.asarray(kw["sybil"])
+                   | np.asarray(kw["eclipse_sybil"])
+                   | (np.asarray(kw["byzantine"])
+                      if kw["byzantine"] is not None else False))
+        directory = self._ckpt_paths(item)
+        resumed = os.path.isdir(directory) and any(
+            name.endswith(".ckpt") for name in os.listdir(directory))
+        fp = (ck.config_fingerprint(server.cfg, server.sc)
+              ^ zlib.crc32(item.raw.encode()))
+        ckc = ck.CheckpointConfig(
+            directory=directory,
+            every=self.cfg.ckpt_every or max(item.spec.ticks // 4, 1),
+            fingerprint=fp, tag="serve")
+        t0 = time.perf_counter()
+        out = ck.ckpt_gossip_run(params, state, item.spec.ticks,
+                                 server.step, ckc)
+        self.wall_device_s += time.perf_counter() - t0
+        reach = np.asarray(gs.reach_counts_from_have(params, out,
+                                                     honest))
+        h = hashlib.blake2b(digest_size=16)
+        for leaf in (out.have, out.mesh, out.backoff, out.tick):
+            h.update(np.asarray(leaf).tobytes())
+        want = np.array(
+            [(honest & (server.members == tau)).sum()
+             for tau in server.topic], dtype=np.float64)
+        want_all = np.array(
+            [(server.members == tau).sum() for tau in server.topic],
+            dtype=np.float64)
+        row = {
+            "id": item.req.get("id"), "ok": True, "long": True,
+            "bucket": item.spec.key(), "ticks": item.spec.ticks,
+            "resumed": bool(resumed),
+            "digest": h.hexdigest(),
+            "honest_delivery_fraction":
+                round(float((reach / want).mean()), 4),
+            "delivery_fraction":
+                round(float((reach / want_all).mean()), 4),
+        }
+        if server.invariants is not None:
+            row["inv_bits"] = int(np.asarray(out.inv_viol))
+        shutil.rmtree(directory, ignore_errors=True)   # digest proven
+        self.long_served += 1
+        if resumed:
+            self.long_resumed += 1
+        return row
+
+    def _dispatch_long_guarded(self, item: _QItem) -> dict:
+        """_dispatch_long with the retry/terminal-row treatment of the
+        short path; CheckpointInterrupt propagates (drain)."""
+        from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
+        attempt = 0
+        while True:
+            try:
+                return self._dispatch_long(item)
+            except ck.CheckpointInterrupt:
+                raise
+            except (ValueError, TypeError) as e:
+                self.errors += 1
+                return {"id": item.req.get("id"), "ok": False,
+                        "error": str(e)}
+            except (RuntimeError, OSError) as e:
+                attempt += 1
+                if attempt > self.cfg.max_retries:
+                    self.transient_failures += 1
+                    return {"id": item.req.get("id"), "ok": False,
+                            "transient": True,
+                            "error": "dispatch failed after "
+                                     f"{attempt} attempts "
+                                     f"({e.__class__.__name__}: {e})"}
+                self.retries += 1
+                time.sleep(self.cfg.backoff_base_s
+                           * (2 ** (attempt - 1)))
+
+    # -- the serve loop ------------------------------------------------
+
+    def queued(self) -> int:
+        return len(self._heap)
+
+    def _head_ready(self) -> bool:
+        """True when the head bucket has a full batch queued (or the
+        head item is long — long scenarios dispatch individually)."""
+        if not self._heap:
+            return False
+        head = self._heap[0][2]
+        if self._is_long(head.spec):
+            return True
+        same = sum(1 for entry in self._heap
+                   if entry[2].spec == head.spec)
+        return same >= self.cfg.batch
+
+    def dispatch_ready(self, *, force: bool = False,
+                       now: float | None = None) -> list[dict]:
+        """Cull expired deadlines, then dispatch the head bucket group
+        when it is full (``force=True`` dispatches partial groups —
+        the drain path).  One call, at most one device dispatch."""
+        now = time.monotonic() if now is None else now
+        rows = self._cull_deadlines(now)
+        if not self._heap or not (force or self._head_ready()):
+            return rows
+        head = self._heap[0][2]
+        if self._is_long(head.spec):
+            item = heapq.heappop(self._heap)[2]
+            rows.append(self._dispatch_long_guarded(item))
+            self.served += 1
+            return rows
+        group = self._pop_group()
+        if not group:
+            return rows
+        bucket = self._bucket(group[0].spec)
+        got = self._submit_with_retry(bucket, group)
+        for item, row in zip(group, got):
+            row.setdefault("bucket", item.spec.key())
+            row["queue_s"] = round(now - item.t_admit, 4)
+            rows.append(row)
+            self.served += 1
+        return rows
+
+    def drain(self) -> list[dict]:
+        """Dispatch everything still queued (partial groups
+        included)."""
+        rows = []
+        while self._heap:
+            rows.extend(self.dispatch_ready(force=True))
+        return rows
+
+    # -- counters ------------------------------------------------------
+
+    def stats(self) -> dict:
+        dev = self.wall_device_s
+        return {
+            "stats": True,
+            "admitted": self.admitted, "served": self.served,
+            "errors": self.errors, "timeouts": self.timeouts,
+            "rejected_overload": self.rejected_overload,
+            "transient_failures": self.transient_failures,
+            "retries": self.retries,
+            "queued": len(self._heap),
+            "parked": len(self._parked_raw),
+            "buckets": [s.key() for s in self.buckets.specs()],
+            "bucket_count": len(self.buckets),
+            "traced_buckets": len(self._traced_specs),
+            "evictions": self.buckets.evictions,
+            "compiles": self.compiles(),
+            "long_compiles": self.long_compiles(),
+            "long_served": self.long_served,
+            "long_resumed": self.long_resumed,
+            "aot_loads": self.aot_loads,
+            "aot_exports": self.aot_exports,
+            "requests_per_sec": round(self.served / dev, 3) if dev
+            else None,
+            "wall_s": round(time.perf_counter() - self._t0, 2),
+            "device_s": round(dev, 2),
+        }
+
+    # -- line protocol (journal + drain; the sweepd shape) -------------
+
+    def _journal_append(self, raw: str) -> None:
+        if self._journal is None:
+            return
+        from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
+        parent = os.path.dirname(self._journal)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(self._journal, "a") as f:
+            f.write(ck.journal_encode_line(raw) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def _journal_compact(self) -> None:
+        """Rewrite the journal to the still-unserved lines: everything
+        queued plus interrupted (parked) long scenarios — atomically,
+        a crash mid-compaction must not lose requests."""
+        if self._journal is None:
+            return
+        from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
+        from go_libp2p_pubsub_tpu.utils.artifacts import (
+            write_text_atomic)
+        parent = os.path.dirname(self._journal)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        raws = [entry[2].raw for entry in sorted(self._heap)]
+        raws += self._parked_raw
+        write_text_atomic(self._journal,
+                          "".join(ck.journal_encode_line(r) + "\n"
+                                  for r in raws))
+
+    def serve_lines(self, lines, out, *, journal: str | None = None
+                    ) -> None:
+        """Drive the front end from an iterable of JSON lines, one
+        request per line, writing rows to ``out``.  Control lines:
+        ``{"cmd": "flush"}`` drains the queue, ``{"cmd": "stats"}``
+        emits the counters row; EOF drains.  With ``journal=PATH``
+        every admitted line is CRC-appended before it can dispatch and
+        the journal is compacted to the still-unserved lines after
+        every dispatch; lines left by a killed server (torn tail lines
+        dropped by name) are replayed on entry.  A pending deferred
+        kill drains short requests and parks interrupted long ones in
+        the journal for the restart to resume."""
+        from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
+
+        self._journal = journal
+
+        def emit(obj):
+            out.write(json.dumps(obj) + "\n")
+            out.flush()
+
+        def emit_all(rows):
+            for row in rows:
+                emit(row)
+            if rows:
+                self._journal_compact()
+
+        def dispatch_guard(*, force: bool = False) -> None:
+            """One dispatch_ready with interrupt parking: a
+            CheckpointInterrupt (deferred kill mid-long-scenario)
+            parks the request's journal line for the restart — its
+            snapshot is already flushed — and emits the named
+            interruption row."""
+            head = self._heap[0][2] if self._heap else None
+            try:
+                emit_all(self.dispatch_ready(force=force))
+            except ck.CheckpointInterrupt as e:
+                self._parked_raw.append(head.raw)
+                emit({"id": head.req.get("id"), "ok": False,
+                      "interrupted": True, "journaled": True,
+                      "error": "interrupted mid-scenario at tick "
+                               f"{e.ticks_done}/{e.n_ticks} — "
+                               "journaled; a restarted server "
+                               "resumes from the snapshot to the "
+                               "bit-identical digest"})
+                self._journal_compact()
+
+        def drain_interruptible() -> None:
+            """Drain; interrupted long scenarios park and the rest
+            keeps draining."""
+            while self._heap:
+                dispatch_guard(force=True)
+
+        def handle(raw: str, *, journal_new: bool) -> None:
+            try:
+                req = json.loads(raw)
+            except json.JSONDecodeError as e:
+                self.errors += 1
+                emit({"ok": False, "error": f"bad JSON: {e}"})
+                return
+            cmd = req.get("cmd") if isinstance(req, dict) else None
+            if cmd == "flush":
+                drain_interruptible()
+            elif cmd == "stats":
+                emit(self.stats())
+            elif cmd:
+                self.errors += 1
+                emit({"ok": False,
+                      "error": f"unknown cmd {cmd!r} (flush/stats)"})
+            else:
+                row = self.admit(req, raw=raw)
+                if row is not None:
+                    emit(row)
+                    return
+                if journal_new:
+                    self._journal_append(raw)
+                while self._head_ready():
+                    dispatch_guard()
+
+        if journal is not None:
+            replay, torn = ck.read_journal(journal)
+            if torn:
+                print(f"serving: dropping {torn} torn journal "
+                      "line(s) (CRC mismatch — the writer died "
+                      f"mid-append); replaying the {len(replay)} "
+                      "intact line(s)", file=sys.stderr, flush=True)
+            if replay:
+                print(f"serving: replaying {len(replay)} journaled "
+                      "request line(s) from an interrupted run",
+                      file=sys.stderr, flush=True)
+                for raw in replay:
+                    handle(raw, journal_new=False)
+                self._journal_compact()
+
+        for line in lines:
+            line = line.strip()
+            if line:
+                handle(line, journal_new=True)
+            if ck.stop_requested():
+                print("serving: stop requested — draining queued "
+                      "requests and parking interrupted long "
+                      "scenarios", file=sys.stderr, flush=True)
+                break
+        drain_interruptible()
+        self._journal_compact()
+        emit(self.stats())

@@ -672,6 +672,80 @@ def test_delta_async_curve_aux_bit_identity(tmp_path):
     assert np.array_equal(np.asarray(c_ref), np.asarray(c))
 
 
+def test_delta_async_chain_links_ordered(tmp_path):
+    """async_write=True + full_every=3 TOGETHER: the writer thread
+    must serialize snapshots in segment order, because each delta is
+    encoded against the previous snapshot's payload CRC — if segment
+    k+1's write ever overtook segment k's, the on-disk base_crc32
+    links would break.  Pins the header chain: kinds
+    full/delta/delta/full and every delta's base_crc32 equal to the
+    PREVIOUS on-disk snapshot's payload_crc32."""
+    cfg, sc, build, steps = _armed()
+    s_ref = _armed_ref("combined")
+    ckc = _ckpt(tmp_path, 3, keep=10, full_every=3, async_write=True)
+    params, state = build()
+    s = ck.ckpt_gossip_run(params, state, TICKS, steps["combined"],
+                           ckc)
+    assert _trees_equal(s_ref, s)
+    headers = {}
+    for name in sorted(os.listdir(ckc.directory)):
+        h, _ = ck.snapshot_read(os.path.join(ckc.directory, name))
+        headers[h["segment"]] = h
+    assert {i: h["kind"] for i, h in headers.items()} == {
+        1: "full", 2: "delta", 3: "delta", 4: "full"}
+    for i, h in headers.items():
+        if h["kind"] == "delta":
+            assert h["base_crc32"] == headers[i - 1]["payload_crc32"], \
+                (i, h)
+    # and a resume landing ON the mid-chain delta reconstructs it
+    h3, _ = ck.read_snapshot_chain(ckc.directory, "sim", 3)
+    assert h3["ticks_done"] == 9
+
+
+def test_delta_async_kill_drains_chain_then_resumes(tmp_path):
+    """The deferred-kill contract with BOTH round-16 flags up: the
+    drain must flush the in-flight DELTA write before
+    CheckpointInterrupt escapes, so the named snapshot's whole chain
+    is durable and readable at that instant; resuming from it
+    reproduces the uninterrupted trajectory bit-identically."""
+    cfg, sc, build, steps = _armed()
+    ckc = _ckpt(tmp_path, 3, keep=10, full_every=3, async_write=True)
+    ck.request_stop()
+    try:
+        params, state = build()
+        with pytest.raises(ck.CheckpointInterrupt) as ei:
+            ck.ckpt_gossip_run(params, state, TICKS,
+                               steps["combined"], ckc)
+        assert os.path.exists(ei.value.path)
+        header, _ = ck.read_snapshot_chain(
+            ckc.directory, "sim", 1)
+        assert header["ticks_done"] == ei.value.ticks_done == 3
+    finally:
+        ck.clear_stop()
+    params, state = build()
+    s_res = ck.ckpt_gossip_run(params, state, TICKS,
+                               steps["combined"], ckc)
+    assert _trees_equal(_armed_ref("combined"), s_res)
+
+
+def test_prune_protects_delta_chain_async(tmp_path):
+    """keep=2 pruning under the async writer: the background thread's
+    prune must floor at the governing full exactly as the synchronous
+    writer does — segment 3 is a delta rooted at the segment-1 full,
+    so segments 1-4 all survive and the mid-chain read reconstructs."""
+    cfg, sc, build, steps = _armed()
+    s_ref = _armed_ref("combined")
+    ckc = _ckpt(tmp_path, 3, keep=2, full_every=3, async_write=True)
+    params, state = build()
+    s = ck.ckpt_gossip_run(params, state, TICKS, steps["combined"],
+                           ckc)
+    assert _trees_equal(s_ref, s)
+    names = sorted(os.listdir(ckc.directory))
+    assert names == [f"sim-seg{i:06d}.ckpt" for i in (1, 2, 3, 4)]
+    h3, _ = ck.read_snapshot_chain(ckc.directory, "sim", 3)
+    assert h3["ticks_done"] == 9
+
+
 def test_unusable_delta_chain_missing_full_rejected(tmp_path):
     cfg, sc, build, steps = _armed()
     ckc = _ckpt(tmp_path, 3, keep=10, full_every=4)

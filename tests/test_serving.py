@@ -1,0 +1,580 @@
+"""Round-18 fault-tolerant multi-tenant serving
+(go_libp2p_pubsub_tpu/serving + the tools/sweepd.py capability lift).
+
+The front end's contracts, each pinned:
+
+* shape bucketing — requests quantize UP into a bounded bucket-spec
+  set; the compile counter equals the number of DISTINCT traced
+  bucket shapes, and LRU eviction + rebuild adds zero (the jit cache
+  is process-global, step closures memoized by identity);
+* request lifecycle — admission past the queue cap is an EXPLICIT
+  ``overloaded`` rejection row, expired deadlines are named timeout
+  rows, transient dispatch failures retry with exponential backoff
+  and then fail with named rows: every admitted request ends in
+  exactly one terminal row (the no-silent-drop accounting identity);
+* crash hardening — CRC'd journal lines survive a torn tail (the
+  mid-append kill) on both sweepd and the front end; an interrupted
+  LONG scenario parks in the journal and a restarted server resumes
+  it from its snapshot to the BIT-IDENTICAL digest;
+* AOT executables — a bucket's batched dispatch round-trips through
+  jax.export serialization and serves bit-identical rows with zero
+  jit-cache growth;
+* capability dispatch — the kernel-path/--devices and kernel-path/
+  batch>1 combinations are refused BY NAME through
+  ``server_capability`` (the sweepd face of ``kernel_capability``),
+  and an unarmed server names ``--k-slots`` when refusing delay
+  knobs.
+"""
+
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
+from go_libp2p_pubsub_tpu.serving import (
+    BucketLRU, BucketSpec, FrontendConfig, ScenarioFrontend,
+    quantize_shape)
+from tools.sweepd import SweepServer, server_capability
+
+#: one tiny serving shape shared by the fast tests (the trace is paid
+#: once per (spec, batch, server_kw) triple — distinct seeds below
+#: keep per-test compile counting honest)
+TINY = {"n": 64, "t": 2, "m": 4, "ticks": 8}
+
+
+def _cfg(seed, **kw):
+    kw.setdefault("batch", 2)
+    kw.setdefault("max_buckets", 4)
+    kw.setdefault("default_shape", (64, 2, 4, 8))
+    kw.setdefault("server_kw", {"seed": seed})
+    return FrontendConfig(**kw)
+
+
+def _req(i, seed=0, **kw):
+    r = dict(TINY, id=f"r{i}", seed=seed)
+    r.update(kw)
+    return r
+
+
+# -- bucket quantization / LRU ---------------------------------------------
+
+
+def test_quantize_shape_rounds_up_only():
+    spec = quantize_shape(200, 3, 5, 13)
+    assert spec == BucketSpec(n=256, t=4, m=8, ticks=16)
+    # floors: tiny requests still get a workable sim
+    assert quantize_shape(1, 1, 1, 1) == BucketSpec(64, 1, 1, 8)
+    # a request never lands in a smaller bucket than itself
+    for n, t, m, ticks in ((64, 2, 4, 8), (65, 2, 4, 9), (1000, 7, 9, 33)):
+        s = quantize_shape(n, t, m, ticks)
+        assert s.n >= n and s.t >= t and s.m >= m and s.ticks >= ticks
+    assert quantize_shape(64, 2, 4, 8, 5).k_slots == 8
+    assert quantize_shape(64, 2, 4, 8, tick_quantum=16).ticks == 16
+
+
+@pytest.mark.parametrize("bad", [
+    {"n": 0}, {"t": -1}, {"m": "x"}, {"ticks": 1.5}, {"n": True},
+    {"k_slots": -1},
+])
+def test_quantize_shape_rejects_by_name(bad):
+    kw = dict(n=64, t=2, m=4, ticks=8)
+    kw.update(bad)
+    with pytest.raises(ValueError, match="shape:"):
+        quantize_shape(**kw)
+
+
+def test_bucket_lru_eviction_order():
+    lru = BucketLRU(2)
+    a, b, c = (BucketSpec(64, 1, 1, 8), BucketSpec(128, 1, 1, 8),
+               BucketSpec(256, 1, 1, 8))
+    assert lru.put(a, "A") == [] and lru.put(b, "B") == []
+    assert lru.get(a) == "A"          # refreshes a's recency
+    evicted = lru.put(c, "C")         # b is now the LRU
+    assert evicted == [(b, "B")] and lru.evictions == 1
+    assert lru.specs() == [a, c] and lru.get(b) is None
+    with pytest.raises(ValueError, match="max_buckets"):
+        BucketLRU(0)
+
+
+# -- capability dispatch (satellite: the --devices lift) -------------------
+
+
+def test_server_capability_refusals_by_name():
+    assert server_capability() is None
+    assert server_capability(kernel=True, batch=1) is None
+    assert server_capability(batch=4, devices=2) is None
+    assert "use batch=1" in server_capability(kernel=True, batch=4)
+    assert ("sequential demonstration"
+            in server_capability(kernel=True, batch=1, devices=2))
+
+
+def test_sweepd_kernel_devices_refused_by_name():
+    """The constructor raises server_capability's reason VERBATIM —
+    the string graftlint's probe-refusal registry pins."""
+    with pytest.raises(ValueError,
+                       match="sequential demonstration"):
+        SweepServer(n=64, t=2, m=4, ticks=8, batch=1, kernel=True,
+                    devices=2)
+    with pytest.raises(ValueError, match="use batch=1"):
+        SweepServer(n=64, t=2, m=4, ticks=8, batch=4, kernel=True)
+
+
+def test_sweepd_cli_multi_refuses_kernel_by_name(capsys):
+    """``--multi --kernel`` is a clean exit 2 with the same named
+    reason, before any jax work."""
+    import tools.sweepd as sweepd
+    assert sweepd.main(["--multi", "--kernel"]) == 2
+    assert "sequential demonstration" in capsys.readouterr().err
+
+
+# -- front-end config validation -------------------------------------------
+
+
+def test_frontend_config_validated_by_name():
+    with pytest.raises(ValueError, match="batch=1 is sweepd's"):
+        FrontendConfig(batch=1)
+    with pytest.raises(ValueError, match="needs ckpt_dir"):
+        FrontendConfig(long_ticks=8)
+
+
+# -- admission: overload, deadlines, bad requests --------------------------
+
+
+def test_overload_rejection_rows_are_explicit(monkeypatch):
+    fe = ScenarioFrontend(_cfg(seed=101, queue_cap=2))
+    monkeypatch.setattr(SweepServer, "submit",
+                        lambda self, reqs: [{"id": r.get("id"),
+                                             "ok": True}
+                                            for r in reqs])
+    rej = [fe.admit(_req(i)) for i in range(4)]
+    assert rej[0] is None and rej[1] is None
+    for row in rej[2:]:
+        assert row["overloaded"] and not row["ok"]
+        assert "rejected explicitly" in row["error"]
+    assert fe.rejected_overload == 2 and fe.admitted == 2
+    rows = fe.drain()
+    assert [r["ok"] for r in rows] == [True, True]
+    # the accounting identity: nothing silently dropped
+    st = fe.stats()
+    assert st["admitted"] == (st["served"] + st["errors"]
+                              + st["timeouts"]
+                              + st["transient_failures"]
+                              + st["queued"] + st["parked"])
+
+
+def test_deadline_cull_emits_named_timeout_rows():
+    fe = ScenarioFrontend(_cfg(seed=102))
+    t0 = time.monotonic()
+    assert fe.admit(_req(0, deadline_s=0.5), now=t0) is None
+    assert fe.admit(_req(1), now=t0) is None          # no deadline
+    rows = fe.dispatch_ready(now=t0 + 5.0)
+    assert len(rows) == 1 and rows[0]["timeout"]
+    assert "deadline exceeded" in rows[0]["error"]
+    assert "deadline_s=0.5" in rows[0]["error"]
+    assert fe.timeouts == 1 and fe.queued() == 1
+
+
+def test_bad_requests_come_back_as_error_rows():
+    fe = ScenarioFrontend(_cfg(seed=103))
+    row = fe.admit([1, 2])
+    assert not row["ok"] and "JSON object" in row["error"]
+    row = fe.admit(_req(0, n=-5))
+    assert not row["ok"] and "positive integer" in row["error"]
+    assert fe.errors == 2 and fe.admitted == 0
+
+
+def test_priority_dispatches_first(monkeypatch):
+    fe = ScenarioFrontend(_cfg(seed=104))
+    monkeypatch.setattr(SweepServer, "submit",
+                        lambda self, reqs: [{"id": r.get("id"),
+                                             "ok": True}
+                                            for r in reqs])
+    fe.admit(_req(0))
+    fe.admit(_req(1, priority=5))
+    fe.admit(_req(2, priority=5))
+    rows = fe.drain()
+    assert [r["id"] for r in rows] == ["r1", "r2", "r0"]
+
+
+# -- bounded retry / transient failure rows --------------------------------
+
+
+def test_transient_failures_retry_with_backoff(monkeypatch):
+    fe = ScenarioFrontend(_cfg(seed=105, max_retries=2,
+                               backoff_base_s=0.001))
+    calls = {"n": 0}
+
+    def flaky(self, reqs):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise RuntimeError("device briefly gone")
+        return [{"id": r.get("id"), "ok": True} for r in reqs]
+    monkeypatch.setattr(SweepServer, "submit", flaky)
+    fe.admit(_req(0))
+    fe.admit(_req(1))
+    rows = fe.drain()
+    assert all(r["ok"] for r in rows) and calls["n"] == 3
+    assert fe.retries == 2 and fe.transient_failures == 0
+
+
+def test_transient_failure_terminal_rows_after_retries(monkeypatch):
+    fe = ScenarioFrontend(_cfg(seed=106, max_retries=1,
+                               backoff_base_s=0.001))
+
+    def dead(self, reqs):
+        raise RuntimeError("device gone for good")
+    monkeypatch.setattr(SweepServer, "submit", dead)
+    fe.admit(_req(0))
+    fe.admit(_req(1))
+    rows = fe.drain()
+    assert len(rows) == 2
+    for r in rows:
+        assert not r["ok"] and r["transient"]
+        assert "after 2 attempts" in r["error"]
+    assert fe.transient_failures == 2 and fe.retries == 1
+    st = fe.stats()
+    assert st["admitted"] == 2 and st["served"] == 2  # terminal rows
+
+
+def test_validation_errors_never_retry(monkeypatch):
+    fe = ScenarioFrontend(_cfg(seed=107, max_retries=5))
+    calls = {"n": 0}
+
+    def reject(self, reqs):
+        calls["n"] += 1
+        raise ValueError("scenario: unknown field(s) ['bogus']")
+    monkeypatch.setattr(SweepServer, "submit", reject)
+    fe.admit(_req(0, bogus=1))
+    fe.admit(_req(1, bogus=1))
+    rows = fe.drain()
+    assert calls["n"] == 1            # terminal on the first attempt
+    assert all("unknown field" in r["error"] for r in rows)
+    assert fe.errors == 2 and fe.retries == 0
+
+
+# -- journal CRC helpers + torn-tail replay --------------------------------
+
+
+def test_journal_codec_roundtrip_and_torn_detection():
+    raw = json.dumps({"id": "x", "seed": 3})
+    enc = ck.journal_encode_line(raw)
+    assert ck.journal_decode_line(enc) == raw
+    # torn inside the suffix: the CRC (or its hex) fails
+    assert ck.journal_decode_line(enc[:-1]) is None
+    assert ck.journal_decode_line(enc[:-2] + "zz") is None
+    # legacy (pre-round-18) journals have no CRC suffix: passthrough
+    assert ck.journal_decode_line(raw) == raw
+    with pytest.raises(ValueError, match="newline"):
+        ck.journal_encode_line("two\nlines")
+
+
+def test_read_journal_drops_torn_tail_keeps_intact(tmp_path):
+    p = tmp_path / "j"
+    lines = [json.dumps({"id": f"s{i}"}) for i in range(3)]
+    enc = [ck.journal_encode_line(x) for x in lines]
+    p.write_text(enc[0] + "\n" + enc[1] + "\n" + enc[2][:-4])
+    payloads, torn = ck.read_journal(str(p))
+    assert payloads == lines[:2] and torn == 1
+    # a tail cut BEFORE the separator: legacy-shaped, but the file's
+    # other lines prove a CRC-aware writer — torn, not legacy
+    p.write_text(enc[0] + "\n" + enc[1][: len(lines[1]) // 2])
+    payloads, torn = ck.read_journal(str(p))
+    assert payloads == lines[:1] and torn == 1
+    # an all-legacy journal replays unchanged
+    p.write_text("".join(x + "\n" for x in lines))
+    assert ck.read_journal(str(p)) == (lines, 0)
+    assert ck.read_journal(str(tmp_path / "missing")) == ([], 0)
+
+
+def test_sweepd_replays_intact_lines_past_torn_tail(tmp_path, capsys,
+                                                    monkeypatch):
+    """A sweepd journal with a torn tail (the writer died mid-append)
+    replays every intact line and names the drop on stderr instead of
+    burning a bad-JSON error row."""
+    monkeypatch.setattr(SweepServer, "submit",
+                        lambda self, reqs: [{"id": r.get("id"),
+                                             "ok": True}
+                                            for r in reqs])
+    journal = tmp_path / "sweepd.journal"
+    raws = [json.dumps({"id": f"s{i}", "seed": i}) for i in range(2)]
+    torn = ck.journal_encode_line(json.dumps({"id": "torn"}))[:-4]
+    journal.write_text("".join(ck.journal_encode_line(r) + "\n"
+                               for r in raws) + torn)
+    srv = SweepServer(n=64, t=2, m=4, ticks=8, batch=2, seed=108)
+    out = io.StringIO()
+    srv.serve_lines([], out, journal=str(journal))
+    err = capsys.readouterr().err
+    assert "dropping 1 torn journal line(s)" in err
+    rows = [json.loads(x) for x in out.getvalue().splitlines()]
+    assert [r["id"] for r in rows if r.get("ok")] == ["s0", "s1"]
+    assert not any("bad JSON" in str(r.get("error")) for r in rows)
+
+
+def test_frontend_replays_intact_lines_past_torn_tail(tmp_path, capsys,
+                                                      monkeypatch):
+    monkeypatch.setattr(SweepServer, "submit",
+                        lambda self, reqs: [{"id": r.get("id"),
+                                             "ok": True}
+                                            for r in reqs])
+    journal = tmp_path / "serve.journal"
+    raws = [json.dumps(_req(i)) for i in range(2)]
+    torn = ck.journal_encode_line(json.dumps(_req(9)))[:-4]
+    journal.write_text("".join(ck.journal_encode_line(r) + "\n"
+                               for r in raws) + torn)
+    fe = ScenarioFrontend(_cfg(seed=109))
+    out = io.StringIO()
+    fe.serve_lines([], out, journal=str(journal))
+    err = capsys.readouterr().err
+    assert "dropping 1 torn journal line(s)" in err
+    rows = [json.loads(x) for x in out.getvalue().splitlines()]
+    assert [r["id"] for r in rows if r.get("ok")] == ["r0", "r1"]
+    stats = rows[-1]
+    assert stats["stats"] and stats["admitted"] == 2
+    # served, so the journal compacted to empty
+    assert journal.read_text() == ""
+
+
+# -- compile == buckets, eviction, delay-armed buckets ---------------------
+
+
+def test_compile_count_equals_buckets_and_eviction_is_free():
+    """Two distinct shapes -> two compiles; evicting one (max_buckets
+    = 1) and re-serving it rebuilds the bucket WITHOUT a new compile
+    (process-global jit cache + the step memo)."""
+    fe = ScenarioFrontend(_cfg(seed=110, max_buckets=1))
+    fe.admit(_req(0))
+    fe.admit(_req(1))
+    rows = fe.drain()
+    fe.admit(_req(2, n=128))           # second shape evicts the first
+    fe.admit(_req(3, n=128))
+    rows += fe.drain()
+    fe.admit(_req(4))                  # first shape again: rebuild
+    fe.admit(_req(5))
+    rows += fe.drain()
+    assert all(r["ok"] for r in rows), rows
+    st = fe.stats()
+    assert st["compiles"] == st["traced_buckets"] == 2
+    assert st["evictions"] == 2 and st["bucket_count"] == 1
+    assert {r["bucket"] for r in rows} == {
+        "n64-t2-m4-ticks8-k0", "n128-t2-m4-ticks8-k0"}
+
+
+def test_delay_knobs_need_a_k_armed_bucket():
+    """A request carrying delay knobs against a k_slots=0 bucket gets
+    the named refusal row pointing at --k-slots; the same request
+    with k_slots set routes to a delay-armed bucket and serves."""
+    fe = ScenarioFrontend(_cfg(seed=111))
+    fe.admit(_req(0, knobs={"delay_base": 2}))
+    fe.admit(_req(1))
+    rows = fe.drain()
+    bad = next(r for r in rows if r["id"] == "r0")
+    assert not bad["ok"] and "--k-slots" in bad["error"]
+    fe.admit(_req(2, k_slots=4, knobs={"delay_base": 2}))
+    fe.admit(_req(3, k_slots=4))
+    rows = fe.drain()
+    assert all(r["ok"] for r in rows), rows
+    assert all(r["bucket"].endswith("-k4") for r in rows)
+
+
+# -- AOT export/load -------------------------------------------------------
+
+
+def test_aot_roundtrip_serves_bit_identical_rows(tmp_path):
+    """Export on first build, load on the next: the AOT bucket serves
+    the exact rows of the traced bucket with zero jit-cache growth
+    and no traced buckets."""
+    aot = str(tmp_path / "aot")
+    fe1 = ScenarioFrontend(_cfg(seed=112, aot_dir=aot))
+    fe1.admit(_req(0))
+    fe1.admit(_req(1, knobs={"d": 3, "d_lo": 2, "d_hi": 6}))
+    ref = fe1.drain()
+    st1 = fe1.stats()
+    # the jit cache keys steps structurally, so an earlier same-shape
+    # bucket anywhere in the process makes fe1's dispatch a cache hit
+    # (compiles() == 0); all this side asserts is export + traced serve
+    assert st1["aot_exports"] == 1 and st1["aot_loads"] == 0
+    assert st1["traced_buckets"] == 1 and st1["compiles"] <= 1
+    assert len(os.listdir(aot)) == 1
+
+    fe2 = ScenarioFrontend(_cfg(seed=112, aot_dir=aot))
+    fe2.admit(_req(0))
+    fe2.admit(_req(1, knobs={"d": 3, "d_lo": 2, "d_hi": 6}))
+    got = fe2.drain()
+    st2 = fe2.stats()
+    assert st2["aot_loads"] == 1 and st2["compiles"] == 0
+    assert st2["traced_buckets"] == 0
+    strip = lambda rows: [{k: v for k, v in r.items()
+                           if k != "queue_s"} for r in rows]
+    assert strip(got) == strip(ref)
+
+
+# -- preemption-surviving long scenarios -----------------------------------
+
+
+def _long_cfg(tmp_path, seed, tag):
+    return _cfg(seed=seed, long_ticks=16,
+                ckpt_dir=str(tmp_path / f"ckpt_{tag}"), ckpt_every=4)
+
+
+def test_long_scenario_parks_on_interrupt_and_resumes_bit_identical(
+        tmp_path):
+    """The full preemption story in-process: a deferred kill lands
+    mid-long-scenario -> CheckpointInterrupt -> the request's journal
+    line PARKS (named interruption row, snapshot flushed); a fresh
+    front end over the same journal replays it, resumes from the
+    snapshot (resumed=True), and its digest matches an uninterrupted
+    reference run bit-identically."""
+    raw = json.dumps(dict(TINY, id="long1", ticks=16, seed=5))
+    journal = str(tmp_path / "serve.journal")
+
+    ref_fe = ScenarioFrontend(_long_cfg(tmp_path, 113, "ref"))
+    buf = io.StringIO()
+    ref_fe.serve_lines([raw], buf)
+    ref = next(json.loads(x) for x in buf.getvalue().splitlines()
+               if json.loads(x).get("long"))
+    assert ref["ok"] and not ref["resumed"]
+
+    fe1 = ScenarioFrontend(_long_cfg(tmp_path, 113, "live"))
+    ck.request_stop()
+    try:
+        buf = io.StringIO()
+        fe1.serve_lines([raw], buf, journal=journal)
+    finally:
+        ck.clear_stop()
+    rows = [json.loads(x) for x in buf.getvalue().splitlines()]
+    parked = next(r for r in rows if r.get("interrupted"))
+    assert parked["journaled"] and "bit-identical" in parked["error"]
+    assert rows[-1]["parked"] == 1
+    assert ck.read_journal(journal)[0] == [raw]
+
+    fe2 = ScenarioFrontend(_long_cfg(tmp_path, 113, "live"))
+    buf = io.StringIO()
+    fe2.serve_lines([], buf, journal=journal)
+    rows = [json.loads(x) for x in buf.getvalue().splitlines()]
+    res = next(r for r in rows if r.get("long"))
+    assert res["ok"] and res["resumed"]
+    assert res["digest"] == ref["digest"]
+    assert rows[-1]["long_resumed"] == 1
+    assert ck.read_journal(journal)[0] == []   # compacted after serve
+
+
+# -- @slow: real SIGKILL subprocess + mini load generator ------------------
+
+
+_KILL_CHILD = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+from go_libp2p_pubsub_tpu.serving import FrontendConfig, ScenarioFrontend
+fe = ScenarioFrontend(FrontendConfig(
+    batch=2, max_buckets=2, long_ticks=32, ckpt_dir={ckpt_dir!r},
+    ckpt_every=2, default_shape=(64, 2, 4, 8),
+    server_kw={{"seed": 114}}))
+lines = [{line!r}] if {first} else []
+fe.serve_lines(lines, sys.stdout, journal={journal!r})
+"""
+
+
+@pytest.mark.slow
+def test_sigkill_mid_long_scenario_resumes_to_identical_digest(
+        tmp_path):
+    """kill -9 (no deferred-stop courtesy) against a server running a
+    journaled long scenario: the restart replays the CRC'd journal,
+    resumes from the flushed snapshot, and reproduces the
+    uninterrupted digest."""
+    import zlib
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    req = dict(TINY, id="kill1", ticks=160, seed=6)
+    raw = json.dumps(req, sort_keys=True)
+    ckpt_dir = str(tmp_path / "ckpt")
+    journal = str(tmp_path / "serve.journal")
+    snapdir = os.path.join(ckpt_dir,
+                           f"kill1-{zlib.crc32(raw.encode()):08x}")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def child(first):
+        script = _KILL_CHILD.format(repo=repo, ckpt_dir=ckpt_dir,
+                                    line=raw, first=int(first),
+                                    journal=journal)
+        return subprocess.Popen([sys.executable, "-c", script],
+                                stdout=subprocess.PIPE, text=True,
+                                env=env)
+
+    c1 = child(first=True)
+    try:
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            if (os.path.isdir(snapdir)
+                    and sum(f.endswith(".ckpt")
+                            for f in os.listdir(snapdir)) >= 2):
+                break
+            assert c1.poll() is None, \
+                "child finished before it could be killed: " \
+                + (c1.communicate()[0] or "")
+            time.sleep(0.01)
+        else:
+            pytest.fail("child never produced snapshots")
+        c1.send_signal(signal.SIGKILL)
+        c1.communicate(timeout=60)
+    finally:
+        if c1.poll() is None:
+            c1.kill()
+
+    # uninterrupted reference (same request, separate snapshot root)
+    fe_ref = ScenarioFrontend(FrontendConfig(
+        batch=2, max_buckets=2, long_ticks=32,
+        ckpt_dir=str(tmp_path / "ckpt_ref"), ckpt_every=40,
+        default_shape=(64, 2, 4, 8), server_kw={"seed": 114}))
+    buf = io.StringIO()
+    fe_ref.serve_lines([raw], buf)
+    ref = next(json.loads(x) for x in buf.getvalue().splitlines()
+               if json.loads(x).get("long"))
+
+    c2 = child(first=False)
+    out, _ = c2.communicate(timeout=600)
+    assert c2.returncode == 0, out
+    rows = [json.loads(x) for x in out.splitlines()]
+    res = next(r for r in rows if r.get("long"))
+    assert res["resumed"], res
+    assert res["digest"] == ref["digest"]
+
+
+@pytest.mark.slow
+def test_mini_loadgen_accounting_identity_holds():
+    """A small Zipf/Poisson load through two buckets with tight
+    deadlines and a finite queue: every admitted request ends in
+    exactly one terminal bucket and the compile count stays at the
+    traced-bucket count."""
+    rng = np.random.default_rng(7)
+    pool = [(64, 2, 4, 8), (128, 2, 4, 8)]
+    fe = ScenarioFrontend(_cfg(seed=115, batch=4, queue_cap=16))
+    n_reqs, rejected = 120, 0
+    rows = []
+    for i in range(n_reqs):
+        n, t, m, ticks = pool[int(rng.random() < 0.25)]
+        req = {"id": f"r{i}", "n": n, "t": t, "m": m, "ticks": ticks,
+               "seed": int(i % 8)}
+        if i % 15 == 0:
+            req["deadline_s"] = 0.001
+        rej = fe.admit(req)
+        if rej is not None:
+            assert rej["overloaded"]
+            rejected += 1
+        if i % 2:
+            rows.extend(fe.dispatch_ready())
+    rows.extend(fe.drain())
+    st = fe.stats()
+    assert st["admitted"] == n_reqs - rejected
+    assert st["admitted"] == (st["served"] + st["errors"]
+                              + st["timeouts"]
+                              + st["transient_failures"])
+    assert st["queued"] == 0 and st["parked"] == 0
+    assert st["compiles"] == st["traced_buckets"] == 2
+    assert len(rows) == st["admitted"]
+    assert all(r.get("inv_bits", 0) == 0 for r in rows if r.get("ok"))

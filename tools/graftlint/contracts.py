@@ -1152,6 +1152,18 @@ def _probe_unusable_delta_chain():
         shutil.rmtree(d, ignore_errors=True)
 
 
+def _probe_sweepd_kernel_devices():
+    """server_capability refuses the kernel-path + --devices combo by
+    name — the pallas step has no batching rule to shard, so the
+    kernel server is the sequential demonstration.  The same
+    admission-time dispatch gates sweepd's CLI and every bucket the
+    serving front end builds."""
+    from tools.sweepd import server_capability
+    reason = server_capability(kernel=True, batch=1, devices=2)
+    if reason:
+        raise ValueError(reason)
+
+
 _PROBE_REFUSALS = {
     # round 13: the rpc_probe[paired-topics] refusal is LIFTED (the
     # probe captures per-slot masks + slot-split payload; see
@@ -1242,6 +1254,12 @@ _PROBE_REFUSALS = {
     "checkpoint[unusable-delta-chain]":
         (_probe_unusable_delta_chain,
          r"unusable delta chain — link .* is missing", ValueError),
+    # round 18: the sweepd/serving capability dispatch — the kernel
+    # path serves sequentially and refuses --devices by name
+    "sweepd[kernel-devices]":
+        (_probe_sweepd_kernel_devices,
+         r"kernel-path server is the sequential demonstration",
+         ValueError),
 }
 
 

@@ -359,6 +359,37 @@ elif [ "$rssrc" -ne 0 ]; then
   sync_log
   exit 12
 fi
+# 4k. fault-tolerant multi-tenant serving (round 18): the
+# shape-bucketed front end under Zipf/Poisson load — compile count ==
+# traced bucket count (LRU evictions free), explicit overload
+# rejection rows (no silent drops: the accounting identity), the
+# SIGKILL-mid-long-scenario journal-replay restart resumed to the
+# BIT-IDENTICAL digest, and the traced-vs-AOT (jax.export) cold-start
+# race — then the servestat gate over the artifact the bench just
+# wrote, vs the committed SERVE_r18.json.  KILL_GRACE=120: a SIGTERMed
+# bench drains its queue and parks interrupted long scenarios before
+# timeout escalates.  (s4k is the kernel flagship run above — this
+# step runs as s4sv.)
+KILL_GRACE=120 run s4sv 2700 python bench_suite.py gossipsub_serving
+echo "=== servestat --check gate ===" | tee -a "$log"
+env JAX_PLATFORMS=cpu python tools/servestat.py \
+    /tmp/gossipsub_serving.json \
+    --check SERVE_r18.json 2>&1 | tee -a "$log"
+svrc=${PIPESTATUS[0]}
+if [ "$svrc" -eq 2 ]; then
+  echo "!! servestat gate failed — unusable serving artifact (bench" \
+      "crashed, no summary rows, or no compile counter?)" \
+      | tee -a "$log"
+  sync_log
+  exit 13
+elif [ "$svrc" -ne 0 ]; then
+  echo "!! servestat gate failed — the front end recompiled past its" \
+      "bucket count, dropped a request silently, stopped rejecting" \
+      "under overload, broke kill-recovery bit-identity, or fell" \
+      "below the baseline throughput/latency floor" | tee -a "$log"
+  sync_log
+  exit 13
+fi
 # 5. GSPMD overhead + diagnostics
 run s5a 1800 python tools/bench_sharded.py
 run s5b 1800 python tools/bench_micro.py 1000000 100

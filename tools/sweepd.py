@@ -43,6 +43,17 @@ bench_suite's ``gossipsub_sweepd`` row and tests drive it in-process;
 D-device ``peers`` mesh axis (parallel/sharded.py) — per replica the
 result rows are bit-identical to the single-device server, still at
 one compile.
+
+``--multi`` (round 18) swaps the one-shape engine for the
+multi-tenant front end (go_libp2p_pubsub_tpu/serving): requests may
+carry their own shape (``n``/``t``/``m``/``ticks``/``k_slots``) plus
+``deadline_s`` and ``priority``; shapes quantize into
+``--max-buckets`` LRU-managed resident bucket servers, ``--aot-dir``
+persists executables across restarts (jax.export), ``--queue-cap``
+admission control rejects overloads by name, and requests past
+``--long-ticks`` run through the checkpointed runners so a kill -9
+mid-scenario resumes to the bit-identical digest.  Same line
+protocol, same ``--socket`` / ``--journal`` plumbing.
 """
 
 from __future__ import annotations
@@ -59,6 +70,75 @@ import numpy as np  # noqa: E402
 #: scenario attack kinds (the tournament's formation axis; "clean" is
 #: the no-attack control)
 ATTACK_KINDS = ("clean", "spam", "eclipse", "byzantine")
+
+
+def server_capability(*, kernel: bool = False, batch: int = 1,
+                      devices: int = 0) -> str | None:
+    """Capability dispatch for the server's execution-path choices —
+    the sweepd face of the ``kernel_capability`` convention
+    (models/gossipsub.py): ``None`` when the combination is serveable,
+    else the named reason the server refuses it.  Callers raise the
+    reason verbatim, so refusals stay string-stable for tests and for
+    graftlint's probe-refusal registry (round 18: the inline
+    ``--devices`` string match lifted here)."""
+    if kernel and batch != 1:
+        # the pallas kernel has no vmap rule: the kernel-path server
+        # is the SEQUENTIAL zero-recompile demonstration
+        return ("kernel-path sweepd serves scenarios sequentially "
+                "(no vmap rule for the pallas step): use batch=1")
+    if kernel and devices:
+        return ("sweepd: --devices shards the batched XLA "
+                "dispatch; the kernel-path server is the "
+                "sequential demonstration — drive the sharded "
+                "kernel through make_gossip_step(shard_mesh=...) "
+                "directly instead")
+    return None
+
+
+def _kernel_attack_axis(gs, receive_block: int):
+    """Derive the kernel-path server's serveable attack axis from the
+    pallas step's OWN capability dispatch instead of a hand-maintained
+    list (round 18): each tournament attack behavior is armed on a
+    tiny probe build together with a SimKnobs point (every sweepd
+    dispatch carries one) and kept only when ``kernel_capability``
+    admits it.  Returns ``(attack_kinds, armed_sc_fields, refusals)``
+    where ``refusals`` maps the dropped behavior/kind to the
+    capability check's named reason (surfaced in the unknown-attack
+    error row)."""
+    n, t, m = max(2 * receive_block, 64), 2, 2
+    offsets = gs.make_gossip_offsets(t, 16, n, seed=0)
+    cfg = gs.GossipSimConfig(offsets=offsets, n_topics=t)
+    subs = np.zeros((n, t), dtype=bool)
+    subs[np.arange(n), np.arange(n) % t] = True
+    origin = np.arange(m, dtype=np.int64)
+    topic = (origin % t).astype(np.int64)
+    pub = np.zeros(m, dtype=np.int32)
+    flags = np.zeros(n, dtype=bool)
+    #: behavior -> (ScoreSimConfig field, the formation arrays a
+    #: scenario arming it would carry, the kind it serves — None for
+    #: behaviors that ride an existing kind rather than adding one)
+    behaviors = (
+        ("sybil_ihave_spam", dict(sybil=flags), "spam"),
+        ("sybil_iwant_spam", dict(sybil=flags), None),
+        ("sybil_eclipse", dict(eclipse_sybil=flags,
+                               eclipse_victim=flags), "eclipse"),
+        ("byzantine_mutation", dict(byzantine=flags), "byzantine"),
+    )
+    kinds, armed, refusals = ["clean"], {}, {}
+    for field, formation, kind in behaviors:
+        sc = gs.ScoreSimConfig(**{field: True})
+        params, state = gs.make_gossip_sim(
+            cfg, subs, topic, origin, pub, score_cfg=sc,
+            sim_knobs={}, pad_to_block=receive_block,
+            track_first_tick=False, **formation)
+        reason = gs.kernel_capability(cfg, sc, params, state)
+        if reason is None:
+            armed[field] = True
+            if kind is not None:
+                kinds.append(kind)
+        else:
+            refusals[kind if kind is not None else field] = reason
+    return tuple(kinds), armed, refusals
 
 
 class SweepServer:
@@ -80,7 +160,8 @@ class SweepServer:
                  receive_block: int = 128, interpret: bool = True,
                  attack_pool_frac: float = 0.2,
                  victim_pool_frac: float = 0.1,
-                 churn_pool_frac: float = 0.1, devices: int = 0):
+                 churn_pool_frac: float = 0.1, devices: int = 0,
+                 k_slots: int = 0):
         import go_libp2p_pubsub_tpu.models.gossipsub as gs
         import go_libp2p_pubsub_tpu.models.invariants as iv
         from go_libp2p_pubsub_tpu.models.tournament import (
@@ -90,12 +171,14 @@ class SweepServer:
         self.n, self.t, self.m, self.ticks = n, t, m, ticks
         self.batch = batch
         self.kernel = kernel
-        if kernel and batch != 1:
-            # the pallas kernel has no vmap rule: the kernel-path
-            # server is the SEQUENTIAL zero-recompile demonstration
-            raise ValueError(
-                "kernel-path sweepd serves scenarios sequentially "
-                "(no vmap rule for the pallas step): use batch=1")
+        self.k_slots = k_slots
+        # execution-path capability dispatch (round 18): refusals are
+        # named by server_capability and raised verbatim, before any
+        # heavy construction work
+        reason = server_capability(kernel=kernel, batch=batch,
+                                   devices=devices)
+        if reason is not None:
+            raise ValueError(reason)
         # round 14: a devices>0 server shards every dispatch over the
         # D-device 'peers' mesh axis (parallel/sharded.py) — stacked
         # scenario replicas keep their trailing peer axis sharded
@@ -104,13 +187,6 @@ class SweepServer:
         self.mesh = None
         self._shardings = None
         if devices:
-            if kernel:
-                raise ValueError(
-                    "sweepd: --devices shards the batched XLA "
-                    "dispatch; the kernel-path server is the "
-                    "sequential demonstration — drive the sharded "
-                    "kernel through make_gossip_step(shard_mesh=...) "
-                    "directly instead")
             from go_libp2p_pubsub_tpu.parallel import mesh as pmesh
             from go_libp2p_pubsub_tpu.parallel import sharded as psh
             self._psh = psh
@@ -118,17 +194,20 @@ class SweepServer:
             pmesh.check_peer_divisible(n, self.mesh)
         rng = np.random.default_rng(seed)
         offsets = gs.make_gossip_offsets(t, n_candidates, n, seed=seed)
+        self._kind_refusals: dict = {}
         if kernel:
-            # the pallas step refuses two of the tournament's armed
-            # behaviors with knobs: sybil_iwant_spam (the in-kernel
-            # serve budget bakes gossip_retransmission — the one
-            # XLA-only knob) and byzantine_mutation (per-edge content
-            # corruption needs the split loops).  The kernel server
-            # arms the rest; its attack axis shrinks accordingly.
+            # the kernel server's attack axis comes from the pallas
+            # step's own capability dispatch: probe each tournament
+            # behavior through kernel_capability and arm what it
+            # admits (today that drops sybil_iwant_spam — the
+            # in-kernel serve budget bakes gossip_retransmission, the
+            # one XLA-only knob — and byzantine_mutation, whose
+            # per-edge content corruption needs the split loops)
+            kinds, armed, self._kind_refusals = _kernel_attack_axis(
+                gs, receive_block)
             self.cfg = gs.GossipSimConfig(offsets=offsets, n_topics=t)
-            self.sc = gs.ScoreSimConfig(sybil_ihave_spam=True,
-                                        sybil_eclipse=True)
-            self.attack_kinds = ("clean", "spam", "eclipse")
+            self.sc = gs.ScoreSimConfig(**armed)
+            self.attack_kinds = kinds
         else:
             self.cfg, self.sc = tournament_static_config(offsets, t)
             self.attack_kinds = ATTACK_KINDS
@@ -140,6 +219,13 @@ class SweepServer:
             self.sim_fixed_kw["pad_to_block"] = receive_block
             step_kw = dict(receive_block=receive_block,
                            receive_interpret=interpret)
+        if k_slots:
+            # round 18: a --k-slots server arms the event-driven delay
+            # line (models/delays.py), making delay_base/delay_jitter
+            # servable knobs; the base point is the one-hop identity
+            from go_libp2p_pubsub_tpu.models.delays import DelayConfig
+            self.sim_fixed_kw["delays"] = DelayConfig(
+                base=1, jitter=0, k_slots=k_slots, seed=seed)
         self.step = gs.make_gossip_step(self.cfg, self.sc,
                                         invariants=self.invariants,
                                         **step_kw)
@@ -186,6 +272,10 @@ class SweepServer:
         #: accepted-but-undispatched scenarios a crash must not lose)
         self._pending_raw: list[str] = []
         self._journal: str | None = None
+        #: round 18 (serving/buckets.py): a deserialized AOT
+        #: executable substituted for the batched XLA dispatch — a
+        #: cold process serves this shape with ZERO compiles
+        self._aot_runner = None
         self._t0 = time.perf_counter()
         # the runner's jit cache is process-global (other shapes /
         # servers share it): THIS server's compile count is the
@@ -219,12 +309,12 @@ class SweepServer:
         # a knob) so it cannot be silently clobbered by the top-level
         # default below
         _, _, fault_kv, delay_kv = kn.split_knob_overrides(knobs)
-        if delay_kv:
+        if delay_kv and not self.k_slots:
             raise ValueError(
                 "scenario: delay knobs (delay_base/delay_jitter) need "
                 "a delay-armed server config — this server was built "
                 "without a DelayConfig, so the delay-line code path "
-                "is not compiled in")
+                "is not compiled in (start sweepd with --k-slots K)")
         if "drop_prob" in req and "drop_prob" in fault_kv:
             raise ValueError(
                 "scenario: drop_prob given both top-level and inside "
@@ -237,12 +327,13 @@ class SweepServer:
         knobs["drop_prob"] = drop
         attack = req.get("attack", "clean")
         if attack not in self.attack_kinds:
+            # a kind the kernel_capability probe dropped carries the
+            # capability check's own named reason (round 18)
+            hint = self._kind_refusals.get(attack)
             raise ValueError(
                 f"scenario: unknown attack {attack!r} — this "
                 f"server's kinds are {self.attack_kinds}"
-                + (" (byzantine is XLA-only: the kernel elides the "
-                   "per-edge loops it needs)"
-                   if attack in ATTACK_KINDS else ""))
+                + (f" ({hint})" if hint else ""))
         frac = float(req.get("attack_frac",
                              0.0 if attack == "clean" else 0.1))
         pool_frac = self.attack_pool.mean()
@@ -344,6 +435,9 @@ class SweepServer:
                         self._psh.sharded_gossip_run_knob_batch(
                             params, state, self.ticks, self.step, sh,
                             honest)
+                elif self._aot_runner is not None:
+                    stateB, reach = self._aot_runner(params, state,
+                                                     honest)
                 else:
                     stateB, reach = gs.gossip_run_knob_batch(
                         params, state, self.ticks, self.step, honest)
@@ -410,6 +504,8 @@ class SweepServer:
             "shape": {"n": self.n, "t": self.t, "m": self.m,
                       "ticks": self.ticks, "batch": self.batch,
                       "kernel": self.kernel,
+                      "k_slots": self.k_slots,
+                      "aot": self._aot_runner is not None,
                       "devices": (self.mesh.size
                                   if self.mesh is not None else 1)},
         }
@@ -420,8 +516,13 @@ class SweepServer:
         if self._journal is None:
             return
         import os
+        from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
         with open(self._journal, "a") as f:
-            f.write(raw + "\n")
+            # round 18: journal lines carry the snapshot-style CRC32
+            # suffix, so a line torn by a mid-write kill is detected
+            # (and dropped) on replay instead of burning the scenario
+            # as a bad-JSON error row
+            f.write(ck.journal_encode_line(raw) + "\n")
             f.flush()
             os.fsync(f.fileno())
 
@@ -430,10 +531,12 @@ class SweepServer:
         (atomically: a crash mid-compaction must not lose scenarios)."""
         if self._journal is None:
             return
+        from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
         from go_libp2p_pubsub_tpu.utils.artifacts import (
             write_text_atomic)
         write_text_atomic(self._journal,
-                          "".join(r + "\n" for r in self._pending_raw))
+                          "".join(ck.journal_encode_line(r) + "\n"
+                                  for r in self._pending_raw))
 
     def serve_lines(self, lines, out, *, journal=None) -> None:
         """Drive the server from an iterable of JSON lines, writing
@@ -507,11 +610,15 @@ class SweepServer:
                     flush()
 
         if journal is not None:
-            try:
-                with open(journal) as f:
-                    replay = [ln.strip() for ln in f if ln.strip()]
-            except FileNotFoundError:
-                replay = []
+            replay, torn = ck.read_journal(journal)
+            if torn:
+                # the CRC suffix names the failure: lines torn by a
+                # mid-write kill are dropped — every intact accepted
+                # line before (and after) them still replays
+                print(f"sweepd: dropping {torn} torn journal line(s) "
+                      "(CRC mismatch — the writer died mid-append); "
+                      f"replaying the {len(replay)} intact line(s)",
+                      file=sys.stderr, flush=True)
             if replay:
                 print(f"sweepd: replaying {len(replay)} journaled "
                       "scenario line(s) from an interrupted run",
@@ -581,6 +688,10 @@ def main(argv=None) -> int:
                     help="shard every dispatch over a D-device "
                          "'peers' mesh (round 14; XLA batched path "
                          "only; peers must divide evenly)")
+    ap.add_argument("--k-slots", type=int, default=0,
+                    help="arm the K-deep delay line (round 18): "
+                         "delay_base/delay_jitter become servable "
+                         "knobs, worst-case base+jitter <= K")
     ap.add_argument("--socket", metavar="PATH",
                     help="serve a Unix socket instead of stdin")
     ap.add_argument("--journal", metavar="PATH",
@@ -588,6 +699,32 @@ def main(argv=None) -> int:
                          "undispatched scenario lines; lines left in "
                          "PATH by a killed server are replayed on "
                          "restart (round 15)")
+    ap.add_argument("--multi", action="store_true",
+                    help="multi-tenant front end (round 18): "
+                         "requests may carry their own shape "
+                         "(n/t/m/ticks/k_slots) plus deadline_s and "
+                         "priority; shapes quantize into LRU-managed "
+                         "resident buckets, --peers/--topics/--msgs/"
+                         "--ticks become the default shape")
+    ap.add_argument("--max-buckets", type=int, default=4,
+                    help="resident executable cap (LRU eviction)")
+    ap.add_argument("--queue-cap", type=int, default=512,
+                    help="admission-control queue depth; admissions "
+                         "past it come back as explicit 'overloaded' "
+                         "rows")
+    ap.add_argument("--aot-dir", metavar="DIR",
+                    help="persist executables as jax.export AOT "
+                         "blobs; a restarted server loads instead of "
+                         "re-tracing")
+    ap.add_argument("--long-ticks", type=int, default=0,
+                    help="route requests with ticks >= this through "
+                         "the checkpointed runners (preemption-"
+                         "surviving; needs --ckpt-dir)")
+    ap.add_argument("--ckpt-dir", metavar="DIR",
+                    help="snapshot root for long scenarios")
+    ap.add_argument("--ckpt-every", type=int, default=0,
+                    help="segment length for long scenarios "
+                         "(0 = horizon/4)")
     ns = ap.parse_args(argv)
 
     # round 15: deferred SIGTERM/SIGINT (parallel/checkpoint.py) —
@@ -597,11 +734,34 @@ def main(argv=None) -> int:
     from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
     prev = ck.install_kill_handlers()
 
-    srv = SweepServer(n=ns.peers, t=ns.topics, m=ns.msgs,
-                      ticks=ns.ticks,
-                      batch=(1 if ns.kernel else ns.batch),
-                      seed=ns.seed, invariants=not ns.no_invariants,
-                      kernel=ns.kernel, devices=ns.devices)
+    if ns.multi:
+        if ns.kernel:
+            print("sweepd: --multi refuses --kernel — the kernel-"
+                  "path server is the sequential demonstration "
+                  "(batch=1, one shape); serve it without --multi",
+                  file=sys.stderr)
+            return 2
+        from go_libp2p_pubsub_tpu.serving import (
+            FrontendConfig, ScenarioFrontend)
+        server_kw = {"seed": ns.seed,
+                     "invariants": not ns.no_invariants}
+        if ns.devices:
+            server_kw["devices"] = ns.devices
+        srv = ScenarioFrontend(FrontendConfig(
+            max_buckets=ns.max_buckets, batch=ns.batch,
+            queue_cap=ns.queue_cap,
+            default_shape=(ns.peers, ns.topics, ns.msgs, ns.ticks),
+            aot_dir=ns.aot_dir, long_ticks=ns.long_ticks,
+            ckpt_dir=ns.ckpt_dir, ckpt_every=ns.ckpt_every,
+            server_kw=server_kw))
+    else:
+        srv = SweepServer(n=ns.peers, t=ns.topics, m=ns.msgs,
+                          ticks=ns.ticks,
+                          batch=(1 if ns.kernel else ns.batch),
+                          seed=ns.seed,
+                          invariants=not ns.no_invariants,
+                          kernel=ns.kernel, devices=ns.devices,
+                          k_slots=ns.k_slots)
     try:
         if ns.socket:
             import socket as sk

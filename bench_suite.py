@@ -2337,6 +2337,303 @@ def bench_gossipsub_serving():
                 "aot_compiles": cold_start["aot_compiles"]})
 
 
+def bench_gossipsub_metrics():
+    """Round 19: the service observability plane under concurrent
+    load.  Three phases, one artifact (/tmp/gossipsub_metrics.json)
+    for the ``obsstat --check`` gate (measure_all step 4l):
+
+    * ``fleet``   a real ``sweepd --multi --socket --metrics-port 0``
+      subprocess served by tools/loadgen.py's multi-process client
+      fleet while the parent scrapes /metrics.json MID-FLIGHT — every
+      scrape, including ones taken while requests are queued between
+      the fleet's concurrent connections, must satisfy the accounting
+      identity (admitted == served + errors + timeouts + transient +
+      queued + parked); the closing stats/metrics verbs cross-check
+      the scrape against the front end's own counters field by field,
+      and /trace.json must come back as loadable Chrome trace JSON.
+    * ``spans``   an in-process front end driven through served /
+      timed-out / overload-rejected requests: distinct trace count ==
+      admissions (rejections never get a trace), every admitted trace
+      reaches a terminal event, zero open spans and zero dropped
+      events after the drain, and the exported trace file round-trips
+      through json.load.
+    * ``delay_parity``  the round-19 refusal lift, measured: identity
+      delays (DelayConfig(1, 0, 1)) with counters armed vs the
+      pre-delay step — max |diff| over all 11 counter fields must be
+      0 — while a real delay spread shows the counters still flow."""
+    import socket as sk
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.request
+
+    import jax
+    from go_libp2p_pubsub_tpu.serving import (FrontendConfig,
+                                              ScenarioFrontend)
+    from tools.loadgen import run_fleet
+
+    procs = int(os.environ.get("GOSSIP_METRICS_PROCS", 3))
+    per_proc = int(os.environ.get("GOSSIP_METRICS_REQS", 6))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    work = tempfile.mkdtemp(prefix="gossip_metrics_bench_")
+    sock_path = os.path.join(work, "sweepd.sock")
+    env = dict(os.environ, JAX_PLATFORMS=jax.default_backend())
+
+    # -- fleet phase: live server, concurrent clients, live scrapes ---
+    child = subprocess.Popen(
+        [sys.executable, "-m", "tools.sweepd", "--multi",
+         "--socket", sock_path, "--metrics-port", "0",
+         "--batch", "2", "--peers", "64", "--topics", "1",
+         "--msgs", "2", "--ticks", "4", "--max-buckets", "4"],
+        cwd=repo, env=env, stderr=subprocess.PIPE, text=True)
+    base_url = None
+    try:
+        for line in child.stderr:
+            if "metrics at " in line:
+                base_url = (line.strip().split("metrics at ", 1)[1]
+                            .rsplit("/metrics", 1)[0])
+            if "listening on" in line:
+                break
+        assert base_url, "sweepd never announced its metrics endpoint"
+        threading.Thread(target=child.stderr.read,
+                         daemon=True).start()
+
+        ident_keys = ("served_total", "errors_total",
+                      "deadline_timeouts_total",
+                      "transient_failures_total", "queue_depth",
+                      "parked")
+
+        def scrape() -> dict:
+            with urllib.request.urlopen(base_url + "/metrics.json",
+                                        timeout=5) as r:
+                fams = [json.loads(ln) for ln in
+                        r.read().decode().splitlines()]
+            vals = {}
+            for fam in fams:
+                if fam["kind"] == "histogram" or not fam["samples"]:
+                    vals.setdefault(fam["name"], 0)
+                    continue
+                s = fam["samples"][0]
+                if not s["labels"]:
+                    vals[fam["name"]] = s["value"]
+            admitted = vals.get("pubsub_serving_admitted_total", 0)
+            accounted = sum(vals.get("pubsub_serving_" + k, 0)
+                            for k in ident_keys)
+            return dict(
+                {k: vals.get("pubsub_serving_" + k, 0)
+                 for k in ident_keys},
+                admitted=admitted, accounted=accounted,
+                identity_ok=admitted == accounted)
+
+        fleet_box = {}
+
+        def drive():
+            fleet_box["out"] = run_fleet(
+                sock_path, procs=procs, requests_per_proc=per_proc,
+                connect_timeout_s=30.0)
+
+        fleet_th = threading.Thread(target=drive)
+        fleet_th.start()
+        scrapes = [dict(scrape(), mid_flight=True)]
+        while fleet_th.is_alive():
+            time.sleep(0.25)
+            scrapes.append(dict(scrape(), mid_flight=True))
+        fleet_th.join()
+        scrapes.append(dict(scrape(), mid_flight=False))
+        fleet = fleet_box["out"]
+        assert not fleet["worker_failures"], fleet["worker_failures"]
+        sent = fleet["requests_sent"]
+        assert len(fleet["rows"]) == sent, (len(fleet["rows"]), sent)
+        assert all(s["identity_ok"] for s in scrapes), scrapes
+
+        # cross-check: the line-protocol stats row vs the scrape,
+        # field by field, on one quiet connection
+        with sk.socket(sk.AF_UNIX, sk.SOCK_STREAM) as s:
+            s.connect(sock_path)
+            with s.makefile("r") as rf, s.makefile("w") as wf:
+                wf.write('{"cmd": "stats"}\n{"cmd": "metrics"}\n')
+                wf.flush()
+                s.shutdown(sk.SHUT_WR)
+                proto = [json.loads(ln) for ln in rf if ln.strip()]
+        stats_row = next(r for r in proto if r.get("stats"))
+        met_row = next(r for r in proto if r.get("metrics"))
+        fam_map = {f["name"]: f for f in met_row["families"]}
+
+        def fam_val(name):
+            smp = fam_map["pubsub_" + name]["samples"]
+            return smp[0]["value"] if smp else 0
+
+        pairs = {"admitted": "serving_admitted_total",
+                 "served": "serving_served_total",
+                 "errors": "serving_errors_total",
+                 "timeouts": "serving_deadline_timeouts_total",
+                 "transient_failures":
+                     "serving_transient_failures_total",
+                 "rejected_overload":
+                     "serving_overload_rejected_total",
+                 "retries": "serving_retries_total",
+                 "queued": "serving_queue_depth",
+                 "parked": "serving_parked"}
+        cross = {k: {"stats": stats_row[k], "scrape": fam_val(v)}
+                 for k, v in pairs.items()}
+        cross_match = all(v["stats"] == v["scrape"]
+                          for v in cross.values())
+        spans_live = met_row["spans"]
+        spans_match = (spans_live["traces"] == stats_row["admitted"]
+                       == sent)
+        assert cross_match, cross
+        assert spans_match, (spans_live, stats_row["admitted"], sent)
+
+        with urllib.request.urlopen(base_url + "/trace.json",
+                                    timeout=5) as r:
+            trace = json.loads(r.read().decode())
+        assert trace["traceEvents"], "empty live Chrome trace"
+        trace_events = len(trace["traceEvents"])
+    finally:
+        child.terminate()
+        try:
+            child.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            child.kill()
+            child.wait(timeout=30)
+
+    fleet_phase = {
+        "procs": procs, "requests_sent": sent,
+        "rows_received": len(fleet["rows"]), "ok": fleet["ok"],
+        "error_rows": fleet["errors"], "rps": fleet["rps"],
+        "wall_s": fleet["wall_s"], "scrape_count": len(scrapes),
+        "mid_flight_scrapes": sum(1 for s in scrapes
+                                  if s["mid_flight"]),
+        "identity_ok": all(s["identity_ok"] for s in scrapes),
+        "cross_match": cross_match, "spans_match": spans_match,
+        "trace_events": trace_events,
+    }
+
+    # -- span phase: served / timed-out / rejected, in-process --------
+    fe = ScenarioFrontend(FrontendConfig(
+        max_buckets=2, batch=2, queue_cap=6, server_kw={"seed": 0}))
+    span_rows = []
+    for i in range(10):
+        req = {"id": f"s{i}", "n": 64, "t": 1, "m": 2, "ticks": 4,
+               "seed": i}
+        if i in (4, 5):
+            req["deadline_s"] = 0.0    # culled at the next dispatch
+        rej = fe.admit(req)
+        if rej is not None:
+            span_rows.append(rej)
+        if i % 4 == 3:
+            time.sleep(0.01)
+            span_rows.extend(fe.dispatch_ready(force=True))
+    span_rows.extend(fe.drain())
+    st = fe.stats()
+    summ = fe.obs.spans.summary()
+    trace_path = "/tmp/gossipsub_metrics_trace.json"
+    fe.obs.spans.write_chrome_trace(trace_path)
+    with open(trace_path) as f:
+        exported = json.load(f)
+    rejected = sum(1 for r in span_rows if r.get("overloaded"))
+    span_phase = {
+        "requests": 10, "admitted": st["admitted"],
+        "served": st["served"], "timeouts": st["timeouts"],
+        "rejected_overload": st["rejected_overload"],
+        "traces": summ["traces"], "terminal": summ["terminal"],
+        "open_spans": summ["open_spans"],
+        "dropped_events": summ["dropped_events"],
+        "phases": summ["phases"],
+        "exported_events": len(exported["traceEvents"]),
+        "trace_path": trace_path,
+    }
+    assert summ["traces"] == st["admitted"] == 10 - rejected, span_phase
+    assert summ["terminal"] == st["admitted"], span_phase
+    assert summ["open_spans"] == 0 == summ["dropped_events"], span_phase
+    assert st["timeouts"] > 0, span_phase
+
+    # -- delay parity: the lifted counters-group refusal, measured ----
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    import go_libp2p_pubsub_tpu.models.telemetry as tl
+    from go_libp2p_pubsub_tpu.models.delays import DelayConfig
+
+    fields = ("payload_sent", "ihave_rpcs", "ihave_ids", "iwant_rpcs",
+              "iwant_ids_requested", "iwant_ids_served", "graft_sends",
+              "prune_sends", "dup_suppressed", "bytes_payload",
+              "bytes_control")
+    pn, pt, pm, pticks = 64, 2, 4, 6
+    subs = np.zeros((pn, pt), dtype=bool)
+    subs[np.arange(pn), np.arange(pn) % pt] = True
+    rng = np.random.default_rng(0)
+    ptopic = rng.integers(0, pt, pm)
+    porigin = rng.integers(0, pn // pt, pm) * pt + ptopic
+    ptks = np.zeros(pm, dtype=np.int32)
+    pcfg = gs.GossipSimConfig(
+        offsets=gs.make_gossip_offsets(pt, 8, pn, seed=1),
+        n_topics=pt, d=3, d_lo=2, d_hi=6, d_score=2, d_out=1,
+        d_lazy=2, backoff_ticks=8)
+    psc = gs.ScoreSimConfig()
+
+    def counter_totals(delays):
+        kw = dict(score_cfg=psc, delays=delays)
+        if delays is not None:
+            kw["delays_counters"] = True
+        params, state = gs.make_gossip_sim(pcfg, subs, ptopic,
+                                           porigin, ptks, **kw)
+        step = gs.make_gossip_step(pcfg, psc,
+                                   telemetry=tl.TelemetryConfig())
+        out = []
+        for _ in range(pticks):
+            state, _d, frame = step(params, state)
+            out.append(np.array([np.asarray(getattr(frame, f)).sum()
+                                 for f in fields], dtype=np.int64))
+        return np.stack(out)
+
+    t0 = time.perf_counter()
+    ref = counter_totals(None)
+    idn = counter_totals(DelayConfig(base=1, jitter=0, k_slots=1))
+    spread = counter_totals(DelayConfig(base=2, jitter=1, k_slots=4))
+    parity_s = time.perf_counter() - t0
+    max_abs_diff = int(np.abs(ref - idn).max())
+    delay_parity = {
+        "fields": len(fields), "ticks": pticks,
+        "max_abs_diff": max_abs_diff,
+        "identity_counter_total": int(idn.sum()),
+        "delayed_counter_total": int(spread.sum()),
+        "wall_s": round(parity_s, 2),
+    }
+    assert max_abs_diff == 0, delay_parity
+    assert spread.sum() > 0, delay_parity
+
+    import shutil
+    shutil.rmtree(work, ignore_errors=True)
+    backend = jax.default_backend()
+    art = {
+        "round": 19,
+        "platform": backend,
+        "hardware_queued": backend != "tpu",
+        "fleet": fleet_phase,
+        "scrapes": scrapes,
+        "cross_check": cross,
+        "spans": span_phase,
+        "delay_parity": delay_parity,
+        "rows": [
+            dict({"id": "fleet"}, **fleet_phase),
+            dict({"id": "spans"}, **span_phase),
+            dict({"id": "delay_parity"}, **delay_parity),
+        ],
+    }
+    write_json_atomic("/tmp/gossipsub_metrics.json", art)
+    emit("gossipsub_metrics_fleet_rps", fleet["rps"], "requests/s",
+         extra={"procs": procs, "requests": sent,
+                "mid_flight_scrapes":
+                    fleet_phase["mid_flight_scrapes"],
+                "identity_ok": fleet_phase["identity_ok"],
+                "cross_match": cross_match,
+                "trace_events": trace_events})
+    emit("gossipsub_metrics_delay_parity_diff", float(max_abs_diff),
+         "counter units",
+         extra={"fields": len(fields),
+                "delayed_counter_total":
+                    delay_parity["delayed_counter_total"]})
+
+
 BENCHES = {
     "floodsub_hosts": bench_floodsub_hosts,
     "randomsub_10k": bench_randomsub_10k,
@@ -2363,6 +2660,7 @@ BENCHES = {
     "gossipsub_resident": bench_gossipsub_resident,
     "gossipsub_resident_sharded": bench_gossipsub_resident_sharded,
     "gossipsub_serving": bench_gossipsub_serving,
+    "gossipsub_metrics": bench_gossipsub_metrics,
 }
 
 

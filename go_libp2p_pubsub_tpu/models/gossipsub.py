@@ -777,6 +777,12 @@ class GossipState:
     pay_line: jnp.ndarray | None = None      # uint32 [K, C, W, N]
     ctrl_line: jnp.ndarray | None = None     # uint32 [K, R, N]
     gsp_line: jnp.ndarray | None = None      # uint32 [K, C, W, N]
+    # round-19 delay-armed telemetry counters: the IHAVE advert words
+    # in flight, observer-only (possession never reads it) — the
+    # iwant_requested/iwant_rpcs estimators need the advert arrival
+    # view, which the fused pay_line cannot reconstruct.  Allocated by
+    # make_gossip_sim(..., delays_counters=True); None otherwise.
+    adv_line: jnp.ndarray | None = None      # uint32 [K, C, W, N]
 
 
 def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
@@ -800,7 +806,8 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
                     score_knobs: dict | None = None,
                     sim_knobs: dict | None = None,
                     delays: _delays.DelayConfig | None = None,
-                    delays_split: bool = False):
+                    delays_split: bool = False,
+                    delays_counters: bool = False):
     """Build (params, state).  subs: bool [N, T] — but each peer may only
     subscribe to its residue-class topic (circulant classes are closed, so
     cross-class subscriptions would never receive anything).
@@ -1193,7 +1200,7 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
     # the broken-promise advert row iff some withholding behavior can
     # be live (the step derives the same predicate at trace time, so
     # the shapes agree).
-    pay_line0 = ctrl_line0 = gsp_line0 = None
+    pay_line0 = ctrl_line0 = gsp_line0 = adv_line0 = None
     if delays is not None:
         kd = int(delays.k_slots)
         has_cheat = (score_cfg is not None
@@ -1202,10 +1209,17 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
         pay_line0 = jnp.zeros((kd, c, w, n), dtype=jnp.uint32)
         ctrl_line0 = jnp.zeros((kd, 3 + int(has_cheat), n),
                                dtype=jnp.uint32)
-        if delays_split:
+        if delays_split or delays_counters:
+            # delays_counters also needs the gossip-class observer
+            # line on the COMBINED path: iwant_served counts the
+            # gossip-class arrivals the fused pay_line merged away
             gsp_line0 = jnp.zeros((kd, c, w, n), dtype=jnp.uint32)
+        if delays_counters:
+            adv_line0 = jnp.zeros((kd, c, w, n), dtype=jnp.uint32)
     elif delays_split:
         raise ValueError("delays_split=True needs a DelayConfig")
+    elif delays_counters:
+        raise ValueError("delays_counters=True needs a DelayConfig")
 
     state = GossipState(
         mesh=zbits(),
@@ -1246,6 +1260,7 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
                    if cfg.paired_topics else None),
         active=active0,
         pay_line=pay_line0, ctrl_line=ctrl_line0, gsp_line=gsp_line0,
+        adv_line=adv_line0,
     )
     # seed the gate pipeline: tick 0's gate words, exactly what the
     # step's epilogue would have emitted at the end of tick -1
@@ -1852,7 +1867,8 @@ def kernel_ticks_fused_capability(
                 "resident working set)")
     if params.delays is not None:
         extra = 0
-        for line in (state.pay_line, state.ctrl_line, state.gsp_line):
+        for line in (state.pay_line, state.ctrl_line, state.gsp_line,
+                     state.adv_line):
             if line is not None:
                 extra += int(line.size) * line.dtype.itemsize
         return ("kernel_ticks_fused: delay-armed sims stay per-tick — "
@@ -2469,7 +2485,9 @@ def make_gossip_step(cfg: GossipSimConfig,
             pay_line=(dex["pay_line"] if with_dl else state.pay_line),
             ctrl_line=(dex["ctrl_line"] if with_dl
                        else state.ctrl_line),
-            gsp_line=state.gsp_line)
+            gsp_line=(dex["gsp_line"] if with_dl else state.gsp_line),
+            adv_line=(dex["adv_line"] if with_dl
+                      else state.adv_line))
         if icfg is not None:
             new_state = apply_invariants(
                 params, state, new_state, have_pre, rejoin_w,
@@ -2487,8 +2505,6 @@ def make_gossip_step(cfg: GossipSimConfig,
         # (pinned by tests/test_pallas_receive.py).
         kw_f = {}
         if tel.counters:
-            sums = tel_row.sum(axis=1)          # [TEL_ROWS] i32
-
             def tx(bits):
                 # handshake RPCs actually transmitted (the XLA
                 # epilogue's tx(): nothing goes on the wire over a
@@ -2504,26 +2520,89 @@ def make_gossip_step(cfg: GossipSimConfig,
                     tx(sel_b["grafts"])).sum(dtype=jnp.int32)
                 prune_cnt = prune_cnt + popcount32(
                     tx(sel_b["dropped"])).sum(dtype=jnp.int32)
+            if with_dl:
+                # round-19 delay lift: the delayed kernel holds no
+                # sender-stream views, so the counter halves assemble
+                # in the epilogue from the SAME delay_exchange
+                # products the XLA delayed step counts — identical by
+                # construction (the latency_hist epilogue below set
+                # the precedent).  Send-side tallies rode out of
+                # delay_exchange; arrival-side counts run here
+                # against this tick's possession words.
+                ts = dex["tel_send"]
+                af = (fmasks["alive_w"] if fmasks is not None
+                      else None)
+                byz_mut_k = (sc is not None and sc.byzantine_mutation
+                             and params.cand_byz is not None)
+                c_recv = c_srv = c_req = c_iwant_rpcs = jnp.int32(0)
+                heard_k = [Z] * W
+                for j in range(C):
+                    byz_j = (bit_row(params.cand_byz, j)
+                             if byz_mut_k else None)
+                    req_c = jnp.zeros((n_pad,), dtype=jnp.int32)
+                    for w in range(W):
+                        got = dex["arr_pay"][j, w]
+                        g_gsp = dex["arr_gsp"][j, w]
+                        g_adv = dex["arr_adv"][j, w]
+                        if af is not None:
+                            got = got & af
+                            g_gsp = g_gsp & af
+                            g_adv = g_adv & af
+                        ns = ~seen_st[w]
+                        c_recv = c_recv + pc(got).sum(
+                            dtype=jnp.int32)
+                        c_srv = c_srv + pc(g_gsp & ns).sum(
+                            dtype=jnp.int32)
+                        req_c = req_c + pc(g_adv & ns).astype(
+                            jnp.int32)
+                        news = got & ns
+                        if byz_j is not None:
+                            news = jnp.where(byz_j, Z, news)
+                        heard_k[w] = heard_k[w] | news
+                    c_req = c_req + req_c.sum(dtype=jnp.int32)
+                    c_iwant_rpcs = c_iwant_rpcs + (req_c > 0).sum(
+                        dtype=jnp.int32)
+                new_ids_k = jnp.int32(0)
+                for w in range(W):
+                    # subscriber gate per PEER (sub_all is the C-bit
+                    # candidate gate; the heard words are 32 message
+                    # bits wide)
+                    new_ids_k = new_ids_k + pc(jnp.where(
+                        sub_all != 0, heard_k[w], Z)).sum(
+                        dtype=jnp.int32)
+                c_payload = ts["payload"]
+                c_ihave_rpcs = ts["ihave_rpcs"]
+                c_ihave_ids = ts["ihave_ids"]
+                c_dup = c_recv - new_ids_k
+            else:
+                sums = tel_row.sum(axis=1)      # [TEL_ROWS] i32
+                c_payload = sums[TEL_PAYLOAD]
+                c_ihave_rpcs = sums[TEL_IHAVE_RPCS]
+                c_ihave_ids = sums[TEL_IHAVE_IDS]
+                c_iwant_rpcs = sums[TEL_IWANT_RPCS]
+                c_req = sums[TEL_IWANT_REQ]
+                c_srv = sums[TEL_IWANT_SERVED]
+                c_dup = sums[TEL_RECV] - sums[TEL_NEW_IDS]
             kw_f.update(
-                payload_sent=sums[TEL_PAYLOAD],
-                ihave_rpcs=sums[TEL_IHAVE_RPCS],
-                ihave_ids=sums[TEL_IHAVE_IDS],
-                iwant_rpcs=sums[TEL_IWANT_RPCS],
-                iwant_ids_requested=sums[TEL_IWANT_REQ],
-                iwant_ids_served=sums[TEL_IWANT_SERVED],
+                payload_sent=c_payload,
+                ihave_rpcs=c_ihave_rpcs,
+                ihave_ids=c_ihave_ids,
+                iwant_rpcs=c_iwant_rpcs,
+                iwant_ids_requested=c_req,
+                iwant_ids_served=c_srv,
                 graft_sends=graft_cnt, prune_sends=prune_cnt,
-                dup_suppressed=sums[TEL_RECV] - sums[TEL_NEW_IDS])
+                dup_suppressed=c_dup)
             if tel.wire:
                 f32c = lambda x: x.astype(jnp.float32)  # noqa: E731
                 kw_f["bytes_payload"] = (
-                    f32c(sums[TEL_PAYLOAD] + sums[TEL_IWANT_SERVED])
+                    f32c(c_payload + c_srv)
                     * float(ws.payload_frame))
                 kw_f["bytes_control"] = (
-                    f32c(sums[TEL_IHAVE_RPCS]) * float(ws.ihave_base)
-                    + f32c(sums[TEL_IHAVE_IDS])
+                    f32c(c_ihave_rpcs) * float(ws.ihave_base)
+                    + f32c(c_ihave_ids)
                     * float(ws.ihave_per_id)
-                    + f32c(sums[TEL_IWANT_RPCS]) * float(ws.iwant_base)
-                    + f32c(sums[TEL_IWANT_REQ])
+                    + f32c(c_iwant_rpcs) * float(ws.iwant_base)
+                    + f32c(c_req)
                     * float(ws.iwant_per_id)
                     + f32c(graft_cnt) * float(ws.graft_frame)
                     + f32c(prune_cnt) * float(ws.prune_frame))
@@ -2646,13 +2725,19 @@ def make_gossip_step(cfg: GossipSimConfig,
                     "place in-flight delay slots); capture RPC "
                     "streams on a delays=None build")
             if tel is not None and tel.counters:
-                raise NotImplementedError(
-                    "delays: the telemetry counters group is not "
-                    "delay-supported (send/receive RPC accounting "
-                    "would need one delay line per traffic class) — "
-                    "run delays with TelemetryConfig(counters=False, "
-                    "wire=False); the histogram, gauge, and fault "
-                    "groups all thread")
+                # round-19 lift: send-side RPC tallies count at the
+                # SEND tick inside delay_exchange, receiver-side
+                # tallies (recv / iwant requested+served) count at
+                # ARRIVAL against the dequeued class lines — the
+                # gossip observer line and the advert line carry the
+                # per-class views the fused payload line merges away.
+                if state.adv_line is None or state.gsp_line is None:
+                    raise ValueError(
+                        "delay-armed telemetry counters need the "
+                        "advert + gossip observer delay lines: build "
+                        "the sim with make_gossip_sim(..., "
+                        "delays=DelayConfig(...), "
+                        "delays_counters=True)")
             if state.pay_line is None or state.ctrl_line is None:
                 raise ValueError(
                     "delay-armed params need delay-line state: build "
@@ -3210,6 +3295,39 @@ def make_gossip_step(cfg: GossipSimConfig,
             else:
                 send_fwd, send_flood = out_bits, flood_bits
 
+            # ---- send-side counter tallies (round-19 lift): payload
+            # copies and IHAVE ids/RPCs count at the SEND tick from
+            # the very pre-roll words the enqueue closures build
+            # (popcount is roll-invariant), so K=1 equals the
+            # pre-delay sender-side accounting bit for bit.  Advert
+            # counting uses ``targets`` PRE-withhold, the documented
+            # convention: a withholding spammer does advertise.
+            tel_send = None
+            if tel is not None and tel.counters:
+                t0 = jnp.int32(0)
+                tel_send = dict(payload=t0, ihave_ids=t0,
+                                ihave_rpcs=t0)
+                adv_any = jnp.zeros((n,), dtype=bool)
+                for w in range(W):
+                    adv_any = adv_any | (adv[w] != 0)
+                for c_send in range(C):
+                    m_adv = bit_row(targets, c_send)
+                    tel_send["ihave_rpcs"] += (
+                        m_adv & adv_any).sum(dtype=jnp.int32)
+                    m_f = bit_row(send_fwd, c_send)
+                    m_fl = (bit_row(send_flood, c_send)
+                            if send_flood is not None else None)
+                    for w in range(W):
+                        pay_w = jnp.where(m_f, fresh[w], Z)
+                        if m_fl is not None:
+                            pay_w = pay_w | jnp.where(
+                                m_fl, injected[w], Z)
+                        tel_send["payload"] += pc(pay_w).sum(
+                            dtype=jnp.int32)
+                        tel_send["ihave_ids"] += pc(
+                            jnp.where(m_adv, adv[w], Z)).sum(
+                            dtype=jnp.int32)
+
             # ---- enqueue: roll each edge's fused (or per-class)
             # word and route it to its sampled slot ------------------
             def enqueue_edges(line, word_of):
@@ -3245,10 +3363,26 @@ def make_gossip_step(cfg: GossipSimConfig,
                     return roll_t(sent, off)
 
                 pay_line = enqueue_edges(state.pay_line, fused_word)
-                gsp_line = state.gsp_line
                 arr_pay, pay_line = _delays.line_dequeue(pay_line,
                                                          tick)
-                arr_gsp = None
+                if tel_send is not None:
+                    # gossip-class OBSERVER line: the same post-gate
+                    # advert words fused_word ORs into pay_line, kept
+                    # separate so iwant_served sees the class
+                    # provenance the merge destroys.  Possession never
+                    # reads it.
+                    def obs_gsp_word(c_send, off, j, w):
+                        return roll_t(jnp.where(
+                            bit_row(send_gsp, c_send), adv[w], Z),
+                            off)
+
+                    gsp_line = enqueue_edges(state.gsp_line,
+                                             obs_gsp_word)
+                    arr_gsp, gsp_line = _delays.line_dequeue(gsp_line,
+                                                             tick)
+                else:
+                    gsp_line = state.gsp_line
+                    arr_gsp = None
             else:
                 # split form: mesh/eager and gossip classes keep their
                 # own lines (P3 needs the arrival provenance); the
@@ -3282,6 +3416,28 @@ def make_gossip_step(cfg: GossipSimConfig,
                 arr_pay, pay_line = _delays.line_dequeue(pay_line,
                                                          tick)
                 arr_gsp, gsp_line = _delays.line_dequeue(gsp_line,
+                                                         tick)
+
+            # ---- advert observer line (round-19 lift): the rolled
+            # IHAVE advert words, carried so iwant_requested counts
+            # against the RECEIVER's possession at the ARRIVAL tick.
+            # Combined convention: ungated (pre-withhold targets);
+            # split convention: the receiver's payload∧gossip gate
+            # applies post-roll at enqueue, as the pre-delay split
+            # gossip loop gated r_adv.
+            adv_line, arr_adv = state.adv_line, None
+            if tel_send is not None:
+                def adv_word_of(c_send, off, j, w):
+                    rolled = roll_t(jnp.where(
+                        bit_row(targets, c_send), adv[w], Z), off)
+                    if split and sc is not None:
+                        rolled = jnp.where(
+                            bit_row(payload_bits & gossip_bits, j),
+                            rolled, Z)
+                    return rolled
+
+                adv_line = enqueue_edges(state.adv_line, adv_word_of)
+                arr_adv, adv_line = _delays.line_dequeue(adv_line,
                                                          tick)
 
             # ---- control enqueue + dequeue -------------------------
@@ -3361,7 +3517,8 @@ def make_gossip_step(cfg: GossipSimConfig,
                         ctrl_line=ctrl_line, graft_arr=graft_arr,
                         prune_arr=prune_arr, retract=retract,
                         cheat_arr=cheat_arr, violation=violation,
-                        accept=accept)
+                        accept=accept, tel_send=tel_send,
+                        arr_adv=arr_adv, adv_line=adv_line)
 
         rpc_snap = None
         if rpc_probe:
@@ -3501,6 +3658,12 @@ def make_gossip_step(cfg: GossipSimConfig,
                     "make_gossip_sim(..., delays=..., "
                     "delays_split=True)")
             dex = delay_exchange(split=not combined)
+            if tel_acc is not None:
+                # sender-side tallies counted at the SEND tick inside
+                # delay_exchange; the arrival loops below add the
+                # receiver-side halves against THIS tick's possession
+                for k_send in ("payload", "ihave_ids", "ihave_rpcs"):
+                    tel_acc[k_send] += dex["tel_send"][k_send]
         if dex is not None and combined:
             # -- 2+3 delayed (round 13): this tick's sends went into
             # the delay line inside delay_exchange; what remains is
@@ -3511,11 +3674,30 @@ def make_gossip_step(cfg: GossipSimConfig,
             for j in range(C):
                 byz_j = bit_row(params.cand_byz, j) if byz_mut else None
                 fd_j = iv_j = None
+                req_c = None
                 for w in range(W):
                     got = dex["arr_pay"][j, w]
                     if fp is not None:
                         got = got & f_alive_w  # down peers hear 0
                     news = got & ~seen[w]
+                    if tel_acc is not None:
+                        # receiver-side tallies at ARRIVAL: duplicates
+                        # against this tick's possession, served ids
+                        # from the gossip observer line, requested ids
+                        # from the advert line (both fault-masked like
+                        # the payload arrivals)
+                        g_gsp = dex["arr_gsp"][j, w]
+                        g_adv = dex["arr_adv"][j, w]
+                        if fp is not None:
+                            g_gsp = g_gsp & f_alive_w
+                            g_adv = g_adv & f_alive_w
+                        tel_acc["recv"] += pc(got).sum(
+                            dtype=jnp.int32)
+                        tel_acc["srv"] += pc(g_gsp & ~seen[w]).sum(
+                            dtype=jnp.int32)
+                        req_c = acc(req_c,
+                                    pc(g_adv & ~seen[w]).astype(
+                                        jnp.int32))
                     if sc is not None:
                         news = jax.lax.optimization_barrier(news)
                     news_bad = None
@@ -3529,6 +3711,10 @@ def make_gossip_step(cfg: GossipSimConfig,
                         if news_bad is not None:
                             iv_j = iv_j + pc(news_bad)
                 fd_add[j], inv_add[j] = fd_j, iv_j
+                if tel_acc is not None and req_c is not None:
+                    tel_acc["req"] += req_c.sum(dtype=jnp.int32)
+                    tel_acc["iwant_rpcs"] += (req_c > 0).sum(
+                        dtype=jnp.int32)
                 if dex["cheat_arr"] is not None:
                     broken_add[j] = (bit_row(dex["cheat_arr"], j)
                                      & lack_any)
@@ -3546,6 +3732,9 @@ def make_gossip_step(cfg: GossipSimConfig,
                     if fp is not None:
                         got = got & f_alive_w
                     news = got & ~seen[w]
+                    if tel_acc is not None:
+                        tel_acc["recv"] += pc(got).sum(
+                            dtype=jnp.int32)
                     news_bad = None
                     if byz_j is not None:
                         news_bad = jnp.where(byz_j, news, Z)
@@ -3566,11 +3755,26 @@ def make_gossip_step(cfg: GossipSimConfig,
             gossip_heard = [Z] * W
             for j in range(C):
                 byz_j = bit_row(params.cand_byz, j) if byz_mut else None
+                req_c = None
                 for w in range(W):
                     got = dex["arr_gsp"][j, w]
                     if fp is not None:
                         got = got & f_alive_w
                     news = got & ~seen_g[w]
+                    if tel_acc is not None:
+                        # requested/served count against START-of-tick
+                        # possession (~seen, not ~seen_g), the same
+                        # estimator the pre-delay split loops used
+                        g_adv = dex["arr_adv"][j, w]
+                        if fp is not None:
+                            g_adv = g_adv & f_alive_w
+                        tel_acc["recv"] += pc(got).sum(
+                            dtype=jnp.int32)
+                        tel_acc["srv"] += pc(got & ~seen[w]).sum(
+                            dtype=jnp.int32)
+                        req_c = acc(req_c,
+                                    pc(g_adv & ~seen[w]).astype(
+                                        jnp.int32))
                     news_bad = None
                     if byz_j is not None:
                         news_bad = jnp.where(byz_j, news, Z)
@@ -3583,6 +3787,10 @@ def make_gossip_step(cfg: GossipSimConfig,
                                          pc(news & ~valid_w[w]))
                         if news_bad is not None:
                             inv_add[j] = inv_add[j] + pc(news_bad)
+                if tel_acc is not None and req_c is not None:
+                    tel_acc["req"] += req_c.sum(dtype=jnp.int32)
+                    tel_acc["iwant_rpcs"] += (req_c > 0).sum(
+                        dtype=jnp.int32)
                 if dex["cheat_arr"] is not None:
                     broken_add[j] = (bit_row(dex["cheat_arr"], j)
                                      & lack_any)
@@ -4196,7 +4404,9 @@ def make_gossip_step(cfg: GossipSimConfig,
             ctrl_line=(dex["ctrl_line"] if dex is not None
                        else state.ctrl_line),
             gsp_line=(dex["gsp_line"] if dex is not None
-                      else state.gsp_line))
+                      else state.gsp_line),
+            adv_line=(dex["adv_line"] if dex is not None
+                      else state.adv_line))
         if state.gates is not None:
             # emit the NEXT tick's gate words now, while the updated
             # counters are live in registers (XLA fuses the score math

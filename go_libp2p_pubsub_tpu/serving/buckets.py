@@ -91,13 +91,20 @@ class BucketLRU:
     eviction.  ``get`` refreshes recency; ``put`` evicts (and returns)
     the least-recently-used entries past ``max_buckets``."""
 
-    def __init__(self, max_buckets: int):
+    def __init__(self, max_buckets: int, metrics=None):
         if max_buckets < 1:
             raise ValueError(
                 f"max_buckets={max_buckets} must be >= 1")
         self.max_buckets = max_buckets
         self._d: OrderedDict = OrderedDict()
         self.evictions = 0
+        self._m_evict = self._m_resident = None
+        if metrics is not None:
+            self._m_evict = metrics.counter(
+                "serving_bucket_evictions_total",
+                "LRU bucket evictions")
+            self._m_resident = metrics.gauge(
+                "serving_buckets_resident", "resident shape buckets")
 
     def __len__(self) -> int:
         return len(self._d)
@@ -123,6 +130,9 @@ class BucketLRU:
         while len(self._d) > self.max_buckets:
             evicted.append(self._d.popitem(last=False))
             self.evictions += 1
+        if self._m_evict is not None:
+            self._m_evict.set_total(self.evictions)
+            self._m_resident.set(len(self._d))
         return evicted
 
 

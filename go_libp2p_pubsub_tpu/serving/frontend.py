@@ -58,6 +58,7 @@ import zlib
 import numpy as np
 
 from . import buckets as bk
+from .. import obs as _obs
 
 __all__ = ["FrontendConfig", "ScenarioFrontend"]
 
@@ -127,16 +128,18 @@ _STEP_MEMO: dict = {}
 
 class _QItem:
     """One admitted request: the raw journal line, its split front/
-    inner fields, its bucket spec, and its lifecycle stamps."""
+    inner fields, its bucket spec, its lifecycle stamps, and its
+    propagated trace id (round 19 — the span spine)."""
 
     __slots__ = ("raw", "req", "inner", "spec", "deadline", "priority",
-                 "seq", "t_admit")
+                 "seq", "t_admit", "trace_id")
 
     def __init__(self, raw, req, inner, spec, deadline, priority, seq,
-                 t_admit):
+                 t_admit, trace_id=None):
         self.raw, self.req, self.inner = raw, req, inner
         self.spec, self.deadline = spec, deadline
         self.priority, self.seq, self.t_admit = priority, seq, t_admit
+        self.trace_id = trace_id
 
 
 class _Bucket:
@@ -166,9 +169,15 @@ class ScenarioFrontend:
     flush/stats cmds, CRC'd journal, replay-on-start, deferred-kill
     drain)."""
 
-    def __init__(self, cfg: FrontendConfig | None = None, **kw):
+    def __init__(self, cfg: FrontendConfig | None = None, *,
+                 obs: _obs.Observability | None = None, **kw):
         self.cfg = cfg or FrontendConfig(**kw)
-        self.buckets = bk.BucketLRU(self.cfg.max_buckets)
+        # round 19: the observability plane — always on (host-only,
+        # cheap); callers share one bundle across servers by passing
+        # their own
+        self.obs = obs or _obs.Observability()
+        self.buckets = bk.BucketLRU(self.cfg.max_buckets,
+                                    metrics=self.obs.metrics)
         self._heap: list = []   # (-priority, seq, _QItem)
         self._seq = 0
         self._journal: str | None = None
@@ -190,9 +199,61 @@ class ScenarioFrontend:
         self.long_resumed = 0
         self.aot_loads = 0
         self.aot_exports = 0
+        self.journal_replays = 0
         self._traced_specs: set = set()
         self._t0 = time.perf_counter()
         self.wall_device_s = 0.0
+        # metric instruments: the accounting counters are MIRRORED
+        # (set_total inside one atomic() block at every publish
+        # point), so a scrape — even mid-burst — always sees the
+        # no-silent-drop identity hold
+        m = self.obs.metrics
+        self._mc = {
+            "serving_admitted_total": lambda: self.admitted,
+            "serving_served_total": lambda: self.served,
+            "serving_errors_total": lambda: self.errors,
+            "serving_deadline_timeouts_total": lambda: self.timeouts,
+            "serving_overload_rejected_total":
+                lambda: self.rejected_overload,
+            "serving_retries_total": lambda: self.retries,
+            "serving_transient_failures_total":
+                lambda: self.transient_failures,
+            "serving_long_served_total": lambda: self.long_served,
+            "serving_long_resumed_total": lambda: self.long_resumed,
+            "serving_aot_loads_total": lambda: self.aot_loads,
+            "serving_aot_exports_total": lambda: self.aot_exports,
+            "serving_journal_replays_total":
+                lambda: self.journal_replays,
+        }
+        for name in self._mc:
+            m.counter(name)
+        self._g_queue = m.gauge("serving_queue_depth",
+                                "requests queued, all buckets")
+        self._g_parked = m.gauge(
+            "serving_parked",
+            "interrupted long scenarios parked in the journal")
+        self._g_compiles = m.gauge(
+            "serving_compiles",
+            "short-path executables compiled since construction")
+        self._g_long_compiles = m.gauge(
+            "serving_long_compiles",
+            "long-path (ckpt) executables compiled")
+        self._g_bucket_q = m.gauge(
+            "serving_bucket_queue_depth",
+            "queued requests per bucket spec")
+        self._c_dispatches = m.counter(
+            "serving_bucket_dispatches_total",
+            "device dispatches per bucket spec")
+        self._h_queue = m.histogram(
+            "serving_queue_seconds",
+            (0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0),
+            "admission-to-dispatch queue wait")
+        self._h_dispatch = m.histogram(
+            "serving_dispatch_seconds",
+            (0.01, 0.05, 0.2, 1.0, 5.0, 30.0),
+            "device-dispatch wall per bucket spec")
+        self._bucket_q_keys: set = set()
+        self._last_trace_id: str | None = None
         # the front end's compile counter: the batched runner's
         # process-global jit-cache growth since construction (every
         # bucket dispatches through it; AOT buckets bypass it)
@@ -215,6 +276,30 @@ class ScenarioFrontend:
     def long_compiles(self) -> int:
         """Executables compiled for the long-scenario (ckpt) path."""
         return self._gs.gossip_run._cache_size() - self._long_cache_base
+
+    def _publish_metrics(self) -> None:
+        """Project the accounting counters into the registry in ONE
+        atomic block — called only at consistent points (end of admit,
+        end of dispatch, after parking), so every scrape satisfies
+        admitted == served + errors + timeouts + transient + queued +
+        parked."""
+        m = self.obs.metrics
+        per: dict[str, int] = {}
+        for entry in self._heap:
+            key = entry[2].spec.key()
+            per[key] = per.get(key, 0) + 1
+        with m.atomic():
+            for name, read in self._mc.items():
+                m.counter(name).set_total(read())
+            self._g_queue.set(len(self._heap))
+            self._g_parked.set(len(self._parked_raw))
+            self._g_compiles.set(self.compiles())
+            self._g_long_compiles.set(self.long_compiles())
+            for key in self._bucket_q_keys - set(per):
+                self._g_bucket_q.set(0, bucket=key)
+            for key, depth in per.items():
+                self._g_bucket_q.set(depth, bucket=key)
+            self._bucket_q_keys |= set(per)
 
     def _bucket(self, spec: bk.BucketSpec) -> _Bucket:
         got = self.buckets.get(spec)
@@ -286,13 +371,16 @@ class ScenarioFrontend:
         request's terminal row (explicit ``overloaded`` rejection, or
         a validation error row) — the caller emits it."""
         now = time.monotonic() if now is None else now
+        self._last_trace_id = None
         if not isinstance(req, dict):
             self.errors += 1
+            self._publish_metrics()
             return {"ok": False,
                     "error": "request must be a JSON object, got "
                              f"{type(req).__name__}"}
         if len(self._heap) >= self.cfg.queue_cap:
             self.rejected_overload += 1
+            self._publish_metrics()
             return {"id": req.get("id"), "ok": False,
                     "overloaded": True,
                     "error": f"overloaded: queue depth "
@@ -309,13 +397,21 @@ class ScenarioFrontend:
             priority = int(req.get("priority", 0))
         except (ValueError, TypeError) as e:
             self.errors += 1
+            self._publish_metrics()
             return {"id": req.get("id"), "ok": False, "error": str(e)}
+        sp = self.obs.spans
+        trace_id = sp.new_trace_id(req.get("id"))
         item = _QItem(raw if raw is not None else json.dumps(req),
                       req, inner, spec, deadline, priority, self._seq,
-                      now)
+                      now, trace_id=trace_id)
+        sp.instant(trace_id, "admit", bucket=spec.key(),
+                   priority=priority)
+        sp.begin(trace_id, "queue", bucket=spec.key())
         heapq.heappush(self._heap, (-priority, self._seq, item))
         self._seq += 1
         self.admitted += 1
+        self._last_trace_id = trace_id
+        self._publish_metrics()
         return None
 
     # -- dispatch ------------------------------------------------------
@@ -327,9 +423,15 @@ class ScenarioFrontend:
             item = entry[2]
             if item.deadline is not None and now > item.deadline:
                 self.timeouts += 1
+                if item.trace_id is not None:
+                    self._h_queue.observe(
+                        self.obs.spans.end(item.trace_id, "queue",
+                                           outcome="timeout"))
+                    self.obs.spans.instant(item.trace_id, "serve",
+                                           outcome="timeout")
                 rows.append({
                     "id": item.req.get("id"), "ok": False,
-                    "timeout": True,
+                    "timeout": True, "trace_id": item.trace_id,
                     "error": "deadline exceeded: request waited "
                              f"{now - item.t_admit:.3f}s in queue, "
                              f"past its deadline_s="
@@ -365,17 +467,48 @@ class ScenarioFrontend:
         return (self.cfg.long_ticks > 0
                 and spec.ticks >= self.cfg.long_ticks)
 
+    def _end_dispatch_spans(self, items: list[_QItem], key: str,
+                            outcome: str) -> None:
+        """Close the group's open dispatch spans; the wall time of the
+        first (all share the device call) feeds the per-bucket
+        dispatch histogram."""
+        wall = None
+        for it in items:
+            if it.trace_id is not None:
+                d = self.obs.spans.end(it.trace_id, "dispatch",
+                                       outcome=outcome)
+                wall = d if wall is None else wall
+        if wall is not None:
+            self._h_dispatch.observe(wall, bucket=key)
+
     def _submit_with_retry(self, bucket: _Bucket,
                            items: list[_QItem]) -> list[dict]:
         from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
+        sp = self.obs.spans
+        key = bucket.spec.key()
+        # the pad phase: assembling the (padded) request group for the
+        # bucket's static batch shape
+        for it in items:
+            if it.trace_id is not None:
+                sp.begin(it.trace_id, "pad", bucket=key)
         reqs = [item.inner for item in items]
+        pad_rows = self.cfg.batch - len(reqs)
+        for it in items:
+            if it.trace_id is not None:
+                sp.end(it.trace_id, "pad", padded_rows=pad_rows)
         attempt = 0
         while True:
             try:
+                for it in items:
+                    if it.trace_id is not None:
+                        sp.begin(it.trace_id, "dispatch", bucket=key,
+                                 attempt=attempt)
                 t0 = time.perf_counter()
                 rows = bucket.server.submit([dict(r) for r in reqs])
                 self.wall_device_s += time.perf_counter() - t0
                 bucket.dispatches += 1
+                self._c_dispatches.inc(bucket=key)
+                self._end_dispatch_spans(items, key, "ok")
                 return rows
             except ck.CheckpointInterrupt:
                 raise   # drain machinery, not a dispatch failure
@@ -384,12 +517,14 @@ class ScenarioFrontend:
                 # retried (determinism: the same input fails the same
                 # way)
                 self.errors += len(items)
+                self._end_dispatch_spans(items, key, "error")
                 return [{"id": it.req.get("id"), "ok": False,
                          "error": str(e)} for it in items]
             except (RuntimeError, OSError) as e:
                 attempt += 1
                 if attempt > self.cfg.max_retries:
                     self.transient_failures += len(items)
+                    self._end_dispatch_spans(items, key, "transient")
                     return [{"id": it.req.get("id"), "ok": False,
                              "transient": True,
                              "error": "dispatch failed after "
@@ -474,20 +609,32 @@ class ScenarioFrontend:
         """_dispatch_long with the retry/terminal-row treatment of the
         short path; CheckpointInterrupt propagates (drain)."""
         from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
+        group = [item]
+        key = item.spec.key()
         attempt = 0
         while True:
             try:
-                return self._dispatch_long(item)
+                if item.trace_id is not None:
+                    self.obs.spans.begin(item.trace_id, "dispatch",
+                                         bucket=key, long=True,
+                                         attempt=attempt)
+                row = self._dispatch_long(item)
+                self._c_dispatches.inc(bucket=key)
+                self._end_dispatch_spans(group, key, "ok")
+                return row
             except ck.CheckpointInterrupt:
-                raise
+                raise   # the dispatch span stays open — the caller
+                # closes it with outcome="interrupted" when it parks
             except (ValueError, TypeError) as e:
                 self.errors += 1
+                self._end_dispatch_spans(group, key, "error")
                 return {"id": item.req.get("id"), "ok": False,
                         "error": str(e)}
             except (RuntimeError, OSError) as e:
                 attempt += 1
                 if attempt > self.cfg.max_retries:
                     self.transient_failures += 1
+                    self._end_dispatch_spans(group, key, "transient")
                     return {"id": item.req.get("id"), "ok": False,
                             "transient": True,
                             "error": "dispatch failed after "
@@ -520,25 +667,44 @@ class ScenarioFrontend:
         when it is full (``force=True`` dispatches partial groups —
         the drain path).  One call, at most one device dispatch."""
         now = time.monotonic() if now is None else now
+        sp = self.obs.spans
         rows = self._cull_deadlines(now)
+        if rows:
+            self._publish_metrics()
         if not self._heap or not (force or self._head_ready()):
             return rows
         head = self._heap[0][2]
         if self._is_long(head.spec):
             item = heapq.heappop(self._heap)[2]
-            rows.append(self._dispatch_long_guarded(item))
+            if item.trace_id is not None:
+                self._h_queue.observe(sp.end(item.trace_id, "queue"))
+            row = self._dispatch_long_guarded(item)
+            row.setdefault("trace_id", item.trace_id)
+            if item.trace_id is not None:
+                sp.instant(item.trace_id, "serve",
+                           outcome="ok" if row.get("ok") else "error")
+            rows.append(row)
             self.served += 1
+            self._publish_metrics()
             return rows
         group = self._pop_group()
         if not group:
             return rows
+        for item in group:
+            if item.trace_id is not None:
+                self._h_queue.observe(sp.end(item.trace_id, "queue"))
         bucket = self._bucket(group[0].spec)
         got = self._submit_with_retry(bucket, group)
         for item, row in zip(group, got):
             row.setdefault("bucket", item.spec.key())
             row["queue_s"] = round(now - item.t_admit, 4)
+            row.setdefault("trace_id", item.trace_id)
+            if item.trace_id is not None:
+                sp.instant(item.trace_id, "serve",
+                           outcome="ok" if row.get("ok") else "error")
             rows.append(row)
             self.served += 1
+        self._publish_metrics()
         return rows
 
     def drain(self) -> list[dict]:
@@ -572,6 +738,8 @@ class ScenarioFrontend:
             "long_resumed": self.long_resumed,
             "aot_loads": self.aot_loads,
             "aot_exports": self.aot_exports,
+            "journal_replays": self.journal_replays,
+            "traces": self.obs.spans.trace_count(),
             "requests_per_sec": round(self.served / dev, 3) if dev
             else None,
             "wall_s": round(time.perf_counter() - self._t0, 2),
@@ -580,7 +748,7 @@ class ScenarioFrontend:
 
     # -- line protocol (journal + drain; the sweepd shape) -------------
 
-    def _journal_append(self, raw: str) -> None:
+    def _journal_append(self, raw: str, trace_id=None) -> None:
         if self._journal is None:
             return
         from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
@@ -591,6 +759,9 @@ class ScenarioFrontend:
             f.write(ck.journal_encode_line(raw) + "\n")
             f.flush()
             os.fsync(f.fileno())
+        if trace_id is not None:
+            self.obs.spans.instant(trace_id, "journal",
+                                   bytes=len(raw))
 
     def _journal_compact(self) -> None:
         """Rewrite the journal to the still-unserved lines: everything
@@ -610,20 +781,27 @@ class ScenarioFrontend:
                           "".join(ck.journal_encode_line(r) + "\n"
                                   for r in raws))
 
-    def serve_lines(self, lines, out, *, journal: str | None = None
-                    ) -> None:
+    def serve_lines(self, lines, out, *, journal: str | None = None,
+                    lock=None) -> None:
         """Drive the front end from an iterable of JSON lines, one
         request per line, writing rows to ``out``.  Control lines:
         ``{"cmd": "flush"}`` drains the queue, ``{"cmd": "stats"}``
-        emits the counters row; EOF drains.  With ``journal=PATH``
-        every admitted line is CRC-appended before it can dispatch and
-        the journal is compacted to the still-unserved lines after
-        every dispatch; lines left by a killed server (torn tail lines
-        dropped by name) are replayed on entry.  A pending deferred
-        kill drains short requests and parks interrupted long ones in
-        the journal for the restart to resume."""
+        emits the counters row, ``{"cmd": "metrics"}`` emits the
+        registry snapshot + span summary; EOF drains.  With
+        ``journal=PATH`` every admitted line is CRC-appended before it
+        can dispatch and the journal is compacted to the still-unserved
+        lines after every dispatch; lines left by a killed server (torn
+        tail lines dropped by name) are replayed on entry.  A pending
+        deferred kill drains short requests and parks interrupted long
+        ones in the journal for the restart to resume.  ``lock`` (a
+        shared ``threading.RLock``) serializes line handling when
+        several connection threads drive ONE front end (sweepd
+        --multi's thread-per-connection socket loop)."""
+        import contextlib
+
         from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
 
+        lk = lock if lock is not None else contextlib.nullcontext()
         self._journal = journal
 
         def emit(obj):
@@ -647,14 +825,21 @@ class ScenarioFrontend:
                 emit_all(self.dispatch_ready(force=force))
             except ck.CheckpointInterrupt as e:
                 self._parked_raw.append(head.raw)
+                if head.trace_id is not None:
+                    self._end_dispatch_spans([head], head.spec.key(),
+                                             "interrupted")
+                    self.obs.spans.instant(head.trace_id, "park",
+                                           ticks_done=e.ticks_done)
                 emit({"id": head.req.get("id"), "ok": False,
                       "interrupted": True, "journaled": True,
+                      "trace_id": head.trace_id,
                       "error": "interrupted mid-scenario at tick "
                                f"{e.ticks_done}/{e.n_ticks} — "
                                "journaled; a restarted server "
                                "resumes from the snapshot to the "
                                "bit-identical digest"})
                 self._journal_compact()
+                self._publish_metrics()
 
         def drain_interruptible() -> None:
             """Drain; interrupted long scenarios park and the rest
@@ -674,17 +859,23 @@ class ScenarioFrontend:
                 drain_interruptible()
             elif cmd == "stats":
                 emit(self.stats())
+            elif cmd == "metrics":
+                emit({"metrics": True,
+                      "families": self.obs.metrics.snapshot(),
+                      "spans": self.obs.spans.summary()})
             elif cmd:
                 self.errors += 1
                 emit({"ok": False,
-                      "error": f"unknown cmd {cmd!r} (flush/stats)"})
+                      "error": f"unknown cmd {cmd!r} "
+                               "(flush/stats/metrics)"})
             else:
                 row = self.admit(req, raw=raw)
                 if row is not None:
                     emit(row)
                     return
                 if journal_new:
-                    self._journal_append(raw)
+                    self._journal_append(raw,
+                                         trace_id=self._last_trace_id)
                 while self._head_ready():
                     dispatch_guard()
 
@@ -699,19 +890,24 @@ class ScenarioFrontend:
                 print(f"serving: replaying {len(replay)} journaled "
                       "request line(s) from an interrupted run",
                       file=sys.stderr, flush=True)
-                for raw in replay:
-                    handle(raw, journal_new=False)
-                self._journal_compact()
+                with lk:
+                    for raw in replay:
+                        handle(raw, journal_new=False)
+                    self.journal_replays += len(replay)
+                    self._journal_compact()
+                    self._publish_metrics()
 
         for line in lines:
             line = line.strip()
             if line:
-                handle(line, journal_new=True)
+                with lk:
+                    handle(line, journal_new=True)
             if ck.stop_requested():
                 print("serving: stop requested — draining queued "
                       "requests and parking interrupted long "
                       "scenarios", file=sys.stderr, flush=True)
                 break
-        drain_interruptible()
-        self._journal_compact()
-        emit(self.stats())
+        with lk:
+            drain_interruptible()
+            self._journal_compact()
+            emit(self.stats())

@@ -379,6 +379,134 @@ def test_delayed_latency_hist_sums_and_multibucket():
                                   frames_by_path[True])
 
 
+# --------------------------------------------------------------------------
+# Delay-armed telemetry counters (round 19: the lifted refusal)
+# --------------------------------------------------------------------------
+
+
+_COUNTER_FIELDS = ("payload_sent", "ihave_rpcs", "ihave_ids",
+                   "iwant_rpcs", "iwant_ids_requested",
+                   "iwant_ids_served", "graft_sends", "prune_sends",
+                   "dup_suppressed", "bytes_payload", "bytes_control")
+
+
+def _run_gossip_frames(delays, *, kernel=False, split=False,
+                       ticks=TICKS):
+    """Counter+wire-armed gossip run; returns summed per-field frame
+    totals plus the final state."""
+    subs, topic, origin, tks = _inputs()
+    cfg = _gossip_cfg()
+    sc = gs.ScoreSimConfig(mesh_message_deliveries_weight=(
+        -1.0 if split else 0.0))
+    kw = dict(score_cfg=sc, delays=delays, fault_schedule=_sched())
+    if delays is not None:
+        kw["delays_counters"] = True
+        if split:
+            kw["delays_split"] = True
+    skw = dict(telemetry=tl.TelemetryConfig())
+    if kernel:
+        kw["pad_to_block"] = BLK
+        skw.update(receive_block=BLK, receive_interpret=True)
+    if split and not kernel:
+        skw["force_split"] = True
+    params, state = gs.make_gossip_sim(cfg, subs, topic, origin, tks,
+                                       **kw)
+    step = gs.make_gossip_step(cfg, sc, **skw)
+    frames = []
+    for _ in range(ticks):
+        state, _d, frame = step(params, state)
+        frames.append({f: np.asarray(getattr(frame, f))
+                       for f in _COUNTER_FIELDS})
+    return state, frames
+
+
+def _assert_frames_equal(a, b):
+    assert len(a) == len(b)
+    for t, (fa, fb) in enumerate(zip(a, b)):
+        for f in _COUNTER_FIELDS:
+            np.testing.assert_array_equal(
+                fa[f], fb[f], err_msg=f"tick {t}: {f}")
+
+
+def test_identity_counters_combined():
+    """DelayConfig(1, 0, 1) counter frames are bit-identical to the
+    pre-delay step's, per tick and per field (combined path)."""
+    _, ref = _run_gossip_frames(None)
+    _, idn = _run_gossip_frames(IDENTITY)
+    _assert_frames_equal(ref, idn)
+
+
+def test_identity_counters_split():
+    _, ref = _run_gossip_frames(None, split=True)
+    _, idn = _run_gossip_frames(IDENTITY, split=True)
+    _assert_frames_equal(ref, idn)
+
+
+@pytest.mark.slow
+def test_identity_counters_kernel_interpret():
+    _, ref = _run_gossip_frames(None, kernel=True)
+    _, idn = _run_gossip_frames(IDENTITY, kernel=True)
+    _assert_frames_equal(ref, idn)
+
+
+@pytest.mark.slow
+def test_delayed_counters_kernel_matches_xla():
+    """Under a REAL heterogeneous delay pipeline the kernel epilogue's
+    counter frames stay bit-identical to the XLA delayed path — both
+    derive from the same delay_exchange operands."""
+    dc = DelayConfig(base=3, jitter=2, k_slots=8)
+    _, xla = _run_gossip_frames(dc)
+    _, krn = _run_gossip_frames(dc, kernel=True)
+    _assert_frames_equal(xla, krn)
+
+
+def test_delayed_counters_flood_and_randomsub_identity():
+    """The flood/randomsub delayed replay paths already thread
+    counters; pin their DelayConfig(1, 0, 1) frame identity too."""
+    subs, topic, origin, tks = _inputs()
+    offs = tuple(int(o) for o in make_circulant_offsets(T, C, N,
+                                                        seed=1))
+    tcfg = tl.TelemetryConfig()
+
+    def run_flood(delays):
+        p, s = fs.make_flood_sim(None, None, subs, None, topic,
+                                 origin, tks, fault_schedule=_sched(),
+                                 fault_offsets=offs, delays=delays)
+        core = fs.make_circulant_step_core(offs, telemetry=tcfg)
+        out = []
+        for _ in range(TICKS):
+            s, _d, frame = core(p, s)
+            out.append(np.asarray(frame.payload_sent))
+        return out
+
+    def run_rsub(delays):
+        rcfg = rs.RandomSubSimConfig(
+            offsets=rs.make_randomsub_offsets(T, C, N, seed=1),
+            n_topics=T, d=3)
+        p, s = rs.make_randomsub_sim(rcfg, subs, topic, origin, tks,
+                                     fault_schedule=_sched(),
+                                     delays=delays)
+        step = rs.make_randomsub_step(rcfg, telemetry=tcfg)
+        out = []
+        for _ in range(TICKS):
+            s, _d, frame = step(p, s)
+            out.append(np.asarray(frame.payload_sent))
+        return out
+
+    for run in (run_flood, run_rsub):
+        a, b = run(None), run(IDENTITY)
+        for t, (x, y) in enumerate(zip(a, b)):
+            np.testing.assert_array_equal(x, y, err_msg=f"tick {t}")
+
+
+def test_delays_counters_build_requires_delayconfig():
+    subs, topic, origin, tks = _inputs()
+    cfg = _gossip_cfg()
+    with pytest.raises(ValueError, match="needs a DelayConfig"):
+        gs.make_gossip_sim(cfg, subs, topic, origin, tks,
+                           delays_counters=True)
+
+
 @pytest.mark.slow
 def test_invariants_green_under_delays_with_cold_restart():
     subs, topic, origin, tks = _inputs()
@@ -450,8 +578,9 @@ def test_refusals_named():
     params, state = gs.make_gossip_sim(
         cfg, subs, topic, origin, tks, score_cfg=sc,
         delays=DelayConfig(1, 0, 1))
-    with pytest.raises(NotImplementedError,
-                       match="counters group is not delay-supported"):
+    # round 19: the counters-group refusal is LIFTED — what remains
+    # is the build requirement for the observer delay lines, named
+    with pytest.raises(ValueError, match="delays_counters=True"):
         gs.make_gossip_step(cfg, sc,
                             telemetry=tl.TelemetryConfig())(params,
                                                             state)

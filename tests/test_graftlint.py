@@ -172,7 +172,7 @@ def test_only_graftlint_fixture_dir_is_exempt(tmp_path):
 
 def test_declared_matrix_shape():
     combos = ja.declared_matrix()
-    assert len(combos) == 70
+    assert len(combos) == 74
     # base 32: all three sims x telemetry x faults x batched; split
     # axis only on gossipsub.  Round-10 variants: gather/dense
     # (tel x faults), rpc (tel, faulted), hist (faults, scored).
@@ -197,17 +197,19 @@ def test_declared_matrix_shape():
     # dispatch — one resident pallas call per shard under shard_map
     # with the in-kernel remote-DMA ring halo; telemetry x faults,
     # the telemetry cases additionally asserting the cross-mesh
-    # frame psum).
+    # frame psum).  Round-19 delays additions: four counter-armed
+    # delay cases (gossip combined faulted + split, flood + randomsub
+    # replay) — the lifted delays[telemetry-counters] refusal traced.
     key = lambda c: (c["sim"], c["split"], c["telemetry"],  # noqa: E731
                      c["faults"], c["batched"], c["variant"])
-    assert len({key(c) for c in combos}) == 70
+    assert len({key(c) for c in combos}) == 74
     assert sum(not c["variant"] for c in combos) == 32
-    for sim, n in (("gossipsub", 41), ("floodsub", 15),
-                   ("randomsub", 14)):
+    for sim, n in (("gossipsub", 43), ("floodsub", 16),
+                   ("randomsub", 15)):
         assert sum(c["sim"] == sim for c in combos) == n
     for var, n in (("gather", 4), ("dense", 4), ("rpc", 2),
                    ("hist", 2), ("inv", 4), ("attack", 2),
-                   ("knobs", 2), ("delays", 5), ("sharded", 2),
+                   ("knobs", 2), ("delays", 9), ("sharded", 2),
                    ("sharded-kernel", 1), ("sharded-kernel-delays", 1),
                    ("ckpt", 3), ("fused", 2), ("fused-sharded", 4)):
         assert sum(c["variant"] == var for c in combos) == n

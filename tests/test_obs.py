@@ -1,0 +1,408 @@
+# graftlint: scope=tests
+"""Round 19: the service observability plane (go_libp2p_pubsub_tpu/
+obs) and its serving integration.
+
+The acceptance pins:
+
+- registry semantics: counters are monotonic (``inc``/``set_total``
+  both refuse decreases), gauges move freely, histograms keep their
+  registration-time buckets, registration is idempotent by name and a
+  kind clash is a named error, and ``atomic()`` makes multi-instrument
+  updates all-or-nothing under concurrent snapshots.
+- render surfaces: the Prometheus text exposition (HELP/TYPE,
+  cumulative histogram buckets, escaped labels) and the JSON-lines
+  snapshot agree with each other.
+- spans: begin/end pairing, never-crash end-without-begin, bounded
+  capacity with COUNTED drops, and a Chrome trace export that
+  round-trips through json.
+- the serving cross-check: a ScenarioFrontend's live metrics scrape
+  reproduces its stats() accounting identity on EVERY scrape —
+  including mid-flight scrapes taken from another thread during a
+  concurrent load burst — and its span ledger covers every admitted
+  request (traces == admitted, one terminal event each, nothing open
+  or dropped after the drain).
+- the sweepd socket loop: thread-per-connection clients against ONE
+  resident server, total terminal rows == total requests sent.
+"""
+
+import io
+import json
+import threading
+import time
+
+import pytest
+
+from go_libp2p_pubsub_tpu.obs import (MetricsRegistry, Observability,
+                                      SpanRecorder)
+
+pytestmark = []
+
+
+# --------------------------------------------------------------------------
+# registry semantics
+# --------------------------------------------------------------------------
+
+
+def test_counter_monotonic():
+    m = MetricsRegistry("t")
+    c = m.counter("reqs_total")
+    c.inc()
+    c.inc(4)
+    assert c.value() == 5
+    c.set_total(9)
+    assert c.value() == 9
+    with pytest.raises(ValueError, match="cannot decrease"):
+        c.inc(-1)
+    with pytest.raises(ValueError, match="cannot decrease"):
+        c.set_total(3)
+
+
+def test_gauge_and_labels():
+    m = MetricsRegistry("t")
+    g = m.gauge("depth")
+    g.set(7, bucket="a")
+    g.add(-2, bucket="a")
+    g.set(1, bucket="b")
+    assert g.value(bucket="a") == 5
+    assert g.value(bucket="b") == 1
+    assert g.value(bucket="zzz") == 0
+    with pytest.raises(ValueError, match="bad label name"):
+        g.set(1, **{"bad-label": "x"})
+
+
+def test_histogram_buckets_fixed_and_cumulative_render():
+    m = MetricsRegistry("t")
+    h = m.histogram("lat", (0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    prom = m.render_prometheus()
+    assert 't_lat_bucket{le="0.1"} 1' in prom
+    assert 't_lat_bucket{le="1.0"} 3' in prom
+    assert 't_lat_bucket{le="10.0"} 4' in prom
+    assert 't_lat_bucket{le="+Inf"} 5' in prom
+    assert "t_lat_count 5" in prom
+    with pytest.raises(ValueError, match="strictly-increasing"):
+        m.histogram("bad", (1.0, 1.0))
+    with pytest.raises(ValueError, match="strictly-increasing"):
+        m.histogram("bad2", ())
+
+
+def test_registration_idempotent_kind_clash_named():
+    m = MetricsRegistry("t")
+    assert m.counter("x_total") is m.counter("x_total")
+    with pytest.raises(ValueError, match="already registered"):
+        m.gauge("x_total")
+    with pytest.raises(ValueError, match="bad metric name"):
+        m.counter("9starts-with-digit")
+    with pytest.raises(ValueError, match="bad namespace"):
+        MetricsRegistry("no spaces")
+
+
+def test_atomic_snapshot_all_or_nothing():
+    """A scraper racing an atomic() update block must never see the
+    identity broken: writer keeps a == b under the lock; reader
+    snapshots concurrently and checks every observation."""
+    m = MetricsRegistry("t")
+    a, b = m.counter("a_total"), m.counter("b_total")
+    stop = threading.Event()
+    broken = []
+
+    def reader():
+        while not stop.is_set():
+            snap = {f["name"]: f for f in m.snapshot()}
+            va = (snap["t_a_total"]["samples"] or
+                  [{"value": 0}])[0]["value"]
+            vb = (snap["t_b_total"]["samples"] or
+                  [{"value": 0}])[0]["value"]
+            if va != vb:
+                broken.append((va, vb))
+    th = threading.Thread(target=reader)
+    th.start()
+    for i in range(300):
+        with m.atomic():
+            a.inc()
+            b.inc()
+    stop.set()
+    th.join()
+    assert not broken, broken[:3]
+    assert a.value() == b.value() == 300
+
+
+def test_prometheus_label_escaping_and_json_agreement():
+    m = MetricsRegistry("t")
+    m.counter("c_total").inc(2, path='a"b\\c')
+    prom = m.render_prometheus()
+    assert 't_c_total{path="a\\"b\\\\c"} 2' in prom
+    fams = [json.loads(ln) for ln in
+            m.render_json_lines().splitlines()]
+    assert fams[0]["name"] == "t_c_total"
+    assert fams[0]["samples"][0]["value"] == 2
+    assert fams[0]["samples"][0]["labels"] == {"path": 'a"b\\c'}
+
+
+# --------------------------------------------------------------------------
+# spans
+# --------------------------------------------------------------------------
+
+
+def test_span_lifecycle_and_chrome_export(tmp_path):
+    rec = SpanRecorder()
+    tid = rec.new_trace_id("req/1")
+    assert "/" not in tid
+    rec.instant(tid, "admit")
+    rec.begin(tid, "queue")
+    time.sleep(0.002)
+    dur = rec.end(tid, "queue")
+    assert dur >= 0.002
+    rec.instant(tid, "serve", outcome="ok")
+    summ = rec.summary()
+    assert summ["traces"] == 1 and summ["open_spans"] == 0
+    assert summ["phases"] == {"admit": 1, "queue": 1, "serve": 1}
+    assert summ["terminal"] == 1
+    path = tmp_path / "trace.json"
+    rec.write_chrome_trace(str(path))
+    trace = json.loads(path.read_text())
+    assert len(trace["traceEvents"]) == 3
+    x = next(e for e in trace["traceEvents"] if e["ph"] == "X")
+    assert x["name"] == "queue" and x["dur"] >= 2000
+    assert x["args"]["trace_id"] == tid
+
+
+def test_span_end_without_begin_never_crashes():
+    rec = SpanRecorder()
+    assert rec.end("ghost-0", "dispatch") == 0.0
+    assert rec.summary()["phases"] == {"dispatch": 1}
+
+
+def test_span_capacity_drops_are_counted():
+    rec = SpanRecorder(capacity=5)
+    for i in range(8):
+        rec.instant(f"t-{i}", "admit")
+    summ = rec.summary()
+    assert summ["events"] == 5 and summ["dropped_events"] == 3
+    assert rec.chrome_trace()["otherData"]["dropped_events"] == 3
+
+
+# --------------------------------------------------------------------------
+# scrape server
+# --------------------------------------------------------------------------
+
+
+def test_scrape_server_endpoints():
+    import urllib.request
+    o = Observability(namespace="t")
+    o.metrics.counter("up_total").inc()
+    o.spans.instant(o.spans.new_trace_id("r"), "admit")
+    srv = o.scrape_server(port=0)
+    try:
+        with urllib.request.urlopen(srv.url("/metrics")) as r:
+            assert b"t_up_total 1" in r.read()
+        with urllib.request.urlopen(srv.url("/metrics.json")) as r:
+            fams = [json.loads(ln) for ln in
+                    r.read().decode().splitlines()]
+            assert any(f["name"] == "t_up_total" for f in fams)
+        with urllib.request.urlopen(srv.url("/trace.json")) as r:
+            assert len(json.loads(r.read())["traceEvents"]) == 1
+        with urllib.request.urlopen(srv.url("/healthz")) as r:
+            assert r.status == 204
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(srv.url("/nope"))
+    finally:
+        srv.close()
+
+
+# --------------------------------------------------------------------------
+# serving cross-check (the satellite acceptance)
+# --------------------------------------------------------------------------
+
+
+def _mk_frontend(**kw):
+    from go_libp2p_pubsub_tpu.serving import (FrontendConfig,
+                                              ScenarioFrontend)
+    base = dict(max_buckets=2, batch=2, queue_cap=64,
+                server_kw={"seed": 0})
+    base.update(kw)
+    return ScenarioFrontend(FrontendConfig(**base))
+
+
+def _scrape_identity(metrics):
+    """(admitted, accounted, ok) from one atomic snapshot."""
+    snap = {f["name"]: f for f in metrics.snapshot()}
+
+    def val(name):
+        s = snap["pubsub_" + name]["samples"]
+        return s[0]["value"] if s else 0
+    admitted = val("serving_admitted_total")
+    accounted = (val("serving_served_total")
+                 + val("serving_errors_total")
+                 + val("serving_deadline_timeouts_total")
+                 + val("serving_transient_failures_total")
+                 + val("serving_queue_depth")
+                 + val("serving_parked"))
+    return admitted, accounted, admitted == accounted
+
+
+def test_frontend_scrape_reproduces_stats_identity():
+    """The committed cross-check: drive served + timed-out +
+    overload-rejected requests, then assert the live scrape equals
+    stats() field by field and the span ledger covers every
+    admission."""
+    fe = _mk_frontend(queue_cap=5)
+    rows = []
+    for i in range(12):
+        req = {"id": f"r{i}", "n": 64, "t": 1, "m": 2, "ticks": 4,
+               "seed": i % 4}
+        if i in (2, 3):
+            req["deadline_s"] = 0.0
+        rej = fe.admit(req)
+        if rej is not None:
+            rows.append(rej)
+        if i % 5 == 4:
+            time.sleep(0.005)
+            rows.extend(fe.dispatch_ready(force=True))
+    rows.extend(fe.drain())
+    st = fe.stats()
+    assert st["rejected_overload"] > 0 and st["timeouts"] > 0, st
+
+    admitted, accounted, ok = _scrape_identity(fe.obs.metrics)
+    assert ok and admitted == st["admitted"]
+    snap = {f["name"]: f for f in fe.obs.metrics.snapshot()}
+
+    def val(name):
+        s = snap["pubsub_" + name]["samples"]
+        return s[0]["value"] if s else 0
+    for field, metric in (
+            ("admitted", "serving_admitted_total"),
+            ("served", "serving_served_total"),
+            ("errors", "serving_errors_total"),
+            ("timeouts", "serving_deadline_timeouts_total"),
+            ("rejected_overload", "serving_overload_rejected_total"),
+            ("transient_failures",
+             "serving_transient_failures_total"),
+            ("queued", "serving_queue_depth"),
+            ("parked", "serving_parked"),
+            ("compiles", "serving_compiles"),
+            ("evictions", "serving_bucket_evictions_total")):
+        assert val(metric) == st[field], (field, val(metric),
+                                          st[field])
+
+    summ = fe.obs.spans.summary()
+    assert summ["traces"] == st["admitted"]
+    assert summ["terminal"] == st["admitted"]
+    assert summ["open_spans"] == 0 and summ["dropped_events"] == 0
+    # every terminal row carries its trace id (rejections never do)
+    for r in rows:
+        if r.get("overloaded"):
+            assert "trace_id" not in r or r["trace_id"] is None
+        else:
+            assert r.get("trace_id")
+
+
+def test_frontend_midflight_scrapes_hold_identity():
+    """Satellite 1's hard part: scrapes taken CONCURRENTLY with a
+    load burst (a scraper thread hammering snapshot() while the
+    serving thread admits and dispatches) must satisfy the identity
+    on every single observation — the atomic publish contract."""
+    fe = _mk_frontend(batch=2)
+    stop = threading.Event()
+    seen = []
+
+    def scraper():
+        while not stop.is_set():
+            seen.append(_scrape_identity(fe.obs.metrics))
+    th = threading.Thread(target=scraper)
+    th.start()
+    try:
+        for i in range(20):
+            rej = fe.admit({"id": f"m{i}", "n": 64, "t": 1, "m": 2,
+                            "ticks": 4, "seed": i % 4})
+            assert rej is None
+            fe.dispatch_ready()
+        fe.drain()
+    finally:
+        stop.set()
+        th.join()
+    broken = [s for s in seen if not s[2]]
+    assert not broken, broken[:3]
+    assert len(seen) > 0
+    final = _scrape_identity(fe.obs.metrics)
+    assert final == (20, 20, True)
+    assert fe.obs.spans.summary()["traces"] == 20
+
+
+def test_serve_lines_metrics_verb_and_journal_replay_counter(
+        tmp_path):
+    fe = _mk_frontend()
+    journal = str(tmp_path / "fe.journal")
+    from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
+    with open(journal, "w") as f:
+        f.write(ck.journal_encode_line(json.dumps(
+            {"id": "old1", "n": 64, "t": 1, "m": 2, "ticks": 4}))
+            + "\n")
+    out = io.StringIO()
+    fe.serve_lines([json.dumps({"cmd": "metrics"})], out,
+                   journal=journal)
+    rows = [json.loads(ln) for ln in out.getvalue().splitlines()]
+    met = next(r for r in rows if r.get("metrics"))
+    st = next(r for r in rows if r.get("stats"))
+    assert st["journal_replays"] == 1 and st["admitted"] == 1
+    fam = {f["name"]: f for f in met["families"]}
+    assert (fam["pubsub_serving_journal_replays_total"]["samples"]
+            [0]["value"] == 1)
+    assert met["spans"]["phases"].get("journal") is None  # replayed
+    # lines are already journaled — no re-append, no journal instant
+
+
+def test_sweepd_socket_thread_per_connection(tmp_path):
+    """Two concurrent client connections against ONE front end
+    through serve_lines with a shared lock (the --socket loop's
+    shape, in-process): total terminal rows == total requests, and
+    the shared server's scrape identity holds."""
+    fe = _mk_frontend(batch=2)
+    lock = threading.RLock()
+    outs = [io.StringIO(), io.StringIO()]
+
+    def client(k):
+        lines = [json.dumps({"id": f"c{k}-{i}", "n": 64, "t": 1,
+                             "m": 2, "ticks": 4, "seed": i % 2})
+                 for i in range(5)]
+        fe.serve_lines(lines, outs[k], lock=lock)
+
+    threads = [threading.Thread(target=client, args=(k,))
+               for k in range(2)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    rows = []
+    for o in outs:
+        rows += [json.loads(ln) for ln in o.getvalue().splitlines()]
+    terminal = [r for r in rows if not r.get("stats")]
+    assert len(terminal) == 10, rows
+    assert all(r.get("ok") for r in terminal)
+    assert _scrape_identity(fe.obs.metrics) == (10, 10, True)
+
+
+def test_sweepserver_metrics_optional_and_verb():
+    """A SweepServer without obs= refuses the metrics verb by name; a
+    main()-style obs-armed server publishes sweepd_* families."""
+    from tools.sweepd import SweepServer
+    srv = SweepServer(n=64, t=1, m=2, ticks=4, batch=2,
+                      invariants=False)
+    out = io.StringIO()
+    srv.serve_lines([json.dumps({"cmd": "metrics"})], out)
+    rows = [json.loads(ln) for ln in out.getvalue().splitlines()]
+    assert "no observability bundle" in rows[0]["error"]
+
+    o = Observability()
+    srv2 = SweepServer(n=64, t=1, m=2, ticks=4, batch=2,
+                       invariants=False, obs=o)
+    out2 = io.StringIO()
+    reqs = [json.dumps({"id": f"q{i}", "seed": i}) for i in range(2)]
+    srv2.serve_lines(reqs + [json.dumps({"cmd": "metrics"})], out2)
+    rows2 = [json.loads(ln) for ln in out2.getvalue().splitlines()]
+    met = next(r for r in rows2 if r.get("metrics"))
+    fam = {f["name"]: f for f in met["families"]}
+    assert fam["pubsub_sweepd_served_total"]["samples"][0]["value"] \
+        == 2
+    assert fam["pubsub_sweepd_compiles"]["samples"][0]["value"] == 1

@@ -959,10 +959,11 @@ def _probe_delays_rpc():
     jax.eval_shape(step, params, state)   # must raise
 
 
-def _probe_delays_tel_counters():
-    """Delays + the telemetry counters group: send/receive accounting
-    would need per-class delay lines — refused by name (the
-    histogram/gauge/fault groups all thread)."""
+def _probe_delays_counter_lines():
+    """Delay-armed counters need the observer delay lines allocated
+    at BUILD time (make_gossip_sim(..., delays_counters=True)): a
+    counter-armed step on a sim built without them is refused by
+    name rather than silently miscounting adverts in flight."""
     import jax
     import go_libp2p_pubsub_tpu.models.telemetry as tl
     gs, cfg, params, state = _delayed_gossip_build()
@@ -1188,9 +1189,17 @@ _PROBE_REFUSALS = {
     "delays[rpc-probe]":
         (_probe_delays_rpc,
          r"delay-armed sims are not probe-supported"),
-    "delays[telemetry-counters]":
-        (_probe_delays_tel_counters,
-         r"telemetry counters group is not delay-supported"),
+    # round 19: the delays[telemetry-counters] refusal is LIFTED —
+    # send-side tallies ride delay_exchange and arrival-side RPC /
+    # duplicate accounting reads the dequeued advert + gossip
+    # observer lines (adv_line / gsp_line), so the counters group
+    # threads on all six paths with DelayConfig(1, 0, 1) bit-
+    # identical to the pre-delay step (tests/test_delays.py).  What
+    # remains is the build requirement: the observer lines must be
+    # allocated up front.
+    "delays[counters-observer-lines]":
+        (_probe_delays_counter_lines,
+         r"delay-armed telemetry counters need the", ValueError),
     "delays[kernel-iwant-spam]":
         (_probe_delays_kernel_iwant,
          r"sybil_iwant_spam stays XLA-only on the pallas step under "
@@ -1199,8 +1208,9 @@ _PROBE_REFUSALS = {
     # mode's arrival operands are per-receiver blocked operands (no
     # sender streams), so sharded_receive consumes them with no halo
     # and the trajectory stays bit-identical (tests/test_sharded.py).
-    # delays[telemetry-counters] above is RE-PINNED: it is a property
-    # of delay mode itself (per-class delay lines), not of sharding.
+    # delays[telemetry-counters] stayed RE-PINNED through round 18
+    # (a property of delay mode itself, not of sharding) and is
+    # lifted in round 19 via the observer delay lines above.
     # round 16: the tick-resident fused window's capability gaps —
     # every kernel_ticks_fused refusal named (the byte-bound ones
     # report the working set), plus the two composition refusals
@@ -1482,16 +1492,18 @@ def _check_sharded_transfer(log=None) -> list[str]:
         d=3, d_lo=2, d_hi=6, d_score=2, d_out=1, d_lazy=2,
         backoff_ticks=8)
     sc = gs.ScoreSimConfig()
-    # fully armed: scores + faults + delays + histogram telemetry
-    # (counters stay off — delays[telemetry-counters] is a refusal)
-    tcfg = tl.TelemetryConfig(counters=False, wire=False, mesh=False,
+    # fully armed: scores + faults + delays + counter/wire/histogram
+    # telemetry (round 19 — the counters group now threads under
+    # delays via the observer lines, so the transfer proof covers it)
+    tcfg = tl.TelemetryConfig(counters=True, wire=True, mesh=False,
                               scores=False, faults=False,
                               latency_hist=True, latency_buckets=4)
     subs, topic, origin, ticks = _inputs(T)
     params, state = gs.make_gossip_sim(
         cfg, subs, topic, origin, ticks, score_cfg=sc,
         fault_schedule=_fault_schedule(),
-        delays=DelayConfig(base=2, jitter=1, k_slots=4))
+        delays=DelayConfig(base=2, jitter=1, k_slots=4),
+        delays_counters=True)
     step = gs.make_gossip_step(cfg, sc, telemetry=tcfg)
     ref = str(jax.make_jaxpr(step)(params, state))
     mesh = pm.make_mesh(2)

@@ -160,6 +160,19 @@ def declared_matrix() -> list[dict]:
                     faults=True, batched=False, variant="delays"))
     out.append(dict(sim="randomsub", split=False, telemetry=False,
                     faults=True, batched=False, variant="delays"))
+    # round-19 delay-armed counter cases: the telemetry counters
+    # group threads under delays (send tallies in delay_exchange,
+    # arrival accounting off the dequeued adv_line/gsp_line observer
+    # lines) — combined faulted, split, and the flood/randomsub
+    # source-ring replay, all counter+wire-armed
+    out.append(dict(sim="gossipsub", split=False, telemetry=True,
+                    faults=True, batched=False, variant="delays"))
+    out.append(dict(sim="gossipsub", split=True, telemetry=True,
+                    faults=False, batched=False, variant="delays"))
+    out.append(dict(sim="floodsub", split=False, telemetry=True,
+                    faults=True, batched=False, variant="delays"))
+    out.append(dict(sim="randomsub", split=False, telemetry=True,
+                    faults=True, batched=False, variant="delays"))
     # round-14 variant cases: the whole-sim multi-chip surface
     # (parallel/sharded.py) — the carry-pinned GSPMD runner sequential
     # (faulted + delayed) and knob-batched, plus the shard_map kernel
@@ -442,13 +455,15 @@ def build_cases() -> list[AuditCase]:
                     return gs.make_gossip_sim(
                         cfg, subs, topic, origin, ticks, seed=r,
                         score_cfg=sc, delays=dc, delays_split=split,
+                        delays_counters=tel is not None,
                         fault_schedule=(audit_fault_schedule(r)
                                         if fsched else None),
                         sim_knobs=({"delay_base": 1 + r,
                                     "delay_jitter": r} if b
                                    else None))
 
-                step = gs.make_gossip_step(cfg, sc, force_split=split)
+                step = gs.make_gossip_step(cfg, sc, telemetry=tel,
+                                           force_split=split)
                 if b:
                     builds = [build_delay(r) for r in range(BATCH)]
                     params = gs.stack_trees([p for p, _ in builds])
@@ -456,7 +471,7 @@ def build_cases() -> list[AuditCase]:
                     runner = gs.gossip_run_knob_batch
                 else:
                     params, state = build_delay(0)
-                    runner = gs.gossip_run
+                    runner = tl.telemetry_run if tel else gs.gossip_run
                 args, statics = (params, state, TICKS, step), (2, 3)
             elif sim == "floodsub":
                 offs = tuple(int(o) for o in
@@ -465,8 +480,9 @@ def build_cases() -> list[AuditCase]:
                     None, None, subs, None, topic, origin, ticks,
                     fault_schedule=fsched, fault_offsets=offs,
                     delays=dc)
-                core = fs.make_circulant_step_core(offs)
-                runner = fs.flood_run_curve
+                core = fs.make_circulant_step_core(offs, telemetry=tel)
+                runner = (tl.telemetry_run_curve if tel
+                          else fs.flood_run_curve)
                 args, statics = ((params, state, TICKS, core, M),
                                  (2, 3, 4))
             else:   # randomsub
@@ -476,8 +492,8 @@ def build_cases() -> list[AuditCase]:
                 params, state = rs.make_randomsub_sim(
                     rcfg, subs, topic, origin, ticks,
                     fault_schedule=fsched, delays=dc)
-                step = rs.make_randomsub_step(rcfg)
-                runner = rs.randomsub_run
+                step = rs.make_randomsub_step(rcfg, telemetry=tel)
+                runner = tl.telemetry_run if tel else rs.randomsub_run
                 args, statics = (params, state, TICKS, step), (2, 3)
 
         elif variant == "sharded":

@@ -390,6 +390,35 @@ elif [ "$svrc" -ne 0 ]; then
   sync_log
   exit 13
 fi
+# 4l. service observability plane (round 19): the metrics/spans bench
+# — a real ``sweepd --multi --socket --metrics-port`` subprocess under
+# tools/loadgen.py's multi-process client fleet with MID-FLIGHT
+# /metrics.json scrapes (every scrape must satisfy the accounting
+# identity), the stats-vs-scrape cross-check over one connection, the
+# Chrome-trace span ledger (traces == admissions, one terminal event
+# each), and the delay-armed device-counter parity rows (the lifted
+# counters-group refusal: DelayConfig(1,0,1) bit-identical to the
+# undelayed counters) — then the obsstat gate over the artifact the
+# bench just wrote, vs the committed METRICS_r19.json
+run s4l 2700 python bench_suite.py gossipsub_metrics
+echo "=== obsstat --check gate ===" | tee -a "$log"
+env JAX_PLATFORMS=cpu python tools/obsstat.py \
+    /tmp/gossipsub_metrics.json \
+    --check METRICS_r19.json 2>&1 | tee -a "$log"
+obrc=${PIPESTATUS[0]}
+if [ "$obrc" -eq 2 ]; then
+  echo "!! obsstat gate failed — unusable metrics artifact (bench" \
+      "crashed, no scrape rows, or no span summary?)" | tee -a "$log"
+  sync_log
+  exit 14
+elif [ "$obrc" -ne 0 ]; then
+  echo "!! obsstat gate failed — a scrape broke the accounting" \
+      "identity, the span ledger lost a request, the fleet dropped a" \
+      "row, delay-armed counter parity broke, or fleet throughput" \
+      "fell below the baseline floor" | tee -a "$log"
+  sync_log
+  exit 14
+fi
 # 5. GSPMD overhead + diagnostics
 run s5a 1800 python tools/bench_sharded.py
 run s5b 1800 python tools/bench_micro.py 1000000 100

@@ -92,10 +92,11 @@ def main():
               f"ticks_fused={T}")
         print(f"{'resident carry / peer':34s} "
               f"{ws['carry_bytes_per_peer']:9d} B")
+        fits = ("FITS" if ws["vmem_bytes"] <= FUSED_VMEM_BUDGET
+                else "REFUSED: kernel_ticks_fused falls back by name")
         print(f"{'VMEM working set':34s} "
               f"{ws['vmem_bytes'] / 1e6:9.1f} MB  "
-              f"(budget {FUSED_VMEM_BUDGET / 1e6:.0f} MB — "
-              f"{'FITS' if ws['vmem_bytes'] <= FUSED_VMEM_BUDGET else 'REFUSED: kernel_ticks_fused falls back by name'})")
+              f"(budget {FUSED_VMEM_BUDGET / 1e6:.0f} MB — {fits})")
         print(f"{'window entry+exit HBM':34s} "
               f"{ws['entry_exit_bytes'] / 1e6:9.1f} MB  "
               f"(amortized over {T} ticks)")

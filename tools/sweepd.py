@@ -25,7 +25,12 @@ fields) — shape-bearing fields are rejected by name with the reason
 they must stay static (KnobStaticFieldError; the error comes back as
 the scenario's result row, it never kills the server).  Control lines:
 ``{"cmd": "flush"}`` dispatches a partial batch immediately,
-``{"cmd": "stats"}`` emits the counters row.  EOF flushes and exits.
+``{"cmd": "stats"}`` emits the counters row, ``{"cmd": "metrics"}``
+(round 19) emits the observability snapshot — the metric families plus
+the span summary; ``--metrics-port`` serves the same plane over
+loopback HTTP (Prometheus text at /metrics, JSON lines at
+/metrics.json, Chrome trace events at /trace.json).  EOF flushes and
+exits.
 
 Result rows (one JSON line per scenario, in completion order):
 
@@ -161,7 +166,7 @@ class SweepServer:
                  attack_pool_frac: float = 0.2,
                  victim_pool_frac: float = 0.1,
                  churn_pool_frac: float = 0.1, devices: int = 0,
-                 k_slots: int = 0):
+                 k_slots: int = 0, obs=None):
         import go_libp2p_pubsub_tpu.models.gossipsub as gs
         import go_libp2p_pubsub_tpu.models.invariants as iv
         from go_libp2p_pubsub_tpu.models.tournament import (
@@ -267,6 +272,30 @@ class SweepServer:
         self.batches = 0
         self.errors = 0
         self.wall_s = 0.0
+        # round 19: optional observability bundle (obs.Observability).
+        # Left None for embedded bucket servers — the multi-tenant
+        # front end publishes its own per-bucket serving_* families —
+        # and armed by main() so `--metrics-port` / the "metrics" verb
+        # expose the standalone server's counters
+        self.obs = obs
+        self._mx = None
+        if obs is not None:
+            m = obs.metrics
+            self._mx = {
+                "sweepd_served_total": lambda: self.served,
+                "sweepd_batches_total": lambda: self.batches,
+                "sweepd_errors_total": lambda: self.errors,
+            }
+            for name in self._mx:
+                m.counter(name)
+            self._g_compiles = m.gauge(
+                "sweepd_compiles",
+                "executables this server compiled (the claim: 1)")
+            self._g_device = m.gauge(
+                "sweepd_device_seconds",
+                "cumulative device-dispatch wall seconds")
+            self._g_pending = m.gauge(
+                "sweepd_pending", "scenarios accepted, not dispatched")
         self._pending: list[dict] = []
         #: raw journal lines parallel to _pending (round 15: the
         #: accepted-but-undispatched scenarios a crash must not lose)
@@ -469,7 +498,21 @@ class SweepServer:
                     row["inv_first"] = int(inv_first[k])
                 rows[i] = row
                 self.served += 1
+        self._publish_metrics()
         return rows  # type: ignore[return-value]
+
+    def _publish_metrics(self) -> None:
+        """Mirror the counters into the registry in one atomic block
+        (scrapes see all-or-nothing updates)."""
+        if self.obs is None:
+            return
+        m = self.obs.metrics
+        with m.atomic():
+            for name, read in self._mx.items():
+                m.counter(name).set_total(read())
+            self._g_compiles.set(self.compiles())
+            self._g_device.set(round(self.wall_s, 6))
+            self._g_pending.set(len(self._pending))
 
     # -- counters ------------------------------------------------------
 
@@ -538,12 +581,16 @@ class SweepServer:
                           "".join(ck.journal_encode_line(r) + "\n"
                                   for r in self._pending_raw))
 
-    def serve_lines(self, lines, out, *, journal=None) -> None:
+    def serve_lines(self, lines, out, *, journal=None,
+                    lock=None) -> None:
         """Drive the server from an iterable of JSON lines, writing
         result rows to ``out`` (a writable file object).  Requests
         accumulate to full batches; ``{"cmd": "flush"}`` dispatches a
-        partial batch, ``{"cmd": "stats"}`` emits counters.  EOF
-        flushes.
+        partial batch, ``{"cmd": "stats"}`` emits counters,
+        ``{"cmd": "metrics"}`` emits the round-19 registry snapshot
+        (needs an ``obs`` bundle).  EOF flushes.  ``lock`` (a shared
+        ``threading.RLock``) serializes line handling when several
+        connection threads drive ONE server (the --socket loop).
 
         Round 15 crash-hardening: with ``journal=PATH`` every accepted
         scenario line is appended (fsync'd) to PATH before it can be
@@ -556,8 +603,11 @@ class SweepServer:
         next line boundary: the in-flight bucket batch is dispatched,
         its rows and the final stats row are emitted, and serve_lines
         returns instead of reading further."""
+        import contextlib
+
         from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
 
+        lk = lock if lock is not None else contextlib.nullcontext()
         self._journal = journal
 
         def emit(obj):
@@ -597,15 +647,29 @@ class SweepServer:
             elif cmd == "stats":
                 flush()
                 emit(self.stats())
+            elif cmd == "metrics":
+                if self.obs is None:
+                    emit({"ok": False,
+                          "error": "metrics: this server carries no "
+                                   "observability bundle (construct "
+                                   "SweepServer with obs=, or drive "
+                                   "it through sweepd main())"})
+                else:
+                    self._publish_metrics()
+                    emit({"metrics": True,
+                          "families": self.obs.metrics.snapshot(),
+                          "spans": self.obs.spans.summary()})
             elif cmd:
                 self.errors += 1
                 emit({"ok": False,
-                      "error": f"unknown cmd {cmd!r} (flush/stats)"})
+                      "error": f"unknown cmd {cmd!r} "
+                               "(flush/stats/metrics)"})
             else:
                 self._pending.append(req)
                 self._pending_raw.append(raw)
                 if journal_new:
                     self._journal_append(raw)
+                self._publish_metrics()
                 if len(self._pending) >= self.batch:
                     flush()
 
@@ -623,24 +687,28 @@ class SweepServer:
                 print(f"sweepd: replaying {len(replay)} journaled "
                       "scenario line(s) from an interrupted run",
                       file=sys.stderr, flush=True)
-                for raw in replay:
-                    # already on disk: re-append would duplicate them
-                    handle(raw, journal_new=False)
-                # re-sync: a flush during the replay compacted away
-                # lines accepted after it, so rewrite the journal to
-                # exactly the surviving partial batch
-                self._journal_compact()
+                with lk:
+                    for raw in replay:
+                        # already on disk: re-append would duplicate
+                        # them
+                        handle(raw, journal_new=False)
+                    # re-sync: a flush during the replay compacted
+                    # away lines accepted after it, so rewrite the
+                    # journal to exactly the surviving partial batch
+                    self._journal_compact()
 
         for line in lines:
             line = line.strip()
             if line:
-                handle(line, journal_new=True)
+                with lk:
+                    handle(line, journal_new=True)
             if ck.stop_requested():
                 print("sweepd: stop requested — draining the pending "
                       "batch and exiting", file=sys.stderr, flush=True)
                 break
-        flush()
-        emit(self.stats())
+        with lk:
+            flush()
+            emit(self.stats())
 
 
 def _make_run_single():
@@ -693,7 +761,15 @@ def main(argv=None) -> int:
                          "delay_base/delay_jitter become servable "
                          "knobs, worst-case base+jitter <= K")
     ap.add_argument("--socket", metavar="PATH",
-                    help="serve a Unix socket instead of stdin")
+                    help="serve a Unix socket instead of stdin "
+                         "(round 19: thread-per-connection — several "
+                         "clients share the one resident server)")
+    ap.add_argument("--metrics-port", type=int, metavar="PORT",
+                    help="round 19: serve the observability plane "
+                         "over loopback HTTP — /metrics (Prometheus "
+                         "text), /metrics.json (JSON lines), "
+                         "/trace.json (Chrome trace events); 0 binds "
+                         "an ephemeral port (printed to stderr)")
     ap.add_argument("--journal", metavar="PATH",
                     help="fsync'd journal of accepted-but-"
                          "undispatched scenario lines; lines left in "
@@ -734,6 +810,12 @@ def main(argv=None) -> int:
     from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
     prev = ck.install_kill_handlers()
 
+    # round 19: one observability bundle for the process — the multi
+    # front end and the single-shape server both publish into it, and
+    # --metrics-port / the "metrics" verb read from it
+    from go_libp2p_pubsub_tpu import obs as _obs
+    obs = _obs.Observability()
+
     if ns.multi:
         if ns.kernel:
             print("sweepd: --multi refuses --kernel — the kernel-"
@@ -753,7 +835,7 @@ def main(argv=None) -> int:
             default_shape=(ns.peers, ns.topics, ns.msgs, ns.ticks),
             aot_dir=ns.aot_dir, long_ticks=ns.long_ticks,
             ckpt_dir=ns.ckpt_dir, ckpt_every=ns.ckpt_every,
-            server_kw=server_kw))
+            server_kw=server_kw), obs=obs)
     else:
         srv = SweepServer(n=ns.peers, t=ns.topics, m=ns.msgs,
                           ticks=ns.ticks,
@@ -761,7 +843,12 @@ def main(argv=None) -> int:
                           seed=ns.seed,
                           invariants=not ns.no_invariants,
                           kernel=ns.kernel, devices=ns.devices,
-                          k_slots=ns.k_slots)
+                          k_slots=ns.k_slots, obs=obs)
+    scrape = None
+    if ns.metrics_port is not None:
+        scrape = obs.scrape_server(port=ns.metrics_port)
+        print(f"sweepd: metrics at {scrape.url()}", file=sys.stderr,
+              flush=True)
     try:
         if ns.socket:
             import socket as sk
@@ -770,9 +857,32 @@ def main(argv=None) -> int:
                 os.unlink(ns.socket)
             except FileNotFoundError:
                 pass
+            import threading
+            # round 19: thread-per-connection — a shared RLock
+            # serializes line handling inside the ONE resident server
+            # while a fleet of clients (tools/loadgen.py) holds
+            # concurrent connections open
+            serve_lock = threading.RLock()
+            conn_threads: list = []
+
+            def serve_conn(conn):
+                try:
+                    with conn, conn.makefile("r") as rf, \
+                            conn.makefile("w") as wf:
+                        srv.serve_lines(rf, wf, journal=ns.journal,
+                                        lock=serve_lock)
+                except (BrokenPipeError, ConnectionResetError) as e:
+                    # a client vanishing mid-conversation must never
+                    # kill the resident server: its accepted lines are
+                    # journaled, the next client (or the restart
+                    # replay) picks them up
+                    print(f"sweepd: client disconnected "
+                          f"({e.__class__.__name__}) — server "
+                          "stays up", file=sys.stderr, flush=True)
+
             with sk.socket(sk.AF_UNIX, sk.SOCK_STREAM) as server_sock:
                 server_sock.bind(ns.socket)
-                server_sock.listen(1)
+                server_sock.listen(16)
                 # 1s accept timeout: the drain flag is polled between
                 # accepts, so a SIGTERM with no client connected still
                 # exits promptly
@@ -784,20 +894,14 @@ def main(argv=None) -> int:
                         conn, _ = server_sock.accept()
                     except TimeoutError:
                         continue
-                    try:
-                        with conn, conn.makefile("r") as rf, \
-                                conn.makefile("w") as wf:
-                            srv.serve_lines(rf, wf,
-                                            journal=ns.journal)
-                    except (BrokenPipeError, ConnectionResetError) \
-                            as e:
-                        # a client vanishing mid-conversation must
-                        # never kill the resident server: its accepted
-                        # lines are journaled, the next client (or the
-                        # restart replay) picks them up
-                        print(f"sweepd: client disconnected "
-                              f"({e.__class__.__name__}) — server "
-                              "stays up", file=sys.stderr, flush=True)
+                    th = threading.Thread(target=serve_conn,
+                                          args=(conn,), daemon=True)
+                    th.start()
+                    conn_threads.append(th)
+                    conn_threads = [t for t in conn_threads
+                                    if t.is_alive()]
+                for th in conn_threads:
+                    th.join(timeout=30)
             os.unlink(ns.socket)
             print("sweepd: drained — socket removed, exiting",
                   file=sys.stderr, flush=True)
@@ -805,6 +909,8 @@ def main(argv=None) -> int:
             srv.serve_lines(sys.stdin, sys.stdout,
                             journal=ns.journal)
     finally:
+        if scrape is not None:
+            scrape.close()
         ck._restore_handlers(prev)
     return 0
 

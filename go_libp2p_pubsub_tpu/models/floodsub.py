@@ -130,11 +130,10 @@ def make_flood_sim(nbrs: np.ndarray, nbr_mask: np.ndarray, subs: np.ndarray,
                 f"fault_schedule.n_peers={fault_schedule.n_peers} != "
                 f"sim peer count {n}")
         if fault_schedule.cold_restart:
-            raise ValueError(
-                "cold_restart: the floodsub simulator refuses "
-                "cold-restart schedules (a cold rejoiner has no "
-                "IHAVE/IWANT repair path to recover through) — "
-                "run it on the gossipsub simulator")
+            # the refusal string is defined once, in the capability
+            # planner (models/plan.py)
+            from .plan import MSG_FLOOD_COLD_RESTART
+            raise ValueError(MSG_FLOOD_COLD_RESTART)
         if nbrs is not None:
             fparams = _faults.compile_faults_gather(fault_schedule,
                                                     nbrs, nbr_mask)

@@ -125,6 +125,7 @@ from ._delivery import (
 )
 from . import delays as _delays
 from . import faults as _faults
+from . import plan as _plan
 from . import invariants as _invariants
 from . import knobs as _knobs
 from . import telemetry as _telemetry
@@ -783,6 +784,13 @@ class GossipState:
     # view, which the fused pay_line cannot reconstruct.  Allocated by
     # make_gossip_sim(..., delays_counters=True); None otherwise.
     adv_line: jnp.ndarray | None = None      # uint32 [K, C, W, N]
+    # round-20 delay-armed rpc_probe (the lifted registry hole): the
+    # three send-class attempt masks in flight (rows: eager-forward,
+    # IHAVE advert, publish-flood), observer-only — the probe
+    # snapshot's arrival leaves dequeue from it so the exporter can
+    # place RECVs at the true arrival tick.  Possession never reads
+    # it.  Allocated by make_gossip_sim(..., delays_probe=True).
+    probe_line: jnp.ndarray | None = None    # uint32 [K, 3, N]
 
 
 def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
@@ -807,7 +815,8 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
                     sim_knobs: dict | None = None,
                     delays: _delays.DelayConfig | None = None,
                     delays_split: bool = False,
-                    delays_counters: bool = False):
+                    delays_counters: bool = False,
+                    delays_probe: bool = False):
     """Build (params, state).  subs: bool [N, T] — but each peer may only
     subscribe to its residue-class topic (circulant classes are closed, so
     cross-class subscriptions would never receive anything).
@@ -871,7 +880,11 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
     by name).  ``delays_split=True`` additionally allocates the
     gossip-class delay line the SPLIT execution paths (track_p3 /
     force_split builds of make_gossip_step) need for mesh-vs-gossip
-    arrival provenance.
+    arrival provenance.  ``delays_counters=True`` allocates the
+    advert + gossip observer lines delay-armed telemetry counters
+    dequeue (round 19); ``delays_probe=True`` allocates the [K, 3, N]
+    probe line delay-armed ``rpc_probe`` builds dequeue their
+    ``arr_*`` arrival masks from (round 20).
     """
     n, t = subs.shape
     if t != cfg.n_topics:
@@ -1101,11 +1114,7 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
             # named capability gap (graftlint probe-refusal registry):
             # the two-mesh overlay would need per-slot payload and
             # ctrl delay lines plus delayed cross-slot routing
-            raise NotImplementedError(
-                "delays: paired-topic mode is not delay-supported "
-                "(per-slot delay lines and delayed cross-slot control "
-                "routing are not modeled); run delays on a "
-                "single-topic-per-peer config")
+            raise NotImplementedError(_plan.MSG_DELAYS_PAIRED)
         kw.update(delays=_delays.compile_delays(delays))
 
     if sim_knobs is not None:
@@ -1201,6 +1210,7 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
     # be live (the step derives the same predicate at trace time, so
     # the shapes agree).
     pay_line0 = ctrl_line0 = gsp_line0 = adv_line0 = None
+    probe_line0 = None
     if delays is not None:
         kd = int(delays.k_slots)
         has_cheat = (score_cfg is not None
@@ -1216,10 +1226,16 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
             gsp_line0 = jnp.zeros((kd, c, w, n), dtype=jnp.uint32)
         if delays_counters:
             adv_line0 = jnp.zeros((kd, c, w, n), dtype=jnp.uint32)
+        if delays_probe:
+            # round-20 probe lift: one packed [N] row per send class
+            # (eager-forward, IHAVE advert, publish-flood)
+            probe_line0 = jnp.zeros((kd, 3, n), dtype=jnp.uint32)
     elif delays_split:
         raise ValueError("delays_split=True needs a DelayConfig")
     elif delays_counters:
         raise ValueError("delays_counters=True needs a DelayConfig")
+    elif delays_probe:
+        raise ValueError("delays_probe=True needs a DelayConfig")
 
     state = GossipState(
         mesh=zbits(),
@@ -1260,7 +1276,7 @@ def make_gossip_sim(cfg: GossipSimConfig, subs: np.ndarray,
                    if cfg.paired_topics else None),
         active=active0,
         pay_line=pay_line0, ctrl_line=ctrl_line0, gsp_line=gsp_line0,
-        adv_line=adv_line0,
+        adv_line=adv_line0, probe_line=probe_line0,
     )
     # seed the gate pipeline: tick 0's gate words, exactly what the
     # step's epilogue would have emitted at the end of tick -1
@@ -1772,47 +1788,19 @@ def kernel_capability(cfg: GossipSimConfig, sc: ScoreSimConfig | None,
     the IWANT-spam attack config — its serve-budget multiply runs
     in-kernel from the baked constant, so a SimKnobs point on an
     iwant-spam config is refused by name (graftlint carries the
-    matching probe)."""
-    if (params.sim_knobs is not None and sc is not None
-            and sc.sybil_iwant_spam):
-        return ("sim_knobs: gossip_retransmission stays XLA-only on "
-                "the pallas step (the in-kernel IWANT serve budget "
-                "bakes it) — run iwant-spam knob sweeps on the XLA "
-                "path, or drop sybil_iwant_spam from the config")
-    if (params.delays is not None and sc is not None
-            and sc.sybil_iwant_spam):
-        # round-13 attack-heavy kernel corner (named refusal,
-        # graftlint probe): the in-kernel IWANT-flood budget reads
-        # the partner advert views the delayed kernel no longer
-        # streams (arrivals ride the delay line as one blocked
-        # operand instead)
-        return ("delays: sybil_iwant_spam stays XLA-only on the "
-                "pallas step under delays (the in-kernel flood "
-                "budget needs the partner advert views the delayed "
-                "kernel does not stream) — run iwant-spam delay "
-                "sweeps on the XLA path")
-    if (cfg.n_candidates > 16 or params.origin_words.shape[0] == 0
-            or params.flood_proto is not None
-            or state.gates is None
-            or (sc is not None
-                and ((sc.byzantine_mutation
-                      and params.cand_byz is not None)
-                     or sc.track_p3
-                     or (not params.static_score_zero
-                         and params.static_score_weights
-                         != (sc.app_specific_weight,
-                             sc.ip_colocation_factor_weight))))):
-        return ("config not supported by the pallas step (needs C<=16, "
-                "W>=1, carried gates, matching static score weights, "
-                "no flood_proto/track_p3/byzantine)")
-    return None
+    matching probe).
+
+    Since round 20 this is a thin call onto the capability planner
+    (models/plan.py) — every refusal string is defined THERE, once."""
+    verdict = _plan.plan_kernel_step(cfg, sc, params, state)
+    return (None if isinstance(verdict, _plan.ExecutionPlan)
+            else verdict.message)
 
 
-#: VMEM the fused window's resident carry may claim (input pair +
-#: revisited output pair + per-tick stream double-buffers).  Sized
-#: under the v5e 128 MiB/core arena with headroom for Mosaic's own
-#: scratch; the refusal reports the computed working set against it.
-FUSED_VMEM_BUDGET = 96 * 1024 * 1024
+#: VMEM the fused window's resident carry may claim — defined by the
+#: capability planner (models/plan.py), re-exported for the existing
+#: call sites.
+FUSED_VMEM_BUDGET = _plan.FUSED_VMEM_BUDGET
 
 
 def kernel_ticks_fused_capability(
@@ -1837,113 +1825,16 @@ def kernel_ticks_fused_capability(
     halo slots must fit, the shard extent must hold whole lane tiles,
     and the candidate reach must stay inside the ``devices``-shard
     ring — each refused by name; delay-armed sims keep the existing
-    per-tick refusal (the K-slot dequeue runs between kernel ticks)."""
-    from ..ops.pallas.receive import (
-        FUSED_ALIGN, FUSED_SHARD_TILE, fused_halo_spec,
-        fused_working_set_bytes)
+    per-tick refusal (the K-slot dequeue runs between kernel ticks).
 
-    if ticks < 1:
-        return ("kernel_ticks_fused: window must be >= 1 tick "
-                f"(got {ticks})")
-    base = kernel_capability(cfg, sc, params, state)
-    if base is not None:
-        return "kernel_ticks_fused: " + base
-    if params.n_true is None:
-        return ("kernel_ticks_fused: needs the padded pallas layout "
-                "(make_gossip_sim(pad_to_block=...))")
-    if sc is not None:
-        extra = 0
-        if state.scores is not None:
-            for leaf in jax.tree_util.tree_leaves(state.scores):
-                extra += int(leaf.size) * leaf.dtype.itemsize
-        return ("kernel_ticks_fused: scored configs stay per-tick — "
-                f"the [C, N] score accumulators add {extra} bytes to "
-                "the resident carry and the gater draw needs the "
-                "start-of-tick score pass; run scored sims on the "
-                "per-tick kernel")
-    if cfg.paired_topics:
-        return ("kernel_ticks_fused: paired-topic overlays stay "
-                "per-tick (the slot-B mesh/backoff carry doubles the "
-                "resident working set)")
-    if params.delays is not None:
-        extra = 0
-        for line in (state.pay_line, state.ctrl_line, state.gsp_line,
-                     state.adv_line):
-            if line is not None:
-                extra += int(line.size) * line.dtype.itemsize
-        return ("kernel_ticks_fused: delay-armed sims stay per-tick — "
-                f"the K-slot delay lines add {extra} bytes of resident "
-                "carry and the dequeue runs in the XLA prologue "
-                "between kernel ticks")
-    if params.sim_knobs is not None:
-        return ("kernel_ticks_fused: knob-carrying sims stay per-tick "
-                "(the degree-family knobs are consumed in the XLA "
-                "prologue the fused window elides)")
-    if state.active is not None:
-        return ("kernel_ticks_fused: px candidate rotation stays "
-                "per-tick (the rotation re-emits the targets gate in "
-                "the XLA epilogue between kernel ticks)")
-    if params.cand_direct is not None:
-        return ("kernel_ticks_fused: direct-peer overlays stay "
-                "per-tick (direct edges rewrite the ctrl pack in the "
-                "XLA prologue)")
-    n_pad = params.subscribed.shape[0]
-    if params.n_true != n_pad:
-        return ("kernel_ticks_fused: needs n_true == n_pad (the "
-                "resident whole-ring lane rolls wrap at the padded "
-                "length) — pick n divisible by the block so "
-                "pad_to_block adds nothing")
-    if not sharded and params.n_true % FUSED_ALIGN != 0:
-        # single-device whole-ring lane rolls wrap at the u32 DMA
-        # tile; the sharded path's constraint is per-SHARD (whole
-        # 128-lane tiles, checked below) — the composition can admit
-        # rings the single-device window refuses
-        return ("kernel_ticks_fused: needs n_true % "
-                f"{FUSED_ALIGN} == 0 (u32 lane-roll tile); got "
-                f"{params.n_true}")
-    D = int(devices) if sharded else 1
-    if sharded:
-        if D < 2:
-            return ("kernel_ticks_fused: sharded windows need a "
-                    f"known device count >= 2 (got devices={D}) — "
-                    "pass the mesh extent through the dispatch")
-        if params.n_true % D != 0:
-            return ("kernel_ticks_fused: sharded windows need "
-                    f"n_true divisible by devices={D}; got "
-                    f"{params.n_true}")
-        S = params.n_true // D
-        if S % FUSED_SHARD_TILE != 0:
-            return ("kernel_ticks_fused: sharded windows need whole "
-                    f"{FUSED_SHARD_TILE}-lane tiles per shard "
-                    f"(S % {FUSED_SHARD_TILE} == 0); got S={S} at "
-                    f"n={params.n_true}, devices={D}")
-        try:
-            fused_halo_spec(cfg.offsets, S, D)
-        except ValueError as e:
-            return str(e)
-    W = state.have.shape[0]
-    lat_b = 0
-    ws = fused_working_set_bytes(
-        cfg.n_candidates, W, cfg.history_gossip, params.n_true,
-        ticks=ticks, lat_buckets=lat_b,
-        with_faults=params.faults is not None,
-        cold_restart=(params.faults is not None
-                      and params.faults.cold_restart),
-        with_telemetry=False,
-        devices=D, offsets=(cfg.offsets if sharded else None))
-    if ws["vmem_bytes"] > vmem_budget_bytes:
-        return ("kernel_ticks_fused: resident carry past the VMEM "
-                f"budget — working set {ws['vmem_bytes']} bytes "
-                f"(carry {ws['carry_bytes']} B x 2 resident pairs + "
-                f"static {ws['static_bytes']} B + per-tick buffers"
-                + (f" + halo/stage {ws['halo_bytes'] + ws['stage_bytes']} B"
-                   if D > 1 else "")
-                + f") > budget {vmem_budget_bytes} B at "
-                f"n={params.n_true}, C={cfg.n_candidates}, W={W}"
-                + (f", devices={D} (per-shard)" if D > 1 else "")
-                + " — shard the sim over more chips or run the "
-                "per-tick kernel")
-    return None
+    Since round 20 this is a thin call onto the capability planner
+    (models/plan.py) — every refusal string is defined THERE, once."""
+    verdict = _plan.plan_fused_window(
+        cfg, sc, params, state, ticks,
+        vmem_budget_bytes=vmem_budget_bytes, sharded=sharded,
+        devices=devices)
+    return (None if isinstance(verdict, _plan.ExecutionPlan)
+            else verdict.message)
 
 
 def make_gossip_step(cfg: GossipSimConfig,
@@ -1973,8 +1864,10 @@ def make_gossip_step(cfg: GossipSimConfig,
     data is a pure READOUT (the state trajectory is bit-identical) and
     works on both execution paths; paired-topic overlays are
     probe-supported since round 13 (per-slot masks + slot-split
-    payload in the snapshot); mixed-protocol overlays and delay-armed
-    sims are not (they raise by name).
+    payload in the snapshot); delay-armed sims are probe-supported
+    since round 20 (build with ``delays_probe=True`` — the snapshot
+    gains ``arr_*`` arrival masks dequeued from a K-slot probe line);
+    mixed-protocol overlays are not (they raise by name).
 
     With ``telemetry`` (models/telemetry.py) the step instead returns
     ``(state, delivered_words, TelemetryFrame)`` — per-tick protocol
@@ -2046,7 +1939,9 @@ def make_gossip_step(cfg: GossipSimConfig,
     # per-slot GRAFT/PRUNE topics and a slot-split IHAVE.  The ONE
     # remaining probe refusal is MIXED-PROTOCOL overlays (flood_proto,
     # raised at trace time in the step where the params are visible);
-    # delay-armed sims also refuse the probe (see the delays block).
+    # delay-armed sims are probe-supported since round 20 (the
+    # snapshot's arrival leaves dequeue from the K-slot probe line —
+    # build with delays_probe=True).
 
     # random-k selection backend.  The mosaic kernel (bit-identical
     # output) is kept as an option, but measured inside the real scanned
@@ -2487,7 +2382,9 @@ def make_gossip_step(cfg: GossipSimConfig,
                        else state.ctrl_line),
             gsp_line=(dex["gsp_line"] if with_dl else state.gsp_line),
             adv_line=(dex["adv_line"] if with_dl
-                      else state.adv_line))
+                      else state.adv_line),
+            probe_line=(dex["probe_line"] if with_dl
+                        else state.probe_line))
         if icfg is not None:
             new_state = apply_invariants(
                 params, state, new_state, have_pre, rejoin_w,
@@ -2712,18 +2609,13 @@ def make_gossip_step(cfg: GossipSimConfig,
         dl = params.delays
         if dl is not None:
             if paired:
-                raise NotImplementedError(
-                    "delays: paired-topic mode is not delay-supported "
-                    "(per-slot delay lines and delayed cross-slot "
-                    "control routing are not modeled); run delays on "
-                    "a single-topic-per-peer config")
-            if rpc_probe:
-                raise NotImplementedError(
-                    "rpc_probe: delay-armed sims are not "
-                    "probe-supported (the per-RPC reconstruction "
-                    "pairs SEND and RECV in one tick and cannot "
-                    "place in-flight delay slots); capture RPC "
-                    "streams on a delays=None build")
+                raise NotImplementedError(_plan.MSG_DELAYS_PAIRED)
+            if rpc_probe and state.probe_line is None:
+                # round-20 lift: the probe is a pure readout, so the
+                # snapshot's arrival leaves ride their own K-slot
+                # probe line (the round-19 counter-tap move) — what
+                # remains is the build requirement for that line
+                raise ValueError(_plan.MSG_DELAYS_NEED_PROBE_LINE)
             if tel is not None and tel.counters:
                 # round-19 lift: send-side RPC tallies count at the
                 # SEND tick inside delay_exchange, receiver-side
@@ -2733,20 +2625,12 @@ def make_gossip_step(cfg: GossipSimConfig,
                 # per-class views the fused payload line merges away.
                 if state.adv_line is None or state.gsp_line is None:
                     raise ValueError(
-                        "delay-armed telemetry counters need the "
-                        "advert + gossip observer delay lines: build "
-                        "the sim with make_gossip_sim(..., "
-                        "delays=DelayConfig(...), "
-                        "delays_counters=True)")
+                        _plan.MSG_DELAYS_NEED_COUNTER_LINES)
             if state.pay_line is None or state.ctrl_line is None:
-                raise ValueError(
-                    "delay-armed params need delay-line state: build "
-                    "(params, state) together through "
-                    "make_gossip_sim(..., delays=DelayConfig(...))")
+                raise ValueError(_plan.MSG_DELAYS_NEED_LINES)
         if kernel_on:
             if params.n_true is None:
-                raise ValueError(
-                    "pallas step needs make_gossip_sim(pad_to_block=...)")
+                raise ValueError(_plan.MSG_KERNEL_NEEDS_PAD)
             # capability dispatch: faults and telemetry run IN the
             # kernel now; anything genuinely unsupported raises the
             # same message-matched refusal as before
@@ -2754,9 +2638,7 @@ def make_gossip_step(cfg: GossipSimConfig,
             if reason is not None:
                 raise ValueError(reason)
         elif params.n_true is not None:
-            raise ValueError(
-                "padded sim state requires the pallas step (XLA rolls "
-                "would wrap at the padded length)")
+            raise ValueError(_plan.MSG_XLA_PADDED_STATE)
         # per-phase uniform fields from the counter-based lane hash (the
         # carried PRNG key's last word is the run seed; threefry per tick
         # would dominate the elementwise cost of the whole step).  The
@@ -3512,23 +3394,41 @@ def make_gossip_step(cfg: GossipSimConfig,
                 retract = (retract & f_alive_all) | (grafts
                                                      & ~f_send_ok)
             retract = retract | retr_arr
+
+            # ---- probe line (round-20 lift): the three send-class
+            # attempt masks ride their own observer line, receiver-
+            # indexed like the ctrl rows, so the probe snapshot can
+            # place RECVs at the true arrival tick.  Post-fault sends
+            # only (a fault-cut RPC never enters the network); pure
+            # readout — possession never reads the dequeue.
+            probe_line, arr_probe = state.probe_line, None
+            if rpc_probe and state.probe_line is not None:
+                fwd_fly = transfer_t(out_bits)
+                adv_fly = transfer_t(targets)
+                flood_fly = (transfer_t(flood_bits)
+                             if flood_bits is not None
+                             else jnp.zeros_like(fwd_fly))
+                probe_line = state.probe_line | jnp.stack(
+                    [jnp.stack([fwd_fly & slot_sel[s],
+                                adv_fly & slot_sel[s],
+                                flood_fly & slot_sel[s]])
+                     for s in range(K)])
+                arr_probe, probe_line = _delays.line_dequeue(
+                    probe_line, tick)
+
             return dict(arr_pay=arr_pay, arr_gsp=arr_gsp,
                         pay_line=pay_line, gsp_line=gsp_line,
                         ctrl_line=ctrl_line, graft_arr=graft_arr,
                         prune_arr=prune_arr, retract=retract,
                         cheat_arr=cheat_arr, violation=violation,
                         accept=accept, tel_send=tel_send,
-                        arr_adv=arr_adv, adv_line=adv_line)
+                        arr_adv=arr_adv, adv_line=adv_line,
+                        probe_line=probe_line, arr_probe=arr_probe)
 
         rpc_snap = None
         if rpc_probe:
             if params.flood_proto is not None:
-                raise NotImplementedError(
-                    "rpc_probe: mixed-protocol overlays are not "
-                    "probe-supported (floodsub-proto flooding rides "
-                    "outside the captured edge masks).  Remaining "
-                    "probe refusals: mixed-protocol (flood_proto) "
-                    "overlays, delay-armed sims")
+                raise NotImplementedError(_plan.MSG_PROBE_MIXED_PROTOCOL)
 
             def stk(rows):
                 return (jnp.stack(rows) if W
@@ -3578,6 +3478,15 @@ def make_gossip_step(cfg: GossipSimConfig,
                           else neg_px | sel_b["neg"])
             dex_k = (delay_exchange(split=False) if dl is not None
                      else None)
+            if rpc_probe and dex_k is not None:
+                # round-20 lift: arrival-side masks dequeued from the
+                # probe/ctrl lines, so the exporter can place RECVs
+                rpc_snap.update(
+                    arr_fwd=dex_k["arr_probe"][0],
+                    arr_ihave=dex_k["arr_probe"][1],
+                    arr_flood=dex_k["arr_probe"][2],
+                    arr_graft=dex_k["graft_arr"],
+                    arr_prune=dex_k["prune_arr"])
             outk = _finish_kernel(
                 dex=dex_k,
                 params=params, state=state, fanout=fanout,
@@ -3652,12 +3561,17 @@ def make_gossip_step(cfg: GossipSimConfig,
         dex = None
         if dl is not None:
             if not combined and state.gsp_line is None:
-                raise ValueError(
-                    "the split execution path under delays needs the "
-                    "gossip-class delay line: build the sim with "
-                    "make_gossip_sim(..., delays=..., "
-                    "delays_split=True)")
+                raise ValueError(_plan.MSG_DELAYS_NEED_SPLIT_LINE)
             dex = delay_exchange(split=not combined)
+            if rpc_snap is not None:
+                # round-20 lift: arrival-side masks dequeued from the
+                # probe/ctrl lines, so the exporter can place RECVs
+                rpc_snap.update(
+                    arr_fwd=dex["arr_probe"][0],
+                    arr_ihave=dex["arr_probe"][1],
+                    arr_flood=dex["arr_probe"][2],
+                    arr_graft=dex["graft_arr"],
+                    arr_prune=dex["prune_arr"])
             if tel_acc is not None:
                 # sender-side tallies counted at the SEND tick inside
                 # delay_exchange; the arrival loops below add the
@@ -4406,7 +4320,9 @@ def make_gossip_step(cfg: GossipSimConfig,
             gsp_line=(dex["gsp_line"] if dex is not None
                       else state.gsp_line),
             adv_line=(dex["adv_line"] if dex is not None
-                      else state.adv_line))
+                      else state.adv_line),
+            probe_line=(dex["probe_line"] if dex is not None
+                        else state.probe_line))
         if state.gates is not None:
             # emit the NEXT tick's gate words now, while the updated
             # counters are live in registers (XLA fuses the score math
@@ -4568,7 +4484,7 @@ def make_fused_window(cfg: GossipSimConfig,
     tel = telemetry
     T = int(ticks_fused)
     if T < 1:
-        raise ValueError(f"ticks_fused must be >= 1 (got {T})")
+        raise ValueError(_plan.msg_fused_window(T))
     shard_D = (int(shard_mesh.shape[shard_axis])
                if shard_mesh is not None else 1)
     step = make_gossip_step(cfg, sc, receive_block=receive_block,
@@ -4839,11 +4755,7 @@ def gossip_run_curve(params: GossipParams, state: GossipState, n_ticks: int,
 
 def _check_fused_horizon(n_ticks: int, ticks_fused: int) -> int:
     if n_ticks % ticks_fused != 0:
-        raise ValueError(
-            f"scan horizon not divisible by the fused window: "
-            f"n_ticks={n_ticks}, ticks_fused={ticks_fused} — pick a "
-            "horizon that is a multiple of the window (or a window "
-            "that divides it)")
+        raise ValueError(_plan.msg_fused_horizon(n_ticks, ticks_fused))
     return n_ticks // ticks_fused
 
 

@@ -1,0 +1,686 @@
+"""The capability planner (round 20): one ``ExecutionPlan`` or one
+named ``Refusal`` — the machine-checked form of the capability matrix.
+
+Before this module the six execution paths × {faults, telemetry,
+delays, attacks, knobs, sharding, fusion, checkpointing, serving}
+feature lattice was dispatched by hand-written capability ladders
+scattered through ``models/gossipsub.py`` (``kernel_capability``,
+``kernel_ticks_fused_capability``), ``tools/sweepd.py``
+(``server_capability``), the step closure's inline delay/probe raises,
+and the mesh-less simulators' build-time rejects — every refusal
+string owned by whichever file happened to raise it.  This module is
+now the ONE definition site: every refusal the repo's capability
+surface can produce is a ``Refusal`` built here, with a stable
+machine-readable ``code``, and the legacy capability functions are
+thin calls onto the planner faces below.  The graftlint pass
+``tools/graftlint/planaudit.py`` exhaustively enumerates the lattice
+and cross-checks every planner verdict against reality: a PLAN cell
+must trace (``jax.make_jaxpr`` / ``eval_shape``, never executing a
+tick) with the plan's declared primitives present and its forbidden
+primitives absent; a REFUSE cell must raise the planner's EXACT
+string from the real entry point.  The verdicts are committed as the
+golden matrix ``PLAN_r19.json`` behind the ``tools/planstat.py
+--check`` gate.
+
+Planner faces (all return ``ExecutionPlan | Refusal``):
+
+- ``plan_kernel_step``   the per-tick pallas step (the old
+                         ``kernel_capability`` ladder)
+- ``plan_fused_window``  the tick-resident window, single-device or
+                         sharded, optionally composed with a
+                         checkpoint segmentation (the old
+                         ``kernel_ticks_fused_capability`` ladder +
+                         the ckpt mid-window boundary reject)
+- ``plan_gossip_step``   the XLA/kernel step dispatch incl. the
+                         delay-line build requirements and the
+                         rpc-probe composition cells
+- ``plan_circulant``     the mesh-less simulators (floodsub /
+                         randomsub, circulant and gather/dense forms)
+- ``plan_serving``       the sweepd execution-path choices (the old
+                         ``server_capability``)
+- ``plan_execution``     the single front door that routes a full
+                         request (config, score config, knobs, delays,
+                         faults, invariants, telemetry, shard spec,
+                         fusion window, checkpoint config, serving
+                         spec) to the face that owns it
+
+Refusal strings are message-matched by tests and by graftlint's
+probe-refusal registry — keep them stable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+__all__ = [
+    "ExecutionPlan",
+    "Refusal",
+    "OperandLayout",
+    "CheckpointSegmentation",
+    "FUSED_VMEM_BUDGET",
+    "PATHS",
+    "plan_kernel_step",
+    "plan_fused_window",
+    "plan_gossip_step",
+    "plan_circulant",
+    "plan_serving",
+    "plan_execution",
+]
+
+#: the six execution paths of the contract tables
+#: (tools/graftlint/contracts.py PATHS order)
+PATHS = ("gossip-xla", "gossip-kernel", "flood-circulant",
+         "flood-gather", "randomsub-circulant", "randomsub-dense")
+
+#: VMEM the fused window's resident carry may claim (input pair +
+#: revisited output pair + per-tick stream double-buffers).  Sized
+#: under the v5e 128 MiB/core arena with headroom for Mosaic's own
+#: scratch; the refusal reports the computed working set against it.
+FUSED_VMEM_BUDGET = 96 * 1024 * 1024
+
+
+@dataclasses.dataclass(frozen=True)
+class Refusal:
+    """Exactly one named reason this request cannot be planned.
+
+    code: stable machine-readable slug (the golden matrix key —
+        renames are planstat regressions).
+    message: THE refusal string the real entry point raises, verbatim
+        (message-matched by tests and graftlint probes).
+    exc: the exception class the entry point raises it as.
+    """
+
+    code: str
+    message: str
+    exc: type = ValueError
+
+
+@dataclasses.dataclass(frozen=True)
+class OperandLayout:
+    """The plan's operand layout: how the carried state is shaped for
+    the chosen path."""
+
+    padded: bool = False            # pallas pad_to_block layout
+    n_true: int | None = None       # true ring length (padded layouts)
+    n_pad: int | None = None        # padded length (= n_true when
+    #                                 residency requires no pad lanes)
+    delay_k_slots: int = 0          # K-slot delay-line depth (0 = off)
+    shard_devices: int = 1          # peer-axis mesh extent
+    shard_extent: int | None = None  # per-shard peer count (S)
+    batch: int = 1                  # batched-dispatch width (serving)
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointSegmentation:
+    """The plan's checkpoint segmentation: segment length in ticks and
+    the window alignment it must respect (snapshots land between
+    device dispatches, never mid-window)."""
+
+    every: int = 0                  # 0 = one segment spans the horizon
+    align: int = 1                  # segment length must be a multiple
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecutionPlan:
+    """The planner's positive verdict: the path, the operand layout,
+    the checkpoint segmentation, and the jaxpr primitives the traced
+    program must (and must not) contain — planaudit's cross-check."""
+
+    path: str
+    layout: OperandLayout = dataclasses.field(
+        default_factory=OperandLayout)
+    segmentation: CheckpointSegmentation | None = None
+    primitives: tuple = ()          # must appear in the traced jaxpr
+    forbidden: tuple = ()           # must NOT appear
+
+
+# --------------------------------------------------------------------------
+# Refusal definition sites.  Fixed strings are module constants;
+# parameterized strings are tiny builders right next to them.  Nothing
+# else in the repo may define these strings.
+# --------------------------------------------------------------------------
+
+# -- per-tick pallas step (the kernel_capability surface) ------------------
+
+MSG_KERNEL_KNOB_IWANT_SPAM = (
+    "sim_knobs: gossip_retransmission stays XLA-only on the pallas "
+    "step (the in-kernel IWANT serve budget bakes it) — run "
+    "iwant-spam knob sweeps on the XLA path, or drop sybil_iwant_spam "
+    "from the config")
+
+MSG_KERNEL_DELAY_IWANT_SPAM = (
+    "delays: sybil_iwant_spam stays XLA-only on the pallas step under "
+    "delays (the in-kernel flood budget needs the partner advert "
+    "views the delayed kernel does not stream) — run iwant-spam delay "
+    "sweeps on the XLA path")
+
+MSG_KERNEL_CONFIG = (
+    "config not supported by the pallas step (needs C<=16, W>=1, "
+    "carried gates, matching static score weights, no "
+    "flood_proto/track_p3/byzantine)")
+
+MSG_KERNEL_NEEDS_PAD = (
+    "pallas step needs make_gossip_sim(pad_to_block=...)")
+
+MSG_XLA_PADDED_STATE = (
+    "padded sim state requires the pallas step (XLA rolls would wrap "
+    "at the padded length)")
+
+# -- step-closure delay / probe dispatch -----------------------------------
+
+MSG_DELAYS_PAIRED = (
+    "delays: paired-topic mode is not delay-supported (per-slot delay "
+    "lines and delayed cross-slot control routing are not modeled); "
+    "run delays on a single-topic-per-peer config")
+
+MSG_PROBE_MIXED_PROTOCOL = (
+    "rpc_probe: mixed-protocol overlays are not probe-supported "
+    "(floodsub-proto flooding rides outside the captured edge "
+    "masks).  Remaining probe refusals: mixed-protocol (flood_proto) "
+    "overlays")
+
+MSG_DELAYS_NEED_LINES = (
+    "delay-armed params need delay-line state: build (params, state) "
+    "together through make_gossip_sim(..., delays=DelayConfig(...))")
+
+MSG_DELAYS_NEED_COUNTER_LINES = (
+    "delay-armed telemetry counters need the advert + gossip observer "
+    "delay lines: build the sim with make_gossip_sim(..., "
+    "delays=DelayConfig(...), delays_counters=True)")
+
+MSG_DELAYS_NEED_SPLIT_LINE = (
+    "the split execution path under delays needs the gossip-class "
+    "delay line: build the sim with make_gossip_sim(..., delays=..., "
+    "delays_split=True)")
+
+#: round 20 — the delays × rpc_probe registry hole is LIFTED: the
+#: probe snapshot threads through a K-slot probe delay line (the
+#: round-19 counter-tap move), and what remains is the build
+#: requirement for that line, named here.
+MSG_DELAYS_NEED_PROBE_LINE = (
+    "delay-armed rpc_probe needs the probe delay line: build the sim "
+    "with make_gossip_sim(..., delays=DelayConfig(...), "
+    "delays_probe=True)")
+
+# -- tick-resident fused window (kernel_ticks_fused_capability) ------------
+
+_FUSED = "kernel_ticks_fused: "
+
+
+def msg_fused_window(ticks) -> str:
+    # pinned pre-prefix by tests/test_fused_kernel.py — the one
+    # refusal of the fused face that predates the kernel_ticks_fused
+    # prefix convention (it is a plain argument error at window build)
+    return f"ticks_fused must be >= 1 (got {int(ticks)})"
+
+
+def msg_fused_horizon(n_ticks, ticks_fused) -> str:
+    return (f"scan horizon not divisible by the fused window: "
+            f"n_ticks={int(n_ticks)}, ticks_fused={int(ticks_fused)} "
+            "— pick a horizon that is a multiple of the window (or a "
+            "window that divides it)")
+
+
+def msg_fused_base(base_message: str) -> str:
+    """A per-tick kernel refusal, surfaced through the fused face."""
+    return _FUSED + base_message
+
+
+MSG_FUSED_UNPADDED = (_FUSED + "needs the padded pallas layout "
+                      "(make_gossip_sim(pad_to_block=...))")
+
+
+def msg_fused_scored(extra_bytes: int) -> str:
+    return (_FUSED + "scored configs stay per-tick — the [C, N] score "
+            f"accumulators add {int(extra_bytes)} bytes to the "
+            "resident carry and the gater draw needs the "
+            "start-of-tick score pass; run scored sims on the "
+            "per-tick kernel")
+
+
+MSG_FUSED_PAIRED = (
+    _FUSED + "paired-topic overlays stay per-tick (the slot-B "
+    "mesh/backoff carry doubles the resident working set)")
+
+
+def msg_fused_delays(extra_bytes: int) -> str:
+    return (_FUSED + "delay-armed sims stay per-tick — the K-slot "
+            f"delay lines add {int(extra_bytes)} bytes of resident "
+            "carry and the dequeue runs in the XLA prologue between "
+            "kernel ticks")
+
+
+MSG_FUSED_KNOBS = (
+    _FUSED + "knob-carrying sims stay per-tick (the degree-family "
+    "knobs are consumed in the XLA prologue the fused window elides)")
+
+MSG_FUSED_PX = (
+    _FUSED + "px candidate rotation stays per-tick (the rotation "
+    "re-emits the targets gate in the XLA epilogue between kernel "
+    "ticks)")
+
+MSG_FUSED_DIRECT = (
+    _FUSED + "direct-peer overlays stay per-tick (direct edges "
+    "rewrite the ctrl pack in the XLA prologue)")
+
+MSG_FUSED_PAD_MISMATCH = (
+    _FUSED + "needs n_true == n_pad (the resident whole-ring lane "
+    "rolls wrap at the padded length) — pick n divisible by the "
+    "block so pad_to_block adds nothing")
+
+
+def msg_fused_align(n_true: int, align: int) -> str:
+    return (_FUSED + f"needs n_true % {int(align)} == 0 (u32 "
+            f"lane-roll tile); got {int(n_true)}")
+
+
+def msg_fused_shard_devices(devices: int) -> str:
+    return (_FUSED + "sharded windows need a known device count >= 2 "
+            f"(got devices={int(devices)}) — pass the mesh extent "
+            "through the dispatch")
+
+
+def msg_fused_shard_divisible(n_true: int, devices: int) -> str:
+    return (_FUSED + "sharded windows need n_true divisible by "
+            f"devices={int(devices)}; got {int(n_true)}")
+
+
+def msg_fused_shard_tile(n_true, devices, shard, tile) -> str:
+    return (_FUSED + f"sharded windows need whole {int(tile)}-lane "
+            f"tiles per shard (S % {int(tile)} == 0); got "
+            f"S={int(shard)} at n={int(n_true)}, devices="
+            f"{int(devices)}")
+
+
+def msg_fused_vmem(ws: dict, budget: int, n_true, n_cand, n_words,
+                   devices: int) -> str:
+    return (_FUSED + "resident carry past the VMEM budget — working "
+            f"set {ws['vmem_bytes']} bytes (carry {ws['carry_bytes']} "
+            "B x 2 resident pairs + static "
+            f"{ws['static_bytes']} B + per-tick buffers"
+            + (f" + halo/stage {ws['halo_bytes'] + ws['stage_bytes']} B"
+               if devices > 1 else "")
+            + f") > budget {int(budget)} B at n={int(n_true)}, "
+            f"C={int(n_cand)}, W={int(n_words)}"
+            + (f", devices={int(devices)} (per-shard)"
+               if devices > 1 else "")
+            + " — shard the sim over more chips or run the per-tick "
+            "kernel")
+
+
+def msg_ckpt_mid_window(every: int, ticks_fused: int) -> str:
+    return ("ckpt segment boundary mid-window: CheckpointConfig."
+            f"every={int(every)} is not a multiple of "
+            f"ticks_fused={int(ticks_fused)} — align the segment "
+            "length to the fused window")
+
+
+# -- serving (server_capability) -------------------------------------------
+
+MSG_SERVE_KERNEL_BATCH = (
+    "kernel-path sweepd serves scenarios sequentially (no vmap rule "
+    "for the pallas step): use batch=1")
+
+MSG_SERVE_KERNEL_DEVICES = (
+    "sweepd: --devices shards the batched XLA dispatch; the "
+    "kernel-path server is the sequential demonstration — drive the "
+    "sharded kernel through make_gossip_step(shard_mesh=...) "
+    "directly instead")
+
+# -- mesh-less simulators (build-time rejects) -----------------------------
+
+MSG_FLOOD_COLD_RESTART = (
+    "cold_restart: the floodsub simulator refuses cold-restart "
+    "schedules (a cold rejoiner has no IHAVE/IWANT repair path to "
+    "recover through) — run it on the gossipsub simulator")
+
+MSG_RANDOMSUB_COLD_RESTART = (
+    "cold_restart: the randomsub simulator refuses cold-restart "
+    "schedules (a cold rejoiner has no IHAVE/IWANT repair path to "
+    "recover through) — run it on the gossipsub simulator")
+
+
+# --------------------------------------------------------------------------
+# Declared jaxpr primitives per path (planaudit's trace cross-check)
+# --------------------------------------------------------------------------
+
+#: the per-tick pallas step and the fused window lower to pallas_call;
+#: the fused SHARDED composition additionally carries the in-kernel
+#: remote-DMA ring halo under shard_map — and must NOT fall back to
+#: the ppermute halo of the non-resident sharded dispatch.
+_PRIMS = {
+    "kernel": (("pallas_call",), ()),
+    "fused": (("pallas_call",), ("ppermute",)),
+    "fused-sharded": (("shard_map", "pallas_call", "dma_start",
+                       "dma_wait"), ("ppermute",)),
+    "xla": ((), ("pallas_call",)),
+}
+
+
+def _layout_for(params, state, *, devices: int = 1) -> OperandLayout:
+    n_pad = int(params.subscribed.shape[0])
+    n_true = (int(params.n_true) if params.n_true is not None
+              else None)
+    dl = params.delays
+    d = int(devices)
+    return OperandLayout(
+        padded=params.n_true is not None,
+        n_true=n_true if n_true is not None else n_pad,
+        n_pad=n_pad,
+        delay_k_slots=(int(dl.k_slots) if dl is not None else 0),
+        shard_devices=d,
+        shard_extent=((n_true // d) if (n_true and d > 1) else None))
+
+
+# --------------------------------------------------------------------------
+# Planner faces
+# --------------------------------------------------------------------------
+
+
+def plan_kernel_step(cfg, sc, params, state) -> ExecutionPlan | Refusal:
+    """The per-tick pallas receive path — the old ``kernel_capability``
+    ladder, verbatim.
+
+    Fault schedules and telemetry configs are CAPABILITIES, not
+    refusals: the kernel threads the per-tick alive/link mask words
+    through its VMEM pass and accumulates the TelemetryFrame counter
+    tallies as in-kernel reductions (ops/pallas/receive.py).  What
+    remains refused is genuinely unsupported: C > 16 (the u16
+    pair-packing and ctrl-byte layout), W == 0 (no payload stream to
+    schedule), mixed-protocol overlays (flood_proto), P3 bookkeeping
+    (needs the split-loop provenance the fused kernel elides), a state
+    without carried gates, a re-weighted NONZERO static score bake,
+    Byzantine payload mutation, and the one XLA-only knob
+    (``gossip_retransmission`` under the IWANT-spam attack config)."""
+    if (params.sim_knobs is not None and sc is not None
+            and sc.sybil_iwant_spam):
+        return Refusal("kernel.knobs-iwant-spam",
+                       MSG_KERNEL_KNOB_IWANT_SPAM)
+    if (params.delays is not None and sc is not None
+            and sc.sybil_iwant_spam):
+        # round-13 attack-heavy kernel corner: the in-kernel
+        # IWANT-flood budget reads the partner advert views the
+        # delayed kernel no longer streams (arrivals ride the delay
+        # line as one blocked operand instead)
+        return Refusal("kernel.delays-iwant-spam",
+                       MSG_KERNEL_DELAY_IWANT_SPAM)
+    if (cfg.n_candidates > 16 or params.origin_words.shape[0] == 0
+            or params.flood_proto is not None
+            or state.gates is None
+            or (sc is not None
+                and ((sc.byzantine_mutation
+                      and params.cand_byz is not None)
+                     or sc.track_p3
+                     or (not params.static_score_zero
+                         and params.static_score_weights
+                         != (sc.app_specific_weight,
+                             sc.ip_colocation_factor_weight))))):
+        return Refusal("kernel.config", MSG_KERNEL_CONFIG)
+    prims, forbidden = _PRIMS["kernel"]
+    return ExecutionPlan("gossip-kernel",
+                         layout=_layout_for(params, state),
+                         primitives=prims, forbidden=forbidden)
+
+
+def plan_fused_window(cfg, sc, params, state, ticks, *,
+                      vmem_budget_bytes: int = FUSED_VMEM_BUDGET,
+                      sharded: bool = False, devices: int = 1,
+                      checkpoint=None,
+                      ckpt_horizon: int | None = None,
+                      horizon: int | None = None
+                      ) -> ExecutionPlan | Refusal:
+    """The round-16 tick-resident window (round-17 sharded
+    composition) — the old ``kernel_ticks_fused_capability`` ladder,
+    plus the checkpoint segmentation the round-15 ``ckpt`` runners
+    align to: with ``checkpoint`` (a CheckpointConfig) a segment
+    boundary that would split a fused window is refused by name, and
+    a PLAN verdict carries the segmentation (``every`` aligned to
+    ``ticks``)."""
+    import jax
+
+    from ..ops.pallas.receive import (
+        FUSED_ALIGN, FUSED_SHARD_TILE, fused_halo_spec,
+        fused_working_set_bytes)
+
+    ticks = int(ticks)
+    if ticks < 1:
+        return Refusal("fused.window", msg_fused_window(ticks))
+    base = plan_kernel_step(cfg, sc, params, state)
+    if isinstance(base, Refusal):
+        return Refusal("fused." + base.code,
+                       msg_fused_base(base.message))
+    if params.n_true is None:
+        return Refusal("fused.unpadded", MSG_FUSED_UNPADDED)
+    if sc is not None:
+        extra = 0
+        if state.scores is not None:
+            for leaf in jax.tree_util.tree_leaves(state.scores):
+                extra += int(leaf.size) * leaf.dtype.itemsize
+        return Refusal("fused.scored", msg_fused_scored(extra))
+    if cfg.paired_topics:
+        return Refusal("fused.paired", MSG_FUSED_PAIRED)
+    if params.delays is not None:
+        extra = 0
+        for line in (state.pay_line, state.ctrl_line, state.gsp_line,
+                     state.adv_line, state.probe_line):
+            if line is not None:
+                extra += int(line.size) * line.dtype.itemsize
+        return Refusal("fused.delays", msg_fused_delays(extra))
+    if params.sim_knobs is not None:
+        return Refusal("fused.knobs", MSG_FUSED_KNOBS)
+    if state.active is not None:
+        return Refusal("fused.px", MSG_FUSED_PX)
+    if params.cand_direct is not None:
+        return Refusal("fused.direct", MSG_FUSED_DIRECT)
+    n_pad = params.subscribed.shape[0]
+    if params.n_true != n_pad:
+        return Refusal("fused.pad-mismatch", MSG_FUSED_PAD_MISMATCH)
+    if not sharded and params.n_true % FUSED_ALIGN != 0:
+        # single-device whole-ring lane rolls wrap at the u32 DMA
+        # tile; the sharded path's constraint is per-SHARD (whole
+        # 128-lane tiles, checked below) — the composition can admit
+        # rings the single-device window refuses
+        return Refusal("fused.align",
+                       msg_fused_align(params.n_true, FUSED_ALIGN))
+    D = int(devices) if sharded else 1
+    if sharded:
+        if D < 2:
+            return Refusal("fused.shard-devices",
+                           msg_fused_shard_devices(D))
+        if params.n_true % D != 0:
+            return Refusal("fused.shard-divisible",
+                           msg_fused_shard_divisible(params.n_true, D))
+        S = params.n_true // D
+        if S % FUSED_SHARD_TILE != 0:
+            return Refusal(
+                "fused.shard-tile",
+                msg_fused_shard_tile(params.n_true, D, S,
+                                     FUSED_SHARD_TILE))
+        try:
+            fused_halo_spec(cfg.offsets, S, D)
+        except ValueError as e:
+            # halo geometry errors are built where the halo spec
+            # lives; the planner names and carries them unchanged
+            return Refusal("fused.shard-halo", str(e))
+    W = state.have.shape[0]
+    lat_b = 0
+    ws = fused_working_set_bytes(
+        cfg.n_candidates, W, cfg.history_gossip, params.n_true,
+        ticks=ticks, lat_buckets=lat_b,
+        with_faults=params.faults is not None,
+        cold_restart=(params.faults is not None
+                      and params.faults.cold_restart),
+        with_telemetry=False,
+        devices=D, offsets=(cfg.offsets if sharded else None))
+    if ws["vmem_bytes"] > vmem_budget_bytes:
+        return Refusal(
+            "fused.vmem",
+            msg_fused_vmem(ws, vmem_budget_bytes, params.n_true,
+                           cfg.n_candidates, W, D))
+    if horizon is not None and int(horizon) % ticks != 0:
+        # the runner-side composition refusal: gossip_run_fused
+        # chunks the horizon into whole windows, never partial ones
+        return Refusal("fused.horizon",
+                       msg_fused_horizon(int(horizon), ticks))
+    segmentation = None
+    if checkpoint is not None:
+        raw_every = int(checkpoint.every)
+        # every=0 means one segment spanning the whole horizon — the
+        # same resolution ckpt_gossip_run_fused applies
+        every = raw_every or int(ckpt_horizon
+                                 if ckpt_horizon is not None
+                                 else ticks)
+        if every % ticks != 0:
+            return Refusal("fused.ckpt-boundary",
+                           msg_ckpt_mid_window(raw_every, ticks))
+        segmentation = CheckpointSegmentation(every=every, align=ticks)
+    prims, forbidden = _PRIMS["fused-sharded" if D > 1 else "fused"]
+    return ExecutionPlan(
+        "gossip-kernel-fused" + ("-sharded" if D > 1 else ""),
+        layout=_layout_for(params, state, devices=D),
+        segmentation=segmentation,
+        primitives=prims, forbidden=forbidden)
+
+
+def plan_gossip_step(cfg, sc, params, state, *, telemetry=None,
+                     rpc_probe: bool = False,
+                     force_split: bool = False,
+                     use_pallas_receive: bool | None = None
+                     ) -> ExecutionPlan | Refusal:
+    """The step-level dispatch ``make_gossip_step``'s closure enforces,
+    in the step's own check order: the delay-line build requirements,
+    the rpc-probe composition cells, then the kernel/XLA path split.
+    A PLAN verdict is the gossip-xla or gossip-kernel plan."""
+    paired = cfg.paired_topics
+    dl = params.delays
+    tel = telemetry
+    kernel_on = (params.n_true is not None
+                 if use_pallas_receive is None else use_pallas_receive)
+    if dl is not None:
+        if paired:
+            return Refusal("step.delays-paired", MSG_DELAYS_PAIRED,
+                           exc=NotImplementedError)
+        if rpc_probe and state.probe_line is None:
+            return Refusal("step.delays-probe-line",
+                           MSG_DELAYS_NEED_PROBE_LINE)
+        if tel is not None and tel.counters and (
+                state.adv_line is None or state.gsp_line is None):
+            return Refusal("step.delays-counter-lines",
+                           MSG_DELAYS_NEED_COUNTER_LINES)
+        if state.pay_line is None or state.ctrl_line is None:
+            return Refusal("step.delays-lines", MSG_DELAYS_NEED_LINES)
+    if kernel_on:
+        if params.n_true is None:
+            return Refusal("kernel.needs-pad", MSG_KERNEL_NEEDS_PAD)
+        base = plan_kernel_step(cfg, sc, params, state)
+        if isinstance(base, Refusal):
+            return base
+    elif params.n_true is not None:
+        return Refusal("xla.padded-state", MSG_XLA_PADDED_STATE)
+    if rpc_probe and params.flood_proto is not None:
+        return Refusal("step.probe-mixed-protocol",
+                       MSG_PROBE_MIXED_PROTOCOL,
+                       exc=NotImplementedError)
+    if dl is not None and not kernel_on:
+        # the split formulation under delays needs its own
+        # gossip-class line (checked where the split loops start)
+        combined = (cfg.n_candidates <= 16
+                    and (sc is None or not sc.track_p3)
+                    and not force_split)
+        if not combined and state.gsp_line is None:
+            return Refusal("step.delays-split-line",
+                           MSG_DELAYS_NEED_SPLIT_LINE)
+    if kernel_on:
+        prims, forbidden = _PRIMS["kernel"]
+        return ExecutionPlan("gossip-kernel",
+                             layout=_layout_for(params, state),
+                             primitives=prims, forbidden=forbidden)
+    prims, forbidden = _PRIMS["xla"]
+    return ExecutionPlan("gossip-xla",
+                         layout=_layout_for(params, state),
+                         primitives=prims, forbidden=forbidden)
+
+
+def plan_circulant(path: str, *, faults=None
+                   ) -> ExecutionPlan | Refusal:
+    """The mesh-less simulators (floodsub / randomsub; circulant and
+    gather/dense forms).  Their one capability hole is the round-11
+    cold-restart reject: a cold rejoiner has no IHAVE/IWANT repair
+    path to recover through."""
+    if path not in PATHS or path.startswith("gossip"):
+        raise ValueError(f"plan_circulant: unknown mesh-less path "
+                         f"{path!r} (expected one of {PATHS[2:]})")
+    sim = "flood" if path.startswith("flood") else "randomsub"
+    if faults is not None and faults.cold_restart:
+        if sim == "flood":
+            return Refusal("flood.cold-restart",
+                           MSG_FLOOD_COLD_RESTART)
+        return Refusal("randomsub.cold-restart",
+                       MSG_RANDOMSUB_COLD_RESTART)
+    prims, forbidden = _PRIMS["xla"]
+    return ExecutionPlan(path, primitives=prims, forbidden=forbidden)
+
+
+def plan_serving(*, kernel: bool = False, batch: int = 1,
+                 devices: int = 0) -> ExecutionPlan | Refusal:
+    """The sweepd execution-path choices — the old
+    ``server_capability`` ladder.  The pallas kernel has no vmap rule,
+    so the kernel-path server is the SEQUENTIAL zero-recompile
+    demonstration; ``--devices`` shards the batched XLA dispatch
+    only."""
+    if kernel and batch != 1:
+        return Refusal("serve.kernel-batch", MSG_SERVE_KERNEL_BATCH)
+    if kernel and devices:
+        return Refusal("serve.kernel-devices",
+                       MSG_SERVE_KERNEL_DEVICES)
+    path = "gossip-kernel" if kernel else "gossip-xla"
+    prims, forbidden = _PRIMS["kernel" if kernel else "xla"]
+    return ExecutionPlan(path,
+                         layout=OperandLayout(batch=int(batch) or 1,
+                                              padded=kernel),
+                         primitives=prims, forbidden=forbidden)
+
+
+def plan_execution(cfg=None, score_cfg=None, params=None, state=None,
+                   *, path: str | None = None, telemetry=None,
+                   faults=None, rpc_probe: bool = False,
+                   force_split: bool = False,
+                   ticks_fused: int | None = None,
+                   vmem_budget_bytes: int = FUSED_VMEM_BUDGET,
+                   shard_devices: int = 1, checkpoint=None,
+                   ckpt_horizon: int | None = None,
+                   horizon: int | None = None,
+                   serving: dict | None = None
+                   ) -> ExecutionPlan | Refusal:
+    """The single front door.  Routes the request to the face that
+    owns it:
+
+    - ``serving={"kernel": ..., "batch": ..., "devices": ...}`` plans
+      the sweepd server surface (nothing else needed);
+    - a mesh-less ``path`` ("flood-*" / "randomsub-*") plans the
+      circulant/gather/dense simulators (``faults`` optional);
+    - ``ticks_fused`` plans the tick-resident fused window
+      (``shard_devices > 1`` composes the round-17 sharded form,
+      ``checkpoint`` composes the round-15 segmentation);
+    - otherwise the per-tick gossip step (XLA or kernel, inferred
+      from the operand layout like the step itself).
+
+    Exactly one verdict: an ``ExecutionPlan`` or one named
+    ``Refusal``."""
+    if serving is not None:
+        return plan_serving(**serving)
+    if path is not None and not path.startswith("gossip"):
+        return plan_circulant(path, faults=faults)
+    if ticks_fused is not None:
+        return plan_fused_window(
+            cfg, score_cfg, params, state, ticks_fused,
+            vmem_budget_bytes=vmem_budget_bytes,
+            sharded=shard_devices > 1, devices=shard_devices,
+            checkpoint=checkpoint, ckpt_horizon=ckpt_horizon,
+            horizon=horizon)
+    return plan_gossip_step(
+        cfg, score_cfg, params, state, telemetry=telemetry,
+        rpc_probe=rpc_probe, force_split=force_split,
+        use_pallas_receive=(True if path == "gossip-kernel"
+                            else False if path == "gossip-xla"
+                            else None))

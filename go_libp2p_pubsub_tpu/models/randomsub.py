@@ -149,11 +149,10 @@ def make_randomsub_sim(cfg: RandomSubSimConfig, subs: np.ndarray,
                 f"fault_schedule.n_peers={fault_schedule.n_peers} != "
                 f"sim peer count {subs.shape[0]}")
         if fault_schedule.cold_restart:
-            raise ValueError(
-                "cold_restart: the randomsub simulator refuses "
-                "cold-restart schedules (a cold rejoiner has no "
-                "IHAVE/IWANT repair path to recover through) — "
-                "run it on the gossipsub simulator")
+            # the refusal string is defined once, in the capability
+            # planner (models/plan.py)
+            from .plan import MSG_RANDOMSUB_COLD_RESTART
+            raise ValueError(MSG_RANDOMSUB_COLD_RESTART)
     n, t = subs.shape
     if t != cfg.n_topics:
         raise ValueError("subs topic dim != cfg.n_topics")

@@ -969,15 +969,15 @@ def ckpt_gossip_run_fused(params, state, n_ticks: int, window,
     there is no mid-window carry to save).  Everything else is the
     ckpt_gossip_run contract: bit-identical resume, kill-safe."""
     from ..models.gossipsub import gossip_run_fused, _check_fused_horizon
+    from ..models.plan import msg_ckpt_mid_window
 
     ticks_fused = int(getattr(window, "ticks_fused", 1))
     every = int(ckpt.every) or int(n_ticks)
     if every % ticks_fused != 0:
-        raise ValueError(
-            f"ckpt segment boundary mid-window: CheckpointConfig."
-            f"every={int(ckpt.every)} is not a multiple of "
-            f"ticks_fused={ticks_fused} — align the segment length to "
-            "the fused window")
+        # the refusal string is defined once, in the capability
+        # planner (models/plan.py)
+        raise ValueError(msg_ckpt_mid_window(int(ckpt.every),
+                                             ticks_fused))
     _check_fused_horizon(n_ticks, ticks_fused)
 
     def seg(s, n):

@@ -584,9 +584,9 @@ def test_refusals_named():
         gs.make_gossip_step(cfg, sc,
                             telemetry=tl.TelemetryConfig())(params,
                                                             state)
-    with pytest.raises(NotImplementedError,
-                       match="delay-armed sims are not "
-                             "probe-supported"):
+    # round 20: the rpc-probe refusal is LIFTED — what remains is the
+    # build requirement for the probe delay line, named
+    with pytest.raises(ValueError, match="delays_probe=True"):
         gs.make_gossip_step(cfg, sc, rpc_probe=True)(params, state)
     # delays + paired refused at BUILD time
     pcfg = gs.GossipSimConfig(
@@ -620,3 +620,41 @@ def test_refusals_named():
         jax.eval_shape(gs.make_gossip_step(cfg, sc_spam,
                                            receive_block=BLK),
                        p3, s3)
+
+
+def test_delays_probe_build_requires_delayconfig():
+    subs, topic, origin, tks = _inputs()
+    cfg = _gossip_cfg()
+    with pytest.raises(ValueError, match="needs a DelayConfig"):
+        gs.make_gossip_sim(cfg, subs, topic, origin, tks,
+                           delays_probe=True)
+
+
+def test_identity_delay_probe_parity():
+    """Round 20 (the lifted delays[rpc-probe] hole): at the identity
+    delay the probe snapshot's shared leaves equal the delays=None
+    snapshot bit for bit, and the new ``arr_*`` arrival masks equal
+    the same tick's sends in the receiver (transfer) view — the K=1
+    probe-line enqueue/dequeue is a value-level pass-through."""
+    subs, topic, origin, tks = _inputs()
+    cfg = _gossip_cfg()
+    step = gs.make_gossip_step(cfg, rpc_probe=True)
+    p0, s0 = gs.make_gossip_sim(cfg, subs, topic, origin, tks)
+    _, snap0 = gs.gossip_run_rpc_snapshots(p0, s0, TICKS, step)
+    p1, s1 = gs.make_gossip_sim(cfg, subs, topic, origin, tks,
+                                delays=IDENTITY, delays_probe=True)
+    _, snap1 = gs.gossip_run_rpc_snapshots(p1, s1, TICKS, step)
+    for k in snap0:
+        np.testing.assert_array_equal(
+            np.asarray(snap0[k]), np.asarray(snap1[k]), err_msg=k)
+    # the arrival leaves: what was sent this tick arrives this tick,
+    # receiver-indexed (the edge-duality transfer of the send mask);
+    # graft/prune arrivals reuse the ctrl-line dequeue the same way
+    for k, send_k in (("arr_fwd", "fwd"), ("arr_ihave", "ihave"),
+                      ("arr_flood", "flood"), ("arr_graft", "graft"),
+                      ("arr_prune", "prune")):
+        got = np.asarray(snap1[k])
+        want = np.stack([
+            np.asarray(gs.transfer_bits(snap1[send_k][t], cfg))
+            for t in range(TICKS)])
+        np.testing.assert_array_equal(got, want, err_msg=k)

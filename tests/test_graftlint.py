@@ -70,6 +70,24 @@ def test_pragmas_suppress_per_line():
     assert findings[0].rule == "sys-path-insert"
 
 
+def test_unknown_pragma_rule_rejected_by_name():
+    """A typo'd ignore[rule] used to be silently accepted — a
+    suppression guarding nothing.  Now it is a pragma-directive
+    finding at its file:line naming the unknown rule, and the finding
+    it failed to silence still fires on the same line."""
+    findings = check_file(FIXTURES / "pragma_unknown.py", root=REPO)
+    rules = {f.rule for f in findings}
+    assert rules == {"pragma-directive", "sys-path-insert"}
+    bad = next(f for f in findings if f.rule == "pragma-directive")
+    assert bad.line == 13
+    assert "sys-path-insrt" in bad.message
+    # a KNOWN rule name in the same position is not flagged
+    src = ('# graftlint: scope=tools\n'
+           'import sys\n'
+           'sys.path.insert(0, ".")  # graftlint: ignore[sys-path-insert]\n')
+    assert check_file(Path("inline.py"), root=REPO, src=src) == []
+
+
 def test_pragma_parsing_forms():
     src = ("a()  # graftlint: ignore[rule-a]\n"
            "b()  # graftlint: ignore[rule-a, rule-b]\n"

@@ -1,0 +1,295 @@
+"""The round-20 capability planner (models/plan.py) and its lattice
+audit (tools/graftlint/planaudit.py, tools/planstat.py).
+
+One ExecutionPlan or one named Refusal, statically proven: tier-1
+runs the fast lattice subset (planner verdict vs real entry point,
+message-matched byte for byte), the golden-matrix round-trip against
+the committed PLAN_r19.json, the planstat gate-trip semantics, and
+the README table pin.  The full 62-cell sweep (every path x feature
+composition, sharded fused included) runs @slow and in
+``python -m tools.graftlint`` (measure_all step 0.5).
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+import tools.planstat as planstat
+from go_libp2p_pubsub_tpu.models import plan
+from tools.graftlint import planaudit
+
+REPO = Path(__file__).resolve().parents[1]
+GOLDEN = REPO / "PLAN_r19.json"
+
+
+def _matrix():
+    return json.loads(GOLDEN.read_text())
+
+
+# --------------------------------------------------------------------------
+# planner == legacy entry points, message-matched (fast subset tier-1)
+# --------------------------------------------------------------------------
+
+
+def test_fast_lattice_subset_audits_clean():
+    """Every fast cell's verdict matches the real entry point: PLAN
+    cells trace without compiling with the declared primitives,
+    REFUSE cells raise the planner's exact string."""
+    problems = planaudit.run_planaudit(fast_only=True)
+    assert problems == [], "\n".join(problems)
+
+
+@pytest.mark.slow
+def test_full_lattice_audits_clean():
+    problems = planaudit.run_planaudit()
+    assert problems == [], "\n".join(problems)
+
+
+def test_pure_planner_faces_need_no_sim():
+    """The host-side faces give verdicts from config alone — the
+    serving tier and the mesh-less cold-restart gate call them
+    before any build."""
+    v = plan.plan_serving(kernel=True, batch=8, devices=0)
+    assert isinstance(v, plan.Refusal)
+    assert v.code == "serve.kernel-batch"
+    assert v.message == plan.MSG_SERVE_KERNEL_BATCH
+    v = plan.plan_serving(kernel=True, batch=1, devices=2)
+    assert v.code == "serve.kernel-devices"
+    v = plan.plan_serving(kernel=False, batch=8, devices=0)
+    assert isinstance(v, plan.ExecutionPlan)
+
+    v = plan.plan_circulant("flood-circulant", faults=None)
+    assert isinstance(v, plan.ExecutionPlan)
+    assert v.path == "flood-circulant"
+
+
+def test_refusal_is_one_definition_site():
+    """The strings legacy call sites used to hand-roll now come FROM
+    the planner module — including the two round-20 stragglers
+    (fused window arity and the scan-horizon divisibility gate)."""
+    assert plan.msg_fused_window(0) == "ticks_fused must be >= 1 (got 0)"
+    assert "scan horizon not divisible by the fused window" in \
+        plan.msg_fused_horizon(3, 2)
+    assert "n_ticks=3" in plan.msg_fused_horizon(3, 2)
+    # gossipsub raises these via the plan module, not local literals
+    import inspect
+
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    src = inspect.getsource(gs)
+    assert "ticks_fused must be >= 1" not in src
+    assert "scan horizon not divisible" not in src
+
+
+def test_audit_cell_catches_seeded_disagreement():
+    """The audit goes nonzero on every way a planner verdict can
+    disagree with the entry point — seeded synthetically so the check
+    itself is checked."""
+    import dataclasses
+
+    import jax
+    refuse = plan.Refusal("x.y", "the named message")
+
+    def mk(ctx):
+        return planaudit.Cell("seed/x", "gossip-xla", "seed",
+                              lambda: dict(ctx))
+
+    # REFUSE but the entry point does not raise
+    probs = planaudit.audit_cell(mk(dict(verdict=refuse,
+                                         provoke=lambda: None)))
+    assert any("did not raise" in p for p in probs)
+
+    # REFUSE but a different string comes out
+    def wrong():
+        raise ValueError("something else entirely")
+    probs = planaudit.audit_cell(mk(dict(verdict=refuse,
+                                         provoke=wrong)))
+    assert any("drift" in p for p in probs)
+
+    # missing arm / unclassifiable verdict
+    probs = planaudit.audit_cell(mk(dict(verdict=refuse)))
+    assert any("unclassifiable" in p for p in probs)
+    probs = planaudit.audit_cell(mk(dict(verdict=None)))
+    assert any("unclassifiable" in p for p in probs)
+
+    # PLAN whose trace lacks a declared primitive
+    base = plan.plan_serving(kernel=False, batch=1, devices=0)
+    assert isinstance(base, plan.ExecutionPlan)
+    lying = dataclasses.replace(base, primitives=("pallas_call",))
+    probs = planaudit.audit_cell(mk(dict(
+        verdict=lying,
+        trace=lambda: jax.make_jaxpr(lambda x: x + 1)(1.0))))
+    assert any("declared primitives missing" in p for p in probs)
+
+    # PLAN whose trace contains a forbidden primitive
+    lying = dataclasses.replace(base, primitives=(),
+                                forbidden=("add",))
+    probs = planaudit.audit_cell(mk(dict(
+        verdict=lying,
+        trace=lambda: jax.make_jaxpr(lambda x: x + 1)(1.0))))
+    assert any("forbidden primitives present" in p for p in probs)
+
+
+# --------------------------------------------------------------------------
+# golden-matrix round-trip
+# --------------------------------------------------------------------------
+
+
+def test_golden_matrix_schema_and_coverage():
+    m = _matrix()
+    assert m["schema"] == planaudit.MATRIX_SCHEMA
+    assert m["round"] == planaudit.MATRIX_ROUND
+    ids = [r["id"] for r in m["cells"]]
+    assert len(ids) == len(set(ids)), "duplicate lattice cell ids"
+    for r in m["cells"]:
+        assert r["verdict"] in ("PLAN", "REFUSE"), \
+            f"unclassified golden cell {r['id']}: {r.get('error')}"
+        if r["verdict"] == "REFUSE":
+            assert r["code"] and r["message"] and r["exc"]
+        else:
+            # composed plans extend a base path's name
+            # (gossip-kernel-fused[-sharded], serving-*)
+            assert any(r["plan_path"].startswith(p)
+                       for p in plan.PATHS) or \
+                r["plan_path"].startswith("serving"), r["plan_path"]
+    # every execution path appears, plus the composition families
+    paths = {r["path"] for r in m["cells"]}
+    assert paths >= set(plan.PATHS) | {
+        "gossip-kernel-fused", "gossip-kernel-fused-sharded",
+        "serving"}
+
+
+def test_golden_matrix_matches_cell_catalog():
+    """The committed matrix covers exactly the audit's cell catalog —
+    a cell added to planaudit without regenerating PLAN_r19.json (or
+    vice versa) is a failure here, not silent drift."""
+    cells = planaudit.build_cells()
+    assert [c.id for c in cells] == [r["id"] for r in
+                                     _matrix()["cells"]]
+    fast = [c.id for c in cells if c.fast]
+    assert len(fast) >= 12, "fast tier-1 subset shrank"
+
+
+@pytest.mark.slow
+def test_emitted_matrix_matches_committed():
+    """capability_matrix() (the --emit-matrix artifact) reproduces
+    the committed golden matrix exactly."""
+    current = planaudit.capability_matrix()
+    assert current == _matrix()
+
+
+def test_readme_table_is_generated_from_matrix():
+    readme = (REPO / "README.md").read_text()
+    begin = "<!-- plan-matrix:begin -->\n"
+    end = "<!-- plan-matrix:end -->"
+    assert begin in readme and end in readme
+    block = readme.split(begin, 1)[1].split(end, 1)[0]
+    assert block.strip() == planaudit.matrix_markdown(
+        _matrix()).strip()
+
+
+# --------------------------------------------------------------------------
+# planstat gate semantics
+# --------------------------------------------------------------------------
+
+
+def _rc(argv):
+    try:
+        return planstat.main(argv)
+    except SystemExit as e:
+        return e.code if isinstance(e.code, int) else 1
+
+
+def test_planstat_clean_on_committed(capsys):
+    assert _rc([str(GOLDEN), "--check", str(GOLDEN)]) == 0
+    out = capsys.readouterr().out
+    assert "100% classified" in out
+
+
+def test_planstat_trips_on_plan_to_refuse_flip(tmp_path, capsys):
+    m = _matrix()
+    flipped = next(r for r in m["cells"] if r["verdict"] == "PLAN")
+    flipped.update(verdict="REFUSE", code="x.y", message="nope",
+                   exc="ValueError")
+    art = tmp_path / "flip.json"
+    art.write_text(json.dumps(m))
+    assert _rc([str(art), "--check", str(GOLDEN)]) == 1
+    assert "regressed PLAN -> REFUSE" in capsys.readouterr().err
+
+
+def test_planstat_trips_on_refusal_message_drift(tmp_path, capsys):
+    m = _matrix()
+    r = next(r for r in m["cells"] if r["verdict"] == "REFUSE")
+    r["message"] += " DRIFTED"
+    art = tmp_path / "drift.json"
+    art.write_text(json.dumps(m))
+    assert _rc([str(art), "--check", str(GOLDEN)]) == 1
+    assert "drifted" in capsys.readouterr().err
+
+
+def test_planstat_trips_on_shrunk_lattice(tmp_path, capsys):
+    m = _matrix()
+    m["cells"] = m["cells"][1:]
+    art = tmp_path / "shrunk.json"
+    art.write_text(json.dumps(m))
+    assert _rc([str(art), "--check", str(GOLDEN)]) == 1
+    assert "lattice shrank" in capsys.readouterr().err
+
+
+def test_planstat_lift_is_note_not_failure(tmp_path, capsys):
+    """REFUSE -> PLAN means capability grew: exit 0 with a note (the
+    delays x rpc-probe precedent)."""
+    m = _matrix()
+    r = next(r for r in m["cells"] if r["verdict"] == "REFUSE")
+    for k in ("code", "message"):
+        r.pop(k, None)
+    r.update(verdict="PLAN", plan_path="gossip-xla", primitives=[],
+             forbidden=["pallas_call"])
+    art = tmp_path / "lift.json"
+    art.write_text(json.dumps(m))
+    assert _rc([str(art), "--check", str(GOLDEN)]) == 0
+    assert "lifted" in capsys.readouterr().out
+
+
+def test_planstat_unclassified_cell_is_regression(tmp_path, capsys):
+    m = _matrix()
+    m["cells"][0] = {"id": m["cells"][0]["id"],
+                     "path": m["cells"][0]["path"],
+                     "feature": m["cells"][0]["feature"],
+                     "verdict": "ERROR", "error": "build exploded"}
+    art = tmp_path / "err.json"
+    art.write_text(json.dumps(m))
+    assert _rc([str(art)]) == 1
+    assert "did not classify" in capsys.readouterr().err
+
+
+def test_planstat_unusable_artifact_exits_2(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text(GOLDEN.read_text()[:80])
+    assert _rc([str(bad)]) == 2
+    wrong = tmp_path / "wrong.json"
+    wrong.write_text(json.dumps({"schema": "other-v0",
+                                 "cells": [{}]}))
+    assert _rc([str(wrong)]) == 2
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"schema": planstat.SCHEMA,
+                                 "cells": []}))
+    assert _rc([str(empty)]) == 2
+
+
+# --------------------------------------------------------------------------
+# CLI surfaces
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_emit_matrix_cli_round_trips():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--emit-matrix"],
+        capture_output=True, text=True, cwd=REPO,
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+             "HOME": "/root"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert json.loads(out.stdout) == _matrix()

@@ -7,7 +7,7 @@ bit-flipped artifact produces a CLEAN exit 2 with a named reason —
 never a traceback (which the shell would read as a generic crash) and
 never a silent 0.  Round 15 makes every artifact write atomic
 (utils/artifacts.py), so a mangled file should no longer occur — but
-the gates stay the last line of defense, and this pins all eight of
+the gates stay the last line of defense, and this pins all nine of
 them, on the artifact operand and on the ``--check`` baseline operand.
 
 The committed baselines double as the valid fixtures: each gate run
@@ -34,6 +34,7 @@ GATES = [
     ("tools.ckptstat", "CKPT_r15.json"),
     ("tools.servestat", "SERVE_r18.json"),
     ("tools.obsstat", "METRICS_r19.json"),
+    ("tools.planstat", "PLAN_r19.json"),
 ]
 
 MODES = ("truncated", "empty", "bitflip")

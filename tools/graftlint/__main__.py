@@ -2,16 +2,22 @@
 
 Default (no paths): the full suite over the repo — AST pass on every
 .py file (fixtures excluded), then the abstract-eval audit over the
-declared config matrix, then the config-contract checker.  Exit 0 =
-clean; exit 1 = findings, each printed as ``path:line: graftlint[rule]
-message`` (AST) or a named audit/contract problem.
+declared config matrix, then the config-contract checker, then the
+capability-lattice plan audit (every lattice cell must PLAN or
+REFUSE exactly as ``models/plan.py`` says).  Exit 0 = clean; exit 1 =
+findings, each printed as ``path:line: graftlint[rule] message``
+(AST) or a named audit/contract/planaudit problem.
 
 With explicit paths, only the AST pass runs, on those paths (fixtures
 included — that is how the seeded-violation corpus self-tests).
 
 Options: ``--ast-only`` (skip the jax-importing passes — the fast
 preflight subset), ``--no-audit``, ``--no-contracts``,
-``--list-rules``.
+``--no-planaudit``, ``--plan-fast`` (planaudit's seconds-scale
+lattice subset), ``--emit-matrix`` (print the planner's capability
+matrix as plan-matrix-v1 JSON on stdout and exit — the PLAN_r19.json
+/ tools/planstat.py artifact), ``--emit-matrix-md`` (same, rendered
+as the README capability table), ``--list-rules``.
 """
 
 from __future__ import annotations
@@ -21,6 +27,26 @@ import sys
 from pathlib import Path
 
 from .astpass import RULES, run_paths
+
+
+def _force_cpu_jax() -> None:
+    # running as `python -m tools.graftlint` implies the repo root
+    # is already importable, so go_libp2p_pubsub_tpu resolves too.
+    # Force the CPU backend (as tools/validate_curves.py does): the
+    # trace/lower passes must run even when the TPU relay is down —
+    # a static preflight must never be a second TPU client.  The
+    # round-14 sharded audit cases want >= 2 CPU devices (they
+    # degrade to a 1-shard mesh otherwise), so request a virtual
+    # host mesh BEFORE jax initializes its backends.
+    import os
+    if "jax" not in sys.modules and \
+            "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
 
 
 def main(argv=None) -> int:
@@ -33,6 +59,15 @@ def main(argv=None) -> int:
                     help="AST pass only (no jax import)")
     ap.add_argument("--no-audit", action="store_true")
     ap.add_argument("--no-contracts", action="store_true")
+    ap.add_argument("--no-planaudit", action="store_true")
+    ap.add_argument("--plan-fast", action="store_true",
+                    help="planaudit: fast lattice subset only")
+    ap.add_argument("--emit-matrix", action="store_true",
+                    help="print the capability matrix as JSON and "
+                         "exit (no lint passes)")
+    ap.add_argument("--emit-matrix-md", action="store_true",
+                    help="print the capability matrix as the README "
+                         "markdown table and exit")
     ap.add_argument("--list-rules", action="store_true")
     ns = ap.parse_args(argv)
 
@@ -40,6 +75,25 @@ def main(argv=None) -> int:
         for name, (scopes, desc) in RULES.items():
             where = ", ".join(scopes) if scopes else "any"
             print(f"{name:18s} [{where}] {desc}")
+        return 0
+
+    if ns.emit_matrix or ns.emit_matrix_md:
+        _force_cpu_jax()
+        import json
+
+        from .planaudit import capability_matrix, matrix_markdown
+        matrix = capability_matrix()
+        if ns.emit_matrix_md:
+            print(matrix_markdown(matrix))
+        else:
+            print(json.dumps(matrix, indent=2))
+        bad = [r for r in matrix["cells"]
+               if r["verdict"] not in ("PLAN", "REFUSE")]
+        if bad:
+            print(f"graftlint: {len(bad)} lattice cell(s) failed to "
+                  f"classify: {[r['id'] for r in bad]}",
+                  file=sys.stderr)
+            return 1
         return 0
 
     # the repo root is the directory that contains this package's
@@ -53,23 +107,7 @@ def main(argv=None) -> int:
     n_problems = len(findings)
 
     if not explicit and not ns.ast_only:
-        # running as `python -m tools.graftlint` implies the repo root
-        # is already importable, so go_libp2p_pubsub_tpu resolves too.
-        # Force the CPU backend (as tools/validate_curves.py does): the
-        # trace/lower passes must run even when the TPU relay is down —
-        # a static preflight must never be a second TPU client.  The
-        # round-14 sharded audit cases want >= 2 CPU devices (they
-        # degrade to a 1-shard mesh otherwise), so request a virtual
-        # host mesh BEFORE jax initializes its backends.
-        import os
-        if "jax" not in sys.modules and \
-                "--xla_force_host_platform_device_count" not in \
-                os.environ.get("XLA_FLAGS", ""):
-            os.environ["XLA_FLAGS"] = (
-                os.environ.get("XLA_FLAGS", "")
-                + " --xla_force_host_platform_device_count=8").strip()
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+        _force_cpu_jax()
         if not ns.no_audit:
             from .jaxpr_audit import run_audit
             print("graftlint: abstract-eval audit over the declared "
@@ -87,6 +125,18 @@ def main(argv=None) -> int:
             for p in contracts:
                 print(p)
             n_problems += len(contracts)
+        if not ns.no_planaudit:
+            from .planaudit import run_planaudit
+            subset = "fast lattice subset" if ns.plan_fast else \
+                "full feature lattice"
+            print(f"graftlint: capability plan audit ({subset}) ...",
+                  file=sys.stderr)
+            plans = run_planaudit(
+                fast_only=ns.plan_fast,
+                log=lambda s: print(s, file=sys.stderr))
+            for p in plans:
+                print(p)
+            n_problems += len(plans)
 
     if n_problems:
         print(f"graftlint: {n_problems} finding(s)", file=sys.stderr)

@@ -32,6 +32,17 @@ full rationale and how to add a rule):
 - ``sys-path-insert`` (tools): module-level ``sys.path`` mutation.
   Grandfathered in the script-style tools (pragma'd); new tools should
   run as modules (``python -m tools.x``) instead.
+- ``lock-discipline`` (service = obs/ + serving/): a PUBLIC method of
+  a lock-owning class (one whose ``__init__`` assigns ``self._lock``
+  or whose methods enter ``with ..._lock:``) mutating ``self``-rooted
+  state outside a ``with ..._lock:`` / ``with ...atomic():`` block.
+  MetricsRegistry / SpanRecorder state is scraped concurrently by the
+  serving threads; an unguarded write races the accounting identity
+  the obsstat gate pins.  Private ``_``-helpers follow the documented
+  caller-holds-lock convention and are exempt; a class that merely
+  USES someone else's ``atomic()`` (the frontend pattern) does not
+  qualify.  Mutation-through-call (``.append(...)``) is out of static
+  reach — the rule catches assignment/augassign/annassign writes.
 
 A function is *traced* when (a) it is decorated with ``jax.jit`` /
 ``partial(jax.jit, ...)``, (b) its name is passed to ``lax.scan`` /
@@ -50,7 +61,8 @@ import ast
 from dataclasses import dataclass
 from pathlib import Path
 
-from .pragmas import pragma_lines, scope_override, suppressed
+from .pragmas import (pragma_lines, scope_override, suppressed,
+                      validate_pragmas)
 
 #: rule name -> (scopes it applies in, or None = any scope; summary)
 RULES: dict[str, tuple[tuple[str, ...] | None, str]] = {
@@ -69,6 +81,9 @@ RULES: dict[str, tuple[tuple[str, ...] | None, str]] = {
         ("tools",), "'except Exception' in tools"),
     "sys-path-insert": (
         ("tools",), "module-level sys.path mutation in tools"),
+    "lock-discipline": (
+        ("service",), "public-method mutation of lock-owning shared "
+                      "state outside 'with ..._lock' / 'atomic()'"),
 }
 
 EXCLUDE_DIRS = {"__pycache__", ".git"}
@@ -102,6 +117,8 @@ def classify_scope(path: Path, root: Path) -> str:
         parts = path.parts
     if "models" in parts or "ops" in parts:
         return "model"
+    if "obs" in parts or "serving" in parts:
+        return "service"
     if "core" in parts:
         return "core"
     if parts and parts[0] == "tools":
@@ -262,6 +279,7 @@ class _FileChecker:
 
     def run(self) -> list[Finding]:
         self._collect_traced()
+        self._check_lock_discipline()
         for node in ast.walk(self.tree):
             if isinstance(node, (ast.If, ast.While, ast.Assert,
                                  ast.IfExp)):
@@ -373,6 +391,102 @@ class _FileChecker:
                 "'except Exception' in tools — catch the specific "
                 "failure, or pragma the documented fallback")
 
+    # -- lock discipline (service scope) ----------------------------------
+
+    @staticmethod
+    def _is_lock_guard(item: ast.withitem) -> bool:
+        """``with <...>._lock:`` or ``with <...>.atomic():``."""
+        ce = item.context_expr
+        d = _dotted(ce)
+        if d is not None and d.split(".")[-1] == "_lock":
+            return True
+        if isinstance(ce, ast.Call):
+            f = _dotted(ce.func)
+            return f is not None and f.split(".")[-1] == "atomic"
+        return False
+
+    def _class_owns_lock(self, cls: ast.ClassDef) -> bool:
+        """Assigns ``self._lock`` or enters ``with ..._lock:``
+        anywhere in its body.  Merely calling someone else's
+        ``atomic()`` (the frontend pattern) does NOT qualify — the
+        guarded state belongs to the registry, not the caller."""
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and t.attr == "_lock"
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        return True
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    d = _dotted(item.context_expr)
+                    if d is not None and d.split(".")[-1] == "_lock":
+                        return True
+        return False
+
+    @staticmethod
+    def _self_rooted(target: ast.AST) -> bool:
+        node = target
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        return isinstance(node, ast.Name) and node.id == "self"
+
+    def _guarded(self, node: ast.AST, method: ast.AST) -> bool:
+        cur = self._parents.get(node)
+        while cur is not None and cur is not method:
+            if isinstance(cur, (ast.With, ast.AsyncWith)) and any(
+                    self._is_lock_guard(i) for i in cur.items):
+                return True
+            cur = self._parents.get(cur)
+        return False
+
+    def _check_lock_discipline(self):
+        scopes = RULES["lock-discipline"][0]
+        if scopes is not None and self.scope not in scopes:
+            return
+        for cls in ast.walk(self.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            if not self._class_owns_lock(cls):
+                continue
+            for method in cls.body:
+                if not isinstance(method, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                    continue
+                if method.name.startswith("_"):
+                    continue  # private helpers: caller holds the lock
+                for node in ast.walk(method):
+                    if isinstance(node, ast.Assign):
+                        targets = node.targets
+                    elif isinstance(node, ast.AugAssign):
+                        targets = [node.target]
+                    elif isinstance(node, ast.AnnAssign):
+                        if node.value is None:   # bare annotation
+                            continue
+                        targets = [node.target]
+                    else:
+                        continue
+                    flat = []
+                    for t in targets:
+                        flat.extend(t.elts if isinstance(
+                            t, (ast.Tuple, ast.List)) else [t])
+                    for t in flat:
+                        if not self._self_rooted(t):
+                            continue
+                        if self._guarded(node, method):
+                            continue
+                        self._emit(
+                            "lock-discipline", node,
+                            f"'{cls.name}.{method.name}' mutates "
+                            "self-rooted state of a lock-owning class "
+                            "outside 'with ..._lock:' / "
+                            "'with ...atomic():' — scrapes race the "
+                            "write; take the lock (private _helpers "
+                            "run under the caller's lock and are "
+                            "exempt)")
+                        break
+
     def _check_sys_path(self, node):
         d = _dotted(node.func)
         if d in ("sys.path.insert", "sys.path.append"):
@@ -403,7 +517,16 @@ def check_file(path: Path, root: Path | None = None,
         # a typo'd directive must be a located finding, not a crash
         return [Finding(str(path), getattr(e, "lineno", 0),
                         "scope-directive", str(e))]
-    return _FileChecker(path, src, tree, scope).run()
+    findings = _FileChecker(path, src, tree, scope).run()
+    # a bracketed ignore naming an unknown rule suppresses NOTHING —
+    # reject it by name (round 19) instead of silently accepting it
+    for line, name in validate_pragmas(src, RULES):
+        findings.append(Finding(
+            str(path), line, "pragma-directive",
+            f"unknown rule {name!r} in '# graftlint: ignore[...]' "
+            f"pragma (one of: {', '.join(sorted(RULES))})"))
+    findings.sort(key=lambda f: (f.line, f.rule))
+    return findings
 
 
 def _is_seeded_fixture(path: Path) -> bool:

@@ -950,9 +950,12 @@ def _probe_delays_paired():
                        delays=DelayConfig(1, 0, 1))   # must raise
 
 
-def _probe_delays_rpc():
-    """Delays + rpc_probe: the per-RPC reconstruction cannot place
-    in-flight slots — refused by name at trace time."""
+def _probe_delays_rpc_line():
+    """Delay-armed rpc_probe needs the probe delay line allocated at
+    BUILD time (make_gossip_sim(..., delays_probe=True)): a probe
+    step on a sim built without it is refused by name rather than
+    silently emitting same-tick arrivals for in-flight RPCs (the
+    round-20 lift of the old delays[rpc-probe] refusal)."""
     import jax
     gs, cfg, params, state = _delayed_gossip_build()
     step = gs.make_gossip_step(cfg, rpc_probe=True)
@@ -1186,9 +1189,17 @@ _PROBE_REFUSALS = {
     "delays[paired-topics]":
         (_probe_delays_paired,
          r"paired-topic mode is not delay-supported"),
-    "delays[rpc-probe]":
-        (_probe_delays_rpc,
-         r"delay-armed sims are not probe-supported"),
+    # round 20: the delays[rpc-probe] refusal is LIFTED — the probe
+    # snapshot is a pure readout, so its three send-class attempt
+    # masks ride a dedicated [K, 3, N] probe delay line and the
+    # snapshot gains arr_* arrival leaves (DelayConfig(1, 0, 1)
+    # bit-parity pinned by tests/test_delays.py).  What remains is
+    # the build requirement: the probe line must be allocated up
+    # front (delays_probe=True).
+    "delays[rpc-probe-line]":
+        (_probe_delays_rpc_line,
+         r"delay-armed rpc_probe needs the probe delay line",
+         ValueError),
     # round 19: the delays[telemetry-counters] refusal is LIFTED —
     # send-side tallies ride delay_exchange and arrival-side RPC /
     # duplicate accounting reads the dequeued advert + gossip

@@ -1,0 +1,58 @@
+"""Seeded violations for the ``lock-discipline`` rule (round 19).
+
+``LeakyRegistry`` owns ``self._lock``, so its PUBLIC methods must
+mutate self-rooted state only under ``with ..._lock:`` or
+``with ...atomic():`` — two methods here don't (the findings).  The
+guarded methods, the private ``_push`` helper (caller-holds-lock
+convention, like SpanRecorder._push), and ``PlainCounters`` (uses a
+registry's ``atomic()`` but owns no lock — the frontend pattern, must
+NOT qualify) pin the rule's negative space.
+"""
+# graftlint: scope=service
+
+import threading
+
+
+class LeakyRegistry:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.count = 0
+        self.rows = {}
+        self.last = None
+
+    def inc(self):
+        self.count += 1          # FINDING: unguarded AugAssign
+
+    def put(self, key, value):
+        self.rows[key] = value   # FINDING: unguarded item write
+
+    def inc_locked(self):
+        with self._lock:
+            self.count += 1      # clean: lexical lock
+
+    def put_atomic(self, reg, key, value):
+        with reg.atomic():
+            self.rows[key] = value   # clean: atomic() guard
+
+    def snapshot(self):
+        with self._lock:
+            total = self.count   # clean: local, not self-rooted
+        return total
+
+    def _push(self, value):
+        self.last = value        # clean: private, caller holds lock
+
+
+class PlainCounters:
+    """No ``self._lock`` — using a registry's ``atomic()`` alone must
+    not make the class qualify."""
+
+    def __init__(self):
+        self.n = 0
+
+    def bump(self, registry):
+        with registry.atomic():
+            self.n += 1
+
+    def bump_plain(self):
+        self.n += 1              # clean: class does not own a lock

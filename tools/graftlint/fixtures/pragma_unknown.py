@@ -1,0 +1,13 @@
+"""Seeded violation for the ``pragma-directive`` finding (round 19).
+
+The bracketed ignore below typos the rule name — before round 19 it
+was silently accepted, a suppression that guarded nothing while
+looking auditable.  Now it must be rejected BY NAME (pragma-directive
+finding at its line), and the sys-path-insert finding it failed to
+silence still fires on the same line.
+"""
+# graftlint: scope=tools
+
+import sys
+
+sys.path.insert(0, ".")  # graftlint: ignore[sys-path-insrt]

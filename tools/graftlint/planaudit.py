@@ -1,0 +1,1049 @@
+"""Exhaustive capability-lattice audit: every cell PLANS or REFUSES.
+
+The round-20 static pass behind the capability planner
+(``models/plan.py``).  It enumerates the full feature lattice — all
+six execution paths crossed with the feature axes (faults, telemetry,
+scores, delays and their armed observer/probe lines, knobs, attacks,
+PX/direct overlays, padding/alignment, fused residency, the sharded
+fused composition, checkpoint segmentation, and the serving surface)
+— and cross-checks EVERY cell's planner verdict against reality:
+
+- a **PLAN** cell must trace (``jax.make_jaxpr`` on the real step /
+  window / runner, never executing a tick — enforced by the same
+  backend-compile guard the jaxpr audit's tests pin) and its jaxpr
+  must contain the plan's declared primitives and none of its
+  forbidden ones (e.g. the sharded fused composition must carry
+  ``shard_map`` + ``dma_start``/``dma_wait`` and must NOT fall back
+  to the ``ppermute`` halo);
+- a **REFUSE** cell must raise the planner's EXACT named string, as
+  the planner's exception class, from the real entry point;
+- a cell whose verdict is neither, or that lacks its trace/provoke
+  arm, is an audit failure — 100% of the lattice classifies.
+
+``capability_matrix()`` serializes the verdicts as the golden matrix
+(``PLAN_r19.json``, gated by ``tools/planstat.py --check``);
+``matrix_markdown()`` renders the README capability table from the
+same verdicts, so the prose can never drift from the planner.
+
+Cells marked ``fast`` form the seconds-scale preflight subset
+(``--plan-fast`` / tools/lint.sh / tier-1 tests); the full sweep runs
+in graftlint's default suite and measure_all.sh step 0.5.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .contracts import C, KERNEL_BLOCK, M, N, T
+
+MATRIX_SCHEMA = "plan-matrix-v1"
+MATRIX_ROUND = 19
+
+
+@dataclasses.dataclass(frozen=True)
+class Cell:
+    """One lattice cell.  ``build()`` returns a dict with ``verdict``
+    (the planner's ExecutionPlan | Refusal) plus the arm that proves
+    it: ``trace`` (() -> ClosedJaxpr, PLAN cells) or ``provoke``
+    (() -> None that must raise, REFUSE cells)."""
+
+    id: str
+    path: str                # lattice path / composition family
+    feature: str
+    build: object
+    fast: bool = False
+
+
+# --------------------------------------------------------------------------
+# Build helpers (lazy jax imports; shapes distinct per concern)
+# --------------------------------------------------------------------------
+
+
+def _gossip_build(n=N, pad=None, paired=False, offsets=None, **kw):
+    import numpy as np
+
+    import go_libp2p_pubsub_tpu.models.gossipsub as gs
+    cfg = gs.GossipSimConfig(
+        offsets=(offsets if offsets is not None
+                 else gs.make_gossip_offsets(T, C, n, seed=1,
+                                             paired=paired)),
+        n_topics=T, paired_topics=paired, d=3, d_lo=2, d_hi=6,
+        d_score=2, d_out=1, d_lazy=2, backoff_ticks=8)
+    subs = np.zeros((n, T), dtype=bool)
+    own = np.arange(n) % T
+    subs[np.arange(n), own] = True
+    if paired:
+        subs[np.arange(n), (own + T // 2) % T] = True
+    rng = np.random.default_rng(0)
+    topic = rng.integers(0, T, M)
+    origin = rng.integers(0, n // T, M) * T + topic
+    ticks = np.zeros(M, dtype=np.int32)
+    if pad is not None:
+        kw["pad_to_block"] = pad
+    params, state = gs.make_gossip_sim(cfg, subs, topic, origin,
+                                       ticks, seed=0, **kw)
+    return gs, cfg, params, state
+
+
+def _sched(n=N, cold=False):
+    from go_libp2p_pubsub_tpu.models.faults import FaultSchedule
+    return FaultSchedule(n_peers=n, horizon=4,
+                         down_intervals=((0, 0, 2), (3, 1, 3)),
+                         drop_prob=0.1, cold_restart=cold, seed=0)
+
+
+def _delay_cfg(k=4):
+    from go_libp2p_pubsub_tpu.models.delays import DelayConfig
+    return DelayConfig(base=1, jitter=1, k_slots=k)
+
+
+def _trace_step(gs, cfg, params, state, sc=None, **step_kw):
+    import jax
+    step = gs.make_gossip_step(cfg, sc, **step_kw)
+    return jax.make_jaxpr(step)(params, state)
+
+
+def _eval_step(gs, cfg, params, state, sc=None, **step_kw):
+    import jax
+    step = gs.make_gossip_step(cfg, sc, **step_kw)
+    jax.eval_shape(step, params, state)   # refusal cells: must raise
+
+
+def _window(gs, cfg, sc=None, ticks=2, block=KERNEL_BLOCK, **kw):
+    return gs.make_fused_window(cfg, sc, ticks_fused=ticks,
+                                receive_block=block,
+                                receive_interpret=True,
+                                on_refusal="raise", **kw)
+
+
+def _mesh(devices):
+    import jax
+
+    from go_libp2p_pubsub_tpu.parallel import mesh as pmesh
+    return pmesh.make_mesh(devices=jax.devices("cpu")[:devices])
+
+
+def _flood_inputs(n=N):
+    import numpy as np
+    subs = np.zeros((n, T), dtype=bool)
+    subs[np.arange(n), np.arange(n) % T] = True
+    rng = np.random.default_rng(0)
+    topic = rng.integers(0, T, M)
+    origin = rng.integers(0, n // T, M) * T + topic
+    ticks = np.zeros(M, dtype=np.int32)
+    return subs, topic, origin, ticks
+
+
+def _circ_offsets():
+    from go_libp2p_pubsub_tpu.ops.graph import make_circulant_offsets
+    return tuple(int(o) for o in make_circulant_offsets(T, C, N,
+                                                        seed=1))
+
+
+def _gather_table():
+    import numpy as np
+    offs = _circ_offsets()
+    nbrs = np.stack([(np.arange(N) + o) % N for o in offs], axis=1)
+    return nbrs, np.ones_like(nbrs, dtype=bool)
+
+
+# --------------------------------------------------------------------------
+# The lattice
+# --------------------------------------------------------------------------
+
+
+def build_cells() -> list[Cell]:
+    from go_libp2p_pubsub_tpu.models import plan as _plan
+
+    cells: list[Cell] = []
+
+    def cell(id, path, feature, fn, fast=False):
+        cells.append(Cell(id, path, feature, fn, fast))
+
+    # -- gossip-xla ---------------------------------------------------------
+
+    def xla_plain():
+        gs, cfg, params, state = _gossip_build()
+        return dict(
+            verdict=_plan.plan_gossip_step(cfg, None, params, state),
+            trace=lambda: _trace_step(gs, cfg, params, state))
+    cell("xla/plain", "gossip-xla", "plain", xla_plain, fast=True)
+
+    def xla_faults():
+        gs, cfg, params, state = _gossip_build(fault_schedule=_sched())
+        return dict(
+            verdict=_plan.plan_gossip_step(cfg, None, params, state),
+            trace=lambda: _trace_step(gs, cfg, params, state))
+    cell("xla/faults", "gossip-xla", "faults", xla_faults)
+
+    def xla_telemetry():
+        import go_libp2p_pubsub_tpu.models.telemetry as tl
+        gs, cfg, params, state = _gossip_build()
+        tcfg = tl.TelemetryConfig()
+        return dict(
+            verdict=_plan.plan_gossip_step(cfg, None, params, state,
+                                           telemetry=tcfg),
+            trace=lambda: _trace_step(gs, cfg, params, state,
+                                      telemetry=tcfg))
+    cell("xla/telemetry", "gossip-xla", "telemetry", xla_telemetry)
+
+    def xla_scored():
+        import go_libp2p_pubsub_tpu.models.gossipsub as gsm
+        sc = gsm.ScoreSimConfig()
+        gs, cfg, params, state = _gossip_build(score_cfg=sc)
+        return dict(
+            verdict=_plan.plan_gossip_step(cfg, sc, params, state),
+            trace=lambda: _trace_step(gs, cfg, params, state, sc))
+    cell("xla/scored", "gossip-xla", "scored", xla_scored)
+
+    def xla_delays():
+        gs, cfg, params, state = _gossip_build(delays=_delay_cfg())
+        return dict(
+            verdict=_plan.plan_gossip_step(cfg, None, params, state),
+            trace=lambda: _trace_step(gs, cfg, params, state))
+    cell("xla/delays", "gossip-xla", "delays", xla_delays, fast=True)
+
+    def xla_probe():
+        gs, cfg, params, state = _gossip_build(fault_schedule=_sched())
+        return dict(
+            verdict=_plan.plan_gossip_step(cfg, None, params, state,
+                                           rpc_probe=True),
+            trace=lambda: _trace_step(gs, cfg, params, state,
+                                      rpc_probe=True))
+    cell("xla/rpc-probe", "gossip-xla", "rpc-probe", xla_probe)
+
+    def xla_delays_probe():
+        # the round-20 lifted registry hole: delays x rpc_probe PLANS
+        # when the probe delay line is armed at build
+        gs, cfg, params, state = _gossip_build(delays=_delay_cfg(),
+                                               delays_probe=True)
+        return dict(
+            verdict=_plan.plan_gossip_step(cfg, None, params, state,
+                                           rpc_probe=True),
+            trace=lambda: _trace_step(gs, cfg, params, state,
+                                      rpc_probe=True))
+    cell("xla/delays-rpc-probe", "gossip-xla", "delays+rpc-probe",
+         xla_delays_probe, fast=True)
+
+    def xla_delays_counters():
+        import go_libp2p_pubsub_tpu.models.telemetry as tl
+        gs, cfg, params, state = _gossip_build(delays=_delay_cfg(),
+                                               delays_counters=True)
+        tcfg = tl.TelemetryConfig()
+        return dict(
+            verdict=_plan.plan_gossip_step(cfg, None, params, state,
+                                           telemetry=tcfg),
+            trace=lambda: _trace_step(gs, cfg, params, state,
+                                      telemetry=tcfg))
+    cell("xla/delays-counters", "gossip-xla", "delays+counters",
+         xla_delays_counters)
+
+    def xla_delays_paired():
+        gs, cfg, params, state = _gossip_build(paired=True)
+        _, _, dparams, _ = _gossip_build(delays=_delay_cfg())
+        grafted = params.replace(delays=dparams.delays)
+
+        def provoke():
+            from go_libp2p_pubsub_tpu.models.delays import DelayConfig
+            _gossip_build(paired=True, delays=DelayConfig(1, 0, 1))
+        return dict(
+            verdict=_plan.plan_gossip_step(cfg, None, grafted, state),
+            provoke=provoke)
+    cell("xla/delays-paired", "gossip-xla", "delays+paired",
+         xla_delays_paired)
+
+    def xla_delays_probe_line():
+        gs, cfg, params, state = _gossip_build(delays=_delay_cfg())
+        return dict(
+            verdict=_plan.plan_gossip_step(cfg, None, params, state,
+                                           rpc_probe=True),
+            provoke=lambda: _eval_step(gs, cfg, params, state,
+                                       rpc_probe=True))
+    cell("xla/delays-probe-line", "gossip-xla",
+         "delays+rpc-probe, line unarmed", xla_delays_probe_line,
+         fast=True)
+
+    def xla_delays_counter_lines():
+        import go_libp2p_pubsub_tpu.models.telemetry as tl
+        gs, cfg, params, state = _gossip_build(delays=_delay_cfg())
+        tcfg = tl.TelemetryConfig()
+        return dict(
+            verdict=_plan.plan_gossip_step(cfg, None, params, state,
+                                           telemetry=tcfg),
+            provoke=lambda: _eval_step(gs, cfg, params, state,
+                                       telemetry=tcfg))
+    cell("xla/delays-counter-lines", "gossip-xla",
+         "delays+counters, lines unarmed", xla_delays_counter_lines)
+
+    def xla_delays_lines():
+        gs, cfg, dparams, _ = _gossip_build(delays=_delay_cfg())
+        _, _, _, pstate = _gossip_build()
+        return dict(
+            verdict=_plan.plan_gossip_step(cfg, None, dparams, pstate),
+            provoke=lambda: _eval_step(gs, cfg, dparams, pstate))
+    cell("xla/delays-lines", "gossip-xla",
+         "delayed params, undelayed state", xla_delays_lines)
+
+    def xla_delays_split():
+        gs, cfg, params, state = _gossip_build(delays=_delay_cfg())
+        return dict(
+            verdict=_plan.plan_gossip_step(cfg, None, params, state,
+                                           force_split=True),
+            provoke=lambda: _eval_step(gs, cfg, params, state,
+                                       force_split=True))
+    cell("xla/delays-split-line", "gossip-xla",
+         "delays+split, line unarmed", xla_delays_split)
+
+    def xla_probe_mixed():
+        import numpy as np
+        gs, cfg, params, state = _gossip_build(
+            flood_proto=(np.arange(N) % 7) == 0)
+        return dict(
+            verdict=_plan.plan_gossip_step(cfg, None, params, state,
+                                           rpc_probe=True),
+            provoke=lambda: _eval_step(gs, cfg, params, state,
+                                       rpc_probe=True))
+    cell("xla/probe-mixed-protocol", "gossip-xla",
+         "rpc-probe+flood-proto", xla_probe_mixed)
+
+    def xla_padded():
+        gs, cfg, params, state = _gossip_build(pad=KERNEL_BLOCK)
+        return dict(
+            verdict=_plan.plan_gossip_step(
+                cfg, None, params, state, use_pallas_receive=False),
+            provoke=lambda: _eval_step(gs, cfg, params, state,
+                                       use_pallas_receive=False))
+    cell("xla/padded-state", "gossip-xla", "padded layout, XLA forced",
+         xla_padded, fast=True)
+
+    # -- gossip-kernel ------------------------------------------------------
+
+    KSTEP = dict(receive_block=KERNEL_BLOCK, receive_interpret=True)
+
+    def kernel_plain():
+        gs, cfg, params, state = _gossip_build(pad=KERNEL_BLOCK)
+        return dict(
+            verdict=_plan.plan_gossip_step(cfg, None, params, state),
+            trace=lambda: _trace_step(gs, cfg, params, state, **KSTEP))
+    cell("kernel/plain", "gossip-kernel", "plain", kernel_plain,
+         fast=True)
+
+    def kernel_faults():
+        gs, cfg, params, state = _gossip_build(pad=KERNEL_BLOCK,
+                                               fault_schedule=_sched())
+        return dict(
+            verdict=_plan.plan_gossip_step(cfg, None, params, state),
+            trace=lambda: _trace_step(gs, cfg, params, state, **KSTEP))
+    cell("kernel/faults", "gossip-kernel", "faults", kernel_faults)
+
+    def kernel_telemetry():
+        import go_libp2p_pubsub_tpu.models.telemetry as tl
+        gs, cfg, params, state = _gossip_build(pad=KERNEL_BLOCK)
+        tcfg = tl.TelemetryConfig()
+        return dict(
+            verdict=_plan.plan_gossip_step(cfg, None, params, state,
+                                           telemetry=tcfg),
+            trace=lambda: _trace_step(gs, cfg, params, state,
+                                      telemetry=tcfg, **KSTEP))
+    cell("kernel/telemetry", "gossip-kernel", "telemetry",
+         kernel_telemetry)
+
+    def kernel_scored():
+        import go_libp2p_pubsub_tpu.models.gossipsub as gsm
+        sc = gsm.ScoreSimConfig()
+        gs, cfg, params, state = _gossip_build(pad=KERNEL_BLOCK,
+                                               score_cfg=sc)
+        return dict(
+            verdict=_plan.plan_gossip_step(cfg, sc, params, state),
+            trace=lambda: _trace_step(gs, cfg, params, state, sc,
+                                      **KSTEP))
+    cell("kernel/scored", "gossip-kernel", "scored", kernel_scored)
+
+    def kernel_delays():
+        gs, cfg, params, state = _gossip_build(pad=KERNEL_BLOCK,
+                                               delays=_delay_cfg())
+        return dict(
+            verdict=_plan.plan_gossip_step(cfg, None, params, state),
+            trace=lambda: _trace_step(gs, cfg, params, state, **KSTEP))
+    cell("kernel/delays", "gossip-kernel", "delays", kernel_delays)
+
+    def kernel_knob_iwant():
+        import numpy as np
+
+        import go_libp2p_pubsub_tpu.models.gossipsub as gsm
+        sc = gsm.ScoreSimConfig(sybil_iwant_spam=True)
+        gs, cfg, params, state = _gossip_build(
+            pad=KERNEL_BLOCK, score_cfg=sc,
+            sybil=(np.arange(N) % 5) == 0,
+            sim_knobs={"gossip_retransmission": 3})
+        return dict(
+            verdict=_plan.plan_gossip_step(cfg, sc, params, state),
+            provoke=lambda: _eval_step(gs, cfg, params, state, sc,
+                                       **KSTEP))
+    cell("kernel/knobs-iwant-spam", "gossip-kernel",
+         "knobs+iwant-spam attack", kernel_knob_iwant)
+
+    def kernel_delay_iwant():
+        import numpy as np
+
+        import go_libp2p_pubsub_tpu.models.gossipsub as gsm
+        sc = gsm.ScoreSimConfig(sybil_iwant_spam=True)
+        gs, cfg, params, state = _gossip_build(
+            pad=KERNEL_BLOCK, score_cfg=sc,
+            sybil=(np.arange(N) % 5) == 0, delays=_delay_cfg())
+        return dict(
+            verdict=_plan.plan_gossip_step(cfg, sc, params, state),
+            provoke=lambda: _eval_step(gs, cfg, params, state, sc,
+                                       **KSTEP))
+    cell("kernel/delays-iwant-spam", "gossip-kernel",
+         "delays+iwant-spam attack", kernel_delay_iwant)
+
+    def kernel_config():
+        import go_libp2p_pubsub_tpu.models.gossipsub as gsm
+        sc = gsm.ScoreSimConfig(mesh_message_deliveries_weight=-1.0)
+        gs, cfg, params, state = _gossip_build(pad=KERNEL_BLOCK,
+                                               score_cfg=sc)
+        return dict(
+            verdict=_plan.plan_gossip_step(cfg, sc, params, state),
+            provoke=lambda: _eval_step(gs, cfg, params, state, sc,
+                                       **KSTEP))
+    cell("kernel/config-p3", "gossip-kernel", "P3 provenance scoring",
+         kernel_config)
+
+    def kernel_needs_pad():
+        gs, cfg, params, state = _gossip_build()
+        return dict(
+            verdict=_plan.plan_gossip_step(
+                cfg, None, params, state, use_pallas_receive=True),
+            provoke=lambda: _eval_step(gs, cfg, params, state,
+                                       use_pallas_receive=True))
+    cell("kernel/needs-pad", "gossip-kernel",
+         "unpadded layout, kernel forced", kernel_needs_pad, fast=True)
+
+    # -- gossip-kernel-fused ------------------------------------------------
+
+    def fused_plain():
+        import jax
+        gs, cfg, params, state = _gossip_build(n=KERNEL_BLOCK,
+                                               pad=KERNEL_BLOCK)
+        return dict(
+            verdict=_plan.plan_fused_window(cfg, None, params, state,
+                                            2),
+            trace=lambda: jax.make_jaxpr(_window(gs, cfg))(params,
+                                                           state))
+    cell("fused/plain", "gossip-kernel-fused", "plain", fused_plain,
+         fast=True)
+
+    def fused_faults():
+        import jax
+        gs, cfg, params, state = _gossip_build(
+            n=KERNEL_BLOCK, pad=KERNEL_BLOCK,
+            fault_schedule=_sched(n=KERNEL_BLOCK))
+        return dict(
+            verdict=_plan.plan_fused_window(cfg, None, params, state,
+                                            2),
+            trace=lambda: jax.make_jaxpr(_window(gs, cfg))(params,
+                                                           state))
+    cell("fused/faults", "gossip-kernel-fused", "faults", fused_faults)
+
+    def fused_ckpt_aligned():
+        import jax
+
+        from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
+        gs, cfg, params, state = _gossip_build(n=KERNEL_BLOCK,
+                                               pad=KERNEL_BLOCK)
+        ckpt = ck.CheckpointConfig(directory="/tmp/planaudit-ckpt",
+                                   every=4)
+        return dict(
+            verdict=_plan.plan_fused_window(cfg, None, params, state,
+                                            4, checkpoint=ckpt,
+                                            ckpt_horizon=8),
+            trace=lambda: jax.make_jaxpr(
+                _window(gs, cfg, ticks=4))(params, state))
+    cell("fused/ckpt-aligned", "gossip-kernel-fused",
+         "checkpoint, aligned segments", fused_ckpt_aligned)
+
+    def fused_window_zero():
+        gs, cfg, params, state = _gossip_build(n=KERNEL_BLOCK,
+                                               pad=KERNEL_BLOCK)
+        return dict(
+            verdict=_plan.plan_fused_window(cfg, None, params, state,
+                                            0),
+            provoke=lambda: _window(gs, cfg, ticks=0))
+    cell("fused/window", "gossip-kernel-fused", "zero-tick window",
+         fused_window_zero)
+
+    def fused_base_wrap():
+        import numpy as np
+        gs, cfg, params, state = _gossip_build(
+            n=KERNEL_BLOCK, pad=KERNEL_BLOCK,
+            flood_proto=(np.arange(KERNEL_BLOCK) % 7) == 0)
+        return dict(
+            verdict=_plan.plan_fused_window(cfg, None, params, state,
+                                            2),
+            provoke=lambda: _window(gs, cfg)(params, state))
+    cell("fused/kernel-config", "gossip-kernel-fused",
+         "per-tick kernel refusal, fused-wrapped", fused_base_wrap)
+
+    def fused_unpadded():
+        gs, cfg, params, state = _gossip_build(n=KERNEL_BLOCK)
+        return dict(
+            verdict=_plan.plan_fused_window(cfg, None, params, state,
+                                            2),
+            provoke=lambda: _window(gs, cfg)(params, state))
+    cell("fused/unpadded", "gossip-kernel-fused", "unpadded layout",
+         fused_unpadded)
+
+    def fused_scored():
+        import go_libp2p_pubsub_tpu.models.gossipsub as gsm
+        sc = gsm.ScoreSimConfig()
+        gs, cfg, params, state = _gossip_build(pad=KERNEL_BLOCK,
+                                               score_cfg=sc)
+        return dict(
+            verdict=_plan.plan_fused_window(cfg, sc, params, state, 2),
+            provoke=lambda: _window(gs, cfg, sc)(params, state))
+    cell("fused/scored", "gossip-kernel-fused", "scored", fused_scored)
+
+    def fused_paired():
+        gs, cfg, params, state = _gossip_build(paired=True,
+                                               pad=KERNEL_BLOCK)
+        return dict(
+            verdict=_plan.plan_fused_window(cfg, None, params, state,
+                                            2),
+            provoke=lambda: _window(gs, cfg)(params, state))
+    cell("fused/paired", "gossip-kernel-fused", "paired topics",
+         fused_paired)
+
+    def fused_delays():
+        gs, cfg, params, state = _gossip_build(pad=KERNEL_BLOCK,
+                                               delays=_delay_cfg())
+        return dict(
+            verdict=_plan.plan_fused_window(cfg, None, params, state,
+                                            2),
+            provoke=lambda: _window(gs, cfg)(params, state))
+    cell("fused/delays", "gossip-kernel-fused", "delays", fused_delays)
+
+    def fused_knobs():
+        gs, cfg, params, state = _gossip_build(pad=KERNEL_BLOCK,
+                                               sim_knobs={"d": 4})
+        return dict(
+            verdict=_plan.plan_fused_window(cfg, None, params, state,
+                                            2),
+            provoke=lambda: _window(gs, cfg)(params, state))
+    cell("fused/knobs", "gossip-kernel-fused", "traced knobs",
+         fused_knobs)
+
+    def fused_px():
+        gs, cfg, params, state = _gossip_build(pad=KERNEL_BLOCK,
+                                               px_candidates=7)
+        return dict(
+            verdict=_plan.plan_fused_window(cfg, None, params, state,
+                                            2),
+            provoke=lambda: _window(gs, cfg)(params, state))
+    cell("fused/px", "gossip-kernel-fused", "PX rotation", fused_px)
+
+    def fused_direct():
+        import numpy as np
+
+        import go_libp2p_pubsub_tpu.models.gossipsub as gsm
+        cfg0 = gsm.GossipSimConfig(
+            offsets=gsm.make_gossip_offsets(T, C, N, seed=1),
+            n_topics=T, d=3, d_lo=2, d_hi=6, d_score=2, d_out=1,
+            d_lazy=2, backoff_ticks=8)
+        f = (np.arange(N) % 5) == 0
+        de = np.zeros((N, C), dtype=bool)
+        for c_ in (0, cfg0.cinv[0]):
+            de[:, c_] = f | np.roll(f, -int(cfg0.offsets[c_]))
+        gs, cfg, params, state = _gossip_build(pad=KERNEL_BLOCK,
+                                               direct_edges=de)
+        return dict(
+            verdict=_plan.plan_fused_window(cfg, None, params, state,
+                                            2),
+            provoke=lambda: _window(gs, cfg)(params, state))
+    cell("fused/direct", "gossip-kernel-fused", "direct peers",
+         fused_direct)
+
+    def fused_pad_mismatch():
+        gs, cfg, params, state = _gossip_build(pad=KERNEL_BLOCK)
+        return dict(
+            verdict=_plan.plan_fused_window(cfg, None, params, state,
+                                            2),
+            provoke=lambda: _window(gs, cfg)(params, state))
+    cell("fused/pad-mismatch", "gossip-kernel-fused",
+         "pad lanes present", fused_pad_mismatch, fast=True)
+
+    def fused_align():
+        gs, cfg, params, state = _gossip_build(n=1152, pad=128)
+        return dict(
+            verdict=_plan.plan_fused_window(cfg, None, params, state,
+                                            2),
+            provoke=lambda: _window(gs, cfg, block=128)(params, state))
+    cell("fused/align", "gossip-kernel-fused", "ring off the u32 tile",
+         fused_align)
+
+    def fused_vmem():
+        gs, cfg, params, state = _gossip_build(n=KERNEL_BLOCK,
+                                               pad=KERNEL_BLOCK)
+        return dict(
+            verdict=_plan.plan_fused_window(
+                cfg, None, params, state, 2,
+                vmem_budget_bytes=1 << 16),
+            provoke=lambda: _window(
+                gs, cfg, vmem_budget_bytes=1 << 16)(params, state))
+    cell("fused/vmem", "gossip-kernel-fused", "carry past VMEM budget",
+         fused_vmem)
+
+    def fused_horizon():
+        gs, cfg, params, state = _gossip_build(n=KERNEL_BLOCK,
+                                               pad=KERNEL_BLOCK)
+
+        def provoke():
+            gs.gossip_run_fused(params, state, 3, _window(gs, cfg))
+        return dict(
+            verdict=_plan.plan_fused_window(cfg, None, params, state,
+                                            2, horizon=3),
+            provoke=provoke)
+    cell("fused/horizon", "gossip-kernel-fused", "indivisible horizon",
+         fused_horizon)
+
+    def fused_ckpt_boundary():
+        from go_libp2p_pubsub_tpu.parallel import checkpoint as ck
+        gs, cfg, params, state = _gossip_build(n=KERNEL_BLOCK,
+                                               pad=KERNEL_BLOCK)
+        ckpt = ck.CheckpointConfig(directory="/tmp/planaudit-ckpt",
+                                   every=6)
+
+        def provoke():
+            ck.ckpt_gossip_run_fused(params, state, 8,
+                                     _window(gs, cfg, ticks=4), ckpt)
+        return dict(
+            verdict=_plan.plan_fused_window(cfg, None, params, state,
+                                            4, checkpoint=ckpt,
+                                            ckpt_horizon=8),
+            provoke=provoke)
+    cell("fused/ckpt-boundary", "gossip-kernel-fused",
+         "checkpoint boundary mid-window", fused_ckpt_boundary)
+
+    # -- gossip-kernel-fused-sharded ----------------------------------------
+
+    def sharded_plain():
+        import jax
+
+        from go_libp2p_pubsub_tpu.parallel import sharded as psh
+        mesh = _mesh(2)
+        n = 2 * KERNEL_BLOCK
+        gs, cfg, params, state = _gossip_build(n=n, pad=KERNEL_BLOCK)
+        verdict = _plan.plan_fused_window(cfg, None, params, state, 2,
+                                          sharded=True, devices=2)
+        # shard placement compiles device transfers — do it at build,
+        # keep only the make_jaxpr under the backend-compile guard
+        window = _window(gs, cfg, shard_mesh=mesh)
+        p, s, sh = psh.shard_sim(params, state, mesh, n)
+        return dict(
+            verdict=verdict,
+            trace=lambda: jax.make_jaxpr(
+                lambda pp, ss: psh.sharded_gossip_run_fused(
+                    pp, ss, 4, window, sh))(p, s))
+    cell("sharded/plain", "gossip-kernel-fused-sharded", "plain, D=2",
+         sharded_plain)
+
+    def sharded_devices():
+        gs, cfg, params, state = _gossip_build(n=KERNEL_BLOCK,
+                                               pad=KERNEL_BLOCK)
+        return dict(
+            verdict=_plan.plan_fused_window(cfg, None, params, state,
+                                            2, sharded=True,
+                                            devices=1),
+            provoke=lambda: _window(
+                gs, cfg, shard_mesh=_mesh(1))(params, state))
+    cell("sharded/devices", "gossip-kernel-fused-sharded",
+         "degenerate 1-extent mesh", sharded_devices)
+
+    def sharded_divisible():
+        gs, cfg, params, state = _gossip_build(n=KERNEL_BLOCK,
+                                               pad=KERNEL_BLOCK)
+        return dict(
+            verdict=_plan.plan_fused_window(cfg, None, params, state,
+                                            2, sharded=True,
+                                            devices=3),
+            provoke=lambda: _window(
+                gs, cfg, shard_mesh=_mesh(3))(params, state))
+    cell("sharded/divisible", "gossip-kernel-fused-sharded",
+         "ring not divisible by D", sharded_divisible)
+
+    def sharded_tile():
+        gs, cfg, params, state = _gossip_build(n=1152, pad=64)
+        return dict(
+            verdict=_plan.plan_fused_window(cfg, None, params, state,
+                                            2, sharded=True,
+                                            devices=2),
+            provoke=lambda: _window(
+                gs, cfg, block=64,
+                shard_mesh=_mesh(2))(params, state))
+    cell("sharded/tile", "gossip-kernel-fused-sharded",
+         "shard splits a 128-lane tile", sharded_tile)
+
+    def sharded_halo():
+        offs = (2, -2, 4, -4, 6, -6, 600, -600)
+        gs, cfg, params, state = _gossip_build(n=KERNEL_BLOCK,
+                                               pad=KERNEL_BLOCK,
+                                               offsets=offs)
+        return dict(
+            verdict=_plan.plan_fused_window(cfg, None, params, state,
+                                            2, sharded=True,
+                                            devices=2),
+            provoke=lambda: _window(
+                gs, cfg, shard_mesh=_mesh(2))(params, state))
+    cell("sharded/halo", "gossip-kernel-fused-sharded",
+         "halo reach spans the ring", sharded_halo)
+
+    # -- mesh-less simulators -----------------------------------------------
+
+    def flood_circ(faulted):
+        def build():
+            import jax
+
+            import go_libp2p_pubsub_tpu.models.floodsub as fs
+            offs = _circ_offsets()
+            subs, topic, origin, ticks = _flood_inputs()
+            sched = _sched() if faulted else None
+            params, state = fs.make_flood_sim(
+                None, None, subs, None, topic, origin, ticks,
+                fault_schedule=sched, fault_offsets=offs)
+            core = fs.make_circulant_step_core(offs)
+            return dict(
+                verdict=_plan.plan_circulant("flood-circulant",
+                                             faults=sched),
+                trace=lambda: jax.make_jaxpr(
+                    lambda p, s: fs.flood_run_curve(p, s, 2, core,
+                                                    M))(params, state))
+        return build
+    cell("flood-circulant/plain", "flood-circulant", "plain",
+         flood_circ(False), fast=True)
+    cell("flood-circulant/faults", "flood-circulant", "faults",
+         flood_circ(True))
+
+    def flood_circ_cold():
+        import go_libp2p_pubsub_tpu.models.floodsub as fs
+        sched = _sched(cold=True)
+        offs = _circ_offsets()
+        subs, topic, origin, ticks = _flood_inputs()
+
+        def provoke():
+            fs.make_flood_sim(None, None, subs, None, topic, origin,
+                              ticks, fault_schedule=sched,
+                              fault_offsets=offs)
+        return dict(
+            verdict=_plan.plan_circulant("flood-circulant",
+                                         faults=sched),
+            provoke=provoke)
+    cell("flood-circulant/cold-restart", "flood-circulant",
+         "cold-restart churn", flood_circ_cold, fast=True)
+
+    def flood_gather(faulted):
+        def build():
+            import jax
+
+            import go_libp2p_pubsub_tpu.models.floodsub as fs
+            nbrs, mask = _gather_table()
+            subs, topic, origin, ticks = _flood_inputs()
+            sched = _sched() if faulted else None
+            params, state = fs.make_flood_sim(
+                nbrs, mask, subs, None, topic, origin, ticks,
+                fault_schedule=sched)
+            core = fs.make_gather_step_core()
+            return dict(
+                verdict=_plan.plan_circulant("flood-gather",
+                                             faults=sched),
+                trace=lambda: jax.make_jaxpr(
+                    lambda p, s: fs.flood_run_curve(p, s, 2, core,
+                                                    M))(params, state))
+        return build
+    cell("flood-gather/plain", "flood-gather", "plain",
+         flood_gather(False))
+    cell("flood-gather/faults", "flood-gather", "faults",
+         flood_gather(True))
+
+    def flood_gather_cold():
+        import go_libp2p_pubsub_tpu.models.floodsub as fs
+        nbrs, mask = _gather_table()
+        sched = _sched(cold=True)
+        subs, topic, origin, ticks = _flood_inputs()
+
+        def provoke():
+            fs.make_flood_sim(nbrs, mask, subs, None, topic, origin,
+                              ticks, fault_schedule=sched)
+        return dict(
+            verdict=_plan.plan_circulant("flood-gather", faults=sched),
+            provoke=provoke)
+    cell("flood-gather/cold-restart", "flood-gather",
+         "cold-restart churn", flood_gather_cold)
+
+    def _rs_build(dense, faulted):
+        import go_libp2p_pubsub_tpu.models.randomsub as rs
+        rcfg = rs.RandomSubSimConfig(
+            offsets=rs.make_randomsub_offsets(T, C, N, seed=1),
+            n_topics=T, d=3)
+        subs, topic, origin, ticks = _flood_inputs()
+        sched = _sched() if faulted else None
+        params, state = rs.make_randomsub_sim(
+            rcfg, subs, topic, origin, ticks, dense=dense,
+            fault_schedule=sched)
+        step = (rs.make_randomsub_dense_step(rcfg) if dense
+                else rs.make_randomsub_step(rcfg))
+        return rs, rcfg, params, state, step, sched
+
+    def randomsub(dense, faulted):
+        path = ("randomsub-dense" if dense else "randomsub-circulant")
+
+        def build():
+            import jax
+            rs, rcfg, params, state, step, sched = _rs_build(dense,
+                                                             faulted)
+            return dict(
+                verdict=_plan.plan_circulant(path, faults=sched),
+                trace=lambda: jax.make_jaxpr(step)(params, state))
+        return build
+    cell("randomsub-circulant/plain", "randomsub-circulant", "plain",
+         randomsub(False, False))
+    cell("randomsub-circulant/faults", "randomsub-circulant", "faults",
+         randomsub(False, True))
+    cell("randomsub-dense/plain", "randomsub-dense", "plain",
+         randomsub(True, False))
+    cell("randomsub-dense/faults", "randomsub-dense", "faults",
+         randomsub(True, True))
+
+    def randomsub_cold(dense):
+        path = ("randomsub-dense" if dense else "randomsub-circulant")
+
+        def build():
+            import go_libp2p_pubsub_tpu.models.randomsub as rs
+            rcfg = rs.RandomSubSimConfig(
+                offsets=rs.make_randomsub_offsets(T, C, N, seed=1),
+                n_topics=T, d=3)
+            sched = _sched(cold=True)
+            subs, topic, origin, ticks = _flood_inputs()
+
+            def provoke():
+                rs.make_randomsub_sim(rcfg, subs, topic, origin,
+                                      ticks, dense=dense,
+                                      fault_schedule=sched)
+            return dict(
+                verdict=_plan.plan_circulant(path, faults=sched),
+                provoke=provoke)
+        return build
+    cell("randomsub-circulant/cold-restart", "randomsub-circulant",
+         "cold-restart churn", randomsub_cold(False))
+    cell("randomsub-dense/cold-restart", "randomsub-dense",
+         "cold-restart churn", randomsub_cold(True), fast=True)
+
+    # -- serving ------------------------------------------------------------
+
+    def serve_xla_batch():
+        import jax
+        import numpy as np
+        gs, cfg, params, state = _gossip_build()
+        verdict = _plan.plan_serving(kernel=False, batch=8, devices=0)
+
+        def trace():
+            step = gs.make_gossip_step(cfg)
+            bp = jax.tree_util.tree_map(
+                lambda x: np.broadcast_to(
+                    np.asarray(x), (8,) + np.asarray(x).shape),
+                (params, state))
+            return jax.make_jaxpr(jax.vmap(step))(*bp)
+        return dict(verdict=verdict, trace=trace)
+    cell("serving/xla-batch", "serving", "batched XLA dispatch, b=8",
+         serve_xla_batch, fast=True)
+
+    def serve_kernel_seq():
+        gs, cfg, params, state = _gossip_build(pad=KERNEL_BLOCK)
+        return dict(
+            verdict=_plan.plan_serving(kernel=True, batch=1,
+                                       devices=0),
+            trace=lambda: _trace_step(gs, cfg, params, state, **KSTEP))
+    cell("serving/kernel-seq", "serving", "sequential kernel path",
+         serve_kernel_seq, fast=True)
+
+    def serve_refuse(batch, devices, feature, fast=False):
+        def build():
+            from tools.sweepd import server_capability
+
+            def provoke():
+                reason = server_capability(kernel=True, batch=batch,
+                                           devices=devices)
+                if reason:
+                    raise ValueError(reason)
+            return dict(
+                verdict=_plan.plan_serving(kernel=True, batch=batch,
+                                           devices=devices),
+                provoke=provoke)
+        cell(f"serving/{feature}", "serving", feature, build,
+             fast=fast)
+    serve_refuse(8, 0, "kernel-batch", fast=True)
+    serve_refuse(1, 2, "kernel-devices", fast=True)
+
+    return cells
+
+
+# --------------------------------------------------------------------------
+# The audit
+# --------------------------------------------------------------------------
+
+
+def audit_cell(cell: Cell) -> list[str]:
+    """Problem strings for one cell (empty = verdict matches
+    reality)."""
+    import jax._src.compiler as _compiler
+
+    from go_libp2p_pubsub_tpu.models import plan as _plan
+
+    from .jaxpr_audit import _iter_eqns
+
+    pre = f"planaudit {cell.id}:"
+    try:
+        ctx = cell.build()
+    except Exception as e:  # graftlint: ignore[broad-except] — any cell failure becomes a named finding
+        return [f"{pre} cell build failed: {type(e).__name__}: {e}"]
+    verdict = ctx.get("verdict")
+
+    if isinstance(verdict, _plan.ExecutionPlan):
+        trace = ctx.get("trace")
+        if trace is None:
+            return [f"{pre} PLAN verdict but no trace arm — "
+                    "unclassifiable cell"]
+        compiled = []
+        orig = _compiler.backend_compile
+
+        def guard(*a, **kw):
+            compiled.append(a)
+            return orig(*a, **kw)
+
+        _compiler.backend_compile = guard
+        try:
+            closed = trace()
+        except Exception as e:  # graftlint: ignore[broad-except] — reported by name
+            return [f"{pre} PLAN cell failed to trace: "
+                    f"{type(e).__name__}: {e}"]
+        finally:
+            _compiler.backend_compile = orig
+        problems = []
+        if compiled:
+            problems.append(
+                f"{pre} PLAN trace reached the compiler "
+                f"{len(compiled)} time(s) — must trace only")
+        prims = {eqn.primitive.name for eqn in _iter_eqns(closed)}
+        missing = [p for p in verdict.primitives if p not in prims]
+        if missing:
+            problems.append(
+                f"{pre} declared primitives missing from the traced "
+                f"jaxpr: {missing} (plan path {verdict.path})")
+        banned = [p for p in verdict.forbidden if p in prims]
+        if banned:
+            problems.append(
+                f"{pre} forbidden primitives present in the traced "
+                f"jaxpr: {banned} (plan path {verdict.path})")
+        return problems
+
+    if isinstance(verdict, _plan.Refusal):
+        provoke = ctx.get("provoke")
+        if provoke is None:
+            return [f"{pre} REFUSE verdict but no provoke arm — "
+                    "unclassifiable cell"]
+        try:
+            provoke()
+        except verdict.exc as e:
+            if str(e) != verdict.message:
+                return [f"{pre} refusal string drift — planner says "
+                        f"{verdict.message!r}, entry point raised "
+                        f"{str(e)!r}"]
+            return []
+        except Exception as e:  # graftlint: ignore[broad-except] — reported by name
+            return [f"{pre} wrong exception class — planner says "
+                    f"{verdict.exc.__name__}, entry point raised "
+                    f"{type(e).__name__}: {e}"]
+        return [f"{pre} planner refuses ({verdict.code}) but the "
+                "entry point did not raise"]
+
+    return [f"{pre} unclassifiable verdict {type(verdict).__name__} "
+            "— planner must return ExecutionPlan or Refusal"]
+
+
+def run_planaudit(cells=None, fast_only: bool = False,
+                  log=None) -> list[str]:
+    """The whole lattice; returns all problems (empty = clean)."""
+    if cells is None:
+        cells = build_cells()
+    if fast_only:
+        cells = [c for c in cells if c.fast]
+    problems = []
+    for cell in cells:
+        probs = audit_cell(cell)
+        if log is not None:
+            log(f"  plan {cell.id}: "
+                f"{'OK' if not probs else f'{len(probs)} problem(s)'}")
+        problems.extend(probs)
+    return problems
+
+
+# --------------------------------------------------------------------------
+# Matrix serialization (the PLAN_r19.json golden artifact + README)
+# --------------------------------------------------------------------------
+
+
+def capability_matrix(cells=None) -> dict:
+    """The planner's verdict over every lattice cell, as data.  Builds
+    the cells (host-side sims) but never traces or provokes — the
+    audit proves the verdicts; this serializes them."""
+    from go_libp2p_pubsub_tpu.models import plan as _plan
+
+    if cells is None:
+        cells = build_cells()
+    rows = []
+    for cell in cells:
+        row = {"id": cell.id, "path": cell.path,
+               "feature": cell.feature}
+        try:
+            verdict = cell.build().get("verdict")
+        except Exception as e:  # graftlint: ignore[broad-except] — reported by name
+            row.update(verdict="ERROR",
+                       error=f"{type(e).__name__}: {e}")
+            rows.append(row)
+            continue
+        if isinstance(verdict, _plan.ExecutionPlan):
+            row.update(verdict="PLAN", plan_path=verdict.path,
+                       primitives=list(verdict.primitives),
+                       forbidden=list(verdict.forbidden))
+        elif isinstance(verdict, _plan.Refusal):
+            row.update(verdict="REFUSE", code=verdict.code,
+                       message=verdict.message,
+                       exc=verdict.exc.__name__)
+        else:
+            row.update(verdict="ERROR",
+                       error=f"unclassifiable verdict "
+                             f"{type(verdict).__name__}")
+        rows.append(row)
+    return {"schema": MATRIX_SCHEMA, "round": MATRIX_ROUND,
+            "cells": rows}
+
+
+def matrix_markdown(matrix: dict | None = None) -> str:
+    """The README capability table, rendered FROM the planner's
+    verdicts (never hand-edited)."""
+    if matrix is None:
+        matrix = capability_matrix()
+    lines = [
+        "| Cell | Feature | Verdict | Detail |",
+        "| --- | --- | --- | --- |",
+    ]
+    for row in matrix["cells"]:
+        if row["verdict"] == "PLAN":
+            prims = ", ".join(row["primitives"]) or "XLA-only"
+            detail = f"`{row['plan_path']}` ({prims})"
+        elif row["verdict"] == "REFUSE":
+            detail = f"`{row['code']}` ({row['exc']})"
+        else:
+            detail = row.get("error", "?")
+        lines.append(f"| `{row['id']}` | {row['feature']} | "
+                     f"{row['verdict']} | {detail} |")
+    return "\n".join(lines)

@@ -33,7 +33,7 @@ _IGNORE_RE = re.compile(
 _SCOPE_RE = re.compile(r"^#\s*graftlint:\s*scope=([a-z]+)\s*$")
 
 #: scopes a file may claim / be classified into
-SCOPES = ("model", "core", "tools", "tests", "other")
+SCOPES = ("model", "core", "service", "tools", "tests", "other")
 
 
 def _comments(src: str):
@@ -58,6 +58,24 @@ def pragma_lines(src: str) -> dict[int, frozenset[str] | None]:
             names = m.group(1)
             out[line] = (None if names is None else frozenset(
                 n.strip() for n in names.split(",") if n.strip()))
+    return out
+
+
+def validate_pragmas(src: str, known) -> list[tuple[int, str]]:
+    """(line, name) for every bracketed ignore naming a rule not in
+    ``known``.  A typo'd name is a suppression that guards NOTHING
+    while looking auditable — round 19 rejects it by name instead of
+    silently accepting it (``pragma_lines`` itself stays parse-only so
+    docs and tests can use placeholder names)."""
+    out: list[tuple[int, str]] = []
+    for line, _col, text in _comments(src):
+        m = _IGNORE_RE.search(text)
+        if m is None or m.group(1) is None:
+            continue
+        for name in m.group(1).split(","):
+            name = name.strip()
+            if name and name not in known:
+                out.append((line, name))
     return out
 
 

@@ -4,10 +4,14 @@
 # (tools/lint_fallback.py — same enforced rule families), so hermetic
 # containers without ruff still gate on a clean pass.  Either way the
 # graftlint AST pass (tools/graftlint, --ast-only: the seconds-fast,
-# jax-free subset of the repo-specific rules) runs on top — the full
-# graftlint suite (abstract-eval audit + config contracts) is its own
-# measure_all.sh step 0.5.  Wired into tools/measure_all.sh as step 0:
-# a measurement pass from a dirty tree wastes chip hours.
+# jax-free subset of the repo-specific rules) runs on top, then the
+# capability-lattice plan audit's fast subset (--plan-fast: the
+# planner's verdict vs the real entry point on the seconds-scale
+# cells) — the full graftlint suite (abstract-eval audit + config
+# contracts + the whole lattice) is its own measure_all.sh step 0.5,
+# and the golden-matrix diff is step 0.6.  Wired into
+# tools/measure_all.sh as step 0: a measurement pass from a dirty
+# tree wastes chip hours.
 set -u
 cd "$(dirname "$0")/.."
 rc=0
@@ -20,4 +24,6 @@ else
   python tools/lint_fallback.py || rc=1
 fi
 python -m tools.graftlint --ast-only || rc=1
+env JAX_PLATFORMS=cpu python -m tools.graftlint \
+    --no-audit --no-contracts --plan-fast || rc=1
 exit $rc

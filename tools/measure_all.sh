@@ -134,9 +134,11 @@ if [ "${PIPESTATUS[0]}" -ne 0 ]; then
 fi
 # 0.5. graftlint preflight (CPU-only, ~1 min): the JAX-specific static
 # suite — AST rules, the abstract-eval audit over the full simulator
-# config matrix (no sim executed), and the config thread-or-refuse
-# contracts.  Exactly the silent regressions (f64 promotion, dropped
-# donation, kernel-contract drift) that would waste the chip window.
+# config matrix (no sim executed), the config thread-or-refuse
+# contracts, and the capability-lattice plan audit (every lattice cell
+# must PLAN or REFUSE exactly as models/plan.py says).  Exactly the
+# silent regressions (f64 promotion, dropped donation, kernel-contract
+# drift, refusal-string drift) that would waste the chip window.
 echo "=== graftlint preflight ===" | tee -a "$log"
 env JAX_PLATFORMS=cpu python -m tools.graftlint 2>&1 | tee -a "$log"
 if [ "${PIPESTATUS[0]}" -ne 0 ]; then
@@ -144,6 +146,28 @@ if [ "${PIPESTATUS[0]}" -ne 0 ]; then
     | tee -a "$log"
   sync_log
   exit 4
+fi
+# 0.6. capability-matrix gate (CPU-only): emit the planner's verdict
+# over the whole lattice and diff against the committed golden matrix.
+# A PLAN->REFUSE flip or a refusal-string drift is a regression (a
+# REFUSE->PLAN lift is a note — capability only grows).
+echo "=== planstat --check gate ===" | tee -a "$log"
+env JAX_PLATFORMS=cpu python -m tools.graftlint --emit-matrix \
+    > /tmp/plan_matrix.json 2>>"$log"
+env JAX_PLATFORMS=cpu python tools/planstat.py /tmp/plan_matrix.json \
+    --check PLAN_r19.json 2>&1 | tee -a "$log"
+plrc=${PIPESTATUS[0]}
+if [ "$plrc" -eq 2 ]; then
+  echo "!! planstat gate failed — unusable capability matrix (emit" \
+      "crashed or schema drift?)" | tee -a "$log"
+  sync_log
+  exit 15
+elif [ "$plrc" -ne 0 ]; then
+  echo "!! planstat gate failed — a lattice cell regressed" \
+      "PLAN->REFUSE, a refusal string drifted from the golden" \
+      "matrix, or a cell failed to classify" | tee -a "$log"
+  sync_log
+  exit 15
 fi
 # 1. hardware kernel-identity artifact (small run, judge deliverable)
 run s1 1800 python tools/kernel_identity.py 200000 KERNEL_IDENTITY_r05.json

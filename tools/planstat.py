@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""planstat: inspect a capability-matrix artifact and gate the
+round-20 planner claims against the committed golden matrix.
+
+    env JAX_PLATFORMS=cpu python -m tools.graftlint --emit-matrix \
+        > /tmp/plan_matrix.json
+    python tools/planstat.py /tmp/plan_matrix.json
+    python tools/planstat.py /tmp/plan_matrix.json --check PLAN_r19.json
+
+The artifact is ``planaudit.capability_matrix()`` serialized: every
+cell of the feature lattice with the planner's verdict — ``PLAN``
+(plan path + declared/forbidden primitives) or ``REFUSE`` (named
+code, exact message, exception class).  Prints a per-path verdict
+summary.  Exit codes (the servestat --check convention):
+
+  0  clean — every lattice cell classified; with --check, no cell
+     regressed (a REFUSE->PLAN lift or a brand-new cell is reported
+     as a note, not a failure: capability only grew)
+  1  regression: an ERROR verdict (an unclassifiable lattice cell),
+     a baseline cell missing from the current matrix, a PLAN cell
+     that now REFUSES, a refusal whose named code / exact message /
+     exception class drifted from the golden matrix, or a PLAN
+     cell whose declared-primitive set shrank or forbidden set grew
+  2  unusable input: missing/unparseable artifact or baseline, wrong
+     schema, or an empty cell list (the planner claims can't be
+     checked)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+SCHEMA = "plan-matrix-v1"
+
+
+def load(path: str) -> dict:
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"planstat: cannot read {path}: {e}", file=sys.stderr)
+        raise SystemExit(2)
+    if obj.get("schema") != SCHEMA:
+        print(f"planstat: {path} is not a {SCHEMA} artifact "
+              f"(schema={obj.get('schema')!r})", file=sys.stderr)
+        raise SystemExit(2)
+    if not obj.get("cells"):
+        print(f"planstat: {path} carries no lattice cells — the "
+              "planner claims cannot be checked", file=sys.stderr)
+        raise SystemExit(2)
+    return obj
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="planstat", description=__doc__)
+    ap.add_argument("artifact")
+    ap.add_argument("--check", metavar="BASELINE",
+                    help="committed golden matrix to gate against")
+    ns = ap.parse_args(argv)
+
+    cur = load(ns.artifact)
+    rc = 0
+
+    by_path: dict[str, list[dict]] = {}
+    for row in cur["cells"]:
+        by_path.setdefault(row["path"], []).append(row)
+    for path, rows in by_path.items():
+        plans = sum(r["verdict"] == "PLAN" for r in rows)
+        refuses = sum(r["verdict"] == "REFUSE" for r in rows)
+        errors = sum(r["verdict"] not in ("PLAN", "REFUSE")
+                     for r in rows)
+        bits = f"PLAN={plans} REFUSE={refuses}"
+        if errors:
+            bits += f" ERROR={errors}"
+        print(f"  {path:<28s} {bits}")
+
+    unclassified = [r for r in cur["cells"]
+                    if r["verdict"] not in ("PLAN", "REFUSE")]
+    if unclassified:
+        print(f"planstat: {len(unclassified)} lattice cell(s) did not "
+              "classify (first: "
+              f"{unclassified[0]['id']}: "
+              f"{unclassified[0].get('error')}) — the planner must "
+              "return one ExecutionPlan or one named refusal for "
+              "EVERY cell", file=sys.stderr)
+        rc = 1
+
+    if ns.check:
+        base = load(ns.check)
+        cur_by_id = {r["id"]: r for r in cur["cells"]}
+        for brow in base["cells"]:
+            crow = cur_by_id.get(brow["id"])
+            if crow is None:
+                print(f"planstat: baseline cell {brow['id']!r} "
+                      "missing from the current matrix — the lattice "
+                      "shrank", file=sys.stderr)
+                rc = 1
+                continue
+            bv, cv = brow["verdict"], crow["verdict"]
+            if bv == "PLAN" and cv == "REFUSE":
+                print(f"planstat: {brow['id']} regressed PLAN -> "
+                      f"REFUSE ({crow.get('code')}: "
+                      f"{crow.get('message')!r})", file=sys.stderr)
+                rc = 1
+            elif bv == "REFUSE" and cv == "REFUSE":
+                for key in ("code", "message", "exc"):
+                    if brow.get(key) != crow.get(key):
+                        print(f"planstat: {brow['id']} refusal {key} "
+                              f"drifted: {brow.get(key)!r} -> "
+                              f"{crow.get(key)!r}", file=sys.stderr)
+                        rc = 1
+            elif bv == "PLAN" and cv == "PLAN":
+                lost = [p for p in brow.get("primitives", ())
+                        if p not in crow.get("primitives", ())]
+                if lost:
+                    print(f"planstat: {brow['id']} no longer declares "
+                          f"primitives {lost}", file=sys.stderr)
+                    rc = 1
+                dropped = [p for p in brow.get("forbidden", ())
+                           if p not in crow.get("forbidden", ())]
+                if dropped:
+                    print(f"planstat: {brow['id']} dropped forbidden "
+                          f"primitives {dropped}", file=sys.stderr)
+                    rc = 1
+            elif bv == "REFUSE" and cv == "PLAN":
+                print(f"planstat: note: {brow['id']} lifted "
+                      "REFUSE -> PLAN (capability grew)")
+        new = [i for i in cur_by_id
+               if i not in {r["id"] for r in base["cells"]}]
+        if new:
+            print(f"planstat: note: {len(new)} new lattice cell(s) "
+                  f"vs baseline: {sorted(new)[:4]}...")
+
+    if rc == 0:
+        print(f"planstat: OK — {len(cur['cells'])} cells, 100% "
+              "classified"
+              + (" , golden matrix holds" if ns.check else ""))
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

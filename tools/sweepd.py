@@ -85,19 +85,16 @@ def server_capability(*, kernel: bool = False, batch: int = 1,
     else the named reason the server refuses it.  Callers raise the
     reason verbatim, so refusals stay string-stable for tests and for
     graftlint's probe-refusal registry (round 18: the inline
-    ``--devices`` string match lifted here)."""
-    if kernel and batch != 1:
-        # the pallas kernel has no vmap rule: the kernel-path server
-        # is the SEQUENTIAL zero-recompile demonstration
-        return ("kernel-path sweepd serves scenarios sequentially "
-                "(no vmap rule for the pallas step): use batch=1")
-    if kernel and devices:
-        return ("sweepd: --devices shards the batched XLA "
-                "dispatch; the kernel-path server is the "
-                "sequential demonstration — drive the sharded "
-                "kernel through make_gossip_step(shard_mesh=...) "
-                "directly instead")
-    return None
+    ``--devices`` string match lifted here).
+
+    Since round 20 this is a thin call onto the capability planner
+    (models/plan.py) — every refusal string is defined THERE, once."""
+    from go_libp2p_pubsub_tpu.models import plan as _plan
+
+    verdict = _plan.plan_serving(kernel=kernel, batch=batch,
+                                 devices=devices)
+    return (None if isinstance(verdict, _plan.ExecutionPlan)
+            else verdict.message)
 
 
 def _kernel_attack_axis(gs, receive_block: int):
